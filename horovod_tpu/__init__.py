@@ -1,0 +1,163 @@
+"""horovod_tpu — a TPU-native distributed training framework with the
+capability surface of Horovod 0.15.2, re-designed for JAX/XLA.
+
+Two data planes:
+
+* **Compiled SPMD path** (`horovod_tpu.ops`, `horovod_tpu.jax`): collectives
+  are XLA ops (`psum`/`all_gather`/`ppermute`) over a named device mesh,
+  lowered onto the TPU ICI fabric.  This replaces the reference's entire
+  background-thread + MPI/NCCL machinery for anything inside `jit`.
+* **Eager path** (this module): Horovod's dynamic named-tensor semantics —
+  async handles, rank-0 negotiation, tensor fusion, stall detection — served
+  by a native C++ engine over TCP for multi-process CPU/host tensors
+  (`horovod_tpu.runtime.native`), with single-process fast paths.
+
+Top-level API mirrors `horovod.torch`/`horovod.tensorflow` basics
+(`/root/reference/horovod/common/__init__.py:51-154`).
+"""
+
+from __future__ import annotations
+
+import itertools
+
+import numpy as np
+
+from horovod_tpu.compression import Compression
+from horovod_tpu.runtime import state as _state
+from horovod_tpu.runtime.state import (
+    init,
+    is_initialized,
+    shutdown,
+    rank,
+    size,
+    local_rank,
+    local_size,
+    cross_rank,
+    cross_size,
+    mpi_threads_supported,
+)
+
+__version__ = "0.1.0"
+
+# Average is the default for gradient allreduce, matching the reference
+# (`/root/reference/horovod/torch/mpi_ops.py:86-121`).
+Sum = "sum"
+Average = "avg"
+
+
+def _as_numpy(tensor) -> np.ndarray:
+    arr = np.asarray(tensor)
+    if arr.dtype == object:
+        raise TypeError(f"unsupported tensor type {type(tensor)!r}")
+    return arr
+
+
+def _auto_name(prefix: str, name: str | None, handle_hint: str = "") -> str:
+    # Reference names anonymous ops "<op>.noname.<n>"
+    # (`/root/reference/horovod/torch/mpi_ops.py:156-176`).  itertools.count
+    # keeps the increment atomic for multi-threaded callers.
+    if name is not None:
+        return f"{prefix}.{name}"
+    return f"{prefix}.noname.{next(_auto_name.counter)}"
+
+
+_auto_name.counter = itertools.count(1)
+
+
+# --------------------------------------------------------------------------
+# Synchronous eager collectives (numpy in, numpy out)
+# --------------------------------------------------------------------------
+
+def allreduce(tensor, average: bool = True, name: str | None = None,
+              compression=Compression.none) -> np.ndarray:
+    """Sum (or average) across all processes."""
+    arr = _as_numpy(tensor)
+    comp, ctx = compression.compress(arr)
+    if compression is Compression.int8:
+        # Per-rank int8 scales cannot be summed; model the quantization
+        # error locally and reduce in the original dtype.  (The native
+        # engine applies true shared-scale wire quantization internally.)
+        comp, ctx = compression.decompress(comp, ctx), None
+    out = _state.engine().allreduce(comp, _auto_name("allreduce", name))
+    out = compression.decompress(out, ctx)
+    if average:
+        out = out / size()
+    return out
+
+
+def allgather(tensor, name: str | None = None) -> np.ndarray:
+    """Concatenate values from all processes along dim 0.  First dims may
+    differ across ranks; other dims must match (reference
+    `/root/reference/horovod/common/operations.cc:387-452`)."""
+    return _state.engine().allgather(_as_numpy(tensor), _auto_name("allgather", name))
+
+
+def broadcast(tensor, root_rank: int, name: str | None = None) -> np.ndarray:
+    """Every process receives root_rank's value."""
+    return _state.engine().broadcast(
+        _as_numpy(tensor), root_rank, _auto_name("broadcast", name)
+    )
+
+
+def alltoall(tensor, name: str | None = None) -> np.ndarray:
+    """Scatter dim-0 slices to each rank and gather their slices (new
+    capability; absent from the reference)."""
+    return _state.engine().alltoall(_as_numpy(tensor), _auto_name("alltoall", name))
+
+
+def barrier() -> None:
+    _state.engine().barrier()
+
+
+# --------------------------------------------------------------------------
+# Asynchronous API with handles
+# --------------------------------------------------------------------------
+
+def allreduce_async(tensor, average: bool = True, name: str | None = None) -> int:
+    arr = _as_numpy(tensor)
+    engine = _state.engine()
+    handle = engine.allreduce_async(arr, _auto_name("allreduce", name))
+    if average:
+        # tracked on the engine so handle-id reuse after shutdown()/init()
+        # can never inherit a stale average flag
+        engine.average_handles.add(handle)
+    return handle
+
+
+def allgather_async(tensor, name: str | None = None) -> int:
+    return _state.engine().allgather_async(_as_numpy(tensor), _auto_name("allgather", name))
+
+
+def broadcast_async(tensor, root_rank: int, name: str | None = None) -> int:
+    return _state.engine().broadcast_async(
+        _as_numpy(tensor), root_rank, _auto_name("broadcast", name)
+    )
+
+
+def poll(handle: int) -> bool:
+    """True when the async op is complete and `synchronize` will not block
+    (reference `/root/reference/horovod/torch/mpi_ops.py:395-409`)."""
+    return _state.engine().poll(handle)
+
+
+def synchronize(handle: int):
+    """Wait for an async op and return its result, raising on cross-rank
+    errors instead of hanging."""
+    engine = _state.engine()
+    out = engine.synchronize(handle)
+    if handle in engine.average_handles:
+        engine.average_handles.discard(handle)
+        out = out / size()
+    return out
+
+
+__all__ = [
+    "init", "shutdown", "is_initialized",
+    "rank", "size", "local_rank", "local_size", "cross_rank", "cross_size",
+    "mpi_threads_supported",
+    "allreduce", "allgather", "broadcast", "alltoall", "barrier",
+    "allreduce_async", "allgather_async", "broadcast_async",
+    "poll", "synchronize",
+    "Compression", "Sum", "Average",
+    "__version__",
+]

@@ -1,0 +1,135 @@
+"""Gradient wire compression.
+
+Reference: ``/root/reference/horovod/tensorflow/compression.py:20-75`` and the
+identical torch twin — a ``Compressor`` with ``compress``/``decompress`` and a
+``Compression`` namespace exposing ``none`` and ``fp16``.
+
+TPU-native additions: ``bf16`` (the MXU-preferred 16-bit format — fp16 on TPU
+costs extra conversions and loses exponent range) and ``int8`` stochastic-free
+linear quantization for bandwidth-bound DCN links.  All compressors are pure
+functions of arrays, so they work identically on the eager path (numpy) and
+inside ``jit`` (jax arrays).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+
+def _xp(tensor):
+    """numpy for eager ndarrays, jax.numpy for traced/jax values."""
+    import numpy as np
+
+    if isinstance(tensor, np.ndarray):
+        return np
+    import jax.numpy as jnp
+
+    return jnp
+
+
+class Compressor:
+    """Interface for compressing tensors before the collective and
+    decompressing after."""
+
+    @staticmethod
+    def compress(tensor) -> tuple[Any, Any]:
+        """Returns (compressed_tensor, context_for_decompress)."""
+        raise NotImplementedError
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        raise NotImplementedError
+
+
+class NoneCompressor(Compressor):
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
+class FP16Compressor(Compressor):
+    """Cast floating tensors to fp16 on the wire, restore dtype after."""
+
+    @staticmethod
+    def compress(tensor):
+        xp = _xp(tensor)
+        dtype = tensor.dtype
+        if xp.issubdtype(dtype, xp.floating) and dtype != xp.float16:
+            return tensor.astype(xp.float16), dtype
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        if ctx is None:
+            return tensor
+        return tensor.astype(ctx)
+
+
+class BF16Compressor(Compressor):
+    """Cast to bfloat16 on the wire — native on TPU (MXU/ICI), full fp32
+    exponent range, no custom reduction op needed (the reference had to
+    register a custom MPI fp16 sum, ``/root/reference/horovod/common/half.cc:27-75``)."""
+
+    @staticmethod
+    def compress(tensor):
+        import ml_dtypes
+        import numpy as np
+
+        xp = _xp(tensor)
+        bf16 = ml_dtypes.bfloat16 if xp is np else None
+        dtype = tensor.dtype
+        if xp.issubdtype(dtype, xp.floating):
+            if xp is np:
+                if dtype != bf16:
+                    return tensor.astype(bf16), dtype
+            else:
+                import jax.numpy as jnp
+
+                if dtype != jnp.bfloat16:
+                    return tensor.astype(jnp.bfloat16), dtype
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        if ctx is None:
+            return tensor
+        return tensor.astype(ctx)
+
+
+class Int8Compressor(Compressor):
+    """Symmetric linear int8 quantization with a per-tensor fp32 scale.
+
+    Intended for DCN-crossing gradients where bandwidth, not precision,
+    dominates.  Reduction happens on the dequantized values (compress is
+    applied before, decompress after the collective), so this trades 4x wire
+    bytes for one quantization error per hop.
+    """
+
+    @staticmethod
+    def compress(tensor):
+        xp = _xp(tensor)
+        if not xp.issubdtype(tensor.dtype, xp.floating):
+            return tensor, None
+        scale = xp.maximum(xp.max(xp.abs(tensor)), 1e-12) / 127.0
+        q = xp.clip(xp.round(tensor / scale), -127, 127).astype(xp.int8)
+        return q, (tensor.dtype, scale)
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        if ctx is None:
+            return tensor
+        dtype, scale = ctx
+        return (tensor.astype(dtype)) * scale
+
+
+class Compression:
+    """Optional gradient compression algorithm used during allreduce."""
+
+    none = NoneCompressor
+    fp16 = FP16Compressor
+    bf16 = BF16Compressor
+    int8 = Int8Compressor

@@ -1,0 +1,19 @@
+from horovod_tpu.ops.collective_ops import (
+    allreduce,
+    grouped_allreduce,
+    allgather,
+    broadcast,
+    reducescatter,
+    alltoall,
+    ppermute,
+    ring_shift,
+    barrier,
+    axis_size,
+    axis_rank,
+)
+
+__all__ = [
+    "allreduce", "grouped_allreduce", "allgather", "broadcast",
+    "reducescatter", "alltoall", "ppermute", "ring_shift", "barrier",
+    "axis_size", "axis_rank",
+]

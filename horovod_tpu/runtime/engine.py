@@ -1,0 +1,176 @@
+"""Eager-path collective engines.
+
+The reference routes every eager collective through a C++ background thread
+(``/root/reference/horovod/common/operations.cc:2472-2591`` enqueue API +
+``RunLoopOnce`` negotiation).  Here the same role is split:
+
+* :class:`SingleProcessEngine` — size-1 semantics (allreduce is identity,
+  allgather is itself, broadcast is identity), mirroring how the reference
+  behaves under ``mpirun -np 1``.
+* :class:`NativeEngine` — ctypes binding to the C++ core
+  (``csrc/``): TCP rendezvous control plane, rank-0 coordinator
+  negotiation, tensor fusion, ring data plane.  Loaded lazily so the pure
+  JAX/SPMD path never needs the native library.
+
+Handles follow the reference's ``handle_manager``
+(``/root/reference/horovod/torch/handle_manager.h:31-42``): an int handle maps
+to a completion slot; ``poll`` and ``synchronize`` query it.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable
+
+import numpy as np
+
+_SUM = "sum"
+_AVG = "avg"
+
+
+class HandleManager:
+    """int handle -> (done, result, error) with mutex, reference-style."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._next = 0
+        self._results: dict[int, tuple[bool, Any, Exception | None]] = {}
+
+    def allocate(self) -> int:
+        with self._lock:
+            handle = self._next
+            self._next += 1
+            self._results[handle] = (False, None, None)
+            return handle
+
+    def mark_done(self, handle: int, result: Any = None, error: Exception | None = None):
+        with self._lock:
+            self._results[handle] = (True, result, error)
+
+    def poll(self, handle: int) -> bool:
+        with self._lock:
+            if handle not in self._results:
+                raise ValueError(f"unknown handle {handle}")
+            return self._results[handle][0]
+
+    def wait(self, handle: int, timeout: float | None = None) -> Any:
+        import time
+
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            with self._lock:
+                if handle not in self._results:
+                    raise ValueError(f"unknown handle {handle}")
+                done, result, error = self._results[handle]
+                if done:
+                    del self._results[handle]
+                    if error is not None:
+                        raise error
+                    return result
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(f"handle {handle} not complete")
+            time.sleep(0.0005)
+
+
+class Engine:
+    """Abstract eager collective engine."""
+
+    name = "abstract"
+
+    def __init__(self) -> None:
+        self.handles = HandleManager()
+        # handles whose results the frontend must divide by world size;
+        # engine-scoped so ids can't leak across shutdown()/init() cycles
+        self.average_handles: set[int] = set()
+
+    # -- sync API ----------------------------------------------------------
+    def allreduce(self, array: np.ndarray, name: str, op: str = _SUM) -> np.ndarray:
+        return self.handles.wait(self.allreduce_async(array, name, op))
+
+    def allgather(self, array: np.ndarray, name: str) -> np.ndarray:
+        return self.handles.wait(self.allgather_async(array, name))
+
+    def broadcast(self, array: np.ndarray, root_rank: int, name: str) -> np.ndarray:
+        return self.handles.wait(self.broadcast_async(array, root_rank, name))
+
+    def alltoall(self, array: np.ndarray, name: str) -> np.ndarray:
+        return self.handles.wait(self.alltoall_async(array, name))
+
+    # -- async API (must be implemented) -----------------------------------
+    def allreduce_async(self, array, name, op=_SUM) -> int:
+        raise NotImplementedError
+
+    def allgather_async(self, array, name) -> int:
+        raise NotImplementedError
+
+    def broadcast_async(self, array, root_rank, name) -> int:
+        raise NotImplementedError
+
+    def alltoall_async(self, array, name) -> int:
+        raise NotImplementedError
+
+    def poll(self, handle: int) -> bool:
+        return self.handles.poll(handle)
+
+    def synchronize(self, handle: int, timeout: float | None = None):
+        return self.handles.wait(handle, timeout)
+
+    def barrier(self) -> None:
+        self.allreduce(np.zeros((1,), np.float32), "__barrier__")
+
+    def shutdown(self) -> None:
+        pass
+
+
+class SingleProcessEngine(Engine):
+    """Size-1 world: collectives are copies, completing immediately."""
+
+    name = "single"
+
+    def _complete(self, result) -> int:
+        handle = self.handles.allocate()
+        self.handles.mark_done(handle, result)
+        return handle
+
+    def allreduce_async(self, array, name, op=_SUM) -> int:
+        return self._complete(np.array(array, copy=True))
+
+    def allgather_async(self, array, name) -> int:
+        return self._complete(np.array(array, copy=True))
+
+    def broadcast_async(self, array, root_rank, name) -> int:
+        if root_rank != 0:
+            raise ValueError(
+                f"broadcast root_rank {root_rank} out of range for size-1 world"
+            )
+        return self._complete(np.array(array, copy=True))
+
+    def alltoall_async(self, array, name) -> int:
+        return self._complete(np.array(array, copy=True))
+
+
+def create_engine(topology, comm_ranks=None) -> Engine:
+    """Pick the engine for the detected topology.
+
+    size==1 -> SingleProcessEngine; otherwise the native C++ engine
+    (TCP-rendezvous'd coordinator + ring data plane).
+    """
+    # topology has already been re-ranked into the sub-world when comm_ranks
+    # was given, so a 1-member sub-communicator needs no peers either.
+    if topology.size == 1:
+        return SingleProcessEngine()
+    try:
+        from horovod_tpu.runtime.native import NativeEngine
+    except ImportError as e:
+        import os
+
+        csrc = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))), "csrc")
+        hint = (f"build it with `make -C {csrc}`" if os.path.isdir(csrc)
+                else "this build does not include the native engine sources")
+        raise RuntimeError(
+            f"multi-process world (rank {topology.rank} of {topology.size}) "
+            f"requires the native collective engine; {hint}"
+        ) from e
+
+    return NativeEngine(topology, comm_ranks=comm_ranks)

@@ -1,0 +1,147 @@
+"""Global runtime state — the analog of the reference's ``HorovodGlobalState``
+singleton (``/root/reference/horovod/common/operations.cc:115-252``) minus
+everything XLA now owns (fusion buffers, streams, communicators).
+
+Python-level state only tracks: initialization flag, topology, the eager
+engine, and shutdown hooks.  The compiled SPMD path carries no global state at
+all — meshes and axis names are explicit arguments.
+"""
+
+from __future__ import annotations
+
+import atexit
+import threading
+
+from horovod_tpu.utils.topo import Topology, detect_topology
+
+
+class _State:
+    def __init__(self) -> None:
+        self.lock = threading.Lock()
+        self.initialized = False
+        self.topology: Topology | None = None
+        self.engine = None
+
+
+_state = _State()
+
+
+class NotInitializedError(RuntimeError):
+    def __init__(self) -> None:
+        super().__init__(
+            "horovod_tpu has not been initialized; call horovod_tpu.init() first"
+        )
+
+
+def init(comm=None) -> None:
+    """Initialize the runtime.
+
+    ``comm`` may be a list of global ranks forming a sub-world (the
+    reference's ``init(comm=[ranks...])``,
+    ``/root/reference/horovod/common/__init__.py:58-84``).  Re-init after
+    shutdown is supported; double-init is a no-op, matching the reference's
+    ``InitializeHorovodOnce`` latch.
+    """
+    with _state.lock:
+        if _state.initialized:
+            return
+        topology = detect_topology()
+        if comm is not None:
+            ranks = sorted(int(r) for r in comm)
+            if topology.rank in ranks:
+                # re-rank inside the sub-world
+                topology = Topology(
+                    rank=ranks.index(topology.rank),
+                    size=len(ranks),
+                    local_rank=0,
+                    local_size=len(ranks),
+                    cross_rank=0,
+                    cross_size=1,
+                    num_local_devices=topology.num_local_devices,
+                    platform=topology.platform,
+                )
+            else:
+                # processes outside the sub-communicator do not participate
+                topology = Topology(
+                    rank=-1,
+                    size=0,
+                    local_rank=-1,
+                    local_size=0,
+                    cross_rank=-1,
+                    cross_size=0,
+                    num_local_devices=topology.num_local_devices,
+                    platform=topology.platform,
+                )
+        from horovod_tpu.runtime.engine import create_engine
+
+        if topology.size == 0:
+            engine = None
+        else:
+            engine = create_engine(topology, comm_ranks=comm)
+        _state.topology = topology
+        _state.engine = engine
+        _state.initialized = True
+
+
+def shutdown() -> None:
+    with _state.lock:
+        if not _state.initialized:
+            return
+        if _state.engine is not None:
+            _state.engine.shutdown()
+        _state.engine = None
+        _state.topology = None
+        _state.initialized = False
+
+
+atexit.register(shutdown)
+
+
+def is_initialized() -> bool:
+    return _state.initialized
+
+
+def _topology() -> Topology:
+    if not _state.initialized or _state.topology is None:
+        raise NotInitializedError()
+    return _state.topology
+
+
+def engine():
+    if not _state.initialized:
+        raise NotInitializedError()
+    if _state.engine is None:
+        raise RuntimeError("this process is outside the active sub-communicator")
+    return _state.engine
+
+
+def rank() -> int:
+    return _topology().rank
+
+
+def size() -> int:
+    return _topology().size
+
+
+def local_rank() -> int:
+    return _topology().local_rank
+
+
+def local_size() -> int:
+    return _topology().local_size
+
+
+def cross_rank() -> int:
+    return _topology().cross_rank
+
+
+def cross_size() -> int:
+    return _topology().cross_size
+
+
+def mpi_threads_supported() -> bool:
+    """Compat shim: the TPU runtime has no MPI; the engine is always
+    thread-safe (reference: ``horovod_mpi_threads_supported``,
+    ``operations.cc:2461-2468``)."""
+    _topology()
+    return True

@@ -1,0 +1,198 @@
+"""Device/mesh topology helpers.
+
+TPU-native replacement for the reference's MPI communicator topology
+(``/root/reference/horovod/common/operations.cc:1760-1797``: WORLD dup,
+``MPI_Comm_split_type(SHARED)`` for the local communicator, split-by-local-rank
+for the cross communicator).  On TPU, process placement comes from the JAX
+runtime (``jax.process_index``/``jax.local_devices``) and the device mesh is an
+explicit :class:`jax.sharding.Mesh` over which XLA lowers collectives onto the
+ICI fabric; the "local vs cross" split of the reference maps to
+intra-slice (ICI) vs inter-slice (DCN) mesh axes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import os
+from typing import Mapping, Sequence
+
+import numpy as np
+
+
+def _jax():
+    import jax
+
+    return jax
+
+
+def available_devices(platform: str | None = None):
+    """All visible devices, optionally restricted to a platform.
+
+    Falls back to the default backend when the requested platform is absent
+    (e.g. asking for ``tpu`` on a CPU-only host).
+    """
+    jax = _jax()
+    if platform is None:
+        return jax.devices()
+    try:
+        return jax.devices(platform)
+    except RuntimeError:
+        return jax.devices()
+
+
+def cpu_devices(count: int | None = None):
+    """CPU devices (the virtual-device test fabric).
+
+    Requires ``--xla_force_host_platform_device_count=N`` in ``XLA_FLAGS``
+    (set by ``tests/conftest.py``) to expose more than one.
+    """
+    jax = _jax()
+    devs = jax.devices("cpu")
+    if count is not None:
+        if len(devs) < count:
+            raise RuntimeError(
+                f"need {count} CPU devices but only {len(devs)} are visible; "
+                "set XLA_FLAGS=--xla_force_host_platform_device_count="
+                f"{count} before importing jax"
+            )
+        devs = devs[:count]
+    return devs
+
+
+def make_mesh(axes: Mapping[str, int], devices: Sequence | None = None):
+    """Build a named :class:`jax.sharding.Mesh` from ``{axis: size}``.
+
+    ``devices`` defaults to all visible devices. The product of the axis sizes
+    must divide the device count; surplus devices are dropped (so a 2x2 mesh
+    can be built on 8 devices for tests).
+    """
+    from jax.sharding import Mesh
+
+    axes = dict(axes)
+    n = math.prod(axes.values())
+    if devices is None:
+        devices = available_devices()
+    if len(devices) < n:
+        raise ValueError(
+            f"mesh {axes} needs {n} devices, only {len(devices)} available"
+        )
+    grid = np.array(devices[:n]).reshape(tuple(axes.values()))
+    return Mesh(grid, tuple(axes.keys()))
+
+
+def single_axis_mesh(axis_name: str = "hvd", devices: Sequence | None = None):
+    """A 1-D mesh over all devices — the Horovod world communicator analog."""
+    if devices is None:
+        devices = available_devices()
+    return make_mesh({axis_name: len(devices)}, devices)
+
+
+@dataclasses.dataclass(frozen=True)
+class Topology:
+    """Discovered process/device topology.
+
+    Mirrors what the reference derives from MPI communicators
+    (rank/size/local_rank/local_size/cross_rank/cross_size) but sourced from
+    the TPU runtime and launcher environment instead of ``MPI_Comm_*``.
+    """
+
+    rank: int
+    size: int
+    local_rank: int
+    local_size: int
+    cross_rank: int
+    cross_size: int
+    num_local_devices: int
+    platform: str
+
+    @property
+    def is_homogeneous(self) -> bool:
+        return self.size % self.local_size == 0
+
+
+_RANK_ENV = ("HOROVOD_TPU_RANK", "HOROVOD_RANK", "OMPI_COMM_WORLD_RANK", "PMI_RANK")
+_SIZE_ENV = ("HOROVOD_TPU_SIZE", "HOROVOD_SIZE", "OMPI_COMM_WORLD_SIZE", "PMI_SIZE")
+_LOCAL_RANK_ENV = (
+    "HOROVOD_TPU_LOCAL_RANK",
+    "HOROVOD_LOCAL_RANK",
+    "OMPI_COMM_WORLD_LOCAL_RANK",
+)
+_LOCAL_SIZE_ENV = (
+    "HOROVOD_TPU_LOCAL_SIZE",
+    "HOROVOD_LOCAL_SIZE",
+    "OMPI_COMM_WORLD_LOCAL_SIZE",
+)
+
+
+def _env_int(names: Sequence[str]) -> int | None:
+    for name in names:
+        val = os.environ.get(name)
+        if val is not None:
+            return int(val)
+    return None
+
+
+def detect_topology() -> Topology:
+    """Assign rank/local_rank from launcher env or the JAX process grid.
+
+    Resolution order:
+      1. launcher environment (``hvdrun`` sets ``HOROVOD_TPU_RANK`` etc.;
+         mpirun-style vars accepted for drop-in compatibility with the
+         reference's test harness, cf. ``/root/reference/test/common.py:25-57``)
+      2. an initialized multi-process JAX runtime
+      3. single-process defaults (rank 0 of 1)
+    """
+    rank = _env_int(_RANK_ENV)
+    size = _env_int(_SIZE_ENV)
+    if (rank is None) != (size is None):
+        missing = "world-size" if size is None else "rank"
+        raise RuntimeError(
+            f"a launcher environment variable is set but no matching {missing} "
+            "variable; refusing to silently run as a size-1 world (set both "
+            "HOROVOD_TPU_RANK and HOROVOD_TPU_SIZE or the launcher's pair)"
+        )
+    if rank is not None and not (0 <= rank < size):
+        raise RuntimeError(f"rank {rank} out of range for world size {size}")
+
+    # Probe JAX for platform/local-device info — but never *force* PJRT
+    # backend initialization from init(): plugin backends (e.g. a tunneled
+    # TPU) can block for minutes, and topology must not depend on that.  If
+    # the backend is already up we read it; otherwise env/defaults win.
+    platform = "uninitialized"
+    num_local = 0
+    jax_rank, jax_size = 0, 1
+    try:
+        import jax
+        from jax._src import xla_bridge as _xb
+
+        if _xb._backends:  # backend already initialized by the user
+            platform = jax.default_backend()
+            num_local = len(jax.local_devices())
+            jax_rank = jax.process_index()
+            jax_size = jax.process_count()
+    except Exception:  # jax missing: pure-CPU engine mode
+        platform = "none"
+
+    if rank is None:
+        rank, size = jax_rank, jax_size
+
+    local_rank = _env_int(_LOCAL_RANK_ENV)
+    local_size = _env_int(_LOCAL_SIZE_ENV)
+    if local_rank is None:
+        local_rank = 0 if size == 1 else rank  # single-host default
+    if local_size is None:
+        local_size = 1 if size == 1 else size
+
+    cross_size = max(1, size // max(1, local_size))
+    cross_rank = rank // max(1, local_size)
+    return Topology(
+        rank=rank,
+        size=size,
+        local_rank=local_rank,
+        local_size=local_size,
+        cross_rank=cross_rank,
+        cross_size=cross_size,
+        num_local_devices=num_local,
+        platform=platform,
+    )

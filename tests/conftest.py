@@ -1,0 +1,81 @@
+"""Test harness configuration.
+
+Mirrors the reference's test strategy (SURVEY.md §4): rank-parametric tests
+that pass single-process and multi-process.  The "cluster" test double here is
+a virtual 8-device CPU mesh (``--xla_force_host_platform_device_count=8``) —
+the TPU-world equivalent of the reference using real local MPI processes to
+simulate multi-node.
+
+This must run before anything imports jax's CPU backend, so it executes at
+conftest import time.  If a TPU/axon plugin already owns the default backend,
+tests still work: meshes are built explicitly from ``jax.devices("cpu")``.
+"""
+
+import os
+import sys
+
+_FLAG = "--xla_force_host_platform_device_count=8"
+if _FLAG not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (_FLAG + " " + os.environ.get("XLA_FLAGS", "")).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+import jax  # noqa: E402
+
+# The axon (tunneled-TPU) PJRT plugin registers itself via sitecustomize and
+# its backend init can block for minutes even when JAX_PLATFORMS=cpu.  Tests
+# only ever use the virtual CPU mesh, so drop the factory before any backend
+# initializes.
+jax.config.update("jax_platforms", "cpu")
+try:  # pragma: no cover - only present under the axon tunnel image
+    from jax._src import xla_bridge as _xb
+
+    _xb._backend_factories.pop("axon", None)
+except Exception:
+    pass
+
+# Eager expectation arrays may be computed on the default (TPU) backend where
+# matmuls default to bf16 — force fp32 math everywhere so CPU-mesh results and
+# eager references are comparable.
+jax.config.update("jax_default_matmul_precision", "highest")
+
+
+@pytest.fixture(scope="session")
+def cpu8():
+    import jax
+
+    devs = jax.devices("cpu")
+    assert len(devs) >= 8, (
+        "conftest must run before the CPU backend initializes; got "
+        f"{len(devs)} devices"
+    )
+    return devs[:8]
+
+
+@pytest.fixture(scope="session")
+def mesh8(cpu8):
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(cpu8).reshape(8), ("hvd",))
+
+
+@pytest.fixture(scope="session")
+def mesh2x4(cpu8):
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(cpu8).reshape(2, 4), ("dp", "tp"))
+
+
+@pytest.fixture()
+def hvd_single():
+    """Initialized single-process runtime, torn down after the test."""
+    import horovod_tpu as hvd
+
+    hvd.shutdown()
+    hvd.init()
+    yield hvd
+    hvd.shutdown()

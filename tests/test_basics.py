@@ -1,0 +1,120 @@
+"""Process-semantics tests: init/rank/size/shutdown + eager collectives.
+
+Reference analog: the rank/size assertions running under any world size in
+``test/test_tensorflow.py`` / ``test/test_torch.py`` — here exercised
+single-process (multi-process engine tests live in test_engine_multiproc.py).
+"""
+
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu.runtime.state import NotInitializedError
+
+
+def test_uninitialized_raises():
+    hvd.shutdown()
+    with pytest.raises(NotInitializedError):
+        hvd.rank()
+    with pytest.raises(NotInitializedError):
+        hvd.size()
+
+
+def test_init_rank_size(hvd_single):
+    assert hvd.rank() == 0
+    assert hvd.size() == 1
+    assert hvd.local_rank() == 0
+    assert hvd.local_size() == 1
+    assert hvd.cross_rank() == 0
+    assert hvd.cross_size() == 1
+    assert hvd.mpi_threads_supported() is True
+    assert hvd.is_initialized()
+
+
+def test_double_init_is_noop(hvd_single):
+    hvd.init()
+    assert hvd.size() == 1
+
+
+def test_reinit_after_shutdown():
+    hvd.shutdown()
+    hvd.init()
+    assert hvd.rank() == 0
+    hvd.shutdown()
+    assert not hvd.is_initialized()
+    hvd.init()
+    assert hvd.size() == 1
+    hvd.shutdown()
+
+
+def test_allreduce_single(hvd_single):
+    x = np.arange(12, dtype=np.float32).reshape(3, 4)
+    out = hvd.allreduce(x, average=False)
+    np.testing.assert_allclose(out, x)
+    out_avg = hvd.allreduce(x, average=True)
+    np.testing.assert_allclose(out_avg, x)
+
+
+def test_allreduce_dtypes(hvd_single):
+    for dtype in (np.float32, np.float64, np.int32, np.int64, np.uint8, np.int8,
+                  np.float16):
+        x = (np.arange(6) % 3).astype(dtype)
+        out = hvd.allreduce(x, average=False)
+        assert out.dtype == dtype
+        np.testing.assert_array_equal(out, x)
+
+
+def test_allgather_single(hvd_single):
+    x = np.ones((2, 3), np.float32)
+    out = hvd.allgather(x)
+    assert out.shape == (2, 3)
+    np.testing.assert_allclose(out, x)
+
+
+def test_broadcast_single(hvd_single):
+    x = np.arange(5, dtype=np.int64)
+    out = hvd.broadcast(x, root_rank=0)
+    np.testing.assert_array_equal(out, x)
+    with pytest.raises(ValueError):
+        hvd.broadcast(x, root_rank=1)  # out of range for size-1 world
+
+
+def test_async_handles(hvd_single):
+    x = np.full((4,), 3.0, np.float32)
+    h = hvd.allreduce_async(x, average=False, name="t0")
+    assert hvd.poll(h)
+    out = hvd.synchronize(h)
+    np.testing.assert_allclose(out, x)
+
+
+def test_async_many_named(hvd_single):
+    # Fusion-style burst: many named ops in flight at once (reference idiom,
+    # test/test_tensorflow.py:107).
+    handles = {
+        f"g{i}": hvd.allreduce_async(np.full((8,), float(i)), average=False,
+                                     name=f"g{i}")
+        for i in range(32)
+    }
+    for i, (name, h) in enumerate(handles.items()):
+        np.testing.assert_allclose(hvd.synchronize(h), np.full((8,), float(i)))
+
+
+def test_compression_roundtrip(hvd_single):
+    from horovod_tpu.compression import Compression
+
+    x = np.linspace(-4, 4, 64).astype(np.float32)
+    for comp in (Compression.none, Compression.fp16, Compression.bf16):
+        out = hvd.allreduce(x, average=False, compression=comp)
+        assert out.dtype == np.float32
+        np.testing.assert_allclose(out, x, atol=0.05)
+    out = hvd.allreduce(x, average=False, compression=Compression.int8)
+    np.testing.assert_allclose(out, x, atol=4 / 127 + 1e-3)
+
+
+def test_alltoall_single(hvd_single):
+    x = np.arange(4, dtype=np.float32)
+    np.testing.assert_allclose(hvd.alltoall(x), x)
+
+
+def test_barrier(hvd_single):
+    hvd.barrier()  # must not deadlock single-process

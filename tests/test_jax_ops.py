@@ -1,0 +1,173 @@
+"""Compiled-path collective ops over the virtual 8-device CPU mesh.
+
+These are the TPU data-plane semantics tests: every op the reference
+implements via MPI/NCCL (`allreduce`/`allgather`/`broadcast`) plus the
+TPU-first additions (reducescatter/alltoall/ppermute), checked for value
+correctness and gradient correctness (the reference's grad tests,
+test/test_tensorflow.py:334,592,723).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import shard_map
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import horovod_tpu.ops as ops
+
+
+def smap(mesh, in_specs, out_specs, **kw):
+    return functools.partial(shard_map, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, **kw)
+
+
+def test_allreduce_sum(mesh8):
+    x = jnp.arange(8.0)  # shard i holds [i]
+    f = smap(mesh8, P("hvd"), P("hvd"))(
+        lambda x: ops.allreduce(x, "hvd", average=False))
+    np.testing.assert_allclose(f(x), np.full(8, 28.0))
+
+
+def test_allreduce_average(mesh8):
+    x = jnp.arange(8.0)
+    f = smap(mesh8, P("hvd"), P("hvd"))(
+        lambda x: ops.allreduce(x, "hvd", average=True))
+    np.testing.assert_allclose(f(x), np.full(8, 3.5))
+
+
+def test_allreduce_min_max(mesh8):
+    x = jnp.arange(8.0)
+    fmin = smap(mesh8, P("hvd"), P("hvd"))(
+        lambda x: ops.allreduce(x, "hvd", average=False, op="min"))
+    fmax = smap(mesh8, P("hvd"), P("hvd"))(
+        lambda x: ops.allreduce(x, "hvd", average=False, op="max"))
+    np.testing.assert_allclose(fmin(x), np.zeros(8))
+    np.testing.assert_allclose(fmax(x), np.full(8, 7.0))
+
+
+def test_allreduce_grad(mesh8):
+    # d/dx_i sum_j(psum(x)_j^2 / 2) summed over ranks: grad = size * x_total?
+    # Per-shard: y = psum(x); loss = y^2/2 summed globally -> dloss/dx_i = size * psum(x).
+    x = jnp.arange(8.0)
+
+    def per_shard(x):
+        y = ops.allreduce(x, "hvd", average=False)
+        return jnp.sum(y ** 2) / 2.0
+
+    loss = smap(mesh8, P("hvd"), P())(
+        lambda x: ops.allreduce(per_shard(x), "hvd", average=False))
+    g = jax.grad(lambda x: loss(x)[()])(x)
+    np.testing.assert_allclose(g, np.full(8, 8 * 28.0))
+
+
+def test_grouped_allreduce(mesh8):
+    tree = {"a": jnp.arange(8.0), "b": jnp.ones((8, 2))}
+    f = smap(mesh8, ({"a": P("hvd"), "b": P("hvd", None)},),
+             {"a": P("hvd"), "b": P("hvd", None)})(
+        lambda t: ops.grouped_allreduce(t, "hvd", average=False))
+    out = f(tree)
+    np.testing.assert_allclose(out["a"], np.full(8, 28.0))
+    np.testing.assert_allclose(out["b"], np.full((8, 2), 8.0))
+
+
+def test_allgather(mesh8):
+    x = jnp.arange(16.0).reshape(8, 2)  # each shard holds one row
+    f = smap(mesh8, P("hvd", None), P(None, None), check_vma=False)(
+        lambda x: ops.allgather(x, "hvd"))
+    out = f(x)
+    # every rank sees the full concat; with out_specs P(None) jax checks
+    # replication consistency
+    np.testing.assert_allclose(out, np.arange(16.0).reshape(8, 2))
+
+
+def test_allgather_grad_is_split_allreduce(mesh8):
+    # Reference: allgather grad = allreduce then split by rank sizes
+    # (tensorflow/mpi_ops.py:127-148). With uniform shards this reduces to:
+    # grad wrt local shard = sum over ranks of upstream grad at my stripe.
+    x = jnp.arange(8.0).reshape(8, 1)
+
+    def loss(x):
+        def per_shard(xs):
+            g = ops.allgather(xs, "hvd")  # (8,1) full
+            w = 1.0 + jax.lax.axis_index("hvd").astype(jnp.float32)
+            return ops.allreduce(jnp.sum(g[:, 0]) * w, "hvd", average=False)
+        return smap(mesh8, P("hvd", None), P())(per_shard)(x)[()]
+
+    g = jax.grad(loss)(x)
+    # d/dx_i = sum_r (1+r) = 36 for every element
+    np.testing.assert_allclose(g, np.full((8, 1), 36.0))
+
+
+def test_broadcast(mesh8):
+    x = jnp.arange(8.0)
+    for root in (0, 3, 7):
+        f = smap(mesh8, P("hvd"), P("hvd"))(
+            lambda x, root=root: ops.broadcast(x, root, "hvd"))
+        np.testing.assert_allclose(f(x), np.full(8, float(root)))
+
+
+def test_broadcast_grad(mesh8):
+    # Reference semantics: broadcast grad = allreduce to root, zero elsewhere
+    # (tensorflow/mpi_ops.py:168-183).
+    x = jnp.arange(8.0)
+
+    def loss(x):
+        def per_shard(xs):
+            y = ops.broadcast(xs, 2, "hvd")
+            w = 1.0 + jax.lax.axis_index("hvd").astype(jnp.float32)
+            return ops.allreduce(jnp.sum(y * w), "hvd", average=False)
+        return smap(mesh8, P("hvd"), P())(per_shard)(x)[()]
+
+    g = jax.grad(loss)(x)
+    expected = np.zeros(8)
+    expected[2] = sum(range(1, 9))  # all upstream grads flow to root
+    np.testing.assert_allclose(g, expected)
+
+
+def test_reducescatter(mesh8):
+    x = jnp.tile(jnp.arange(8.0), (8,)).reshape(8, 8)  # every rank holds 0..7
+    f = smap(mesh8, P("hvd", None), P("hvd"))(
+        lambda x: ops.reducescatter(x[0], "hvd"))
+    out = np.asarray(f(x))
+    np.testing.assert_allclose(out, np.arange(8.0) * 8)
+
+
+def test_alltoall(mesh8):
+    # rank r sends value r*8+k to rank k
+    x = jnp.arange(64.0).reshape(8, 8)
+    f = smap(mesh8, P("hvd", None), P("hvd", None))(
+        lambda x: ops.alltoall(x.reshape(8, 1), "hvd", split_axis=0,
+                               concat_axis=0).reshape(1, 8))
+    out = f(x)
+    np.testing.assert_allclose(out, np.arange(64.0).reshape(8, 8).T)
+
+
+def test_ring_shift(mesh8):
+    x = jnp.arange(8.0)
+    f = smap(mesh8, P("hvd"), P("hvd"))(
+        lambda x: ops.ring_shift(x, "hvd", shift=1))
+    np.testing.assert_allclose(f(x), np.roll(np.arange(8.0), 1))
+
+
+def test_barrier_compiles(mesh8):
+    f = smap(mesh8, P("hvd"), P())(
+        lambda x: ops.barrier("hvd") + ops.allreduce(jnp.sum(x) * 0, "hvd",
+                                                     average=False))
+    assert f(jnp.arange(8.0)).shape == ()
+
+
+def test_jit_end_to_end_sharded(mesh8):
+    # allreduce inside jit with explicit shardings; verifies the compiled
+    # path works through jax.jit + NamedSharding (not just bare shard_map).
+    sharding = NamedSharding(mesh8, P("hvd"))
+    x = jax.device_put(jnp.arange(8.0), sharding)
+
+    @jax.jit
+    def step(x):
+        return shard_map(lambda s: ops.allreduce(s, "hvd", average=True),
+                         mesh=mesh8, in_specs=P("hvd"), out_specs=P("hvd"))(x)
+
+    np.testing.assert_allclose(step(x), np.full(8, 3.5))

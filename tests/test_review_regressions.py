@@ -1,0 +1,88 @@
+"""Regression tests for review findings: NaN-safe broadcast, tuple-structured
+gradient trees, shared-scale int8 allreduce, handle-id reuse across re-init,
+and rank-env validation."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvd_top
+import horovod_tpu.jax as hvd
+import horovod_tpu.ops as ops
+
+
+def test_broadcast_ignores_nan_on_nonroot(mesh8):
+    # non-root ranks hold uninitialized garbage (NaN) — the canonical
+    # broadcast use case; the root's value must still win.
+    vals = jnp.where(jnp.arange(8.0) == 2, 5.0, jnp.nan)
+    f = functools.partial(shard_map, mesh=mesh8, in_specs=P("hvd"),
+                          out_specs=P("hvd"))(
+        lambda x: ops.broadcast(x, 2, "hvd"))
+    np.testing.assert_allclose(f(vals), np.full(8, 5.0))
+
+
+def test_allreduce_gradients_tuple_tree(mesh8):
+    # tuple-structured grads (idiomatic jax: tuples of layer params) must not
+    # be confused with (value, ctx) pairs
+    grads = (jnp.arange(8.0), jnp.ones((8, 2)))
+    f = functools.partial(
+        shard_map, mesh=mesh8,
+        in_specs=((P("hvd"), P("hvd", None)),),
+        out_specs=(P("hvd"), P("hvd", None)))(
+        lambda g: hvd.allreduce_gradients(g, "hvd", average=False))
+    out = f(grads)
+    assert len(out) == 2 and out[1] is not None
+    np.testing.assert_allclose(out[0], np.full(8, 28.0))
+    np.testing.assert_allclose(out[1], np.full((8, 2), 8.0))
+
+
+def test_int8_allreduce_shared_scale(mesh8):
+    # ranks hold 100..800; per-rank-scale int8 summing would produce garbage
+    x = jnp.arange(1.0, 9.0) * 100.0
+    f = functools.partial(shard_map, mesh=mesh8, in_specs=P("hvd"),
+                          out_specs=P("hvd"))(
+        lambda x: hvd.allreduce(x, average=False,
+                                compression=hvd.Compression.int8,
+                                axis_name="hvd"))
+    out = f(x)
+    np.testing.assert_allclose(out, np.full(8, 3600.0), rtol=0.02)
+
+
+def test_handle_average_flag_not_reused_across_reinit():
+    hvd_top.shutdown()
+    hvd_top.init()
+    h = hvd_top.allreduce_async(np.ones(2), average=True, name="stale")
+    assert h == 0
+    # never synchronized; re-init resets engine and handle ids
+    hvd_top.shutdown()
+    hvd_top.init()
+    h2 = hvd_top.allreduce_async(np.full(2, 6.0), average=False, name="fresh")
+    assert h2 == 0  # same id as the stale average handle
+    out = hvd_top.synchronize(h2)
+    np.testing.assert_allclose(out, np.full(2, 6.0))  # must NOT be divided
+    hvd_top.shutdown()
+
+
+def test_rank_env_without_size_raises(monkeypatch):
+    from horovod_tpu.utils.topo import detect_topology
+
+    monkeypatch.setenv("HOROVOD_TPU_RANK", "3")
+    for var in ("HOROVOD_TPU_SIZE", "HOROVOD_SIZE", "OMPI_COMM_WORLD_SIZE",
+                "PMI_SIZE"):
+        monkeypatch.delenv(var, raising=False)
+    with pytest.raises(RuntimeError, match="world-size"):
+        detect_topology()
+
+
+def test_rank_out_of_range_raises(monkeypatch):
+    from horovod_tpu.utils.topo import detect_topology
+
+    monkeypatch.setenv("HOROVOD_TPU_RANK", "5")
+    monkeypatch.setenv("HOROVOD_TPU_SIZE", "2")
+    with pytest.raises(RuntimeError, match="out of range"):
+        detect_topology()
