@@ -1,0 +1,111 @@
+"""Synthetic ResNet-50 training benchmark — the TPU-native analog of the
+reference's ``examples/tensorflow_synthetic_benchmark.py`` (ResNet-50,
+10 warmup batches, 10 iterations x 10 batches, synthetic ImageNet data,
+``/root/reference/examples/tensorflow_synthetic_benchmark.py:22-35``).
+
+Prints exactly one JSON line:
+  {"metric": "resnet50_images_per_sec_per_chip", "value": N,
+   "unit": "images/sec/chip", "vs_baseline": N}
+
+Baseline: the reference's published tf_cnn_benchmarks number, 1656.82
+images/sec on 16 Pascal GPUs => 103.55 images/sec/GPU
+(``/root/reference/docs/benchmarks.md:22-38``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+REFERENCE_IMAGES_PER_SEC_PER_DEVICE = 1656.82 / 16
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=128)
+    ap.add_argument("--image-size", type=int, default=224)
+    ap.add_argument("--num-warmup", type=int, default=10)
+    ap.add_argument("--num-iters", type=int, default=10)
+    ap.add_argument("--num-batches-per-iter", type=int, default=10)
+    ap.add_argument("--cpu", action="store_true",
+                    help="force the CPU backend (debug)")
+    args = ap.parse_args()
+
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        try:
+            from jax._src import xla_bridge as _xb
+
+            _xb._backend_factories.pop("axon", None)
+        except Exception:
+            pass
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from horovod_tpu.models import resnet
+    import horovod_tpu.jax as hvd
+
+    hvd.init()
+
+    platform = jax.default_backend()
+    config = resnet.ResNetConfig(depth=50, num_classes=1000)
+    params, state = resnet.init(jax.random.key(0), config)
+
+    opt = hvd.DistributedOptimizer(optax.sgd(0.01, momentum=0.9),
+                                   axis_name=None)  # single-chip: no axis
+    opt_state = opt.init(params)
+
+    rng = np.random.RandomState(0)
+    images = jnp.asarray(
+        rng.rand(args.batch_size, args.image_size, args.image_size, 3),
+        jnp.bfloat16 if platform == "tpu" else jnp.float32,
+    )
+    labels = jnp.asarray(rng.randint(0, 1000, args.batch_size), jnp.int32)
+
+    @jax.jit
+    def train_step(params, state, opt_state, images, labels):
+        (loss, new_state), grads = jax.value_and_grad(
+            resnet.loss_fn, has_aux=True
+        )(params, state, images, labels, config)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), new_state, opt_state, loss
+
+    # warmup (includes compile)
+    for _ in range(args.num_warmup):
+        params, state, opt_state, loss = train_step(
+            params, state, opt_state, images, labels
+        )
+    jax.block_until_ready(loss)
+
+    rates = []
+    for _ in range(args.num_iters):
+        t0 = time.perf_counter()
+        for _ in range(args.num_batches_per_iter):
+            params, state, opt_state, loss = train_step(
+                params, state, opt_state, images, labels
+            )
+        jax.block_until_ready(loss)
+        dt = time.perf_counter() - t0
+        rates.append(args.batch_size * args.num_batches_per_iter / dt)
+
+    value = float(np.mean(rates))
+    print(json.dumps({
+        "metric": "resnet50_images_per_sec_per_chip",
+        "value": round(value, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(value / REFERENCE_IMAGES_PER_SEC_PER_DEVICE, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

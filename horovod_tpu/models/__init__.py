@@ -1,0 +1,4 @@
+"""Model zoo: TPU-first implementations of the reference's benchmark models
+(ResNet family) plus the transformer family the north-star configs require."""
+
+from horovod_tpu.models import resnet, llama  # noqa: F401

@@ -1,0 +1,217 @@
+"""Llama-style decoder-only transformer — the long-context / FSDP flagship.
+
+The reference has no transformer (2018-era convnet benchmarks only); this
+model exists to serve the north-star config in ``BASELINE.json``: a
+Llama-3-8B-class model trained FSDP-style over a TPU mesh with optional
+tensor and sequence parallelism.  TPU-first design choices:
+
+* Layer parameters are **stacked along a leading layer axis** and the block
+  stack runs under ``lax.scan`` — one compiled layer body regardless of
+  depth (fast compiles, XLA-friendly).
+* bf16 activations / fp32 params; RMSNorm and softmax in fp32.
+* Sharding is declared, not hand-coded: :func:`param_specs` returns a
+  ``PartitionSpec`` pytree (fsdp shards the layer-stacked weight dim 1, tp
+  shards heads / ffn) and XLA/GSPMD inserts the collectives
+  (all-gather for fsdp params, psum for tp contractions) on the ICI mesh.
+* Sequence parallelism: ``apply(..., axis_name=...)`` inside ``shard_map``
+  routes attention through ring attention
+  (:mod:`horovod_tpu.parallel.ring_attention`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    d_model: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    d_ff: int = 14336
+    rope_theta: float = 500000.0
+    rms_eps: float = 1e-5
+    compute_dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @staticmethod
+    def llama3_8b() -> "LlamaConfig":
+        return LlamaConfig(vocab_size=128256, d_model=4096, n_layers=32,
+                           n_heads=32, n_kv_heads=8, d_ff=14336)
+
+    @staticmethod
+    def tiny(vocab_size: int = 256) -> "LlamaConfig":
+        """Small config for tests / dryruns."""
+        return LlamaConfig(vocab_size=vocab_size, d_model=64, n_layers=2,
+                           n_heads=4, n_kv_heads=2, d_ff=128)
+
+
+def init(rng, config: LlamaConfig):
+    """Parameters as a flat dict; per-layer weights stacked on axis 0."""
+    c = config
+    L, D, F = c.n_layers, c.d_model, c.d_ff
+    Hq, Hkv, Dh = c.n_heads, c.n_kv_heads, c.head_dim
+    k = iter(jax.random.split(rng, 8))
+
+    def norm(key, shape, fan_in):
+        return jax.random.normal(key, shape, jnp.float32) / jnp.sqrt(fan_in)
+
+    return {
+        "embed": norm(next(k), (c.vocab_size, D), D),
+        "wq": norm(next(k), (L, D, Hq * Dh), D),
+        "wk": norm(next(k), (L, D, Hkv * Dh), D),
+        "wv": norm(next(k), (L, D, Hkv * Dh), D),
+        "wo": norm(next(k), (L, Hq * Dh, D), Hq * Dh),
+        "w_gate": norm(next(k), (L, D, F), D),
+        "w_up": norm(next(k), (L, D, F), D),
+        "w_down": norm(next(k), (L, F, D), F),
+        "attn_norm": jnp.ones((L, D), jnp.float32),
+        "mlp_norm": jnp.ones((L, D), jnp.float32),
+        "final_norm": jnp.ones((D,), jnp.float32),
+        "lm_head": norm(jax.random.fold_in(rng, 99), (D, c.vocab_size), D),
+    }
+
+
+def param_specs(config: LlamaConfig, fsdp: str | None = "fsdp",
+                tp: str | None = "tp"):
+    """PartitionSpec pytree for GSPMD.
+
+    * ``fsdp`` axis shards the largest weight dim (ZeRO-3-style parameter
+      sharding; XLA all-gathers just-in-time per layer under ``lax.scan``).
+    * ``tp`` axis shards attention heads and the ffn hidden dim (Megatron
+      layout: column-parallel in-proj, row-parallel out-proj).
+    """
+    return {
+        "embed": P(tp, fsdp),
+        "wq": P(None, fsdp, tp),
+        "wk": P(None, fsdp, tp),
+        "wv": P(None, fsdp, tp),
+        "wo": P(None, tp, fsdp),
+        "w_gate": P(None, fsdp, tp),
+        "w_up": P(None, fsdp, tp),
+        "w_down": P(None, tp, fsdp),
+        "attn_norm": P(None, None),
+        "mlp_norm": P(None, None),
+        "final_norm": P(None),
+        "lm_head": P(fsdp, tp),
+    }
+
+
+def _rms_norm(x, scale, eps):
+    xf = x.astype(jnp.float32)
+    inv = lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    return (xf * inv * scale).astype(x.dtype)
+
+
+def rope_cos_sin(positions, head_dim, theta, dtype):
+    """[T] int positions -> ([T, Dh/2] cos, sin)."""
+    freqs = 1.0 / (
+        theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
+    )
+    angles = positions.astype(jnp.float32)[:, None] * freqs[None, :]
+    return jnp.cos(angles).astype(dtype), jnp.sin(angles).astype(dtype)
+
+
+def apply_rope(x, cos, sin):
+    """x: [B, T, H, Dh]; cos/sin: [T, Dh/2]."""
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    c = cos[None, :, None, :]
+    s = sin[None, :, None, :]
+    return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+
+
+def _attention(q, k, v, positions):
+    """Causal GQA attention.  q: [B,T,Hq,Dh], k/v: [B,T,Hkv,Dh]."""
+    B, T, Hq, Dh = q.shape
+    Hkv = k.shape[2]
+    group = Hq // Hkv
+    q = q.reshape(B, T, Hkv, group, Dh)
+    scores = jnp.einsum("bthgd,bshd->bhgts", q, k).astype(jnp.float32)
+    scores = scores / jnp.sqrt(Dh).astype(jnp.float32)
+    # causal mask from absolute positions (supports sequence-sharded T)
+    qpos = positions[:, None]
+    kpos = positions[None, :]
+    scores = jnp.where(kpos <= qpos, scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    out = jnp.einsum("bhgts,bshd->bthgd", probs, v)
+    return out.reshape(B, T, Hq * Dh)
+
+
+def _block(x, layer_params, cos, sin, positions, config, attn_fn):
+    c = config
+    B, T, D = x.shape
+    Dh = c.head_dim
+    h = _rms_norm(x, layer_params["attn_norm"], c.rms_eps)
+    q = (h @ layer_params["wq"].astype(h.dtype)).reshape(B, T, c.n_heads, Dh)
+    k = (h @ layer_params["wk"].astype(h.dtype)).reshape(B, T, c.n_kv_heads, Dh)
+    v = (h @ layer_params["wv"].astype(h.dtype)).reshape(B, T, c.n_kv_heads, Dh)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+    if attn_fn is None:
+        attn = _attention(q, k, v, positions)
+    else:
+        attn = attn_fn(q, k, v, positions)
+    x = x + attn @ layer_params["wo"].astype(x.dtype)
+    h = _rms_norm(x, layer_params["mlp_norm"], c.rms_eps)
+    gate = jax.nn.silu(h @ layer_params["w_gate"].astype(h.dtype))
+    up = h @ layer_params["w_up"].astype(h.dtype)
+    x = x + (gate * up) @ layer_params["w_down"].astype(x.dtype)
+    return x
+
+
+_LAYER_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down",
+               "attn_norm", "mlp_norm")
+
+
+def apply(params, tokens, config: LlamaConfig, positions=None, attn_fn=None,
+          remat: bool = True):
+    """Forward pass.  ``tokens``: [B, T] int32 -> logits [B, T, V] (fp32).
+
+    ``positions`` defaults to 0..T-1; pass global positions when the
+    sequence dim is sharded (sequence parallelism).  ``attn_fn`` overrides
+    the attention inner (e.g. ring attention over a mesh axis).
+    ``remat`` checkpoints each layer (recompute in backward — the standard
+    HBM-for-FLOPs trade on TPU).
+    """
+    c = config
+    B, T = tokens.shape
+    if positions is None:
+        positions = jnp.arange(T, dtype=jnp.int32)
+    x = params["embed"][tokens].astype(c.compute_dtype)
+    cos, sin = rope_cos_sin(positions, c.head_dim, c.rope_theta, c.compute_dtype)
+
+    layer_stack = {k: params[k] for k in _LAYER_KEYS}
+
+    def body(carry, layer_params):
+        out = _block(carry, layer_params, cos, sin, positions, c, attn_fn)
+        return out, None
+
+    if remat:
+        body = jax.checkpoint(body)
+    x, _ = lax.scan(body, x, layer_stack)
+    x = _rms_norm(x, params["final_norm"], c.rms_eps)
+    return (x @ params["lm_head"].astype(x.dtype)).astype(jnp.float32)
+
+
+def loss_fn(params, tokens, config: LlamaConfig, positions=None, attn_fn=None):
+    """Next-token cross-entropy (shift-by-one inside)."""
+    logits = apply(params, tokens, config, positions=positions, attn_fn=attn_fn)
+    logp = jax.nn.log_softmax(logits[:, :-1])
+    targets = tokens[:, 1:]
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
+    return jnp.mean(nll)
+
+
+def num_params(params) -> int:
+    return sum(int(p.size) for p in jax.tree.leaves(params))

@@ -1,0 +1,201 @@
+"""ResNet-50 v1.5 — the flagship convnet benchmark model.
+
+The reference benches ResNet-50 via ``examples/tensorflow_synthetic_benchmark.py``
+(``/root/reference/examples/tensorflow_synthetic_benchmark.py:22-35``) and
+publishes ResNet-101 scaling numbers (``/root/reference/docs/benchmarks.md:22-38``).
+This implementation is TPU-first, not a port:
+
+* **NHWC** layout end-to-end (TPU convolutions tile NHWC onto the MXU).
+* **bf16 compute / fp32 params** mixed precision: params and BN stats stay
+  fp32; activations and conv inputs are cast to bf16 so the MXU runs at full
+  rate.
+* Functional: ``init(rng)`` returns a params/state pytree; ``apply`` is pure
+  and jittable; batch-norm batch statistics are returned as new state, so the
+  whole train step stays a single compiled XLA program.
+
+Depths: 50 = [3,4,6,3], 101 = [3,4,23,3], 152 = [3,8,36,3] bottleneck stages.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+STAGE_BLOCKS = {50: (3, 4, 6, 3), 101: (3, 4, 23, 3), 152: (3, 8, 36, 3)}
+
+_CONV_DN = ("NHWC", "HWIO", "NHWC")
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNetConfig:
+    depth: int = 50
+    num_classes: int = 1000
+    width: int = 64
+    compute_dtype: Any = jnp.bfloat16
+    bn_momentum: float = 0.9
+    bn_eps: float = 1e-5
+
+    @property
+    def stage_blocks(self):
+        return STAGE_BLOCKS[self.depth]
+
+
+def _conv_init(rng, kh, kw, cin, cout):
+    # He/Kaiming fan-out init, the standard for ResNet conv layers.
+    fan_out = kh * kw * cout
+    std = jnp.sqrt(2.0 / fan_out)
+    return jax.random.normal(rng, (kh, kw, cin, cout), jnp.float32) * std
+
+
+def _bn_init(c):
+    return {
+        "scale": jnp.ones((c,), jnp.float32),
+        "bias": jnp.zeros((c,), jnp.float32),
+    }
+
+
+def _bn_state(c):
+    return {
+        "mean": jnp.zeros((c,), jnp.float32),
+        "var": jnp.ones((c,), jnp.float32),
+    }
+
+
+def _bottleneck_init(rng, cin, cmid, cout, stride):
+    ks = jax.random.split(rng, 4)
+    p = {
+        "conv1": _conv_init(ks[0], 1, 1, cin, cmid),
+        "bn1": _bn_init(cmid),
+        "conv2": _conv_init(ks[1], 3, 3, cmid, cmid),
+        "bn2": _bn_init(cmid),
+        "conv3": _conv_init(ks[2], 1, 1, cmid, cout),
+        "bn3": _bn_init(cout),
+    }
+    s = {"bn1": _bn_state(cmid), "bn2": _bn_state(cmid), "bn3": _bn_state(cout)}
+    if stride != 1 or cin != cout:
+        p["proj"] = _conv_init(ks[3], 1, 1, cin, cout)
+        p["bn_proj"] = _bn_init(cout)
+        s["bn_proj"] = _bn_state(cout)
+    return p, s
+
+
+def init(rng, config: ResNetConfig = ResNetConfig()):
+    """Build the (params, state) pytrees."""
+    n_stages = len(config.stage_blocks)
+    keys = jax.random.split(rng, 2 + n_stages)
+    params: dict = {
+        "conv_stem": _conv_init(keys[0], 7, 7, 3, config.width),
+        "bn_stem": _bn_init(config.width),
+    }
+    state: dict = {"bn_stem": _bn_state(config.width)}
+    cin = config.width
+    for i, blocks in enumerate(config.stage_blocks):
+        cmid = config.width * (2**i)
+        cout = cmid * 4
+        stage_p, stage_s = [], []
+        bkeys = jax.random.split(keys[2 + i], blocks)
+        for b in range(blocks):
+            stride = 2 if (b == 0 and i > 0) else 1
+            p, s = _bottleneck_init(bkeys[b], cin, cmid, cout, stride)
+            stage_p.append(p)
+            stage_s.append(s)
+            cin = cout
+        params[f"stage{i}"] = stage_p
+        state[f"stage{i}"] = stage_s
+    fan_in = cin
+    params["fc_w"] = jax.random.normal(
+        keys[1], (fan_in, config.num_classes), jnp.float32
+    ) / jnp.sqrt(fan_in)
+    params["fc_b"] = jnp.zeros((config.num_classes,), jnp.float32)
+    return params, state
+
+
+def _conv(x, w, stride, config):
+    return lax.conv_general_dilated(
+        x.astype(config.compute_dtype),
+        w.astype(config.compute_dtype),
+        window_strides=(stride, stride),
+        padding="SAME",
+        dimension_numbers=_CONV_DN,
+    )
+
+
+def _batch_norm(x, p, s, config, train: bool):
+    if train:
+        # Batch statistics in fp32 regardless of compute dtype.
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=(0, 1, 2))
+        var = jnp.var(xf, axis=(0, 1, 2))
+        m = config.bn_momentum
+        new_s = {
+            "mean": m * s["mean"] + (1 - m) * mean,
+            "var": m * s["var"] + (1 - m) * var,
+        }
+    else:
+        mean, var = s["mean"], s["var"]
+        new_s = s
+    inv = lax.rsqrt(var + config.bn_eps) * p["scale"]
+    out = (x.astype(jnp.float32) - mean) * inv + p["bias"]
+    return out.astype(config.compute_dtype), new_s
+
+
+def _bottleneck_apply(x, p, s, stride, config, train):
+    y, s1 = _batch_norm(_conv(x, p["conv1"], 1, config), p["bn1"], s["bn1"], config, train)
+    y = jax.nn.relu(y)
+    y, s2 = _batch_norm(
+        _conv(y, p["conv2"], stride, config), p["bn2"], s["bn2"], config, train
+    )
+    y = jax.nn.relu(y)
+    y, s3 = _batch_norm(_conv(y, p["conv3"], 1, config), p["bn3"], s["bn3"], config, train)
+    new_s = {"bn1": s1, "bn2": s2, "bn3": s3}
+    if "proj" in p:
+        shortcut, sp = _batch_norm(
+            _conv(x, p["proj"], stride, config), p["bn_proj"], s["bn_proj"], config, train
+        )
+        new_s["bn_proj"] = sp
+    else:
+        shortcut = x
+    return jax.nn.relu(y + shortcut), new_s
+
+
+def apply(params, state, images, config: ResNetConfig = ResNetConfig(),
+          train: bool = True):
+    """Forward pass.  ``images``: [N,H,W,3] (any float dtype).
+
+    Returns ``(logits_fp32, new_state)``.
+    """
+    x = images.astype(config.compute_dtype)
+    x = _conv(x, params["conv_stem"], 2, config)
+    x, stem_s = _batch_norm(x, params["bn_stem"], state["bn_stem"], config, train)
+    x = jax.nn.relu(x)
+    x = lax.reduce_window(
+        x, -jnp.inf, lax.max, (1, 3, 3, 1), (1, 2, 2, 1), "SAME"
+    )
+    new_state: dict = {"bn_stem": stem_s}
+    for i in range(len(config.stage_blocks)):
+        stage_s = []
+        for b, (p, s) in enumerate(zip(params[f"stage{i}"], state[f"stage{i}"])):
+            stride = 2 if (b == 0 and i > 0) else 1
+            x, ns = _bottleneck_apply(x, p, s, stride, config, train)
+            stage_s.append(ns)
+        new_state[f"stage{i}"] = stage_s
+    x = jnp.mean(x.astype(jnp.float32), axis=(1, 2))
+    logits = x @ params["fc_w"] + params["fc_b"]
+    return logits, new_state
+
+
+def loss_fn(params, state, images, labels, config: ResNetConfig = ResNetConfig()):
+    """Softmax cross-entropy; returns (loss, new_state)."""
+    logits, new_state = apply(params, state, images, config, train=True)
+    logp = jax.nn.log_softmax(logits)
+    loss = -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=1))
+    return loss, new_state
+
+
+def num_params(params) -> int:
+    return sum(int(p.size) for p in jax.tree.leaves(params))
