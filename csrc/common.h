@@ -1,0 +1,147 @@
+// Core types for the native eager-path collective engine.
+//
+// Role analog: the reference's horovod/common/common.h (Status, TensorShape,
+// dtype enum).  Everything here is new code designed for a TCP/host-memory
+// data plane: the TPU compiled path never touches this engine (XLA owns it);
+// this serves Horovod's *dynamic* named-tensor semantics for host tensors.
+#pragma once
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace hvdtpu {
+
+enum class DType : int32_t {
+  kUInt8 = 0,
+  kInt8 = 1,
+  kInt32 = 2,
+  kInt64 = 3,
+  kFloat16 = 4,
+  kBFloat16 = 5,
+  kFloat32 = 6,
+  kFloat64 = 7,
+};
+
+inline size_t DTypeSize(DType d) {
+  switch (d) {
+    case DType::kUInt8:
+    case DType::kInt8:
+      return 1;
+    case DType::kFloat16:
+    case DType::kBFloat16:
+      return 2;
+    case DType::kInt32:
+    case DType::kFloat32:
+      return 4;
+    case DType::kInt64:
+    case DType::kFloat64:
+      return 8;
+  }
+  return 0;
+}
+
+inline const char* DTypeName(DType d) {
+  switch (d) {
+    case DType::kUInt8: return "uint8";
+    case DType::kInt8: return "int8";
+    case DType::kInt32: return "int32";
+    case DType::kInt64: return "int64";
+    case DType::kFloat16: return "float16";
+    case DType::kBFloat16: return "bfloat16";
+    case DType::kFloat32: return "float32";
+    case DType::kFloat64: return "float64";
+  }
+  return "?";
+}
+
+enum class OpType : int32_t {
+  kAllreduce = 0,
+  kAllgather = 1,
+  kBroadcast = 2,
+  kAlltoall = 3,
+  kError = 4,     // response-only: cross-rank validation failed
+  kShutdown = 5,  // response-only: coordinated shutdown
+};
+
+struct Status {
+  enum Code { kOk = 0, kError = 1, kShutdown = 2 };
+  Code code = kOk;
+  std::string message;
+
+  static Status OK() { return {}; }
+  static Status Error(std::string msg) { return {kError, std::move(msg)}; }
+  static Status Shutdown() {
+    return {kShutdown, "engine shut down before this op completed"};
+  }
+  bool ok() const { return code == kOk; }
+};
+
+// fp16 <-> fp32 software conversion (portable; no F16C requirement).
+inline float HalfToFloat(uint16_t h) {
+  uint32_t sign = (h & 0x8000u) << 16;
+  uint32_t exp = (h >> 10) & 0x1f;
+  uint32_t mant = h & 0x3ffu;
+  uint32_t f;
+  if (exp == 0) {
+    if (mant == 0) {
+      f = sign;
+    } else {  // subnormal
+      exp = 127 - 15 + 1;
+      while ((mant & 0x400u) == 0) {
+        mant <<= 1;
+        exp--;
+      }
+      mant &= 0x3ffu;
+      f = sign | (exp << 23) | (mant << 13);
+    }
+  } else if (exp == 0x1f) {
+    f = sign | 0x7f800000u | (mant << 13);
+  } else {
+    f = sign | ((exp + 127 - 15) << 23) | (mant << 13);
+  }
+  float out;
+  std::memcpy(&out, &f, 4);
+  return out;
+}
+
+inline uint16_t FloatToHalf(float x) {
+  uint32_t f;
+  std::memcpy(&f, &x, 4);
+  uint32_t sign = (f >> 16) & 0x8000u;
+  int32_t exp = static_cast<int32_t>((f >> 23) & 0xff) - 127 + 15;
+  uint32_t mant = f & 0x7fffffu;
+  if (((f >> 23) & 0xff) == 0xff)  // inf/nan: preserve nan-ness
+    return static_cast<uint16_t>(sign | 0x7c00u | (mant ? 0x200u : 0u));
+  if (exp <= 0) {
+    if (exp < -10) return static_cast<uint16_t>(sign);
+    mant |= 0x800000u;
+    uint32_t shift = static_cast<uint32_t>(14 - exp);
+    uint16_t out = static_cast<uint16_t>(sign | (mant >> shift));
+    // round-to-nearest
+    if ((mant >> (shift - 1)) & 1u) out++;
+    return out;
+  }
+  if (exp >= 0x1f) return static_cast<uint16_t>(sign | 0x7c00u);  // inf
+  uint16_t out = static_cast<uint16_t>(sign | (exp << 10) | (mant >> 13));
+  if (mant & 0x1000u) out++;  // round
+  return out;
+}
+
+inline float BF16ToFloat(uint16_t b) {
+  uint32_t f = static_cast<uint32_t>(b) << 16;
+  float out;
+  std::memcpy(&out, &f, 4);
+  return out;
+}
+
+inline uint16_t FloatToBF16(float x) {
+  uint32_t f;
+  std::memcpy(&f, &x, 4);
+  // round-to-nearest-even
+  uint32_t rounded = f + 0x7fffu + ((f >> 16) & 1u);
+  return static_cast<uint16_t>(rounded >> 16);
+}
+
+}  // namespace hvdtpu

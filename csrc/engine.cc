@@ -1,0 +1,1015 @@
+// The native eager-path collective engine.
+//
+// Role analog: the reference's horovod/common/operations.cc — background
+// thread, rank-0 coordinator negotiation of dynamically-ready named tensors,
+// tensor fusion, stall detection, coordinated shutdown — re-designed for a
+// TPU-era stack: the control plane is a TCP star to rank 0 (no MPI anywhere),
+// the data plane is ring/tree collectives over a full mesh of peer TCP
+// sockets operating on host buffers.  The *compiled* data plane (XLA
+// collectives over ICI) never enters this file; this engine exists for
+// Horovod's dynamic named-tensor semantics on host tensors.
+//
+// Negotiation contract (mirrors the reference's guarantees,
+// operations.cc:287-523,2030-2380, without copying its structure):
+//   * an op runs only when every rank has submitted it (readiness count);
+//   * cross-rank shape/dtype/op/root mismatches produce a clean error on
+//     every rank instead of a hang;
+//   * duplicate in-flight names error immediately;
+//   * same-dtype allreduces are fused up to a threshold (default 64 MB);
+//   * responses execute in coordinator-broadcast order on every rank, so
+//     data-plane messages need no tags;
+//   * any rank's shutdown propagates, failing outstanding ops cleanly.
+
+#include <algorithm>
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdio>
+#include <cstdlib>
+#include <deque>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <set>
+#include <sstream>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+#include "common.h"
+#include "socket.h"
+#include "wire.h"
+
+namespace hvdtpu {
+namespace {
+
+int64_t EnvInt64(const char* name, int64_t dflt) {
+  const char* v = getenv(name);
+  return v ? strtoll(v, nullptr, 10) : dflt;
+}
+
+bool EnvFlag(const char* name) {
+  const char* v = getenv(name);
+  return v && v[0] && strcmp(v, "0") != 0;
+}
+
+void LogWarn(const std::string& msg) {
+  fprintf(stderr, "[hvdtpu] WARNING: %s\n", msg.c_str());
+}
+
+int64_t NumElems(const std::vector<int64_t>& dims) {
+  int64_t n = 1;
+  for (int64_t d : dims) n *= d;
+  return n;
+}
+
+std::string DimsStr(const std::vector<int64_t>& dims) {
+  std::ostringstream os;
+  os << "[";
+  for (size_t i = 0; i < dims.size(); i++) os << (i ? "," : "") << dims[i];
+  os << "]";
+  return os.str();
+}
+
+// ---------------------------------------------------------------------------
+// elementwise sum of src into dst, dispatched on dtype
+// ---------------------------------------------------------------------------
+
+template <typename T>
+void AccumT(T* dst, const T* src, int64_t n) {
+  for (int64_t i = 0; i < n; i++) dst[i] += src[i];
+}
+
+void Accumulate(void* dst, const void* src, int64_t n, DType d) {
+  switch (d) {
+    case DType::kUInt8:
+      AccumT(static_cast<uint8_t*>(dst), static_cast<const uint8_t*>(src), n);
+      break;
+    case DType::kInt8:
+      AccumT(static_cast<int8_t*>(dst), static_cast<const int8_t*>(src), n);
+      break;
+    case DType::kInt32:
+      AccumT(static_cast<int32_t*>(dst), static_cast<const int32_t*>(src), n);
+      break;
+    case DType::kInt64:
+      AccumT(static_cast<int64_t*>(dst), static_cast<const int64_t*>(src), n);
+      break;
+    case DType::kFloat32:
+      AccumT(static_cast<float*>(dst), static_cast<const float*>(src), n);
+      break;
+    case DType::kFloat64:
+      AccumT(static_cast<double*>(dst), static_cast<const double*>(src), n);
+      break;
+    case DType::kFloat16: {
+      auto* dp = static_cast<uint16_t*>(dst);
+      auto* sp = static_cast<const uint16_t*>(src);
+      for (int64_t i = 0; i < n; i++)
+        dp[i] = FloatToHalf(HalfToFloat(dp[i]) + HalfToFloat(sp[i]));
+      break;
+    }
+    case DType::kBFloat16: {
+      auto* dp = static_cast<uint16_t*>(dst);
+      auto* sp = static_cast<const uint16_t*>(src);
+      for (int64_t i = 0; i < n; i++)
+        dp[i] = FloatToBF16(BF16ToFloat(dp[i]) + BF16ToFloat(sp[i]));
+      break;
+    }
+  }
+}
+
+// ---------------------------------------------------------------------------
+
+struct TensorEntry {
+  Request req;
+  std::vector<char> data;
+  int handle = -1;
+  std::chrono::steady_clock::time_point enqueued_at;
+};
+
+struct HandleState {
+  bool done = false;
+  Status status;
+  std::vector<int64_t> out_dims;
+  std::vector<char> result;
+};
+
+class Engine {
+ public:
+  Status Init(const std::string& host, int port, int rank, int size);
+  void Shutdown();
+
+  int Enqueue(OpType op, const std::string& name, DType dtype,
+              const std::vector<int64_t>& dims, const void* data,
+              int root_rank);
+  int PollHandle(int handle);  // 0 pending, 1 ok, -1 error
+  int WaitHandle(int handle, double timeout_s);
+  HandleState* GetDone(int handle);  // valid until ReleaseHandle
+  void ReleaseHandle(int handle);
+  std::string TakeError(int handle);
+
+  int rank() const { return rank_; }
+  int size() const { return size_; }
+
+ private:
+  void BackgroundLoop();
+  void CoordinatorTick(RequestList& local, ResponseList* out);
+  void HandleArrivedRequests(const RequestList& list, ResponseList* out);
+  void FuseReady(ResponseList* out);
+  void StallCheck();
+  void Execute(const Response& resp);
+  void ExecuteAllreduce(const Response& resp,
+                        std::vector<TensorEntry>& entries);
+  void ExecuteAllgather(const Response& resp, TensorEntry& entry);
+  void ExecuteBroadcast(const Response& resp, TensorEntry& entry);
+  void ExecuteAlltoall(const Response& resp, TensorEntry& entry);
+  Status RingAllreduce(char* buf, int64_t nelems, DType dtype);
+  Status TreeBroadcast(char* buf, int64_t nbytes, int root);
+  void MarkDone(int handle, Status st, std::vector<int64_t> dims,
+                std::vector<char> result);
+  void FailAll(const Status& st);
+
+  int rank_ = 0, size_ = 1;
+  int64_t fusion_threshold_ = 64 << 20;
+  int cycle_ms_ = 5;
+  double stall_warn_s_ = 60.0;
+  bool stall_check_ = true;
+
+  Socket coord_;                        // worker->coordinator (rank != 0)
+  std::vector<Socket> workers_;         // coordinator->worker (rank 0)
+  std::vector<Socket> peers_;           // data plane, by rank
+  Listener data_listener_;
+
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::deque<Request> queue_;           // submitted, not yet negotiated
+  std::unordered_map<std::string, TensorEntry> tensor_table_;
+  std::unordered_map<int, HandleState> handles_;
+  int next_handle_ = 0;
+  bool shutdown_requested_ = false;
+  bool shutdown_sent_ = false;
+  std::atomic<bool> running_{false};
+  std::thread bg_;
+
+  // coordinator-only negotiation state
+  struct Negotiation {
+    std::vector<Request> received;      // one per rank, first arrival first
+    std::set<int32_t> ranks;
+    std::chrono::steady_clock::time_point first_arrival;
+    bool stall_warned = false;
+  };
+  std::map<std::string, Negotiation> message_table_;  // ordered for stable fuse
+  std::deque<std::string> ready_;       // fully-subscribed names, FIFO
+  std::deque<Response> error_ready_;    // validation failures to broadcast
+};
+
+// ---------------------------------------------------------------------------
+// bootstrap
+// ---------------------------------------------------------------------------
+
+Status Engine::Init(const std::string& host, int port, int rank, int size) {
+  rank_ = rank;
+  size_ = size;
+  fusion_threshold_ = EnvInt64("HOROVOD_TPU_FUSION_THRESHOLD",
+                               EnvInt64("HOROVOD_FUSION_THRESHOLD", 64 << 20));
+  cycle_ms_ = static_cast<int>(
+      EnvInt64("HOROVOD_TPU_CYCLE_TIME", EnvInt64("HOROVOD_CYCLE_TIME", 5)));
+  stall_warn_s_ = static_cast<double>(
+      EnvInt64("HOROVOD_TPU_STALL_WARNING_SECS", 60));
+  stall_check_ = !EnvFlag("HOROVOD_TPU_STALL_CHECK_DISABLE") &&
+                 !EnvFlag("HOROVOD_STALL_CHECK_DISABLE");
+
+  if (size_ > 1) {
+    // data-plane listener first, so peers can connect whenever they learn
+    // our address
+    Status s = data_listener_.Listen("", 0);
+    if (!s.ok()) return s;
+
+    std::vector<std::string> hosts(size_);
+    std::vector<int> ports(size_);
+    if (rank_ == 0) {
+      Listener rv;
+      s = rv.Listen("", port);
+      if (!s.ok()) return s;
+      // advertise the address workers dial for rendezvous (routable from
+      // every host by construction); localhost stays localhost
+      const char* adv = getenv("HOROVOD_TPU_DATA_ADDR");
+      hosts[0] = adv ? adv : (host.empty() ? "127.0.0.1" : host);
+      ports[0] = data_listener_.port();
+      workers_.resize(size_);
+      std::vector<int> order(size_, -1);
+      for (int i = 1; i < size_; i++) {
+        Socket sock;
+        s = rv.Accept(&sock, 120.0);
+        if (!s.ok()) return s;
+        std::string hello;
+        s = sock.RecvFrame(&hello);
+        if (!s.ok()) return s;
+        // hello = "<rank> <host> <port>"
+        std::istringstream is(hello);
+        int r, p;
+        std::string h;
+        is >> r >> h >> p;
+        if (r < 1 || r >= size_ || workers_[r].valid())
+          return Status::Error("bad hello from worker: " + hello);
+        hosts[r] = h;
+        ports[r] = p;
+        workers_[r] = std::move(sock);
+      }
+      std::ostringstream table;
+      for (int i = 0; i < size_; i++) table << hosts[i] << " " << ports[i] << " ";
+      for (int i = 1; i < size_; i++) {
+        s = workers_[i].SendFrame(table.str());
+        if (!s.ok()) return s;
+      }
+    } else {
+      s = Socket::Connect(host, port, &coord_, 120.0);
+      if (!s.ok()) return s;
+      // advertise the local IP on the route to the coordinator — the
+      // address peers on other hosts can reach our data listener at
+      const char* adv = getenv("HOROVOD_TPU_DATA_ADDR");
+      std::ostringstream hello;
+      hello << rank_ << " " << (adv ? adv : coord_.LocalAddr()) << " "
+            << data_listener_.port();
+      s = coord_.SendFrame(hello.str());
+      if (!s.ok()) return s;
+      std::string table;
+      s = coord_.RecvFrame(&table);
+      if (!s.ok()) return s;
+      std::istringstream is(table);
+      for (int i = 0; i < size_; i++) is >> hosts[i] >> ports[i];
+    }
+
+    // full data-plane mesh: connect to lower ranks, accept from higher ones
+    peers_.resize(size_);
+    for (int j = 0; j < rank_; j++) {
+      Socket sock;
+      s = Socket::Connect(hosts[j], ports[j], &sock, 120.0);
+      if (!s.ok()) return s;
+      int32_t me = rank_;
+      s = sock.SendAll(&me, sizeof(me));
+      if (!s.ok()) return s;
+      peers_[j] = std::move(sock);
+    }
+    for (int j = rank_ + 1; j < size_; j++) {
+      Socket sock;
+      s = data_listener_.Accept(&sock, 120.0);
+      if (!s.ok()) return s;
+      int32_t who = -1;
+      s = sock.RecvAll(&who, sizeof(who));
+      if (!s.ok()) return s;
+      if (who <= rank_ || who >= size_)
+        return Status::Error("unexpected data-plane peer " +
+                             std::to_string(who));
+      peers_[who] = std::move(sock);
+    }
+  }
+
+  running_ = true;
+  bg_ = std::thread(&Engine::BackgroundLoop, this);
+  return Status::OK();
+}
+
+void Engine::Shutdown() {
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (!running_) return;
+    shutdown_requested_ = true;
+  }
+  if (bg_.joinable()) bg_.join();
+}
+
+// ---------------------------------------------------------------------------
+// submission / handles
+// ---------------------------------------------------------------------------
+
+int Engine::Enqueue(OpType op, const std::string& name, DType dtype,
+                    const std::vector<int64_t>& dims, const void* data,
+                    int root_rank) {
+  std::lock_guard<std::mutex> lk(mu_);
+  int handle = next_handle_++;
+  handles_[handle] = HandleState{};
+  if (!running_) {
+    handles_[handle].done = true;
+    handles_[handle].status = Status::Shutdown();
+    return handle;
+  }
+  if (tensor_table_.count(name)) {
+    // reference behavior: duplicate in-flight name is an immediate error
+    handles_[handle].done = true;
+    handles_[handle].status = Status::Error(
+        "duplicate in-flight op name '" + name +
+        "'; await the previous op or use distinct names");
+    cv_.notify_all();
+    return handle;
+  }
+  TensorEntry e;
+  e.req.rank = rank_;
+  e.req.op = op;
+  e.req.dtype = dtype;
+  e.req.name = name;
+  e.req.root_rank = root_rank;
+  e.req.dims = dims;
+  size_t nbytes = static_cast<size_t>(NumElems(dims)) * DTypeSize(dtype);
+  e.data.assign(static_cast<const char*>(data),
+                static_cast<const char*>(data) + nbytes);
+  e.handle = handle;
+  e.enqueued_at = std::chrono::steady_clock::now();
+  queue_.push_back(e.req);
+  tensor_table_.emplace(name, std::move(e));
+  return handle;
+}
+
+int Engine::PollHandle(int handle) {
+  std::lock_guard<std::mutex> lk(mu_);
+  auto it = handles_.find(handle);
+  if (it == handles_.end()) return -2;  // unknown
+  if (!it->second.done) return 0;
+  return it->second.status.ok() ? 1 : -1;
+}
+
+int Engine::WaitHandle(int handle, double timeout_s) {
+  std::unique_lock<std::mutex> lk(mu_);
+  auto it = handles_.find(handle);
+  if (it == handles_.end()) return -2;
+  auto pred = [&] { return handles_[handle].done; };
+  if (timeout_s < 0) {
+    cv_.wait(lk, pred);
+  } else if (!cv_.wait_for(lk, std::chrono::duration<double>(timeout_s),
+                           pred)) {
+    return 0;
+  }
+  return handles_[handle].status.ok() ? 1 : -1;
+}
+
+HandleState* Engine::GetDone(int handle) {
+  std::lock_guard<std::mutex> lk(mu_);
+  auto it = handles_.find(handle);
+  return (it != handles_.end() && it->second.done) ? &it->second : nullptr;
+}
+
+void Engine::ReleaseHandle(int handle) {
+  std::lock_guard<std::mutex> lk(mu_);
+  handles_.erase(handle);
+}
+
+std::string Engine::TakeError(int handle) {
+  std::lock_guard<std::mutex> lk(mu_);
+  auto it = handles_.find(handle);
+  if (it == handles_.end()) return "unknown handle";
+  return it->second.status.message;
+}
+
+void Engine::MarkDone(int handle, Status st, std::vector<int64_t> dims,
+                      std::vector<char> result) {
+  std::lock_guard<std::mutex> lk(mu_);
+  auto it = handles_.find(handle);
+  if (it == handles_.end()) return;  // caller released without waiting
+  it->second.done = true;
+  it->second.status = std::move(st);
+  it->second.out_dims = std::move(dims);
+  it->second.result = std::move(result);
+  cv_.notify_all();
+}
+
+void Engine::FailAll(const Status& st) {
+  std::lock_guard<std::mutex> lk(mu_);
+  for (auto& [name, entry] : tensor_table_) {
+    auto it = handles_.find(entry.handle);
+    if (it != handles_.end() && !it->second.done) {
+      it->second.done = true;
+      it->second.status = st;
+    }
+  }
+  tensor_table_.clear();
+  queue_.clear();
+  cv_.notify_all();
+}
+
+// ---------------------------------------------------------------------------
+// background loop (worker + coordinator duties)
+// ---------------------------------------------------------------------------
+
+void Engine::BackgroundLoop() {
+  bool stop = false;
+  while (!stop) {
+    auto cycle_start = std::chrono::steady_clock::now();
+
+    RequestList local;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      while (!queue_.empty()) {
+        local.requests.push_back(std::move(queue_.front()));
+        queue_.pop_front();
+      }
+      if (shutdown_requested_ && !shutdown_sent_) {
+        local.shutdown = true;
+        shutdown_sent_ = true;
+      }
+    }
+
+    ResponseList to_execute;
+    if (size_ == 1) {
+      // degenerate world: everything local is immediately ready
+      for (Request& r : local.requests) {
+        Response resp;
+        resp.op = r.op;
+        resp.names = {r.name};
+        resp.root_rank = r.root_rank;
+        resp.first_dims = {r.dims.empty() ? 1 : r.dims[0]};
+        to_execute.responses.push_back(std::move(resp));
+      }
+      to_execute.shutdown = local.shutdown;
+    } else if (rank_ == 0) {
+      CoordinatorTick(local, &to_execute);
+    } else {
+      if (!local.requests.empty() || local.shutdown) {
+        Status s = coord_.SendFrame(Serialize(local));
+        if (!s.ok()) {
+          FailAll(Status::Error("lost coordinator: " + s.message));
+          break;
+        }
+      }
+      while (coord_.Readable(0)) {
+        std::string frame;
+        Status s = coord_.RecvFrame(&frame);
+        if (!s.ok()) {
+          FailAll(Status::Error("lost coordinator: " + s.message));
+          stop = true;
+          break;
+        }
+        ResponseList rl;
+        s = Parse(frame, &rl);
+        if (!s.ok()) {
+          FailAll(s);
+          stop = true;
+          break;
+        }
+        for (Response& r : rl.responses)
+          to_execute.responses.push_back(std::move(r));
+        to_execute.shutdown = to_execute.shutdown || rl.shutdown;
+      }
+    }
+
+    for (const Response& resp : to_execute.responses) Execute(resp);
+    if (to_execute.shutdown) {
+      FailAll(Status::Shutdown());
+      stop = true;
+    }
+
+    if (!stop) {
+      auto elapsed = std::chrono::steady_clock::now() - cycle_start;
+      auto budget = std::chrono::milliseconds(cycle_ms_);
+      if (elapsed < budget) std::this_thread::sleep_for(budget - elapsed);
+    }
+  }
+  running_ = false;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    cv_.notify_all();
+  }
+}
+
+void Engine::CoordinatorTick(RequestList& local, ResponseList* out) {
+  // own requests
+  HandleArrivedRequests(local, out);
+  bool shutdown = local.shutdown;
+  // worker requests
+  for (int i = 1; i < size_; i++) {
+    while (workers_[i].valid() && workers_[i].Readable(0)) {
+      std::string frame;
+      Status s = workers_[i].RecvFrame(&frame);
+      if (!s.ok()) {
+        LogWarn("worker " + std::to_string(i) + " lost: " + s.message);
+        workers_[i].Close();
+        shutdown = true;
+        break;
+      }
+      RequestList rl;
+      s = Parse(frame, &rl);
+      if (!s.ok()) {
+        LogWarn("bad frame from worker: " + s.message);
+        shutdown = true;
+        break;
+      }
+      HandleArrivedRequests(rl, out);
+      shutdown = shutdown || rl.shutdown;
+    }
+  }
+  FuseReady(out);
+  if (stall_check_) StallCheck();
+  out->shutdown = shutdown;
+  if (!out->responses.empty() || out->shutdown) {
+    std::string frame = Serialize(*out);
+    for (int i = 1; i < size_; i++) {
+      if (!workers_[i].valid()) continue;
+      Status s = workers_[i].SendFrame(frame);
+      if (!s.ok()) LogWarn("send to worker failed: " + s.message);
+    }
+  }
+}
+
+void Engine::HandleArrivedRequests(const RequestList& list,
+                                   ResponseList* out) {
+  for (const Request& r : list.requests) {
+    Negotiation& neg = message_table_[r.name];
+    if (neg.ranks.count(r.rank)) {
+      Response err;
+      err.op = OpType::kError;
+      err.names = {r.name};
+      err.error_message = "rank " + std::to_string(r.rank) +
+                          " submitted op '" + r.name + "' twice";
+      error_ready_.push_back(std::move(err));
+      continue;
+    }
+    if (neg.received.empty()) neg.first_arrival = std::chrono::steady_clock::now();
+    neg.ranks.insert(r.rank);
+    neg.received.push_back(r);
+    if (static_cast<int>(neg.ranks.size()) == size_) {
+      // validate cross-rank consistency -> clean error instead of hang
+      const Request& first = neg.received.front();
+      std::string err;
+      for (const Request& q : neg.received) {
+        if (q.op != first.op) {
+          err = "op type mismatch";
+        } else if (q.dtype != first.dtype) {
+          err = "dtype mismatch: rank " + std::to_string(first.rank) + " has " +
+                DTypeName(first.dtype) + ", rank " + std::to_string(q.rank) +
+                " has " + DTypeName(q.dtype);
+        } else if (q.op == OpType::kBroadcast &&
+                   q.root_rank != first.root_rank) {
+          err = "broadcast root mismatch: " + std::to_string(first.root_rank) +
+                " vs " + std::to_string(q.root_rank);
+        } else if (q.op == OpType::kAllreduce && q.dims != first.dims) {
+          err = "shape mismatch: rank " + std::to_string(first.rank) + " has " +
+                DimsStr(first.dims) + ", rank " + std::to_string(q.rank) +
+                " has " + DimsStr(q.dims);
+        } else if ((q.op == OpType::kAllgather || q.op == OpType::kAlltoall) &&
+                   (q.dims.size() != first.dims.size() ||
+                    !std::equal(q.dims.begin() + 1, q.dims.end(),
+                                first.dims.begin() + 1))) {
+          err = "shape mismatch beyond first dim: rank " +
+                std::to_string(first.rank) + " has " + DimsStr(first.dims) +
+                ", rank " + std::to_string(q.rank) + " has " + DimsStr(q.dims);
+        } else if (q.op == OpType::kBroadcast && q.dims != first.dims) {
+          err = "broadcast shape mismatch: " + DimsStr(first.dims) + " vs " +
+                DimsStr(q.dims);
+        }
+        if (!err.empty()) break;
+      }
+      if (!err.empty()) {
+        Response resp;
+        resp.op = OpType::kError;
+        resp.names = {first.name};
+        resp.error_message = "op '" + first.name + "': " + err;
+        error_ready_.push_back(std::move(resp));
+        message_table_.erase(r.name);
+      } else {
+        ready_.push_back(r.name);
+      }
+    }
+  }
+}
+
+void Engine::FuseReady(ResponseList* out) {
+  while (!error_ready_.empty()) {
+    out->responses.push_back(std::move(error_ready_.front()));
+    error_ready_.pop_front();
+  }
+  while (!ready_.empty()) {
+    std::string name = std::move(ready_.front());
+    ready_.pop_front();
+    auto it = message_table_.find(name);
+    if (it == message_table_.end()) continue;
+    const Request& first = it->second.received.front();
+    Response resp;
+    resp.op = first.op;
+    resp.names = {name};
+    resp.root_rank = first.root_rank;
+    if (first.op == OpType::kAllgather || first.op == OpType::kAlltoall) {
+      // collect every rank's first-dim in rank order
+      std::vector<int64_t> fd(size_, 0);
+      for (const Request& q : it->second.received)
+        fd[q.rank] = q.dims.empty() ? 1 : q.dims[0];
+      resp.first_dims = std::move(fd);
+    }
+    int64_t bytes =
+        NumElems(first.dims) * static_cast<int64_t>(DTypeSize(first.dtype));
+    DType dtype = first.dtype;
+    message_table_.erase(it);
+    // fuse successive ready same-dtype allreduces up to the threshold
+    if (resp.op == OpType::kAllreduce) {
+      while (!ready_.empty() && bytes < fusion_threshold_) {
+        auto nx = message_table_.find(ready_.front());
+        if (nx == message_table_.end()) {
+          ready_.pop_front();
+          continue;
+        }
+        const Request& nr = nx->second.received.front();
+        if (nr.op != OpType::kAllreduce || nr.dtype != dtype) break;
+        int64_t nbytes =
+            NumElems(nr.dims) * static_cast<int64_t>(DTypeSize(nr.dtype));
+        if (bytes + nbytes > fusion_threshold_) break;
+        bytes += nbytes;
+        resp.names.push_back(ready_.front());
+        message_table_.erase(nx);
+        ready_.pop_front();
+      }
+    }
+    out->responses.push_back(std::move(resp));
+  }
+}
+
+void Engine::StallCheck() {
+  auto now = std::chrono::steady_clock::now();
+  for (auto& [name, neg] : message_table_) {
+    if (neg.stall_warned || neg.received.empty()) continue;
+    double age =
+        std::chrono::duration<double>(now - neg.first_arrival).count();
+    if (age > stall_warn_s_) {
+      std::ostringstream os;
+      os << "op '" << name << "' has waited " << static_cast<int>(age)
+         << "s for ranks [";
+      bool first = true;
+      for (int r = 0; r < size_; r++) {
+        if (!neg.ranks.count(r)) {
+          os << (first ? "" : ",") << r;
+          first = false;
+        }
+      }
+      os << "] — possible stall (one rank may have skipped this op)";
+      LogWarn(os.str());
+      neg.stall_warned = true;
+    }
+  }
+}
+
+// ---------------------------------------------------------------------------
+// execution (data plane)
+// ---------------------------------------------------------------------------
+
+void Engine::Execute(const Response& resp) {
+  if (resp.op == OpType::kError) {
+    for (const std::string& name : resp.names) {
+      std::unique_lock<std::mutex> lk(mu_);
+      auto it = tensor_table_.find(name);
+      if (it == tensor_table_.end()) continue;
+      int handle = it->second.handle;
+      tensor_table_.erase(it);
+      lk.unlock();
+      MarkDone(handle, Status::Error(resp.error_message), {}, {});
+    }
+    return;
+  }
+  std::vector<TensorEntry> entries;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    for (const std::string& name : resp.names) {
+      auto it = tensor_table_.find(name);
+      if (it == tensor_table_.end()) {
+        LogWarn("response for unknown tensor '" + name + "'");
+        continue;
+      }
+      entries.push_back(std::move(it->second));
+      tensor_table_.erase(it);
+    }
+  }
+  if (entries.empty()) return;
+  switch (resp.op) {
+    case OpType::kAllreduce:
+      ExecuteAllreduce(resp, entries);
+      break;
+    case OpType::kAllgather:
+      ExecuteAllgather(resp, entries[0]);
+      break;
+    case OpType::kBroadcast:
+      ExecuteBroadcast(resp, entries[0]);
+      break;
+    case OpType::kAlltoall:
+      ExecuteAlltoall(resp, entries[0]);
+      break;
+    default:
+      break;
+  }
+}
+
+void Engine::ExecuteAllreduce(const Response& resp,
+                              std::vector<TensorEntry>& entries) {
+  DType dtype = entries[0].req.dtype;
+  if (entries.size() == 1) {
+    // no fusion copy needed: reduce in place on the entry buffer
+    TensorEntry& e = entries[0];
+    Status st = RingAllreduce(e.data.data(), NumElems(e.req.dims), dtype);
+    MarkDone(e.handle, st, e.req.dims, std::move(e.data));
+    if (!st.ok()) FailAll(st);
+    return;
+  }
+  // fusion buffer: pack, one ring allreduce, unpack
+  size_t total = 0;
+  for (auto& e : entries) total += e.data.size();
+  std::vector<char> fused(total);
+  size_t off = 0;
+  for (auto& e : entries) {
+    std::memcpy(fused.data() + off, e.data.data(), e.data.size());
+    off += e.data.size();
+  }
+  Status st = RingAllreduce(
+      fused.data(), static_cast<int64_t>(total / DTypeSize(dtype)), dtype);
+  off = 0;
+  for (auto& e : entries) {
+    if (st.ok())
+      std::memcpy(e.data.data(), fused.data() + off, e.data.size());
+    off += e.data.size();
+    MarkDone(e.handle, st, e.req.dims, std::move(e.data));
+  }
+  if (!st.ok()) FailAll(st);
+}
+
+// Ring allreduce: reduce-scatter then allgather over the rank ring — the
+// classic bandwidth-optimal algorithm (2(n-1)/n bytes per element on the
+// wire), operating on the (possibly fused) contiguous buffer.
+Status Engine::RingAllreduce(char* buf, int64_t nelems, DType dtype) {
+  if (size_ == 1) return Status::OK();
+  size_t esize = DTypeSize(dtype);
+  int right = (rank_ + 1) % size_;
+  int left = (rank_ + size_ - 1) % size_;
+  auto chunk_lo = [&](int c) { return nelems * c / size_; };
+  std::vector<char> tmp(static_cast<size_t>(
+      (nelems / size_ + 1) * static_cast<int64_t>(esize)));
+
+  for (int step = 0; step < size_ - 1; step++) {
+    int send_c = (rank_ - step + 2 * size_) % size_;
+    int recv_c = (rank_ - step - 1 + 2 * size_) % size_;
+    int64_t s_lo = chunk_lo(send_c), s_hi = chunk_lo(send_c + 1);
+    int64_t r_lo = chunk_lo(recv_c), r_hi = chunk_lo(recv_c + 1);
+    Status st = Socket::SendRecv(
+        peers_[right], buf + s_lo * esize, (s_hi - s_lo) * esize,
+        peers_[left], tmp.data(), (r_hi - r_lo) * esize);
+    if (!st.ok())
+      return Status::Error("ring allreduce failed: " + st.message);
+    Accumulate(buf + r_lo * esize, tmp.data(), r_hi - r_lo, dtype);
+  }
+  for (int step = 0; step < size_ - 1; step++) {
+    int send_c = (rank_ + 1 - step + 2 * size_) % size_;
+    int recv_c = (rank_ - step + 2 * size_) % size_;
+    int64_t s_lo = chunk_lo(send_c), s_hi = chunk_lo(send_c + 1);
+    int64_t r_lo = chunk_lo(recv_c), r_hi = chunk_lo(recv_c + 1);
+    Status st = Socket::SendRecv(
+        peers_[right], buf + s_lo * esize, (s_hi - s_lo) * esize,
+        peers_[left], buf + r_lo * esize, (r_hi - r_lo) * esize);
+    if (!st.ok())
+      return Status::Error("ring allreduce failed: " + st.message);
+  }
+  return Status::OK();
+}
+
+// Variable-sized ring allgather: block b travels the ring; after n-1 steps
+// every rank holds all blocks at the right offsets.
+void Engine::ExecuteAllgather(const Response& resp, TensorEntry& entry) {
+  DType dtype = entry.req.dtype;
+  size_t esize = DTypeSize(dtype);
+  // row stride = product of dims[1:]
+  int64_t stride = 1;
+  for (size_t i = 1; i < entry.req.dims.size(); i++)
+    stride *= entry.req.dims[i];
+  std::vector<int64_t> offsets(size_ + 1, 0);
+  for (int r = 0; r < size_; r++)
+    offsets[r + 1] = offsets[r] + resp.first_dims[r] * stride;
+  std::vector<char> out(static_cast<size_t>(offsets[size_]) * esize);
+  std::memcpy(out.data() + offsets[rank_] * esize, entry.data.data(),
+              entry.data.size());
+  int right = (rank_ + 1) % size_;
+  int left = (rank_ + size_ - 1) % size_;
+  for (int step = 0; step < size_ - 1; step++) {
+    int send_b = (rank_ - step + 2 * size_) % size_;
+    int recv_b = (rank_ - step - 1 + 2 * size_) % size_;
+    Status st = Socket::SendRecv(
+        peers_[right], out.data() + offsets[send_b] * esize,
+        static_cast<size_t>(resp.first_dims[send_b] * stride) * esize,
+        peers_[left], out.data() + offsets[recv_b] * esize,
+        static_cast<size_t>(resp.first_dims[recv_b] * stride) * esize);
+    if (!st.ok()) {
+      Status err = Status::Error("ring allgather failed: " + st.message);
+      MarkDone(entry.handle, err, {}, {});
+      FailAll(err);
+      return;
+    }
+  }
+  std::vector<int64_t> out_dims = entry.req.dims;
+  if (out_dims.empty()) out_dims = {1};
+  out_dims[0] = offsets[size_] / (stride ? stride : 1);
+  MarkDone(entry.handle, Status::OK(), std::move(out_dims), std::move(out));
+}
+
+// Binomial-tree broadcast rooted at resp.root_rank: parent = clear the
+// lowest set bit of the root-relative rank; children = set each bit below
+// the lowest set bit.  log2(n) rounds, works for any world size.
+Status Engine::TreeBroadcast(char* buf, int64_t nbytes, int root) {
+  int vrank = (rank_ - root + size_) % size_;
+  int mask = 1;
+  while (mask < size_) {
+    if (vrank & mask) {
+      int parent = ((vrank ^ mask) + root) % size_;
+      Status st = peers_[parent].RecvAll(buf, static_cast<size_t>(nbytes));
+      if (!st.ok()) return st;
+      break;
+    }
+    mask <<= 1;
+  }
+  // mask is now the lowest set bit of vrank (or >= size_ for the root);
+  // children live at every bit position below it.
+  for (mask >>= 1; mask > 0; mask >>= 1) {
+    int child_v = vrank | mask;
+    if (child_v < size_) {
+      int child = (child_v + root) % size_;
+      Status st = peers_[child].SendAll(buf, static_cast<size_t>(nbytes));
+      if (!st.ok()) return st;
+    }
+  }
+  return Status::OK();
+}
+
+void Engine::ExecuteBroadcast(const Response& resp, TensorEntry& entry) {
+  Status st = TreeBroadcast(entry.data.data(),
+                            static_cast<int64_t>(entry.data.size()),
+                            resp.root_rank);
+  if (!st.ok()) {
+    Status err = Status::Error("broadcast failed: " + st.message);
+    MarkDone(entry.handle, err, {}, {});
+    FailAll(err);
+    return;
+  }
+  MarkDone(entry.handle, Status::OK(), entry.req.dims, std::move(entry.data));
+}
+
+// Pairwise-exchange alltoall: rank i sends its j-th row-block to rank j.
+// Requires dim0 divisible by size (validated at enqueue in the frontend).
+void Engine::ExecuteAlltoall(const Response& resp, TensorEntry& entry) {
+  DType dtype = entry.req.dtype;
+  size_t esize = DTypeSize(dtype);
+  int64_t stride = 1;
+  for (size_t i = 1; i < entry.req.dims.size(); i++)
+    stride *= entry.req.dims[i];
+  // rows I contribute to each destination
+  int64_t my_rows = (entry.req.dims.empty() ? 1 : entry.req.dims[0]) / size_;
+  // rows I receive from each source = their dim0 / size
+  std::vector<int64_t> recv_rows(size_);
+  std::vector<int64_t> recv_off(size_ + 1, 0);
+  for (int r = 0; r < size_; r++) {
+    recv_rows[r] = resp.first_dims[r] / size_;
+    recv_off[r + 1] = recv_off[r] + recv_rows[r] * stride;
+  }
+  std::vector<char> out(static_cast<size_t>(recv_off[size_]) * esize);
+  int64_t blk = my_rows * stride * static_cast<int64_t>(esize);
+  // own block
+  std::memcpy(out.data() + recv_off[rank_] * esize,
+              entry.data.data() + rank_ * blk, static_cast<size_t>(blk));
+  for (int step = 1; step < size_; step++) {
+    int to = (rank_ + step) % size_;
+    int from = (rank_ - step + size_) % size_;
+    Status st = Socket::SendRecv(
+        peers_[to], entry.data.data() + to * blk, static_cast<size_t>(blk),
+        peers_[from], out.data() + recv_off[from] * esize,
+        static_cast<size_t>(recv_rows[from] * stride) * esize);
+    if (!st.ok()) {
+      Status err = Status::Error("alltoall failed: " + st.message);
+      MarkDone(entry.handle, err, {}, {});
+      FailAll(err);
+      return;
+    }
+  }
+  std::vector<int64_t> out_dims = entry.req.dims;
+  if (out_dims.empty()) out_dims = {1};
+  out_dims[0] = recv_off[size_] / (stride ? stride : 1);
+  MarkDone(entry.handle, Status::OK(), std::move(out_dims), std::move(out));
+}
+
+Engine* g_engine = nullptr;
+std::mutex g_engine_mu;
+
+}  // namespace
+}  // namespace hvdtpu
+
+// ---------------------------------------------------------------------------
+// C API (ctypes surface) — role analog of the reference's extern "C" layer
+// (horovod/common/operations.cc:2413-2468) plus the handle API
+// (horovod/torch/handle_manager.h).
+// ---------------------------------------------------------------------------
+
+using namespace hvdtpu;
+
+extern "C" {
+
+int hvd_native_init(const char* host, int port, int rank, int size) {
+  std::lock_guard<std::mutex> lk(g_engine_mu);
+  if (g_engine) return 0;  // idempotent
+  auto* e = new Engine();
+  Status s = e->Init(host ? host : "127.0.0.1", port, rank, size);
+  if (!s.ok()) {
+    fprintf(stderr, "[hvdtpu] init failed: %s\n", s.message.c_str());
+    delete e;
+    return -1;
+  }
+  g_engine = e;
+  return 0;
+}
+
+void hvd_native_shutdown() {
+  std::lock_guard<std::mutex> lk(g_engine_mu);
+  if (!g_engine) return;
+  g_engine->Shutdown();
+  delete g_engine;
+  g_engine = nullptr;
+}
+
+int hvd_enqueue(int op, const char* name, int dtype, int ndim,
+                const int64_t* dims, const void* data, int root_rank) {
+  if (!g_engine) return -1;
+  std::vector<int64_t> d(dims, dims + ndim);
+  return g_engine->Enqueue(static_cast<OpType>(op), name,
+                           static_cast<DType>(dtype), d, data, root_rank);
+}
+
+int hvd_poll(int handle) { return g_engine ? g_engine->PollHandle(handle) : -2; }
+
+int hvd_wait(int handle, double timeout_s) {
+  return g_engine ? g_engine->WaitHandle(handle, timeout_s) : -2;
+}
+
+int hvd_result_ndim(int handle) {
+  if (!g_engine) return -1;
+  auto* h = g_engine->GetDone(handle);
+  return h ? static_cast<int>(h->out_dims.size()) : -1;
+}
+
+void hvd_result_dims(int handle, int64_t* out) {
+  if (!g_engine) return;
+  auto* h = g_engine->GetDone(handle);
+  if (!h) return;
+  for (size_t i = 0; i < h->out_dims.size(); i++) out[i] = h->out_dims[i];
+}
+
+int64_t hvd_result_nbytes(int handle) {
+  if (!g_engine) return -1;
+  auto* h = g_engine->GetDone(handle);
+  return h ? static_cast<int64_t>(h->result.size()) : -1;
+}
+
+void hvd_result_copy(int handle, void* dst) {
+  if (!g_engine) return;
+  auto* h = g_engine->GetDone(handle);
+  if (h && !h->result.empty()) std::memcpy(dst, h->result.data(), h->result.size());
+}
+
+// Returns a malloc'd copy the caller must free via hvd_free_cstr.
+const char* hvd_error_str(int handle) {
+  if (!g_engine) return strdup("engine not initialized");
+  return strdup(g_engine->TakeError(handle).c_str());
+}
+
+void hvd_free_cstr(const char* p) { free(const_cast<char*>(p)); }
+
+void hvd_release(int handle) {
+  if (g_engine) g_engine->ReleaseHandle(handle);
+}
+
+}  // extern "C"

@@ -1,0 +1,241 @@
+#include "socket.h"
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <thread>
+
+namespace hvdtpu {
+
+namespace {
+
+Status Errno(const std::string& what) {
+  return Status::Error(what + ": " + strerror(errno));
+}
+
+void SetNoDelay(int fd) {
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+}
+
+}  // namespace
+
+Socket& Socket::operator=(Socket&& o) noexcept {
+  if (this != &o) {
+    Close();
+    fd_ = o.fd_;
+    o.fd_ = -1;
+  }
+  return *this;
+}
+
+Socket::~Socket() { Close(); }
+
+void Socket::Close() {
+  if (fd_ >= 0) {
+    ::close(fd_);
+    fd_ = -1;
+  }
+}
+
+Status Socket::SendAll(const void* data, size_t n) {
+  const char* p = static_cast<const char*>(data);
+  while (n > 0) {
+    ssize_t k = ::send(fd_, p, n, MSG_NOSIGNAL);
+    if (k < 0) {
+      if (errno == EINTR) continue;
+      return Errno("send");
+    }
+    p += k;
+    n -= static_cast<size_t>(k);
+  }
+  return Status::OK();
+}
+
+Status Socket::RecvAll(void* data, size_t n) {
+  char* p = static_cast<char*>(data);
+  while (n > 0) {
+    ssize_t k = ::recv(fd_, p, n, 0);
+    if (k < 0) {
+      if (errno == EINTR) continue;
+      return Errno("recv");
+    }
+    if (k == 0) return Status::Error("peer closed connection");
+    p += k;
+    n -= static_cast<size_t>(k);
+  }
+  return Status::OK();
+}
+
+Status Socket::SendRecv(Socket& send_sock, const void* send_buf, size_t send_n,
+                        Socket& recv_sock, void* recv_buf, size_t recv_n) {
+  const char* sp = static_cast<const char*>(send_buf);
+  char* rp = static_cast<char*>(recv_buf);
+  size_t sleft = send_n, rleft = recv_n;
+  while (sleft > 0 || rleft > 0) {
+    struct pollfd fds[2];
+    int nf = 0;
+    int si = -1, ri = -1;
+    if (sleft > 0) {
+      si = nf;
+      fds[nf].fd = send_sock.fd_;
+      fds[nf].events = POLLOUT;
+      nf++;
+    }
+    if (rleft > 0) {
+      ri = nf;
+      fds[nf].fd = recv_sock.fd_;
+      fds[nf].events = POLLIN;
+      nf++;
+    }
+    int rc = ::poll(fds, nf, 60000);
+    if (rc < 0) {
+      if (errno == EINTR) continue;
+      return Errno("poll");
+    }
+    if (rc == 0) return Status::Error("send_recv timed out after 60s");
+    if (si >= 0 && (fds[si].revents & (POLLOUT | POLLERR | POLLHUP))) {
+      ssize_t k = ::send(send_sock.fd_, sp, sleft, MSG_NOSIGNAL | MSG_DONTWAIT);
+      if (k < 0 && errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR)
+        return Errno("send");
+      if (k > 0) {
+        sp += k;
+        sleft -= static_cast<size_t>(k);
+      }
+    }
+    if (ri >= 0 && (fds[ri].revents & (POLLIN | POLLERR | POLLHUP))) {
+      ssize_t k = ::recv(recv_sock.fd_, rp, rleft, MSG_DONTWAIT);
+      if (k < 0 && errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR)
+        return Errno("recv");
+      if (k == 0) return Status::Error("peer closed connection");
+      if (k > 0) {
+        rp += k;
+        rleft -= static_cast<size_t>(k);
+      }
+    }
+  }
+  return Status::OK();
+}
+
+Status Socket::SendFrame(const std::string& payload) {
+  uint64_t len = payload.size();
+  Status s = SendAll(&len, sizeof(len));
+  if (!s.ok()) return s;
+  return SendAll(payload.data(), payload.size());
+}
+
+Status Socket::RecvFrame(std::string* payload) {
+  uint64_t len = 0;
+  Status s = RecvAll(&len, sizeof(len));
+  if (!s.ok()) return s;
+  if (len > (1ull << 34)) return Status::Error("frame too large");
+  payload->resize(len);
+  if (len == 0) return Status::OK();
+  return RecvAll(payload->data(), len);
+}
+
+std::string Socket::LocalAddr() const {
+  struct sockaddr_in addr;
+  socklen_t len = sizeof(addr);
+  if (getsockname(fd_, reinterpret_cast<struct sockaddr*>(&addr), &len) != 0)
+    return "127.0.0.1";
+  char buf[INET_ADDRSTRLEN];
+  if (!inet_ntop(AF_INET, &addr.sin_addr, buf, sizeof(buf)))
+    return "127.0.0.1";
+  return buf;
+}
+
+bool Socket::Readable(int timeout_ms) const {
+  struct pollfd p;
+  p.fd = fd_;
+  p.events = POLLIN;
+  return ::poll(&p, 1, timeout_ms) > 0 && (p.revents & (POLLIN | POLLHUP));
+}
+
+Status Socket::Connect(const std::string& host, int port, Socket* out,
+                       double timeout_s) {
+  auto deadline =
+      std::chrono::steady_clock::now() + std::chrono::duration<double>(timeout_s);
+  std::string err = "unknown";
+  while (std::chrono::steady_clock::now() < deadline) {
+    struct addrinfo hints;
+    memset(&hints, 0, sizeof(hints));
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    struct addrinfo* res = nullptr;
+    std::string portstr = std::to_string(port);
+    int rc = getaddrinfo(host.c_str(), portstr.c_str(), &hints, &res);
+    if (rc != 0) {
+      err = std::string("getaddrinfo: ") + gai_strerror(rc);
+    } else {
+      int fd = ::socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+      if (fd >= 0 &&
+          ::connect(fd, res->ai_addr, res->ai_addrlen) == 0) {
+        SetNoDelay(fd);
+        freeaddrinfo(res);
+        *out = Socket(fd);
+        return Status::OK();
+      }
+      err = std::string("connect: ") + strerror(errno);
+      if (fd >= 0) ::close(fd);
+      freeaddrinfo(res);
+    }
+    // rendezvous peer may not be listening yet — retry
+    std::this_thread::sleep_for(std::chrono::milliseconds(50));
+  }
+  return Status::Error("connect to " + host + ":" + std::to_string(port) +
+                       " timed out (" + err + ")");
+}
+
+Status Listener::Listen(const std::string& host, int port) {
+  fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd_ < 0) return Errno("socket");
+  int one = 1;
+  setsockopt(fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  struct sockaddr_in addr;
+  memset(&addr, 0, sizeof(addr));
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  addr.sin_addr.s_addr =
+      host.empty() ? INADDR_ANY : inet_addr(host.c_str());
+  if (::bind(fd_, reinterpret_cast<struct sockaddr*>(&addr), sizeof(addr)) != 0)
+    return Errno("bind " + host + ":" + std::to_string(port));
+  if (::listen(fd_, 128) != 0) return Errno("listen");
+  socklen_t len = sizeof(addr);
+  getsockname(fd_, reinterpret_cast<struct sockaddr*>(&addr), &len);
+  port_ = ntohs(addr.sin_port);
+  return Status::OK();
+}
+
+Status Listener::Accept(Socket* out, double timeout_s) {
+  struct pollfd p;
+  p.fd = fd_;
+  p.events = POLLIN;
+  int rc = ::poll(&p, 1, static_cast<int>(timeout_s * 1000));
+  if (rc <= 0) return Status::Error("accept timed out");
+  int fd = ::accept(fd_, nullptr, nullptr);
+  if (fd < 0) return Errno("accept");
+  SetNoDelay(fd);
+  *out = Socket(fd);
+  return Status::OK();
+}
+
+void Listener::Close() {
+  if (fd_ >= 0) {
+    ::close(fd_);
+    fd_ = -1;
+  }
+}
+
+Listener::~Listener() { Close(); }
+
+}  // namespace hvdtpu

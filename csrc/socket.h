@@ -1,0 +1,70 @@
+// Minimal TCP socket layer for the control plane (rank-0 coordinator) and
+// the peer-to-peer data plane.  Role analog: the transport MPI provided the
+// reference; here it is plain TCP, matching the Spark launcher's TCP service
+// pattern (/root/reference/horovod/spark/util/network.py) re-done natively.
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+#include "common.h"
+
+namespace hvdtpu {
+
+class Socket {
+ public:
+  Socket() = default;
+  explicit Socket(int fd) : fd_(fd) {}
+  Socket(const Socket&) = delete;
+  Socket& operator=(const Socket&) = delete;
+  Socket(Socket&& o) noexcept : fd_(o.fd_) { o.fd_ = -1; }
+  Socket& operator=(Socket&& o) noexcept;
+  ~Socket();
+
+  bool valid() const { return fd_ >= 0; }
+  int fd() const { return fd_; }
+  void Close();
+
+  // Blocking helpers (loop over partial transfers; EINTR-safe).
+  Status SendAll(const void* data, size_t n);
+  Status RecvAll(void* data, size_t n);
+
+  // Simultaneous send+recv via poll(): required by ring steps where every
+  // rank sends to one neighbor while receiving from the other — pure
+  // blocking send-then-recv deadlocks once payloads exceed kernel buffers.
+  static Status SendRecv(Socket& send_sock, const void* send_buf, size_t send_n,
+                         Socket& recv_sock, void* recv_buf, size_t recv_n);
+
+  // Length-prefixed frames.
+  Status SendFrame(const std::string& payload);
+  Status RecvFrame(std::string* payload);
+  // True if a frame header is ready to read without blocking.
+  bool Readable(int timeout_ms = 0) const;
+
+  static Status Connect(const std::string& host, int port, Socket* out,
+                        double timeout_s = 30.0);
+
+  // Local IP of this socket as seen on the route to its peer — the address
+  // other hosts can reach us at (multi-host data-plane advertising).
+  std::string LocalAddr() const;
+
+ private:
+  int fd_ = -1;
+};
+
+class Listener {
+ public:
+  // Binds to host:port; port 0 picks an ephemeral port (readable via port()).
+  Status Listen(const std::string& host, int port);
+  Status Accept(Socket* out, double timeout_s = 30.0);
+  int port() const { return port_; }
+  void Close();
+  ~Listener();
+
+ private:
+  int fd_ = -1;
+  int port_ = 0;
+};
+
+}  // namespace hvdtpu

@@ -1,0 +1,142 @@
+#include "wire.h"
+
+namespace hvdtpu {
+
+namespace {
+
+void PutI32(std::string* s, int32_t v) { s->append(reinterpret_cast<char*>(&v), 4); }
+void PutI64(std::string* s, int64_t v) { s->append(reinterpret_cast<char*>(&v), 8); }
+void PutStr(std::string* s, const std::string& v) {
+  PutI64(s, static_cast<int64_t>(v.size()));
+  s->append(v);
+}
+void PutDims(std::string* s, const std::vector<int64_t>& dims) {
+  PutI64(s, static_cast<int64_t>(dims.size()));
+  for (int64_t d : dims) PutI64(s, d);
+}
+
+struct Reader {
+  const std::string& buf;
+  size_t off = 0;
+  bool fail = false;
+
+  bool Need(size_t n) {
+    if (off + n > buf.size()) {
+      fail = true;
+      return false;
+    }
+    return true;
+  }
+  int32_t I32() {
+    if (!Need(4)) return 0;
+    int32_t v;
+    std::memcpy(&v, buf.data() + off, 4);
+    off += 4;
+    return v;
+  }
+  int64_t I64() {
+    if (!Need(8)) return 0;
+    int64_t v;
+    std::memcpy(&v, buf.data() + off, 8);
+    off += 8;
+    return v;
+  }
+  std::string Str() {
+    int64_t n = I64();
+    if (n < 0 || !Need(static_cast<size_t>(n))) {
+      fail = true;
+      return "";
+    }
+    std::string v = buf.substr(off, static_cast<size_t>(n));
+    off += static_cast<size_t>(n);
+    return v;
+  }
+  std::vector<int64_t> Dims() {
+    int64_t n = I64();
+    std::vector<int64_t> v;
+    if (n < 0 || n > 1024) {
+      fail = true;
+      return v;
+    }
+    v.reserve(static_cast<size_t>(n));
+    for (int64_t i = 0; i < n && !fail; i++) v.push_back(I64());
+    return v;
+  }
+};
+
+}  // namespace
+
+std::string Serialize(const RequestList& l) {
+  std::string s;
+  PutI32(&s, l.shutdown ? 1 : 0);
+  PutI64(&s, static_cast<int64_t>(l.requests.size()));
+  for (const Request& r : l.requests) {
+    PutI32(&s, r.rank);
+    PutI32(&s, static_cast<int32_t>(r.op));
+    PutI32(&s, static_cast<int32_t>(r.dtype));
+    PutI32(&s, r.root_rank);
+    PutStr(&s, r.name);
+    PutDims(&s, r.dims);
+  }
+  return s;
+}
+
+Status Parse(const std::string& buf, RequestList* out) {
+  Reader rd{buf};
+  out->shutdown = rd.I32() != 0;
+  int64_t n = rd.I64();
+  if (n < 0 || n > (1 << 24)) return Status::Error("bad request count");
+  out->requests.clear();
+  out->requests.reserve(static_cast<size_t>(n));
+  for (int64_t i = 0; i < n; i++) {
+    Request r;
+    r.rank = rd.I32();
+    r.op = static_cast<OpType>(rd.I32());
+    r.dtype = static_cast<DType>(rd.I32());
+    r.root_rank = rd.I32();
+    r.name = rd.Str();
+    r.dims = rd.Dims();
+    if (rd.fail) return Status::Error("truncated request list");
+    out->requests.push_back(std::move(r));
+  }
+  return Status::OK();
+}
+
+std::string Serialize(const ResponseList& l) {
+  std::string s;
+  PutI32(&s, l.shutdown ? 1 : 0);
+  PutI64(&s, static_cast<int64_t>(l.responses.size()));
+  for (const Response& r : l.responses) {
+    PutI32(&s, static_cast<int32_t>(r.op));
+    PutI32(&s, r.root_rank);
+    PutStr(&s, r.error_message);
+    PutI64(&s, static_cast<int64_t>(r.names.size()));
+    for (const std::string& nm : r.names) PutStr(&s, nm);
+    PutDims(&s, r.first_dims);
+  }
+  return s;
+}
+
+Status Parse(const std::string& buf, ResponseList* out) {
+  Reader rd{buf};
+  out->shutdown = rd.I32() != 0;
+  int64_t n = rd.I64();
+  if (n < 0 || n > (1 << 24)) return Status::Error("bad response count");
+  out->responses.clear();
+  out->responses.reserve(static_cast<size_t>(n));
+  for (int64_t i = 0; i < n; i++) {
+    Response r;
+    r.op = static_cast<OpType>(rd.I32());
+    r.root_rank = rd.I32();
+    r.error_message = rd.Str();
+    int64_t nn = rd.I64();
+    if (nn < 0 || nn > (1 << 24)) return Status::Error("bad name count");
+    for (int64_t j = 0; j < nn; j++) r.names.push_back(rd.Str());
+    r.first_dims = rd.Dims();
+    if (rd.fail) return Status::Error("truncated response list");
+    out->responses.push_back(std::move(r));
+  }
+  return Status::OK();
+}
+
+}  // namespace hvdtpu

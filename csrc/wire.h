@@ -1,0 +1,51 @@
+// Control-plane wire protocol: worker->coordinator request lists and
+// coordinator->worker response lists.  Role analog: the reference's
+// MPIRequest/MPIResponse flatbuffers (horovod/common/mpi_message.h,
+// common/wire/mpi_message.fbs) — re-designed as a hand-rolled, dependency-
+// free, length-prefixed binary encoding (the schema is 6 fields; a
+// serialization library buys nothing here).
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+#include "common.h"
+
+namespace hvdtpu {
+
+struct Request {
+  int32_t rank = 0;
+  OpType op = OpType::kAllreduce;
+  DType dtype = DType::kFloat32;
+  std::string name;
+  int32_t root_rank = -1;                 // broadcast only
+  std::vector<int64_t> dims;              // tensor shape
+};
+
+struct RequestList {
+  std::vector<Request> requests;
+  bool shutdown = false;
+};
+
+struct Response {
+  OpType op = OpType::kAllreduce;
+  std::vector<std::string> names;         // >1 => fused execution
+  std::string error_message;              // op == kError
+  int32_t root_rank = -1;                 // broadcast
+  // allgather/alltoall: first-dim contribution of every rank, in rank order
+  std::vector<int64_t> first_dims;
+};
+
+struct ResponseList {
+  std::vector<Response> responses;
+  bool shutdown = false;
+};
+
+// Serialization (little-endian host assumed; single-arch clusters).
+std::string Serialize(const RequestList& l);
+std::string Serialize(const ResponseList& l);
+Status Parse(const std::string& buf, RequestList* out);
+Status Parse(const std::string& buf, ResponseList* out);
+
+}  // namespace hvdtpu

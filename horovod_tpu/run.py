@@ -1,0 +1,153 @@
+"""``python -m horovod_tpu.run`` — the process launcher.
+
+Role analog of the reference's launch story (external ``mpirun``,
+``/root/reference/README.md:164-184``, plus the Spark launcher's process
+management ``/root/reference/horovod/spark/util/safe_shell_exec.py``) —
+except self-contained: no MPI.  It spawns N local worker processes with the
+rank/size/rendezvous environment the native engine bootstraps from, and
+kills the whole process tree if any worker dies or the launcher is
+interrupted (no orphans, no half-dead training jobs).
+
+Usage:
+    python -m horovod_tpu.run -np 4 python train.py [args...]
+
+Multi-host: run one launcher per host with ``--hosts`` listing
+"host:slots,..." and ``--host-index`` identifying this host; rendezvous is
+rank 0's host.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _parse_hosts(spec: str) -> list[tuple[str, int]]:
+    out = []
+    for part in spec.split(","):
+        host, _, slots = part.partition(":")
+        out.append((host.strip(), int(slots or "1")))
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="horovod_tpu.run")
+    ap.add_argument("-np", "--num-proc", type=int, required=True)
+    ap.add_argument("--hosts", default=None,
+                    help='"host1:slots,host2:slots" for multi-host runs')
+    ap.add_argument("--host-index", type=int, default=0,
+                    help="index of this host in --hosts")
+    ap.add_argument("--rendezvous-port", type=int, default=None)
+    ap.add_argument("--start-timeout", type=float, default=120.0)
+    ap.add_argument("command", nargs=argparse.REMAINDER)
+    args = ap.parse_args(argv)
+
+    if not args.command:
+        ap.error("no command given")
+    cmd = args.command
+    if cmd[0] == "--":
+        cmd = cmd[1:]
+
+    if args.hosts:
+        hosts = _parse_hosts(args.hosts)
+        total_slots = sum(s for _, s in hosts)
+        if total_slots < args.num_proc:
+            ap.error(f"--hosts provides {total_slots} slots < -np {args.num_proc}")
+        if args.rendezvous_port is None and not os.environ.get(
+                "HOROVOD_TPU_RENDEZVOUS_PORT"):
+            # each host runs its own launcher; a randomly-chosen port on one
+            # host cannot be known by the others
+            ap.error("--hosts requires an explicit --rendezvous-port "
+                     "(or HOROVOD_TPU_RENDEZVOUS_PORT) agreed by every host")
+        rendezvous_host = hosts[0][0]
+        first_rank = sum(s for _, s in hosts[: args.host_index])
+        local_n = min(hosts[args.host_index][1],
+                      args.num_proc - first_rank)
+        cross_size = len(hosts)
+        cross_rank = args.host_index
+    else:
+        rendezvous_host = "127.0.0.1"
+        first_rank = 0
+        local_n = args.num_proc
+        cross_size, cross_rank = 1, 0
+
+    port = args.rendezvous_port or int(
+        os.environ.get("HOROVOD_TPU_RENDEZVOUS_PORT", 0)) or _free_port()
+
+    procs: list[subprocess.Popen] = []
+
+    def _kill_all(*_):
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    os.killpg(p.pid, signal.SIGTERM)
+                except ProcessLookupError:
+                    pass
+        for p in procs:
+            try:
+                p.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                try:
+                    os.killpg(p.pid, signal.SIGKILL)
+                except ProcessLookupError:
+                    pass
+
+    signal.signal(signal.SIGINT, lambda *a: (_kill_all(), sys.exit(130)))
+    signal.signal(signal.SIGTERM, lambda *a: (_kill_all(), sys.exit(143)))
+
+    for local_rank in range(local_n):
+        rank = first_rank + local_rank
+        env = dict(os.environ)
+        env.update({
+            "HOROVOD_TPU_RANK": str(rank),
+            "HOROVOD_TPU_SIZE": str(args.num_proc),
+            "HOROVOD_TPU_LOCAL_RANK": str(local_rank),
+            "HOROVOD_TPU_LOCAL_SIZE": str(local_n),
+            "HOROVOD_TPU_CROSS_RANK": str(cross_rank),
+            "HOROVOD_TPU_CROSS_SIZE": str(cross_size),
+            "HOROVOD_TPU_RENDEZVOUS": f"{rendezvous_host}:{port}",
+        })
+        # each worker leads its own process group so a stuck worker's whole
+        # subtree can be killed
+        procs.append(subprocess.Popen(cmd, env=env, start_new_session=True))
+
+    exit_code = 0
+    remaining = set(range(local_n))
+    try:
+        while remaining:
+            for i in sorted(remaining):
+                rc = procs[i].poll()
+                if rc is None:
+                    continue
+                remaining.discard(i)
+                if rc != 0:
+                    print(
+                        f"[horovod_tpu.run] rank {first_rank + i} exited "
+                        f"with code {rc}; terminating remaining workers",
+                        file=sys.stderr,
+                    )
+                    exit_code = rc
+                    _kill_all()
+                    remaining.clear()
+                    break
+            if remaining:
+                import time
+
+                time.sleep(0.05)
+    finally:
+        _kill_all()
+    return exit_code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

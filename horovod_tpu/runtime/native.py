@@ -1,0 +1,231 @@
+"""ctypes binding to the native collective engine (``csrc/libhvdtpu.so``).
+
+Role analog of the reference's Python→C bridge
+(``/root/reference/horovod/common/__init__.py:51-154`` ctypes basics plus the
+torch handle API ``/root/reference/horovod/torch/mpi_ops.py:86-438``): async
+ops return integer handles owned by the C++ engine; ``poll``/``synchronize``
+query them.  The GIL is released for the duration of every native call, so
+the background thread makes progress while Python waits.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+from horovod_tpu.runtime.engine import Engine
+
+_SUM = "sum"
+
+# DType enum mirror of csrc/common.h
+_DTYPES: dict[str, int] = {
+    "uint8": 0,
+    "int8": 1,
+    "int32": 2,
+    "int64": 3,
+    "float16": 4,
+    "bfloat16": 5,
+    "float32": 6,
+    "float64": 7,
+}
+
+_OP_ALLREDUCE, _OP_ALLGATHER, _OP_BROADCAST, _OP_ALLTOALL = 0, 1, 2, 3
+
+_build_lock = threading.Lock()
+_lib = None
+
+
+def _csrc_dir() -> str:
+    return os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+        "csrc",
+    )
+
+
+def _load_lib():
+    global _lib
+    with _build_lock:
+        if _lib is not None:
+            return _lib
+        so = os.path.join(_csrc_dir(), "libhvdtpu.so")
+        if not os.path.exists(so):
+            # build on demand; the toolchain is a framework requirement
+            subprocess.run(
+                ["make", "-C", _csrc_dir()], check=True, capture_output=True
+            )
+        lib = ctypes.CDLL(so)
+        lib.hvd_native_init.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                        ctypes.c_int, ctypes.c_int]
+        lib.hvd_native_init.restype = ctypes.c_int
+        lib.hvd_native_shutdown.restype = None
+        lib.hvd_enqueue.argtypes = [
+            ctypes.c_int, ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_void_p, ctypes.c_int,
+        ]
+        lib.hvd_enqueue.restype = ctypes.c_int
+        lib.hvd_poll.argtypes = [ctypes.c_int]
+        lib.hvd_poll.restype = ctypes.c_int
+        lib.hvd_wait.argtypes = [ctypes.c_int, ctypes.c_double]
+        lib.hvd_wait.restype = ctypes.c_int
+        lib.hvd_result_ndim.argtypes = [ctypes.c_int]
+        lib.hvd_result_ndim.restype = ctypes.c_int
+        lib.hvd_result_dims.argtypes = [ctypes.c_int,
+                                        ctypes.POINTER(ctypes.c_int64)]
+        lib.hvd_result_dims.restype = None
+        lib.hvd_result_nbytes.argtypes = [ctypes.c_int]
+        lib.hvd_result_nbytes.restype = ctypes.c_int64
+        lib.hvd_result_copy.argtypes = [ctypes.c_int, ctypes.c_void_p]
+        lib.hvd_result_copy.restype = None
+        lib.hvd_error_str.argtypes = [ctypes.c_int]
+        lib.hvd_error_str.restype = ctypes.c_void_p  # manual free
+        lib.hvd_free_cstr.argtypes = [ctypes.c_void_p]
+        lib.hvd_free_cstr.restype = None
+        lib.hvd_release.argtypes = [ctypes.c_int]
+        lib.hvd_release.restype = None
+        _lib = lib
+        return lib
+
+
+def rendezvous_addr() -> tuple[str, int]:
+    addr = os.environ.get("HOROVOD_TPU_RENDEZVOUS", "127.0.0.1:29500")
+    host, _, port = addr.rpartition(":")
+    return host or "127.0.0.1", int(port)
+
+
+def _np_view(array: np.ndarray) -> tuple[np.ndarray, int]:
+    """Contiguous view + DType code, mapping unsupported dtypes up."""
+    arr = np.ascontiguousarray(array)
+    name = arr.dtype.name
+    if name == "bool":
+        arr = arr.astype(np.uint8)
+        name = "uint8"
+    if name not in _DTYPES:
+        raise TypeError(f"dtype {array.dtype} not supported by the native engine")
+    return arr, _DTYPES[name]
+
+
+class NativeEngine(Engine):
+    """Multi-process eager engine backed by the C++ core."""
+
+    name = "native"
+
+    def __init__(self, topology, comm_ranks=None) -> None:
+        super().__init__()
+        if comm_ranks is not None:
+            raise NotImplementedError(
+                "sub-communicators on the native engine are not implemented "
+                "yet; run the sub-world as its own launch instead"
+            )
+        self._topology = topology
+        self._dtype_by_handle: dict[int, np.dtype] = {}
+        self._lock = threading.Lock()
+        lib = _load_lib()
+        host, port = rendezvous_addr()
+        rc = lib.hvd_native_init(host.encode(), port, topology.rank,
+                                 topology.size)
+        if rc != 0:
+            raise RuntimeError(
+                f"native engine init failed (rank {topology.rank} of "
+                f"{topology.size}, rendezvous {host}:{port})"
+            )
+        self._lib = lib
+
+    # -- async ops ---------------------------------------------------------
+    def _enqueue(self, op: int, array, name: str, root_rank: int = -1) -> int:
+        arr, dtype = _np_view(np.asarray(array))
+        dims = (ctypes.c_int64 * max(arr.ndim, 1))(*(arr.shape or (1,)))
+        handle = self._lib.hvd_enqueue(
+            op, name.encode(), dtype, arr.ndim, dims,
+            arr.ctypes.data_as(ctypes.c_void_p), root_rank,
+        )
+        if handle < 0:
+            raise RuntimeError("enqueue failed: engine not running")
+        with self._lock:
+            self._dtype_by_handle[handle] = arr.dtype
+        return handle
+
+    def allreduce_async(self, array, name, op=_SUM) -> int:
+        if op != _SUM:
+            raise ValueError("native engine reduces with op='sum'; apply "
+                             "min/max via the compiled path")
+        return self._enqueue(_OP_ALLREDUCE, array, name)
+
+    def allgather_async(self, array, name) -> int:
+        return self._enqueue(_OP_ALLGATHER, array, name)
+
+    def broadcast_async(self, array, root_rank, name) -> int:
+        if not 0 <= root_rank < self._topology.size:
+            raise ValueError(
+                f"broadcast root_rank {root_rank} out of range for world "
+                f"size {self._topology.size}"
+            )
+        return self._enqueue(_OP_BROADCAST, array, name, root_rank)
+
+    def alltoall_async(self, array, name) -> int:
+        arr = np.asarray(array)
+        dim0 = arr.shape[0] if arr.ndim else 1
+        if dim0 % self._topology.size != 0:
+            raise ValueError(
+                f"alltoall first dim {dim0} must be divisible by world size "
+                f"{self._topology.size}"
+            )
+        return self._enqueue(_OP_ALLTOALL, array, name)
+
+    # -- completion --------------------------------------------------------
+    def poll(self, handle: int) -> bool:
+        rc = self._lib.hvd_poll(handle)
+        if rc == -2:
+            raise ValueError(f"unknown handle {handle}")
+        return rc != 0
+
+    def synchronize(self, handle: int, timeout: float | None = None):
+        rc = self._lib.hvd_wait(handle, -1.0 if timeout is None else timeout)
+        if rc == 0:
+            raise TimeoutError(f"handle {handle} not complete")
+        if rc == -2:
+            raise ValueError(f"unknown handle {handle}")
+        try:
+            if rc < 0:
+                p = self._lib.hvd_error_str(handle)
+                try:
+                    msg = ctypes.cast(p, ctypes.c_char_p).value.decode()
+                finally:
+                    self._lib.hvd_free_cstr(p)
+                raise RuntimeError(f"collective failed: {msg}")
+            ndim = self._lib.hvd_result_ndim(handle)
+            dims = (ctypes.c_int64 * max(ndim, 1))()
+            self._lib.hvd_result_dims(handle, dims)
+            shape = tuple(dims[i] for i in range(ndim))
+            with self._lock:
+                dtype = self._dtype_by_handle.get(handle, np.dtype(np.float32))
+            out = np.empty(shape, dtype)
+            nbytes = self._lib.hvd_result_nbytes(handle)
+            assert nbytes == out.nbytes, (nbytes, out.nbytes, shape, dtype)
+            self._lib.hvd_result_copy(handle, out.ctypes.data_as(ctypes.c_void_p))
+            return out
+        finally:
+            # note: average_handles is NOT touched here — the frontend
+            # (horovod_tpu.synchronize) owns the divide-by-size contract
+            self._lib.hvd_release(handle)
+            with self._lock:
+                self._dtype_by_handle.pop(handle, None)
+
+    # -- sync wrappers (route through native wait, not HandleManager) ------
+    def allreduce(self, array, name, op=_SUM):
+        return self.synchronize(self.allreduce_async(array, name, op))
+
+    def allgather(self, array, name):
+        return self.synchronize(self.allgather_async(array, name))
+
+    def broadcast(self, array, root_rank, name):
+        return self.synchronize(self.broadcast_async(array, root_rank, name))
+
+    def alltoall(self, array, name):
+        return self.synchronize(self.alltoall_async(array, name))
+
+    def shutdown(self) -> None:
+        self._lib.hvd_native_shutdown()

@@ -1,0 +1,148 @@
+"""Rank-parametric worker driven by tests/test_native_engine.py through the
+launcher — the same strategy as the reference's mpirun-able test files
+(SURVEY.md §4): one script, any world size, rank expectations from env."""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import horovod_tpu as hvd  # noqa: E402
+
+
+def scenario_collectives():
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+
+    out = hvd.allreduce(np.full((4, 2), float(r + 1), np.float32), average=False)
+    assert np.allclose(out, n * (n + 1) / 2), (r, out)
+
+    out = hvd.allreduce(np.full(5, float(r), np.float64))
+    assert np.allclose(out, (n - 1) / 2), (r, out)
+
+    # fusion: many async named ops in flight at once
+    handles = [
+        hvd.allreduce_async(np.full(3, float(i + r), np.float32),
+                            average=False, name=f"t{i}")
+        for i in range(20)
+    ]
+    ranks_sum = n * (n - 1) / 2
+    for i, h in enumerate(handles):
+        got = hvd.synchronize(h)
+        assert np.allclose(got, n * i + ranks_sum), (r, i, got)
+
+    # allgather with rank-dependent first dim
+    gat = hvd.allgather(np.full((r + 1, 2), float(r), np.int32))
+    expect = np.concatenate(
+        [np.full((k + 1, 2), k, np.int32) for k in range(n)]
+    )
+    assert np.array_equal(gat, expect), (r, gat)
+
+    # broadcast from root 1
+    val = np.arange(6, dtype=np.float32).reshape(2, 3) * (r + 1)
+    got = hvd.broadcast(val, root_rank=1)
+    assert np.allclose(got, np.arange(6, dtype=np.float32).reshape(2, 3) * 2)
+
+    # alltoall, n rows to each destination
+    rows = 2 * n
+    inp = np.arange(rows * 2, dtype=np.float32).reshape(rows, 2) + 100 * r
+    got = hvd.alltoall(inp)
+    expect = np.concatenate([
+        (np.arange(rows * 2, dtype=np.float32).reshape(rows, 2) + 100 * k)[
+            2 * r:2 * r + 2]
+        for k in range(n)
+    ])
+    assert np.array_equal(got, expect), (r, got, expect)
+
+    # async + average: the frontend must divide after synchronize
+    # (regression: the engine once consumed the average flag itself)
+    h = hvd.allreduce_async(np.full(3, float(n), np.float32), average=True)
+    got = hvd.synchronize(h)
+    assert np.allclose(got, float(n)), (r, got)
+
+    # bf16 reduction (native engine converts via float)
+    import ml_dtypes
+
+    got = hvd.allreduce(np.full(4, 1.5, ml_dtypes.bfloat16), average=False)
+    assert got.dtype.name == "bfloat16"
+    assert np.allclose(got.astype(np.float32), 1.5 * n)
+
+    hvd.shutdown()
+    print(f"rank {r}: collectives OK", flush=True)
+
+
+def scenario_errors():
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+
+    # cross-rank shape mismatch -> clean error on every rank, not a hang
+    try:
+        hvd.allreduce(np.zeros((r + 1,), np.float32), name="bad_shape")
+        raise SystemExit(f"rank {r}: expected mismatch error")
+    except RuntimeError as e:
+        assert "shape mismatch" in str(e), str(e)
+
+    # dtype mismatch
+    dtype = np.float32 if r % 2 == 0 else np.float64
+    try:
+        hvd.allreduce(np.zeros(4, dtype), name="bad_dtype")
+        raise SystemExit(f"rank {r}: expected dtype error")
+    except RuntimeError as e:
+        assert "dtype mismatch" in str(e), str(e)
+
+    # broadcast root disagreement
+    try:
+        hvd.broadcast(np.zeros(4, np.float32), root_rank=r % 2, name="bad_root")
+        raise SystemExit(f"rank {r}: expected root error")
+    except RuntimeError as e:
+        assert "root mismatch" in str(e), str(e)
+
+    # engine still healthy after errors
+    out = hvd.allreduce(np.ones(2, np.float32), average=False, name="after")
+    assert np.allclose(out, n), out
+
+    # duplicate in-flight name errors immediately
+    h1 = hvd.allreduce_async(np.ones(4, np.float32), name="dup")
+    h2 = hvd.allreduce_async(np.ones(4, np.float32), name="dup")
+    try:
+        hvd.synchronize(h2)
+        raise SystemExit(f"rank {r}: expected duplicate error")
+    except RuntimeError as e:
+        assert "duplicate" in str(e), str(e)
+    hvd.synchronize(h1)
+
+    hvd.shutdown()
+    print(f"rank {r}: errors OK", flush=True)
+
+
+def scenario_stall():
+    # rank 0 submits an op nobody else joins; the coordinator must warn
+    hvd.init()
+    r = hvd.rank()
+    if r == 0:
+        h = hvd.allreduce_async(np.ones(2, np.float32), name="lonely")
+        import time
+
+        time.sleep(2.0)
+        assert not hvd.poll(h)
+    else:
+        import time
+
+        time.sleep(2.0)
+    hvd.shutdown()
+    print(f"rank {r}: stall OK", flush=True)
+
+
+def scenario_crash():
+    hvd.init()
+    if hvd.rank() == 1:
+        sys.exit(3)  # simulated worker death
+    import time
+
+    time.sleep(30)  # must be killed by the launcher, not run to completion
+
+
+if __name__ == "__main__":
+    globals()[f"scenario_{sys.argv[1]}"]()

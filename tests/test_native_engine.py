@@ -1,0 +1,58 @@
+"""Multi-process tests of the native C++ engine, driven through the launcher
+— the "real processes as cluster test-double" strategy of the reference
+(SURVEY.md §4), with the launcher replacing mpirun."""
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "native_worker.py")
+
+
+def _run(scenario: str, np_: int, timeout: float = 120.0, env=None):
+    full_env = dict(os.environ)
+    full_env.update(env or {})
+    return subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.run", "-np", str(np_),
+         sys.executable, WORKER, scenario],
+        cwd=REPO, env=full_env, capture_output=True, text=True,
+        timeout=timeout,
+    )
+
+
+# 6 exercises the non-power-of-two binomial broadcast tree (regression:
+# vrank 5's parent never forwarded with the old mask walk)
+@pytest.mark.parametrize("np_", [2, 3, 6])
+def test_collectives(np_):
+    res = _run("collectives", np_)
+    assert res.returncode == 0, res.stderr + res.stdout
+    for r in range(np_):
+        assert f"rank {r}: collectives OK" in res.stdout
+
+
+def test_cross_rank_errors_do_not_hang():
+    t0 = time.monotonic()
+    res = _run("errors", 3)
+    assert res.returncode == 0, res.stderr + res.stdout
+    assert time.monotonic() - t0 < 60, "error path took suspiciously long"
+    for r in range(3):
+        assert f"rank {r}: errors OK" in res.stdout
+
+
+def test_stall_warning():
+    res = _run("stall", 2, env={"HOROVOD_TPU_STALL_WARNING_SECS": "1"})
+    assert res.returncode == 0, res.stderr + res.stdout
+    assert "possible stall" in res.stderr
+    assert "lonely" in res.stderr
+
+
+def test_worker_crash_kills_world():
+    t0 = time.monotonic()
+    res = _run("crash", 3)
+    # launcher must propagate the failing exit code and kill the sleepers
+    assert res.returncode == 3, (res.returncode, res.stderr)
+    assert time.monotonic() - t0 < 25, "launcher failed to kill surviving workers"
