@@ -173,6 +173,7 @@ class Engine {
   int cycle_ms_ = 5;
   double stall_warn_s_ = 60.0;
   bool stall_check_ = true;
+  double start_timeout_s_ = 120.0;
 
   Socket coord_;                        // worker->coordinator (rank != 0)
   std::vector<Socket> workers_;         // coordinator->worker (rank 0)
@@ -217,6 +218,8 @@ Status Engine::Init(const std::string& host, int port, int rank, int size) {
       EnvInt64("HOROVOD_TPU_STALL_WARNING_SECS", 60));
   stall_check_ = !EnvFlag("HOROVOD_TPU_STALL_CHECK_DISABLE") &&
                  !EnvFlag("HOROVOD_STALL_CHECK_DISABLE");
+  start_timeout_s_ = static_cast<double>(
+      EnvInt64("HOROVOD_TPU_START_TIMEOUT", 120));
 
   if (size_ > 1) {
     // data-plane listener first, so peers can connect whenever they learn
@@ -239,7 +242,7 @@ Status Engine::Init(const std::string& host, int port, int rank, int size) {
       std::vector<int> order(size_, -1);
       for (int i = 1; i < size_; i++) {
         Socket sock;
-        s = rv.Accept(&sock, 120.0);
+        s = rv.Accept(&sock, start_timeout_s_);
         if (!s.ok()) return s;
         std::string hello;
         s = sock.RecvFrame(&hello);
@@ -262,7 +265,7 @@ Status Engine::Init(const std::string& host, int port, int rank, int size) {
         if (!s.ok()) return s;
       }
     } else {
-      s = Socket::Connect(host, port, &coord_, 120.0);
+      s = Socket::Connect(host, port, &coord_, start_timeout_s_);
       if (!s.ok()) return s;
       // advertise the local IP on the route to the coordinator — the
       // address peers on other hosts can reach our data listener at
@@ -283,7 +286,7 @@ Status Engine::Init(const std::string& host, int port, int rank, int size) {
     peers_.resize(size_);
     for (int j = 0; j < rank_; j++) {
       Socket sock;
-      s = Socket::Connect(hosts[j], ports[j], &sock, 120.0);
+      s = Socket::Connect(hosts[j], ports[j], &sock, start_timeout_s_);
       if (!s.ok()) return s;
       int32_t me = rank_;
       s = sock.SendAll(&me, sizeof(me));
@@ -292,7 +295,7 @@ Status Engine::Init(const std::string& host, int port, int rank, int size) {
     }
     for (int j = rank_ + 1; j < size_; j++) {
       Socket sock;
-      s = data_listener_.Accept(&sock, 120.0);
+      s = data_listener_.Accept(&sock, start_timeout_s_);
       if (!s.ok()) return s;
       int32_t who = -1;
       s = sock.RecvAll(&who, sizeof(who));

@@ -116,6 +116,8 @@ def main(argv=None) -> int:
             "HOROVOD_TPU_CROSS_RANK": str(cross_rank),
             "HOROVOD_TPU_CROSS_SIZE": str(cross_size),
             "HOROVOD_TPU_RENDEZVOUS": f"{rendezvous_host}:{port}",
+            # native engine bounds its rendezvous connect/accept by this
+            "HOROVOD_TPU_START_TIMEOUT": str(int(args.start_timeout)),
         })
         # each worker leads its own process group so a stuck worker's whole
         # subtree can be killed

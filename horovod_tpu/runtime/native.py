@@ -52,8 +52,16 @@ def _load_lib():
         if _lib is not None:
             return _lib
         so = os.path.join(_csrc_dir(), "libhvdtpu.so")
-        if not os.path.exists(so):
-            # build on demand; the toolchain is a framework requirement
+        sources = [
+            os.path.join(_csrc_dir(), f)
+            for f in os.listdir(_csrc_dir())
+            if f.endswith((".cc", ".h")) or f == "Makefile"
+        ]
+        stale = not os.path.exists(so) or any(
+            os.path.getmtime(src) > os.path.getmtime(so) for src in sources
+        )
+        if stale:
+            # (re)build on demand; the toolchain is a framework requirement
             subprocess.run(
                 ["make", "-C", _csrc_dir()], check=True, capture_output=True
             )

@@ -1,0 +1,272 @@
+"""PyTorch frontend — API parity with ``horovod.torch``
+(``/root/reference/horovod/torch/__init__.py``), served by the TPU-native
+eager engine instead of MPI/NCCL.
+
+Provides the reference's full surface: basics (init/rank/size/...), the
+collective ops in all variants (``horovod_tpu.torch.mpi_ops``),
+``DistributedOptimizer`` with per-parameter backward hooks and
+``backward_passes_per_step`` gradient accumulation, ``broadcast_parameters``
+and ``broadcast_optimizer_state`` for start-of-training consistency.
+"""
+
+from __future__ import annotations
+
+import collections
+
+import numpy as np
+import torch
+
+from horovod_tpu import (  # noqa: F401  (re-exported basics)
+    init, shutdown, is_initialized,
+    rank, size, local_rank, local_size, cross_rank, cross_size,
+    mpi_threads_supported,
+)
+from horovod_tpu.torch.compression import Compression
+from horovod_tpu.torch.mpi_ops import (  # noqa: F401
+    allreduce, allreduce_, allreduce_async, allreduce_async_,
+    allgather, allgather_async,
+    broadcast, broadcast_, broadcast_async, broadcast_async_,
+    alltoall, poll, synchronize,
+)
+
+
+class _DistributedOptimizer(torch.optim.Optimizer):
+    """Wraps a torch optimizer so gradients are allreduced during backward.
+
+    Mirrors the reference's design (``torch/__init__.py:42-151``): a hook per
+    parameter fires when its gradient is accumulated, launching an async
+    allreduce immediately — communication overlaps the rest of backward —
+    and ``step()`` first ``synchronize()``s every outstanding handle.
+    ``backward_passes_per_step=k`` delays the allreduce until k backward
+    passes have accumulated into ``.grad`` (reference ``:90-130``).
+    """
+
+    def __init__(self, params, named_parameters, compression,
+                 backward_passes_per_step=1):
+        # deliberately no Optimizer.__init__: this object adopted the state
+        # of an existing optimizer (see DistributedOptimizer factory below)
+        self._compression = compression
+        if named_parameters is not None:
+            named_parameters = list(named_parameters)
+        else:
+            named_parameters = [
+                (f"allreduce.noname.{i}", v)
+                for i, v in enumerate(
+                    v for group in self.param_groups for v in group["params"])
+            ]
+        # all named_parameters must be (str, Tensor) and names unique
+        dups = [k for k, n in collections.Counter(
+            name for name, _ in named_parameters).items() if n > 1]
+        if dups:
+            raise ValueError(f"named_parameters has duplicate names: {dups}")
+        all_params = {
+            id(v) for group in self.param_groups for v in group["params"]
+        }
+        named = {id(v) for _, v in named_parameters}
+        unnamed = all_params - named
+        if unnamed:
+            raise ValueError(
+                f"named_parameters covers {len(named & all_params)} of "
+                f"{len(all_params)} optimizer parameters; name them all")
+        self._parameter_names = {v: k for k, v in named_parameters}
+        self.backward_passes_per_step = backward_passes_per_step
+        self._allreduce_delay = {}
+        self._handles = {}
+        self._grad_accs = []
+        if size() > 1:
+            self._register_hooks()
+
+    def set_backward_passes_per_step(self, passes):
+        self.backward_passes_per_step = passes
+        for p in self._allreduce_delay:
+            self._allreduce_delay[p] = passes
+
+    def _register_hooks(self):
+        for param_group in self.param_groups:
+            for p in param_group["params"]:
+                if p.requires_grad:
+                    self._allreduce_delay[p] = self.backward_passes_per_step
+                    if hasattr(p, "register_post_accumulate_grad_hook"):
+                        p.register_post_accumulate_grad_hook(
+                            self._make_post_hook())
+                    else:
+                        # pre-2.1 torch: hook the autograd-graph gradient
+                        # accumulator node for p
+                        p_tmp = p.expand_as(p)
+                        grad_acc = p_tmp.grad_fn.next_functions[0][0]
+                        grad_acc.register_hook(self._make_acc_hook(p))
+                        self._grad_accs.append(grad_acc)
+
+    def _allreduce_grad_async(self, p):
+        name = self._parameter_names[p]
+        tensor_compressed, ctx = self._compression.compress(p.grad)
+        handle = allreduce_async_(tensor_compressed, average=True, name=name)
+        return handle, ctx, tensor_compressed
+
+    def _hook_fired(self, p):
+        if p.grad is None:
+            return
+        if self._allreduce_delay[p] <= 0:
+            raise AssertionError(
+                "Gradients were computed more than backward_passes_per_step "
+                "times before step(); raise backward_passes_per_step or call "
+                "step() between backward passes")
+        self._allreduce_delay[p] -= 1
+        if self._allreduce_delay[p] == 0:
+            self._handles[p] = self._allreduce_grad_async(p)
+
+    def _make_post_hook(self):
+        return self._hook_fired
+
+    def _make_acc_hook(self, p):
+        def hook(*ignore):
+            if p.grad is not None:
+                assert not p.grad.requires_grad
+            self._hook_fired(p)
+        return hook
+
+    def synchronize(self):
+        """Wait for every outstanding gradient allreduce and install the
+        averaged, decompressed results into ``.grad``.
+
+        Parameters whose hook never fired this step (partial accumulation,
+        param unused in this rank's forward) are force-reduced here so ranks
+        can never silently apply un-averaged local gradients (reference
+        ``torch/__init__.py:132-143``).
+        """
+        missing = [p for p in self._allreduce_delay
+                   if p.requires_grad and p.grad is not None
+                   and p not in self._handles]
+        for p in missing:
+            self._handles[p] = self._allreduce_grad_async(p)
+        for p, (handle, ctx, compressed) in self._handles.items():
+            synchronize(handle)
+            self._allreduce_delay[p] = self.backward_passes_per_step
+            with torch.no_grad():
+                p.grad.copy_(self._compression.decompress(compressed, ctx))
+        self._handles.clear()
+
+    def step(self, closure=None):
+        if size() > 1:
+            self.synchronize()
+        return self._inner_step(closure)
+
+
+def DistributedOptimizer(optimizer, named_parameters=None,
+                         compression=Compression.none,
+                         backward_passes_per_step=1):
+    """An optimizer that averages gradients across all processes before
+    applying them (reference ``torch/__init__.py:154-197``)."""
+    body = {k: v for k, v in _DistributedOptimizer.__dict__.items()
+            if k not in ("__dict__", "__weakref__")}
+    cls = type("DistributedOptimizer", (optimizer.__class__,), body)
+    obj = cls.__new__(cls)
+    obj.__dict__.update(optimizer.__dict__)
+    obj._inner_step = super(cls, obj).step
+    _DistributedOptimizer.__init__(obj, None, named_parameters, compression,
+                                   backward_passes_per_step)
+    return obj
+
+
+def broadcast_parameters(params, root_rank=0):
+    """Broadcast parameters from ``root_rank`` to all other processes.
+
+    Accepts a ``state_dict()`` or any iterable of ``(name, tensor)``
+    (reference ``torch/__init__.py:200-229``).  All broadcasts launch async
+    first, then synchronize — the engine overlaps and fuses them.
+    """
+    if isinstance(params, dict):
+        items = sorted(params.items())
+    else:
+        items = list(params)
+    handles = []
+    for name, p in items:
+        if p is None:
+            continue
+        if not torch.is_tensor(p):
+            raise ValueError(f"invalid params of type {type(p)} for {name!r}")
+        handles.append(broadcast_async_(p, root_rank, name=f"param.{name}"))
+    for h in handles:
+        synchronize(h)
+
+
+def broadcast_optimizer_state(optimizer, root_rank=0):
+    """Broadcast an optimizer's full state (per-param state tensors AND
+    scalar hyper-options like lr/momentum) from ``root_rank``.
+
+    Scalars are wrapped into tensors for the wire and cast back to their
+    original Python types afterwards (reference ``torch/__init__.py:232-348``).
+    """
+    if isinstance(optimizer, torch.optim.LBFGS):
+        raise ValueError("cannot broadcast torch.optim.LBFGS state")
+    state_dict = optimizer.state_dict()
+
+    # Ranks that have not stepped yet have empty per-param state; initialize
+    # it by applying a zero-gradient step so every rank holds the same slots.
+    # Grads are zeroed unconditionally: a pending real gradient must not turn
+    # this into a genuine local-only update that diverges from root.
+    if len(state_dict["state"]) == 0:
+        for group in optimizer.param_groups:
+            for p in group["params"]:
+                if p.requires_grad:
+                    p.grad = torch.zeros_like(p)
+        optimizer.step()
+        state_dict = optimizer.state_dict()
+
+    handles = []          # (apply_fn, handle)
+
+    def _wrap(key, value, assign):
+        """Broadcast a python scalar as a tensor and restore its type."""
+        if isinstance(value, bool):
+            t, back = torch.tensor([int(value)]), lambda v: bool(int(v[0]))
+        elif isinstance(value, int):
+            t, back = torch.tensor([value], dtype=torch.int64), lambda v: int(v[0])
+        elif isinstance(value, float):
+            t, back = torch.tensor([value], dtype=torch.float64), lambda v: float(v[0])
+        else:
+            return False
+        h = broadcast_async_(t, root_rank, name=key)
+        handles.append((lambda v=t, fn=back, a=assign: a(fn(v)), h))
+        return True
+
+    for gi, group in enumerate(state_dict["param_groups"]):
+        for opt_key, opt_val in sorted(group.items()):
+            if opt_key == "params":
+                continue
+            def _assign(v, g=group, k=opt_key):
+                g[k] = v
+            _wrap(f"opt.group{gi}.{opt_key}", opt_val, _assign)
+
+    for pid, pstate in sorted(state_dict["state"].items(),
+                              key=lambda kv: str(kv[0])):
+        for key, value in sorted(pstate.items()):
+            wire_key = f"opt.state.{pid}.{key}"
+            if torch.is_tensor(value):
+                handles.append((None, broadcast_async_(value, root_rank,
+                                                       name=wire_key)))
+            else:
+                def _assign(v, s=pstate, k=key):
+                    s[k] = v
+                if not _wrap(wire_key, value, _assign):
+                    raise ValueError(
+                        f"cannot broadcast optimizer state {wire_key!r} of "
+                        f"type {type(value)}")
+
+    for apply_fn, h in handles:
+        synchronize(h)
+        if apply_fn is not None:
+            apply_fn()
+    optimizer.load_state_dict(state_dict)
+
+
+__all__ = [
+    "init", "shutdown", "is_initialized",
+    "rank", "size", "local_rank", "local_size", "cross_rank", "cross_size",
+    "mpi_threads_supported",
+    "allreduce", "allreduce_", "allreduce_async", "allreduce_async_",
+    "allgather", "allgather_async",
+    "broadcast", "broadcast_", "broadcast_async", "broadcast_async_",
+    "alltoall", "poll", "synchronize",
+    "DistributedOptimizer", "broadcast_parameters",
+    "broadcast_optimizer_state", "Compression",
+]

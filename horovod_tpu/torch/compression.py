@@ -1,0 +1,66 @@
+"""Torch-native gradient wire compression.
+
+Role analog of ``/root/reference/horovod/torch/compression.py:20-75``: a
+``Compressor`` interface with ``compress``/``decompress`` and a
+``Compression`` namespace.  TPU-native addition: ``bf16`` — the format the
+ICI/MXU actually prefers — alongside the reference's ``fp16``.
+"""
+
+from __future__ import annotations
+
+import torch
+
+
+class Compressor:
+    """Interface for compressing tensors on the wire."""
+
+    @staticmethod
+    def compress(tensor: torch.Tensor):
+        """Returns (compressed_tensor, context_for_decompress)."""
+        raise NotImplementedError
+
+    @staticmethod
+    def decompress(tensor: torch.Tensor, ctx):
+        raise NotImplementedError
+
+
+class NoneCompressor(Compressor):
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
+class _CastCompressor(Compressor):
+    wire_dtype: torch.dtype = torch.float16
+
+    @classmethod
+    def compress(cls, tensor):
+        if tensor.dtype.is_floating_point and tensor.dtype != cls.wire_dtype:
+            return tensor.to(cls.wire_dtype), tensor.dtype
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        if ctx is None:
+            return tensor
+        return tensor.to(ctx)
+
+
+class FP16Compressor(_CastCompressor):
+    wire_dtype = torch.float16
+
+
+class BF16Compressor(_CastCompressor):
+    wire_dtype = torch.bfloat16
+
+
+class Compression:
+    """Optional gradient compression algorithm used during allreduce."""
+
+    none = NoneCompressor
+    fp16 = FP16Compressor
+    bf16 = BF16Compressor

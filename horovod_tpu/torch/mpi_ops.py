@@ -1,0 +1,221 @@
+"""Torch collective ops on the eager engine.
+
+API parity with ``/root/reference/horovod/torch/mpi_ops.py:86-438``: every
+collective comes in sync / async / in-place / in-place-async variants, async
+ops return integer handles resolved by ``poll``/``synchronize``, and the sync
+out-of-place variants are differentiable ``torch.autograd.Function``s whose
+backward passes are themselves collectives (allreduce grad = allreduce;
+allgather grad = allreduce + slice own rows; broadcast grad = allreduce,
+zeroed off-root — reference ``mpi_ops.py:110-121,236-254,318-332``).
+
+The data plane is the framework's eager engine (C++ TCP/ring core for
+multi-process, identity for size 1); tensors cross as host numpy buffers —
+the CPU-staged route the reference itself uses when built without GPU
+collectives (``/root/reference/horovod/torch/mpi_ops_v2.cc:78-110``).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+
+import numpy as np
+import torch
+
+from horovod_tpu.runtime import state as _state
+from horovod_tpu.torch.compression import Compression
+
+_NONAME = itertools.count(1)
+
+# handle -> (inplace_target_or_None, average, torch_dtype)
+_handle_map: dict[int, tuple[torch.Tensor | None, bool, torch.dtype]] = {}
+_handle_lock = threading.Lock()
+
+
+def _name(op: str, name: str | None) -> str:
+    if name is None:
+        return f"{op}.noname.{next(_NONAME)}"
+    return f"{op}.{name}"
+
+
+def _to_numpy(tensor: torch.Tensor) -> np.ndarray:
+    """Host numpy view of a torch tensor; bf16 rides the wire as bf16 via a
+    bit-level reinterpretation (numpy has no native bfloat16)."""
+    t = tensor.detach().contiguous().cpu()
+    if t.dtype == torch.bfloat16:
+        import ml_dtypes
+
+        return t.view(torch.uint16).numpy().view(ml_dtypes.bfloat16)
+    return t.numpy()
+
+
+def _from_numpy(arr: np.ndarray, dtype: torch.dtype) -> torch.Tensor:
+    if dtype == torch.bfloat16:
+        arr16 = np.asarray(arr).view(np.uint16)
+        return torch.from_numpy(arr16.copy()).view(torch.bfloat16)
+    return torch.from_numpy(np.ascontiguousarray(arr))
+
+
+def _register(handle: int, target: torch.Tensor | None, average: bool,
+              dtype: torch.dtype) -> int:
+    with _handle_lock:
+        _handle_map[handle] = (target, average, dtype)
+    return handle
+
+
+# ---------------------------------------------------------------------------
+# allreduce
+# ---------------------------------------------------------------------------
+
+def allreduce_async(tensor, average=True, name=None) -> int:
+    handle = _state.engine().allreduce_async(
+        _to_numpy(tensor), _name("allreduce", name))
+    return _register(handle, None, average, tensor.dtype)
+
+
+def allreduce_async_(tensor, average=True, name=None) -> int:
+    """In-place: on synchronize, the reduced values overwrite ``tensor``."""
+    handle = _state.engine().allreduce_async(
+        _to_numpy(tensor), _name("allreduce", name))
+    return _register(handle, tensor, average, tensor.dtype)
+
+
+class _HorovodAllreduce(torch.autograd.Function):
+    @staticmethod
+    def forward(ctx, tensor, average, name):
+        ctx.average = average
+        return synchronize(allreduce_async(tensor, average, name))
+
+    @staticmethod
+    def backward(ctx, grad_output):
+        return synchronize(
+            allreduce_async(grad_output, ctx.average)), None, None
+
+
+def allreduce(tensor, average=True, name=None, compression=Compression.none):
+    """Differentiable out-of-place allreduce with optional wire compression
+    (reference ``mpi_ops.py:124-154``)."""
+    compressed, ctx = compression.compress(tensor)
+    summed = _HorovodAllreduce.apply(compressed, average, name)
+    return compression.decompress(summed, ctx)
+
+
+def allreduce_(tensor, average=True, name=None):
+    return synchronize(allreduce_async_(tensor, average, name))
+
+
+# ---------------------------------------------------------------------------
+# allgather
+# ---------------------------------------------------------------------------
+
+def allgather_async(tensor, name=None) -> int:
+    handle = _state.engine().allgather_async(
+        _to_numpy(tensor), _name("allgather", name))
+    return _register(handle, None, False, tensor.dtype)
+
+
+class _HorovodAllgather(torch.autograd.Function):
+    @staticmethod
+    def forward(ctx, tensor, name):
+        ctx.dim0 = tensor.shape[0] if tensor.dim() else 1
+        return synchronize(allgather_async(tensor, name))
+
+    @staticmethod
+    def backward(ctx, grad_output):
+        # Sum of each rank's grad, then slice out this rank's rows.  Row
+        # offsets come from allgathering the per-rank dim0 (ranks may gather
+        # unequal first dims — reference mpi_ops.py:246-254).
+        import horovod_tpu as hvd
+
+        grad = synchronize(allreduce_async(grad_output, average=False))
+        dim0s = hvd.allgather(np.array([ctx.dim0], np.int64))
+        start = int(dim0s[: hvd.rank()].sum())
+        return grad[start:start + ctx.dim0], None
+
+
+def allgather(tensor, name=None):
+    """Concatenate each rank's tensor along dim 0 (first dims may differ);
+    differentiable."""
+    return _HorovodAllgather.apply(tensor, name)
+
+
+# ---------------------------------------------------------------------------
+# broadcast
+# ---------------------------------------------------------------------------
+
+def broadcast_async(tensor, root_rank, name=None) -> int:
+    handle = _state.engine().broadcast_async(
+        _to_numpy(tensor), root_rank, _name("broadcast", name))
+    return _register(handle, None, False, tensor.dtype)
+
+
+def broadcast_async_(tensor, root_rank, name=None) -> int:
+    handle = _state.engine().broadcast_async(
+        _to_numpy(tensor), root_rank, _name("broadcast", name))
+    return _register(handle, tensor, False, tensor.dtype)
+
+
+class _HorovodBroadcast(torch.autograd.Function):
+    @staticmethod
+    def forward(ctx, tensor, root_rank, name):
+        ctx.root_rank = root_rank
+        return synchronize(broadcast_async(tensor, root_rank, name))
+
+    @staticmethod
+    def backward(ctx, grad_output):
+        import horovod_tpu as hvd
+
+        grad = synchronize(allreduce_async(grad_output, average=False))
+        if hvd.rank() != ctx.root_rank:
+            grad = grad * 0
+        return grad, None, None
+
+
+def broadcast(tensor, root_rank, name=None):
+    return _HorovodBroadcast.apply(tensor, root_rank, name)
+
+
+def broadcast_(tensor, root_rank, name=None):
+    return synchronize(broadcast_async_(tensor, root_rank, name))
+
+
+# ---------------------------------------------------------------------------
+# alltoall (TPU-native addition; absent from the reference)
+# ---------------------------------------------------------------------------
+
+def alltoall(tensor, name=None):
+    arr = _state.engine().alltoall(_to_numpy(tensor), _name("alltoall", name))
+    return _from_numpy(arr, tensor.dtype)
+
+
+# ---------------------------------------------------------------------------
+# completion
+# ---------------------------------------------------------------------------
+
+def poll(handle: int) -> bool:
+    """True when the async op has completed and ``synchronize`` will not
+    block (reference ``mpi_ops.py:406-420``)."""
+    return _state.engine().poll(handle)
+
+
+def synchronize(handle: int) -> torch.Tensor:
+    """Wait for an async op; returns the output tensor (the input itself for
+    in-place variants).  Cross-rank mismatches raise instead of hanging."""
+    with _handle_lock:
+        if handle not in _handle_map:
+            raise ValueError(f"unknown handle {handle}")
+        target, average, dtype = _handle_map.pop(handle)
+    arr = _state.engine().synchronize(handle)
+    out = _from_numpy(arr, dtype)
+    if average:
+        import horovod_tpu as hvd
+
+        if out.dtype.is_floating_point:
+            out = out / hvd.size()
+        else:
+            out = out // hvd.size()
+    if target is not None:
+        with torch.no_grad():
+            target.copy_(out.reshape(target.shape))
+        return target
+    return out

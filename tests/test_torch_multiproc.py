@@ -1,0 +1,44 @@
+"""Multi-process torch frontend tests via the launcher (reference strategy:
+``mpirun -np N python test_torch.py``, SURVEY.md §4)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytest.importorskip("torch")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "torch_worker.py")
+
+
+def _run(scenario: str, np_: int, timeout: float = 180.0):
+    return subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.run", "-np", str(np_),
+         sys.executable, WORKER, scenario],
+        cwd=REPO, env=dict(os.environ), capture_output=True, text=True,
+        timeout=timeout,
+    )
+
+
+@pytest.mark.parametrize("np_", [2, 3])
+def test_torch_ops(np_):
+    res = _run("ops", np_)
+    assert res.returncode == 0, res.stderr + res.stdout
+    for r in range(np_):
+        assert f"rank {r}: torch ops OK" in res.stdout
+
+
+def test_torch_distributed_optimizer():
+    res = _run("optimizer", 2)
+    assert res.returncode == 0, res.stderr + res.stdout
+    for r in range(2):
+        assert f"rank {r}: torch optimizer OK" in res.stdout
+
+
+def test_torch_broadcast_state():
+    res = _run("state", 2)
+    assert res.returncode == 0, res.stderr + res.stdout
+    for r in range(2):
+        assert f"rank {r}: torch state OK" in res.stdout

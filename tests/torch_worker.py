@@ -1,0 +1,146 @@
+"""Rank-parametric torch-frontend worker, launched by
+``tests/test_torch_multiproc.py`` through the launcher (the reference's
+``mpirun -np N python test_torch.py`` strategy, SURVEY.md §4)."""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import torch  # noqa: E402
+
+import horovod_tpu.torch as hvd  # noqa: E402
+
+
+def scenario_ops():
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+
+    # average allreduce
+    x = torch.full((3, 2), float(r + 1))
+    out = hvd.allreduce(x, average=True)
+    assert torch.allclose(out, torch.full((3, 2), (n + 1) / 2)), (r, out)
+
+    # in-place sum
+    y = torch.full((4,), float(r))
+    hvd.allreduce_(y, average=False)
+    assert torch.allclose(y, torch.full((4,), n * (n - 1) / 2)), (r, y)
+
+    # grad of allreduce = allreduce of the grad with the same average flag
+    # (reference mpi_ops.py:110-121): incoming ones, averaged -> ones
+    xg = torch.ones(5, requires_grad=True)
+    hvd.allreduce(xg, average=True).sum().backward()
+    assert torch.allclose(xg.grad, torch.ones(5)), (r, xg.grad)
+
+    # allgather with rank-dependent first dim + grad slicing
+    a = torch.full((r + 1, 2), float(r), requires_grad=True)
+    gat = hvd.allgather(a)
+    assert gat.shape[0] == n * (n + 1) // 2, (r, gat.shape)
+    gat.sum().backward()
+    # every rank contributes grad 1 for own rows, summed over ranks = n...
+    # backward allreduces with average=False then slices own rows -> n
+    assert torch.allclose(a.grad, torch.full((r + 1, 2), float(n))), (r, a.grad)
+
+    # broadcast + off-root grad zeroing
+    b = torch.full((2,), float(r + 1), requires_grad=True)
+    out = hvd.broadcast(b, root_rank=1)
+    assert torch.allclose(out, torch.full((2,), 2.0)), (r, out)
+    out.sum().backward()
+    expect = float(n) if r == 1 else 0.0
+    assert torch.allclose(b.grad, torch.full((2,), expect)), (r, b.grad)
+
+    # bf16 across the wire
+    z = hvd.allreduce(torch.full((4,), 1.5, dtype=torch.bfloat16),
+                      average=False)
+    assert z.dtype == torch.bfloat16 and torch.allclose(
+        z.float(), torch.full((4,), 1.5 * n)), (r, z)
+
+    hvd.shutdown()
+    print(f"rank {r}: torch ops OK", flush=True)
+
+
+def scenario_optimizer():
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    torch.manual_seed(0)  # same init on every rank
+    model = torch.nn.Sequential(
+        torch.nn.Linear(4, 8), torch.nn.Tanh(), torch.nn.Linear(8, 2))
+    ref = torch.nn.Sequential(
+        torch.nn.Linear(4, 8), torch.nn.Tanh(), torch.nn.Linear(8, 2))
+    ref.load_state_dict(model.state_dict())
+
+    opt = hvd.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.05),
+        named_parameters=model.named_parameters())
+    ref_opt = torch.optim.SGD(ref.parameters(), lr=0.05)
+
+    # per-rank batches; the reference model trains on the average gradient
+    torch.manual_seed(100 + r)
+    batches = [torch.randn(6, 4) for _ in range(3)]
+
+    for step, x in enumerate(batches):
+        opt.zero_grad()
+        model(x).pow(2).mean().backward()
+        opt.step()
+
+        # reference: manually average grads across ranks via raw allreduce
+        ref_opt.zero_grad()
+        ref(x).pow(2).mean().backward()
+        for pi, p in enumerate(ref.parameters()):
+            hvd.allreduce_(p.grad, average=True, name=f"ref{step}.{pi}")
+        ref_opt.step()
+
+    for pa, pb in zip(model.parameters(), ref.parameters()):
+        assert torch.allclose(pa, pb, atol=1e-5), (r, (pa - pb).abs().max())
+
+    # all ranks converged to identical parameters
+    for i, p in enumerate(model.parameters()):
+        gat = hvd.allgather(p.detach().reshape(1, -1), name=f"chk{i}")
+        assert torch.allclose(gat, gat[0].expand_as(gat), atol=0), (r, i)
+
+    hvd.shutdown()
+    print(f"rank {r}: torch optimizer OK", flush=True)
+
+
+def scenario_state():
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    torch.manual_seed(r)  # deliberately different init per rank
+    model = torch.nn.Linear(3, 3)
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+
+    # everyone now matches rank 0's init
+    gat = hvd.allgather(model.weight.detach().reshape(1, -1), name="w")
+    assert torch.allclose(gat, gat[0].expand_as(gat)), r
+
+    # optimizer state: rank 0 steps with momentum, others start cold;
+    # broadcast must align both tensors and scalar hyper-options
+    opt = torch.optim.SGD(model.parameters(), lr=0.1 * (r + 1), momentum=0.9)
+    model(torch.randn(2, 3)).sum().backward()
+    opt.step()
+    hvd.broadcast_optimizer_state(opt, root_rank=0)
+    assert abs(opt.param_groups[0]["lr"] - 0.1) < 1e-12, (r, opt.param_groups)
+
+    bufs = [opt.state[p]["momentum_buffer"].reshape(1, -1)
+            for p in model.parameters()]
+    flat = torch.cat(bufs, dim=1)
+    gat = hvd.allgather(flat, name="mom")
+    assert torch.allclose(gat, gat[0].expand_as(gat)), r
+
+    # backward_passes_per_step: allreduce fires every 2nd backward
+    opt2 = hvd.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.01),
+        named_parameters=model.named_parameters(),
+        backward_passes_per_step=2)
+    for _ in range(2):
+        model(torch.randn(2, 3)).sum().backward()
+    opt2.step()  # must not hang: exactly one allreduce per param happened
+
+    hvd.shutdown()
+    print(f"rank {r}: torch state OK", flush=True)
+
+
+if __name__ == "__main__":
+    globals()[f"scenario_{sys.argv[1]}"]()
