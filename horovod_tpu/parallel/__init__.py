@@ -1,0 +1,50 @@
+"""Parallelism strategies over the TPU device mesh.
+
+The reference supports exactly one strategy — synchronous data parallelism
+via allreduce (SURVEY.md §2.3).  This package keeps that as the base case
+and adds the mesh-native axes modern workloads need: ZeRO-3/FSDP parameter
+sharding, tensor parallelism specs, sequence/context parallelism (ring,
+Ulysses, all-gather-KV), pipeline parallelism, and expert parallelism —
+all expressed as shardings + XLA collectives so the compiler schedules and
+overlaps the communication.
+"""
+
+from horovod_tpu.parallel.mesh import (
+    AXIS_ORDER,
+    MeshSpec,
+    auto_spec,
+    hybrid_mesh,
+    make_mesh,
+)
+from horovod_tpu.parallel.sharding import (
+    batch_spec,
+    constrain,
+    fsdp_spec,
+    fsdp_specs,
+    replicated,
+    shard,
+)
+from horovod_tpu.parallel.ring_attention import (
+    allgather_kv_attention,
+    local_flash_attention,
+    make_ring_attn_fn,
+    ring_attention,
+    sequence_parallel_attn_fn,
+    ulysses_attention,
+)
+from horovod_tpu.parallel.pipeline import (
+    pipeline_apply,
+    pipeline_loss,
+    stage_split,
+)
+from horovod_tpu.parallel import moe
+
+__all__ = [
+    "AXIS_ORDER", "MeshSpec", "auto_spec", "hybrid_mesh", "make_mesh",
+    "batch_spec", "constrain", "fsdp_spec", "fsdp_specs", "replicated",
+    "shard",
+    "allgather_kv_attention", "local_flash_attention", "make_ring_attn_fn",
+    "ring_attention", "sequence_parallel_attn_fn", "ulysses_attention",
+    "pipeline_apply", "pipeline_loss", "stage_split",
+    "moe",
+]

@@ -1,0 +1,144 @@
+"""Device-mesh construction for every parallelism axis.
+
+The reference derives process topology from MPI communicators
+(``/root/reference/horovod/common/operations.cc:1760-1797``: WORLD split into
+local/cross by shared-memory locality).  On TPU the analogous facts come from
+the device list itself: a ``jax.sharding.Mesh`` over the pod slice, with named
+axes for each parallelism dimension, and the ICI/DCN hierarchy expressed by
+putting intra-slice axes innermost (contiguous devices share ICI) — the mesh
+is the communicator.
+
+Axis vocabulary (canonical order, outermost/slowest first):
+
+* ``pp``   — pipeline stages (cheapest traffic: one activation per tick)
+* ``dp``   — pure data parallelism (gradient psum)
+* ``fsdp`` — data parallel with ZeRO-3 parameter sharding (all-gather heavy)
+* ``sp``   — sequence/context parallelism (ring attention traffic)
+* ``tp``   — tensor parallelism (activation allreduce every layer: keep on
+  fastest ICI, so innermost)
+* ``ep``   — expert parallelism (alltoall; conventionally aliased onto the
+  fsdp/sp axis group rather than a separate one)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Mapping, Sequence
+
+import numpy as np
+
+AXIS_ORDER = ("pp", "dp", "fsdp", "sp", "tp")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Sizes for each named axis; 1 (or absent) means the axis is unused.
+
+    ``build()`` materializes a ``jax.sharding.Mesh`` whose axis order follows
+    :data:`AXIS_ORDER` so that tensor parallelism lands on neighbouring
+    devices (fastest ICI links) and pipeline stages on the farthest.
+    """
+
+    pp: int = 1
+    dp: int = 1
+    fsdp: int = 1
+    sp: int = 1
+    tp: int = 1
+
+    @property
+    def size(self) -> int:
+        return self.pp * self.dp * self.fsdp * self.sp * self.tp
+
+    def axis_sizes(self) -> dict[str, int]:
+        return {a: getattr(self, a) for a in AXIS_ORDER}
+
+    def build(self, devices: Sequence | None = None):
+        import jax
+        from jax.sharding import Mesh
+
+        if devices is None:
+            devices = jax.devices()
+        if len(devices) < self.size:
+            raise ValueError(
+                f"mesh spec needs {self.size} devices "
+                f"({self.axis_sizes()}), only {len(devices)} available"
+            )
+        shape = tuple(self.axis_sizes().values())
+        arr = np.array(devices[: self.size]).reshape(shape)
+        return Mesh(arr, AXIS_ORDER)
+
+
+def auto_spec(n_devices: int, *, pp: int = 1, sp: int = 1, tp: int = 1,
+              prefer_fsdp: bool = True) -> MeshSpec:
+    """Factor ``n_devices`` into a :class:`MeshSpec`, fixing any axes given
+    and assigning the remainder to fsdp (ZeRO-3 default) or dp."""
+    fixed = pp * sp * tp
+    if n_devices % fixed != 0:
+        raise ValueError(f"{n_devices} devices not divisible by pp*sp*tp={fixed}")
+    rest = n_devices // fixed
+    if prefer_fsdp:
+        return MeshSpec(pp=pp, dp=1, fsdp=rest, sp=sp, tp=tp)
+    return MeshSpec(pp=pp, dp=rest, fsdp=1, sp=sp, tp=tp)
+
+
+def make_mesh(axes: Mapping[str, int] | MeshSpec | None = None,
+              devices: Sequence | None = None):
+    """Build a mesh from a spec, a ``{name: size}`` mapping (any names, in
+    the given order), or — with no arguments — a single ``hvd`` axis over all
+    devices (the reference's flat WORLD communicator)."""
+    import jax
+    from jax.sharding import Mesh
+
+    if isinstance(axes, MeshSpec):
+        return axes.build(devices)
+    if devices is None:
+        devices = jax.devices()
+    if axes is None:
+        axes = {"hvd": len(devices)}
+    from horovod_tpu.utils.topo import make_mesh as _topo_make_mesh
+
+    return _topo_make_mesh(axes, devices)
+
+
+def hybrid_mesh(ici_axes: Mapping[str, int], dcn_axes: Mapping[str, int],
+                devices: Sequence | None = None):
+    """Two-level mesh: ``dcn_axes`` span slices (slow DCN links), ``ici_axes``
+    stay within a slice (fast ICI) — the TPU analog of the reference's
+    hierarchical allreduce split into local/cross communicators
+    (``/root/reference/horovod/common/operations.cc:1284-1446``).
+
+    Uses device ``slice_index`` when the platform exposes it; falls back to a
+    contiguous reshape (valid for the virtual CPU mesh used in tests).
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    names = tuple(dcn_axes.keys()) + tuple(ici_axes.keys())
+    sizes = tuple(dcn_axes.values()) + tuple(ici_axes.values())
+    need = math.prod(sizes)
+    if len(devices) < need:
+        raise ValueError(f"need {need} devices, have {len(devices)}")
+    devices = list(devices)[:need]
+    slice_ids = {getattr(d, "slice_index", 0) or 0 for d in devices}
+    if len(slice_ids) > 1:
+        # Real multi-slice hardware: build the topology-aware mesh.  Both
+        # shape arguments to create_hybrid_device_mesh must have one entry
+        # per mesh axis (elementwise product = final shape), so pad each
+        # side with 1s in the (dcn..., ici...) axis order.  Any failure is
+        # a hard error — a contiguous-reshape fallback would silently route
+        # "ICI" collectives over DCN.
+        from jax.experimental import mesh_utils
+
+        ici_shape = (1,) * len(dcn_axes) + tuple(ici_axes.values())
+        dcn_shape = tuple(dcn_axes.values()) + (1,) * len(ici_axes)
+        arr = mesh_utils.create_hybrid_device_mesh(
+            ici_shape, dcn_shape, devices=devices,
+            allow_split_physical_axes=True,
+        )
+        return Mesh(arr, names)
+    # single slice (or the virtual CPU mesh in tests): contiguous reshape is
+    # exact — every link is the same class
+    return Mesh(np.array(devices).reshape(sizes), names)

@@ -1,0 +1,126 @@
+"""Expert parallelism: a Mixture-of-Experts FFN layer with top-k gating and
+all-to-all token dispatch over a named mesh axis.
+
+New capability relative to the reference (SURVEY.md §2.3: EP absent).  The
+TPU-shaped design: gating and capacity bucketing are dense einsums over a
+``[tokens, experts, capacity]`` dispatch tensor (MXU-friendly one-hot
+contractions, no scatter/gather with dynamic shapes), and the only
+communication is two ``lax.all_to_all``s along the expert axis — the
+canonical ICI traffic pattern for MoE.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@dataclasses.dataclass(frozen=True)
+class MoeConfig:
+    d_model: int
+    d_ff: int
+    n_experts: int
+    top_k: int = 2
+    capacity_factor: float = 1.25
+
+
+def init(rng, config: MoeConfig):
+    c = config
+    kg, ki, ko = jax.random.split(rng, 3)
+
+    def norm(key, shape, fan_in):
+        return jax.random.normal(key, shape, jnp.float32) / jnp.sqrt(fan_in)
+
+    return {
+        "gate": norm(kg, (c.d_model, c.n_experts), c.d_model),
+        "w_in": norm(ki, (c.n_experts, c.d_model, c.d_ff), c.d_model),
+        "w_out": norm(ko, (c.n_experts, c.d_ff, c.d_model), c.d_ff),
+    }
+
+
+def param_specs(ep: str | None = "ep"):
+    """Experts shard over the ``ep`` axis; the gate replicates."""
+    from jax.sharding import PartitionSpec as P
+
+    return {"gate": P(), "w_in": P(ep, None, None), "w_out": P(ep, None, None)}
+
+
+def _top_k_dispatch(probs, k, capacity):
+    """probs: [G, E] -> (dispatch [G, E, C] 0/1, combine [G, E, C] weights,
+    aux load-balancing loss)."""
+    G, E = probs.shape
+    _, idx = lax.top_k(probs, k)                       # [G, k]
+    counts = jnp.zeros((E,), jnp.float32)
+    dispatch = jnp.zeros((G, E, capacity), jnp.float32)
+    slots, gates = [], []
+    for j in range(k):
+        onehot = jax.nn.one_hot(idx[:, j], E, dtype=jnp.float32)   # [G, E]
+        pos = jnp.cumsum(onehot, axis=0) - 1.0 + counts[None, :]   # [G, E]
+        pos_j = jnp.sum(pos * onehot, axis=-1)                     # [G]
+        keep = (pos_j < capacity).astype(jnp.float32)
+        slot = jax.nn.one_hot(pos_j.astype(jnp.int32), capacity,
+                              dtype=jnp.float32)                   # [G, C]
+        d = onehot[:, :, None] * slot[:, None, :] * keep[:, None, None]
+        dispatch = dispatch + d
+        slots.append(d)
+        gates.append(jnp.sum(probs * onehot, axis=-1))             # [G]
+        counts = counts + jnp.sum(onehot, axis=0)
+    # combine weights: top-1 keeps the raw router prob (Switch — keeps the
+    # gate differentiable); top-k>1 normalizes over the selected experts
+    gsum = jnp.maximum(functools.reduce(jnp.add, gates), 1e-9)
+    combine = jnp.zeros((G, E, capacity), jnp.float32)
+    for d, g in zip(slots, gates):
+        w = g if k == 1 else g / gsum
+        combine = combine + d * w[:, None, None]
+    # Switch-style load-balancing auxiliary: E * mean(prob) . mean(assigned)
+    frac_tokens = jnp.mean(dispatch.sum(axis=2), axis=0)           # [E]
+    frac_probs = jnp.mean(probs, axis=0)                           # [E]
+    aux = E * jnp.sum(frac_tokens * frac_probs)
+    return dispatch, combine, aux
+
+
+def moe_layer(params, x, config: MoeConfig, axis_name: str | None = None):
+    """Apply the MoE FFN.  ``x``: [..., D] (leading dims are token dims).
+
+    With ``axis_name`` set (inside shard_map), ``params['w_in'/'w_out']``
+    must be the **local** expert shard ``[E/n, ...]`` and tokens are the
+    local batch shard; two all-to-alls route tokens to expert owners and
+    back.  Returns ``(y, aux_loss)``.
+    """
+    c = config
+    shape = x.shape
+    D = shape[-1]
+    xf = x.reshape(-1, D)                                # [G, D]
+    G = xf.shape[0]
+    probs = jax.nn.softmax(
+        (xf.astype(jnp.float32)) @ params["gate"].astype(jnp.float32), axis=-1
+    )
+    capacity = max(1, int(c.top_k * G * c.capacity_factor / c.n_experts))
+    dispatch, combine, aux = _top_k_dispatch(probs, c.top_k, capacity)
+    dispatch = dispatch.astype(x.dtype)
+
+    expert_in = jnp.einsum("gec,gd->ecd", dispatch, xf)  # [E, C, D]
+    if axis_name is not None:
+        n = lax.axis_size(axis_name)
+        # route: each device sends its per-expert buckets to the expert's
+        # owner; received buckets stack along capacity -> [E/n, n*C, D]
+        expert_in = lax.all_to_all(expert_in, axis_name, split_axis=0,
+                                   concat_axis=1, tiled=True)
+        aux = lax.pmean(aux, axis_name)
+
+    h = jnp.einsum("ecd,edf->ecf", expert_in,
+                   params["w_in"].astype(x.dtype))
+    h = jax.nn.gelu(h)
+    expert_out = jnp.einsum("ecf,efd->ecd", h,
+                            params["w_out"].astype(x.dtype))
+
+    if axis_name is not None:
+        expert_out = lax.all_to_all(expert_out, axis_name, split_axis=1,
+                                    concat_axis=0, tiled=True)
+    y = jnp.einsum("gec,ecd->gd", combine.astype(x.dtype), expert_out)
+    return y.reshape(shape), aux

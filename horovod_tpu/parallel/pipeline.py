@@ -1,0 +1,92 @@
+"""Pipeline parallelism (GPipe-style) over a named mesh axis.
+
+New capability relative to the reference (SURVEY.md §2.3: PP absent).  Runs
+inside ``shard_map``: each device along ``axis_name`` owns one stage's
+parameters; activations hop stage-to-stage via ``ppermute`` while
+microbatches stream through, so at steady state all stages compute
+concurrently.  The whole schedule is a single ``lax.scan`` — XLA sees a
+static loop of (compute, neighbor-permute) and overlaps the ICI transfer
+with the next tick's compute.
+
+Differentiable end-to-end: ``ppermute``'s transpose reverses the ring, so
+``jax.grad`` of a pipelined loss yields the backward pipeline automatically
+(the 1F1B memory optimisation is left to rematerialisation via ``remat``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def pipeline_apply(stage_fn, stage_params, microbatches, axis_name: str,
+                   remat: bool = True):
+    """Run ``microbatches`` through a pipeline of ``axis_size`` stages.
+
+    Args:
+      stage_fn: ``(stage_params, x) -> y`` — one stage's computation; the
+        activation shape must be the same on every stage (standard GPipe
+        constraint).
+      stage_params: this device's stage parameters (sharded over
+        ``axis_name`` outside, e.g. layer-stack dim split across stages).
+      microbatches: ``[M, ...]`` — the *full* input on every device (only
+        stage 0 reads it; pass zeros elsewhere if the input itself is
+        sharded).
+      axis_name: mesh axis of size = number of stages.
+
+    Returns:
+      ``[M, ...]`` stage-(n−1) outputs, valid on the **last** stage (other
+      stages return zeros — combine with ``where(stage == n-1, ...)``).
+    """
+    n = lax.axis_size(axis_name)
+    stage = lax.axis_index(axis_name)
+    M = microbatches.shape[0]
+    total = M + n - 1
+    x0 = jnp.zeros_like(microbatches[0])
+    fwd = [(i, i + 1) for i in range(n - 1)]   # no wraparound: stage 0 injects
+
+    def tick(carry, t):
+        buf = carry                                   # activation entering
+        inject = microbatches[jnp.minimum(t, M - 1)]
+        x = jnp.where(stage == 0, inject, buf)
+        y = stage_fn(stage_params, x)
+        buf_next = lax.ppermute(y, axis_name, fwd)
+        # capture last stage's output for ticks >= n-1
+        out = jnp.where(stage == n - 1, y, jnp.zeros_like(y))
+        return buf_next, out
+
+    if remat:
+        tick = jax.checkpoint(tick)
+    _, outs = lax.scan(tick, x0, jnp.arange(total))
+    return outs[n - 1:]                               # [M, ...]
+
+
+def pipeline_loss(stage_fn, loss_fn, stage_params, microbatches, targets,
+                  axis_name: str, remat: bool = True):
+    """Pipelined forward + mean loss, replicated to all stages via psum so
+    every rank's gradient graph agrees.  ``loss_fn(y, target) -> scalar``."""
+    n = lax.axis_size(axis_name)
+    stage = lax.axis_index(axis_name)
+    outs = pipeline_apply(stage_fn, stage_params, microbatches, axis_name,
+                          remat=remat)
+    per_mb = jax.vmap(loss_fn)(outs, targets)         # [M]
+    # select, don't multiply: loss_fn may be non-finite on the zero
+    # placeholder outputs of earlier stages, and inf * 0 = NaN would
+    # poison the psum
+    local = jnp.where(stage == n - 1, jnp.mean(per_mb), 0.0)
+    return lax.psum(local, axis_name)
+
+
+def stage_split(stacked_params, axis_name: str):
+    """Slice a layer-stacked params pytree ``[L, ...]`` down to this stage's
+    ``[L/n, ...]`` block (use when params arrive replicated; under GSPMD
+    prefer sharding the stack dim with ``P(axis_name, ...)`` instead)."""
+    n = lax.axis_size(axis_name)
+    stage = lax.axis_index(axis_name)
+
+    def slc(p):
+        per = p.shape[0] // n
+        return lax.dynamic_slice_in_dim(p, stage * per, per, axis=0)
+
+    return jax.tree.map(slc, stacked_params)

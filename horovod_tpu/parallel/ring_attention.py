@@ -1,0 +1,257 @@
+"""Sequence/context parallelism: ring attention, Ulysses (all-to-all), and
+all-gather-KV attention over a named mesh axis.
+
+New capability relative to the reference (SURVEY.md §5: long-context support
+is absent there — the only sequence-dim primitive is allgather-on-dim-0,
+``/root/reference/horovod/tensorflow/mpi_ops.cc:369-391``).  Built directly
+on XLA collectives so the blockwise compute and the ``ppermute`` transfers
+pipeline over the ICI ring.
+
+All functions run **inside** ``shard_map``/``pmap`` with ``axis_name`` bound,
+on locally-sharded blocks:
+
+* ``q``:    ``[B, Tq_local, Hq, Dh]``
+* ``k,v``:  ``[B, Tkv_local, Hkv, Dh]`` (GQA: ``Hq % Hkv == 0``)
+* positions are **global** token indices of the local block — the causal
+  mask is computed from positions, so correctness is independent of how the
+  sequence was split across devices.
+
+The online-softmax accumulation is the standard flash/ring formulation
+(running max ``m``, normalizer ``l``, unnormalized output ``o``), using a
+finite mask floor (−1e30) so fully-masked blocks underflow to zero instead
+of producing NaNs.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_MASK = -1.0e30
+
+
+def _varying(x, axis_name):
+    """Mark a constant as device-varying over ``axis_name`` so shard_map's
+    VMA check accepts it as a scan carry alongside varying operands."""
+    try:
+        return lax.pcast(x, (axis_name,), to="varying")
+    except (AttributeError, TypeError):  # older jax
+        return lax.pvary(x, (axis_name,))
+
+
+def _block_scores(q, k, q_pos, k_pos, scale, causal):
+    """q: [B,T,Hkv,G,Dh], k: [B,S,Hkv,Dh] -> fp32 scores [B,Hkv,G,T,S]."""
+    s = jnp.einsum("bthgd,bshd->bhgts", q, k).astype(jnp.float32) * scale
+    if causal:
+        mask = k_pos[None, :] <= q_pos[:, None]           # [T, S]
+        s = jnp.where(mask[None, None, None], s, _MASK)
+    return s
+
+
+def _online_update(carry, s, v):
+    """One blockwise online-softmax accumulation step."""
+    o, m, l = carry                                      # o:[B,h,g,T,Dh] f32
+    m_new = jnp.maximum(m, s.max(axis=-1))               # [B,h,g,T]
+    # explicitly zero masked entries: when an entire row is masked the
+    # running max equals the mask floor and exp(s - m) would be exp(0)=1,
+    # not 0 — the guard keeps fully-masked rows at l=0 (output 0)
+    p = jnp.exp(s - m_new[..., None]) * (s > 0.5 * _MASK)  # [B,h,g,T,S]
+    corr = jnp.exp(m - m_new)                            # [B,h,g,T]
+    l = l * corr + p.sum(axis=-1)
+    pv = jnp.einsum("bhgts,bshd->bhgtd", p, v.astype(jnp.float32))
+    o = o * corr[..., None] + pv
+    return o, m_new, l
+
+
+def _finalize(o, l, B, T, Hq, Dh, dtype):
+    out = o / jnp.maximum(l, 1e-30)[..., None]           # [B,h,g,T,Dh]
+    out = jnp.moveaxis(out, 3, 1)                        # [B,T,h,g,Dh]
+    return out.reshape(B, T, Hq, Dh).astype(dtype)
+
+
+def _gqa_split(q, n_kv):
+    B, T, Hq, Dh = q.shape
+    return q.reshape(B, T, n_kv, Hq // n_kv, Dh)
+
+
+def local_flash_attention(q, k, v, q_positions=None, kv_positions=None,
+                          causal=True, block_size=None):
+    """Single-device blockwise attention (the ring's degenerate case).
+
+    ``block_size`` chunks the KV sequence through the same online-softmax
+    accumulator under ``lax.scan`` — O(T·block) memory instead of O(T²).
+    """
+    B, T, Hq, Dh = q.shape
+    S, Hkv = k.shape[1], k.shape[2]
+    if q_positions is None:
+        q_positions = jnp.arange(T, dtype=jnp.int32)
+    if kv_positions is None:
+        kv_positions = jnp.arange(S, dtype=jnp.int32)
+    scale = 1.0 / jnp.sqrt(Dh).astype(jnp.float32)
+    qh = _gqa_split(q, Hkv)
+    G = Hq // Hkv
+
+    if not block_size or block_size >= S:
+        s = _block_scores(qh, k, q_positions, kv_positions, scale, causal)
+        o = jnp.zeros((B, Hkv, G, T, Dh), jnp.float32)
+        m = jnp.full((B, Hkv, G, T), _MASK, jnp.float32)
+        l = jnp.zeros((B, Hkv, G, T), jnp.float32)
+        o, m, l = _online_update((o, m, l), s, v)
+        return _finalize(o, l, B, T, Hq, Dh, q.dtype)
+
+    if S % block_size != 0:
+        raise ValueError(f"kv length {S} not divisible by block {block_size}")
+    nb = S // block_size
+    kb = k.reshape(B, nb, block_size, Hkv, Dh)
+    vb = v.reshape(B, nb, block_size, Hkv, Dh)
+    pb = kv_positions.reshape(nb, block_size)
+
+    def body(carry, blk):
+        kcur, vcur, pcur = blk
+        s = _block_scores(qh, kcur, q_positions, pcur, scale, causal)
+        return _online_update(carry, s, vcur), None
+
+    init = (jnp.zeros((B, Hkv, G, T, Dh), jnp.float32),
+            jnp.full((B, Hkv, G, T), _MASK, jnp.float32),
+            jnp.zeros((B, Hkv, G, T), jnp.float32))
+    (o, m, l), _ = lax.scan(
+        body, init,
+        (jnp.moveaxis(kb, 1, 0), jnp.moveaxis(vb, 1, 0), pb))
+    return _finalize(o, l, B, T, Hq, Dh, q.dtype)
+
+
+def ring_attention(q, k, v, axis_name: str, q_positions, kv_positions=None,
+                   causal: bool = True, remat: bool = True):
+    """Ring attention: each device keeps its Q block resident and the K/V
+    blocks rotate around the ``axis_name`` ring via ``ppermute``, one hop per
+    step, accumulating online softmax — attention over the full (sharded)
+    sequence in ``axis_size`` steps with O(T_local²) peak memory.
+
+    Differentiable end-to-end (``ppermute``'s transpose is the reverse
+    permutation, so autodiff yields the backward ring for free); ``remat``
+    checkpoints each ring step.
+    """
+    n = lax.axis_size(axis_name)
+    B, T, Hq, Dh = q.shape
+    Hkv = k.shape[2]
+    G = Hq // Hkv
+    if kv_positions is None:
+        kv_positions = q_positions
+    scale = 1.0 / jnp.sqrt(Dh).astype(jnp.float32)
+    qh = _gqa_split(q, Hkv)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, _):
+        acc, kcur, vcur, pcur = carry
+        s = _block_scores(qh, kcur, q_positions, pcur, scale, causal)
+        acc = _online_update(acc, s, vcur)
+        kcur = lax.ppermute(kcur, axis_name, perm)
+        vcur = lax.ppermute(vcur, axis_name, perm)
+        pcur = lax.ppermute(pcur, axis_name, perm)
+        return (acc, kcur, vcur, pcur), None
+
+    if remat:
+        step = jax.checkpoint(step)
+
+    acc = tuple(
+        _varying(a, axis_name)
+        for a in (jnp.zeros((B, Hkv, G, T, Dh), jnp.float32),
+                  jnp.full((B, Hkv, G, T), _MASK, jnp.float32),
+                  jnp.zeros((B, Hkv, G, T), jnp.float32))
+    )
+    (acc, _, _, _), _ = lax.scan(step, (acc, k, v, kv_positions), None,
+                                 length=n)
+    o, m, l = acc
+    return _finalize(o, l, B, T, Hq, Dh, q.dtype)
+
+
+def ulysses_attention(q, k, v, axis_name: str, q_positions,
+                      causal: bool = True):
+    """DeepSpeed-Ulysses-style sequence parallelism: two ``all_to_all``s swap
+    the sharded dim from sequence to heads, attention runs dense locally over
+    the full sequence for ``H/n`` heads, then swaps back.
+
+    Requires ``Hkv % axis_size == 0``.  Cheaper than ring for moderate T
+    (2 alltoalls vs n−1 permutes) but caps the axis at the KV-head count.
+    """
+    n = lax.axis_size(axis_name)
+    B, T, Hq, Dh = q.shape
+    Hkv = k.shape[2]
+    if Hq % n or Hkv % n:
+        raise ValueError(f"ulysses needs heads divisible by axis size "
+                         f"(Hq={Hq}, Hkv={Hkv}, n={n})")
+    # [B, T/n, H, Dh] -> [B, T, H/n, Dh]
+    swap = functools.partial(lax.all_to_all, axis_name=axis_name,
+                             split_axis=2, concat_axis=1, tiled=True)
+    qf, kf, vf = swap(q), swap(k), swap(v)
+    pos = lax.all_gather(q_positions, axis_name, tiled=True)
+    out = local_flash_attention(qf, kf, vf, pos, pos, causal=causal)
+    # [B, T, Hq/n, Dh] -> [B, T/n, Hq, Dh]
+    return lax.all_to_all(out, axis_name=axis_name, split_axis=1,
+                          concat_axis=2, tiled=True)
+
+
+def allgather_kv_attention(q, k, v, axis_name: str, q_positions,
+                           kv_positions=None, causal: bool = True,
+                           block_size=None):
+    """Simplest SP scheme: all-gather K/V over the axis, attend locally.
+    O(T_global) memory for K/V — fine for short contexts, the baseline the
+    ring beats at long ones."""
+    if kv_positions is None:
+        kv_positions = q_positions
+    kg = lax.all_gather(k, axis_name, axis=1, tiled=True)
+    vg = lax.all_gather(v, axis_name, axis=1, tiled=True)
+    pg = lax.all_gather(kv_positions, axis_name, tiled=True)
+    return local_flash_attention(q, kg, vg, q_positions, pg, causal=causal,
+                                 block_size=block_size)
+
+
+def make_ring_attn_fn(axis_name: str, mode: str = "ring"):
+    """Adapter producing the ``attn_fn(q, k, v, positions)`` signature used
+    by :func:`horovod_tpu.models.llama.apply`."""
+    impl = {"ring": ring_attention,
+            "ulysses": ulysses_attention,
+            "allgather": allgather_kv_attention}[mode]
+
+    def attn_fn(q, k, v, positions):
+        out = impl(q, k, v, axis_name, positions)
+        B, T, Hq, Dh = out.shape
+        return out.reshape(B, T, Hq * Dh)
+
+    return attn_fn
+
+
+def sequence_parallel_attn_fn(mesh, axis_name: str = "sp",
+                              mode: str = "ring", batch_axes=("dp", "fsdp")):
+    """Attention callback for ``llama.apply`` that runs **inside a normal
+    GSPMD ``jit``**: only ``axis_name`` goes manual (shard_map with
+    ``axis_names={axis_name}``); every other mesh axis (fsdp/tp/dp) stays
+    automatic, so XLA keeps inserting the FSDP all-gathers and TP psums
+    around the manual ring.
+
+    This is the mixed auto/manual composition that lets one train step carry
+    dp x fsdp x tp x sp simultaneously.
+    """
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    del batch_axes  # batch/model axes stay automatic; only specs over the
+    # manual axis are allowed (and needed) in a partial-manual shard_map
+    inner = make_ring_attn_fn(axis_name, mode)
+
+    def attn_fn(q, k, v, positions):
+        f = jax.shard_map(
+            lambda q, k, v, p: inner(q, k, v, p),
+            mesh=mesh,
+            in_specs=(P(None, axis_name), P(None, axis_name),
+                      P(None, axis_name), P(axis_name)),
+            out_specs=P(None, axis_name),
+            axis_names=frozenset({axis_name}),
+            check_vma=False,
+        )
+        return f(q, k, v, positions)
+
+    return attn_fn

@@ -61,10 +61,26 @@ def _load_lib():
             os.path.getmtime(src) > os.path.getmtime(so) for src in sources
         )
         if stale:
-            # (re)build on demand; the toolchain is a framework requirement
-            subprocess.run(
-                ["make", "-C", _csrc_dir()], check=True, capture_output=True
-            )
+            # (re)build on demand; the toolchain is a framework requirement.
+            # flock serializes concurrently-launched worker processes (all
+            # ranks hit this path after a source edit) so only one make runs
+            # at a time and nobody dlopens a half-linked .so.
+            import fcntl
+
+            with open(os.path.join(_csrc_dir(), ".build.lock"), "w") as lk:
+                fcntl.flock(lk, fcntl.LOCK_EX)
+                try:
+                    still_stale = not os.path.exists(so) or any(
+                        os.path.getmtime(src) > os.path.getmtime(so)
+                        for src in sources
+                    )
+                    if still_stale:
+                        subprocess.run(
+                            ["make", "-C", _csrc_dir()], check=True,
+                            capture_output=True,
+                        )
+                finally:
+                    fcntl.flock(lk, fcntl.LOCK_UN)
         lib = ctypes.CDLL(so)
         lib.hvd_native_init.argtypes = [ctypes.c_char_p, ctypes.c_int,
                                         ctypes.c_int, ctypes.c_int]

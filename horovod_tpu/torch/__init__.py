@@ -235,7 +235,26 @@ def broadcast_optimizer_state(optimizer, root_rank=0):
                 continue
             def _assign(v, g=group, k=opt_key):
                 g[k] = v
-            _wrap(f"opt.group{gi}.{opt_key}", opt_val, _assign)
+            wire_key = f"opt.group{gi}.{opt_key}"
+            if _wrap(wire_key, opt_val, _assign):
+                continue
+            if opt_val is None:
+                continue  # structural; nothing to put on the wire
+            if (isinstance(opt_val, (tuple, list))
+                    and all(isinstance(v, (bool, int, float))
+                            for v in opt_val)):
+                # e.g. Adam betas: broadcast element-wise, keep the type
+                for vi, v in enumerate(opt_val):
+                    def _assign_elem(new, g=group, k=opt_key, i=vi,
+                                     cls=type(opt_val)):
+                        seq = list(g[k])
+                        seq[i] = new
+                        g[k] = seq if cls is list else cls(seq)
+                    _wrap(f"{wire_key}.{vi}", v, _assign_elem)
+                continue
+            raise ValueError(
+                f"cannot broadcast optimizer option {wire_key!r} of "
+                f"type {type(opt_val)}")
 
     for pid, pstate in sorted(state_dict["state"].items(),
                               key=lambda kv: str(kv[0])):

@@ -16,26 +16,26 @@ collectives (``/root/reference/horovod/torch/mpi_ops_v2.cc:78-110``).
 
 from __future__ import annotations
 
-import itertools
 import threading
 
 import numpy as np
 import torch
 
+from horovod_tpu import _auto_name as _name  # shared "<op>.noname.<n>" scheme
 from horovod_tpu.runtime import state as _state
 from horovod_tpu.torch.compression import Compression
 
-_NONAME = itertools.count(1)
-
-# handle -> (inplace_target_or_None, average, torch_dtype)
-_handle_map: dict[int, tuple[torch.Tensor | None, bool, torch.dtype]] = {}
 _handle_lock = threading.Lock()
 
 
-def _name(op: str, name: str | None) -> str:
-    if name is None:
-        return f"{op}.noname.{next(_NONAME)}"
-    return f"{op}.{name}"
+def _handle_map(engine) -> dict:
+    """handle -> (inplace_target_or_None, average, torch_dtype), scoped to
+    the engine instance so ids cannot alias across shutdown()/init() cycles
+    (same hazard the engine's own average_handles set guards against)."""
+    m = getattr(engine, "_torch_handle_map", None)
+    if m is None:
+        m = engine._torch_handle_map = {}
+    return m
 
 
 def _to_numpy(tensor: torch.Tensor) -> np.ndarray:
@@ -59,7 +59,7 @@ def _from_numpy(arr: np.ndarray, dtype: torch.dtype) -> torch.Tensor:
 def _register(handle: int, target: torch.Tensor | None, average: bool,
               dtype: torch.dtype) -> int:
     with _handle_lock:
-        _handle_map[handle] = (target, average, dtype)
+        _handle_map(_state.engine())[handle] = (target, average, dtype)
     return handle
 
 
@@ -201,11 +201,13 @@ def poll(handle: int) -> bool:
 def synchronize(handle: int) -> torch.Tensor:
     """Wait for an async op; returns the output tensor (the input itself for
     in-place variants).  Cross-rank mismatches raise instead of hanging."""
+    engine = _state.engine()
     with _handle_lock:
-        if handle not in _handle_map:
+        hmap = _handle_map(engine)
+        if handle not in hmap:
             raise ValueError(f"unknown handle {handle}")
-        target, average, dtype = _handle_map.pop(handle)
-    arr = _state.engine().synchronize(handle)
+        target, average, dtype = hmap.pop(handle)
+    arr = engine.synchronize(handle)
     out = _from_numpy(arr, dtype)
     if average:
         import horovod_tpu as hvd

@@ -1,0 +1,342 @@
+"""Tests for horovod_tpu.parallel on the virtual 8-device CPU mesh.
+
+Test double per SURVEY.md §4: the reference proves multi-node semantics with
+multi-process MPI on one host; here the equivalent is shard_map over 8
+virtual CPU devices — every collective really executes.
+"""
+
+import functools
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from horovod_tpu import parallel
+from horovod_tpu.parallel import moe as moe_lib
+
+
+# ---------------------------------------------------------------------------
+# mesh / sharding helpers
+# ---------------------------------------------------------------------------
+
+def test_mesh_spec_build(cpu8):
+    spec = parallel.MeshSpec(pp=2, dp=1, fsdp=2, sp=1, tp=2)
+    assert spec.size == 8
+    mesh = spec.build(cpu8)
+    assert mesh.axis_names == ("pp", "dp", "fsdp", "sp", "tp")
+    assert dict(mesh.shape) == {"pp": 2, "dp": 1, "fsdp": 2, "sp": 1, "tp": 2}
+
+
+def test_auto_spec():
+    s = parallel.auto_spec(8, tp=2)
+    assert s.tp == 2 and s.fsdp == 4 and s.size == 8
+    with pytest.raises(ValueError):
+        parallel.auto_spec(8, tp=3)
+
+
+def test_hybrid_mesh(cpu8):
+    mesh = parallel.hybrid_mesh({"tp": 4}, {"dp": 2}, cpu8)
+    assert mesh.axis_names == ("dp", "tp")
+    assert dict(mesh.shape) == {"dp": 2, "tp": 4}
+
+
+def test_fsdp_specs(cpu8):
+    mesh = parallel.make_mesh({"fsdp": 8}, cpu8)
+    params = {"big": jnp.zeros((128, 64)), "tiny": jnp.zeros((4,)),
+              "odd": jnp.zeros((7, 2048))}
+    specs = parallel.fsdp_specs(params, "fsdp", mesh)
+    assert specs["big"] == P("fsdp", None)
+    assert specs["tiny"] == P()          # below min size -> replicated
+    assert specs["odd"] == P(None, "fsdp")  # 7 not divisible, 2048 is
+    sharded = parallel.shard(params, specs, mesh)
+    assert sharded["big"].sharding.spec == P("fsdp", None)
+
+
+def test_batch_spec(cpu8):
+    mesh = parallel.make_mesh({"dp": 2, "fsdp": 2, "tp": 2}, cpu8)
+    assert parallel.batch_spec(mesh, "dp", "fsdp") == P(("dp", "fsdp"))
+    assert parallel.batch_spec(mesh, "missing") == P(None)
+
+
+# ---------------------------------------------------------------------------
+# sequence parallelism: ring / ulysses / allgather vs dense reference
+# ---------------------------------------------------------------------------
+
+def _dense_reference(q, k, v, positions):
+    """Straightforward causal GQA attention in fp32."""
+    B, T, Hq, Dh = q.shape
+    Hkv = k.shape[2]
+    G = Hq // Hkv
+    qh = q.reshape(B, T, Hkv, G, Dh)
+    s = jnp.einsum("bthgd,bshd->bhgts", qh, k).astype(jnp.float32)
+    s = s / np.sqrt(Dh)
+    mask = positions[None, :] <= positions[:, None]
+    s = jnp.where(mask[None, None, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhgts,bshd->bthgd", p, v.astype(jnp.float32))
+    return out.reshape(B, T, Hq, Dh)
+
+
+def _qkv(B=2, T=32, Hq=4, Hkv=2, Dh=8, seed=0):
+    ks = jax.random.split(jax.random.key(seed), 3)
+    q = jax.random.normal(ks[0], (B, T, Hq, Dh), jnp.float32)
+    k = jax.random.normal(ks[1], (B, T, Hkv, Dh), jnp.float32)
+    v = jax.random.normal(ks[2], (B, T, Hkv, Dh), jnp.float32)
+    return q, k, v
+
+
+def test_local_flash_matches_dense():
+    q, k, v = _qkv()
+    pos = jnp.arange(32, dtype=jnp.int32)
+    ref = _dense_reference(q, k, v, pos)
+    out = parallel.local_flash_attention(q, k, v, pos, pos)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+    blocked = parallel.local_flash_attention(q, k, v, pos, pos, block_size=8)
+    np.testing.assert_allclose(np.asarray(blocked), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_fully_masked_rows_are_zero():
+    """A query whose position precedes every key attends to nothing and
+    must produce exactly zero (not a uniform average over values)."""
+    q, k, v = _qkv(B=1, T=4, Hq=2, Hkv=2, Dh=4)
+    qpos = jnp.arange(4, dtype=jnp.int32)          # queries at 0..3
+    kpos = jnp.arange(4, dtype=jnp.int32) + 10     # keys strictly later
+    out = parallel.local_flash_attention(q, k, v, qpos, kpos)
+    np.testing.assert_array_equal(np.asarray(out), 0.0)
+
+
+@pytest.mark.parametrize("mode", ["ring", "ulysses", "allgather"])
+def test_sequence_parallel_matches_dense(cpu8, mode):
+    mesh = parallel.make_mesh({"sp": 8}, cpu8)
+    B, T, Hq, Hkv, Dh = 2, 64, 8, 8, 4
+    q, k, v = _qkv(B, T, Hq, Hkv, Dh, seed=1)
+    pos = jnp.arange(T, dtype=jnp.int32)
+    ref = _dense_reference(q, k, v, pos)
+
+    impl = {"ring": parallel.ring_attention,
+            "ulysses": parallel.ulysses_attention,
+            "allgather": parallel.allgather_kv_attention}[mode]
+
+    def fn(q, k, v, pos):
+        if mode == "ulysses":
+            return impl(q, k, v, "sp", pos)
+        return impl(q, k, v, "sp", pos, pos)
+
+    sharded = shard_map(
+        fn, mesh=mesh,
+        in_specs=(P(None, "sp"), P(None, "sp"), P(None, "sp"), P("sp")),
+        out_specs=P(None, "sp"),
+    )
+    out = sharded(q, k, v, pos)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_attention_grads_match(cpu8):
+    """Gradients through the ring equal gradients through dense attention."""
+    mesh = parallel.make_mesh({"sp": 4}, cpu8[:4])
+    B, T, Hq, Hkv, Dh = 1, 16, 2, 2, 4
+    q, k, v = _qkv(B, T, Hq, Hkv, Dh, seed=2)
+    pos = jnp.arange(T, dtype=jnp.int32)
+
+    def ring_loss(q, k, v):
+        fn = shard_map(
+            lambda q, k, v, p: parallel.ring_attention(q, k, v, "sp", p, p),
+            mesh=mesh,
+            in_specs=(P(None, "sp"), P(None, "sp"), P(None, "sp"), P("sp")),
+            out_specs=P(None, "sp"),
+        )
+        return jnp.sum(fn(q, k, v, pos) ** 2)
+
+    def dense_loss(q, k, v):
+        return jnp.sum(_dense_reference(q, k, v, pos) ** 2)
+
+    g_ring = jax.grad(ring_loss, argnums=(0, 1, 2))(q, k, v)
+    g_dense = jax.grad(dense_loss, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ring, g_dense):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_ring_attention_in_llama(cpu8):
+    """llama.apply with ring attention over sp == unsharded llama.apply."""
+    from horovod_tpu.models import llama
+
+    mesh = parallel.make_mesh({"sp": 4}, cpu8[:4])
+    import dataclasses
+
+    config = dataclasses.replace(llama.LlamaConfig.tiny(),
+                                 compute_dtype=jnp.float32)
+    params = llama.init(jax.random.key(0), config)
+    tokens = jnp.asarray(
+        np.random.RandomState(0).randint(0, config.vocab_size, (2, 32)),
+        jnp.int32)
+    ref = llama.apply(params, tokens, config)
+
+    def fwd(params, tokens, positions):
+        return llama.apply(params, tokens, config, positions=positions,
+                           attn_fn=parallel.make_ring_attn_fn("sp"))
+
+    sharded = shard_map(
+        fwd, mesh=mesh,
+        in_specs=(P(), P(None, "sp"), P("sp")),
+        out_specs=P(None, "sp"),
+        check_vma=False,
+    )
+    pos = jnp.arange(32, dtype=jnp.int32)
+    out = sharded(params, tokens, pos)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_sequence_parallel_attn_fn_mixed_gspmd(cpu8):
+    """Mixed auto/manual: fsdp params via GSPMD + ring attention over sp
+    inside one jit — logits match the fully-replicated forward."""
+    import dataclasses
+
+    from horovod_tpu.models import llama
+
+    mesh = parallel.make_mesh({"fsdp": 2, "sp": 4}, cpu8)
+    config = dataclasses.replace(llama.LlamaConfig.tiny(),
+                                 compute_dtype=jnp.float32)
+    params = llama.init(jax.random.key(0), config)
+    tokens = jnp.asarray(
+        np.random.RandomState(0).randint(0, config.vocab_size, (2, 32)),
+        jnp.int32)
+    ref = llama.apply(params, tokens, config)
+
+    specs = parallel.fsdp_specs(params, "fsdp", mesh, min_size_to_shard=64)
+    params_sh = parallel.shard(params, specs, mesh)
+    tokens_sh = jax.device_put(tokens, NamedSharding(mesh, P(None, "sp")))
+    pos = jax.device_put(jnp.arange(32, dtype=jnp.int32),
+                         NamedSharding(mesh, P("sp")))
+    attn_fn = parallel.sequence_parallel_attn_fn(mesh, "sp")
+
+    @jax.jit
+    def fwd(params, tokens, pos):
+        return llama.apply(params, tokens, config, positions=pos,
+                           attn_fn=attn_fn)
+
+    out = fwd(params_sh, tokens_sh, pos)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+# ---------------------------------------------------------------------------
+# pipeline parallelism
+# ---------------------------------------------------------------------------
+
+def test_pipeline_apply_matches_serial(cpu8):
+    mesh = parallel.make_mesh({"pp": 4}, cpu8[:4])
+    D, M = 8, 6
+    ws = jax.random.normal(jax.random.key(0), (4, D, D), jnp.float32) * 0.3
+    xs = jax.random.normal(jax.random.key(1), (M, 3, D), jnp.float32)
+
+    def stage_fn(w, x):
+        return jnp.tanh(x @ w[0])
+
+    # serial reference: apply the 4 stages in order
+    ref = xs
+    for i in range(4):
+        ref = jax.vmap(lambda x, w=ws[i]: jnp.tanh(x @ w))(ref)
+
+    # outputs are valid on the last stage only; psum the masked output so
+    # the returned (replicated) value is exactly the last stage's
+    collected = shard_map(
+        lambda w, x: jax.lax.psum(
+            jnp.where(jax.lax.axis_index("pp") == 3,
+                      parallel.pipeline_apply(stage_fn, w, x, "pp"),
+                      0.0), "pp"),
+        mesh=mesh, in_specs=(P("pp"), P()), out_specs=P(),
+        check_vma=False,
+    )(ws, xs)
+    np.testing.assert_allclose(np.asarray(collected), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_pipeline_loss_and_grads(cpu8):
+    mesh = parallel.make_mesh({"pp": 4}, cpu8[:4])
+    D, M = 8, 4
+    ws = jax.random.normal(jax.random.key(0), (4, D, D), jnp.float32) * 0.3
+    xs = jax.random.normal(jax.random.key(1), (M, 3, D), jnp.float32)
+    ts = jax.random.normal(jax.random.key(2), (M, 3, D), jnp.float32)
+
+    def stage_fn(w, x):
+        return jnp.tanh(x @ w[0])
+
+    def loss_fn(y, t):
+        return jnp.mean((y - t) ** 2)
+
+    def serial_loss(ws):
+        y = xs
+        for i in range(4):
+            y = jnp.tanh(y @ ws[i])
+        return jnp.mean(jax.vmap(loss_fn)(y, ts))
+
+    piped = shard_map(
+        lambda w, x, t: parallel.pipeline_loss(stage_fn, loss_fn, w, x, t, "pp"),
+        mesh=mesh, in_specs=(P("pp"), P(), P()), out_specs=P(),
+        check_vma=False,
+    )
+
+    def piped_loss(ws):
+        return piped(ws, xs, ts)
+
+    np.testing.assert_allclose(float(piped_loss(ws)), float(serial_loss(ws)),
+                               rtol=1e-5)
+    g_pipe = jax.grad(piped_loss)(ws)
+    g_ser = jax.grad(serial_loss)(ws)
+    np.testing.assert_allclose(np.asarray(g_pipe), np.asarray(g_ser),
+                               rtol=1e-4, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# expert parallelism
+# ---------------------------------------------------------------------------
+
+def test_moe_dense_runs_and_balances():
+    cfg = moe_lib.MoeConfig(d_model=16, d_ff=32, n_experts=4, top_k=2,
+                            capacity_factor=2.0)
+    params = moe_lib.init(jax.random.key(0), cfg)
+    x = jax.random.normal(jax.random.key(1), (2, 8, 16), jnp.float32)
+    y, aux = moe_lib.moe_layer(params, x, cfg)
+    assert y.shape == x.shape
+    assert np.isfinite(float(aux))
+    # gradient flows to every param
+    def loss(p):
+        out, aux = moe_lib.moe_layer(p, x, cfg)
+        return jnp.sum(out ** 2) + 0.01 * aux
+    grads = jax.grad(loss)(params)
+    for k, g in grads.items():
+        assert np.isfinite(np.asarray(g)).all(), k
+        assert float(jnp.abs(g).sum()) > 0, k
+
+
+def test_moe_expert_parallel_matches_dense(cpu8):
+    """EP over 4 devices == the same layer computed on one device, provided
+    per-device capacity doesn't truncate (generous capacity_factor)."""
+    mesh = parallel.make_mesh({"ep": 4}, cpu8[:4])
+    cfg = moe_lib.MoeConfig(d_model=8, d_ff=16, n_experts=4, top_k=1,
+                            capacity_factor=4.0)
+    params = moe_lib.init(jax.random.key(0), cfg)
+    G = 16
+    x = jax.random.normal(jax.random.key(1), (G, 8), jnp.float32)
+
+    y_ref, _ = moe_lib.moe_layer(params, x, cfg)
+
+    ep_fn = shard_map(
+        lambda p, x: moe_lib.moe_layer(p, x, cfg, axis_name="ep")[0],
+        mesh=mesh,
+        in_specs=({"gate": P(), "w_in": P("ep"), "w_out": P("ep")}, P("ep")),
+        out_specs=P("ep"),
+        check_vma=False,
+    )
+    y_ep = ep_fn(params, x)
+    np.testing.assert_allclose(np.asarray(y_ep), np.asarray(y_ref),
+                               rtol=1e-5, atol=1e-5)
