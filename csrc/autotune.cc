@@ -1,0 +1,275 @@
+#include "autotune.h"
+
+#include "common.h"
+
+#include <algorithm>
+#include <cmath>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+
+namespace hvdtpu {
+namespace {
+
+// Dense Cholesky factorization A = L L^T (row-major, n x n).  Returns false
+// if A is not positive definite.
+bool Cholesky(std::vector<double>& a, int n) {
+  for (int i = 0; i < n; i++) {
+    for (int j = 0; j <= i; j++) {
+      double s = a[i * n + j];
+      for (int k = 0; k < j; k++) s -= a[i * n + k] * a[j * n + k];
+      if (i == j) {
+        if (s <= 0) return false;
+        a[i * n + i] = std::sqrt(s);
+      } else {
+        a[i * n + j] = s / a[j * n + j];
+      }
+    }
+    for (int j = i + 1; j < n; j++) a[i * n + j] = 0.0;
+  }
+  return true;
+}
+
+// Solve L y = b in place.
+void ForwardSolve(const std::vector<double>& l, int n, std::vector<double>& b) {
+  for (int i = 0; i < n; i++) {
+    double s = b[i];
+    for (int k = 0; k < i; k++) s -= l[i * n + k] * b[k];
+    b[i] = s / l[i * n + i];
+  }
+}
+
+// Solve L^T x = b in place.
+void BackSolve(const std::vector<double>& l, int n, std::vector<double>& b) {
+  for (int i = n - 1; i >= 0; i--) {
+    double s = b[i];
+    for (int k = i + 1; k < n; k++) s -= l[k * n + i] * b[k];
+    b[i] = s / l[i * n + i];
+  }
+}
+
+double NormCdf(double x) { return 0.5 * std::erfc(-x / std::sqrt(2.0)); }
+double NormPdf(double x) {
+  return std::exp(-0.5 * x * x) / std::sqrt(2.0 * M_PI);
+}
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// GaussianProcess
+// ---------------------------------------------------------------------------
+
+double GaussianProcess::Kernel(const std::vector<double>& a,
+                               const std::vector<double>& b) const {
+  double d2 = 0;
+  for (size_t i = 0; i < a.size(); i++) {
+    double d = a[i] - b[i];
+    d2 += d * d;
+  }
+  return signal_var_ * std::exp(-d2 / (2.0 * length_scale_ * length_scale_));
+}
+
+void GaussianProcess::Fit(const std::vector<std::vector<double>>& x,
+                          const std::vector<double>& y) {
+  x_ = x;
+  int n = static_cast<int>(x.size());
+  // normalize targets (GPML Alg. 2.1 operates on zero-mean data)
+  y_mean_ = 0;
+  for (double v : y) y_mean_ += v;
+  y_mean_ /= n;
+  double var = 0;
+  for (double v : y) var += (v - y_mean_) * (v - y_mean_);
+  y_std_ = n > 1 ? std::sqrt(var / n) : 1.0;
+  if (y_std_ < 1e-12) y_std_ = 1.0;
+  y_.resize(n);
+  for (int i = 0; i < n; i++) y_[i] = (y[i] - y_mean_) / y_std_;
+
+  chol_.assign(static_cast<size_t>(n) * n, 0.0);
+  for (int i = 0; i < n; i++)
+    for (int j = 0; j < n; j++)
+      chol_[i * n + j] = Kernel(x_[i], x_[j]) + (i == j ? noise_ : 0.0);
+  if (!Cholesky(chol_, n)) {
+    // fall back to stronger regularization
+    for (int i = 0; i < n; i++)
+      for (int j = 0; j < n; j++)
+        chol_[i * n + j] = Kernel(x_[i], x_[j]) + (i == j ? 1e-2 : 0.0);
+    Cholesky(chol_, n);
+  }
+  alpha_ = y_;
+  ForwardSolve(chol_, n, alpha_);
+  BackSolve(chol_, n, alpha_);
+}
+
+void GaussianProcess::Predict(const std::vector<double>& x, double* mean,
+                              double* var) const {
+  int n = static_cast<int>(x_.size());
+  if (n == 0) {
+    *mean = 0;
+    *var = signal_var_;
+    return;
+  }
+  std::vector<double> k(n);
+  for (int i = 0; i < n; i++) k[i] = Kernel(x, x_[i]);
+  double m = 0;
+  for (int i = 0; i < n; i++) m += k[i] * alpha_[i];
+  std::vector<double> v = k;
+  ForwardSolve(chol_, n, v);
+  double kv = 0;
+  for (int i = 0; i < n; i++) kv += v[i] * v[i];
+  *mean = m * y_std_ + y_mean_;
+  double raw = Kernel(x, x) - kv;
+  *var = std::max(raw, 1e-12) * y_std_ * y_std_;
+}
+
+// ---------------------------------------------------------------------------
+// BayesianOptimization
+// ---------------------------------------------------------------------------
+
+BayesianOptimization::BayesianOptimization(int dims) : dims_(dims) {}
+
+void BayesianOptimization::AddSample(const std::vector<double>& x, double y) {
+  xs_.push_back(x);
+  ys_.push_back(y);
+  gp_.Fit(xs_, ys_);
+}
+
+std::vector<double> BayesianOptimization::Best() const {
+  if (ys_.empty()) return std::vector<double>(dims_, 0.5);
+  size_t best = 0;
+  for (size_t i = 1; i < ys_.size(); i++)
+    if (ys_[i] > ys_[best]) best = i;
+  return xs_[best];
+}
+
+double BayesianOptimization::ExpectedImprovement(const std::vector<double>& x,
+                                                 double best) const {
+  double mean, var;
+  gp_.Predict(x, &mean, &var);
+  double sd = std::sqrt(var);
+  if (sd < 1e-12) return 0.0;
+  const double xi = 0.01;  // exploration margin
+  double z = (mean - best - xi) / sd;
+  return (mean - best - xi) * NormCdf(z) + sd * NormPdf(z);
+}
+
+std::vector<double> BayesianOptimization::NextSample() {
+  // 4 deterministic seed points spanning the space (reference seeds its BO
+  // with 4 points too, parameter_manager.cc:44-53)
+  static const double kSeeds[4][2] = {
+      {0.25, 0.25}, {0.75, 0.25}, {0.25, 0.75}, {0.75, 0.75}};
+  if (xs_.size() < 4) {
+    std::vector<double> p(dims_, 0.5);
+    for (int d = 0; d < std::min(dims_, 2); d++)
+      p[d] = kSeeds[xs_.size()][d];
+    return p;
+  }
+  double best = *std::max_element(ys_.begin(), ys_.end());
+  std::vector<double> argmax(dims_, 0.5);
+  double best_ei = -1.0;
+  for (int c = 0; c < 256; c++) {
+    std::vector<double> cand(dims_);
+    for (int d = 0; d < dims_; d++) {
+      rng_ = rng_ * 6364136223846793005ull + 1442695040888963407ull;
+      cand[d] = static_cast<double>((rng_ >> 33) & 0x7fffffff) / 0x7fffffff;
+    }
+    double ei = ExpectedImprovement(cand, best);
+    if (ei > best_ei) {
+      best_ei = ei;
+      argmax = cand;
+    }
+  }
+  return argmax;
+}
+
+// ---------------------------------------------------------------------------
+// ParameterManager
+// ---------------------------------------------------------------------------
+
+namespace {
+constexpr double kFusionMax = 64.0 * (1 << 20);  // 0..64 MB
+constexpr double kCycleMinUs = 1e3, kCycleMaxUs = 1e5;  // 1..100 ms
+}  // namespace
+
+void ParameterManager::Initialize(int64_t fusion0, int64_t cycle_us0) {
+  const char* on = getenv("HOROVOD_AUTOTUNE");
+  if (!on || !on[0] || !strcmp(on, "0")) on = getenv("HOROVOD_TPU_AUTOTUNE");
+  active_ = on && on[0] && strcmp(on, "0") != 0;
+  fusion_ = fusion0;
+  cycle_us_ = cycle_us0;
+  if (!active_) return;
+  const char* log = getenv("HOROVOD_AUTOTUNE_LOG");
+  log_path_ = log ? log : "";
+  cycles_per_sample_ =
+      static_cast<int>(EnvInt64("HOROVOD_TPU_AUTOTUNE_CYCLES_PER_SAMPLE", 10));
+  samples_per_step_ =
+      static_cast<int>(EnvInt64("HOROVOD_TPU_AUTOTUNE_SAMPLES_PER_STEP", 5));
+  warmup_samples_ =
+      static_cast<int>(EnvInt64("HOROVOD_TPU_AUTOTUNE_WARMUP_SAMPLES", 3));
+  max_steps_ = static_cast<int>(EnvInt64("HOROVOD_TPU_AUTOTUNE_MAX_STEPS", 20));
+  warmup_left_ = warmup_samples_;
+  current_unit_ = {std::min(1.0, static_cast<double>(fusion0) / kFusionMax),
+                   (static_cast<double>(cycle_us0) - kCycleMinUs) /
+                       (kCycleMaxUs - kCycleMinUs)};
+  if (!log_path_.empty()) {
+    FILE* f = fopen(log_path_.c_str(), "w");
+    if (f) {
+      fputs("fusion_threshold_bytes,cycle_time_us,score_bytes_per_us\n", f);
+      fclose(f);
+    }
+  }
+}
+
+void ParameterManager::Log(double score) {
+  if (log_path_.empty()) return;
+  FILE* f = fopen(log_path_.c_str(), "a");
+  if (!f) return;
+  fprintf(f, "%lld,%lld,%.6f\n", static_cast<long long>(fusion_),
+          static_cast<long long>(cycle_us_), score);
+  fclose(f);
+}
+
+void ParameterManager::SetPoint(const std::vector<double>& unit) {
+  current_unit_ = unit;
+  fusion_ = static_cast<int64_t>(unit[0] * kFusionMax);
+  cycle_us_ = static_cast<int64_t>(kCycleMinUs +
+                                   unit[1] * (kCycleMaxUs - kCycleMinUs));
+}
+
+bool ParameterManager::RecordCycle(int64_t bytes, double cycle_secs,
+                                   int64_t* fusion_out,
+                                   int64_t* cycle_us_out) {
+  if (!active_ || converged_) return false;
+  bytes_acc_ += bytes;
+  secs_acc_ += cycle_secs;
+  if (++cycle_count_ < cycles_per_sample_) return false;
+  // one sample = bytes/µs across the window (0 traffic -> skip the sample)
+  double us = secs_acc_ * 1e6;
+  double score = us > 0 ? static_cast<double>(bytes_acc_) / us : 0.0;
+  cycle_count_ = 0;
+  bytes_acc_ = 0;
+  secs_acc_ = 0;
+  if (score <= 0.0) return false;  // idle window: not a measurement
+  if (warmup_left_ > 0) {
+    warmup_left_--;
+    return false;
+  }
+  scores_.push_back(score);
+  if (static_cast<int>(scores_.size()) < samples_per_step_) return false;
+  std::nth_element(scores_.begin(), scores_.begin() + scores_.size() / 2,
+                   scores_.end());
+  double median = scores_[scores_.size() / 2];
+  scores_.clear();
+  Log(median);
+  bo_.AddSample(current_unit_, median);
+  if (++steps_ >= max_steps_) {
+    SetPoint(bo_.Best());
+    converged_ = true;
+  } else {
+    SetPoint(bo_.NextSample());
+  }
+  *fusion_out = fusion_;
+  *cycle_us_out = cycle_us_;
+  return true;
+}
+
+}  // namespace hvdtpu

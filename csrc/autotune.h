@@ -1,0 +1,107 @@
+// Autotuner: Bayesian optimization of the engine's fusion threshold and
+// cycle time.
+//
+// Role analog of the reference's horovod/common/parameter_manager.{h,cc} +
+// optim/bayesian_optimization.{h,cc} + optim/gaussian_process.{h,cc}:
+// a GP-regressed score surface (bytes/µs) over the 2-D knob space, expected-
+// improvement acquisition, warmup discard, median-of-samples scoring, and a
+// CSV log via HOROVOD_AUTOTUNE_LOG.  Dependency-free: the GP solves a
+// <100-dim Cholesky with hand-rolled dense linear algebra instead of Eigen,
+// and EI is maximized by candidate sampling instead of L-BFGS restarts.
+//
+// Enabled by HOROVOD_AUTOTUNE=1 (alias HOROVOD_TPU_AUTOTUNE).  The
+// coordinator tunes; workers receive values through the response wire.
+
+#ifndef HVDTPU_AUTOTUNE_H_
+#define HVDTPU_AUTOTUNE_H_
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+namespace hvdtpu {
+
+// RBF-kernel Gaussian process regressor on normalized inputs.
+class GaussianProcess {
+ public:
+  void Fit(const std::vector<std::vector<double>>& x,
+           const std::vector<double>& y);
+  // Predictive mean and variance at a point.
+  void Predict(const std::vector<double>& x, double* mean, double* var) const;
+  bool fitted() const { return !x_.empty(); }
+
+ private:
+  double Kernel(const std::vector<double>& a,
+                const std::vector<double>& b) const;
+
+  double length_scale_ = 0.3;
+  double signal_var_ = 1.0;
+  double noise_ = 1e-4;
+  std::vector<std::vector<double>> x_;
+  std::vector<double> y_;
+  double y_mean_ = 0.0, y_std_ = 1.0;
+  std::vector<double> chol_;    // lower-triangular L of K+noise, row-major
+  std::vector<double> alpha_;   // (K+noise)^-1 y
+};
+
+// Expected-improvement Bayesian maximizer over the unit hypercube.
+class BayesianOptimization {
+ public:
+  explicit BayesianOptimization(int dims);
+  void AddSample(const std::vector<double>& x, double y);
+  // Next point to evaluate: seed points first, then argmax-EI over random
+  // candidates (deterministic LCG so runs are reproducible).
+  std::vector<double> NextSample();
+  std::vector<double> Best() const;
+
+ private:
+  double ExpectedImprovement(const std::vector<double>& x, double best) const;
+
+  int dims_;
+  uint64_t rng_ = 0x9e3779b97f4a7c15ull;
+  std::vector<std::vector<double>> xs_;
+  std::vector<double> ys_;
+  GaussianProcess gp_;
+};
+
+// Tunes {fusion_threshold_bytes, cycle_time_us} online from observed
+// throughput.  Call RecordCycle once per background-loop cycle with the
+// bytes processed that cycle; when a tuning step fires, returns true and
+// writes the new values.
+class ParameterManager {
+ public:
+  void Initialize(int64_t fusion0, int64_t cycle_us0);
+  bool active() const { return active_; }
+
+  // Returns true when new parameter values should be applied (and synced).
+  bool RecordCycle(int64_t bytes, double cycle_secs, int64_t* fusion_out,
+                   int64_t* cycle_us_out);
+
+ private:
+  void Log(double score);
+  void SetPoint(const std::vector<double>& unit);
+
+  bool active_ = false;
+  BayesianOptimization bo_{2};
+  std::vector<double> current_unit_;
+  int64_t fusion_ = 64 << 20;
+  int64_t cycle_us_ = 5000;
+
+  int cycles_per_sample_ = 10;
+  int samples_per_step_ = 5;
+  int warmup_samples_ = 3;
+  int max_steps_ = 20;
+
+  int cycle_count_ = 0;
+  int64_t bytes_acc_ = 0;
+  double secs_acc_ = 0.0;
+  std::vector<double> scores_;
+  int warmup_left_ = 0;
+  int steps_ = 0;
+  bool converged_ = false;
+  std::string log_path_;
+};
+
+}  // namespace hvdtpu
+
+#endif  // HVDTPU_AUTOTUNE_H_

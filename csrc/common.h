@@ -7,6 +7,7 @@
 #pragma once
 
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
 #include <string>
 #include <vector>
@@ -142,6 +143,17 @@ inline uint16_t FloatToBF16(float x) {
   // round-to-nearest-even
   uint32_t rounded = f + 0x7fffu + ((f >> 16) & 1u);
   return static_cast<uint16_t>(rounded >> 16);
+}
+
+// Env-var knob parsing shared by the engine and the autotuner.
+inline int64_t EnvInt64(const char* name, int64_t dflt) {
+  const char* v = getenv(name);
+  return v ? strtoll(v, nullptr, 10) : dflt;
+}
+
+inline bool EnvFlag(const char* name) {
+  const char* v = getenv(name);
+  return v && v[0] && strcmp(v, "0") != 0;
 }
 
 }  // namespace hvdtpu

@@ -36,22 +36,14 @@
 #include <unordered_map>
 #include <vector>
 
+#include "autotune.h"
 #include "common.h"
 #include "socket.h"
+#include "timeline.h"
 #include "wire.h"
 
 namespace hvdtpu {
 namespace {
-
-int64_t EnvInt64(const char* name, int64_t dflt) {
-  const char* v = getenv(name);
-  return v ? strtoll(v, nullptr, 10) : dflt;
-}
-
-bool EnvFlag(const char* name) {
-  const char* v = getenv(name);
-  return v && v[0] && strcmp(v, "0") != 0;
-}
 
 void LogWarn(const std::string& msg) {
   fprintf(stderr, "[hvdtpu] WARNING: %s\n", msg.c_str());
@@ -61,6 +53,16 @@ int64_t NumElems(const std::vector<int64_t>& dims) {
   int64_t n = 1;
   for (int64_t d : dims) n *= d;
   return n;
+}
+
+const char* OpName(OpType op) {
+  switch (op) {
+    case OpType::kAllreduce: return "ALLREDUCE";
+    case OpType::kAllgather: return "ALLGATHER";
+    case OpType::kBroadcast: return "BROADCAST";
+    case OpType::kAlltoall: return "ALLTOALL";
+    default: return "ERROR";
+  }
 }
 
 std::string DimsStr(const std::vector<int64_t>& dims) {
@@ -170,7 +172,7 @@ class Engine {
 
   int rank_ = 0, size_ = 1;
   int64_t fusion_threshold_ = 64 << 20;
-  int cycle_ms_ = 5;
+  int64_t cycle_us_ = 5000;
   double stall_warn_s_ = 60.0;
   bool stall_check_ = true;
   double start_timeout_s_ = 120.0;
@@ -201,6 +203,16 @@ class Engine {
   std::map<std::string, Negotiation> message_table_;  // ordered for stable fuse
   std::deque<std::string> ready_;       // fully-subscribed names, FIFO
   std::deque<Response> error_ready_;    // validation failures to broadcast
+
+  // chrome-tracing profiler, active on rank 0 when HOROVOD_TIMELINE is set;
+  // emit calls outside the background thread are forbidden (SPSC ring)
+  Timeline timeline_;
+
+  // autotuner (coordinator tunes; workers receive via the response wire)
+  ParameterManager pm_;
+  int64_t cycle_bytes_ = 0;             // bytes executed this cycle (bg thread)
+  int64_t pending_tuned_fusion_ = -1;   // values to ship with next broadcast
+  int64_t pending_tuned_cycle_ = -1;
 };
 
 // ---------------------------------------------------------------------------
@@ -212,14 +224,23 @@ Status Engine::Init(const std::string& host, int port, int rank, int size) {
   size_ = size;
   fusion_threshold_ = EnvInt64("HOROVOD_TPU_FUSION_THRESHOLD",
                                EnvInt64("HOROVOD_FUSION_THRESHOLD", 64 << 20));
-  cycle_ms_ = static_cast<int>(
-      EnvInt64("HOROVOD_TPU_CYCLE_TIME", EnvInt64("HOROVOD_CYCLE_TIME", 5)));
+  cycle_us_ = 1000 * EnvInt64("HOROVOD_TPU_CYCLE_TIME",
+                              EnvInt64("HOROVOD_CYCLE_TIME", 5));
+  if (rank_ == 0) pm_.Initialize(fusion_threshold_, cycle_us_);
   stall_warn_s_ = static_cast<double>(
       EnvInt64("HOROVOD_TPU_STALL_WARNING_SECS", 60));
   stall_check_ = !EnvFlag("HOROVOD_TPU_STALL_CHECK_DISABLE") &&
                  !EnvFlag("HOROVOD_STALL_CHECK_DISABLE");
   start_timeout_s_ = static_cast<double>(
       EnvInt64("HOROVOD_TPU_START_TIMEOUT", 120));
+  if (rank_ == 0) {
+    const char* tl = getenv("HOROVOD_TIMELINE");
+    if (!tl || !tl[0]) tl = getenv("HOROVOD_TPU_TIMELINE");
+    if (tl && tl[0])
+      timeline_.Initialize(tl,
+                           EnvFlag("HOROVOD_TIMELINE_MARK_CYCLES") ||
+                               EnvFlag("HOROVOD_TPU_TIMELINE_MARK_CYCLES"));
+  }
 
   if (size_ > 1) {
     // data-plane listener first, so peers can connect whenever they learn
@@ -319,6 +340,7 @@ void Engine::Shutdown() {
     shutdown_requested_ = true;
   }
   if (bg_.joinable()) bg_.join();
+  timeline_.Shutdown();
 }
 
 // ---------------------------------------------------------------------------
@@ -436,6 +458,7 @@ void Engine::BackgroundLoop() {
   bool stop = false;
   while (!stop) {
     auto cycle_start = std::chrono::steady_clock::now();
+    timeline_.MarkCycleStart();
 
     RequestList local;
     {
@@ -454,6 +477,9 @@ void Engine::BackgroundLoop() {
     if (size_ == 1) {
       // degenerate world: everything local is immediately ready
       for (Request& r : local.requests) {
+        timeline_.NegotiateStart(r.name, OpName(r.op));
+        timeline_.NegotiateRankReady(r.name, 0);
+        timeline_.NegotiateEnd(r.name);
         Response resp;
         resp.op = r.op;
         resp.names = {r.name};
@@ -490,10 +516,19 @@ void Engine::BackgroundLoop() {
         for (Response& r : rl.responses)
           to_execute.responses.push_back(std::move(r));
         to_execute.shutdown = to_execute.shutdown || rl.shutdown;
+        if (rl.tuned_fusion >= 0) to_execute.tuned_fusion = rl.tuned_fusion;
+        if (rl.tuned_cycle_us >= 0)
+          to_execute.tuned_cycle_us = rl.tuned_cycle_us;
       }
     }
 
     for (const Response& resp : to_execute.responses) Execute(resp);
+    // workers adopt coordinator-tuned knobs from the wire
+    if (rank_ != 0) {
+      if (to_execute.tuned_fusion >= 0)
+        fusion_threshold_ = to_execute.tuned_fusion;
+      if (to_execute.tuned_cycle_us > 0) cycle_us_ = to_execute.tuned_cycle_us;
+    }
     if (to_execute.shutdown) {
       FailAll(Status::Shutdown());
       stop = true;
@@ -501,8 +536,21 @@ void Engine::BackgroundLoop() {
 
     if (!stop) {
       auto elapsed = std::chrono::steady_clock::now() - cycle_start;
-      auto budget = std::chrono::milliseconds(cycle_ms_);
+      auto budget = std::chrono::microseconds(cycle_us_);
       if (elapsed < budget) std::this_thread::sleep_for(budget - elapsed);
+    }
+    if (rank_ == 0 && pm_.active()) {
+      double secs = std::chrono::duration<double>(
+                        std::chrono::steady_clock::now() - cycle_start)
+                        .count();
+      int64_t f, cus;
+      if (pm_.RecordCycle(cycle_bytes_, secs, &f, &cus)) {
+        fusion_threshold_ = f;
+        cycle_us_ = cus;
+        pending_tuned_fusion_ = f;
+        pending_tuned_cycle_ = cus;
+      }
+      cycle_bytes_ = 0;
     }
   }
   running_ = false;
@@ -541,12 +589,25 @@ void Engine::CoordinatorTick(RequestList& local, ResponseList* out) {
   FuseReady(out);
   if (stall_check_) StallCheck();
   out->shutdown = shutdown;
-  if (!out->responses.empty() || out->shutdown) {
+  if (pending_tuned_fusion_ >= 0 || pending_tuned_cycle_ >= 0) {
+    out->tuned_fusion = pending_tuned_fusion_;
+    out->tuned_cycle_us = pending_tuned_cycle_;
+  }
+  if (!out->responses.empty() || out->shutdown ||
+      out->tuned_fusion >= 0 || out->tuned_cycle_us >= 0) {
     std::string frame = Serialize(*out);
+    bool sent = true;
     for (int i = 1; i < size_; i++) {
       if (!workers_[i].valid()) continue;
       Status s = workers_[i].SendFrame(frame);
-      if (!s.ok()) LogWarn("send to worker failed: " + s.message);
+      if (!s.ok()) {
+        LogWarn("send to worker failed: " + s.message);
+        sent = false;
+      }
+    }
+    if (sent) {
+      pending_tuned_fusion_ = -1;
+      pending_tuned_cycle_ = -1;
     }
   }
 }
@@ -564,9 +625,13 @@ void Engine::HandleArrivedRequests(const RequestList& list,
       error_ready_.push_back(std::move(err));
       continue;
     }
-    if (neg.received.empty()) neg.first_arrival = std::chrono::steady_clock::now();
+    if (neg.received.empty()) {
+      neg.first_arrival = std::chrono::steady_clock::now();
+      timeline_.NegotiateStart(r.name, OpName(r.op));
+    }
     neg.ranks.insert(r.rank);
     neg.received.push_back(r);
+    timeline_.NegotiateRankReady(r.name, r.rank);
     if (static_cast<int>(neg.ranks.size()) == size_) {
       // validate cross-rank consistency -> clean error instead of hang
       const Request& first = neg.received.front();
@@ -599,6 +664,7 @@ void Engine::HandleArrivedRequests(const RequestList& list,
         }
         if (!err.empty()) break;
       }
+      timeline_.NegotiateEnd(r.name);
       if (!err.empty()) {
         Response resp;
         resp.op = OpType::kError;
@@ -717,6 +783,10 @@ void Engine::Execute(const Response& resp) {
     }
   }
   if (entries.empty()) return;
+  for (const TensorEntry& e : entries)
+    cycle_bytes_ += static_cast<int64_t>(e.data.size());
+  for (const std::string& name : resp.names)
+    timeline_.Start(name, OpName(resp.op));
   switch (resp.op) {
     case OpType::kAllreduce:
       ExecuteAllreduce(resp, entries);
@@ -733,15 +803,24 @@ void Engine::Execute(const Response& resp) {
     default:
       break;
   }
+  for (const std::string& name : resp.names) timeline_.End(name);
 }
 
 void Engine::ExecuteAllreduce(const Response& resp,
                               std::vector<TensorEntry>& entries) {
   DType dtype = entries[0].req.dtype;
+  auto act_start = [&](const char* activity) {
+    for (auto& e : entries) timeline_.ActivityStart(e.req.name, activity);
+  };
+  auto act_end = [&]() {
+    for (auto& e : entries) timeline_.ActivityEnd(e.req.name);
+  };
   if (entries.size() == 1) {
     // no fusion copy needed: reduce in place on the entry buffer
     TensorEntry& e = entries[0];
+    act_start("RING_ALLREDUCE");
     Status st = RingAllreduce(e.data.data(), NumElems(e.req.dims), dtype);
+    act_end();
     MarkDone(e.handle, st, e.req.dims, std::move(e.data));
     if (!st.ok()) FailAll(st);
     return;
@@ -751,19 +830,25 @@ void Engine::ExecuteAllreduce(const Response& resp,
   for (auto& e : entries) total += e.data.size();
   std::vector<char> fused(total);
   size_t off = 0;
+  act_start("MEMCPY_IN_FUSION_BUFFER");
   for (auto& e : entries) {
     std::memcpy(fused.data() + off, e.data.data(), e.data.size());
     off += e.data.size();
   }
+  act_end();
+  act_start("RING_ALLREDUCE");
   Status st = RingAllreduce(
       fused.data(), static_cast<int64_t>(total / DTypeSize(dtype)), dtype);
+  act_end();
+  act_start("MEMCPY_OUT_FUSION_BUFFER");
   off = 0;
   for (auto& e : entries) {
     if (st.ok())
       std::memcpy(e.data.data(), fused.data() + off, e.data.size());
     off += e.data.size();
-    MarkDone(e.handle, st, e.req.dims, std::move(e.data));
   }
+  act_end();
+  for (auto& e : entries) MarkDone(e.handle, st, e.req.dims, std::move(e.data));
   if (!st.ok()) FailAll(st);
 }
 

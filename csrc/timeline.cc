@@ -1,0 +1,203 @@
+#include "timeline.h"
+
+#include <chrono>
+#include <cstdio>
+
+namespace hvdtpu {
+namespace {
+
+int64_t NowUs() {
+  return std::chrono::duration_cast<std::chrono::microseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+// Minimal JSON string escaping for event/lane names.
+std::string JsonEscape(const std::string& s) {
+  std::string out;
+  out.reserve(s.size() + 2);
+  for (char c : s) {
+    switch (c) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\n': out += "\\n"; break;
+      case '\t': out += "\\t"; break;
+      default:
+        if (static_cast<unsigned char>(c) < 0x20) {
+          char buf[8];
+          snprintf(buf, sizeof(buf), "\\u%04x", c);
+          out += buf;
+        } else {
+          out += c;
+        }
+    }
+  }
+  return out;
+}
+
+}  // namespace
+
+Timeline::~Timeline() { Shutdown(); }
+
+void Timeline::Initialize(const std::string& path, bool mark_cycles) {
+  if (path.empty() || enabled_) return;
+  path_ = path;
+  mark_cycles_ = mark_cycles;
+  start_us_ = NowUs();
+  ring_.resize(kCapacity);
+  running_ = true;
+  enabled_ = true;
+  writer_ = std::thread(&Timeline::WriterLoop, this);
+}
+
+void Timeline::Shutdown() {
+  if (!enabled_) return;
+  running_ = false;
+  if (writer_.joinable()) writer_.join();
+  enabled_ = false;
+}
+
+int64_t Timeline::TensorLane(const std::string& tensor) {
+  auto it = lanes_.find(tensor);
+  if (it != lanes_.end()) return it->second;
+  if (lanes_.size() >= kMaxLanes) {
+    if (overflow_lane_ < 0) {
+      overflow_lane_ = next_lane_++;
+      Push(TimelineRecordType::kThreadName, overflow_lane_, "other");
+    }
+    return overflow_lane_;
+  }
+  int64_t lane = next_lane_++;
+  lanes_.emplace(tensor, lane);
+  Push(TimelineRecordType::kThreadName, lane, tensor);
+  return lane;
+}
+
+void Timeline::Push(TimelineRecordType type, int64_t tid,
+                    const std::string& name) {
+  size_t tail = tail_.load(std::memory_order_relaxed);
+  size_t next = (tail + 1) % kCapacity;
+  if (next == head_.load(std::memory_order_acquire)) {
+    dropped_.fetch_add(1, std::memory_order_relaxed);
+    return;  // ring full: drop rather than stall the engine
+  }
+  TimelineRecord& r = ring_[tail];
+  r.type = type;
+  r.tid = tid;
+  r.ts_us = NowUs() - start_us_;
+  r.name = name;
+  tail_.store(next, std::memory_order_release);
+}
+
+void Timeline::NegotiateStart(const std::string& tensor,
+                              const std::string& op) {
+  if (!enabled_) return;
+  Push(TimelineRecordType::kBegin, TensorLane(tensor), "NEGOTIATE_" + op);
+}
+
+void Timeline::NegotiateRankReady(const std::string& tensor, int rank) {
+  if (!enabled_) return;
+  Push(TimelineRecordType::kInstant, TensorLane(tensor),
+       std::to_string(rank) + "_READY");
+}
+
+void Timeline::NegotiateEnd(const std::string& tensor) {
+  if (!enabled_) return;
+  Push(TimelineRecordType::kEnd, TensorLane(tensor), "");
+}
+
+void Timeline::Start(const std::string& tensor, const std::string& op) {
+  if (!enabled_) return;
+  Push(TimelineRecordType::kBegin, TensorLane(tensor), op);
+}
+
+void Timeline::ActivityStart(const std::string& tensor,
+                             const std::string& activity) {
+  if (!enabled_) return;
+  Push(TimelineRecordType::kBegin, TensorLane(tensor), activity);
+}
+
+void Timeline::ActivityEnd(const std::string& tensor) {
+  if (!enabled_) return;
+  Push(TimelineRecordType::kEnd, TensorLane(tensor), "");
+}
+
+void Timeline::End(const std::string& tensor) {
+  if (!enabled_) return;
+  Push(TimelineRecordType::kEnd, TensorLane(tensor), "");
+}
+
+void Timeline::MarkCycleStart() {
+  if (!enabled_ || !mark_cycles_) return;
+  Push(TimelineRecordType::kInstant, 0, "CYCLE_START");
+}
+
+void Timeline::WriterLoop() {
+  FILE* f = fopen(path_.c_str(), "w");
+  if (!f) {
+    fprintf(stderr, "[hvdtpu] WARNING: cannot open timeline file %s\n",
+            path_.c_str());
+    // keep consuming so the producer never blocks
+    while (running_.load(std::memory_order_acquire)) {
+      head_.store(tail_.load(std::memory_order_acquire),
+                  std::memory_order_release);
+      std::this_thread::sleep_for(std::chrono::milliseconds(10));
+    }
+    return;
+  }
+  fputs("[\n", f);
+  fprintf(f, "{\"name\":\"thread_name\",\"ph\":\"M\",\"pid\":0,\"tid\":0,"
+             "\"args\":{\"name\":\"cycles\"}}");
+  auto drain = [&]() {
+    size_t head = head_.load(std::memory_order_relaxed);
+    size_t tail = tail_.load(std::memory_order_acquire);
+    while (head != tail) {
+      const TimelineRecord& r = ring_[head];
+      switch (r.type) {
+        case TimelineRecordType::kThreadName:
+          fprintf(f,
+                  ",\n{\"name\":\"thread_name\",\"ph\":\"M\",\"pid\":0,"
+                  "\"tid\":%lld,\"args\":{\"name\":\"%s\"}}",
+                  static_cast<long long>(r.tid), JsonEscape(r.name).c_str());
+          break;
+        case TimelineRecordType::kBegin:
+          fprintf(f,
+                  ",\n{\"name\":\"%s\",\"ph\":\"B\",\"pid\":0,\"tid\":%lld,"
+                  "\"ts\":%lld}",
+                  JsonEscape(r.name).c_str(), static_cast<long long>(r.tid),
+                  static_cast<long long>(r.ts_us));
+          break;
+        case TimelineRecordType::kEnd:
+          fprintf(f,
+                  ",\n{\"ph\":\"E\",\"pid\":0,\"tid\":%lld,\"ts\":%lld}",
+                  static_cast<long long>(r.tid),
+                  static_cast<long long>(r.ts_us));
+          break;
+        case TimelineRecordType::kInstant:
+          fprintf(f,
+                  ",\n{\"name\":\"%s\",\"ph\":\"i\",\"s\":\"t\",\"pid\":0,"
+                  "\"tid\":%lld,\"ts\":%lld}",
+                  JsonEscape(r.name).c_str(), static_cast<long long>(r.tid),
+                  static_cast<long long>(r.ts_us));
+          break;
+      }
+      head = (head + 1) % kCapacity;
+      head_.store(head, std::memory_order_release);
+      tail = tail_.load(std::memory_order_acquire);
+    }
+  };
+  while (running_.load(std::memory_order_acquire)) {
+    drain();
+    fflush(f);
+    std::this_thread::sleep_for(std::chrono::milliseconds(5));
+  }
+  drain();
+  int64_t dropped = dropped_.load();
+  if (dropped > 0)
+    fprintf(stderr, "[hvdtpu] WARNING: timeline dropped %lld records\n",
+            static_cast<long long>(dropped));
+  fputs("\n]\n", f);
+  fclose(f);
+}
+
+}  // namespace hvdtpu

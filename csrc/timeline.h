@@ -1,0 +1,91 @@
+// Chrome-tracing timeline for the eager collective engine.
+//
+// Role analog of the reference's horovod/common/timeline.{h,cc}: rank 0
+// writes a chrome://tracing JSON file named by HOROVOD_TIMELINE, with a
+// per-tensor lane (tid) showing the NEGOTIATE_<OP> phase (with per-rank
+// readiness ticks), the top-level op, and nested processing activities;
+// optional cycle markers via HOROVOD_TIMELINE_MARK_CYCLES.
+//
+// I/O is decoupled from the engine's background thread through a fixed-size
+// single-producer/single-consumer lock-free ring (the engine background
+// thread is the only producer; a dedicated writer thread is the consumer) —
+// same design point as the reference's boost::lockfree SPSC queue, done
+// with C++11 atomics instead of a vendored library.
+
+#ifndef HVDTPU_TIMELINE_H_
+#define HVDTPU_TIMELINE_H_
+
+#include <atomic>
+#include <cstdint>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace hvdtpu {
+
+enum class TimelineRecordType : uint8_t {
+  kBegin,        // duration begin (ph "B")
+  kEnd,          // duration end (ph "E")
+  kInstant,      // instant event (ph "i")
+  kThreadName,   // metadata: lane name
+};
+
+struct TimelineRecord {
+  TimelineRecordType type;
+  int64_t tid = 0;
+  int64_t ts_us = 0;
+  std::string name;  // event name (or lane name for kThreadName)
+};
+
+class Timeline {
+ public:
+  ~Timeline();
+
+  // Opens the file and starts the writer thread; no-op if path is empty.
+  void Initialize(const std::string& path, bool mark_cycles);
+  void Shutdown();
+  bool Enabled() const { return enabled_; }
+  bool MarkCyclesEnabled() const { return enabled_ && mark_cycles_; }
+
+  // All emit methods must be called from ONE thread (the engine background
+  // thread) — the ring is SPSC.
+  void NegotiateStart(const std::string& tensor, const std::string& op);
+  void NegotiateRankReady(const std::string& tensor, int rank);
+  void NegotiateEnd(const std::string& tensor);
+  void Start(const std::string& tensor, const std::string& op);
+  void ActivityStart(const std::string& tensor, const std::string& activity);
+  void ActivityEnd(const std::string& tensor);
+  void End(const std::string& tensor);
+  void MarkCycleStart();
+
+ private:
+  int64_t TensorLane(const std::string& tensor);
+  void Push(TimelineRecordType type, int64_t tid, const std::string& name);
+  void WriterLoop();
+
+  bool enabled_ = false;
+  bool mark_cycles_ = false;
+  std::string path_;
+  int64_t start_us_ = 0;
+
+  // Lane map is bounded: auto-named ops (allreduce.noname.N) would otherwise
+  // grow it without limit; overflow ops share one "other" lane.
+  static constexpr size_t kMaxLanes = 4096;
+  std::unordered_map<std::string, int64_t> lanes_;
+  int64_t next_lane_ = 1;  // lane 0 reserved for cycle markers
+  int64_t overflow_lane_ = -1;
+
+  // SPSC ring
+  static constexpr size_t kCapacity = 1 << 16;
+  std::vector<TimelineRecord> ring_;
+  std::atomic<size_t> head_{0};  // consumer position
+  std::atomic<size_t> tail_{0};  // producer position
+  std::atomic<bool> running_{false};
+  std::atomic<int64_t> dropped_{0};
+  std::thread writer_;
+};
+
+}  // namespace hvdtpu
+
+#endif  // HVDTPU_TIMELINE_H_

@@ -40,6 +40,11 @@ struct Response {
 struct ResponseList {
   std::vector<Response> responses;
   bool shutdown = false;
+  // autotuner sync (coordinator -> workers), -1 = no change.  Role analog
+  // of the reference's ParameterManager::SyncParams MPI struct broadcast
+  // (horovod/common/parameter_manager.cc:213-246).
+  int64_t tuned_fusion = -1;
+  int64_t tuned_cycle_us = -1;
 };
 
 // Serialization (little-endian host assumed; single-arch clusters).

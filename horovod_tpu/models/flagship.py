@@ -1,0 +1,204 @@
+"""Flagship 5D-parallel training step: pp x dp x fsdp x sp x tp (+ ep).
+
+Composes every parallelism axis in the framework into ONE jitted train step
+on a MoE-augmented Llama-style transformer:
+
+* **pp**   — pipeline stages via :func:`horovod_tpu.parallel.pipeline_apply`
+  (partial-manual shard_map over the ``pp`` axis; microbatches stream
+  through stages over ``ppermute``).
+* **dp / fsdp** — batch sharded over the data axes; parameters ZeRO-3
+  sharded over ``fsdp`` by GSPMD (auto axes inside the pipeline region).
+* **sp**   — ring attention over the sequence axis (nested partial-manual
+  shard_map bound to the context mesh).
+* **tp**   — Megatron-style head/ffn sharding via the llama param specs
+  (auto axis; XLA inserts the activation psums).
+* **ep**   — each stage ends with a mixture-of-experts FFN whose experts
+  shard over the ``sp`` axis group (the conventional aliasing of expert
+  parallelism onto the sequence/data axis group), tokens routed by
+  ``all_to_all``.
+
+The reference framework has exactly one of these axes (dp); this module is
+the capability bar for the rest (SURVEY.md §2.3, §5 long-context).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from horovod_tpu.models import llama
+from horovod_tpu.parallel import moe as moe_lib
+from horovod_tpu.parallel import pipeline as pipe
+from horovod_tpu.parallel.ring_attention import sequence_parallel_attn_fn
+
+
+@dataclasses.dataclass(frozen=True)
+class FlagshipConfig:
+    llama: llama.LlamaConfig
+    n_experts: int = 4
+    d_ff_moe: int = 64
+    top_k: int = 1
+    capacity_factor: float = 4.0
+    microbatches: int = 2
+    aux_weight: float = 0.01
+
+    @property
+    def moe(self) -> moe_lib.MoeConfig:
+        return moe_lib.MoeConfig(
+            d_model=self.llama.d_model, d_ff=self.d_ff_moe,
+            n_experts=self.n_experts, top_k=self.top_k,
+            capacity_factor=self.capacity_factor)
+
+
+_STAGE_KEYS = llama._LAYER_KEYS  # dense block params, stacked [L, ...]
+
+
+def init(rng, config: FlagshipConfig, n_stages: int):
+    """Parameters: llama stack [L, ...] + per-stage MoE [n_stages, ...]."""
+    c = config.llama
+    if c.n_layers % n_stages:
+        raise ValueError(f"n_layers {c.n_layers} not divisible by {n_stages} stages")
+    params = llama.init(rng, c)
+    moe_keys = jax.random.split(jax.random.fold_in(rng, 7), n_stages)
+    moe_stack = jax.tree.map(
+        lambda *xs: jnp.stack(xs),
+        *[moe_lib.init(k, config.moe) for k in moe_keys])
+    params["moe"] = moe_stack
+    return params
+
+
+def param_specs(config: FlagshipConfig, pp="pp", fsdp="fsdp", tp="tp",
+                ep="sp"):
+    """PartitionSpec pytree: llama specs with the layer-stack dim re-labeled
+    ``pp`` (each stage owns its layer slice), MoE experts sharded over the
+    ``ep`` alias axis."""
+    specs = llama.param_specs(config.llama, fsdp=fsdp, tp=tp)
+    # vocab-sharded embedding + token gather trips an XLA SPMD partitioner
+    # CHECK on some backends; shard the feature dim instead (same memory
+    # win, gather stays local)
+    specs["embed"] = P(None, fsdp)
+    for k in _STAGE_KEYS:
+        old = specs[k]
+        specs[k] = P(pp, *old[1:])
+    specs["moe"] = {
+        "gate": P(pp),
+        "w_in": P(pp, ep, None, None),
+        "w_out": P(pp, ep, None, None),
+    }
+    return specs
+
+
+def data_specs(batch_axes=("dp", "fsdp"), sp="sp"):
+    """tokens [B, T]: batch over the data axes, sequence over sp."""
+    return P(batch_axes, sp)
+
+
+def build_train_step(mesh, config: FlagshipConfig, optimizer):
+    """Returns ``step(params, opt_state, tokens) -> (params, opt_state,
+    loss)``, jittable over ``mesh``.  ``tokens``: [B, T] int32 with
+    ``B % microbatches == 0`` and microbatch size divisible by the data-axis
+    product."""
+    c = config.llama
+    n_stages = mesh.shape["pp"]
+    M = config.microbatches
+    attn_fn = sequence_parallel_attn_fn(mesh=None, axis_name="sp")
+    moe_cfg = config.moe
+
+    def stage_fn(stage_params, x):
+        """One pipeline stage: L/n_stages dense llama blocks + MoE FFN.
+        Runs inside the pp-manual region; fsdp/tp/sp/dp remain auto except
+        the nested sp-manual regions for ring attention and expert routing.
+        """
+        T = x.shape[1]
+        positions = jnp.arange(T, dtype=jnp.int32)
+        cos, sin = llama.rope_cos_sin(positions, c.head_dim, c.rope_theta,
+                                      x.dtype)
+        dense_stack = {k: stage_params[k] for k in _STAGE_KEYS}
+
+        def body(carry, layer_params):
+            out = llama._block(carry, layer_params, cos, sin, positions, c,
+                               attn_fn)
+            return out, None
+
+        x, _ = lax.scan(jax.checkpoint(body), x, dense_stack)
+
+        # MoE FFN with expert parallelism over the sp axis group (nested
+        # sp-manual region; context mesh).  The load-balancing aux loss is
+        # dropped here — GPipe stages can only forward activations, and the
+        # flagship step optimizes the LM loss (use moe_layer directly for
+        # aux-weighted training).
+        moe_params = jax.tree.map(lambda p: p[0], stage_params["moe"])
+        y, _ = jax.shard_map(
+            lambda mp, x: moe_lib.moe_layer(mp, x, moe_cfg, axis_name="sp"),
+            in_specs=({"gate": P(), "w_in": P("sp"), "w_out": P("sp")},
+                      P(None, "sp")),
+            out_specs=(P(None, "sp"), P()),
+            axis_names=frozenset({"sp"}),
+            check_vma=False,
+        )(moe_params, x)
+        return x + y
+
+    def loss_fn(params, tokens):
+        B, T = tokens.shape
+        mb = B // M
+        # one-hot matmul embedding: the canonical TPU/SPMD-safe lookup
+        onehot = jax.nn.one_hot(tokens, c.vocab_size, dtype=c.compute_dtype)
+        x = onehot @ params["embed"].astype(c.compute_dtype)    # [B, T, D]
+        x = x.reshape(M, mb, T, c.d_model)
+        targets = tokens.reshape(M, mb, T)
+
+        def pp_region(stage_params, microbatches, targets):
+            n = lax.axis_size("pp")
+            stage = lax.axis_index("pp")
+            outs = pipe.pipeline_apply(stage_fn, stage_params, microbatches,
+                                       "pp")
+
+            def mb_loss(y, t):
+                h = llama._rms_norm(y, params["final_norm"], c.rms_eps)
+                logits = (h @ params["lm_head"].astype(h.dtype)).astype(
+                    jnp.float32)
+                logp = jax.nn.log_softmax(logits[:, :-1])
+                # one-hot contraction instead of take_along_axis: gathers
+                # along a tp-sharded vocab dim inside a manual region crash
+                # the SPMD partitioner, and the einsum is MXU-friendly
+                onehot = jax.nn.one_hot(t[:, 1:], c.vocab_size,
+                                        dtype=logp.dtype)
+                nll = -jnp.einsum("btv,btv->bt", logp, onehot)
+                return jnp.mean(nll)
+
+            per_mb = jax.vmap(mb_loss)(outs, targets)
+            local = jnp.where(stage == n - 1, jnp.mean(per_mb), 0.0)
+            return lax.psum(local, "pp")
+
+        # Stage params enter the pp-manual region split on their stacked
+        # leading dim (dense: [L] -> [L/n]; moe: [n_stages] -> [1]); their
+        # trailing fsdp/tp shardings stay automatic.  final_norm / lm_head
+        # ride in by closure as fully-auto values.
+        stage_params = {k: params[k] for k in _STAGE_KEYS}
+        stage_params["moe"] = params["moe"]
+        in_stage_specs = {k: P("pp") for k in _STAGE_KEYS}
+        in_stage_specs["moe"] = jax.tree.map(lambda _: P("pp"),
+                                             params["moe"])
+        return jax.shard_map(
+            pp_region,
+            mesh=mesh,
+            in_specs=(in_stage_specs, P(), P()),
+            out_specs=P(),
+            axis_names=frozenset({"pp"}),
+            check_vma=False,
+        )(stage_params, x, targets)
+
+    def step(params, opt_state, tokens):
+        import optax
+
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    return step

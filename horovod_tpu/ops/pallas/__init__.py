@@ -1,0 +1,13 @@
+"""Hand-written Pallas TPU kernels for the framework's hot ops.
+
+XLA fusion covers most of the elementwise/matmul pipeline; these kernels
+cover the patterns XLA does not schedule optimally by itself (blockwise
+attention with online softmax).
+"""
+
+from horovod_tpu.ops.pallas.flash_attention import (
+    flash_attention,
+    flash_attn_fn,
+)
+
+__all__ = ["flash_attention", "flash_attn_fn"]

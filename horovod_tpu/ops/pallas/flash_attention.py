@@ -1,0 +1,184 @@
+"""Pallas TPU flash-attention kernel.
+
+The hot op of the transformer path.  Blockwise online-softmax attention with
+the canonical TPU schedule: grid (batch, q-head, q-block, kv-block) with the
+kv-block dimension innermost, so the fp32 accumulator and running max/sum
+live in VMEM scratch across the kv sweep and the output block is written
+once at the end — O(block_q x block_k) VMEM instead of O(T²).
+
+GQA maps query head ``h`` to kv head ``h // (Hq//Hkv)`` in the BlockSpec
+index maps, so K/V blocks are fetched once per kv head group.
+
+The causal mask is computed from global positions ``q_start + i`` /
+``k_start + j``, making the kernel directly usable as the per-step block
+compute of ring attention (each ring hop presents a contiguous KV block with
+a rotating global offset).
+
+Backward: recompute-based ``jax.custom_vjp`` — the VJP replays the
+blockwise reference implementation (``lax.scan`` over KV blocks) under
+autodiff, giving exact gradients with blockwise memory; the Pallas kernel
+accelerates the forward (and inference).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from horovod_tpu.parallel.ring_attention import local_flash_attention
+
+_MASK = -1.0e30
+
+
+def _fa_kernel(qs_ref, ks_ref, q_ref, k_ref, v_ref, o_ref,
+               acc_ref, m_ref, l_ref, *, scale, causal, block_q, block_k):
+    from jax.experimental import pallas as pl
+
+    j = pl.program_id(3)
+    nj = pl.num_programs(3)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _MASK)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    q = q_ref[0, 0].astype(jnp.float32)                   # [bq, Dh]
+    k = k_ref[0, 0].astype(jnp.float32)                   # [bk, Dh]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale       # [bq, bk]
+
+    if causal:
+        i = pl.program_id(2)
+        qpos = qs_ref[0] + i * block_q + lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        kpos = ks_ref[0] + j * block_k + lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(kpos <= qpos, s, _MASK)
+
+    m_prev = m_ref[:, 0:1]                                # [bq, 1]
+    l_prev = l_ref[:, 0:1]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+    # zero masked entries explicitly: a fully-masked row keeps m == _MASK
+    # and exp(s - m) would be 1, not 0
+    p = jnp.exp(s - m_new) * (s > 0.5 * _MASK)            # [bq, bk]
+    corr = jnp.exp(m_prev - m_new)                        # [bq, 1]
+    l_new = l_prev * corr + jnp.sum(p, axis=1, keepdims=True)
+    v = v_ref[0, 0].astype(jnp.float32)                   # [bk, Dh]
+    pv = jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)               # [bq, Dh]
+    acc_ref[:] = acc_ref[:] * corr + pv
+    m_ref[:, 0:1] = m_new
+    l_ref[:, 0:1] = l_new
+
+    @pl.when(j == nj - 1)
+    def _finalize():
+        o_ref[0, 0] = (acc_ref[:] /
+                       jnp.maximum(l_ref[:, 0:1], 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_fwd_pallas(q, k, v, q_start, k_start, causal, block_q, block_k,
+                      interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, T, Hq, Dh = q.shape
+    S, Hkv = k.shape[1], k.shape[2]
+    G = Hq // Hkv
+    bq = min(block_q, T)
+    bk = min(block_k, S)
+    if T % bq or S % bk:
+        raise ValueError(f"seq lens ({T},{S}) not divisible by blocks ({bq},{bk})")
+    scale = float(1.0 / (Dh ** 0.5))
+
+    qt = jnp.moveaxis(q, 2, 1)                            # [B, Hq, T, Dh]
+    kt = jnp.moveaxis(k, 2, 1)                            # [B, Hkv, S, Dh]
+    vt = jnp.moveaxis(v, 2, 1)
+
+    kernel = functools.partial(_fa_kernel, scale=scale, causal=causal,
+                               block_q=bq, block_k=bk)
+    grid = (B, Hq, T // bq, S // bk)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),        # q_start [1]
+            pl.BlockSpec(memory_space=pltpu.SMEM),        # k_start [1]
+            pl.BlockSpec((1, 1, bq, Dh), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bk, Dh), lambda b, h, i, j: (b, h // G, j, 0)),
+            pl.BlockSpec((1, 1, bk, Dh), lambda b, h, i, j: (b, h // G, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, bq, Dh), lambda b, h, i, j: (b, h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, Hq, T, Dh), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((bq, Dh), jnp.float32),            # acc
+            pltpu.VMEM((bq, 128), jnp.float32),           # running max
+            pltpu.VMEM((bq, 128), jnp.float32),           # running sum
+        ],
+        interpret=interpret,
+    )(jnp.asarray([q_start], jnp.int32), jnp.asarray([k_start], jnp.int32),
+      qt, kt, vt)
+    return jnp.moveaxis(out, 1, 2)                        # [B, T, Hq, Dh]
+
+
+def _reference(q, k, v, q_start, k_start, causal, block_k):
+    T, S = q.shape[1], k.shape[1]
+    qpos = q_start + jnp.arange(T, dtype=jnp.int32)
+    kpos = k_start + jnp.arange(S, dtype=jnp.int32)
+    return local_flash_attention(q, k, v, qpos, kpos, causal=causal,
+                                 block_size=min(block_k, S))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def flash_attention(q, k, v, q_start=0, k_start=0, causal=True,
+                    block_q=128, block_k=128, interpret=False):
+    """Flash attention.  ``q``: [B, T, Hq, Dh]; ``k``/``v``: [B, S, Hkv, Dh]
+    (GQA when Hkv < Hq).  ``q_start``/``k_start`` are the global positions of
+    the first query/key (for sequence-sharded blocks); causal masking uses
+    global positions.  Returns [B, T, Hq, Dh] in ``q.dtype``.
+
+    ``interpret=True`` runs the kernel in the Pallas interpreter (CPU
+    testing).
+    """
+    return _flash_fwd_pallas(q, k, v, q_start, k_start, causal,
+                             block_q, block_k, interpret)
+
+
+def _fwd(q, k, v, q_start, k_start, causal, block_q, block_k, interpret):
+    out = _flash_fwd_pallas(q, k, v, q_start, k_start, causal,
+                            block_q, block_k, interpret)
+    return out, (q, k, v, q_start, k_start)
+
+
+def _bwd(causal, block_q, block_k, interpret, res, g):
+    q, k, v, q_start, k_start = res
+    _, vjp = jax.vjp(
+        lambda q, k, v: _reference(q, k, v, q_start, k_start, causal, block_k),
+        q, k, v)
+    dq, dk, dv = vjp(g.astype(q.dtype))
+    return dq, dk, dv, None, None
+
+
+flash_attention.defvjp(_fwd, _bwd)
+
+
+def flash_attn_fn(causal: bool = True, block_q: int = 128,
+                  block_k: int = 128, interpret: bool = False):
+    """Adapter producing the ``attn_fn(q, k, v, positions)`` callback used by
+    :func:`horovod_tpu.models.llama.apply`.  ``positions`` must be a
+    contiguous range (the model's default); its first element is the global
+    offset."""
+
+    def attn_fn(q, k, v, positions):
+        start = positions[0]
+        out = flash_attention(q, k, v, start, start, causal,
+                              block_q, block_k, interpret)
+        B, T, Hq, Dh = out.shape
+        return out.reshape(B, T, Hq * Dh)
+
+    return attn_fn

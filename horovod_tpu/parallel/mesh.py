@@ -54,19 +54,9 @@ class MeshSpec:
         return {a: getattr(self, a) for a in AXIS_ORDER}
 
     def build(self, devices: Sequence | None = None):
-        import jax
-        from jax.sharding import Mesh
+        from horovod_tpu.utils.topo import make_mesh as _topo_make_mesh
 
-        if devices is None:
-            devices = jax.devices()
-        if len(devices) < self.size:
-            raise ValueError(
-                f"mesh spec needs {self.size} devices "
-                f"({self.axis_sizes()}), only {len(devices)} available"
-            )
-        shape = tuple(self.axis_sizes().values())
-        arr = np.array(devices[: self.size]).reshape(shape)
-        return Mesh(arr, AXIS_ORDER)
+        return _topo_make_mesh(self.axis_sizes(), devices)
 
 
 def auto_spec(n_devices: int, *, pp: int = 1, sp: int = 1, tp: int = 1,

@@ -224,8 +224,8 @@ def make_ring_attn_fn(axis_name: str, mode: str = "ring"):
     return attn_fn
 
 
-def sequence_parallel_attn_fn(mesh, axis_name: str = "sp",
-                              mode: str = "ring", batch_axes=("dp", "fsdp")):
+def sequence_parallel_attn_fn(mesh=None, axis_name: str = "sp",
+                              mode: str = "ring"):
     """Attention callback for ``llama.apply`` that runs **inside a normal
     GSPMD ``jit``**: only ``axis_name`` goes manual (shard_map with
     ``axis_names={axis_name}``); every other mesh axis (fsdp/tp/dp) stays
@@ -233,24 +233,25 @@ def sequence_parallel_attn_fn(mesh, axis_name: str = "sp",
     around the manual ring.
 
     This is the mixed auto/manual composition that lets one train step carry
-    dp x fsdp x tp x sp simultaneously.
+    dp x fsdp x tp x sp simultaneously.  Pass ``mesh=None`` when calling from
+    inside another manual region (e.g. a pipeline stage): the shard_map then
+    binds to the context mesh, which is required for nesting.
     """
     import jax
     from jax.sharding import PartitionSpec as P
 
-    del batch_axes  # batch/model axes stay automatic; only specs over the
-    # manual axis are allowed (and needed) in a partial-manual shard_map
     inner = make_ring_attn_fn(axis_name, mode)
 
     def attn_fn(q, k, v, positions):
+        kwargs = {} if mesh is None else {"mesh": mesh}
         f = jax.shard_map(
             lambda q, k, v, p: inner(q, k, v, p),
-            mesh=mesh,
             in_specs=(P(None, axis_name), P(None, axis_name),
                       P(None, axis_name), P(axis_name)),
             out_specs=P(None, axis_name),
             axis_names=frozenset({axis_name}),
             check_vma=False,
+            **kwargs,
         )
         return f(q, k, v, positions)
 

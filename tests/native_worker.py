@@ -135,6 +135,41 @@ def scenario_stall():
     print(f"rank {r}: stall OK", flush=True)
 
 
+def scenario_timeline():
+    """Fused + unfused ops with HOROVOD_TIMELINE set; the test asserts on
+    the rank-0 trace file after exit."""
+    hvd.init()
+    r = hvd.rank()
+    handles = [
+        hvd.allreduce_async(np.full(4, float(r + i), np.float32),
+                            name=f"grad{i}")
+        for i in range(8)
+    ]
+    for h in handles:
+        hvd.synchronize(h)
+    hvd.allgather(np.full((r + 1,), r, np.int32), name="gat")
+    hvd.broadcast(np.arange(3, dtype=np.float32), root_rank=0, name="bc")
+    hvd.shutdown()  # finalizes the timeline file
+    print(f"rank {r}: timeline OK")
+
+
+def scenario_autotune():
+    """Sustained allreduce traffic so the coordinator's parameter manager
+    takes several tuning steps (accelerated via env knobs set by the test)."""
+    hvd.init()
+    r = hvd.rank()
+    for step in range(60):
+        handles = [
+            hvd.allreduce_async(np.full(256, float(r + i), np.float32),
+                                name=f"s{step}.g{i}")
+            for i in range(4)
+        ]
+        for h in handles:
+            hvd.synchronize(h)
+    hvd.shutdown()
+    print(f"rank {r}: autotune OK")
+
+
 def scenario_crash():
     hvd.init()
     if hvd.rank() == 1:
