@@ -1,0 +1,230 @@
+"""High-level training API — the TPU-native analog of the reference's Keras
+frontends (``/root/reference/horovod/keras/__init__.py``,
+``horovod/_keras/__init__.py``): a distributed optimizer factory, a minimal
+``fit``-style loop the callbacks hook into, and checkpoint save/load that
+round-trips the optimizer state (the reference's ``load_model`` re-wrapping,
+``_keras/__init__.py:93-109``).
+
+The loop's step is a single jitted function, so everything inside (loss,
+grads, allreduce, update) compiles onto the TPU; callbacks run between
+steps on the host.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, Sequence
+
+import numpy as np
+
+from horovod_tpu.keras import callbacks as callbacks_lib
+from horovod_tpu.keras.callbacks import (
+    BroadcastGlobalVariablesCallback,
+    Callback,
+    LearningRateScheduleCallback,
+    LearningRateWarmupCallback,
+    MetricAverageCallback,
+)
+
+
+def create_distributed_optimizer(opt_factory: Callable[..., Any],
+                                 learning_rate: float,
+                                 axis_name: str | None = "hvd",
+                                 compression=None,
+                                 backward_passes_per_step: int = 1,
+                                 **opt_kwargs):
+    """Build ``opt_factory(learning_rate=..., **kwargs)`` with LR (and
+    momentum, if the factory takes one) exposed as runtime-adjustable
+    hyperparameters, wrapped so gradients are allreduced first — the analog
+    of the reference's ``create_distributed_optimizer``
+    (``_keras/__init__.py:20-70``) where the LR schedule callbacks need
+    ``optimizer.lr`` to be assignable.
+
+    Example::
+
+        opt = create_distributed_optimizer(optax.sgd, 0.1 * hvd.size(),
+                                           momentum=0.9, axis_name="dp")
+    """
+    import optax
+
+    from horovod_tpu.compression import Compression
+    import horovod_tpu.jax as hvd_jax
+
+    injected = optax.inject_hyperparams(opt_factory)(
+        learning_rate=learning_rate, **opt_kwargs)
+    return hvd_jax.DistributedOptimizer(
+        injected, axis_name=axis_name,
+        compression=compression or Compression.none,
+        backward_passes_per_step=backward_passes_per_step)
+
+
+def _hyperparams(opt_state):
+    """Locate the inject_hyperparams dict inside an optax state tree."""
+    if hasattr(opt_state, "hyperparams"):
+        return opt_state.hyperparams
+    if isinstance(opt_state, (tuple, list)):
+        for s in opt_state:
+            h = _hyperparams(s)
+            if h is not None:
+                return h
+    inner = getattr(opt_state, "inner_opt_state", None)
+    if inner is not None:
+        return _hyperparams(inner)
+    return None
+
+
+class Trainer:
+    """Minimal keras-like fit loop over a jitted train step.
+
+    Args:
+      loss_fn: ``(params, batch) -> scalar loss`` (pure; jit-compiled).
+      params: initial parameter pytree.
+      optimizer: an ``optax.GradientTransformation`` — typically from
+        :func:`create_distributed_optimizer` so LR callbacks can steer it.
+      axis_name: SPMD axis for in-step metrics psum, or None for the eager
+        engine path (metrics averaged by MetricAverageCallback instead).
+    """
+
+    def __init__(self, loss_fn, params, optimizer, donate: bool = True):
+        import jax
+
+        self.params = params
+        self.optimizer = optimizer
+        self.opt_state = optimizer.init(params)
+        self.steps_per_epoch: int | None = None
+        self.stop_training = False
+
+        def step(params, opt_state, batch):
+            import optax
+
+            loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        self._step = jax.jit(
+            step, donate_argnums=(0, 1) if donate else ())
+
+    # -- LR / momentum control for schedule callbacks ----------------------
+    @property
+    def lr(self) -> float:
+        h = _hyperparams(self.opt_state)
+        if h is None or "learning_rate" not in h:
+            raise AttributeError(
+                "optimizer has no adjustable learning_rate; build it with "
+                "create_distributed_optimizer / optax.inject_hyperparams")
+        return float(h["learning_rate"])
+
+    @lr.setter
+    def lr(self, value: float) -> None:
+        h = _hyperparams(self.opt_state)
+        if h is None or "learning_rate" not in h:
+            raise AttributeError("optimizer has no adjustable learning_rate")
+        import jax.numpy as jnp
+
+        h["learning_rate"] = jnp.asarray(value, jnp.asarray(
+            h["learning_rate"]).dtype)
+
+    @property
+    def momentum(self) -> float | None:
+        h = _hyperparams(self.opt_state)
+        if h is None or "momentum" not in h:
+            return None
+        return float(h["momentum"])
+
+    @momentum.setter
+    def momentum(self, value: float) -> None:
+        h = _hyperparams(self.opt_state)
+        if h is None or "momentum" not in h:
+            raise AttributeError("optimizer has no adjustable momentum")
+        import jax.numpy as jnp
+
+        h["momentum"] = jnp.asarray(value, jnp.asarray(h["momentum"]).dtype)
+
+    # -- fit ---------------------------------------------------------------
+    def fit(self, batches: Sequence | Iterable, epochs: int = 1,
+            callbacks: Sequence[Callback] = (), verbose: bool = False):
+        """Run ``epochs`` passes over ``batches`` (a sequence, re-iterated
+        per epoch).  Returns the history: list of per-epoch logs dicts."""
+        callbacks = list(callbacks)
+        for cb in callbacks:
+            cb.set_trainer(self)
+        if hasattr(batches, "__len__"):
+            self.steps_per_epoch = len(batches)
+        history = []
+        for cb in callbacks:
+            cb.on_train_begin()
+        for epoch in range(epochs):
+            if self.stop_training:
+                break
+            for cb in callbacks:
+                cb.on_epoch_begin(epoch)
+            losses = []
+            for i, batch in enumerate(batches):
+                for cb in callbacks:
+                    cb.on_batch_begin(i)
+                self.params, self.opt_state, loss = self._step(
+                    self.params, self.opt_state, batch)
+                losses.append(loss)
+                for cb in callbacks:
+                    cb.on_batch_end(i)
+            logs = {"loss": float(np.mean([float(l) for l in losses]))}
+            for cb in callbacks:
+                cb.on_epoch_end(epoch, logs)
+            history.append(logs)
+            if verbose:
+                print(f"epoch {epoch}: " +
+                      " ".join(f"{k}={v:.5g}" for k, v in logs.items()))
+        for cb in callbacks:
+            cb.on_train_end()
+        return history
+
+
+# ---------------------------------------------------------------------------
+# checkpointing (the reference's load_model optimizer round-trip)
+# ---------------------------------------------------------------------------
+
+def save_model(path: str, params, opt_state) -> None:
+    """Checkpoint params + optimizer state with orbax.  Call on rank 0 only
+    (the reference's documented convention, README.md:113-115)."""
+    import jax
+    import orbax.checkpoint as ocp
+
+    with ocp.PyTreeCheckpointer() as ckptr:
+        ckptr.save(path, {"params": params,
+                          "opt_state": _to_pure_tree(opt_state)})
+
+
+def load_model(path: str, params_like, optimizer):
+    """Restore (params, opt_state).  ``optimizer`` is re-wrapped around the
+    restored state: its ``init`` rebuilds the state *structure* and the
+    saved leaves are poured back in — the analog of the reference
+    re-instantiating wrapped optimizers on ``load_model``."""
+    import jax
+    import orbax.checkpoint as ocp
+
+    opt_state_like = optimizer.init(params_like)
+    with ocp.PyTreeCheckpointer() as ckptr:
+        restored = ckptr.restore(path)
+    params = jax.tree.unflatten(
+        jax.tree.structure(params_like),
+        jax.tree.leaves(restored["params"]))
+    opt_state = jax.tree.unflatten(
+        jax.tree.structure(opt_state_like),
+        jax.tree.leaves(restored["opt_state"]))
+    return params, opt_state
+
+
+def _to_pure_tree(tree):
+    """Structure-preserving conversion to plain containers for orbax."""
+    import jax
+
+    leaves, _ = jax.tree.flatten(tree)
+    return leaves
+
+
+__all__ = [
+    "Trainer", "create_distributed_optimizer",
+    "save_model", "load_model",
+    "Callback", "BroadcastGlobalVariablesCallback", "MetricAverageCallback",
+    "LearningRateScheduleCallback", "LearningRateWarmupCallback",
+    "callbacks_lib",
+]

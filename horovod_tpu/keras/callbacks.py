@@ -1,0 +1,165 @@
+"""Training-loop callbacks — the TPU-native analog of the reference's Keras
+callback suite (``/root/reference/horovod/_keras/callbacks.py``).
+
+The reference hooks Keras's fit loop; here the same four behaviors hook
+:class:`horovod_tpu.keras.Trainer` (a minimal fit loop over a jitted step):
+
+* :class:`BroadcastGlobalVariablesCallback` — start-of-training consistency
+  (reference ``callbacks.py:20-30``).
+* :class:`MetricAverageCallback` — epoch metrics averaged across workers
+  (reference ``callbacks.py:33-67``).
+* :class:`LearningRateScheduleCallback` / :class:`LearningRateWarmupCallback`
+  — LR scaling schedule with momentum correction (reference
+  ``callbacks.py:70-168``; warmup rule from the "Accurate, Large Minibatch
+  SGD" recipe).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import numpy as np
+
+
+class Callback:
+    """Base callback; the trainer is attached before on_train_begin."""
+
+    trainer: Any = None
+
+    def set_trainer(self, trainer) -> None:
+        self.trainer = trainer
+
+    def on_train_begin(self, logs=None): ...
+    def on_train_end(self, logs=None): ...
+    def on_epoch_begin(self, epoch, logs=None): ...
+    def on_epoch_end(self, epoch, logs=None): ...
+    def on_batch_begin(self, batch, logs=None): ...
+    def on_batch_end(self, batch, logs=None): ...
+
+
+class BroadcastGlobalVariablesCallback(Callback):
+    """Broadcast parameters AND optimizer state from ``root_rank`` to every
+    process when training begins, so all workers start identical (fresh
+    start or checkpoint restore)."""
+
+    def __init__(self, root_rank: int = 0):
+        self.root_rank = root_rank
+
+    def on_train_begin(self, logs=None):
+        import horovod_tpu.jax as hvd
+
+        self.trainer.params = hvd.broadcast_parameters(
+            self.trainer.params, self.root_rank)
+        self.trainer.opt_state = hvd.broadcast_optimizer_state(
+            self.trainer.opt_state, self.root_rank)
+
+
+class MetricAverageCallback(Callback):
+    """Average epoch metrics over all workers in place (sorted by name for
+    cross-rank op-ordering consistency, like the reference)."""
+
+    def on_epoch_end(self, epoch, logs=None):
+        if not logs:
+            return
+        import horovod_tpu as hvd
+
+        for metric in sorted(logs):
+            value = logs[metric]
+            if isinstance(value, (int, float, np.floating, np.integer)):
+                logs[metric] = float(hvd.allreduce(
+                    np.asarray(float(value)), average=True,
+                    name=f"metric.{metric}"))
+
+
+class LearningRateScheduleCallback(Callback):
+    """Multiply the base LR by ``multiplier(epoch)`` within
+    [start_epoch, end_epoch); non-staircase mode interpolates within the
+    epoch.  ``momentum_correction`` rescales momentum by new_lr/old_lr for
+    the adjusted batch and restores it after (the large-minibatch SGD
+    momentum fix)."""
+
+    def __init__(self, multiplier, start_epoch: int = 0,
+                 end_epoch: int | None = None, staircase: bool = True,
+                 momentum_correction: bool = True,
+                 steps_per_epoch: int | None = None):
+        if not callable(multiplier):
+            staircase = True
+            const = float(multiplier)
+            multiplier = lambda epoch: const  # noqa: E731
+        self.multiplier = multiplier
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+        self.staircase = staircase
+        self.momentum_correction = momentum_correction
+        self.steps_per_epoch = steps_per_epoch
+        self.initial_lr = None
+        self.current_epoch = 0
+        self._restore_momentum = None
+
+    def on_train_begin(self, logs=None):
+        self.initial_lr = self.trainer.lr
+        if not self.staircase and not self.steps_per_epoch:
+            self.steps_per_epoch = self.trainer.steps_per_epoch
+            if not self.steps_per_epoch:
+                raise ValueError(
+                    "steps_per_epoch is required for non-staircase LR "
+                    "schedules (could not autodetect from the trainer)")
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.current_epoch = epoch
+
+    def _adjust(self, epoch_float):
+        old_lr = self.trainer.lr
+        new_lr = self.initial_lr * self.multiplier(epoch_float)
+        self.trainer.lr = new_lr
+        if self.momentum_correction and self.trainer.momentum is not None \
+                and old_lr > 0:
+            self._restore_momentum = self.trainer.momentum
+            self.trainer.momentum = self._restore_momentum * new_lr / old_lr
+
+    def on_batch_begin(self, batch, logs=None):
+        if (self.current_epoch < self.start_epoch or
+                (self.end_epoch is not None and
+                 self.current_epoch >= self.end_epoch)):
+            return
+        if self.staircase and batch == 0:
+            self._adjust(self.current_epoch)
+        elif not self.staircase:
+            self._adjust(self.current_epoch + batch / self.steps_per_epoch)
+
+    def on_batch_end(self, batch, logs=None):
+        if self._restore_momentum is not None:
+            self.trainer.momentum = self._restore_momentum
+            self._restore_momentum = None
+
+    def on_epoch_end(self, epoch, logs=None):
+        if logs is not None:
+            logs["lr"] = self.trainer.lr
+
+
+class LearningRateWarmupCallback(LearningRateScheduleCallback):
+    """Gradually scale LR from 1x to size() x over ``warmup_epochs`` —
+    ``lr = initial * (1/size) * (epoch*(size-1)/warmup + 1)`` (reference
+    ``callbacks.py:149-168``).  Pair with a base LR already scaled by
+    ``size()``."""
+
+    def __init__(self, warmup_epochs: int = 5, momentum_correction: bool = True,
+                 steps_per_epoch: int | None = None, verbose: int = 0):
+        import horovod_tpu as hvd
+
+        def multiplier(epoch):
+            epoch += 1.0 / (self.steps_per_epoch or 1)
+            size = hvd.size()
+            return 1.0 / size * (epoch * (size - 1) / warmup_epochs + 1)
+
+        super().__init__(multiplier, start_epoch=0, end_epoch=warmup_epochs,
+                         staircase=False,
+                         momentum_correction=momentum_correction,
+                         steps_per_epoch=steps_per_epoch)
+        self.verbose = verbose
+
+    def on_epoch_end(self, epoch, logs=None):
+        super().on_epoch_end(epoch, logs)
+        if epoch == (self.end_epoch or 0) - 1 and self.verbose:
+            print(f"\nEpoch {epoch + 1}: finished gradual learning rate "
+                  f"warmup to {self.trainer.lr:g}.")

@@ -1,0 +1,115 @@
+"""High-level (keras-analog) API tests: trainer loop, LR schedule/warmup
+callbacks with momentum correction, metric averaging, checkpoint round-trip.
+Mirrors the reference's test/test_keras.py coverage areas."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+import horovod_tpu.keras as hvd_keras
+from horovod_tpu.keras import (
+    BroadcastGlobalVariablesCallback,
+    LearningRateScheduleCallback,
+    LearningRateWarmupCallback,
+    MetricAverageCallback,
+    Trainer,
+    create_distributed_optimizer,
+)
+
+
+def _linear_problem(seed=0, n=64, d=4):
+    rng = np.random.RandomState(seed)
+    W = rng.randn(d, 1).astype(np.float32)
+    X = rng.randn(n, d).astype(np.float32)
+    y = X @ W
+    params = {"w": jnp.zeros((d, 1), jnp.float32)}
+
+    def loss_fn(params, batch):
+        xb, yb = batch
+        return jnp.mean((xb @ params["w"] - yb) ** 2)
+
+    batches = [(jnp.asarray(X[i:i + 16]), jnp.asarray(y[i:i + 16]))
+               for i in range(0, n, 16)]
+    return params, loss_fn, batches
+
+
+def test_trainer_fits(hvd_single):
+    params, loss_fn, batches = _linear_problem()
+    opt = create_distributed_optimizer(optax.sgd, 0.1, axis_name=None)
+    trainer = Trainer(loss_fn, params, opt)
+    history = trainer.fit(batches, epochs=20)
+    assert history[-1]["loss"] < history[0]["loss"] * 0.01
+
+
+def test_lr_schedule_staircase(hvd_single):
+    params, loss_fn, batches = _linear_problem()
+    opt = create_distributed_optimizer(optax.sgd, 0.1, axis_name=None)
+    trainer = Trainer(loss_fn, params, opt)
+    cb = LearningRateScheduleCallback(
+        multiplier=lambda epoch: 0.5 ** epoch, momentum_correction=False)
+    history = trainer.fit(batches, epochs=3, callbacks=[cb])
+    # logged lr follows initial_lr * 0.5^epoch
+    assert history[0]["lr"] == pytest.approx(0.1, rel=1e-5)
+    assert history[1]["lr"] == pytest.approx(0.05, rel=1e-5)
+    assert history[2]["lr"] == pytest.approx(0.025, rel=1e-5)
+
+
+def test_lr_warmup_reaches_base(hvd_single):
+    """At size 1 the warmup multiplier is identically 1 — lr stays at base
+    (the reference's formula collapses to 1/1*(...*0+1))."""
+    params, loss_fn, batches = _linear_problem()
+    opt = create_distributed_optimizer(optax.sgd, 0.2, axis_name=None,
+                                       momentum=0.9)
+    trainer = Trainer(loss_fn, params, opt)
+    cb = LearningRateWarmupCallback(warmup_epochs=2)
+    history = trainer.fit(batches, epochs=3, callbacks=[cb])
+    for h in history:
+        assert h["lr"] == pytest.approx(0.2, rel=1e-5)
+
+
+def test_momentum_correction_restores(hvd_single):
+    params, loss_fn, batches = _linear_problem()
+    opt = create_distributed_optimizer(optax.sgd, 0.1, axis_name=None,
+                                       momentum=0.9)
+    trainer = Trainer(loss_fn, params, opt)
+    cb = LearningRateScheduleCallback(multiplier=0.5,
+                                      momentum_correction=True)
+    trainer.fit(batches, epochs=1, callbacks=[cb])
+    # after the epoch, momentum must be restored to its configured value
+    assert trainer.momentum == pytest.approx(0.9, rel=1e-5)
+    assert trainer.lr == pytest.approx(0.05, rel=1e-5)
+
+
+def test_metric_average_and_broadcast(hvd_single):
+    params, loss_fn, batches = _linear_problem()
+    opt = create_distributed_optimizer(optax.sgd, 0.1, axis_name=None)
+    trainer = Trainer(loss_fn, params, opt)
+    history = trainer.fit(
+        batches, epochs=1,
+        callbacks=[BroadcastGlobalVariablesCallback(0),
+                   MetricAverageCallback()])
+    # size-1 world: averaging is identity, broadcast is identity — the point
+    # is the full callback path runs against the engine
+    assert np.isfinite(history[0]["loss"])
+
+
+def test_checkpoint_roundtrip(hvd_single, tmp_path):
+    params, loss_fn, batches = _linear_problem()
+    opt = create_distributed_optimizer(optax.adam, 0.05, axis_name=None)
+    trainer = Trainer(loss_fn, params, opt)
+    trainer.fit(batches, epochs=5)
+    path = str(tmp_path / "ckpt")
+    hvd_keras.save_model(path, trainer.params, trainer.opt_state)
+
+    params2, opt_state2 = hvd_keras.load_model(
+        path, params_like=params, optimizer=opt)
+    np.testing.assert_allclose(np.asarray(params2["w"]),
+                               np.asarray(trainer.params["w"]))
+    # resumed training continues from the restored optimizer state
+    trainer2 = Trainer(loss_fn, params2, opt)
+    trainer2.opt_state = opt_state2
+    h = trainer2.fit(batches, epochs=1)
+    assert np.isfinite(h[0]["loss"])
