@@ -80,8 +80,8 @@ class Trainer:
       params: initial parameter pytree.
       optimizer: an ``optax.GradientTransformation`` — typically from
         :func:`create_distributed_optimizer` so LR callbacks can steer it.
-      axis_name: SPMD axis for in-step metrics psum, or None for the eager
-        engine path (metrics averaged by MetricAverageCallback instead).
+      donate: donate params/opt_state buffers to the jitted step (saves a
+        copy per step; disable when the caller aliases them elsewhere).
     """
 
     def __init__(self, loss_fn, params, optimizer, donate: bool = True):
@@ -147,8 +147,12 @@ class Trainer:
         callbacks = list(callbacks)
         for cb in callbacks:
             cb.set_trainer(self)
-        if hasattr(batches, "__len__"):
-            self.steps_per_epoch = len(batches)
+        if not hasattr(batches, "__len__"):
+            # a one-shot iterator would silently train only epoch 0
+            batches = list(batches)
+        if len(batches) == 0:
+            raise ValueError("fit() got an empty batch sequence")
+        self.steps_per_epoch = len(batches)
         history = []
         for cb in callbacks:
             cb.on_train_begin()
@@ -185,12 +189,12 @@ class Trainer:
 def save_model(path: str, params, opt_state) -> None:
     """Checkpoint params + optimizer state with orbax.  Call on rank 0 only
     (the reference's documented convention, README.md:113-115)."""
-    import jax
     import orbax.checkpoint as ocp
 
     with ocp.PyTreeCheckpointer() as ckptr:
         ckptr.save(path, {"params": params,
-                          "opt_state": _to_pure_tree(opt_state)})
+                          "opt_state": _to_pure_tree(opt_state),
+                          "opt_state_sig": _state_signature(opt_state)})
 
 
 def load_model(path: str, params_like, optimizer):
@@ -204,6 +208,13 @@ def load_model(path: str, params_like, optimizer):
     opt_state_like = optimizer.init(params_like)
     with ocp.PyTreeCheckpointer() as ckptr:
         restored = ckptr.restore(path)
+    saved_sig = restored.get("opt_state_sig")
+    want_sig = _state_signature(opt_state_like)
+    if saved_sig is not None and saved_sig != want_sig:
+        raise ValueError(
+            "checkpoint optimizer state does not match the optimizer passed "
+            f"to load_model:\n  saved:    {saved_sig}\n  expected: {want_sig}"
+        )
     params = jax.tree.unflatten(
         jax.tree.structure(params_like),
         jax.tree.leaves(restored["params"]))
@@ -214,11 +225,26 @@ def load_model(path: str, params_like, optimizer):
 
 
 def _to_pure_tree(tree):
-    """Structure-preserving conversion to plain containers for orbax."""
+    """Flatten to a leaf list for orbax (the treedef itself contains optax
+    namedtuples orbax cannot serialize); the structure is fingerprinted
+    separately by ``_state_signature`` and checked on restore."""
     import jax
 
     leaves, _ = jax.tree.flatten(tree)
     return leaves
+
+
+def _state_signature(tree) -> str:
+    """Structure fingerprint: treedef repr + per-leaf shape/dtype, so a
+    checkpoint cannot be silently poured into a mismatched optimizer."""
+    import jax
+    import numpy as _np
+
+    leaves, treedef = jax.tree.flatten(tree)
+    shapes = ";".join(
+        f"{_np.asarray(l).dtype}{list(_np.asarray(l).shape)}" for l in leaves
+    )
+    return f"{treedef}|{shapes}"
 
 
 __all__ = [

@@ -1,0 +1,210 @@
+"""Driver-side control service for the cluster launcher.
+
+Role analog of ``/root/reference/horovod/spark/driver/driver_service.py``:
+tasks register their addresses and host hash; the driver determines the set
+of routable interfaces per task (reference's ring-ping,
+``/root/reference/horovod/spark/__init__.py:33-39,134-140``), groups ranks by
+host hash, and serves the pickled user function to workers (the reference's
+``CodeRequest``).  TPU-first difference: instead of composing an ``mpirun``
+command line, rank assignment feeds the native engine's TCP rendezvous
+(``HOROVOD_TPU_*`` env, ``horovod_tpu/run.py``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Any
+
+from horovod_tpu.spark.util import codec, network
+
+
+@dataclasses.dataclass
+class RegisterTaskRequest:
+    index: int
+    task_addresses: list
+    rendezvous_port: int
+    host_hash: str
+
+
+@dataclasses.dataclass
+class RegisterTaskToTaskAddressesRequest:
+    """After probing its ring-successor, a task reports the subset of the
+    successor's addresses that were actually reachable."""
+    index: int
+    reachable_addresses: list
+
+
+@dataclasses.dataclass
+class AllTaskAddressesRequest:
+    index: int
+
+
+@dataclasses.dataclass
+class AllTaskAddressesResponse:
+    all_task_addresses: list
+
+
+@dataclasses.dataclass
+class CodeRequest:
+    pass
+
+
+@dataclasses.dataclass
+class CodeResponse:
+    """``payload`` is pre-pickled (by-value for user modules) bytes of
+    ``(fn, args, kwargs)`` — see ``codec.dumps_by_value``."""
+    payload: bytes
+
+
+@dataclasses.dataclass
+class ResultRequest:
+    rank: int
+    index: int
+    result: Any
+    error: str | None
+
+
+@dataclasses.dataclass
+class Ack:
+    pass
+
+
+class DriverService(network.BasicService):
+    NAME = "launcher driver service"
+
+    def __init__(self, num_proc: int, key: bytes, fn, args: tuple,
+                 kwargs: dict):
+        super().__init__(self.NAME, key)
+        self._num_proc = num_proc
+        self._code_bytes = codec.dumps_by_value((fn, args, kwargs), fn)
+        self._lock = threading.Condition()
+        self._task_addresses: dict[int, list] = {}
+        self._task_rdv_port: dict[int, int] = {}
+        self._task_host_hash: dict[int, str] = {}
+        self._reachable: dict[int, list] = {}
+        self._results: dict[int, Any] = {}
+        self._errors: dict[int, str] = {}
+        self._ranks: dict[int, int] | None = None  # task index -> rank
+
+    # ---------------------------------------------------------- handlers
+    def handle(self, req, client_address):
+        if isinstance(req, RegisterTaskRequest):
+            with self._lock:
+                # Also record the source IP the driver observed — it is
+                # routable from the driver even if no advertised address is
+                # (NAT'd executors).
+                addrs = list(req.task_addresses)
+                if addrs and client_address[0] not in (a[0] for a in addrs):
+                    addrs.append((client_address[0], addrs[0][1]))
+                self._task_addresses[req.index] = addrs
+                self._task_rdv_port[req.index] = req.rendezvous_port
+                self._task_host_hash[req.index] = req.host_hash
+                self._lock.notify_all()
+            return Ack()
+        if isinstance(req, RegisterTaskToTaskAddressesRequest):
+            with self._lock:
+                self._reachable[req.index] = list(req.reachable_addresses)
+                self._lock.notify_all()
+            return Ack()
+        if isinstance(req, AllTaskAddressesRequest):
+            with self._lock:
+                return AllTaskAddressesResponse(
+                    self._task_addresses.get(req.index, []))
+        if isinstance(req, CodeRequest):
+            return CodeResponse(self._code_bytes)
+        if isinstance(req, ResultRequest):
+            with self._lock:
+                if req.error is not None:
+                    self._errors[req.rank] = req.error
+                else:
+                    self._results[req.rank] = req.result
+                self._lock.notify_all()
+            return Ack()
+        return super().handle(req, client_address)
+
+    # ---------------------------------------------------------- driver API
+    def wait_for_initial_registration(self, timeout) -> None:
+        with self._lock:
+            while len(self._task_addresses) < self._num_proc:
+                timeout.check_time_out_for(
+                    "all launcher tasks to register; confirm the cluster has "
+                    f"{self._num_proc} free slots and that firewalls allow "
+                    "TCP between the driver and executors")
+                self._lock.wait(0.2)
+
+    def wait_for_task_to_task_pings(self, timeout) -> None:
+        with self._lock:
+            while len(self._reachable) < self._num_proc:
+                timeout.check_time_out_for(
+                    "task-to-task interface discovery; executors cannot "
+                    "reach each other's control ports")
+                self._lock.wait(0.2)
+
+    def task_addresses_for(self, index: int) -> list:
+        with self._lock:
+            return list(self._task_addresses[index])
+
+    def task_indices(self) -> list[int]:
+        with self._lock:
+            return sorted(self._task_addresses)
+
+    def set_reachable(self, index: int, addresses: list) -> None:
+        with self._lock:
+            if addresses:
+                self._reachable[index] = list(addresses)
+            self._lock.notify_all()
+
+    def reachable_addresses_for(self, index: int) -> list:
+        with self._lock:
+            return list(self._reachable.get(index) or
+                        self._task_addresses[index])
+
+    def assign_ranks(self) -> dict[int, dict]:
+        """Group tasks by host hash → per-task rank/local/cross assignment.
+
+        Sorted host hashes give every process the same deterministic
+        ordering (analog of ``/root/reference/horovod/spark/__init__.py:
+        134-152``'s hosthash grouping).
+        """
+        with self._lock:
+            by_host: dict[str, list[int]] = {}
+            for idx, hh in sorted(self._task_host_hash.items()):
+                by_host.setdefault(hh, []).append(idx)
+            hosts = sorted(by_host)
+            assignment: dict[int, dict] = {}
+            rank = 0
+            for cross_rank, hh in enumerate(hosts):
+                for local_rank, idx in enumerate(by_host[hh]):
+                    assignment[idx] = {
+                        "rank": rank,
+                        "local_rank": local_rank,
+                        "local_size": len(by_host[hh]),
+                        "cross_rank": cross_rank,
+                        "cross_size": len(hosts),
+                        "size": self._num_proc,
+                    }
+                    rank += 1
+            self._ranks = {i: a["rank"] for i, a in assignment.items()}
+            return assignment
+
+    def rendezvous_address(self, assignment: dict[int, dict]) \
+            -> tuple[str, int]:
+        """(host, port) of rank 0's native-engine rendezvous."""
+        rank0_idx = next(i for i, a in assignment.items() if a["rank"] == 0)
+        ip = self.reachable_addresses_for(rank0_idx)[0][0]
+        return ip, self._task_rdv_port[rank0_idx]
+
+    def wait_for_results(self, timeout) -> dict[int, Any]:
+        with self._lock:
+            while len(self._results) + len(self._errors) < self._num_proc:
+                timeout.check_time_out_for(
+                    "workers to finish; at least one worker neither "
+                    "returned a result nor reported an error")
+                self._lock.wait(0.2)
+            if self._errors:
+                lines = [f"rank {r}: {e}" for r, e in
+                         sorted(self._errors.items())]
+                raise RuntimeError(
+                    "launcher workers failed:\n" + "\n".join(lines))
+            return dict(self._results)

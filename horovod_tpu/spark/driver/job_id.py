@@ -1,0 +1,15 @@
+"""Human-readable unique job ids (role analog of
+``/root/reference/horovod/spark/driver/job_id.py:19-27``)."""
+
+from __future__ import annotations
+
+import os
+import time
+
+
+def job_id() -> str:
+    return f"horovod-tpu.{int(time.time())}.{os.getpid()}"
+
+
+def spark_job_group(jid: str) -> str:
+    return f"horovod_tpu.spark.run.{jid}"

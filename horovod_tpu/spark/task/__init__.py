@@ -1,0 +1,1 @@
+"""Task-side launcher services."""

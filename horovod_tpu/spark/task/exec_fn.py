@@ -1,0 +1,49 @@
+"""Worker entry point: fetch the pickled user fn from the driver and run it.
+
+Role analog of ``/root/reference/horovod/spark/task/mpirun_exec_fn.py``: the
+worker process is started by its TaskService with the full ``HOROVOD_TPU_*``
+rank/rendezvous environment already set; it pulls the function over the
+authenticated control channel (``CodeRequest``) so user code is never baked
+into the command line, runs it, and pushes the result (or traceback) back.
+"""
+
+from __future__ import annotations
+
+import base64
+import os
+import sys
+import traceback
+
+
+def main() -> int:
+    from horovod_tpu.spark.driver import driver_service
+    from horovod_tpu.spark.util import codec, network
+
+    key = base64.b64decode(os.environ["HOROVOD_TPU_LAUNCHER_SECRET"])
+    driver_addresses = codec.loads_base64(
+        os.environ["HOROVOD_TPU_LAUNCHER_DRIVER"])
+    rank = int(os.environ["HOROVOD_TPU_RANK"])
+    index = int(os.environ["HOROVOD_TPU_LAUNCHER_TASK_INDEX"])
+
+    driver = network.BasicClient(driver_service.DriverService.NAME,
+                                 driver_addresses, key)
+    code = driver.request(driver_service.CodeRequest())
+    import cloudpickle
+
+    fn, fn_args, fn_kwargs = cloudpickle.loads(code.payload)
+
+    try:
+        result = fn(*fn_args, **fn_kwargs)
+        err = None
+    except BaseException:
+        result, err = None, traceback.format_exc()
+    driver.request(driver_service.ResultRequest(
+        rank=rank, index=index, result=result, error=err))
+    if err is not None:
+        sys.stderr.write(err)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

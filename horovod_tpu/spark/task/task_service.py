@@ -1,0 +1,122 @@
+"""Per-executor control service.
+
+Role analog of ``/root/reference/horovod/spark/task/task_service.py``: one
+runs inside every placement slot (Spark task, k8s pod, plain SSH session).
+It registers with the driver, answers ring pings from its predecessor task,
+and — on the driver's ``RunCommandRequest`` — spawns the worker subprocess
+through :mod:`safe_shell_exec` so the whole tree dies with the executor.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import socket
+import sys
+import threading
+
+from horovod_tpu.spark.util import network, safe_shell_exec
+
+
+@dataclasses.dataclass
+class RunCommandRequest:
+    command: list
+    env: dict
+
+
+@dataclasses.dataclass
+class ProbeAddressesRequest:
+    """Driver asks this task to probe a peer task's advertised addresses and
+    report which are reachable — task-to-task routability, which the driver
+    cannot establish by probing on its own (NAT, per-subnet firewalls)."""
+    service_name: str
+    addresses: list
+
+
+@dataclasses.dataclass
+class ProbeAddressesResponse:
+    reachable: list
+
+
+@dataclasses.dataclass
+class CommandExitCodeRequest:
+    pass
+
+
+@dataclasses.dataclass
+class CommandExitCodeResponse:
+    terminated: bool
+    exit_code: int | None
+
+
+@dataclasses.dataclass
+class Ack:
+    pass
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("0.0.0.0", 0))
+        return s.getsockname()[1]
+
+
+class TaskService(network.BasicService):
+    NAME_FMT = "launcher task service #%d"
+
+    def __init__(self, index: int, key: bytes):
+        super().__init__(self.NAME_FMT % index, key)
+        self.index = index
+        # Reserved ahead of time so the driver can point every worker at
+        # rank 0's native-engine rendezvous before any worker starts.
+        self.rendezvous_port = free_port()
+        self._lock = threading.Lock()
+        self._exit_code: int | None = None
+        self._command_thread: threading.Thread | None = None
+
+    def handle(self, req, client_address):
+        if isinstance(req, RunCommandRequest):
+            with self._lock:
+                if self._command_thread is None:
+                    self._command_thread = threading.Thread(
+                        target=self._run, args=(req.command, req.env),
+                        daemon=True,
+                    )
+                    self._command_thread.start()
+            return Ack()
+        if isinstance(req, ProbeAddressesRequest):
+            reachable = []
+            for addr in req.addresses:
+                try:
+                    client = network.BasicClient(
+                        req.service_name, [tuple(addr)], self._key,
+                        probe_timeout=2.0, retries=1)
+                    client.request(network.PingRequest(), timeout=2.0)
+                    reachable.append(tuple(addr))
+                except (ConnectionError, OSError):
+                    pass
+            return ProbeAddressesResponse(reachable)
+        if isinstance(req, CommandExitCodeRequest):
+            with self._lock:
+                done = (self._command_thread is not None
+                        and not self._command_thread.is_alive())
+                return CommandExitCodeResponse(done, self._exit_code)
+        return super().handle(req, client_address)
+
+    def _run(self, command: list, env: dict) -> None:
+        import os
+
+        merged = {**os.environ, **{str(k): str(v) for k, v in env.items()}}
+        rc = safe_shell_exec.execute(command, env=merged,
+                                     stdout=sys.stdout, stderr=sys.stderr)
+        with self._lock:
+            self._exit_code = rc
+
+    def wait_for_command_termination(self, poll_s: float = 0.2) -> int:
+        while True:
+            with self._lock:
+                thread = self._command_thread
+            if thread is not None:
+                thread.join()
+                with self._lock:
+                    return self._exit_code if self._exit_code is not None \
+                        else 1
+            threading.Event().wait(poll_s)

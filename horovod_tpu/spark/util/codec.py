@@ -1,0 +1,40 @@
+"""Pickle⇄text codec for embedding callables/addresses in env vars and CLI
+args (role analog of ``/root/reference/horovod/spark/util/codec.py:19-27``)."""
+
+from __future__ import annotations
+
+import base64
+
+import cloudpickle
+
+
+def dumps_base64(obj) -> str:
+    return base64.b64encode(cloudpickle.dumps(obj)).decode("ascii")
+
+
+def loads_base64(encoded: str):
+    return cloudpickle.loads(base64.b64decode(encoded.encode("ascii")))
+
+
+def dumps_by_value(obj, anchor_fn) -> bytes:
+    """Serialize *obj* so workers need neither ``anchor_fn``'s defining
+    module on their ``sys.path`` nor a shared filesystem: if that module
+    isn't an installed package (user scripts, ``__main__``, test modules),
+    register it for cloudpickle's by-value mode for the duration of the
+    dump."""
+    import inspect
+    import sys
+
+    mod = inspect.getmodule(anchor_fn)
+    by_value = (
+        mod is not None
+        and mod.__name__.split(".")[0] not in sys.stdlib_module_names
+        and not mod.__name__.startswith("horovod_tpu")
+    )
+    if by_value:
+        cloudpickle.register_pickle_by_value(mod)
+    try:
+        return cloudpickle.dumps(obj)
+    finally:
+        if by_value:
+            cloudpickle.unregister_pickle_by_value(mod)

@@ -1,0 +1,30 @@
+"""Host identity hashing.
+
+Role analog of ``/root/reference/horovod/spark/util/host_hash.py:24-37``: two
+launcher tasks share a "host" (and therefore a local communicator / shared
+TPU chips) iff their host hash matches.  The hash mixes the hostname with the
+mount + PID namespace ids so two containers on one physical box — which look
+like the same hostname but cannot share memory or chips — hash differently.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import socket
+
+
+def _namespace_ids() -> str:
+    ids = []
+    for ns in ("mnt", "pid"):
+        try:
+            ids.append(os.readlink(f"/proc/self/ns/{ns}"))
+        except OSError:
+            ids.append("")
+    return ",".join(ids)
+
+
+def host_hash() -> str:
+    """Stable per-(host, container) identity string."""
+    payload = f"{socket.gethostname()}-{_namespace_ids()}"
+    return hashlib.md5(payload.encode("utf-8")).hexdigest()

@@ -1,0 +1,235 @@
+"""Authenticated TCP request/response services for the launcher control plane.
+
+Role analog of ``/root/reference/horovod/spark/util/network.py:44-236``: the
+driver and every task each run a tiny threaded TCP server speaking
+length-prefixed cloudpickle messages signed with a per-job HMAC key
+(:mod:`horovod_tpu.spark.util.secret`).  A message whose digest does not
+verify is dropped before unpickling — the port may be reachable by anyone on
+the cluster network, but only holders of the job secret can make the service
+deserialize anything.
+
+TPU-first difference from the reference: these services do not tunnel an
+``orted`` launch; they place and supervise workers that rendezvous with the
+native collective engine (``csrc/engine.cc``) directly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import hmac
+import socket
+import socketserver
+import struct
+import threading
+from typing import Any
+
+import cloudpickle
+
+from horovod_tpu.spark.util import secret
+
+_LEN = struct.Struct(">I")
+_DIGEST_BYTES = hashlib.new(secret.DIGEST_ALGORITHM).digest_size
+_MAX_MESSAGE = 256 << 20
+
+
+class AuthenticationError(Exception):
+    pass
+
+
+def _sign(key: bytes, payload: bytes) -> bytes:
+    return hmac.new(key, payload, secret.DIGEST_ALGORITHM).digest()
+
+
+def write_message(sock: socket.socket, key: bytes, obj: Any) -> None:
+    payload = cloudpickle.dumps(obj)
+    sock.sendall(_LEN.pack(len(payload)) + payload + _sign(key, payload))
+
+
+def _read_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed connection mid-message")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def read_message(sock: socket.socket, key: bytes) -> Any:
+    (length,) = _LEN.unpack(_read_exact(sock, _LEN.size))
+    if length > _MAX_MESSAGE:
+        raise AuthenticationError(f"message length {length} exceeds limit")
+    payload = _read_exact(sock, length)
+    digest = _read_exact(sock, _DIGEST_BYTES)
+    if not hmac.compare_digest(digest, _sign(key, payload)):
+        raise AuthenticationError("HMAC digest mismatch — wrong job secret")
+    return cloudpickle.loads(payload)
+
+
+@dataclasses.dataclass
+class PingRequest:
+    pass
+
+
+@dataclasses.dataclass
+class PingResponse:
+    service_name: str
+    source_address: tuple
+
+
+class BasicService:
+    """Threaded one-request-per-connection TCP service.
+
+    Subclasses override :meth:`handle` and receive already-authenticated,
+    already-unpickled request objects.
+    """
+
+    def __init__(self, name: str, key: bytes):
+        self.name = name
+        self._key = key
+        service = self
+
+        class _Handler(socketserver.BaseRequestHandler):
+            def handle(self) -> None:  # noqa: A003
+                try:
+                    req = read_message(self.request, service._key)
+                except (AuthenticationError, ConnectionError, EOFError):
+                    return
+                try:
+                    resp = service.handle(req, self.client_address)
+                except Exception as e:  # surfaced client-side by request()
+                    resp = e
+                try:
+                    write_message(self.request, service._key, resp)
+                except OSError:
+                    pass
+
+        class _Server(socketserver.ThreadingTCPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self._server = _Server(("0.0.0.0", 0), _Handler)
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name=f"{name}-service",
+            daemon=True,
+        )
+        self._thread.start()
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def addresses(self) -> list[tuple[str, int]]:
+        """All (ip, port) pairs this service is reachable on, one per
+        non-loopback interface (plus loopback as a last resort)."""
+        port = self.port
+        addrs: list[tuple[str, int]] = []
+        for ip in local_addresses():
+            addrs.append((ip, port))
+        return addrs
+
+    def handle(self, req: Any, client_address: tuple) -> Any:
+        if isinstance(req, PingRequest):
+            return PingResponse(self.name, client_address)
+        raise NotImplementedError(
+            f"{self.name}: unhandled request type {type(req).__name__}"
+        )
+
+    def shutdown(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        self._thread.join(timeout=5)
+
+
+class BasicClient:
+    """Client that remembers which of a service's advertised addresses
+    actually answers, trying them in order on first use."""
+
+    def __init__(self, service_name: str, addresses: list[tuple[str, int]],
+                 key: bytes, probe_timeout: float = 5.0,
+                 retries: int = 3):
+        self._service_name = service_name
+        self._key = key
+        self._probe_timeout = probe_timeout
+        self._retries = retries
+        self._good_address: tuple[str, int] | None = None
+        self._addresses = list(addresses)
+        if not self._addresses:
+            raise ValueError(f"no addresses given for {service_name}")
+
+    def _probe(self) -> tuple[str, int]:
+        if self._good_address is not None:
+            return self._good_address
+        last_err: Exception | None = None
+        for addr in self._addresses:
+            try:
+                resp = self._request_at(addr, PingRequest(),
+                                        timeout=self._probe_timeout)
+                if isinstance(resp, PingResponse) \
+                        and resp.service_name == self._service_name:
+                    self._good_address = addr
+                    return addr
+            except OSError as e:
+                last_err = e
+        raise ConnectionError(
+            f"could not reach {self._service_name} on any of "
+            f"{self._addresses}: {last_err}"
+        )
+
+    def _request_at(self, addr: tuple[str, int], req: Any,
+                    timeout: float | None) -> Any:
+        with socket.create_connection(addr, timeout=timeout) as sock:
+            write_message(sock, self._key, req)
+            return read_message(sock, self._key)
+
+    def request(self, req: Any, timeout: float | None = None) -> Any:
+        addr = self._probe()
+        last_err: Exception | None = None
+        for _ in range(self._retries):
+            try:
+                resp = self._request_at(addr, req, timeout)
+            except OSError as e:
+                last_err = e
+                continue
+            if isinstance(resp, Exception):
+                raise resp
+            return resp
+        raise ConnectionError(
+            f"request to {self._service_name}@{addr} failed: {last_err}"
+        )
+
+    def probe_source_ip(self) -> str:
+        """The IP the service sees this client connecting from — used for
+        routable-interface discovery (the reference's ring-ping,
+        ``/root/reference/horovod/spark/__init__.py:33-39``)."""
+        addr = self._probe()
+        resp = self._request_at(addr, PingRequest(),
+                                timeout=self._probe_timeout)
+        return resp.source_address[0]
+
+
+def local_addresses() -> list[str]:
+    """Best-effort list of this host's IP addresses, non-loopback first."""
+    ips: list[str] = []
+    try:
+        hostname_ips = socket.getaddrinfo(
+            socket.gethostname(), None, socket.AF_INET
+        )
+        ips.extend(info[4][0] for info in hostname_ips)
+    except socket.gaierror:
+        pass
+    # The default-route trick finds the outward-facing interface even when
+    # the hostname resolves to loopback.
+    try:
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.connect(("10.255.255.255", 1))
+            ips.append(s.getsockname()[0])
+    except OSError:
+        pass
+    ordered: list[str] = []
+    for ip in ips:
+        if ip not in ordered and not ip.startswith("127."):
+            ordered.append(ip)
+    ordered.append("127.0.0.1")
+    return ordered

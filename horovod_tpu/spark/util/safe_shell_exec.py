@@ -1,0 +1,101 @@
+"""Run a command so its whole process tree dies with the caller.
+
+Role analog of ``/root/reference/horovod/spark/util/safe_shell_exec.py``: the
+launcher's workers are spawned through a *middleman* process in its own
+session (``setsid``).  The middleman holds the read end of a pipe from the
+caller; when the caller dies for any reason, the pipe closes and the
+middleman SIGTERMs (then SIGKILLs) the entire process group, so no orphaned
+trainers keep TPU chips locked.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+GRACEFUL_TERMINATION_TIME_S = 5
+
+
+def _middleman_main(read_fd: int, env_b64: str, argv: list[str]) -> int:
+    os.setsid()
+    env = None
+    if env_b64:
+        from horovod_tpu.spark.util import codec
+
+        env = codec.loads_base64(env_b64)
+    proc = subprocess.Popen(argv, env=env, preexec_fn=os.setpgrp)
+
+    def _watch_parent() -> None:
+        try:
+            # blocks until the caller closes its write end (i.e. exits)
+            os.read(read_fd, 1)
+        except OSError:
+            pass
+        _kill_group(proc)
+
+    watcher = threading.Thread(target=_watch_parent, daemon=True)
+    watcher.start()
+    rc = proc.wait()
+    return rc
+
+
+def _kill_group(proc: subprocess.Popen) -> None:
+    try:
+        pgid = os.getpgid(proc.pid)
+    except ProcessLookupError:
+        return
+    try:
+        os.killpg(pgid, signal.SIGTERM)
+    except ProcessLookupError:
+        return
+    deadline = time.monotonic() + GRACEFUL_TERMINATION_TIME_S
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            return
+        time.sleep(0.1)
+    try:
+        os.killpg(pgid, signal.SIGKILL)
+    except ProcessLookupError:
+        pass
+
+
+def execute(command: list[str] | str, env: dict | None = None,
+            stdout=None, stderr=None) -> int:
+    """Run *command*; returns its exit code.  The command and all its
+    descendants are killed if the calling process dies first."""
+    if isinstance(command, str):
+        argv = ["/bin/sh", "-c", command]
+    else:
+        argv = list(command)
+
+    read_fd, write_fd = os.pipe()
+    os.set_inheritable(read_fd, True)
+
+    from horovod_tpu.spark.util import codec
+
+    env_b64 = codec.dumps_base64(dict(env)) if env is not None else ""
+    middleman_code = (
+        "import sys; from horovod_tpu.spark.util import safe_shell_exec as m; "
+        "sys.exit(m._middleman_main(int(sys.argv[1]), sys.argv[2], "
+        "sys.argv[3:]))"
+    )
+    # The middleman itself must be able to import this package even when the
+    # caller relied on sys.path manipulation rather than PYTHONPATH.
+    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))))
+    mm_env = dict(os.environ)
+    mm_env["PYTHONPATH"] = pkg_root + os.pathsep + mm_env.get("PYTHONPATH", "")
+    middleman = subprocess.Popen(
+        [sys.executable, "-c", middleman_code, str(read_fd), env_b64] + argv,
+        env=mm_env, stdout=stdout, stderr=stderr,
+        pass_fds=(read_fd,), close_fds=True,
+    )
+    os.close(read_fd)
+    try:
+        return middleman.wait()
+    finally:
+        os.close(write_fd)

@@ -1,0 +1,19 @@
+"""Shared-secret generation for launcher wire authentication.
+
+Role analog of the reference's ``spark/util/secret.py`` (
+``/root/reference/horovod/spark/util/secret.py:21-36``): every message on the
+driver/task control sockets is HMAC-signed with a per-job random key so that
+an attacker who can reach the port cannot inject pickled payloads.
+"""
+
+from __future__ import annotations
+
+import secrets
+
+DIGEST_ALGORITHM = "sha256"
+KEY_BYTES = 32
+
+
+def make_secret_key() -> bytes:
+    """A fresh 256-bit random key for one launcher job."""
+    return secrets.token_bytes(KEY_BYTES)

@@ -1,0 +1,32 @@
+"""Deadline helper with actionable error messages.
+
+Role analog of ``/root/reference/horovod/spark/util/timeout.py:19-34``: the
+launcher start path checks one shared deadline at every blocking step so a
+hung cluster surfaces as a clear exception naming the stuck step, not a hang.
+"""
+
+from __future__ import annotations
+
+import time
+
+
+class TimeoutException(Exception):
+    pass
+
+
+class Timeout:
+    def __init__(self, timeout: float, message: str):
+        self._deadline = time.monotonic() + timeout
+        self._message = message
+
+    def remaining(self) -> float:
+        return max(0.0, self._deadline - time.monotonic())
+
+    def timed_out(self) -> bool:
+        return time.monotonic() > self._deadline
+
+    def check_time_out_for(self, activity: str) -> None:
+        if self.timed_out():
+            raise TimeoutException(
+                self._message.format(activity=activity)
+            )

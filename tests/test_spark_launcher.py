@@ -1,0 +1,181 @@
+"""Launcher (horovod_tpu.spark) tests — util layer and full local flow.
+
+Mirrors the reference's launcher test strategy
+(``/root/reference/test/test_spark.py``): happy-path end-to-end run, start
+timeout with an actionable message, plus unit coverage of the wire/auth and
+process-cleanup utilities that the reference leaves implicit.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+from horovod_tpu.spark import run_local
+from horovod_tpu.spark.driver import driver_service
+from horovod_tpu.spark.util import codec, host_hash, network, secret
+from horovod_tpu.spark.util.timeout import Timeout, TimeoutException
+
+
+def test_codec_roundtrip():
+    obj = {"fn": lambda x: x + 1, "data": [1, 2, 3]}
+    out = codec.loads_base64(codec.dumps_base64(obj))
+    assert out["data"] == [1, 2, 3]
+    assert out["fn"](41) == 42
+
+
+def test_host_hash_stable_and_hexish():
+    h1, h2 = host_hash.host_hash(), host_hash.host_hash()
+    assert h1 == h2
+    assert len(h1) == 32
+
+
+def test_timeout_message_names_activity():
+    t = Timeout(0.0, "Timed out waiting for {activity}.")
+    time.sleep(0.01)
+    with pytest.raises(TimeoutException, match="tasks to register"):
+        t.check_time_out_for("tasks to register")
+
+
+def test_basic_service_ping_roundtrip():
+    key = secret.make_secret_key()
+    svc = network.BasicService("unit test service", key)
+    try:
+        client = network.BasicClient("unit test service", svc.addresses(),
+                                     key)
+        resp = client.request(network.PingRequest())
+        assert resp.service_name == "unit test service"
+        assert client.probe_source_ip()
+    finally:
+        svc.shutdown()
+
+
+def test_wrong_secret_is_rejected_before_unpickling():
+    key = secret.make_secret_key()
+    svc = network.BasicService("auth test service", key)
+    try:
+        bad = network.BasicClient("auth test service", svc.addresses(),
+                                  secret.make_secret_key(),
+                                  probe_timeout=1.0, retries=1)
+        with pytest.raises(ConnectionError):
+            bad.request(network.PingRequest(), timeout=1.0)
+    finally:
+        svc.shutdown()
+
+
+def test_tampered_message_raises_auth_error():
+    key = secret.make_secret_key()
+    svc = network.BasicService("tamper test", key)
+    try:
+        with socket.create_connection(("127.0.0.1", svc.port)) as s:
+            network.write_message(s, key, network.PingRequest())
+            s.settimeout(1.0)
+            # server answered; now tamper a reply read client-side
+            import cloudpickle
+            payload = cloudpickle.dumps(network.PingRequest())
+            # hand-build a frame with a bad digest and confirm the reader
+            # refuses it
+            frame = (len(payload).to_bytes(4, "big") + payload +
+                     b"\x00" * 32)
+            r, w = socket.socketpair()
+            try:
+                w.sendall(frame)
+                with pytest.raises(network.AuthenticationError):
+                    network.read_message(r, key)
+            finally:
+                r.close()
+                w.close()
+    finally:
+        svc.shutdown()
+
+
+def test_safe_shell_exec_kills_orphaned_tree():
+    """If the caller dies, the spawned command's whole group must die too."""
+    script = (
+        "import os, sys, time\n"
+        "sys.path.insert(0, %r)\n"
+        "from horovod_tpu.spark.util import safe_shell_exec\n"
+        "safe_shell_exec.execute("
+        "[sys.executable, '-c', 'import time,os;"
+        "print(os.getpid(), flush=True); time.sleep(300)'],"
+        " stdout=sys.stdout)\n"
+    ) % os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    caller = subprocess.Popen([sys.executable, "-c", script],
+                              stdout=subprocess.PIPE, text=True)
+    grandchild_pid = int(caller.stdout.readline().strip())
+    # grandchild alive while caller alive
+    os.kill(grandchild_pid, 0)
+    caller.send_signal(signal.SIGKILL)
+    caller.wait()
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        try:
+            os.kill(grandchild_pid, 0)
+        except ProcessLookupError:
+            return
+        time.sleep(0.2)
+    os.kill(grandchild_pid, signal.SIGKILL)
+    pytest.fail("grandchild survived caller death")
+
+
+def _worker_fn(scale):
+    import horovod_tpu as hvd
+
+    hvd.init()
+    try:
+        value = hvd.allreduce([float(hvd.rank() + 1)], average=False,
+                              name="spark_test")
+        return {"rank": hvd.rank(), "size": hvd.size(),
+                "sum": float(value[0]) * scale}
+    finally:
+        hvd.shutdown()
+
+
+def test_run_local_end_to_end():
+    """Full launcher flow on local placement: registration, ring probe,
+    rank assignment, code distribution, native-engine rendezvous, results
+    in rank order."""
+    results = run_local(_worker_fn, args=(2,), num_proc=2,
+                        start_timeout=120.0)
+    assert [r["rank"] for r in results] == [0, 1]
+    assert all(r["size"] == 2 for r in results)
+    # allreduce sum of (1+2) = 3, scaled by 2
+    assert all(r["sum"] == pytest.approx(6.0) for r in results)
+
+
+def test_run_local_worker_exception_is_reported():
+    def boom():
+        raise ValueError("intentional worker failure")
+
+    with pytest.raises(RuntimeError, match="intentional worker failure"):
+        run_local(boom, num_proc=2, start_timeout=120.0)
+
+
+def test_run_local_start_timeout_actionable():
+    key = secret.make_secret_key()
+    driver = driver_service.DriverService(2, key, lambda: None, (), {})
+    try:
+        t = Timeout(0.3, "Timed out waiting for {activity}.")
+        with pytest.raises(TimeoutException, match="register"):
+            driver.wait_for_initial_registration(t)
+    finally:
+        driver.shutdown()
+
+
+def test_spark_run_requires_pyspark():
+    pytest.importorskip_reason = None
+    try:
+        import pyspark  # noqa: F401
+        pytest.skip("pyspark installed; gating path not applicable")
+    except ImportError:
+        pass
+    from horovod_tpu import spark as hvd_spark
+
+    with pytest.raises(ImportError, match="pyspark"):
+        hvd_spark.run(lambda: None, num_proc=2)
