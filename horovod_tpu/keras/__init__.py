@@ -12,7 +12,7 @@ steps on the host.
 
 from __future__ import annotations
 
-from typing import Any, Callable, Iterable, Sequence
+from typing import Any, Callable, Sequence
 
 import numpy as np
 
@@ -140,7 +140,7 @@ class Trainer:
         h["momentum"] = jnp.asarray(value, jnp.asarray(h["momentum"]).dtype)
 
     # -- fit ---------------------------------------------------------------
-    def fit(self, batches: Sequence | Iterable, epochs: int = 1,
+    def fit(self, batches: Sequence, epochs: int = 1,
             callbacks: Sequence[Callback] = (), verbose: bool = False):
         """Run ``epochs`` passes over ``batches`` (a sequence, re-iterated
         per epoch).  Returns the history: list of per-epoch logs dicts."""
@@ -148,8 +148,14 @@ class Trainer:
         for cb in callbacks:
             cb.set_trainer(self)
         if not hasattr(batches, "__len__"):
-            # a one-shot iterator would silently train only epoch 0
-            batches = list(batches)
+            # A one-shot iterator would silently train only epoch 0, and
+            # materializing it could hang on infinite streams — demand a
+            # re-iterable sequence explicitly.
+            raise TypeError(
+                "fit() needs a sized, re-iterable batch sequence (list, "
+                "tuple, or a __len__-bearing dataset), not a one-shot "
+                "iterator/generator: epochs > 1 re-iterate it. Wrap finite "
+                "streams in list(...) yourself.")
         if len(batches) == 0:
             raise ValueError("fit() got an empty batch sequence")
         self.steps_per_epoch = len(batches)
@@ -241,10 +247,18 @@ def _state_signature(tree) -> str:
     import numpy as _np
 
     leaves, treedef = jax.tree.flatten(tree)
-    shapes = ";".join(
-        f"{_np.asarray(l).dtype}{list(_np.asarray(l).shape)}" for l in leaves
-    )
-    return f"{treedef}|{shapes}"
+
+    def _sig(leaf) -> str:
+        # jax Arrays expose dtype/shape without any device→host transfer;
+        # np.asarray only for Python scalars
+        dtype = getattr(leaf, "dtype", None)
+        shape = getattr(leaf, "shape", None)
+        if dtype is None or shape is None:
+            arr = _np.asarray(leaf)
+            dtype, shape = arr.dtype, arr.shape
+        return f"{dtype}{list(shape)}"
+
+    return f"{treedef}|" + ";".join(_sig(l) for l in leaves)
 
 
 __all__ = [

@@ -1,0 +1,114 @@
+"""MXNet frontend — API parity with
+``/root/reference/horovod/mxnet/__init__.py`` on the TPU-native core:
+``DistributedOptimizer`` wrapping ``update``/``update_multi_precision`` with
+a per-index named allreduce, and ``broadcast_parameters`` for both plain
+dicts and Gluon ParameterDicts (deferred-init parameters skipped).
+
+MXNet is imported lazily; the basics re-exports work without it.
+"""
+
+from __future__ import annotations
+
+from horovod_tpu.runtime.state import (  # noqa: F401  (re-exported basics)
+    init,
+    is_initialized,
+    shutdown,
+    rank,
+    size,
+    local_rank,
+    local_size,
+    cross_rank,
+    cross_size,
+    mpi_threads_supported,
+)
+from horovod_tpu.mxnet import mpi_ops
+from horovod_tpu.mxnet.mpi_ops import (  # noqa: F401
+    allreduce,
+    allreduce_,
+    allgather,
+    broadcast,
+    broadcast_,
+    _mx,
+)
+
+
+def _make_classes():
+    mx = _mx()
+
+    class DistributedOptimizer(mx.optimizer.Optimizer):
+        """Averages gradients across ranks before every update (reference
+        ``mxnet/__init__.py:36-59``: allreduce keyed by parameter index so
+        tensor names agree across ranks)."""
+
+        def __init__(self, optimizer):
+            self._optimizer = optimizer
+
+        def __getattr__(self, item):
+            return getattr(self._optimizer, item)
+
+        def _do_allreduce(self, index, grad):
+            if size() == 1:
+                return
+            if isinstance(index, (tuple, list)):
+                for i in range(len(index)):
+                    allreduce_(grad[i], average=True, name=str(index[i]))
+            else:
+                allreduce_(grad, average=True, name=str(index))
+
+        def update(self, index, weight, grad, state):
+            self._do_allreduce(index, grad)
+            self._optimizer.update(index, weight, grad, state)
+
+        def update_multi_precision(self, index, weight, grad, state):
+            self._do_allreduce(index, grad)
+            self._optimizer.update_multi_precision(index, weight, grad,
+                                                   state)
+
+        def set_learning_rate(self, lr):
+            self._optimizer.set_learning_rate(lr)
+
+        def set_lr_mult(self, args_lr_mult):
+            self._optimizer.set_lr_mult(args_lr_mult)
+
+        def set_wd_mult(self, args_wd_mult):
+            self._optimizer.set_wd_mult(args_wd_mult)
+
+        def create_state_multi_precision(self, index, weight):
+            return self._optimizer.create_state_multi_precision(index,
+                                                                weight)
+
+    return {"DistributedOptimizer": DistributedOptimizer}
+
+
+_lazy_classes: dict = {}
+
+
+def __getattr__(name: str):
+    if name == "DistributedOptimizer":
+        if not _lazy_classes:
+            _lazy_classes.update(_make_classes())
+        return _lazy_classes[name]
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def broadcast_parameters(params, root_rank: int = 0):
+    """Broadcast a parameter dict or Gluon ParameterDict from root
+    (reference ``mxnet/__init__.py:71-104``); parameters whose deferred
+    initialization hasn't run yet are skipped like the reference does."""
+    tensors = {}
+    if isinstance(params, dict):
+        tensors = {k: v for k, v in sorted(params.items())
+                   if v is not None}
+    else:  # gluon.ParameterDict duck-typing
+        for name, p in sorted(params.items()):
+            try:
+                tensors[name] = p.data()
+            except Exception:
+                # deferred initialization — value doesn't exist yet
+                continue
+    for name, tensor in tensors.items():
+        broadcast_(tensor, root_rank, name=str(name))
+    # MXNet is asynchronous: block until broadcasts land before training
+    for tensor in tensors.values():
+        if hasattr(tensor, "wait_to_read"):
+            tensor.wait_to_read()

@@ -1,0 +1,79 @@
+"""MXNet collective ops on the eager engine.
+
+API parity with ``/root/reference/horovod/mxnet/mpi_ops.py:40-214``:
+``allreduce``/``allreduce_``/``allgather``/``broadcast``/``broadcast_``
+over NDArrays.  The reference pushes async closures into MXNet's dependency
+engine (``/root/reference/horovod/mxnet/mpi_ops.cc:181-220``); here ordering
+is preserved by executing the collective synchronously on the NDArray's
+host buffer through the framework's native eager engine — MXNet's engine
+dependencies are respected because ``asnumpy()`` synchronizes the array.
+
+MXNet is imported lazily; calling any op without mxnet installed raises an
+actionable ImportError.
+"""
+
+from __future__ import annotations
+
+from horovod_tpu import _auto_name as _name
+from horovod_tpu.runtime import state as _state
+
+
+def _mx():
+    try:
+        import mxnet as mx
+        return mx
+    except ImportError as e:
+        raise ImportError(
+            "horovod_tpu.mxnet requires the mxnet package, which is not "
+            "installed in this environment. Install mxnet, or use the "
+            "first-class JAX frontend (horovod_tpu.jax).") from e
+
+
+def _run(kind: str, tensor, name: str, root_rank: int = 0):
+    import numpy as np
+
+    is_nd = hasattr(tensor, "asnumpy")
+    arr = tensor.asnumpy() if is_nd else np.asarray(tensor)
+    eng = _state.engine()
+    if kind == "allreduce":
+        out = eng.synchronize(eng.allreduce_async(arr, name))
+    elif kind == "allgather":
+        out = eng.synchronize(eng.allgather_async(arr, name))
+    else:
+        out = eng.synchronize(eng.broadcast_async(arr, root_rank, name))
+    if is_nd:
+        try:
+            import mxnet as mx
+        except ImportError:
+            mx = None
+        if mx is not None and isinstance(tensor, mx.nd.NDArray):
+            return mx.nd.array(out, ctx=tensor.context, dtype=out.dtype)
+    return out  # plain arrays / NDArray-like duck types stay numpy
+
+
+def allreduce(tensor, average: bool = True, name: str | None = None):
+    out = _run("allreduce", tensor, _name("allreduce", name))
+    return out / _state.size() if average else out
+
+
+def allreduce_(tensor, average: bool = True, name: str | None = None):
+    """In-place allreduce (the reference's gradient path,
+    ``mxnet/__init__.py:36-59``)."""
+    out = allreduce(tensor, average=average, name=name)
+    tensor[:] = out
+    return tensor
+
+
+def allgather(tensor, name: str | None = None):
+    return _run("allgather", tensor, _name("allgather", name))
+
+
+def broadcast(tensor, root_rank: int, name: str | None = None):
+    return _run("broadcast", tensor, _name("broadcast", name),
+                root_rank=root_rank)
+
+
+def broadcast_(tensor, root_rank: int, name: str | None = None):
+    out = broadcast(tensor, root_rank, name=name)
+    tensor[:] = out
+    return tensor

@@ -21,15 +21,12 @@ from __future__ import annotations
 import argparse
 import os
 import signal
-import socket
 import subprocess
 import sys
 
+from horovod_tpu.utils import net
 
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
+
 
 
 def _parse_hosts(spec: str) -> list[tuple[str, int]]:
@@ -82,7 +79,7 @@ def main(argv=None) -> int:
         cross_size, cross_rank = 1, 0
 
     port = args.rendezvous_port or int(
-        os.environ.get("HOROVOD_TPU_RENDEZVOUS_PORT", 0)) or _free_port()
+        os.environ.get("HOROVOD_TPU_RENDEZVOUS_PORT", 0)) or net.free_port()
 
     procs: list[subprocess.Popen] = []
 

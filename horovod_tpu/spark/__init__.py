@@ -30,18 +30,21 @@ from horovod_tpu.spark.task import task_service
 from horovod_tpu.spark.util import codec, host_hash as _host_hash
 from horovod_tpu.spark.util import network, secret
 from horovod_tpu.spark.util.timeout import Timeout
-
-
-def _pkg_root() -> str:
-    return os.path.dirname(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))))
+from horovod_tpu.utils import net
 
 
 def launch_on_tasks(driver: driver_service.DriverService, key: bytes,
-                    num_proc: int, timeout: Timeout) -> list:
+                    num_proc: int, timeout: Timeout,
+                    placement_failure=None) -> list:
     """Placement-agnostic launch: expects ``num_proc`` TaskServices to have
     been placed somewhere and given the driver's addresses; orchestrates the
-    full job and returns per-rank results ordered by rank."""
+    full job and returns per-rank results ordered by rank.
+
+    ``timeout`` covers STARTUP only (registration through worker launch);
+    the training run itself is unbounded, watched by a liveness check.
+    ``placement_failure`` (optional callable → Exception|None) lets the
+    placement layer surface its own failures (e.g. a died Spark job).
+    """
     driver.wait_for_initial_registration(timeout)
     indices = driver.task_indices()
 
@@ -81,13 +84,39 @@ def launch_on_tasks(driver: driver_service.DriverService, key: bytes,
                 base64.b64encode(key).decode("ascii"),
             "HOROVOD_TPU_LAUNCHER_DRIVER": codec.dumps_base64(driver_addrs),
             "HOROVOD_TPU_LAUNCHER_TASK_INDEX": str(i),
-            "PYTHONPATH": _pkg_root() + os.pathsep +
+            "PYTHONPATH": net.pkg_root() + os.pathsep +
                 os.environ.get("PYTHONPATH", ""),
         }
         command = [sys.executable, "-m", "horovod_tpu.spark.task.exec_fn"]
         clients[i].request(task_service.RunCommandRequest(command, env))
 
-    results = driver.wait_for_results(timeout)
+    def _health_check():
+        if placement_failure is not None:
+            err = placement_failure()
+            if err is not None:
+                raise RuntimeError(
+                    f"placement layer failed during the run: {err!r}"
+                ) from err
+        for i in indices:
+            try:
+                resp = clients[i].request(
+                    task_service.CommandExitCodeRequest(), timeout=5.0)
+            except ConnectionError as e:
+                raise RuntimeError(
+                    f"lost contact with task {i} (rank "
+                    f"{assignment[i]['rank']}) during the run: {e}")
+            if resp.terminated and resp.exit_code not in (0, None):
+                rank = assignment[i]["rank"]
+                reported = driver.error_for_rank(rank)
+                if reported is not None:
+                    raise RuntimeError(
+                        f"worker rank {rank} failed:\n{reported}")
+                raise RuntimeError(
+                    f"worker rank {rank} (task {i}) exited with code "
+                    f"{resp.exit_code} without reporting a result — see "
+                    "its stderr above")
+
+    results = driver.wait_for_results(health_check=_health_check)
     return [results[r] for r in sorted(results)]
 
 
@@ -137,16 +166,17 @@ def run(fn, args: tuple = (), kwargs: dict | None = None,
 
     def _spark_thread():
         try:
-            result_holder["indices"] = (
-                spark_context.range(0, num_proc, numSlices=num_proc)
-                .mapPartitionsWithIndex(_task_fn).collect())
-        except BaseException as e:  # surfaced via wait_for_results timeout
+            spark_context.range(0, num_proc, numSlices=num_proc) \
+                .mapPartitionsWithIndex(_task_fn).collect()
+        except BaseException as e:  # surfaced by launch's health check
             result_holder["error"] = e
 
     spark_thread = threading.Thread(target=_spark_thread, daemon=True)
     spark_thread.start()
     try:
-        return launch_on_tasks(driver, key, num_proc, timeout)
+        return launch_on_tasks(
+            driver, key, num_proc, timeout,
+            placement_failure=lambda: result_holder.get("error"))
     finally:
         spark_context.cancelJobGroup(_job_id.spark_job_group(jid))
         driver.shutdown()

@@ -28,24 +28,6 @@ class RegisterTaskRequest:
 
 
 @dataclasses.dataclass
-class RegisterTaskToTaskAddressesRequest:
-    """After probing its ring-successor, a task reports the subset of the
-    successor's addresses that were actually reachable."""
-    index: int
-    reachable_addresses: list
-
-
-@dataclasses.dataclass
-class AllTaskAddressesRequest:
-    index: int
-
-
-@dataclasses.dataclass
-class AllTaskAddressesResponse:
-    all_task_addresses: list
-
-
-@dataclasses.dataclass
 class CodeRequest:
     pass
 
@@ -102,15 +84,6 @@ class DriverService(network.BasicService):
                 self._task_host_hash[req.index] = req.host_hash
                 self._lock.notify_all()
             return Ack()
-        if isinstance(req, RegisterTaskToTaskAddressesRequest):
-            with self._lock:
-                self._reachable[req.index] = list(req.reachable_addresses)
-                self._lock.notify_all()
-            return Ack()
-        if isinstance(req, AllTaskAddressesRequest):
-            with self._lock:
-                return AllTaskAddressesResponse(
-                    self._task_addresses.get(req.index, []))
         if isinstance(req, CodeRequest):
             return CodeResponse(self._code_bytes)
         if isinstance(req, ResultRequest):
@@ -131,14 +104,6 @@ class DriverService(network.BasicService):
                     "all launcher tasks to register; confirm the cluster has "
                     f"{self._num_proc} free slots and that firewalls allow "
                     "TCP between the driver and executors")
-                self._lock.wait(0.2)
-
-    def wait_for_task_to_task_pings(self, timeout) -> None:
-        with self._lock:
-            while len(self._reachable) < self._num_proc:
-                timeout.check_time_out_for(
-                    "task-to-task interface discovery; executors cannot "
-                    "reach each other's control ports")
                 self._lock.wait(0.2)
 
     def task_addresses_for(self, index: int) -> list:
@@ -195,13 +160,34 @@ class DriverService(network.BasicService):
         ip = self.reachable_addresses_for(rank0_idx)[0][0]
         return ip, self._task_rdv_port[rank0_idx]
 
-    def wait_for_results(self, timeout) -> dict[int, Any]:
+    def error_for_rank(self, rank: int) -> str | None:
+        with self._lock:
+            return self._errors.get(rank)
+
+    def wait_for_results(self, health_check=None,
+                         poll_s: float = 0.2) -> dict[int, Any]:
+        """Block until every rank reported a result or an error.
+
+        There is deliberately NO deadline here — training runs arbitrarily
+        long (the reference's start timeout also covers startup only).
+        ``health_check``, called roughly once a second, detects silently
+        dead workers (crashed placement task, non-zero exit without a
+        result) and raises.
+        """
+        last_check = 0.0
+        import time as _time
+
         with self._lock:
             while len(self._results) + len(self._errors) < self._num_proc:
-                timeout.check_time_out_for(
-                    "workers to finish; at least one worker neither "
-                    "returned a result nor reported an error")
-                self._lock.wait(0.2)
+                self._lock.wait(poll_s)
+                now = _time.monotonic()
+                if health_check is not None and now - last_check > 1.0:
+                    last_check = now
+                    self._lock.release()
+                    try:
+                        health_check()
+                    finally:
+                        self._lock.acquire()
             if self._errors:
                 lines = [f"rank {r}: {e}" for r, e in
                          sorted(self._errors.items())]

@@ -10,11 +10,11 @@ through :mod:`safe_shell_exec` so the whole tree dies with the executor.
 from __future__ import annotations
 
 import dataclasses
-import socket
 import sys
 import threading
 
 from horovod_tpu.spark.util import network, safe_shell_exec
+from horovod_tpu.utils import net
 
 
 @dataclasses.dataclass
@@ -53,12 +53,6 @@ class Ack:
     pass
 
 
-def free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("0.0.0.0", 0))
-        return s.getsockname()[1]
-
-
 class TaskService(network.BasicService):
     NAME_FMT = "launcher task service #%d"
 
@@ -67,7 +61,7 @@ class TaskService(network.BasicService):
         self.index = index
         # Reserved ahead of time so the driver can point every worker at
         # rank 0's native-engine rendezvous before any worker starts.
-        self.rendezvous_port = free_port()
+        self.rendezvous_port = net.free_port()
         self._lock = threading.Lock()
         self._exit_code: int | None = None
         self._command_thread: threading.Thread | None = None

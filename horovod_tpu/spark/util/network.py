@@ -199,16 +199,6 @@ class BasicClient:
             f"request to {self._service_name}@{addr} failed: {last_err}"
         )
 
-    def probe_source_ip(self) -> str:
-        """The IP the service sees this client connecting from — used for
-        routable-interface discovery (the reference's ring-ping,
-        ``/root/reference/horovod/spark/__init__.py:33-39``)."""
-        addr = self._probe()
-        resp = self._request_at(addr, PingRequest(),
-                                timeout=self._probe_timeout)
-        return resp.source_address[0]
-
-
 def local_addresses() -> list[str]:
     """Best-effort list of this host's IP addresses, non-loopback first."""
     ips: list[str] = []

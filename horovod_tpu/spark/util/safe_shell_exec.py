@@ -85,10 +85,11 @@ def execute(command: list[str] | str, env: dict | None = None,
     )
     # The middleman itself must be able to import this package even when the
     # caller relied on sys.path manipulation rather than PYTHONPATH.
-    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
-        os.path.dirname(os.path.abspath(__file__)))))
+    from horovod_tpu.utils import net
+
     mm_env = dict(os.environ)
-    mm_env["PYTHONPATH"] = pkg_root + os.pathsep + mm_env.get("PYTHONPATH", "")
+    mm_env["PYTHONPATH"] = (net.pkg_root() + os.pathsep +
+                            mm_env.get("PYTHONPATH", ""))
     middleman = subprocess.Popen(
         [sys.executable, "-c", middleman_code, str(read_fd), env_b64] + argv,
         env=mm_env, stdout=stdout, stderr=stderr,

@@ -1,0 +1,177 @@
+"""TensorFlow frontend — API parity with
+``/root/reference/horovod/tensorflow/__init__.py`` on the TPU-native core.
+
+Provides ``allreduce`` (dense + IndexedSlices sparse path, compression),
+``broadcast_global_variables`` / ``broadcast_variables``,
+``BroadcastGlobalVariablesHook``, ``DistributedOptimizer`` (graph mode) and
+``DistributedGradientTape`` (eager), over the framework's eager collective
+engine.  TensorFlow itself is imported lazily so this module is importable
+(and its basics usable) in TF-less environments; TF-dependent classes are
+materialized on first attribute access.
+"""
+
+from __future__ import annotations
+
+from horovod_tpu.compression import Compression
+from horovod_tpu.runtime.state import (  # noqa: F401  (re-exported basics)
+    init,
+    is_initialized,
+    shutdown,
+    rank,
+    size,
+    local_rank,
+    local_size,
+    cross_rank,
+    cross_size,
+    mpi_threads_supported,
+)
+from horovod_tpu.tensorflow import mpi_ops
+from horovod_tpu.tensorflow.mpi_ops import allgather, broadcast  # noqa: F401
+from horovod_tpu.tensorflow.mpi_ops import _allreduce, _tf
+
+
+def allreduce(tensor, average: bool = True, compression=Compression.none):
+    """Averaging allreduce with the reference's sparse handling: an
+    ``IndexedSlices`` gradient becomes allgather(values)+allgather(indices)
+    (`/root/reference/horovod/tensorflow/__init__.py:72-83`)."""
+    tf = _tf()
+    if isinstance(tensor, tf.IndexedSlices):
+        values = allgather(tensor.values)
+        indices = allgather(tensor.indices)
+        return tf.IndexedSlices(
+            values / size() if average else values,
+            indices, dense_shape=tensor.dense_shape)
+    # wire compression = cast before the collective, restore after
+    # (reference ``tensorflow/compression.py:46-64``); stays symbolic.
+    wire = tf.cast(tensor, tf.float16) \
+        if compression is Compression.fp16 and tensor.dtype in (
+            tf.float32, tf.float64) else tensor
+    summed = _allreduce(wire, name=None)
+    summed = tf.cast(summed, tensor.dtype)
+    return summed / size() if average else summed
+
+
+def broadcast_variables(variables, root_rank: int = 0):
+    """Assign every variable to root's value (consistency at start/resume,
+    reference ``tensorflow/__init__.py:95-114``)."""
+    for var in variables:
+        var.assign(broadcast(var.read_value() if hasattr(var, "read_value")
+                             else var, root_rank,
+                             name=getattr(var, "name", None)))
+
+
+def broadcast_global_variables(root_rank: int = 0):
+    tf = _tf()
+    broadcast_variables(tf.compat.v1.global_variables(), root_rank)
+
+
+class DistributedGradientTape:
+    """Eager-mode tape wrapper: ``gradient()`` allreduces every gradient
+    (reference ``tensorflow/__init__.py:252-326``)."""
+
+    def __init__(self, tape, compression=Compression.none,
+                 device_dense: str = "", device_sparse: str = ""):
+        self._tape = tape
+        self._compression = compression
+
+    def __getattr__(self, item):
+        return getattr(self._tape, item)
+
+    def __enter__(self):
+        self._tape.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        return self._tape.__exit__(*exc)
+
+    def gradient(self, target, sources, output_gradients=None):
+        tf = _tf()
+        grads = self._tape.gradient(target, sources, output_gradients)
+        # mirror the sources structure (single tensor, list, nested dict)
+        # exactly as tf.GradientTape does — reference uses nest.map_structure
+        return tf.nest.map_structure(
+            lambda g: g if g is None else allreduce(
+                g, average=True, compression=self._compression),
+            grads)
+
+
+def _make_tf_classes():
+    """Build the TF-base-class-dependent API lazily (TF may be absent)."""
+    tf = _tf()
+
+    class BroadcastGlobalVariablesHook(tf.compat.v1.train.SessionRunHook):
+        """Session hook broadcasting all global variables from root after
+        init (reference ``tensorflow/__init__.py:117-148``)."""
+
+        def __init__(self, root_rank: int = 0, device: str = ""):
+            super().__init__()
+            self.root_rank = root_rank
+            self.bcast_op = None
+
+        def begin(self):
+            self.bcast_op = tf.group(*[
+                tf.compat.v1.assign(
+                    var, broadcast(var, self.root_rank,
+                                   name=var.name))
+                for var in tf.compat.v1.global_variables()])
+
+        def after_create_session(self, session, coord):
+            session.run(self.bcast_op)
+
+    class DistributedOptimizer(tf.compat.v1.train.Optimizer):
+        """Graph-mode wrapper: ``compute_gradients`` allreduces every
+        gradient before ``apply_gradients`` sees it (reference
+        ``tensorflow/__init__.py:151-249``)."""
+
+        def __init__(self, optimizer, name=None, use_locking=False,
+                     device_dense="", device_sparse="",
+                     compression=Compression.none, sparse_as_dense=False):
+            self._optimizer = optimizer
+            self._compression = compression
+            self._sparse_as_dense = sparse_as_dense
+            if name is None:
+                name = f"Distributed{type(optimizer).__name__}"
+            super().__init__(name=name, use_locking=use_locking)
+
+        def compute_gradients(self, *args, **kwargs):
+            gradients = self._optimizer.compute_gradients(*args, **kwargs)
+            if size() == 1:
+                return gradients
+            averaged = []
+            for grad, var in gradients:
+                if grad is None:
+                    averaged.append((None, var))
+                    continue
+                if self._sparse_as_dense and \
+                        isinstance(grad, tf.IndexedSlices):
+                    grad = tf.convert_to_tensor(grad)
+                averaged.append((allreduce(
+                    grad, average=True,
+                    compression=self._compression), var))
+            return averaged
+
+        def apply_gradients(self, *args, **kwargs):
+            return self._optimizer.apply_gradients(*args, **kwargs)
+
+        def get_slot(self, *args, **kwargs):
+            return self._optimizer.get_slot(*args, **kwargs)
+
+        def get_slot_names(self, *args, **kwargs):
+            return self._optimizer.get_slot_names(*args, **kwargs)
+
+        def variables(self, *args, **kwargs):
+            return self._optimizer.variables(*args, **kwargs)
+
+    return {"BroadcastGlobalVariablesHook": BroadcastGlobalVariablesHook,
+            "DistributedOptimizer": DistributedOptimizer}
+
+
+_lazy_classes: dict = {}
+
+
+def __getattr__(name: str):
+    if name in ("BroadcastGlobalVariablesHook", "DistributedOptimizer"):
+        if not _lazy_classes:
+            _lazy_classes.update(_make_tf_classes())
+        return _lazy_classes[name]
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

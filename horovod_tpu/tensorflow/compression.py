@@ -1,0 +1,11 @@
+"""Gradient wire compression for the TF frontend — same interface as the
+shared implementation (reference keeps per-framework copies,
+``/root/reference/horovod/tensorflow/compression.py:20-75``; here one
+implementation is shared and re-exported)."""
+
+from horovod_tpu.compression import (  # noqa: F401
+    Compression,
+    Compressor,
+    NoneCompressor,
+    FP16Compressor,
+)

@@ -1,0 +1,142 @@
+"""TensorFlow collective ops on the eager engine.
+
+API parity with ``/root/reference/horovod/tensorflow/mpi_ops.py:78-183``:
+``_allreduce``/``allgather``/``broadcast`` with per-tensor op names
+(``HorovodAllreduce_<name>``) and gradient registrations — allreduce's grad
+is an allreduce (`mpi_ops.py:94-105`), allgather's grad is an allreduce then
+a slice of this rank's rows (`mpi_ops.py:127-148`), broadcast's grad is an
+allreduce zeroed on non-root ranks (`mpi_ops.py:168-183`).
+
+TPU-first data plane: instead of a custom TF C++ kernel enqueueing into an
+MPI background thread, tensors bridge through ``tf.py_function`` to the
+framework's native eager engine (C++ TCP/ring core).  On-TPU compiled
+training should use the JAX frontend; this adapter exists for API parity and
+CPU/host-side TF programs.
+
+TensorFlow is imported lazily: importing this module without TF installed
+succeeds, calling any op raises an actionable ImportError.
+"""
+
+from __future__ import annotations
+
+import re
+
+import numpy as np
+
+from horovod_tpu.runtime import state as _state
+
+
+def _tf():
+    try:
+        import tensorflow as tf
+        return tf
+    except ImportError as e:
+        raise ImportError(
+            "horovod_tpu.tensorflow requires the tensorflow package, which "
+            "is not installed in this environment. Install tensorflow, or "
+            "use the first-class JAX frontend (horovod_tpu.jax) / the torch "
+            "frontend (horovod_tpu.torch).") from e
+
+
+def _normalize(name: str | None, tensor, prefix: str) -> str:
+    if name is None:
+        name = getattr(tensor, "name", None) or "noname"
+    # TF variable names contain ':'/'/' which the reference also scrubs
+    return f"{prefix}_{re.sub(r'[^A-Za-z0-9_]', '_', str(name))}"
+
+
+def _run_collective(kind: str, tensor, name: str, root_rank: int = 0):
+    """Bridge one collective through the eager engine via py_function so it
+    works inside tf.function graphs as well as eagerly."""
+    tf = _tf()
+
+    def _op(x):
+        arr = x.numpy() if hasattr(x, "numpy") else np.asarray(x)
+        eng = _state.engine()
+        if kind == "allreduce":
+            out = eng.synchronize(eng.allreduce_async(arr, name))
+        elif kind == "allgather":
+            out = eng.synchronize(eng.allgather_async(arr, name))
+        else:
+            out = eng.synchronize(
+                eng.broadcast_async(arr, root_rank, name))
+        return out.astype(arr.dtype, copy=False)
+
+    out = tf.py_function(_op, [tensor], Tout=tensor.dtype, name=name)
+    if kind == "allreduce" or kind == "broadcast":
+        out.set_shape(tensor.shape)
+    else:
+        shape = tensor.shape.as_list() if tensor.shape.rank is not None \
+            else None
+        if shape is not None and shape:
+            shape[0] = None
+        out.set_shape(shape)
+    return out
+
+
+def _allreduce(tensor, name: str | None = None):
+    """Sum across ranks (no averaging — that lives in the high-level
+    ``allreduce``, matching the reference split)."""
+    tf = _tf()
+    op_name = _normalize(name, tensor, "HorovodAllreduce")
+
+    @tf.custom_gradient
+    def _fwd(x):
+        y = _run_collective("allreduce", x, op_name)
+
+        def grad(dy):
+            return _allreduce(dy, name=op_name + "_grad")
+
+        return y, grad
+
+    return _fwd(tensor)
+
+
+def allgather(tensor, name: str | None = None):
+    """Concatenate across ranks on dim 0; ranks may differ on dim 0."""
+    tf = _tf()
+    op_name = _normalize(name, tensor, "HorovodAllgather")
+
+    @tf.custom_gradient
+    def _fwd(x):
+        y = _run_collective("allgather", x, op_name)
+
+        def grad(dy):
+            # grad = allreduce(dy) sliced to this rank's rows — needs every
+            # rank's dim-0 size, obtained by allgathering them.
+            sizes = _run_collective(
+                "allgather",
+                tf.cast(tf.reshape(tf.shape(x)[0], [1]), tf.int32),
+                op_name + "_sizes")
+            summed = _allreduce(dy, name=op_name + "_grad")
+            r = _state.rank()
+            begin = tf.reduce_sum(sizes[:r])
+            return tf.slice(
+                summed,
+                tf.concat([[begin], tf.zeros_like(tf.shape(x))[1:]], 0),
+                tf.shape(x))
+
+        return y, grad
+
+    return _fwd(tensor)
+
+
+def broadcast(tensor, root_rank: int, name: str | None = None):
+    """Every rank returns root's value.  Gradient: allreduce, kept on root
+    only (reference ``mpi_ops.py:168-183``)."""
+    tf = _tf()
+    op_name = _normalize(name, tensor, "HorovodBroadcast")
+
+    @tf.custom_gradient
+    def _fwd(x):
+        y = _run_collective("broadcast", x, op_name, root_rank=root_rank)
+
+        def grad(dy):
+            reduced = _allreduce(dy, name=op_name + "_grad")
+            if _state.rank() == root_rank:
+                return reduced
+            return tf.zeros_like(reduced)
+
+        return y, grad
+
+    return _fwd(tensor)

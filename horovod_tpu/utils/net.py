@@ -1,0 +1,23 @@
+"""Small shared networking/path helpers used across the launcher stack."""
+
+from __future__ import annotations
+
+import os
+import socket
+
+
+def free_port(host: str = "0.0.0.0") -> int:
+    """Reserve-by-probe a free TCP port (TOCTOU-racy by nature; callers
+    bind it again promptly)."""
+    with socket.socket() as s:
+        s.bind((host, 0))
+        return s.getsockname()[1]
+
+
+def pkg_root() -> str:
+    """Directory containing the ``horovod_tpu`` package (for PYTHONPATH of
+    spawned workers)."""
+    import horovod_tpu
+
+    return os.path.dirname(os.path.dirname(
+        os.path.abspath(horovod_tpu.__file__)))

@@ -51,7 +51,7 @@ def test_basic_service_ping_roundtrip():
                                      key)
         resp = client.request(network.PingRequest())
         assert resp.service_name == "unit test service"
-        assert client.probe_source_ip()
+        assert resp.source_address[0]
     finally:
         svc.shutdown()
 
