@@ -71,6 +71,17 @@ class MetricAverageCallback(Callback):
                     name=f"metric.{metric}"))
 
 
+def warmup_multiplier(epoch: float, size: int, warmup_epochs: float) -> float:
+    """Gradual-warmup LR multiplier ``1/size * (epoch*(size-1)/warmup + 1)``
+    — ramps from ``1/size`` at epoch 0 to 1 at ``warmup_epochs`` (the
+    "Accurate, Large Minibatch SGD" recipe; reference
+    ``_keras/callbacks.py:149-160``).  Shared by every frontend's warmup
+    callback so the formula can't drift."""
+    if warmup_epochs <= 0:
+        return 1.0
+    return 1.0 / size * (epoch * (size - 1) / warmup_epochs + 1)
+
+
 class LearningRateScheduleCallback(Callback):
     """Multiply the base LR by ``multiplier(epoch)`` within
     [start_epoch, end_epoch); non-staircase mode interpolates within the
@@ -149,8 +160,7 @@ class LearningRateWarmupCallback(LearningRateScheduleCallback):
 
         def multiplier(epoch):
             epoch += 1.0 / (self.steps_per_epoch or 1)
-            size = hvd.size()
-            return 1.0 / size * (epoch * (size - 1) / warmup_epochs + 1)
+            return warmup_multiplier(epoch, hvd.size(), warmup_epochs)
 
         super().__init__(multiplier, start_epoch=0, end_epoch=warmup_epochs,
                          staircase=False,

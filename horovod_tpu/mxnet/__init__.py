@@ -103,9 +103,13 @@ def broadcast_parameters(params, root_rank: int = 0):
         for name, p in sorted(params.items()):
             try:
                 tensors[name] = p.data()
-            except Exception:
-                # deferred initialization — value doesn't exist yet
-                continue
+            except Exception as e:
+                # skip ONLY deferred initialization (value doesn't exist
+                # yet, reference ``mxnet/__init__.py:95-100``); anything
+                # else must surface, or ranks silently keep divergent inits
+                if type(e).__name__ == "DeferredInitializationError":
+                    continue
+                raise
     for name, tensor in tensors.items():
         broadcast_(tensor, root_rank, name=str(name))
     # MXNet is asynchronous: block until broadcasts land before training

@@ -41,6 +41,9 @@ def _run(kind: str, tensor, name: str, root_rank: int = 0):
         out = eng.synchronize(eng.allgather_async(arr, name))
     else:
         out = eng.synchronize(eng.broadcast_async(arr, root_rank, name))
+    if kind != "allgather":
+        # the wire flattens scalars to 1-element vectors; restore
+        out = out.reshape(arr.shape)
     if is_nd:
         try:
             import mxnet as mx

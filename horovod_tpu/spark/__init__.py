@@ -105,15 +105,21 @@ def launch_on_tasks(driver: driver_service.DriverService, key: bytes,
                 raise RuntimeError(
                     f"lost contact with task {i} (rank "
                     f"{assignment[i]['rank']}) during the run: {e}")
-            if resp.terminated and resp.exit_code not in (0, None):
+            if resp.terminated:
                 rank = assignment[i]["rank"]
+                if driver.has_outcome(rank):
+                    continue  # finished after pushing its result/error
                 reported = driver.error_for_rank(rank)
                 if reported is not None:
                     raise RuntimeError(
                         f"worker rank {rank} failed:\n{reported}")
+                # covers non-zero exits AND exit_code None (the task's
+                # runner thread died before recording one) AND clean exits
+                # that never pushed a result — all would otherwise hang
+                # the deadline-less wait_for_results forever
                 raise RuntimeError(
-                    f"worker rank {rank} (task {i}) exited with code "
-                    f"{resp.exit_code} without reporting a result — see "
+                    f"worker rank {rank} (task {i}) terminated (exit code "
+                    f"{resp.exit_code}) without reporting a result — see "
                     "its stderr above")
 
     results = driver.wait_for_results(health_check=_health_check)

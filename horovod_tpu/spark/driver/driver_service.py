@@ -164,6 +164,11 @@ class DriverService(network.BasicService):
         with self._lock:
             return self._errors.get(rank)
 
+    def has_outcome(self, rank: int) -> bool:
+        """True once ``rank`` pushed either a result or an error."""
+        with self._lock:
+            return rank in self._results or rank in self._errors
+
     def wait_for_results(self, health_check=None,
                          poll_s: float = 0.2) -> dict[int, Any]:
         """Block until every rank reported a result or an error.

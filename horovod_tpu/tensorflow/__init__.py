@@ -54,10 +54,14 @@ def allreduce(tensor, average: bool = True, compression=Compression.none):
 def broadcast_variables(variables, root_rank: int = 0):
     """Assign every variable to root's value (consistency at start/resume,
     reference ``tensorflow/__init__.py:95-114``)."""
+    tf = _tf()
     for var in variables:
-        var.assign(broadcast(var.read_value() if hasattr(var, "read_value")
-                             else var, root_rank,
-                             name=getattr(var, "name", None)))
+        # materialize to a plain tensor first: custom_gradient ops must not
+        # capture the variable itself (and keras-3 Variables are not
+        # tf.Variables)
+        value = tf.convert_to_tensor(var)
+        var.assign(broadcast(value, root_rank,
+                             name=getattr(var, "name", None) or "var"))
 
 
 def broadcast_global_variables(root_rank: int = 0):

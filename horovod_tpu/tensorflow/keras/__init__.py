@@ -1,0 +1,138 @@
+"""tf.keras frontend — API parity with
+``/root/reference/horovod/tensorflow/keras/__init__.py`` (the thin binding
+of the shared ``_keras`` impl to the ``tf.keras`` backend,
+``/root/reference/horovod/tensorflow/keras/__init__.py:16-39``) on the
+TPU-native core.
+
+Surface: basics re-exports, ``allreduce``/``allgather``/``broadcast``,
+``broadcast_global_variables``, ``DistributedOptimizer`` (dynamic subclass
+of the wrapped optimizer whose gradient application allreduces first, the
+analog of the reference's ``get_gradients`` override,
+``/root/reference/horovod/_keras/__init__.py:20-70``), and ``load_model``
+that re-wraps deserialized optimizers
+(``/root/reference/horovod/_keras/__init__.py:93-109``).
+
+TensorFlow is imported lazily so this module imports cleanly without TF;
+the first framework-dependent call raises an actionable ImportError.
+
+Note: the pure-JAX high-level training API lives at ``horovod_tpu.keras``;
+this package exists for users porting real ``tf.keras`` models.
+"""
+
+from __future__ import annotations
+
+from horovod_tpu.compression import Compression  # noqa: F401
+from horovod_tpu.runtime.state import (  # noqa: F401  (re-exported basics)
+    init,
+    is_initialized,
+    shutdown,
+    rank,
+    size,
+    local_rank,
+    local_size,
+    cross_rank,
+    cross_size,
+    mpi_threads_supported,
+)
+from horovod_tpu.tensorflow import (  # noqa: F401
+    allgather,
+    allreduce,
+    broadcast,
+    broadcast_global_variables,
+    broadcast_variables,
+)
+from horovod_tpu.tensorflow.mpi_ops import _tf
+
+
+def _wrap_optimizer_class(opt_cls, compression, sparse_as_dense):
+    """Dynamic subclass of ``opt_cls`` whose ``apply_gradients`` allreduces
+    every gradient before the parent applies it — the TF2/keras-3 analog of
+    the reference's ``get_gradients`` override (graph-mode keras,
+    ``/root/reference/horovod/_keras/__init__.py:30-53``): same semantics
+    (average across ranks, sparse-as-dense option, wire compression), hooked
+    at gradient *application* because modern keras computes gradients with
+    a tape rather than ``optimizer.get_gradients``.
+    """
+    tf = _tf()
+
+    def _reduce(grad):
+        if grad is None or size() == 1:
+            return grad
+        if isinstance(grad, tf.IndexedSlices) and sparse_as_dense:
+            grad = tf.convert_to_tensor(grad)
+        return allreduce(grad, average=True, compression=compression)
+
+    if hasattr(opt_cls, "apply"):
+        # keras 3: apply() is the single funnel — fit() reaches it through
+        # apply_gradients(), and custom loops call it directly.  Overriding
+        # only here avoids double-reducing on the fit path.
+        class _Distributed(opt_cls):
+            _hvd_wrapped = True
+
+            def apply(self, grads, trainable_variables=None, **kwargs):
+                grads = [_reduce(g) for g in grads]
+                return super().apply(grads, trainable_variables, **kwargs)
+    else:
+        # legacy optimizers (tf.keras.optimizers.legacy / graph keras):
+        # hook application and the graph-mode get_gradients path.
+        class _Distributed(opt_cls):
+            _hvd_wrapped = True
+
+            def apply_gradients(self, grads_and_vars, *args, **kwargs):
+                grads_and_vars = [
+                    (_reduce(g), v) for g, v in grads_and_vars]
+                return super().apply_gradients(
+                    grads_and_vars, *args, **kwargs)
+
+            def get_gradients(self, loss, params):  # pragma: no cover
+                grads = super().get_gradients(loss, params)
+                return [_reduce(g) for g in grads]
+
+    _Distributed.__name__ = opt_cls.__name__
+    return _Distributed
+
+
+def DistributedOptimizer(optimizer, name=None, device_dense="",
+                         device_sparse="", compression=Compression.none,
+                         sparse_as_dense=False):
+    """Wrap a ``tf.keras`` optimizer so every gradient is averaged across
+    ranks before being applied (reference signature
+    ``/root/reference/horovod/tensorflow/keras/__init__.py:16-39``;
+    ``device_dense``/``device_sparse`` are accepted for parity and ignored —
+    placement is XLA's job on TPU)."""
+    cls = _wrap_optimizer_class(
+        optimizer.__class__, compression, sparse_as_dense)
+    config = optimizer.get_config()
+    if name is not None:
+        config["name"] = name
+    return cls.from_config(config)
+
+
+def load_model(filepath, custom_optimizers=None, custom_objects=None,
+               compression=Compression.none):
+    """Load a keras model with every optimizer wrapped as a
+    ``DistributedOptimizer`` (reference
+    ``/root/reference/horovod/_keras/__init__.py:93-109``): checkpoints
+    written by a distributed run round-trip back into a distributed run."""
+    tf = _tf()
+    # builtins first, user-supplied layered on top so they win on name
+    # collision (reference precedence, ``_keras/__init__.py:96-105``)
+    opt_classes = [tf.keras.optimizers.SGD, tf.keras.optimizers.Adam,
+                   tf.keras.optimizers.RMSprop, tf.keras.optimizers.Adagrad,
+                   tf.keras.optimizers.Adadelta, tf.keras.optimizers.Adamax,
+                   tf.keras.optimizers.Nadam]
+    opt_classes += list(custom_optimizers or [])
+    objs = {}
+    for cls in opt_classes:
+        objs[cls.__name__] = _wrap_optimizer_class(
+            cls, compression, sparse_as_dense=False)
+    objs.update(custom_objects or {})
+    return tf.keras.models.load_model(filepath, custom_objects=objs)
+
+
+def __getattr__(name):
+    if name == "callbacks":
+        import importlib
+        return importlib.import_module(
+            "horovod_tpu.tensorflow.keras.callbacks")
+    raise AttributeError(name)

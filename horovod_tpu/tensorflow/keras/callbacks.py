@@ -1,0 +1,200 @@
+"""tf.keras callbacks — binding of the reference's callback suite
+(``/root/reference/horovod/tensorflow/keras/callbacks.py``, impls in
+``/root/reference/horovod/_keras/callbacks.py``) to real
+``tf.keras.callbacks.Callback`` objects over the TPU-native core.
+
+* ``BroadcastGlobalVariablesCallback`` — broadcast model + optimizer
+  variables from root at train begin (``_keras/callbacks.py:20-30``).
+* ``MetricAverageCallback`` — allreduce-average epoch metrics
+  (``_keras/callbacks.py:33-67``).
+* ``LearningRateScheduleCallback`` / ``LearningRateWarmupCallback`` — LR
+  schedule with momentum correction / gradual warmup
+  (``_keras/callbacks.py:70-168``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import horovod_tpu as hvd
+
+try:  # TF optional: module stays importable without it (stub base class)
+    from tensorflow.keras.callbacks import Callback as _Base
+except ImportError:  # pragma: no cover - exercised in TF-less images
+    class _Base:  # minimal keras-callback protocol
+        def set_model(self, model):
+            self.model = model
+
+        def set_params(self, params):
+            self.params = params
+
+
+def _var_value(var):
+    try:
+        return float(var.numpy())
+    except Exception:
+        return float(var)
+
+
+def _set_var(owner, attr, value):
+    var = getattr(owner, attr)
+    if hasattr(var, "assign"):
+        var.assign(value)
+    else:
+        setattr(owner, attr, value)
+
+
+class BroadcastGlobalVariablesCallback(_Base):
+    """Broadcast all model and optimizer variables from ``root_rank`` at
+    the start of training (fresh start or checkpoint restore consistency,
+    reference ``_keras/callbacks.py:20-30``)."""
+
+    def __init__(self, root_rank: int = 0, device: str = ""):
+        super().__init__()
+        self.root_rank = root_rank
+        self.broadcast_done = False
+
+    def on_batch_end(self, batch, logs=None):
+        # After the first batch: by then the optimizer has created its slot
+        # variables, so they broadcast too (same reasoning as the reference
+        # broadcasting post-build).
+        if self.broadcast_done:
+            return
+        from horovod_tpu.tensorflow import broadcast_variables
+
+        variables = list(self.model.variables)
+        opt = getattr(self.model, "optimizer", None)
+        if opt is not None:
+            variables += [v for v in getattr(opt, "variables", lambda: [])()]\
+                if callable(getattr(opt, "variables", None)) \
+                else list(getattr(opt, "variables", []))
+        broadcast_variables(variables, self.root_rank)
+        self.broadcast_done = True
+
+
+class MetricAverageCallback(_Base):
+    """Average epoch metrics across ranks in place (sorted by metric name
+    so every rank issues identically-ordered collectives, reference
+    ``_keras/callbacks.py:47-61``)."""
+
+    def __init__(self):
+        super().__init__()
+
+    def on_epoch_end(self, epoch, logs=None):
+        if logs is None:
+            return
+        for name in sorted(logs.keys()):
+            value = logs[name]
+            if isinstance(value, (int, float, np.floating, np.integer)):
+                logs[name] = float(hvd.allreduce(
+                    np.asarray(value, np.float64), average=True,
+                    name=f"metric_{name}"))
+
+
+class LearningRateScheduleCallback(_Base):
+    """Multiply the initial LR by ``multiplier`` (a constant or a function
+    of epoch) between ``start_epoch`` and ``end_epoch``; with
+    ``staircase=False`` the epoch is fractional per batch.  When the
+    optimizer has momentum and ``momentum_correction`` is set, momentum is
+    rescaled by ``new_lr/old_lr`` for the duration of each batch (reference
+    ``_keras/callbacks.py:70-133``, momentum-correction recipe from the
+    large-minibatch SGD paper)."""
+
+    def __init__(self, multiplier, start_epoch: int = 0, end_epoch=None,
+                 staircase: bool = True, momentum_correction: bool = True,
+                 steps_per_epoch=None):
+        super().__init__()
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+        self.staircase = staircase
+        self.momentum_correction = momentum_correction
+        self.steps_per_epoch = steps_per_epoch
+        self.initial_lr = None
+        self.restore_momentum = None
+        self.current_epoch = None
+        if not callable(multiplier):
+            self.staircase = True
+            self.multiplier = lambda epoch: multiplier
+        else:
+            self.multiplier = multiplier
+
+    def _lr_attr(self):
+        opt = self.model.optimizer
+        return "learning_rate" if hasattr(opt, "learning_rate") else "lr"
+
+    def _adjust_learning_rate(self, epoch):
+        opt = self.model.optimizer
+        attr = self._lr_attr()
+        old_lr = _var_value(getattr(opt, attr))
+        new_lr = self.initial_lr * self.multiplier(epoch)
+        _set_var(opt, attr, new_lr)
+        if hasattr(opt, "momentum") and self.momentum_correction \
+                and old_lr > 0:
+            self.restore_momentum = _var_value(opt.momentum)
+            _set_var(opt, "momentum",
+                     self.restore_momentum * new_lr / old_lr)
+
+    def _restore_momentum_if_needed(self):
+        if self.restore_momentum is not None:
+            _set_var(self.model.optimizer, "momentum", self.restore_momentum)
+            self.restore_momentum = None
+
+    def on_train_begin(self, logs=None):
+        self.initial_lr = _var_value(
+            getattr(self.model.optimizer, self._lr_attr()))
+        if not self.staircase and not self.steps_per_epoch:
+            self.steps_per_epoch = self.params.get("steps") \
+                if getattr(self, "params", None) else None
+            if not self.steps_per_epoch:
+                raise ValueError(
+                    "steps_per_epoch is required with staircase=False when "
+                    "it cannot be autodetected from the fit loop")
+
+    def _in_range(self, epoch):
+        return epoch >= self.start_epoch and \
+            (self.end_epoch is None or epoch < self.end_epoch)
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.current_epoch = epoch
+        if self.staircase and self._in_range(epoch):
+            self._adjust_learning_rate(epoch)
+
+    def on_batch_begin(self, batch, logs=None):
+        if not self.staircase and self._in_range(self.current_epoch):
+            epoch = self.current_epoch + float(batch) / self.steps_per_epoch
+            self._adjust_learning_rate(epoch)
+
+    def on_batch_end(self, batch, logs=None):
+        self._restore_momentum_if_needed()
+
+    def on_epoch_end(self, epoch, logs=None):
+        if logs is not None:
+            logs["lr"] = _var_value(
+                getattr(self.model.optimizer, self._lr_attr()))
+
+
+class LearningRateWarmupCallback(LearningRateScheduleCallback):
+    """Gradual warmup from ``lr / size`` to ``lr`` over ``warmup_epochs``
+    (reference ``_keras/callbacks.py:136-168``)."""
+
+    def __init__(self, warmup_epochs: int = 5,
+                 momentum_correction: bool = True, steps_per_epoch=None,
+                 verbose: int = 0):
+        from horovod_tpu.keras.callbacks import warmup_multiplier
+
+        def multiplier(epoch):
+            return warmup_multiplier(epoch, hvd.size(), warmup_epochs)
+        super().__init__(multiplier, start_epoch=0, end_epoch=warmup_epochs,
+                         staircase=False,
+                         momentum_correction=momentum_correction,
+                         steps_per_epoch=steps_per_epoch)
+        self.verbose = verbose
+
+    def on_epoch_end(self, epoch, logs=None):
+        super().on_epoch_end(epoch, logs)
+        if epoch == self.end_epoch - 1 and self.verbose > 0 \
+                and hvd.rank() == 0:
+            new_lr = _var_value(
+                getattr(self.model.optimizer, self._lr_attr()))
+            print(f"\nEpoch {epoch + 1}: finished gradual learning rate "
+                  f"warmup to {new_lr}.")

@@ -19,11 +19,18 @@ succeeds, calling any op raises an actionable ImportError.
 
 from __future__ import annotations
 
+import itertools
 import re
 
 import numpy as np
 
 from horovod_tpu.runtime import state as _state
+
+# unnamed tensors get sequenced names ("allreduce.noname.<n>" in the
+# reference, ``torch/mpi_ops_v2.cc:35-41``): assignment happens at Python
+# trace/call time, which is program-ordered and identical on every rank, so
+# names agree across ranks while staying unique in flight on each
+_noname_counter = itertools.count()
 
 
 def _tf():
@@ -40,7 +47,12 @@ def _tf():
 
 def _normalize(name: str | None, tensor, prefix: str) -> str:
     if name is None:
-        name = getattr(tensor, "name", None) or "noname"
+        try:
+            name = tensor.name  # graph tensors/variables only
+        except Exception:  # eager tensors have no meaningful .name
+            name = None
+    if name is None:
+        name = f"noname.{next(_noname_counter)}"
     # TF variable names contain ':'/'/' which the reference also scrubs
     return f"{prefix}_{re.sub(r'[^A-Za-z0-9_]', '_', str(name))}"
 
@@ -60,6 +72,9 @@ def _run_collective(kind: str, tensor, name: str, root_rank: int = 0):
         else:
             out = eng.synchronize(
                 eng.broadcast_async(arr, root_rank, name))
+        if kind != "allgather":
+            # the wire flattens scalars to 1-element vectors; restore
+            out = out.reshape(arr.shape)
         return out.astype(arr.dtype, copy=False)
 
     out = tf.py_function(_op, [tensor], Tout=tensor.dtype, name=name)

@@ -1,0 +1,128 @@
+"""tf.keras frontend tests — modeled on the reference's
+``test/test_tensorflow_keras.py`` (optimizer wrapping, callbacks, model
+save/load round-trip re-wrapping optimizers).
+
+Single-process (size 1): the distributed semantics collapse to identity,
+which is exactly the reference's single-rank test contract.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+import horovod_tpu.tensorflow.keras as hvd  # noqa: E402
+from horovod_tpu.tensorflow.keras import callbacks as hvd_callbacks  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _hvd():
+    hvd.init()
+    yield
+    hvd.shutdown()
+
+
+def _tiny_model():
+    model = tf.keras.Sequential([
+        tf.keras.layers.Input(shape=(4,)),
+        tf.keras.layers.Dense(3, activation="relu"),
+        tf.keras.layers.Dense(1),
+    ])
+    return model
+
+
+def _data(n=16):
+    rng = np.random.RandomState(0)
+    return rng.randn(n, 4).astype(np.float32), \
+        rng.randn(n, 1).astype(np.float32)
+
+
+def test_distributed_optimizer_wraps_and_trains():
+    model = _tiny_model()
+    opt = hvd.DistributedOptimizer(tf.keras.optimizers.SGD(
+        learning_rate=0.01, momentum=0.9))
+    # dynamic subclass keeps the wrapped class's name (reference behavior)
+    assert opt.__class__.__name__ == "SGD"
+    assert getattr(opt, "_hvd_wrapped", False)
+    model.compile(optimizer=opt, loss="mse")
+    x, y = _data()
+    before = model.evaluate(x, y, verbose=0)
+    model.fit(x, y, batch_size=8, epochs=2, verbose=0)
+    after = model.evaluate(x, y, verbose=0)
+    assert after < before  # it actually optimizes
+
+
+def test_distributed_optimizer_matches_plain_at_size_1():
+    x, y = _data()
+    tf.keras.utils.set_random_seed(7)
+    plain = _tiny_model()
+    plain.compile(optimizer=tf.keras.optimizers.SGD(0.05), loss="mse")
+    plain.fit(x, y, batch_size=8, epochs=1, shuffle=False, verbose=0)
+
+    tf.keras.utils.set_random_seed(7)
+    dist = _tiny_model()
+    dist.compile(optimizer=hvd.DistributedOptimizer(
+        tf.keras.optimizers.SGD(0.05)), loss="mse")
+    dist.fit(x, y, batch_size=8, epochs=1, shuffle=False, verbose=0)
+
+    for a, b in zip(plain.get_weights(), dist.get_weights()):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+def test_callbacks_broadcast_and_metric_average():
+    model = _tiny_model()
+    model.compile(optimizer=hvd.DistributedOptimizer(
+        tf.keras.optimizers.SGD(0.01)), loss="mse")
+    x, y = _data()
+    bcast = hvd_callbacks.BroadcastGlobalVariablesCallback(0)
+    metric = hvd_callbacks.MetricAverageCallback()
+    history = model.fit(x, y, batch_size=8, epochs=1, verbose=0,
+                        callbacks=[bcast, metric])
+    assert bcast.broadcast_done
+    assert "loss" in history.history
+
+
+def test_lr_warmup_schedule():
+    model = _tiny_model()
+    model.compile(optimizer=hvd.DistributedOptimizer(
+        tf.keras.optimizers.SGD(0.08, momentum=0.9)), loss="mse")
+    x, y = _data()
+    warm = hvd_callbacks.LearningRateWarmupCallback(
+        warmup_epochs=2, steps_per_epoch=2)
+    model.fit(x, y, batch_size=8, epochs=3, verbose=0, callbacks=[warm])
+    # warmup done: LR restored to the initial value (size 1 => multiplier 1)
+    assert float(model.optimizer.learning_rate.numpy()) == \
+        pytest.approx(0.08, rel=1e-5)
+    # momentum correction must not leak
+    assert float(np.asarray(model.optimizer.momentum)) == \
+        pytest.approx(0.9, rel=1e-6)
+
+
+def test_lr_schedule_staircase():
+    model = _tiny_model()
+    model.compile(optimizer=hvd.DistributedOptimizer(
+        tf.keras.optimizers.SGD(0.1)), loss="mse")
+    x, y = _data()
+    sched = hvd_callbacks.LearningRateScheduleCallback(
+        multiplier=lambda epoch: 0.1 ** epoch)
+    model.fit(x, y, batch_size=8, epochs=3, verbose=0, callbacks=[sched])
+    assert float(model.optimizer.learning_rate.numpy()) == \
+        pytest.approx(0.1 * 0.1 ** 2, rel=1e-4)
+
+
+def test_load_model_rewraps_optimizer(tmp_path):
+    model = _tiny_model()
+    model.compile(optimizer=hvd.DistributedOptimizer(
+        tf.keras.optimizers.SGD(0.02)), loss="mse")
+    x, y = _data()
+    model.fit(x, y, batch_size=8, epochs=1, verbose=0)
+    path = os.path.join(tmp_path, "model.keras")
+    model.save(path)
+
+    loaded = hvd.load_model(path)
+    assert getattr(loaded.optimizer, "_hvd_wrapped", False)
+    loaded.fit(x, y, batch_size=8, epochs=1, verbose=0)  # still trains
