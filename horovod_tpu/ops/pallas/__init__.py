@@ -7,7 +7,17 @@ attention with online softmax).
 
 from horovod_tpu.ops.pallas.flash_attention import (
     flash_attention,
+    flash_attention_block,
     flash_attn_fn,
+    merge_attention_blocks,
+)
+from horovod_tpu.ops.pallas.ring_flash import (
+    make_ring_flash_attn_fn,
+    ring_flash_attention,
 )
 
-__all__ = ["flash_attention", "flash_attn_fn"]
+__all__ = [
+    "flash_attention", "flash_attention_block", "flash_attn_fn",
+    "merge_attention_blocks", "make_ring_flash_attn_fn",
+    "ring_flash_attention",
+]

@@ -1,4 +1,4 @@
-"""Pallas TPU flash-attention kernel.
+"""Pallas TPU flash-attention kernels — forward AND backward.
 
 The hot op of the transformer path.  Blockwise online-softmax attention with
 the canonical TPU schedule: grid (batch, q-head, q-block, kv-block) with the
@@ -12,12 +12,25 @@ index maps, so K/V blocks are fetched once per kv head group.
 The causal mask is computed from global positions ``q_start + i`` /
 ``k_start + j``, making the kernel directly usable as the per-step block
 compute of ring attention (each ring hop presents a contiguous KV block with
-a rotating global offset).
+a rotating global offset); blocks that the causal mask fully excludes are
+skipped on-device.
 
-Backward: recompute-based ``jax.custom_vjp`` — the VJP replays the
-blockwise reference implementation (``lax.scan`` over KV blocks) under
-autodiff, giving exact gradients with blockwise memory; the Pallas kernel
-accelerates the forward (and inference).
+Backward is two Pallas kernels (the standard flash-attention-2 split):
+
+* **dq kernel** — grid (B, Hq, q-block, kv-block), kv innermost; recomputes
+  the probability block from the saved log-sum-exp and accumulates
+  ``dq += ds @ k`` in VMEM scratch.
+* **dkv kernel** — grid (B, Hq, kv-block, q-block), q innermost; accumulates
+  ``dk += dsᵀ @ q`` and ``dv += pᵀ @ do`` per query head, summed over the
+  GQA group outside.
+
+Both take ``dterm = rowsum(do·out) − dlse`` precomputed on the host side of
+the kernel, so the same kernels serve plain attention (``dlse = 0``) and the
+merged-block ring formulation (``dlse`` from the log-sum-exp merge).
+
+This is the TPU-native analog of the reference's rule that the hot op gets
+native code (its CPU/GPU data plane lives in C++/CUDA,
+``/root/reference/horovod/common/operations.cc:768-1621``).
 """
 
 from __future__ import annotations
@@ -28,15 +41,18 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from horovod_tpu.parallel.ring_attention import local_flash_attention
-
 _MASK = -1.0e30
 
 
-def _fa_kernel(qs_ref, ks_ref, q_ref, k_ref, v_ref, o_ref,
+# ---------------------------------------------------------------------------
+# forward kernel
+# ---------------------------------------------------------------------------
+
+def _fa_kernel(qs_ref, ks_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
                acc_ref, m_ref, l_ref, *, scale, causal, block_q, block_k):
     from jax.experimental import pallas as pl
 
+    i = pl.program_id(2)
     j = pl.program_id(3)
     nj = pl.num_programs(3)
 
@@ -46,44 +62,58 @@ def _fa_kernel(qs_ref, ks_ref, q_ref, k_ref, v_ref, o_ref,
         m_ref[:] = jnp.full_like(m_ref, _MASK)
         l_ref[:] = jnp.zeros_like(l_ref)
 
-    q = q_ref[0, 0].astype(jnp.float32)                   # [bq, Dh]
-    k = k_ref[0, 0].astype(jnp.float32)                   # [bk, Dh]
-    s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32) * scale       # [bq, bk]
-
+    # causal block skip: the block contributes iff some kpos <= some qpos,
+    # i.e. first kpos <= last qpos
+    needed = True
     if causal:
-        i = pl.program_id(2)
-        qpos = qs_ref[0] + i * block_q + lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0)
-        kpos = ks_ref[0] + j * block_k + lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1)
-        s = jnp.where(kpos <= qpos, s, _MASK)
+        needed = ks_ref[0] + j * block_k <= qs_ref[0] + (i + 1) * block_q - 1
 
-    m_prev = m_ref[:, 0:1]                                # [bq, 1]
-    l_prev = l_ref[:, 0:1]
-    m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-    # zero masked entries explicitly: a fully-masked row keeps m == _MASK
-    # and exp(s - m) would be 1, not 0
-    p = jnp.exp(s - m_new) * (s > 0.5 * _MASK)            # [bq, bk]
-    corr = jnp.exp(m_prev - m_new)                        # [bq, 1]
-    l_new = l_prev * corr + jnp.sum(p, axis=1, keepdims=True)
-    v = v_ref[0, 0].astype(jnp.float32)                   # [bk, Dh]
-    pv = jax.lax.dot_general(
-        p, v, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)               # [bq, Dh]
-    acc_ref[:] = acc_ref[:] * corr + pv
-    m_ref[:, 0:1] = m_new
-    l_ref[:, 0:1] = l_new
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)                   # [bq, Dh]
+        k = k_ref[0, 0].astype(jnp.float32)                   # [bk, Dh]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale       # [bq, bk]
+
+        if causal:
+            qpos = qs_ref[0] + i * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = ks_ref[0] + j * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(kpos <= qpos, s, _MASK)
+
+        m_prev = m_ref[:, 0:1]                                # [bq, 1]
+        l_prev = l_ref[:, 0:1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        # zero masked entries explicitly: a fully-masked row keeps m == _MASK
+        # and exp(s - m) would be 1, not 0
+        p = jnp.exp(s - m_new) * (s > 0.5 * _MASK)            # [bq, bk]
+        corr = jnp.exp(m_prev - m_new)                        # [bq, 1]
+        l_new = l_prev * corr + jnp.sum(p, axis=1, keepdims=True)
+        v = v_ref[0, 0].astype(jnp.float32)                   # [bk, Dh]
+        pv = jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)               # [bq, Dh]
+        acc_ref[:] = acc_ref[:] * corr + pv
+        m_ref[:, 0:1] = m_new
+        l_ref[:, 0:1] = l_new
 
     @pl.when(j == nj - 1)
     def _finalize():
+        l = l_ref[:, 0:1]
         o_ref[0, 0] = (acc_ref[:] /
-                       jnp.maximum(l_ref[:, 0:1], 1e-30)).astype(o_ref.dtype)
+                       jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+        # log-sum-exp per row, lane-replicated to the (bq, 128) stats layout
+        # (Mosaic wants >=2D blocks with (8k, 128k) minor dims); fully-masked
+        # rows stay at ~_MASK (m == _MASK)
+        lse = m_ref[:, 0:1] + jnp.log(jnp.maximum(l_ref[:, 0:1], 1e-30))
+        lse_ref[0, 0] = jnp.broadcast_to(lse, lse_ref.shape[2:])
 
 
 def _flash_fwd_pallas(q, k, v, q_start, k_start, causal, block_q, block_k,
                       interpret):
+    """Returns (out [B,T,Hq,Dh] in q.dtype, lse [B,Hq,T] fp32)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -103,7 +133,7 @@ def _flash_fwd_pallas(q, k, v, q_start, k_start, causal, block_q, block_k,
     kernel = functools.partial(_fa_kernel, scale=scale, causal=causal,
                                block_q=bq, block_k=bk)
     grid = (B, Hq, T // bq, S // bk)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -113,8 +143,16 @@ def _flash_fwd_pallas(q, k, v, q_start, k_start, causal, block_q, block_k,
             pl.BlockSpec((1, 1, bk, Dh), lambda b, h, i, j: (b, h // G, j, 0)),
             pl.BlockSpec((1, 1, bk, Dh), lambda b, h, i, j: (b, h // G, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, 1, bq, Dh), lambda b, h, i, j: (b, h, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((B, Hq, T, Dh), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, 1, bq, Dh), lambda b, h, i, j: (b, h, i, 0)),
+            # per-row stats are lane-replicated to (bq, 128) — the layout
+            # Mosaic supports for >=2D blocks (minor dims (8k, 128k))
+            pl.BlockSpec((1, 1, bq, 128), lambda b, h, i, j: (b, h, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, Hq, T, Dh), q.dtype),
+            jax.ShapeDtypeStruct((B, Hq, T, 128), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((bq, Dh), jnp.float32),            # acc
             pltpu.VMEM((bq, 128), jnp.float32),           # running max
@@ -123,48 +161,265 @@ def _flash_fwd_pallas(q, k, v, q_start, k_start, causal, block_q, block_k,
         interpret=interpret,
     )(jnp.asarray([q_start], jnp.int32), jnp.asarray([k_start], jnp.int32),
       qt, kt, vt)
-    return jnp.moveaxis(out, 1, 2)                        # [B, T, Hq, Dh]
+    return jnp.moveaxis(out, 1, 2), lse[..., 0]           # [B,T,Hq,Dh], [B,Hq,T]
 
 
-def _reference(q, k, v, q_start, k_start, causal, block_k):
-    T, S = q.shape[1], k.shape[1]
-    qpos = q_start + jnp.arange(T, dtype=jnp.int32)
-    kpos = k_start + jnp.arange(S, dtype=jnp.int32)
-    return local_flash_attention(q, k, v, qpos, kpos, causal=causal,
-                                 block_size=min(block_k, S))
+# ---------------------------------------------------------------------------
+# backward kernels
+# ---------------------------------------------------------------------------
 
+def _dq_kernel(qs_ref, ks_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+               dterm_ref, dq_ref, dq_acc, *, scale, causal, block_q, block_k):
+    from jax.experimental import pallas as pl
+
+    i = pl.program_id(2)
+    j = pl.program_id(3)
+    nj = pl.num_programs(3)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    needed = True
+    if causal:
+        needed = ks_ref[0] + j * block_k <= qs_ref[0] + (i + 1) * block_q - 1
+
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)                   # [bq, Dh]
+        k = k_ref[0, 0].astype(jnp.float32)                   # [bk, Dh]
+        v = v_ref[0, 0].astype(jnp.float32)                   # [bk, Dh]
+        do = do_ref[0, 0].astype(jnp.float32)                 # [bq, Dh]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale       # [bq, bk]
+        if causal:
+            qpos = qs_ref[0] + i * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = ks_ref[0] + j * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(kpos <= qpos, s, _MASK)
+        lse = lse_ref[0, 0][:, 0:1]                           # [bq, 1]
+        p = jnp.exp(s - lse) * (s > 0.5 * _MASK)              # [bq, bk]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)               # [bq, bk]
+        ds = p * (dp - dterm_ref[0, 0][:, 0:1])               # [bq, bk]
+        dq_acc[:] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    @pl.when(j == nj - 1)
+    def _finalize():
+        dq_ref[0, 0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(qs_ref, ks_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                dterm_ref, dk_ref, dv_ref, dk_acc, dv_acc,
+                *, scale, causal, block_q, block_k):
+    from jax.experimental import pallas as pl
+
+    j = pl.program_id(2)          # kv block (outer)
+    i = pl.program_id(3)          # q block (inner sweep)
+    ni = pl.num_programs(3)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    needed = True
+    if causal:
+        needed = ks_ref[0] + j * block_k <= qs_ref[0] + (i + 1) * block_q - 1
+
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)                   # [bq, Dh]
+        k = k_ref[0, 0].astype(jnp.float32)                   # [bk, Dh]
+        v = v_ref[0, 0].astype(jnp.float32)                   # [bk, Dh]
+        do = do_ref[0, 0].astype(jnp.float32)                 # [bq, Dh]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale       # [bq, bk]
+        if causal:
+            qpos = qs_ref[0] + i * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = ks_ref[0] + j * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(kpos <= qpos, s, _MASK)
+        lse = lse_ref[0, 0][:, 0:1]                           # [bq, 1]
+        p = jnp.exp(s - lse) * (s > 0.5 * _MASK)              # [bq, bk]
+        # dv += pᵀ @ do
+        dv_acc[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)               # [bk, Dh]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)               # [bq, bk]
+        ds = p * (dp - dterm_ref[0, 0][:, 0:1])               # [bq, bk]
+        # dk += dsᵀ @ q * scale
+        dk_acc[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    @pl.when(i == ni - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd_pallas(q, k, v, out, lse, do, dlse, q_start, k_start, causal,
+                      block_q, block_k, interpret):
+    """dq/dk/dv via the two backward kernels.  ``dlse`` is the cotangent of
+    the log-sum-exp output (zeros for plain attention)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, T, Hq, Dh = q.shape
+    S, Hkv = k.shape[1], k.shape[2]
+    G = Hq // Hkv
+    bq = min(block_q, T)
+    bk = min(block_k, S)
+    scale = float(1.0 / (Dh ** 0.5))
+
+    qt = jnp.moveaxis(q, 2, 1)                            # [B, Hq, T, Dh]
+    kt = jnp.moveaxis(k, 2, 1)                            # [B, Hkv, S, Dh]
+    vt = jnp.moveaxis(v, 2, 1)
+    dot = jnp.moveaxis(do, 2, 1).astype(q.dtype)          # [B, Hq, T, Dh]
+
+    # delta = rowsum(do * out) per query row; dterm = delta - dlse,
+    # lane-replicated to [B, Hq, T, 128] for the Mosaic stats-block layout
+    delta = jnp.einsum("bthd,bthd->bht", do.astype(jnp.float32),
+                       out.astype(jnp.float32))           # [B, Hq, T]
+    dterm = delta - dlse.astype(jnp.float32)
+    dterm = jnp.broadcast_to(dterm[..., None], (B, Hq, T, 128))
+    lse = jnp.broadcast_to(lse[..., None], (B, Hq, T, 128))
+
+    smem = [pl.BlockSpec(memory_space=pltpu.SMEM)] * 2
+    starts = (jnp.asarray([q_start], jnp.int32),
+              jnp.asarray([k_start], jnp.int32))
+
+    kernel = functools.partial(_dq_kernel, scale=scale, causal=causal,
+                               block_q=bq, block_k=bk)
+    dq = pl.pallas_call(
+        kernel,
+        grid=(B, Hq, T // bq, S // bk),
+        in_specs=smem + [
+            pl.BlockSpec((1, 1, bq, Dh), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bk, Dh), lambda b, h, i, j: (b, h // G, j, 0)),
+            pl.BlockSpec((1, 1, bk, Dh), lambda b, h, i, j: (b, h // G, j, 0)),
+            pl.BlockSpec((1, 1, bq, Dh), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bq, 128), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bq, 128), lambda b, h, i, j: (b, h, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, bq, Dh), lambda b, h, i, j: (b, h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, Hq, T, Dh), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, Dh), jnp.float32)],
+        interpret=interpret,
+    )(*starts, qt, kt, vt, dot, lse, dterm)
+
+    kernel = functools.partial(_dkv_kernel, scale=scale, causal=causal,
+                               block_q=bq, block_k=bk)
+    dk, dv = pl.pallas_call(
+        kernel,
+        grid=(B, Hq, S // bk, T // bq),
+        in_specs=smem + [
+            pl.BlockSpec((1, 1, bq, Dh), lambda b, h, j, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bk, Dh), lambda b, h, j, i: (b, h // G, j, 0)),
+            pl.BlockSpec((1, 1, bk, Dh), lambda b, h, j, i: (b, h // G, j, 0)),
+            pl.BlockSpec((1, 1, bq, Dh), lambda b, h, j, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bq, 128), lambda b, h, j, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bq, 128), lambda b, h, j, i: (b, h, i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bk, Dh), lambda b, h, j, i: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, bk, Dh), lambda b, h, j, i: (b, h, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, Hq, S, Dh), k.dtype),
+            jax.ShapeDtypeStruct((B, Hq, S, Dh), v.dtype),
+        ],
+        scratch_shapes=[pltpu.VMEM((bk, Dh), jnp.float32),
+                        pltpu.VMEM((bk, Dh), jnp.float32)],
+        interpret=interpret,
+    )(*starts, qt, kt, vt, dot, lse, dterm)
+
+    # sum the per-query-head dk/dv over each GQA group
+    dk = dk.reshape(B, Hkv, G, S, Dh).sum(axis=2)
+    dv = dv.reshape(B, Hkv, G, S, Dh).sum(axis=2)
+    dq = jnp.moveaxis(dq, 1, 2)                           # [B, T, Hq, Dh]
+    dk = jnp.moveaxis(dk, 1, 2).astype(k.dtype)           # [B, S, Hkv, Dh]
+    dv = jnp.moveaxis(dv, 1, 2).astype(v.dtype)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# public API: flash_attention (out only) + flash_attention_block (out, lse)
+# ---------------------------------------------------------------------------
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
-def flash_attention(q, k, v, q_start=0, k_start=0, causal=True,
-                    block_q=128, block_k=128, interpret=False):
-    """Flash attention.  ``q``: [B, T, Hq, Dh]; ``k``/``v``: [B, S, Hkv, Dh]
-    (GQA when Hkv < Hq).  ``q_start``/``k_start`` are the global positions of
-    the first query/key (for sequence-sharded blocks); causal masking uses
-    global positions.  Returns [B, T, Hq, Dh] in ``q.dtype``.
+def flash_attention_block(q, k, v, q_start=0, k_start=0, causal=True,
+                          block_q=128, block_k=128, interpret=False):
+    """Flash attention returning ``(out, lse)``.
 
-    ``interpret=True`` runs the kernel in the Pallas interpreter (CPU
+    ``q``: [B, T, Hq, Dh]; ``k``/``v``: [B, S, Hkv, Dh] (GQA when
+    Hkv < Hq).  ``q_start``/``k_start`` are the global positions of the
+    first query/key (for sequence-sharded blocks); causal masking uses
+    global positions.  ``out``: [B, T, Hq, Dh] in ``q.dtype``; ``lse``:
+    [B, Hq, T] fp32 log-sum-exp per query row (~-1e30 for fully-masked
+    rows).  Differentiable in both outputs, so per-hop results can be
+    merged with :func:`merge_attention_blocks` (ring attention) with exact
+    gradients.
+
+    ``interpret=True`` runs the kernels in the Pallas interpreter (CPU
     testing).
     """
     return _flash_fwd_pallas(q, k, v, q_start, k_start, causal,
                              block_q, block_k, interpret)
 
 
-def _fwd(q, k, v, q_start, k_start, causal, block_q, block_k, interpret):
-    out = _flash_fwd_pallas(q, k, v, q_start, k_start, causal,
-                            block_q, block_k, interpret)
-    return out, (q, k, v, q_start, k_start)
+def _block_fwd(q, k, v, q_start, k_start, causal, block_q, block_k, interpret):
+    out, lse = _flash_fwd_pallas(q, k, v, q_start, k_start, causal,
+                                 block_q, block_k, interpret)
+    return (out, lse), (q, k, v, out, lse, q_start, k_start)
 
 
-def _bwd(causal, block_q, block_k, interpret, res, g):
-    q, k, v, q_start, k_start = res
-    _, vjp = jax.vjp(
-        lambda q, k, v: _reference(q, k, v, q_start, k_start, causal, block_k),
-        q, k, v)
-    dq, dk, dv = vjp(g.astype(q.dtype))
+def _block_bwd(causal, block_q, block_k, interpret, res, g):
+    q, k, v, out, lse, q_start, k_start = res
+    do, dlse = g
+    dlse = jnp.zeros_like(lse) if dlse is None else dlse
+    dq, dk, dv = _flash_bwd_pallas(q, k, v, out, lse, do.astype(jnp.float32),
+                                   dlse, q_start, k_start, causal,
+                                   block_q, block_k, interpret)
     return dq, dk, dv, None, None
 
 
-flash_attention.defvjp(_fwd, _bwd)
+flash_attention_block.defvjp(_block_fwd, _block_bwd)
+
+
+def flash_attention(q, k, v, q_start=0, k_start=0, causal=True,
+                    block_q=128, block_k=128, interpret=False):
+    """Flash attention returning just the output [B, T, Hq, Dh]
+    (:func:`flash_attention_block` without the log-sum-exp)."""
+    out, _ = flash_attention_block(q, k, v, q_start, k_start, causal,
+                                   block_q, block_k, interpret)
+    return out
+
+
+def merge_attention_blocks(o_a, lse_a, o_b, lse_b):
+    """Merge two normalized attention partials over disjoint KV blocks.
+
+    ``o``: [B, T, Hq, Dh]; ``lse``: [B, Hq, T].  Standard log-sum-exp
+    combine; a fully-masked partial (lse ~ -1e30) contributes zero weight.
+    Differentiable — gradients flow into both partials and both lse's.
+    """
+    lse_new = jnp.logaddexp(lse_a, lse_b)                 # [B, Hq, T]
+    w_a = jnp.exp(lse_a - lse_new)[..., None]             # [B, Hq, T, 1]
+    w_b = jnp.exp(lse_b - lse_new)[..., None]
+    oa = jnp.moveaxis(o_a, 2, 1).astype(jnp.float32)      # [B, Hq, T, Dh]
+    ob = jnp.moveaxis(o_b, 2, 1).astype(jnp.float32)
+    o = oa * w_a + ob * w_b
+    return jnp.moveaxis(o, 1, 2).astype(o_a.dtype), lse_new
 
 
 def flash_attn_fn(causal: bool = True, block_q: int = 128,

@@ -1,0 +1,84 @@
+"""Ring attention with the Pallas flash kernel as the per-hop block compute.
+
+Runs inside ``shard_map`` with ``axis_name`` bound.  Each device keeps its Q
+block resident; K/V blocks rotate around the ring via ``ppermute``.  Every
+hop runs :func:`flash_attention_block` (out + log-sum-exp) and the partials
+are folded with :func:`merge_attention_blocks` — the log-sum-exp merge whose
+gradients route exactly through the kernel's custom VJP (the ``dlse``
+cotangent feeds the backward kernels' ``dterm``).
+
+Compared to the pure-jnp :func:`horovod_tpu.parallel.ring_attention.
+ring_attention`, the inner loop is a Mosaic kernel: fp32 accumulators in
+VMEM, one MXU matmul pair per block, causal blocks skipped on-device — while
+the ``ppermute`` transfers still pipeline over the ICI ring.
+
+Requires contiguous position blocks (the standard sequence sharding):
+``q_positions`` / ``kv_positions`` are the global offsets of the local
+blocks, as produced by splitting 0..T-1 across the axis.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from horovod_tpu.ops.pallas.flash_attention import (
+    _MASK,
+    flash_attention_block,
+    merge_attention_blocks,
+)
+from horovod_tpu.parallel.ring_attention import _varying
+
+
+def ring_flash_attention(q, k, v, axis_name: str, q_positions,
+                         kv_positions=None, causal: bool = True,
+                         block_q: int = 128, block_k: int = 128,
+                         interpret: bool = False, remat: bool = True):
+    """q: [B, T_local, Hq, Dh]; k/v: [B, S_local, Hkv, Dh]; positions are
+    global token indices of the local block (must be contiguous).  Returns
+    [B, T_local, Hq, Dh] in ``q.dtype``."""
+    n = lax.axis_size(axis_name)
+    B, T, Hq, Dh = q.shape
+    if kv_positions is None:
+        kv_positions = q_positions
+    q_start = q_positions[0]
+    k_start0 = kv_positions[:1]                           # [1] so ppermute works
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, _):
+        o_acc, lse_acc, kcur, vcur, kstart = carry
+        o_i, lse_i = flash_attention_block(
+            q, kcur, vcur, q_start, kstart[0], causal,
+            block_q, block_k, interpret)
+        o_acc, lse_acc = merge_attention_blocks(o_acc, lse_acc, o_i, lse_i)
+        kcur = lax.ppermute(kcur, axis_name, perm)
+        vcur = lax.ppermute(vcur, axis_name, perm)
+        kstart = lax.ppermute(kstart, axis_name, perm)
+        return (o_acc, lse_acc, kcur, vcur, kstart), None
+
+    if remat:
+        step = jax.checkpoint(step)
+
+    # fp32 accumulator across hops (merge preserves the accumulator dtype);
+    # single downcast to q.dtype after the scan
+    o0 = _varying(jnp.zeros((B, T, Hq, Dh), jnp.float32), axis_name)
+    lse0 = _varying(jnp.full((B, Hq, T), _MASK, jnp.float32), axis_name)
+    (o, _, _, _, _), _ = lax.scan(step, (o0, lse0, k, v, k_start0), None,
+                                  length=n)
+    return o.astype(q.dtype)
+
+
+def make_ring_flash_attn_fn(axis_name: str, block_q: int = 128,
+                            block_k: int = 128, interpret: bool = False):
+    """Adapter producing the ``attn_fn(q, k, v, positions)`` callback used by
+    :func:`horovod_tpu.models.llama.apply` (inside a shard_map region)."""
+
+    def attn_fn(q, k, v, positions):
+        out = ring_flash_attention(q, k, v, axis_name, positions,
+                                   block_q=block_q, block_k=block_k,
+                                   interpret=interpret)
+        B, T, Hq, Dh = out.shape
+        return out.reshape(B, T, Hq * Dh)
+
+    return attn_fn

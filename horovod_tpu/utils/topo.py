@@ -123,6 +123,8 @@ _LOCAL_SIZE_ENV = (
     "HOROVOD_LOCAL_SIZE",
     "OMPI_COMM_WORLD_LOCAL_SIZE",
 )
+_CROSS_RANK_ENV = ("HOROVOD_TPU_CROSS_RANK", "HOROVOD_CROSS_RANK")
+_CROSS_SIZE_ENV = ("HOROVOD_TPU_CROSS_SIZE", "HOROVOD_CROSS_SIZE")
 
 
 def _env_int(names: Sequence[str]) -> int | None:
@@ -184,8 +186,15 @@ def detect_topology() -> Topology:
     if local_size is None:
         local_size = 1 if size == 1 else size
 
-    cross_size = max(1, size // max(1, local_size))
-    cross_rank = rank // max(1, local_size)
+    # Launcher-exported cross topology wins: with heterogeneous slot layouts
+    # (e.g. --hosts host1:3,host2:5) the homogeneous rank//local_size formula
+    # below is wrong, and run.py exports the true values per process.
+    cross_rank = _env_int(_CROSS_RANK_ENV)
+    cross_size = _env_int(_CROSS_SIZE_ENV)
+    if cross_size is None:
+        cross_size = max(1, size // max(1, local_size))
+    if cross_rank is None:
+        cross_rank = rank // max(1, local_size)
     return Topology(
         rank=rank,
         size=size,
