@@ -336,9 +336,12 @@ Status Engine::Init(const std::string& host, int port, int rank, int size) {
 void Engine::Shutdown() {
   {
     std::lock_guard<std::mutex> lk(mu_);
-    if (!running_) return;
     shutdown_requested_ = true;
   }
+  // Always join, even when the loop already stopped on its own (a peer's
+  // shutdown propagated and set running_ = false): skipping the join there
+  // would leave bg_ joinable and its destruction at process exit would
+  // call std::terminate.  join-after-join is guarded by joinable().
   if (bg_.joinable()) bg_.join();
   timeline_.Shutdown();
 }

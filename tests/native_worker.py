@@ -170,6 +170,25 @@ def scenario_autotune():
     print(f"rank {r}: autotune OK")
 
 
+def scenario_skewed_shutdown():
+    """Rank 0 lags its shutdown by seconds (checkpointing, logging...) while
+    the peers shut down and exit immediately.  Regression: the engine's
+    background loop stops on its own when a peer's shutdown propagates; a
+    later explicit Shutdown() must still join the thread, or the joinable
+    std::thread's destruction at process exit calls std::terminate
+    (observed as 'terminate called without an active exception', SIGABRT)."""
+    import time
+
+    hvd.init()
+    r = hvd.rank()
+    out = hvd.allreduce(np.ones(4, np.float32), average=False, name="warm")
+    assert np.allclose(out, hvd.size())
+    if r == 0:
+        time.sleep(3)
+    hvd.shutdown()
+    print(f"rank {r}: skewed shutdown OK", flush=True)
+
+
 def scenario_crash():
     hvd.init()
     if hvd.rank() == 1:

@@ -43,6 +43,17 @@ def test_cross_rank_errors_do_not_hang():
         assert f"rank {r}: errors OK" in res.stdout
 
 
+def test_skewed_shutdown_exits_cleanly():
+    """Rank-0-delayed shutdown (e.g. rank-0-only checkpointing) must not
+    SIGABRT: the engine joins its background thread even when the loop
+    already stopped via a peer's propagated shutdown."""
+    res = _run("skewed_shutdown", 2)
+    assert res.returncode == 0, res.stderr + res.stdout
+    assert "terminate called" not in res.stderr
+    for r in range(2):
+        assert f"rank {r}: skewed shutdown OK" in res.stdout
+
+
 def test_stall_warning():
     res = _run("stall", 2, env={"HOROVOD_TPU_STALL_WARNING_SECS": "1"})
     assert res.returncode == 0, res.stderr + res.stdout
