@@ -111,7 +111,9 @@ def broadcast_parameters(params, root_rank: int = 0):
                             name=f"param.{i}")
         for i, leaf in enumerate(leaves)
     ]
-    out = [jnp.asarray(hvd.synchronize(h)) for h in handles]
+    # the engine wire carries rank-1 buffers; restore 0-d leaf shapes
+    out = [jnp.asarray(hvd.synchronize(h)).reshape(jnp.shape(leaf))
+           for h, leaf in zip(handles, leaves)]
     return jax.tree.unflatten(treedef, out)
 
 

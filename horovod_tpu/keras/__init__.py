@@ -103,6 +103,46 @@ class Trainer:
         self._step = jax.jit(
             step, donate_argnums=(0, 1) if donate else ())
 
+        # multi-process path: compiled grad + compiled apply, with the
+        # eager engine's fused allreduce between them — the reference's
+        # framework-computes / engine-reduces split (keras gradients flow
+        # through hvd allreduce, `_keras/__init__.py:20-70`)
+        self._grad = jax.jit(jax.value_and_grad(loss_fn))
+
+        def apply_grads(params, opt_state, grads):
+            import optax
+
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state
+
+        self._apply = jax.jit(
+            apply_grads, donate_argnums=(0, 1) if donate else ())
+
+    def _run_step(self, batch):
+        import horovod_tpu as hvd
+
+        if not (hvd.is_initialized() and hvd.size() > 1):
+            return self._step(self.params, self.opt_state, batch)
+        import numpy as np
+
+        import jax
+
+        loss, grads = self._grad(self.params, batch)
+        leaves, treedef = jax.tree.flatten(grads)
+        # issue all allreduces before waiting: the engine fuses them
+        handles = [
+            hvd.allreduce_async(np.asarray(jax.device_get(g)), average=True,
+                                name=f"grad.{i}")
+            for i, g in enumerate(leaves)
+        ]
+        # the engine wire carries rank-1 buffers; restore 0-d leaf shapes
+        reduced = jax.tree.unflatten(
+            treedef,
+            [np.asarray(hvd.synchronize(h)).reshape(np.shape(g))
+             for h, g in zip(handles, leaves)])
+        params, opt_state = self._apply(self.params, self.opt_state, reduced)
+        return params, opt_state, loss
+
     # -- LR / momentum control for schedule callbacks ----------------------
     @property
     def lr(self) -> float:
@@ -171,8 +211,7 @@ class Trainer:
             for i, batch in enumerate(batches):
                 for cb in callbacks:
                     cb.on_batch_begin(i)
-                self.params, self.opt_state, loss = self._step(
-                    self.params, self.opt_state, batch)
+                self.params, self.opt_state, loss = self._run_step(batch)
                 losses.append(loss)
                 for cb in callbacks:
                     cb.on_batch_end(i)
