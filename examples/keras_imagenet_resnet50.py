@@ -1,0 +1,116 @@
+"""Keras-style ResNet-50 training with horovod_tpu's JAX Keras frontend.
+
+TPU-native counterpart of
+``/root/reference/examples/keras_imagenet_resnet50.py``: the same training
+recipe — ``create_distributed_optimizer``, rank-0 weight broadcast,
+metric averaging, LR warmup schedule, rank-0-only checkpointing — on the
+framework's JAX trainer and native ResNet instead of keras-on-TF, with
+synthetic ImageNet-shaped data (no dataset egress in this image).
+
+Run:
+  python examples/keras_imagenet_resnet50.py --depth 18 --image-size 64
+  python -m horovod_tpu.run -np 2 python \
+      examples/keras_imagenet_resnet50.py --depth 18 --image-size 64
+(depth 50 / image-size 224 reproduce the reference's config.)
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import tempfile
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--depth", type=int, default=50,
+                    choices=(50, 101, 152))
+    ap.add_argument("--width", type=int, default=64,
+                    help="stem width (64 = standard; smaller for smoke runs)")
+    ap.add_argument("--image-size", type=int, default=224)
+    ap.add_argument("--num-classes", type=int, default=1000)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--batches-per-epoch", type=int, default=4)
+    ap.add_argument("--base-lr", type=float, default=0.0125)
+    ap.add_argument("--warmup-epochs", type=int, default=1)
+    ap.add_argument("--checkpoint-dir", default=None)
+    args = ap.parse_args()
+
+    from horovod_tpu.utils import cpu_requested, force_cpu_backend
+
+    if cpu_requested():
+        force_cpu_backend()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    import horovod_tpu.keras as hvd_keras
+    import horovod_tpu.jax as hvd
+    from horovod_tpu.keras import callbacks as hvd_callbacks
+    from horovod_tpu.models import resnet
+
+    hvd.init()
+
+    config = resnet.ResNetConfig(depth=args.depth, width=args.width,
+                                 num_classes=args.num_classes)
+    params, state = resnet.init(jax.random.key(0), config)
+
+    # reference recipe: lr scales with world size, warmup callback ramps it.
+    # axis_name=None: cross-process gradient averaging happens through the
+    # eager engine inside Trainer (there is no mesh axis in this jit step)
+    opt = hvd_keras.create_distributed_optimizer(
+        optax.sgd, learning_rate=args.base_lr * hvd.size(), momentum=0.9,
+        axis_name=None)
+
+    # BN statistics ride along in the bundle; this demo keeps them frozen
+    # (the trainer optimizes a scalar loss_fn)
+    def loss_fn(bundle, batch):
+        images, labels = batch
+        loss, _new_state = resnet.loss_fn(bundle["params"], bundle["state"],
+                                          images, labels, config)
+        return loss
+
+    trainer = hvd_keras.Trainer(
+        loss_fn, {"params": params, "state": state}, opt)
+
+    # synthetic ImageNet shard for this rank
+    rng = np.random.RandomState(1234 + hvd.rank())
+    batches = [
+        (jnp.asarray(rng.rand(args.batch_size, args.image_size,
+                              args.image_size, 3), jnp.float32),
+         jnp.asarray(rng.randint(0, args.num_classes, args.batch_size),
+                     jnp.int32))
+        for _ in range(args.batches_per_epoch)
+    ]
+
+    ckpt_dir = args.checkpoint_dir
+    if ckpt_dir is None and hvd.rank() == 0:
+        ckpt_dir = tempfile.mkdtemp(prefix="hvd_keras_ckpt_")
+
+    cbs = [
+        # start from rank 0's weights (BroadcastGlobalVariablesHook analog)
+        hvd_callbacks.BroadcastGlobalVariablesCallback(0),
+        hvd_callbacks.MetricAverageCallback(),
+        hvd_callbacks.LearningRateWarmupCallback(
+            warmup_epochs=args.warmup_epochs, verbose=False),
+    ]
+    history = trainer.fit(batches, epochs=args.epochs, callbacks=cbs)
+
+    if hvd.rank() == 0:
+        # checkpoint on rank 0 only (reference keras_imagenet_resnet50.py
+        # checkpointing convention)
+        path = os.path.join(ckpt_dir, "checkpoint-final")
+        hvd_keras.save_model(path, trainer.params, trainer.opt_state)
+        losses = [h["loss"] for h in history]
+        print(f"epoch losses: {[round(l, 4) for l in losses]}", flush=True)
+        print(f"checkpoint: {path}", flush=True)
+        assert losses[-1] < losses[0] * 1.5, losses  # sanity: not diverging
+        print("DONE", flush=True)
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,117 @@
+"""PyTorch MNIST with horovod_tpu — the BASELINE.json smoke config.
+
+TPU-native counterpart of ``/root/reference/examples/pytorch_mnist.py``:
+same structure (DistributedOptimizer wrapping, parameter + optimizer-state
+broadcast from rank 0, per-rank data sharding, lr scaled by world size,
+rank-0-only logging), but on synthetic MNIST-shaped data — this image has
+no dataset egress, and the example is about the distributed plumbing, not
+the pixels.
+
+Run:
+  python examples/pytorch_mnist.py                       # single process
+  python -m horovod_tpu.run -np 2 python examples/pytorch_mnist.py
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+import torch
+import torch.nn as nn
+import torch.nn.functional as F
+import torch.optim as optim
+
+import horovod_tpu.torch as hvd
+
+
+class Net(nn.Module):
+    """The reference example's model (pytorch_mnist.py:17-35)."""
+
+    def __init__(self):
+        super().__init__()
+        self.conv1 = nn.Conv2d(1, 10, kernel_size=5)
+        self.conv2 = nn.Conv2d(10, 20, kernel_size=5)
+        self.conv2_drop = nn.Dropout2d()
+        self.fc1 = nn.Linear(320, 50)
+        self.fc2 = nn.Linear(50, 10)
+
+    def forward(self, x):
+        x = F.relu(F.max_pool2d(self.conv1(x), 2))
+        x = F.relu(F.max_pool2d(self.conv2_drop(self.conv2(x)), 2))
+        x = x.view(-1, 320)
+        x = F.relu(self.fc1(x))
+        x = F.dropout(x, training=self.training)
+        x = self.fc2(x)
+        return F.log_softmax(x, dim=1)
+
+
+def synthetic_mnist(n: int, seed: int):
+    """Class-separable synthetic digits: class k lights up a distinct 7x7
+    patch grid cell, so the model can actually learn."""
+    rng = np.random.RandomState(seed)
+    labels = rng.randint(0, 10, n)
+    images = rng.rand(n, 1, 28, 28).astype(np.float32) * 0.1
+    for i, k in enumerate(labels):
+        r, c = divmod(int(k), 4)
+        images[i, 0, 7 * r:7 * r + 7, 7 * c:7 * c + 7] += 1.0
+    return (torch.from_numpy(images),
+            torch.from_numpy(labels.astype(np.int64)))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--lr", type=float, default=0.01)
+    ap.add_argument("--momentum", type=float, default=0.5)
+    ap.add_argument("--train-size", type=int, default=2048)
+    ap.add_argument("--seed", type=int, default=42)
+    args = ap.parse_args()
+
+    hvd.init()
+    torch.manual_seed(args.seed)
+
+    model = Net()
+    # scale lr by world size (reference pytorch_mnist.py:60-62)
+    optimizer = optim.SGD(model.parameters(), lr=args.lr * hvd.size(),
+                          momentum=args.momentum)
+    optimizer = hvd.DistributedOptimizer(
+        optimizer, named_parameters=model.named_parameters())
+
+    # start consistent: rank 0's weights + optimizer state everywhere
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    hvd.broadcast_optimizer_state(optimizer, root_rank=0)
+
+    # shard the data by rank (the reference uses DistributedSampler)
+    images, labels = synthetic_mnist(args.train_size, args.seed)
+    images = images[hvd.rank()::hvd.size()]
+    labels = labels[hvd.rank()::hvd.size()]
+
+    model.train()
+    first_loss = last_loss = None
+    for epoch in range(args.epochs):
+        perm = torch.randperm(len(images))
+        for start in range(0, len(images) - args.batch_size + 1,
+                           args.batch_size):
+            idx = perm[start:start + args.batch_size]
+            optimizer.zero_grad()
+            output = model(images[idx])
+            loss = F.nll_loss(output, labels[idx])
+            loss.backward()
+            optimizer.step()
+            last_loss = float(loss.detach())
+            if first_loss is None:
+                first_loss = last_loss
+        if hvd.rank() == 0:
+            print(f"epoch {epoch}: loss {last_loss:.4f}", flush=True)
+
+    if hvd.rank() == 0:
+        # sanity bound, not convergence: single-batch loss is noisy (dropout)
+        assert last_loss < first_loss * 1.5, (first_loss, last_loss)
+        print(f"DONE loss {first_loss:.4f} -> {last_loss:.4f}", flush=True)
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

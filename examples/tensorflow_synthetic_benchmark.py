@@ -1,0 +1,108 @@
+"""TensorFlow synthetic ResNet-50 benchmark with horovod_tpu.
+
+TPU-native counterpart of
+``/root/reference/examples/tensorflow_synthetic_benchmark.py:22-35``: same
+harness shape (synthetic ImageNet batch, warmup batches, timed iterations
+of N batches, img/sec log-mean on rank 0, allreduce-averaged across ranks)
+on the eager ``DistributedGradientTape`` API.
+
+Run:
+  python examples/tensorflow_synthetic_benchmark.py --model small
+  python -m horovod_tpu.run -np 2 python \
+      examples/tensorflow_synthetic_benchmark.py --model small
+(``--model resnet50`` for the real benchmark; ``small`` keeps CPU smoke
+runs fast.)
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import numpy as np
+
+
+def build_model(name: str, num_classes: int = 1000):
+    import tensorflow as tf
+
+    if name == "resnet50":
+        return tf.keras.applications.ResNet50(weights=None)
+    # small: a conv net with the same input signature for CPU smoke runs
+    return tf.keras.Sequential([
+        tf.keras.layers.Conv2D(16, 7, strides=4, activation="relu",
+                               input_shape=(224, 224, 3)),
+        tf.keras.layers.MaxPool2D(4),
+        tf.keras.layers.Flatten(),
+        tf.keras.layers.Dense(num_classes),
+    ])
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="resnet50",
+                    choices=("resnet50", "small"))
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--num-warmup-batches", type=int, default=10)
+    ap.add_argument("--num-batches-per-iter", type=int, default=10)
+    ap.add_argument("--num-iters", type=int, default=10)
+    args = ap.parse_args()
+
+    import tensorflow as tf
+
+    import horovod_tpu.tensorflow as hvd
+
+    hvd.init()
+
+    model = build_model(args.model)
+    opt = tf.optimizers.SGD(0.01 * hvd.size())
+    loss_obj = tf.keras.losses.SparseCategoricalCrossentropy(
+        from_logits=True)
+
+    rng = np.random.RandomState(hvd.rank())
+    data = tf.constant(rng.rand(args.batch_size, 224, 224, 3),
+                       tf.float32)
+    target = tf.constant(rng.randint(0, 1000, args.batch_size), tf.int64)
+
+    @tf.function
+    def benchmark_step(first_batch):
+        with tf.GradientTape() as tape:
+            probs = model(data, training=True)
+            loss = loss_obj(target, probs)
+        tape = hvd.DistributedGradientTape(tape)
+        grads = tape.gradient(loss, model.trainable_variables)
+        opt.apply_gradients(zip(grads, model.trainable_variables))
+        return loss
+
+    # first batch builds variables; broadcast afterwards so all ranks start
+    # from rank 0's init (reference tensorflow_synthetic_benchmark.py:66-70)
+    benchmark_step(True)
+    hvd.broadcast_variables(model.variables, root_rank=0)
+    hvd.broadcast_variables(opt.variables(), root_rank=0)
+
+    for _ in range(args.num_warmup_batches):
+        benchmark_step(False)
+
+    img_secs = []
+    for _ in range(args.num_iters):
+        t0 = time.perf_counter()
+        for _ in range(args.num_batches_per_iter):
+            benchmark_step(False)
+        dt = time.perf_counter() - t0
+        img_sec = args.batch_size * args.num_batches_per_iter / dt
+        if hvd.rank() == 0:
+            print(f"Iter: {img_sec:.1f} img/sec per rank", flush=True)
+        img_secs.append(img_sec)
+
+    # average the per-rank rate across the world like the reference does
+    mean_rate = float(np.mean(img_secs))
+    total = hvd.size() * float(
+        hvd.allreduce(tf.constant(mean_rate), average=True))
+    if hvd.rank() == 0:
+        print(f"Total img/sec on {hvd.size()} rank(s): {total:.1f}",
+              flush=True)
+        print("DONE", flush=True)
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,106 @@
+"""Smoke tests for the examples/ suite (the BASELINE.json configs).
+
+Each example runs as a subprocess the way a user would launch it —
+single-process and through ``python -m horovod_tpu.run -np 2`` — on tiny
+shapes.  Mirrors the reference's convention that examples double as
+integration tests (``/root/reference/examples/pytorch_mnist.py:1``).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EXAMPLES = os.path.join(REPO, "examples")
+
+
+def _run(argv, timeout=240, np_procs=None):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.setdefault("XLA_FLAGS", "")
+    if "--xla_force_host_platform_device_count" not in env["XLA_FLAGS"]:
+        env["XLA_FLAGS"] = ("--xla_force_host_platform_device_count=8 "
+                            + env["XLA_FLAGS"])
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    if np_procs:
+        argv = [sys.executable, "-m", "horovod_tpu.run", "-np",
+                str(np_procs), sys.executable] + argv
+    else:
+        argv = [sys.executable] + argv
+    out = subprocess.run(argv, cwd=REPO, env=env, capture_output=True,
+                         text=True, timeout=timeout)
+    assert out.returncode == 0, (out.stdout[-2000:], out.stderr[-2000:])
+    assert "DONE" in out.stdout, out.stdout[-2000:]
+    return out.stdout
+
+
+PYTORCH = [os.path.join(EXAMPLES, "pytorch_mnist.py"),
+           "--epochs", "1", "--train-size", "256", "--batch-size", "32"]
+TF = [os.path.join(EXAMPLES, "tensorflow_synthetic_benchmark.py"),
+      "--model", "small", "--batch-size", "4", "--num-warmup-batches", "1",
+      "--num-batches-per-iter", "2", "--num-iters", "2"]
+KERAS = [os.path.join(EXAMPLES, "keras_imagenet_resnet50.py"),
+         "--depth", "50", "--width", "8", "--image-size", "32",
+         "--num-classes", "8", "--batch-size", "4", "--epochs", "1",
+         "--batches-per-epoch", "2"]
+MXNET = [os.path.join(EXAMPLES, "mxnet_imagenet_resnet50.py"),
+         "--steps", "2", "--batch-size", "2", "--image-size", "64"]
+JAX_LLAMA = [os.path.join(EXAMPLES, "jax_llama.py"),
+             "--layers", "2", "--d-model", "64", "--d-ff", "128",
+             "--heads", "4", "--kv-heads", "2", "--vocab-size", "256",
+             "--seq", "64", "--batch", "8", "--steps", "3"]
+
+
+def test_pytorch_mnist_single():
+    out = _run(PYTORCH)
+    assert "loss" in out
+
+
+def test_pytorch_mnist_2proc():
+    _run(PYTORCH, np_procs=2)
+
+
+@pytest.mark.skipif(
+    not os.environ.get("HOROVOD_TPU_TEST_TF"),
+    reason="TF import is slow; set HOROVOD_TPU_TEST_TF=1 to include")
+def test_tensorflow_synthetic_single():
+    _run(TF, timeout=600)
+
+
+@pytest.mark.skipif(
+    not os.environ.get("HOROVOD_TPU_TEST_TF"),
+    reason="TF import is slow; set HOROVOD_TPU_TEST_TF=1 to include")
+def test_tensorflow_synthetic_2proc():
+    _run(TF, timeout=600, np_procs=2)
+
+
+def test_keras_resnet_single():
+    _run(KERAS)
+
+
+def test_keras_resnet_2proc():
+    _run(KERAS, np_procs=2)
+
+
+def test_mxnet_example_single():
+    _run(MXNET)
+
+
+def test_mxnet_example_2proc():
+    _run(MXNET, np_procs=2)
+
+
+def test_jax_llama_fsdp():
+    out = _run(JAX_LLAMA + ["--fsdp", "4", "--tp", "2"])
+    assert "mesh fsdp=4 tp=2" in out
+
+
+def test_jax_llama_fsdp_2proc():
+    """Two independent processes each running the FSDP mesh (the launcher
+    just fans them out; SPMD meshes are per-process on CPU)."""
+    _run(JAX_LLAMA + ["--fsdp", "2", "--tp", "1", "--cpu-devices", "2"],
+         np_procs=2)
