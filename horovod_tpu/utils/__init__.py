@@ -1,0 +1,26 @@
+"""Shared utilities: topology discovery, networking, XLA flag plumbing."""
+
+from __future__ import annotations
+
+import os
+
+
+def force_cpu_backend() -> None:
+    """Make the CPU backend the default even when a TPU PJRT plugin has
+    registered itself (e.g. the axon tunnel plugin, whose registration
+    overrides ``JAX_PLATFORMS=cpu`` programmatically).  Must run before the
+    first JAX computation."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        from jax._src import xla_bridge as _xb
+
+        _xb._backend_factories.pop("axon", None)
+    except Exception:
+        pass
+
+
+def cpu_requested() -> bool:
+    """Whether the launching environment asked for the CPU backend."""
+    return os.environ.get("JAX_PLATFORMS", "").split(",")[0] == "cpu"

@@ -16,7 +16,10 @@ per platform:
 * **GPU/CPU** (upstream XLA): ``xla_gpu_all_reduce_combine_threshold_bytes``
   and friends.
 
-XLA debug flags are read once at backend initialization, so
+TPU flags travel via ``LIBTPU_INIT_ARGS`` (libtpu's flag channel —
+putting ``xla_tpu_*`` flags in ``XLA_FLAGS`` aborts the host-side XLA flag
+parser, which doesn't know them); GPU/CPU flags travel via ``XLA_FLAGS``.
+Both are read once at backend initialization, so
 :func:`set_combine_threshold` must run before the first ``jax`` computation
 (it raises otherwise unless ``force=True``, which only affects future
 processes via the env).
@@ -50,11 +53,16 @@ def _backend_initialized() -> bool:
         return False
 
 
+def _flag_env(name: str) -> str:
+    return "LIBTPU_INIT_ARGS" if name.startswith("xla_tpu") else "XLA_FLAGS"
+
+
 def _set_flag(name: str, value: int) -> None:
-    flags = os.environ.get("XLA_FLAGS", "")
+    env = _flag_env(name)
+    flags = os.environ.get(env, "")
     parts = [f for f in flags.split() if not f.startswith(f"--{name}=")]
     parts.append(f"--{name}={int(value)}")
-    os.environ["XLA_FLAGS"] = " ".join(parts)
+    os.environ[env] = " ".join(parts)
 
 
 def set_combine_threshold(nbytes: int = DEFAULT_THRESHOLD,
@@ -106,7 +114,7 @@ def get_combine_threshold(platform: str | None = None,
         platform = os.environ.get("HOROVOD_TPU_PLATFORM", "tpu")
     table = _TPU_FLAGS if platform == "tpu" else _GPU_FLAGS
     flag = table[collective]
-    for part in os.environ.get("XLA_FLAGS", "").split():
+    for part in os.environ.get(_flag_env(flag), "").split():
         if part.startswith(f"--{flag}="):
             return int(part.split("=", 1)[1])
     return None
