@@ -99,15 +99,35 @@ def data_specs(batch_axes=("dp", "fsdp"), sp="sp"):
     return P(batch_axes, sp)
 
 
-def build_train_step(mesh, config: FlagshipConfig, optimizer):
+def build_train_step(mesh, config: FlagshipConfig, optimizer,
+                     attn_mode: str = "auto"):
     """Returns ``step(params, opt_state, tokens) -> (params, opt_state,
     loss)``, jittable over ``mesh``.  ``tokens``: [B, T] int32 with
     ``B % microbatches == 0`` and microbatch size divisible by the data-axis
-    product."""
+    product.
+
+    ``attn_mode`` selects the sequence-parallel attention implementation
+    (:func:`horovod_tpu.parallel.make_ring_attn_fn` modes); the default
+    ``"auto"`` uses the Pallas-kernel ring on TPU and the jnp ring
+    elsewhere.
+    """
     c = config.llama
     n_stages = mesh.shape["pp"]
     M = config.microbatches
-    attn_fn = sequence_parallel_attn_fn(mesh=None, axis_name="sp")
+    if attn_mode == "auto":
+        try:
+            import jax as _jax
+
+            on_tpu = _jax.default_backend() == "tpu"
+        except Exception:
+            on_tpu = False
+        attn_mode = "ring_pallas" if on_tpu else "ring"
+    # Inside the pp-manual region the nested sp shard_maps must bind to the
+    # context mesh (mesh=None); on the flat n_stages==1 path there is no
+    # enclosing manual region, so they take the concrete mesh.
+    smap_mesh = mesh if n_stages == 1 else None
+    attn_fn = sequence_parallel_attn_fn(mesh=smap_mesh, axis_name="sp",
+                                        mode=attn_mode)
     moe_cfg = config.moe
 
     def stage_fn(stage_params, x):
@@ -141,6 +161,7 @@ def build_train_step(mesh, config: FlagshipConfig, optimizer):
             out_specs=(P(None, "sp"), P()),
             axis_names=frozenset({"sp"}),
             check_vma=False,
+            **({} if smap_mesh is None else {"mesh": smap_mesh}),
         )(moe_params, x)
         return x + y
 
@@ -153,25 +174,36 @@ def build_train_step(mesh, config: FlagshipConfig, optimizer):
         x = x.reshape(M, mb, T, c.d_model)
         targets = tokens.reshape(M, mb, T)
 
+        def mb_loss(y, t):
+            h = llama._rms_norm(y, params["final_norm"], c.rms_eps)
+            logits = (h @ params["lm_head"].astype(h.dtype)).astype(
+                jnp.float32)
+            logp = jax.nn.log_softmax(logits[:, :-1])
+            # one-hot contraction instead of take_along_axis: gathers
+            # along a tp-sharded vocab dim inside a manual region crash
+            # the SPMD partitioner, and the einsum is MXU-friendly
+            onehot = jax.nn.one_hot(t[:, 1:], c.vocab_size,
+                                    dtype=logp.dtype)
+            nll = -jnp.einsum("btv,btv->bt", logp, onehot)
+            return jnp.mean(nll)
+
+        stage_params = {k: params[k] for k in _STAGE_KEYS}
+        stage_params["moe"] = params["moe"]
+
+        if n_stages == 1:
+            # No pipeline: a size-1 manual pp axis would still emit
+            # pp-subgroup collectives, which trips the SPMD partitioner
+            # (cross-partition allreduce outside manual mode); run the
+            # single stage sequentially over microbatches instead (the
+            # nested sp shard_maps got the concrete mesh above).
+            outs = lax.map(lambda xm: stage_fn(stage_params, xm), x)
+            return jnp.mean(jax.vmap(mb_loss)(outs, targets))
+
         def pp_region(stage_params, microbatches, targets):
             n = lax.axis_size("pp")
             stage = lax.axis_index("pp")
             outs = pipe.pipeline_apply(stage_fn, stage_params, microbatches,
                                        "pp")
-
-            def mb_loss(y, t):
-                h = llama._rms_norm(y, params["final_norm"], c.rms_eps)
-                logits = (h @ params["lm_head"].astype(h.dtype)).astype(
-                    jnp.float32)
-                logp = jax.nn.log_softmax(logits[:, :-1])
-                # one-hot contraction instead of take_along_axis: gathers
-                # along a tp-sharded vocab dim inside a manual region crash
-                # the SPMD partitioner, and the einsum is MXU-friendly
-                onehot = jax.nn.one_hot(t[:, 1:], c.vocab_size,
-                                        dtype=logp.dtype)
-                nll = -jnp.einsum("btv,btv->bt", logp, onehot)
-                return jnp.mean(nll)
-
             per_mb = jax.vmap(mb_loss)(outs, targets)
             local = jnp.where(stage == n - 1, jnp.mean(per_mb), 0.0)
             return lax.psum(local, "pp")
@@ -180,8 +212,6 @@ def build_train_step(mesh, config: FlagshipConfig, optimizer):
         # leading dim (dense: [L] -> [L/n]; moe: [n_stages] -> [1]); their
         # trailing fsdp/tp shardings stay automatic.  final_norm / lm_head
         # ride in by closure as fully-auto values.
-        stage_params = {k: params[k] for k in _STAGE_KEYS}
-        stage_params["moe"] = params["moe"]
         in_stage_specs = {k: P("pp") for k in _STAGE_KEYS}
         in_stage_specs["moe"] = jax.tree.map(lambda _: P("pp"),
                                              params["moe"])

@@ -174,18 +174,41 @@ _LAYER_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down",
                "attn_norm", "mlp_norm")
 
 
-def apply(params, tokens, config: LlamaConfig, positions=None, attn_fn=None,
-          remat: bool = True):
+def _resolve_attn_fn(attn_fn, seq_len: int):
+    """``attn_fn="auto"``: Pallas flash attention on TPU (the hot op gets
+    the Mosaic kernel), dense jnp attention elsewhere.  The kernel needs the
+    sequence to tile into (..., 128) Mosaic blocks: T a multiple of 128, or
+    a single equal-to-dim block."""
+    if attn_fn != "auto":
+        return attn_fn
+    try:
+        import jax
+
+        on_tpu = jax.default_backend() == "tpu"
+    except Exception:
+        on_tpu = False
+    if on_tpu and (seq_len % 128 == 0 or seq_len < 128):
+        from horovod_tpu.ops.pallas import flash_attn_fn
+
+        return flash_attn_fn()
+    return None
+
+
+def apply(params, tokens, config: LlamaConfig, positions=None,
+          attn_fn="auto", remat: bool = True):
     """Forward pass.  ``tokens``: [B, T] int32 -> logits [B, T, V] (fp32).
 
     ``positions`` defaults to 0..T-1; pass global positions when the
     sequence dim is sharded (sequence parallelism).  ``attn_fn`` overrides
-    the attention inner (e.g. ring attention over a mesh axis).
+    the attention inner (e.g. ring attention over a mesh axis); the default
+    ``"auto"`` routes through the Pallas flash-attention kernel on TPU and
+    the dense jnp path elsewhere; ``None`` forces the dense path.
     ``remat`` checkpoints each layer (recompute in backward — the standard
     HBM-for-FLOPs trade on TPU).
     """
     c = config
     B, T = tokens.shape
+    attn_fn = _resolve_attn_fn(attn_fn, T)
     if positions is None:
         positions = jnp.arange(T, dtype=jnp.int32)
     x = params["embed"][tokens].astype(c.compute_dtype)
@@ -204,7 +227,8 @@ def apply(params, tokens, config: LlamaConfig, positions=None, attn_fn=None,
     return (x @ params["lm_head"].astype(x.dtype)).astype(jnp.float32)
 
 
-def loss_fn(params, tokens, config: LlamaConfig, positions=None, attn_fn=None):
+def loss_fn(params, tokens, config: LlamaConfig, positions=None,
+            attn_fn="auto"):
     """Next-token cross-entropy (shift-by-one inside)."""
     logits = apply(params, tokens, config, positions=positions, attn_fn=attn_fn)
     logp = jax.nn.log_softmax(logits[:, :-1])
