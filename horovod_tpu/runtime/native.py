@@ -46,10 +46,26 @@ def _csrc_dir() -> str:
     )
 
 
+def _installed_so() -> str | None:
+    """`pip install` ships the engine as package data next to horovod_tpu's
+    __init__ (built by setup.py's build_py); prefer it when there is no
+    source tree to rebuild from."""
+    pkg_dir = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    so = os.path.join(pkg_dir, "libhvdtpu.so")
+    buildable = os.path.exists(os.path.join(_csrc_dir(), "Makefile"))
+    if os.path.exists(so) and not buildable:
+        return so
+    return None
+
+
 def _load_lib():
     global _lib
     with _build_lock:
         if _lib is not None:
+            return _lib
+        so = _installed_so()
+        if so is not None:
+            _lib = _bind(ctypes.CDLL(so))
             return _lib
         so = os.path.join(_csrc_dir(), "libhvdtpu.so")
         sources = [
@@ -81,37 +97,40 @@ def _load_lib():
                         )
                 finally:
                     fcntl.flock(lk, fcntl.LOCK_UN)
-        lib = ctypes.CDLL(so)
-        lib.hvd_native_init.argtypes = [ctypes.c_char_p, ctypes.c_int,
-                                        ctypes.c_int, ctypes.c_int]
-        lib.hvd_native_init.restype = ctypes.c_int
-        lib.hvd_native_shutdown.restype = None
-        lib.hvd_enqueue.argtypes = [
-            ctypes.c_int, ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
-            ctypes.POINTER(ctypes.c_int64), ctypes.c_void_p, ctypes.c_int,
-        ]
-        lib.hvd_enqueue.restype = ctypes.c_int
-        lib.hvd_poll.argtypes = [ctypes.c_int]
-        lib.hvd_poll.restype = ctypes.c_int
-        lib.hvd_wait.argtypes = [ctypes.c_int, ctypes.c_double]
-        lib.hvd_wait.restype = ctypes.c_int
-        lib.hvd_result_ndim.argtypes = [ctypes.c_int]
-        lib.hvd_result_ndim.restype = ctypes.c_int
-        lib.hvd_result_dims.argtypes = [ctypes.c_int,
-                                        ctypes.POINTER(ctypes.c_int64)]
-        lib.hvd_result_dims.restype = None
-        lib.hvd_result_nbytes.argtypes = [ctypes.c_int]
-        lib.hvd_result_nbytes.restype = ctypes.c_int64
-        lib.hvd_result_copy.argtypes = [ctypes.c_int, ctypes.c_void_p]
-        lib.hvd_result_copy.restype = None
-        lib.hvd_error_str.argtypes = [ctypes.c_int]
-        lib.hvd_error_str.restype = ctypes.c_void_p  # manual free
-        lib.hvd_free_cstr.argtypes = [ctypes.c_void_p]
-        lib.hvd_free_cstr.restype = None
-        lib.hvd_release.argtypes = [ctypes.c_int]
-        lib.hvd_release.restype = None
-        _lib = lib
-        return lib
+        _lib = _bind(ctypes.CDLL(so))
+        return _lib
+
+
+def _bind(lib):
+    lib.hvd_native_init.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                    ctypes.c_int, ctypes.c_int]
+    lib.hvd_native_init.restype = ctypes.c_int
+    lib.hvd_native_shutdown.restype = None
+    lib.hvd_enqueue.argtypes = [
+        ctypes.c_int, ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_void_p, ctypes.c_int,
+    ]
+    lib.hvd_enqueue.restype = ctypes.c_int
+    lib.hvd_poll.argtypes = [ctypes.c_int]
+    lib.hvd_poll.restype = ctypes.c_int
+    lib.hvd_wait.argtypes = [ctypes.c_int, ctypes.c_double]
+    lib.hvd_wait.restype = ctypes.c_int
+    lib.hvd_result_ndim.argtypes = [ctypes.c_int]
+    lib.hvd_result_ndim.restype = ctypes.c_int
+    lib.hvd_result_dims.argtypes = [ctypes.c_int,
+                                    ctypes.POINTER(ctypes.c_int64)]
+    lib.hvd_result_dims.restype = None
+    lib.hvd_result_nbytes.argtypes = [ctypes.c_int]
+    lib.hvd_result_nbytes.restype = ctypes.c_int64
+    lib.hvd_result_copy.argtypes = [ctypes.c_int, ctypes.c_void_p]
+    lib.hvd_result_copy.restype = None
+    lib.hvd_error_str.argtypes = [ctypes.c_int]
+    lib.hvd_error_str.restype = ctypes.c_void_p  # manual free
+    lib.hvd_free_cstr.argtypes = [ctypes.c_void_p]
+    lib.hvd_free_cstr.restype = None
+    lib.hvd_release.argtypes = [ctypes.c_int]
+    lib.hvd_release.restype = None
+    return lib
 
 
 def rendezvous_addr() -> tuple[str, int]:

@@ -1,0 +1,95 @@
+"""Build & install horovod_tpu, compiling the native collective engine.
+
+The reference builds one C++ extension per framework frontend with
+feature-detection test compiles and actionable error messages
+(``/root/reference/setup.py:32-36,314-557``).  This framework needs exactly
+one native artifact — the framework-agnostic eager collective engine
+``libhvdtpu.so`` (all frontends bridge to it over ctypes, so there is no
+per-framework ABI to detect) — plus the pure-Python package.  The compiled
+TPU data plane is JAX/XLA and needs no build step at all.
+
+Build errors surface with the failing compiler invocation and a hint, in
+the spirit of the reference's feature-detection UX.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+import sys
+
+from setuptools import Command, setup
+from setuptools.command.build_py import build_py as _build_py
+
+HERE = os.path.abspath(os.path.dirname(__file__))
+CSRC = os.path.join(HERE, "csrc")
+SOURCES = ["socket.cc", "wire.cc", "timeline.cc", "autotune.cc", "engine.cc"]
+HEADERS = ["common.h", "socket.h", "wire.h", "timeline.h", "autotune.h"]
+
+
+def _compiler() -> str:
+    cxx = os.environ.get("CXX") or shutil.which("g++") or shutil.which("c++")
+    if not cxx:
+        raise SystemExit(
+            "horovod_tpu: no C++ compiler found. The native collective "
+            "engine (csrc/) needs g++ or clang++ with C++17 support. "
+            "Install one or set CXX, e.g.:  CXX=clang++ pip install ."
+        )
+    return cxx
+
+
+def _build_native(out_dir: str) -> str:
+    """Compile csrc/ into ``out_dir``/libhvdtpu.so; returns the .so path."""
+    cxx = _compiler()
+    os.makedirs(out_dir, exist_ok=True)
+    so = os.path.join(out_dir, "libhvdtpu.so")
+    srcs = [os.path.join(CSRC, s) for s in SOURCES]
+    hdrs = [os.path.join(CSRC, h) for h in HEADERS]
+    if os.path.exists(so) and all(
+        os.path.getmtime(f) <= os.path.getmtime(so) for f in srcs + hdrs
+    ):
+        return so
+    cmd = [cxx, "-O2", "-g", "-std=c++17", "-fPIC", "-Wall", "-shared",
+           "-pthread", "-o", so, *srcs]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+    except subprocess.CalledProcessError as exc:
+        sys.stderr.write(exc.stderr or "")
+        raise SystemExit(
+            "horovod_tpu: native engine build failed.\n"
+            f"  command: {' '.join(cmd)}\n"
+            "  The engine is plain C++17 with no dependencies beyond "
+            "pthreads; the error above is from your compiler. If your "
+            "default compiler predates C++17, point CXX at a newer one."
+        ) from exc
+    return so
+
+
+class build_native(Command):
+    """`python setup.py build_native` — compile the engine in-place."""
+
+    description = "compile the native collective engine (csrc -> horovod_tpu/)"
+    user_options: list = []
+
+    def initialize_options(self) -> None:
+        pass
+
+    def finalize_options(self) -> None:
+        pass
+
+    def run(self) -> None:
+        so = _build_native(os.path.join(HERE, "horovod_tpu"))
+        print(f"built {so}")
+
+
+class build_py(_build_py):
+    """Compile the engine and ship it as package data inside horovod_tpu/."""
+
+    def run(self) -> None:
+        super().run()
+        out = os.path.join(self.build_lib, "horovod_tpu")
+        _build_native(out)
+
+
+setup(cmdclass={"build_py": build_py, "build_native": build_native})
