@@ -218,7 +218,8 @@ def bench_llama(args, peak_tflops):
 # ---------------------------------------------------------------------------
 
 def allreduce_worker(args):
-    """Runs inside ``horovod_tpu.run``: times fused ring allreduce."""
+    """Runs inside ``horovod_tpu.run``: times fused ring allreduce, fp32
+    and fp16 (the half path exercises the engine's SIMD accumulate)."""
     import numpy as np
 
     import horovod_tpu as hvd
@@ -226,21 +227,23 @@ def allreduce_worker(args):
     hvd.init()
     n = hvd.size()
     nbytes = args.size_mb * 1024 * 1024
-    arr = np.ones(nbytes // 4, np.float32)
-    for _ in range(3):
-        hvd.allreduce(arr, average=False, name="warmup")
-    t0 = time.perf_counter()
-    for i in range(args.ar_iters):
-        hvd.allreduce(arr, average=False, name=f"bench.{i}")
-    dt = time.perf_counter() - t0
-    if hvd.rank() == 0:
+    out = {"np": n, "size_mb": args.size_mb}
+    for dtype, tag in ((np.float32, "fp32"), (np.float16, "fp16")):
+        arr = np.ones(nbytes // np.dtype(dtype).itemsize, dtype)
+        res = np.empty_like(arr)  # reused result buffer: warm pages
+        for _ in range(3):
+            hvd.allreduce(arr, average=False, name=f"warmup.{tag}", out=res)
+        t0 = time.perf_counter()
+        for i in range(args.ar_iters):
+            hvd.allreduce(arr, average=False, name=f"bench.{tag}.{i}",
+                          out=res)
+        dt = time.perf_counter() - t0
         # ring busbw convention: busbw = algbw * 2(n-1)/n
         algbw = nbytes * args.ar_iters / dt
-        busbw = algbw * 2 * (n - 1) / n
-        print(json.dumps({"np": n, "size_mb": args.size_mb,
-                          "algbw_gbps": round(algbw / 1e9, 3),
-                          "busbw_gbps": round(busbw / 1e9, 3)}),
-              flush=True)
+        out[f"algbw_gbps_{tag}"] = round(algbw / 1e9, 3)
+        out[f"busbw_gbps_{tag}"] = round(algbw * 2 * (n - 1) / n / 1e9, 3)
+    if hvd.rank() == 0:
+        print(json.dumps(out), flush=True)
     hvd.shutdown()
 
 

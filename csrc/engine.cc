@@ -36,8 +36,11 @@
 #include <unordered_map>
 #include <vector>
 
+#include <unistd.h>
+
 #include "autotune.h"
 #include "common.h"
+#include "logging.h"
 #include "socket.h"
 #include "timeline.h"
 #include "wire.h"
@@ -45,9 +48,7 @@
 namespace hvdtpu {
 namespace {
 
-void LogWarn(const std::string& msg) {
-  fprintf(stderr, "[hvdtpu] WARNING: %s\n", msg.c_str());
-}
+void LogWarn(const std::string& msg) { LOG(Warning) << msg; }
 
 int64_t NumElems(const std::vector<int64_t>& dims) {
   int64_t n = 1;
@@ -82,6 +83,80 @@ void AccumT(T* dst, const T* src, int64_t n) {
   for (int64_t i = 0; i < n; i++) dst[i] += src[i];
 }
 
+#if defined(__x86_64__) || defined(__i386__)
+#define HVDTPU_X86_SIMD 1
+#include <immintrin.h>
+
+// 8-wide fp16 accumulate: convert to fp32 (F16C), add, convert back.
+// Role analog of the reference's SIMD float16 sum (half.cc:27-75), with
+// per-function target attributes + a runtime CPU check instead of
+// build-time flags so the same .so runs on any x86.
+__attribute__((target("avx2,f16c")))
+void AccumHalfSimd(uint16_t* dst, const uint16_t* src, int64_t n) {
+  int64_t i = 0;
+  for (; i + 8 <= n; i += 8) {
+    __m256 a = _mm256_cvtph_ps(_mm_loadu_si128(
+        reinterpret_cast<const __m128i*>(dst + i)));
+    __m256 b = _mm256_cvtph_ps(_mm_loadu_si128(
+        reinterpret_cast<const __m128i*>(src + i)));
+    _mm_storeu_si128(
+        reinterpret_cast<__m128i*>(dst + i),
+        _mm256_cvtps_ph(_mm256_add_ps(a, b), _MM_FROUND_TO_NEAREST_INT));
+  }
+  for (; i < n; i++)
+    dst[i] = FloatToHalf(HalfToFloat(dst[i]) + HalfToFloat(src[i]));
+}
+
+// 8-wide bf16 accumulate: widen u16 lanes to the high half of u32 (a
+// bf16's bits ARE the top 16 of a float32), add as float, round back to
+// nearest-even with the scalar helper's carry trick, vectorized.
+__attribute__((target("avx2")))
+void AccumBF16Simd(uint16_t* dst, const uint16_t* src, int64_t n) {
+  int64_t i = 0;
+  const __m256i lsb_mask = _mm256_set1_epi32(1);
+  const __m256i bias = _mm256_set1_epi32(0x7FFF);
+  for (; i + 8 <= n; i += 8) {
+    __m256i a16 = _mm256_cvtepu16_epi32(_mm_loadu_si128(
+        reinterpret_cast<const __m128i*>(dst + i)));
+    __m256i b16 = _mm256_cvtepu16_epi32(_mm_loadu_si128(
+        reinterpret_cast<const __m128i*>(src + i)));
+    __m256 a = _mm256_castsi256_ps(_mm256_slli_epi32(a16, 16));
+    __m256 b = _mm256_castsi256_ps(_mm256_slli_epi32(b16, 16));
+    __m256i s = _mm256_castps_si256(_mm256_add_ps(a, b));
+    // round-to-nearest-even on the truncated half: add 0x7FFF + lsb(hi)
+    __m256i hi_lsb = _mm256_and_si256(_mm256_srli_epi32(s, 16), lsb_mask);
+    s = _mm256_add_epi32(s, _mm256_add_epi32(bias, hi_lsb));
+    __m256i hi = _mm256_srli_epi32(s, 16);
+    // pack the 8 u32 lane-bottoms back to u16 (lane-crossing shuffle)
+    __m128i lo128 = _mm256_castsi256_si128(hi);
+    __m128i hi128 = _mm256_extracti128_si256(hi, 1);
+    _mm_storeu_si128(reinterpret_cast<__m128i*>(dst + i),
+                     _mm_packus_epi32(lo128, hi128));
+  }
+  for (; i < n; i++)
+    dst[i] = FloatToBF16(BF16ToFloat(dst[i]) + BF16ToFloat(src[i]));
+}
+#endif  // x86
+
+bool CpuHasF16C() {
+#ifdef HVDTPU_X86_SIMD
+  static bool ok = __builtin_cpu_supports("avx2") &&
+                   __builtin_cpu_supports("f16c");
+  return ok;
+#else
+  return false;
+#endif
+}
+
+bool CpuHasAvx2() {
+#ifdef HVDTPU_X86_SIMD
+  static bool ok = __builtin_cpu_supports("avx2");
+  return ok;
+#else
+  return false;
+#endif
+}
+
 void Accumulate(void* dst, const void* src, int64_t n, DType d) {
   switch (d) {
     case DType::kUInt8:
@@ -105,6 +180,12 @@ void Accumulate(void* dst, const void* src, int64_t n, DType d) {
     case DType::kFloat16: {
       auto* dp = static_cast<uint16_t*>(dst);
       auto* sp = static_cast<const uint16_t*>(src);
+#ifdef HVDTPU_X86_SIMD
+      if (CpuHasF16C()) {
+        AccumHalfSimd(dp, sp, n);
+        break;
+      }
+#endif
       for (int64_t i = 0; i < n; i++)
         dp[i] = FloatToHalf(HalfToFloat(dp[i]) + HalfToFloat(sp[i]));
       break;
@@ -112,6 +193,12 @@ void Accumulate(void* dst, const void* src, int64_t n, DType d) {
     case DType::kBFloat16: {
       auto* dp = static_cast<uint16_t*>(dst);
       auto* sp = static_cast<const uint16_t*>(src);
+#ifdef HVDTPU_X86_SIMD
+      if (CpuHasAvx2()) {
+        AccumBF16Simd(dp, sp, n);
+        break;
+      }
+#endif
       for (int64_t i = 0; i < n; i++)
         dp[i] = FloatToBF16(BF16ToFloat(dp[i]) + BF16ToFloat(sp[i]));
       break;
@@ -125,6 +212,10 @@ struct TensorEntry {
   Request req;
   std::vector<char> data;
   int handle = -1;
+  // caller-owned output buffer (same shape as input): the engine writes
+  // the result there on the background thread and skips the result-vector
+  // stage entirely — the ≤1-copy-each-way eager path
+  void* user_out = nullptr;
   std::chrono::steady_clock::time_point enqueued_at;
 };
 
@@ -142,7 +233,7 @@ class Engine {
 
   int Enqueue(OpType op, const std::string& name, DType dtype,
               const std::vector<int64_t>& dims, const void* data,
-              int root_rank);
+              int root_rank, void* user_out);
   int PollHandle(int handle);  // 0 pending, 1 ok, -1 error
   int WaitHandle(int handle, double timeout_s);
   HandleState* GetDone(int handle);  // valid until ReleaseHandle
@@ -164,8 +255,22 @@ class Engine {
   void ExecuteAllgather(const Response& resp, TensorEntry& entry);
   void ExecuteBroadcast(const Response& resp, TensorEntry& entry);
   void ExecuteAlltoall(const Response& resp, TensorEntry& entry);
-  Status RingAllreduce(char* buf, int64_t nelems, DType dtype);
-  Status TreeBroadcast(char* buf, int64_t nbytes, int root);
+  Status RingAllreduce(char* buf, int64_t nelems, DType dtype) {
+    return RingAllreduceGroup(buf, nelems, dtype, all_ranks_);
+  }
+  Status RingAllreduceGroup(char* buf, int64_t nelems, DType dtype,
+                            const std::vector<int>& members);
+  Status HierarchicalAllreduce(char* buf, int64_t nelems, DType dtype);
+  Status RingAllgatherGroup(const std::vector<int>& members,
+                            const std::vector<size_t>& member_bytes,
+                            char* concat);
+  Status HierarchicalAllgather(const Response& resp, TensorEntry& entry,
+                               int64_t stride, std::vector<char>* out);
+  Status TreeBroadcast(char* buf, int64_t nbytes, int root) {
+    return TreeBroadcastGroup(buf, nbytes, root, all_ranks_);
+  }
+  Status TreeBroadcastGroup(char* buf, int64_t nbytes, int root,
+                            const std::vector<int>& members);
   void MarkDone(int handle, Status st, std::vector<int64_t> dims,
                 std::vector<char> result);
   void FailAll(const Status& st);
@@ -176,6 +281,65 @@ class Engine {
   double stall_warn_s_ = 60.0;
   bool stall_check_ = true;
   double start_timeout_s_ = 120.0;
+
+  // two-level topology, grouped by host hash at bootstrap
+  std::vector<int> all_ranks_;          // 0..size-1
+  std::vector<int> local_group_;        // ranks sharing my host hash, sorted
+  std::vector<int> cross_group_;        // local roots (min rank per host)
+  std::vector<std::vector<int>> host_groups_;  // all groups, by min rank
+  bool hierarchical_allreduce_ = false;
+  bool hierarchical_allgather_ = false;
+
+  // persistent data-plane scratch (background thread only): fusion buffer
+  // kept across responses instead of a malloc per fused response (ref
+  // fusion_buffer_manager.h:31-56), plus the ring's chunk scratch
+  std::vector<char> fusion_buf_;
+  std::vector<char> ring_scratch_;
+
+  // byte-buffer pool for entry/result staging (guarded by mu_): fresh
+  // 64 MB allocations fault pages at a fraction of warm-copy bandwidth,
+  // so buffers cycle enqueue -> execute -> release -> reuse
+  std::vector<std::vector<char>> pool_;
+  size_t pool_bytes_ = 0;
+  static constexpr size_t kPoolMaxBytes = 512u << 20;
+  static constexpr size_t kPoolMaxBufs = 32;
+
+  std::vector<char> PoolGet(size_t n) {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      // best fit: smallest pooled buffer with capacity >= n, else largest
+      int best = -1;
+      for (int i = 0; i < static_cast<int>(pool_.size()); i++) {
+        if (pool_[i].capacity() >= n &&
+            (best < 0 || pool_[i].capacity() < pool_[best].capacity()))
+          best = i;
+      }
+      // no-fit requests allocate fresh below (growing a pooled buffer
+      // would memcpy its stale contents for nothing)
+      if (best >= 0) {
+        std::vector<char> v = std::move(pool_[best]);
+        pool_.erase(pool_.begin() + best);
+        pool_bytes_ -= v.capacity();
+        v.resize(n);
+        return v;
+      }
+    }
+    return std::vector<char>(n);
+  }
+
+  void PoolPutLocked(std::vector<char>&& v) {
+    if (v.capacity() == 0) return;
+    if (pool_.size() >= kPoolMaxBufs ||
+        pool_bytes_ + v.capacity() > kPoolMaxBytes)
+      return;  // let it free
+    pool_bytes_ += v.capacity();
+    pool_.push_back(std::move(v));
+  }
+
+  void PoolPut(std::vector<char>&& v) {
+    std::lock_guard<std::mutex> lk(mu_);
+    PoolPutLocked(std::move(v));
+  }
 
   Socket coord_;                        // worker->coordinator (rank != 0)
   std::vector<Socket> workers_;         // coordinator->worker (rank 0)
@@ -242,6 +406,20 @@ Status Engine::Init(const std::string& host, int port, int rank, int size) {
                                EnvFlag("HOROVOD_TPU_TIMELINE_MARK_CYCLES"));
   }
 
+  // host hash groups ranks into "same host" sets for the hierarchical
+  // paths; overridable for tests and exotic fabrics (the reference's
+  // host_hash concept, spark/util/host_hash.py)
+  const char* hh = getenv("HOROVOD_TPU_HOST_HASH");
+  std::string my_hash;
+  if (hh && hh[0]) {
+    my_hash = hh;
+  } else {
+    char hostname[256] = "localhost";
+    gethostname(hostname, sizeof(hostname) - 1);
+    my_hash = hostname;
+  }
+
+  std::vector<std::string> hashes(size_, my_hash);
   if (size_ > 1) {
     // data-plane listener first, so peers can connect whenever they learn
     // our address
@@ -268,19 +446,21 @@ Status Engine::Init(const std::string& host, int port, int rank, int size) {
         std::string hello;
         s = sock.RecvFrame(&hello);
         if (!s.ok()) return s;
-        // hello = "<rank> <host> <port>"
+        // hello = "<rank> <host> <port> <host_hash>"
         std::istringstream is(hello);
         int r, p;
-        std::string h;
-        is >> r >> h >> p;
+        std::string h, hash;
+        is >> r >> h >> p >> hash;
         if (r < 1 || r >= size_ || workers_[r].valid())
           return Status::Error("bad hello from worker: " + hello);
         hosts[r] = h;
         ports[r] = p;
+        hashes[r] = hash.empty() ? h : hash;
         workers_[r] = std::move(sock);
       }
       std::ostringstream table;
-      for (int i = 0; i < size_; i++) table << hosts[i] << " " << ports[i] << " ";
+      for (int i = 0; i < size_; i++)
+        table << hosts[i] << " " << ports[i] << " " << hashes[i] << " ";
       for (int i = 1; i < size_; i++) {
         s = workers_[i].SendFrame(table.str());
         if (!s.ok()) return s;
@@ -293,14 +473,14 @@ Status Engine::Init(const std::string& host, int port, int rank, int size) {
       const char* adv = getenv("HOROVOD_TPU_DATA_ADDR");
       std::ostringstream hello;
       hello << rank_ << " " << (adv ? adv : coord_.LocalAddr()) << " "
-            << data_listener_.port();
+            << data_listener_.port() << " " << my_hash;
       s = coord_.SendFrame(hello.str());
       if (!s.ok()) return s;
       std::string table;
       s = coord_.RecvFrame(&table);
       if (!s.ok()) return s;
       std::istringstream is(table);
-      for (int i = 0; i < size_; i++) is >> hosts[i] >> ports[i];
+      for (int i = 0; i < size_; i++) is >> hosts[i] >> ports[i] >> hashes[i];
     }
 
     // full data-plane mesh: connect to lower ranks, accept from higher ones
@@ -328,6 +508,42 @@ Status Engine::Init(const std::string& host, int port, int rank, int size) {
     }
   }
 
+  // two-level topology from the agreed host hashes (identical on every
+  // rank: all derive it from the broadcast table)
+  all_ranks_.resize(size_);
+  for (int i = 0; i < size_; i++) all_ranks_[i] = i;
+  std::map<std::string, std::vector<int>> groups;
+  for (int i = 0; i < size_; i++) groups[hashes[i]].push_back(i);
+  local_group_ = groups[hashes[rank_]];
+  for (auto& [h, g] : groups) cross_group_.push_back(g.front());
+  std::sort(cross_group_.begin(), cross_group_.end());
+  for (int root : cross_group_)
+    for (auto& [h, g] : groups)
+      if (g.front() == root) host_groups_.push_back(g);
+  bool multi_host = groups.size() > 1;
+  // hierarchical data plane: local ring -> cross ring on local roots ->
+  // local broadcast (the eager analog of the reference's two-level path,
+  // operations.cc:1284-1446); default on exactly when the topology is
+  // multi-host with local groups to exploit, env-forceable either way.
+  // The default must be computed from globally shared data (host_groups_,
+  // identical on every rank) — deriving it from the rank's OWN group size
+  // would make asymmetric topologies disagree on the algorithm and hang.
+  bool any_local = false;
+  for (const auto& g : host_groups_) any_local |= g.size() > 1;
+  bool dflt = multi_host && any_local;
+  const char* ha = getenv("HOROVOD_TPU_HIERARCHICAL_ALLREDUCE");
+  if (!ha || !ha[0]) ha = getenv("HOROVOD_HIERARCHICAL_ALLREDUCE");
+  hierarchical_allreduce_ = (ha && ha[0]) ? (strcmp(ha, "0") != 0) : dflt;
+  const char* hg = getenv("HOROVOD_TPU_HIERARCHICAL_ALLGATHER");
+  if (!hg || !hg[0]) hg = getenv("HOROVOD_HIERARCHICAL_ALLGATHER");
+  hierarchical_allgather_ = (hg && hg[0]) ? (strcmp(hg, "0") != 0) : false;
+  hierarchical_allreduce_ &= multi_host;
+  hierarchical_allgather_ &= multi_host;
+  LOG_RANK(Debug, rank_) << "topology: " << groups.size() << " host group(s),"
+                         << " local group size " << local_group_.size()
+                         << ", hierarchical allreduce "
+                         << (hierarchical_allreduce_ ? "on" : "off");
+
   running_ = true;
   bg_ = std::thread(&Engine::BackgroundLoop, this);
   return Status::OK();
@@ -352,13 +568,19 @@ void Engine::Shutdown() {
 
 int Engine::Enqueue(OpType op, const std::string& name, DType dtype,
                     const std::vector<int64_t>& dims, const void* data,
-                    int root_rank) {
+                    int root_rank, void* user_out) {
+  size_t nbytes = static_cast<size_t>(NumElems(dims)) * DTypeSize(dtype);
+  // stage the input outside the lock (pooled: warm pages after the first
+  // few ops instead of a fresh 64 MB fault storm per op)
+  std::vector<char> staged = PoolGet(nbytes);
+  std::memcpy(staged.data(), data, nbytes);
   std::lock_guard<std::mutex> lk(mu_);
   int handle = next_handle_++;
   handles_[handle] = HandleState{};
   if (!running_) {
     handles_[handle].done = true;
     handles_[handle].status = Status::Shutdown();
+    PoolPutLocked(std::move(staged));
     return handle;
   }
   if (tensor_table_.count(name)) {
@@ -367,6 +589,7 @@ int Engine::Enqueue(OpType op, const std::string& name, DType dtype,
     handles_[handle].status = Status::Error(
         "duplicate in-flight op name '" + name +
         "'; await the previous op or use distinct names");
+    PoolPutLocked(std::move(staged));
     cv_.notify_all();
     return handle;
   }
@@ -377,10 +600,9 @@ int Engine::Enqueue(OpType op, const std::string& name, DType dtype,
   e.req.name = name;
   e.req.root_rank = root_rank;
   e.req.dims = dims;
-  size_t nbytes = static_cast<size_t>(NumElems(dims)) * DTypeSize(dtype);
-  e.data.assign(static_cast<const char*>(data),
-                static_cast<const char*>(data) + nbytes);
+  e.data = std::move(staged);
   e.handle = handle;
+  e.user_out = user_out;
   e.enqueued_at = std::chrono::steady_clock::now();
   queue_.push_back(e.req);
   tensor_table_.emplace(name, std::move(e));
@@ -417,7 +639,10 @@ HandleState* Engine::GetDone(int handle) {
 
 void Engine::ReleaseHandle(int handle) {
   std::lock_guard<std::mutex> lk(mu_);
-  handles_.erase(handle);
+  auto it = handles_.find(handle);
+  if (it == handles_.end()) return;
+  PoolPutLocked(std::move(it->second.result));
+  handles_.erase(it);
 }
 
 std::string Engine::TakeError(int handle) {
@@ -708,23 +933,35 @@ void Engine::FuseReady(ResponseList* out) {
         NumElems(first.dims) * static_cast<int64_t>(DTypeSize(first.dtype));
     DType dtype = first.dtype;
     message_table_.erase(it);
-    // fuse successive ready same-dtype allreduces up to the threshold
+    // fuse ready same-dtype allreduces up to the threshold, looking ahead
+    // PAST non-matching entries (other ops, other dtypes, too-big) instead
+    // of stopping at the first mismatch — the reference's skip-list
+    // behavior (operations.cc:2160-2265) that keeps interleaved fp16/fp32
+    // gradient streams fusing into one buffer per dtype.  Skipped entries
+    // stay in ready_ (in order) and head later responses this same tick.
     if (resp.op == OpType::kAllreduce) {
-      while (!ready_.empty() && bytes < fusion_threshold_) {
-        auto nx = message_table_.find(ready_.front());
+      for (auto itr = ready_.begin();
+           itr != ready_.end() && bytes < fusion_threshold_;) {
+        auto nx = message_table_.find(*itr);
         if (nx == message_table_.end()) {
-          ready_.pop_front();
+          itr = ready_.erase(itr);
           continue;
         }
         const Request& nr = nx->second.received.front();
-        if (nr.op != OpType::kAllreduce || nr.dtype != dtype) break;
+        if (nr.op != OpType::kAllreduce || nr.dtype != dtype) {
+          ++itr;  // skip, keep for a later response
+          continue;
+        }
         int64_t nbytes =
             NumElems(nr.dims) * static_cast<int64_t>(DTypeSize(nr.dtype));
-        if (bytes + nbytes > fusion_threshold_) break;
+        if (bytes + nbytes > fusion_threshold_) {
+          ++itr;
+          continue;
+        }
         bytes += nbytes;
-        resp.names.push_back(ready_.front());
+        resp.names.push_back(*itr);
         message_table_.erase(nx);
-        ready_.pop_front();
+        itr = ready_.erase(itr);
       }
     }
     out->responses.push_back(std::move(resp));
@@ -818,83 +1055,229 @@ void Engine::ExecuteAllreduce(const Response& resp,
   auto act_end = [&]() {
     for (auto& e : entries) timeline_.ActivityEnd(e.req.name);
   };
+  auto reduce = [&](char* buf, int64_t nelems) {
+    if (hierarchical_allreduce_)
+      return HierarchicalAllreduce(buf, nelems, dtype);
+    return RingAllreduce(buf, nelems, dtype);
+  };
+  const char* act = hierarchical_allreduce_ ? "HIERARCHICAL_ALLREDUCE"
+                                            : "RING_ALLREDUCE";
+  // completes one entry: user_out callers get the result written into
+  // their buffer on this (background) thread; others get the vector moved
+  // into the handle state
+  auto finish = [&](TensorEntry& e, const Status& st) {
+    if (e.user_out) {
+      if (st.ok())
+        std::memcpy(e.user_out, e.data.data(), e.data.size());
+      PoolPut(std::move(e.data));
+      MarkDone(e.handle, st, e.req.dims, {});
+    } else {
+      MarkDone(e.handle, st, e.req.dims, std::move(e.data));
+    }
+  };
   if (entries.size() == 1) {
     // no fusion copy needed: reduce in place on the entry buffer
     TensorEntry& e = entries[0];
-    act_start("RING_ALLREDUCE");
-    Status st = RingAllreduce(e.data.data(), NumElems(e.req.dims), dtype);
+    act_start(act);
+    Status st = reduce(e.data.data(), NumElems(e.req.dims));
     act_end();
-    MarkDone(e.handle, st, e.req.dims, std::move(e.data));
+    finish(e, st);
     if (!st.ok()) FailAll(st);
     return;
   }
-  // fusion buffer: pack, one ring allreduce, unpack
+  // fusion buffer (persistent across responses): pack, one allreduce, unpack
   size_t total = 0;
   for (auto& e : entries) total += e.data.size();
-  std::vector<char> fused(total);
+  if (fusion_buf_.size() < total) fusion_buf_.resize(total);
+  char* fused = fusion_buf_.data();
   size_t off = 0;
   act_start("MEMCPY_IN_FUSION_BUFFER");
   for (auto& e : entries) {
-    std::memcpy(fused.data() + off, e.data.data(), e.data.size());
+    std::memcpy(fused + off, e.data.data(), e.data.size());
     off += e.data.size();
   }
   act_end();
-  act_start("RING_ALLREDUCE");
-  Status st = RingAllreduce(
-      fused.data(), static_cast<int64_t>(total / DTypeSize(dtype)), dtype);
+  act_start(act);
+  Status st = reduce(fused, static_cast<int64_t>(total / DTypeSize(dtype)));
   act_end();
   act_start("MEMCPY_OUT_FUSION_BUFFER");
   off = 0;
   for (auto& e : entries) {
-    if (st.ok())
-      std::memcpy(e.data.data(), fused.data() + off, e.data.size());
+    // unpack straight into the caller's buffer when provided
+    if (st.ok()) {
+      char* dst = e.user_out ? static_cast<char*>(e.user_out) : e.data.data();
+      std::memcpy(dst, fused + off, e.data.size());
+    }
     off += e.data.size();
   }
   act_end();
-  for (auto& e : entries) MarkDone(e.handle, st, e.req.dims, std::move(e.data));
+  for (auto& e : entries) {
+    if (e.user_out) {
+      PoolPut(std::move(e.data));
+      MarkDone(e.handle, st, e.req.dims, {});
+    } else {
+      MarkDone(e.handle, st, e.req.dims, std::move(e.data));
+    }
+  }
   if (!st.ok()) FailAll(st);
 }
 
-// Ring allreduce: reduce-scatter then allgather over the rank ring — the
-// classic bandwidth-optimal algorithm (2(n-1)/n bytes per element on the
-// wire), operating on the (possibly fused) contiguous buffer.
-Status Engine::RingAllreduce(char* buf, int64_t nelems, DType dtype) {
-  if (size_ == 1) return Status::OK();
+// Ring allreduce over an arbitrary rank subgroup: reduce-scatter then
+// allgather over the member ring — the classic bandwidth-optimal algorithm
+// (2(m-1)/m bytes per element on the wire), operating on the (possibly
+// fused) contiguous buffer.  members must be identical on every member.
+Status Engine::RingAllreduceGroup(char* buf, int64_t nelems, DType dtype,
+                                  const std::vector<int>& members) {
+  int m = static_cast<int>(members.size());
+  if (m <= 1) return Status::OK();
+  int me = static_cast<int>(
+      std::find(members.begin(), members.end(), rank_) - members.begin());
+  if (me == m) return Status::Error("rank not in ring group");
   size_t esize = DTypeSize(dtype);
-  int right = (rank_ + 1) % size_;
-  int left = (rank_ + size_ - 1) % size_;
-  auto chunk_lo = [&](int c) { return nelems * c / size_; };
-  std::vector<char> tmp(static_cast<size_t>(
-      (nelems / size_ + 1) * static_cast<int64_t>(esize)));
+  Socket& right = peers_[members[(me + 1) % m]];
+  Socket& left = peers_[members[(me + m - 1) % m]];
+  auto chunk_lo = [&](int c) { return nelems * c / m; };
+  size_t scratch = static_cast<size_t>(
+      (nelems / m + 1) * static_cast<int64_t>(esize));
+  if (ring_scratch_.size() < scratch) ring_scratch_.resize(scratch);
+  char* tmp = ring_scratch_.data();
 
-  for (int step = 0; step < size_ - 1; step++) {
-    int send_c = (rank_ - step + 2 * size_) % size_;
-    int recv_c = (rank_ - step - 1 + 2 * size_) % size_;
+  for (int step = 0; step < m - 1; step++) {
+    int send_c = (me - step + 2 * m) % m;
+    int recv_c = (me - step - 1 + 2 * m) % m;
     int64_t s_lo = chunk_lo(send_c), s_hi = chunk_lo(send_c + 1);
     int64_t r_lo = chunk_lo(recv_c), r_hi = chunk_lo(recv_c + 1);
     Status st = Socket::SendRecv(
-        peers_[right], buf + s_lo * esize, (s_hi - s_lo) * esize,
-        peers_[left], tmp.data(), (r_hi - r_lo) * esize);
+        right, buf + s_lo * esize, (s_hi - s_lo) * esize,
+        left, tmp, (r_hi - r_lo) * esize);
     if (!st.ok())
       return Status::Error("ring allreduce failed: " + st.message);
-    Accumulate(buf + r_lo * esize, tmp.data(), r_hi - r_lo, dtype);
+    Accumulate(buf + r_lo * esize, tmp, r_hi - r_lo, dtype);
   }
-  for (int step = 0; step < size_ - 1; step++) {
-    int send_c = (rank_ + 1 - step + 2 * size_) % size_;
-    int recv_c = (rank_ - step + 2 * size_) % size_;
+  for (int step = 0; step < m - 1; step++) {
+    int send_c = (me + 1 - step + 2 * m) % m;
+    int recv_c = (me - step + 2 * m) % m;
     int64_t s_lo = chunk_lo(send_c), s_hi = chunk_lo(send_c + 1);
     int64_t r_lo = chunk_lo(recv_c), r_hi = chunk_lo(recv_c + 1);
     Status st = Socket::SendRecv(
-        peers_[right], buf + s_lo * esize, (s_hi - s_lo) * esize,
-        peers_[left], buf + r_lo * esize, (r_hi - r_lo) * esize);
+        right, buf + s_lo * esize, (s_hi - s_lo) * esize,
+        left, buf + r_lo * esize, (r_hi - r_lo) * esize);
     if (!st.ok())
       return Status::Error("ring allreduce failed: " + st.message);
   }
   return Status::OK();
 }
 
-// Variable-sized ring allgather: block b travels the ring; after n-1 steps
-// every rank holds all blocks at the right offsets.
+// Two-level allreduce for multi-host topologies (eager analog of the
+// reference's hierarchical path, operations.cc:1284-1446): ring within the
+// host group (fast intra-host links), ring across the local roots (one
+// flow per host pair on the slow links instead of local_size flows), then
+// broadcast the result within each host.  Wire cost on the cross links
+// drops from 2(n-1)/n per rank to 2(h-1)/h per host.
+Status Engine::HierarchicalAllreduce(char* buf, int64_t nelems, DType dtype) {
+  Status st = RingAllreduceGroup(buf, nelems, dtype, local_group_);
+  if (!st.ok()) return st;
+  int local_root = local_group_.front();
+  if (rank_ == local_root && cross_group_.size() > 1) {
+    st = RingAllreduceGroup(buf, nelems, dtype, cross_group_);
+    if (!st.ok()) return st;
+  }
+  return TreeBroadcastGroup(buf,
+                            nelems * static_cast<int64_t>(DTypeSize(dtype)),
+                            local_root, local_group_);
+}
+
+// Variable-sized ring allgather over a subgroup: member block b travels
+// the ring; after m-1 steps every member holds the concat of all member
+// blocks (in member order) in `concat`, whose caller pre-placed this
+// member's own block at its offset.
+Status Engine::RingAllgatherGroup(const std::vector<int>& members,
+                                 const std::vector<size_t>& member_bytes,
+                                 char* concat) {
+  int m = static_cast<int>(members.size());
+  if (m <= 1) return Status::OK();
+  int me = static_cast<int>(
+      std::find(members.begin(), members.end(), rank_) - members.begin());
+  if (me == m) return Status::Error("rank not in allgather group");
+  std::vector<size_t> off(m + 1, 0);
+  for (int i = 0; i < m; i++) off[i + 1] = off[i] + member_bytes[i];
+  Socket& right = peers_[members[(me + 1) % m]];
+  Socket& left = peers_[members[(me + m - 1) % m]];
+  for (int step = 0; step < m - 1; step++) {
+    int send_b = (me - step + 2 * m) % m;
+    int recv_b = (me - step - 1 + 2 * m) % m;
+    Status st = Socket::SendRecv(
+        right, concat + off[send_b], member_bytes[send_b],
+        left, concat + off[recv_b], member_bytes[recv_b]);
+    if (!st.ok())
+      return Status::Error("ring allgather failed: " + st.message);
+  }
+  return Status::OK();
+}
+
+// Two-level allgather (eager analog of the reference's hierarchical
+// allgather, operations.cc:929-1033, shared-memory window replaced by the
+// intra-host ring): gather within the host group, exchange whole host
+// blocks between local roots, reorder into global rank order, broadcast
+// within the host.  Cross links carry one flow per host pair.
+Status Engine::HierarchicalAllgather(const Response& resp, TensorEntry& entry,
+                                     int64_t stride,
+                                     std::vector<char>* out) {
+  size_t esize = DTypeSize(entry.req.dtype);
+  auto rank_bytes = [&](int r) {
+    return static_cast<size_t>(resp.first_dims[r] * stride) * esize;
+  };
+  // stage 1: local ring allgather -> local concat (member order)
+  int m = static_cast<int>(local_group_.size());
+  std::vector<size_t> lbytes(m);
+  size_t loff = 0, lme = 0;
+  for (int i = 0; i < m; i++) {
+    lbytes[i] = rank_bytes(local_group_[i]);
+    if (local_group_[i] == rank_) lme = loff;
+    loff += lbytes[i];
+  }
+  // group blocks (concat of member rows) laid out in host-group order
+  std::vector<size_t> gbytes(host_groups_.size());
+  std::vector<size_t> goff(host_groups_.size() + 1, 0);
+  size_t my_goff = 0;
+  for (size_t g = 0; g < host_groups_.size(); g++) {
+    size_t b = 0;
+    for (int r : host_groups_[g]) b += rank_bytes(r);
+    gbytes[g] = b;
+    goff[g + 1] = goff[g] + b;
+    if (host_groups_[g].front() == local_group_.front()) my_goff = goff[g];
+  }
+  std::vector<char> gathered(goff.back());
+  std::memcpy(gathered.data() + my_goff + lme, entry.data.data(),
+              entry.data.size());
+  Status st = RingAllgatherGroup(
+      local_group_, lbytes, gathered.data() + my_goff);
+  if (!st.ok()) return st;
+  // stage 2: local roots exchange host blocks
+  if (rank_ == local_group_.front() && cross_group_.size() > 1) {
+    st = RingAllgatherGroup(cross_group_, gbytes, gathered.data());
+    if (!st.ok()) return st;
+  }
+  // stage 3: root broadcasts the full concat within the host
+  st = TreeBroadcastGroup(gathered.data(),
+                          static_cast<int64_t>(gathered.size()),
+                          local_group_.front(), local_group_);
+  if (!st.ok()) return st;
+  // reorder host-grouped concat into global rank order
+  std::vector<size_t> global_off(size_ + 1, 0);
+  for (int r = 0; r < size_; r++)
+    global_off[r + 1] = global_off[r] + rank_bytes(r);
+  out->assign(global_off[size_], 0);
+  size_t src = 0;
+  for (const auto& g : host_groups_)
+    for (int r : g) {
+      std::memcpy(out->data() + global_off[r], gathered.data() + src,
+                  rank_bytes(r));
+      src += rank_bytes(r);
+    }
+  return Status::OK();
+}
+
 void Engine::ExecuteAllgather(const Response& resp, TensorEntry& entry) {
   DType dtype = entry.req.dtype;
   size_t esize = DTypeSize(dtype);
@@ -905,53 +1288,70 @@ void Engine::ExecuteAllgather(const Response& resp, TensorEntry& entry) {
   std::vector<int64_t> offsets(size_ + 1, 0);
   for (int r = 0; r < size_; r++)
     offsets[r + 1] = offsets[r] + resp.first_dims[r] * stride;
-  std::vector<char> out(static_cast<size_t>(offsets[size_]) * esize);
-  std::memcpy(out.data() + offsets[rank_] * esize, entry.data.data(),
-              entry.data.size());
-  int right = (rank_ + 1) % size_;
-  int left = (rank_ + size_ - 1) % size_;
-  for (int step = 0; step < size_ - 1; step++) {
-    int send_b = (rank_ - step + 2 * size_) % size_;
-    int recv_b = (rank_ - step - 1 + 2 * size_) % size_;
-    Status st = Socket::SendRecv(
-        peers_[right], out.data() + offsets[send_b] * esize,
-        static_cast<size_t>(resp.first_dims[send_b] * stride) * esize,
-        peers_[left], out.data() + offsets[recv_b] * esize,
-        static_cast<size_t>(resp.first_dims[recv_b] * stride) * esize);
-    if (!st.ok()) {
-      Status err = Status::Error("ring allgather failed: " + st.message);
-      MarkDone(entry.handle, err, {}, {});
-      FailAll(err);
-      return;
-    }
-  }
   std::vector<int64_t> out_dims = entry.req.dims;
   if (out_dims.empty()) out_dims = {1};
   out_dims[0] = offsets[size_] / (stride ? stride : 1);
+
+  if (hierarchical_allgather_) {
+    std::vector<char> out;
+    Status st = HierarchicalAllgather(resp, entry, stride, &out);
+    if (!st.ok()) {
+      MarkDone(entry.handle, st, {}, {});
+      FailAll(st);
+      return;
+    }
+    MarkDone(entry.handle, Status::OK(), std::move(out_dims), std::move(out));
+    return;
+  }
+
+  std::vector<char> out = PoolGet(static_cast<size_t>(offsets[size_]) * esize);
+  std::memcpy(out.data() + offsets[rank_] * esize, entry.data.data(),
+              entry.data.size());
+  PoolPut(std::move(entry.data));
+  // flat variable-sized ring: block b travels the ring; after n-1 steps
+  // every rank holds all blocks at the right offsets
+  std::vector<size_t> bytes(size_);
+  for (int r = 0; r < size_; r++)
+    bytes[r] = static_cast<size_t>(resp.first_dims[r] * stride) * esize;
+  Status st = RingAllgatherGroup(all_ranks_, bytes, out.data());
+  if (!st.ok()) {
+    MarkDone(entry.handle, st, {}, {});
+    FailAll(st);
+    return;
+  }
   MarkDone(entry.handle, Status::OK(), std::move(out_dims), std::move(out));
 }
 
-// Binomial-tree broadcast rooted at resp.root_rank: parent = clear the
-// lowest set bit of the root-relative rank; children = set each bit below
-// the lowest set bit.  log2(n) rounds, works for any world size.
-Status Engine::TreeBroadcast(char* buf, int64_t nbytes, int root) {
-  int vrank = (rank_ - root + size_) % size_;
+// Binomial-tree broadcast over an arbitrary rank subgroup, rooted at
+// global rank `root` (must be a member): parent = clear the lowest set bit
+// of the root-relative member index; children = set each bit below the
+// lowest set bit.  log2(m) rounds, works for any group size.
+Status Engine::TreeBroadcastGroup(char* buf, int64_t nbytes, int root,
+                                  const std::vector<int>& members) {
+  int m = static_cast<int>(members.size());
+  if (m <= 1) return Status::OK();
+  int me = static_cast<int>(
+      std::find(members.begin(), members.end(), rank_) - members.begin());
+  int ri = static_cast<int>(
+      std::find(members.begin(), members.end(), root) - members.begin());
+  if (me == m || ri == m) return Status::Error("rank not in broadcast group");
+  int vrank = (me - ri + m) % m;
   int mask = 1;
-  while (mask < size_) {
+  while (mask < m) {
     if (vrank & mask) {
-      int parent = ((vrank ^ mask) + root) % size_;
+      int parent = members[((vrank ^ mask) + ri) % m];
       Status st = peers_[parent].RecvAll(buf, static_cast<size_t>(nbytes));
       if (!st.ok()) return st;
       break;
     }
     mask <<= 1;
   }
-  // mask is now the lowest set bit of vrank (or >= size_ for the root);
+  // mask is now the lowest set bit of vrank (or >= m for the root);
   // children live at every bit position below it.
   for (mask >>= 1; mask > 0; mask >>= 1) {
     int child_v = vrank | mask;
-    if (child_v < size_) {
-      int child = (child_v + root) % size_;
+    if (child_v < m) {
+      int child = members[(child_v + ri) % m];
       Status st = peers_[child].SendAll(buf, static_cast<size_t>(nbytes));
       if (!st.ok()) return st;
     }
@@ -967,6 +1367,12 @@ void Engine::ExecuteBroadcast(const Response& resp, TensorEntry& entry) {
     Status err = Status::Error("broadcast failed: " + st.message);
     MarkDone(entry.handle, err, {}, {});
     FailAll(err);
+    return;
+  }
+  if (entry.user_out) {
+    std::memcpy(entry.user_out, entry.data.data(), entry.data.size());
+    PoolPut(std::move(entry.data));
+    MarkDone(entry.handle, Status::OK(), entry.req.dims, {});
     return;
   }
   MarkDone(entry.handle, Status::OK(), entry.req.dims, std::move(entry.data));
@@ -1057,7 +1463,21 @@ int hvd_enqueue(int op, const char* name, int dtype, int ndim,
   if (!g_engine) return -1;
   std::vector<int64_t> d(dims, dims + ndim);
   return g_engine->Enqueue(static_cast<OpType>(op), name,
-                           static_cast<DType>(dtype), d, data, root_rank);
+                           static_cast<DType>(dtype), d, data, root_rank,
+                           nullptr);
+}
+
+// Same, with a caller-owned output buffer of the input's size: the engine
+// writes the completed result there (background thread) and skips the
+// result-vector stage — allreduce/broadcast only (same-shape ops).
+int hvd_enqueue_out(int op, const char* name, int dtype, int ndim,
+                    const int64_t* dims, const void* data, int root_rank,
+                    void* out) {
+  if (!g_engine) return -1;
+  std::vector<int64_t> d(dims, dims + ndim);
+  return g_engine->Enqueue(static_cast<OpType>(op), name,
+                           static_cast<DType>(dtype), d, data, root_rank,
+                           out);
 }
 
 int hvd_poll(int handle) { return g_engine ? g_engine->PollHandle(handle) : -2; }

@@ -1,0 +1,119 @@
+// Leveled stream logging for the native engine.
+//
+// Role analog of the reference's logging framework
+// (/root/reference/horovod/common/logging.h:7-57): LOG(severity) stream
+// macros with an environment-controlled minimum level and optional
+// timestamps — re-designed as a single header with no generated code.
+//
+// Env:
+//   HOROVOD_TPU_LOG_LEVEL / HOROVOD_LOG_LEVEL: trace|debug|info|warning|
+//     error|fatal (default warning)
+//   HOROVOD_TPU_LOG_TIMESTAMP / HOROVOD_LOG_TIMESTAMP: prefix wall time
+#ifndef HVDTPU_LOGGING_H_
+#define HVDTPU_LOGGING_H_
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <ctime>
+#include <sstream>
+#include <string>
+
+namespace hvdtpu {
+
+enum class LogLevel : int {
+  kTrace = 0,
+  kDebug = 1,
+  kInfo = 2,
+  kWarning = 3,
+  kError = 4,
+  kFatal = 5,
+};
+
+inline LogLevel ParseLogLevel(const char* s) {
+  if (!s || !s[0]) return LogLevel::kWarning;
+  std::string v(s);
+  for (char& c : v) c = static_cast<char>(tolower(c));
+  if (v == "trace" || v == "0") return LogLevel::kTrace;
+  if (v == "debug" || v == "1") return LogLevel::kDebug;
+  if (v == "info" || v == "2") return LogLevel::kInfo;
+  if (v == "warning" || v == "warn" || v == "3") return LogLevel::kWarning;
+  if (v == "error" || v == "4") return LogLevel::kError;
+  if (v == "fatal" || v == "5") return LogLevel::kFatal;
+  return LogLevel::kWarning;
+}
+
+inline LogLevel MinLogLevel() {
+  static LogLevel lvl = [] {
+    const char* s = getenv("HOROVOD_TPU_LOG_LEVEL");
+    if (!s || !s[0]) s = getenv("HOROVOD_LOG_LEVEL");
+    return ParseLogLevel(s);
+  }();
+  return lvl;
+}
+
+inline bool LogTimestamps() {
+  static bool on = [] {
+    const char* s = getenv("HOROVOD_TPU_LOG_TIMESTAMP");
+    if (!s || !s[0]) s = getenv("HOROVOD_LOG_TIMESTAMP");
+    return s && s[0] && strcmp(s, "0") != 0;
+  }();
+  return on;
+}
+
+inline const char* LevelName(LogLevel l) {
+  switch (l) {
+    case LogLevel::kTrace: return "TRACE";
+    case LogLevel::kDebug: return "DEBUG";
+    case LogLevel::kInfo: return "INFO";
+    case LogLevel::kWarning: return "WARNING";
+    case LogLevel::kError: return "ERROR";
+    case LogLevel::kFatal: return "FATAL";
+  }
+  return "?";
+}
+
+// One log statement: buffers the stream, writes one line on destruction.
+class LogMessage {
+ public:
+  LogMessage(LogLevel level, int rank = -1) : level_(level) {
+    if (LogTimestamps()) {
+      char buf[32];
+      time_t t = time(nullptr);
+      struct tm tmv;
+      localtime_r(&t, &tmv);
+      strftime(buf, sizeof(buf), "%F %T", &tmv);
+      os_ << buf << " ";
+    }
+    os_ << "[hvdtpu";
+    if (rank >= 0) os_ << " rank " << rank;
+    os_ << "] " << LevelName(level) << ": ";
+  }
+  ~LogMessage() {
+    os_ << "\n";
+    fputs(os_.str().c_str(), stderr);
+    fflush(stderr);
+    if (level_ == LogLevel::kFatal) abort();
+  }
+  std::ostringstream& stream() { return os_; }
+
+ private:
+  LogLevel level_;
+  std::ostringstream os_;
+};
+
+}  // namespace hvdtpu
+
+// LOG(INFO) << "..."; LOG_RANK(DEBUG, rank_) << "...";
+// The dead-branch ternary keeps disabled levels zero-cost (no stream work).
+#define HVD_LOG_ENABLED(lvl) \
+  (static_cast<int>(::hvdtpu::LogLevel::k##lvl) >= \
+   static_cast<int>(::hvdtpu::MinLogLevel()))
+#define LOG(lvl) \
+  if (HVD_LOG_ENABLED(lvl)) \
+  ::hvdtpu::LogMessage(::hvdtpu::LogLevel::k##lvl).stream()
+#define LOG_RANK(lvl, rank) \
+  if (HVD_LOG_ENABLED(lvl)) \
+  ::hvdtpu::LogMessage(::hvdtpu::LogLevel::k##lvl, (rank)).stream()
+
+#endif  // HVDTPU_LOGGING_H_

@@ -25,6 +25,12 @@ Status Errno(const std::string& what) {
 void SetNoDelay(int fd) {
   int one = 1;
   setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  // large kernel buffers keep the bulk data plane streaming (the default
+  // autotuned windows throttle same-host multi-MB ring hops); harmless if
+  // the kernel clamps to its rmem/wmem max
+  int bufsz = 8 << 20;
+  setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &bufsz, sizeof(bufsz));
+  setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &bufsz, sizeof(bufsz));
 }
 
 }  // namespace

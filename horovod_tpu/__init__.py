@@ -69,8 +69,15 @@ _auto_name.counter = itertools.count(1)
 # --------------------------------------------------------------------------
 
 def allreduce(tensor, average: bool = True, name: str | None = None,
-              compression=Compression.none) -> np.ndarray:
-    """Sum (or average) across all processes."""
+              compression=Compression.none, out=None) -> np.ndarray:
+    """Sum (or average) across all processes.
+
+    ``out``: optional result buffer (input's shape/dtype, C-contiguous)
+    the engine writes into — reuse it across steps to keep the eager path
+    on warm pages; pass the input itself for an in-place reduce.  Only
+    honored on the uncompressed path (compression changes the wire
+    shape).
+    """
     arr = _as_numpy(tensor)
     comp, ctx = compression.compress(arr)
     if compression is Compression.int8:
@@ -78,11 +85,19 @@ def allreduce(tensor, average: bool = True, name: str | None = None,
         # error locally and reduce in the original dtype.  (The native
         # engine applies true shared-scale wire quantization internally.)
         comp, ctx = compression.decompress(comp, ctx), None
-    out = _state.engine().allreduce(comp, _auto_name("allreduce", name))
-    out = compression.decompress(out, ctx)
+    direct = out if compression is Compression.none else None
+    res = _state.engine().allreduce(comp, _auto_name("allreduce", name),
+                                    out=direct)
+    res = compression.decompress(res, ctx)
     if average:
-        out = out / size()
-    return out
+        if direct is not None:
+            # keep the caller's buffer authoritative for every dtype (the
+            # quotient is cast back into out's dtype — bf16 included)
+            np.divide(res, size(), out=direct, casting="unsafe")
+            res = direct
+        else:
+            res = res / size()
+    return res
 
 
 def allgather(tensor, name: str | None = None) -> np.ndarray:
@@ -92,10 +107,12 @@ def allgather(tensor, name: str | None = None) -> np.ndarray:
     return _state.engine().allgather(_as_numpy(tensor), _auto_name("allgather", name))
 
 
-def broadcast(tensor, root_rank: int, name: str | None = None) -> np.ndarray:
-    """Every process receives root_rank's value."""
+def broadcast(tensor, root_rank: int, name: str | None = None,
+              out=None) -> np.ndarray:
+    """Every process receives root_rank's value.  ``out`` as in
+    :func:`allreduce` (pass the input itself for in-place)."""
     return _state.engine().broadcast(
-        _as_numpy(tensor), root_rank, _auto_name("broadcast", name)
+        _as_numpy(tensor), root_rank, _auto_name("broadcast", name), out=out
     )
 
 
@@ -113,10 +130,12 @@ def barrier() -> None:
 # Asynchronous API with handles
 # --------------------------------------------------------------------------
 
-def allreduce_async(tensor, average: bool = True, name: str | None = None) -> int:
+def allreduce_async(tensor, average: bool = True, name: str | None = None,
+                    out=None) -> int:
     arr = _as_numpy(tensor)
     engine = _state.engine()
-    handle = engine.allreduce_async(arr, _auto_name("allreduce", name))
+    handle = engine.allreduce_async(arr, _auto_name("allreduce", name),
+                                    out=out)
     if average:
         # tracked on the engine so handle-id reuse after shutdown()/init()
         # can never inherit a stale average flag
@@ -147,7 +166,15 @@ def synchronize(handle: int):
     out = engine.synchronize(handle)
     if handle in engine.average_handles:
         engine.average_handles.discard(handle)
-        out = out / size()
+        floaty = isinstance(out, np.ndarray) and (
+            np.issubdtype(out.dtype, np.floating)
+            or out.dtype.name == "bfloat16")
+        if floaty:
+            # in place: keeps caller-provided `out` buffers authoritative
+            # (bf16 divides through float32 and casts back)
+            np.divide(out, size(), out=out, casting="unsafe")
+        else:
+            out = out / size()  # ints promote, as before
     return out
 
 

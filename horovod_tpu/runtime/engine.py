@@ -84,26 +84,33 @@ class Engine:
         self.average_handles: set[int] = set()
 
     # -- sync API ----------------------------------------------------------
-    def allreduce(self, array: np.ndarray, name: str, op: str = _SUM) -> np.ndarray:
-        return self.handles.wait(self.allreduce_async(array, name, op))
+    def allreduce(self, array: np.ndarray, name: str, op: str = _SUM,
+                  out: np.ndarray | None = None) -> np.ndarray:
+        return self.handles.wait(self.allreduce_async(array, name, op,
+                                                      out=out))
 
     def allgather(self, array: np.ndarray, name: str) -> np.ndarray:
         return self.handles.wait(self.allgather_async(array, name))
 
-    def broadcast(self, array: np.ndarray, root_rank: int, name: str) -> np.ndarray:
-        return self.handles.wait(self.broadcast_async(array, root_rank, name))
+    def broadcast(self, array: np.ndarray, root_rank: int, name: str,
+                  out: np.ndarray | None = None) -> np.ndarray:
+        return self.handles.wait(
+            self.broadcast_async(array, root_rank, name, out=out))
 
     def alltoall(self, array: np.ndarray, name: str) -> np.ndarray:
         return self.handles.wait(self.alltoall_async(array, name))
 
     # -- async API (must be implemented) -----------------------------------
-    def allreduce_async(self, array, name, op=_SUM) -> int:
+    # `out` (allreduce/broadcast): caller-owned result buffer of the
+    # input's shape/dtype — written by the engine, enabling in-place ops
+    # and buffer reuse across steps (no fresh pages per op)
+    def allreduce_async(self, array, name, op=_SUM, out=None) -> int:
         raise NotImplementedError
 
     def allgather_async(self, array, name) -> int:
         raise NotImplementedError
 
-    def broadcast_async(self, array, root_rank, name) -> int:
+    def broadcast_async(self, array, root_rank, name, out=None) -> int:
         raise NotImplementedError
 
     def alltoall_async(self, array, name) -> int:
@@ -132,18 +139,24 @@ class SingleProcessEngine(Engine):
         self.handles.mark_done(handle, result)
         return handle
 
-    def allreduce_async(self, array, name, op=_SUM) -> int:
-        return self._complete(np.array(array, copy=True))
+    def _copy(self, array, out):
+        if out is not None:
+            np.copyto(out, array)
+            return out
+        return np.array(array, copy=True)
+
+    def allreduce_async(self, array, name, op=_SUM, out=None) -> int:
+        return self._complete(self._copy(array, out))
 
     def allgather_async(self, array, name) -> int:
         return self._complete(np.array(array, copy=True))
 
-    def broadcast_async(self, array, root_rank, name) -> int:
+    def broadcast_async(self, array, root_rank, name, out=None) -> int:
         if root_rank != 0:
             raise ValueError(
                 f"broadcast root_rank {root_rank} out of range for size-1 world"
             )
-        return self._complete(np.array(array, copy=True))
+        return self._complete(self._copy(array, out))
 
     def alltoall_async(self, array, name) -> int:
         return self._complete(np.array(array, copy=True))

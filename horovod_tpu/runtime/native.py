@@ -111,6 +111,12 @@ def _bind(lib):
         ctypes.POINTER(ctypes.c_int64), ctypes.c_void_p, ctypes.c_int,
     ]
     lib.hvd_enqueue.restype = ctypes.c_int
+    lib.hvd_enqueue_out.argtypes = [
+        ctypes.c_int, ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_void_p, ctypes.c_int,
+        ctypes.c_void_p,
+    ]
+    lib.hvd_enqueue_out.restype = ctypes.c_int
     lib.hvd_poll.argtypes = [ctypes.c_int]
     lib.hvd_poll.restype = ctypes.c_int
     lib.hvd_wait.argtypes = [ctypes.c_int, ctypes.c_double]
@@ -165,6 +171,9 @@ class NativeEngine(Engine):
             )
         self._topology = topology
         self._dtype_by_handle: dict[int, np.dtype] = {}
+        # result arrays the engine writes directly (allreduce/broadcast):
+        # also pins the buffer until synchronize
+        self._out_by_handle: dict[int, np.ndarray] = {}
         self._lock = threading.Lock()
         lib = _load_lib()
         host, port = rendezvous_addr()
@@ -178,35 +187,57 @@ class NativeEngine(Engine):
         self._lib = lib
 
     # -- async ops ---------------------------------------------------------
-    def _enqueue(self, op: int, array, name: str, root_rank: int = -1) -> int:
+    def _enqueue(self, op: int, array, name: str, root_rank: int = -1,
+                 out: np.ndarray | None = None) -> int:
         arr, dtype = _np_view(np.asarray(array))
+        if out is not None:
+            if (out.dtype != arr.dtype or out.shape != arr.shape
+                    or not out.flags.c_contiguous):
+                raise ValueError(
+                    "out must be C-contiguous with the input's shape/dtype"
+                    f" (got {out.dtype}{out.shape} for {arr.dtype}{arr.shape})")
         dims = (ctypes.c_int64 * max(arr.ndim, 1))(*(arr.shape or (1,)))
-        handle = self._lib.hvd_enqueue(
-            op, name.encode(), dtype, arr.ndim, dims,
-            arr.ctypes.data_as(ctypes.c_void_p), root_rank,
-        )
+        if op in (_OP_ALLREDUCE, _OP_BROADCAST):
+            # same-shape ops: the engine writes the result straight into
+            # this buffer on its background thread (one copy out, no
+            # result-vector stage); `out` lets callers go fully in-place
+            if out is None:
+                out = np.empty_like(arr)
+            handle = self._lib.hvd_enqueue_out(
+                op, name.encode(), dtype, arr.ndim, dims,
+                arr.ctypes.data_as(ctypes.c_void_p), root_rank,
+                out.ctypes.data_as(ctypes.c_void_p),
+            )
+        else:
+            out = None
+            handle = self._lib.hvd_enqueue(
+                op, name.encode(), dtype, arr.ndim, dims,
+                arr.ctypes.data_as(ctypes.c_void_p), root_rank,
+            )
         if handle < 0:
             raise RuntimeError("enqueue failed: engine not running")
         with self._lock:
             self._dtype_by_handle[handle] = arr.dtype
+            if out is not None:
+                self._out_by_handle[handle] = out
         return handle
 
-    def allreduce_async(self, array, name, op=_SUM) -> int:
+    def allreduce_async(self, array, name, op=_SUM, out=None) -> int:
         if op != _SUM:
             raise ValueError("native engine reduces with op='sum'; apply "
                              "min/max via the compiled path")
-        return self._enqueue(_OP_ALLREDUCE, array, name)
+        return self._enqueue(_OP_ALLREDUCE, array, name, out=out)
 
     def allgather_async(self, array, name) -> int:
         return self._enqueue(_OP_ALLGATHER, array, name)
 
-    def broadcast_async(self, array, root_rank, name) -> int:
+    def broadcast_async(self, array, root_rank, name, out=None) -> int:
         if not 0 <= root_rank < self._topology.size:
             raise ValueError(
                 f"broadcast root_rank {root_rank} out of range for world "
                 f"size {self._topology.size}"
             )
-        return self._enqueue(_OP_BROADCAST, array, name, root_rank)
+        return self._enqueue(_OP_BROADCAST, array, name, root_rank, out=out)
 
     def alltoall_async(self, array, name) -> int:
         arr = np.asarray(array)
@@ -239,6 +270,12 @@ class NativeEngine(Engine):
                 finally:
                     self._lib.hvd_free_cstr(p)
                 raise RuntimeError(f"collective failed: {msg}")
+            with self._lock:
+                direct = self._out_by_handle.get(handle)
+            if direct is not None:
+                # engine already wrote the result into this buffer on its
+                # background thread
+                return direct
             ndim = self._lib.hvd_result_ndim(handle)
             dims = (ctypes.c_int64 * max(ndim, 1))()
             self._lib.hvd_result_dims(handle, dims)
@@ -256,16 +293,18 @@ class NativeEngine(Engine):
             self._lib.hvd_release(handle)
             with self._lock:
                 self._dtype_by_handle.pop(handle, None)
+                self._out_by_handle.pop(handle, None)
 
     # -- sync wrappers (route through native wait, not HandleManager) ------
-    def allreduce(self, array, name, op=_SUM):
-        return self.synchronize(self.allreduce_async(array, name, op))
+    def allreduce(self, array, name, op=_SUM, out=None):
+        return self.synchronize(self.allreduce_async(array, name, op, out=out))
 
     def allgather(self, array, name):
         return self.synchronize(self.allgather_async(array, name))
 
-    def broadcast(self, array, root_rank, name):
-        return self.synchronize(self.broadcast_async(array, root_rank, name))
+    def broadcast(self, array, root_rank, name, out=None):
+        return self.synchronize(
+            self.broadcast_async(array, root_rank, name, out=out))
 
     def alltoall(self, array, name):
         return self.synchronize(self.alltoall_async(array, name))

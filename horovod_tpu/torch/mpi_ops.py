@@ -74,9 +74,12 @@ def allreduce_async(tensor, average=True, name=None) -> int:
 
 
 def allreduce_async_(tensor, average=True, name=None) -> int:
-    """In-place: on synchronize, the reduced values overwrite ``tensor``."""
+    """In-place: on synchronize, the reduced values overwrite ``tensor``.
+    For contiguous CPU tensors the engine writes the result directly into
+    the tensor's memory (the numpy view doubles as the output buffer)."""
+    arr = _to_numpy(tensor)
     handle = _state.engine().allreduce_async(
-        _to_numpy(tensor), _name("allreduce", name))
+        arr, _name("allreduce", name), out=arr)
     return _register(handle, tensor, average, tensor.dtype)
 
 
@@ -150,8 +153,9 @@ def broadcast_async(tensor, root_rank, name=None) -> int:
 
 
 def broadcast_async_(tensor, root_rank, name=None) -> int:
+    arr = _to_numpy(tensor)
     handle = _state.engine().broadcast_async(
-        _to_numpy(tensor), root_rank, _name("broadcast", name))
+        arr, root_rank, _name("broadcast", name), out=arr)
     return _register(handle, tensor, False, tensor.dtype)
 
 

@@ -25,7 +25,8 @@ from setuptools.command.build_py import build_py as _build_py
 HERE = os.path.abspath(os.path.dirname(__file__))
 CSRC = os.path.join(HERE, "csrc")
 SOURCES = ["socket.cc", "wire.cc", "timeline.cc", "autotune.cc", "engine.cc"]
-HEADERS = ["common.h", "socket.h", "wire.h", "timeline.h", "autotune.h"]
+HEADERS = ["common.h", "socket.h", "wire.h", "timeline.h", "autotune.h",
+           "logging.h"]
 
 
 def _compiler() -> str:

@@ -170,6 +170,93 @@ def scenario_autotune():
     print(f"rank {r}: autotune OK")
 
 
+def scenario_hierarchical():
+    """Two simulated hosts of 2 ranks (host-hash override) with the
+    two-level allreduce + allgather paths forced on; asserts correctness
+    across dtypes (incl. the SIMD fp16/bf16 accumulate) and odd sizes."""
+    r = int(os.environ["HOROVOD_TPU_RANK"])
+    os.environ["HOROVOD_TPU_HOST_HASH"] = f"simhost{r // 2}"
+    os.environ["HOROVOD_TPU_HIERARCHICAL_ALLREDUCE"] = "1"
+    os.environ["HOROVOD_TPU_HIERARCHICAL_ALLGATHER"] = "1"
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+
+    import ml_dtypes
+
+    ranks_sum = n * (n - 1) / 2
+    for dtype, atol in ((np.float32, 1e-5), (np.float64, 0.0),
+                        (np.float16, 0.1), (ml_dtypes.bfloat16, 0.5),
+                        (np.int32, 0.0)):
+        # sizes straddle the ring chunking and the 8-wide SIMD tail
+        for sz in (1, 7, 64, 1001):
+            base = (np.arange(sz) % 13).astype(dtype)
+            out = hvd.allreduce(
+                base + np.asarray(r, dtype), average=False,
+                name=f"h.{np.dtype(dtype).name}.{sz}")
+            expect = (np.arange(sz) % 13).astype(np.float64) * n + ranks_sum
+            assert np.allclose(out.astype(np.float64), expect, atol=atol), (
+                r, dtype, sz)
+
+    # variable-first-dim allgather through the two-level path
+    gat = hvd.allgather(np.full((r + 1, 3), float(r), np.float32), name="hg")
+    expect = np.concatenate(
+        [np.full((k + 1, 3), float(k), np.float32) for k in range(n)])
+    assert np.array_equal(gat, expect), (r, gat)
+
+    # fused hierarchical allreduce
+    handles = [
+        hvd.allreduce_async(np.full(16, float(i + r), np.float32),
+                            average=False, name=f"hf{i}")
+        for i in range(8)
+    ]
+    for i, h in enumerate(handles):
+        got = hvd.synchronize(h)
+        assert np.allclose(got, n * i + ranks_sum), (r, i, got)
+    hvd.shutdown()
+    print(f"rank {r}: hierarchical OK", flush=True)
+
+
+def scenario_hierarchical_default():
+    """Asymmetric simulated topology (2+1 ranks) with NO hierarchical env
+    forcing: every rank must derive the same on/off default from the
+    shared host table (a per-rank default diverges and deadlocks)."""
+    r = int(os.environ["HOROVOD_TPU_RANK"])
+    os.environ["HOROVOD_TPU_HOST_HASH"] = f"simhost{min(r // 2, 1)}"
+    os.environ.pop("HOROVOD_TPU_HIERARCHICAL_ALLREDUCE", None)
+    os.environ.pop("HOROVOD_HIERARCHICAL_ALLREDUCE", None)
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    out = hvd.allreduce(np.full(100, float(r + 1), np.float32),
+                        average=False, name="hd")
+    assert np.allclose(out, n * (n + 1) / 2), (r, out)
+    # in-place variant through the two-level path
+    buf = np.full(33, float(r), np.float32)
+    res = hvd.allreduce(buf, average=True, name="hd2", out=buf)
+    assert res is buf and np.allclose(buf, (n - 1) / 2), (r, buf)
+    hvd.shutdown()
+    print(f"rank {r}: hierarchical default OK", flush=True)
+
+
+def scenario_mixed_fusion():
+    """Interleaved fp32/fp16 gradient stream under a long cycle time; the
+    test asserts (via the timeline) that the coordinator's look-ahead
+    fused BOTH dtype runs instead of stopping at the first mismatch."""
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    handles = []
+    for i in range(12):
+        dt = np.float32 if i % 2 == 0 else np.float16
+        handles.append(
+            hvd.allreduce_async(np.full(64, float(i + r), dt),
+                                average=False, name=f"mix{i}"))
+    ranks_sum = n * (n - 1) / 2
+    for i, h in enumerate(handles):
+        got = hvd.synchronize(h)
+        assert np.allclose(got.astype(np.float64), n * i + ranks_sum), (r, i)
+    hvd.shutdown()
+    print(f"rank {r}: mixed fusion OK", flush=True)
+
+
 def scenario_skewed_shutdown():
     """Rank 0 lags its shutdown by seconds (checkpointing, logging...) while
     the peers shut down and exit immediately.  Regression: the engine's

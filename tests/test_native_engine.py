@@ -43,6 +43,63 @@ def test_cross_rank_errors_do_not_hang():
         assert f"rank {r}: errors OK" in res.stdout
 
 
+@pytest.mark.parametrize("np_", [4, 3, 6])
+def test_hierarchical_two_level(np_):
+    """Simulated multi-host topology (host-hash override, 2 ranks per
+    host): the two-level allreduce/allgather paths must agree with the
+    flat results across dtypes (incl. SIMD fp16/bf16) and odd sizes."""
+    res = _run("hierarchical", np_, timeout=180)
+    assert res.returncode == 0, res.stderr + res.stdout
+    for r in range(np_):
+        assert f"rank {r}: hierarchical OK" in res.stdout
+
+
+@pytest.mark.parametrize("np_", [3, 5])
+def test_hierarchical_default_asymmetric(np_):
+    """No env forcing, unequal ranks per simulated host: the hierarchical
+    default must be derived from globally shared topology (regression: a
+    per-rank default made hosts disagree on the algorithm and hang)."""
+    res = _run("hierarchical_default", np_, timeout=120)
+    assert res.returncode == 0, res.stderr + res.stdout
+    for r in range(np_):
+        assert f"rank {r}: hierarchical default OK" in res.stdout
+
+
+def test_mixed_dtype_fusion_lookahead(tmp_path):
+    """Interleaved fp32/fp16 ops under one long negotiation cycle: the
+    coordinator's look-ahead must fuse BOTH dtype runs (two fusion
+    buffers) instead of stopping at the first dtype mismatch, which left
+    every op unfused.  Asserted via the fusion activities in the rank-0
+    timeline."""
+    import json
+
+    tl = tmp_path / "tl.json"
+    res = _run("mixed_fusion", 2, env={
+        "HOROVOD_TIMELINE": str(tl),
+        "HOROVOD_TPU_CYCLE_TIME": "200",
+    })
+    assert res.returncode == 0, res.stderr + res.stdout
+    events = json.loads(tl.read_text())
+    lane = {e["tid"]: e["args"]["name"] for e in events
+            if e.get("ph") == "M" and "name" in e.get("args", {})}
+    fused = {lane.get(e.get("tid")) for e in events
+             if e.get("name") == "MEMCPY_IN_FUSION_BUFFER"}
+    fused.discard(None)
+    assert any(n.endswith(("mix0", "mix2", "mix4")) for n in fused), fused
+    assert any(n.endswith(("mix1", "mix3", "mix5")) for n in fused), fused
+
+
+def test_log_level_env():
+    """Leveled C++ logging: the topology debug line appears only when the
+    env raises verbosity (reference logging.h:7-57 behavior)."""
+    res = _run("collectives", 2, env={"HOROVOD_TPU_LOG_LEVEL": "debug"})
+    assert res.returncode == 0, res.stderr + res.stdout
+    assert "DEBUG: topology:" in res.stderr, res.stderr[-2000:]
+    res = _run("collectives", 2, env={"HOROVOD_TPU_LOG_LEVEL": "error"})
+    assert res.returncode == 0, res.stderr + res.stdout
+    assert "DEBUG: topology:" not in res.stderr
+
+
 def test_skewed_shutdown_exits_cleanly():
     """Rank-0-delayed shutdown (e.g. rank-0-only checkpointing) must not
     SIGABRT: the engine joins its background thread even when the loop
