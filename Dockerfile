@@ -1,0 +1,25 @@
+# horovod_tpu runtime image.
+#
+# Role analog of the reference's Dockerfile (CUDA + framework + OpenMPI
+# stack, /root/reference/Dockerfile:1-8) — re-based for TPU hosts: no CUDA,
+# no MPI; JAX with the TPU PJRT plugin is the compute stack, and the native
+# engine builds from source at install time (g++ only).
+FROM python:3.12-slim-bookworm
+
+RUN apt-get update && apt-get install -y --no-install-recommends \
+        g++ make \
+    && rm -rf /var/lib/apt/lists/*
+
+# TPU-enabled JAX (libtpu comes with the 'tpu' extra; on non-TPU hosts
+# JAX falls back to CPU), plus the framework frontends' runtime deps.
+RUN pip install --no-cache-dir "jax[tpu]" optax orbax-checkpoint \
+        ml_dtypes einops
+
+WORKDIR /horovod_tpu
+COPY . .
+RUN pip install --no-cache-dir .
+
+# smoke: the engine builds and a size-1 world initializes
+RUN python -c "import horovod_tpu as hvd; hvd.init(); assert hvd.size() == 1; hvd.shutdown()"
+
+ENTRYPOINT ["hvdrun"]

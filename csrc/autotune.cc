@@ -190,13 +190,17 @@ constexpr double kFusionMax = 64.0 * (1 << 20);  // 0..64 MB
 constexpr double kCycleMinUs = 1e3, kCycleMaxUs = 1e5;  // 1..100 ms
 }  // namespace
 
-void ParameterManager::Initialize(int64_t fusion0, int64_t cycle_us0) {
+void ParameterManager::Initialize(int64_t fusion0, int64_t cycle_us0,
+                                  bool tune_hierarchical, bool hier0) {
   const char* on = getenv("HOROVOD_AUTOTUNE");
   if (!on || !on[0] || !strcmp(on, "0")) on = getenv("HOROVOD_TPU_AUTOTUNE");
   active_ = on && on[0] && strcmp(on, "0") != 0;
   fusion_ = fusion0;
   cycle_us_ = cycle_us0;
+  tune_hier_ = tune_hierarchical;
+  hier_ = hier0;
   if (!active_) return;
+  if (tune_hier_) bo_ = BayesianOptimization(3);
   const char* log = getenv("HOROVOD_AUTOTUNE_LOG");
   log_path_ = log ? log : "";
   cycles_per_sample_ =
@@ -210,10 +214,12 @@ void ParameterManager::Initialize(int64_t fusion0, int64_t cycle_us0) {
   current_unit_ = {std::min(1.0, static_cast<double>(fusion0) / kFusionMax),
                    (static_cast<double>(cycle_us0) - kCycleMinUs) /
                        (kCycleMaxUs - kCycleMinUs)};
+  if (tune_hier_) current_unit_.push_back(hier0 ? 1.0 : 0.0);
   if (!log_path_.empty()) {
     FILE* f = fopen(log_path_.c_str(), "w");
     if (f) {
-      fputs("fusion_threshold_bytes,cycle_time_us,score_bytes_per_us\n", f);
+      fputs("fusion_threshold_bytes,cycle_time_us,hierarchical_allreduce,"
+            "score_bytes_per_us\n", f);
       fclose(f);
     }
   }
@@ -223,8 +229,8 @@ void ParameterManager::Log(double score) {
   if (log_path_.empty()) return;
   FILE* f = fopen(log_path_.c_str(), "a");
   if (!f) return;
-  fprintf(f, "%lld,%lld,%.6f\n", static_cast<long long>(fusion_),
-          static_cast<long long>(cycle_us_), score);
+  fprintf(f, "%lld,%lld,%d,%.6f\n", static_cast<long long>(fusion_),
+          static_cast<long long>(cycle_us_), hier_ ? 1 : 0, score);
   fclose(f);
 }
 
@@ -233,11 +239,12 @@ void ParameterManager::SetPoint(const std::vector<double>& unit) {
   fusion_ = static_cast<int64_t>(unit[0] * kFusionMax);
   cycle_us_ = static_cast<int64_t>(kCycleMinUs +
                                    unit[1] * (kCycleMaxUs - kCycleMinUs));
+  if (tune_hier_ && unit.size() > 2) hier_ = unit[2] >= 0.5;
 }
 
 bool ParameterManager::RecordCycle(int64_t bytes, double cycle_secs,
                                    int64_t* fusion_out,
-                                   int64_t* cycle_us_out) {
+                                   int64_t* cycle_us_out, int* hier_out) {
   if (!active_ || converged_) return false;
   bytes_acc_ += bytes;
   secs_acc_ += cycle_secs;
@@ -269,6 +276,7 @@ bool ParameterManager::RecordCycle(int64_t bytes, double cycle_secs,
   }
   *fusion_out = fusion_;
   *cycle_us_out = cycle_us_;
+  *hier_out = tune_hier_ ? (hier_ ? 1 : 0) : -1;
   return true;
 }
 

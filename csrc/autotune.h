@@ -64,24 +64,30 @@ class BayesianOptimization {
   GaussianProcess gp_;
 };
 
-// Tunes {fusion_threshold_bytes, cycle_time_us} online from observed
+// Tunes {fusion_threshold_bytes, cycle_time_us} — plus, on multi-host
+// topologies, the hierarchical-allreduce on/off decision as a categorical
+// third dimension (unit value >= 0.5 maps to on; the reference tunes the
+// same knob, parameter_manager.cc:42-43) — online from observed
 // throughput.  Call RecordCycle once per background-loop cycle with the
 // bytes processed that cycle; when a tuning step fires, returns true and
-// writes the new values.
+// writes the new values (*hier_out is -1 when the knob isn't tuned).
 class ParameterManager {
  public:
-  void Initialize(int64_t fusion0, int64_t cycle_us0);
+  void Initialize(int64_t fusion0, int64_t cycle_us0,
+                  bool tune_hierarchical = false, bool hier0 = false);
   bool active() const { return active_; }
 
   // Returns true when new parameter values should be applied (and synced).
   bool RecordCycle(int64_t bytes, double cycle_secs, int64_t* fusion_out,
-                   int64_t* cycle_us_out);
+                   int64_t* cycle_us_out, int* hier_out);
 
  private:
   void Log(double score);
   void SetPoint(const std::vector<double>& unit);
 
   bool active_ = false;
+  bool tune_hier_ = false;
+  bool hier_ = false;
   BayesianOptimization bo_{2};
   std::vector<double> current_unit_;
   int64_t fusion_ = 64 << 20;

@@ -377,6 +377,7 @@ class Engine {
   int64_t cycle_bytes_ = 0;             // bytes executed this cycle (bg thread)
   int64_t pending_tuned_fusion_ = -1;   // values to ship with next broadcast
   int64_t pending_tuned_cycle_ = -1;
+  int64_t pending_tuned_hier_ = -1;
 };
 
 // ---------------------------------------------------------------------------
@@ -390,7 +391,8 @@ Status Engine::Init(const std::string& host, int port, int rank, int size) {
                                EnvInt64("HOROVOD_FUSION_THRESHOLD", 64 << 20));
   cycle_us_ = 1000 * EnvInt64("HOROVOD_TPU_CYCLE_TIME",
                               EnvInt64("HOROVOD_CYCLE_TIME", 5));
-  if (rank_ == 0) pm_.Initialize(fusion_threshold_, cycle_us_);
+  // pm_.Initialize happens after topology discovery below (the
+  // hierarchical knob is only tunable on multi-host topologies)
   stall_warn_s_ = static_cast<double>(
       EnvInt64("HOROVOD_TPU_STALL_WARNING_SECS", 60));
   stall_check_ = !EnvFlag("HOROVOD_TPU_STALL_CHECK_DISABLE") &&
@@ -543,6 +545,12 @@ Status Engine::Init(const std::string& host, int port, int rank, int size) {
                          << " local group size " << local_group_.size()
                          << ", hierarchical allreduce "
                          << (hierarchical_allreduce_ ? "on" : "off");
+  // the autotuner owns the hierarchical decision when the env didn't pin
+  // it (reference parameter_manager.cc:42-43 categorical param)
+  if (rank_ == 0)
+    pm_.Initialize(fusion_threshold_, cycle_us_,
+                   /*tune_hierarchical=*/dflt && !(ha && ha[0]),
+                   hierarchical_allreduce_);
 
   running_ = true;
   bg_ = std::thread(&Engine::BackgroundLoop, this);
@@ -747,16 +755,24 @@ void Engine::BackgroundLoop() {
         if (rl.tuned_fusion >= 0) to_execute.tuned_fusion = rl.tuned_fusion;
         if (rl.tuned_cycle_us >= 0)
           to_execute.tuned_cycle_us = rl.tuned_cycle_us;
+        if (rl.tuned_hierarchical >= 0)
+          to_execute.tuned_hierarchical = rl.tuned_hierarchical;
       }
     }
 
-    for (const Response& resp : to_execute.responses) Execute(resp);
-    // workers adopt coordinator-tuned knobs from the wire
+    // workers adopt coordinator-tuned knobs from the wire BEFORE executing
+    // the responses that carried them: the coordinator already runs the
+    // new values for these responses, and the hierarchical flag changes
+    // the collective algorithm itself — a one-response skew would make
+    // ranks exchange with incompatible patterns and hang
     if (rank_ != 0) {
       if (to_execute.tuned_fusion >= 0)
         fusion_threshold_ = to_execute.tuned_fusion;
       if (to_execute.tuned_cycle_us > 0) cycle_us_ = to_execute.tuned_cycle_us;
+      if (to_execute.tuned_hierarchical >= 0)
+        hierarchical_allreduce_ = to_execute.tuned_hierarchical != 0;
     }
+    for (const Response& resp : to_execute.responses) Execute(resp);
     if (to_execute.shutdown) {
       FailAll(Status::Shutdown());
       stop = true;
@@ -772,11 +788,16 @@ void Engine::BackgroundLoop() {
                         std::chrono::steady_clock::now() - cycle_start)
                         .count();
       int64_t f, cus;
-      if (pm_.RecordCycle(cycle_bytes_, secs, &f, &cus)) {
+      int hier;
+      if (pm_.RecordCycle(cycle_bytes_, secs, &f, &cus, &hier)) {
         fusion_threshold_ = f;
         cycle_us_ = cus;
         pending_tuned_fusion_ = f;
         pending_tuned_cycle_ = cus;
+        if (hier >= 0) {
+          hierarchical_allreduce_ = hier != 0;
+          pending_tuned_hier_ = hier;
+        }
       }
       cycle_bytes_ = 0;
     }
@@ -817,12 +838,15 @@ void Engine::CoordinatorTick(RequestList& local, ResponseList* out) {
   FuseReady(out);
   if (stall_check_) StallCheck();
   out->shutdown = shutdown;
-  if (pending_tuned_fusion_ >= 0 || pending_tuned_cycle_ >= 0) {
+  if (pending_tuned_fusion_ >= 0 || pending_tuned_cycle_ >= 0 ||
+      pending_tuned_hier_ >= 0) {
     out->tuned_fusion = pending_tuned_fusion_;
     out->tuned_cycle_us = pending_tuned_cycle_;
+    out->tuned_hierarchical = pending_tuned_hier_;
   }
   if (!out->responses.empty() || out->shutdown ||
-      out->tuned_fusion >= 0 || out->tuned_cycle_us >= 0) {
+      out->tuned_fusion >= 0 || out->tuned_cycle_us >= 0 ||
+      out->tuned_hierarchical >= 0) {
     std::string frame = Serialize(*out);
     bool sent = true;
     for (int i = 1; i < size_; i++) {
@@ -836,6 +860,7 @@ void Engine::CoordinatorTick(RequestList& local, ResponseList* out) {
     if (sent) {
       pending_tuned_fusion_ = -1;
       pending_tuned_cycle_ = -1;
+      pending_tuned_hier_ = -1;
     }
   }
 }

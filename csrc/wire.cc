@@ -107,6 +107,7 @@ std::string Serialize(const ResponseList& l) {
   PutI32(&s, l.shutdown ? 1 : 0);
   PutI64(&s, l.tuned_fusion);
   PutI64(&s, l.tuned_cycle_us);
+  PutI64(&s, l.tuned_hierarchical);
   PutI64(&s, static_cast<int64_t>(l.responses.size()));
   for (const Response& r : l.responses) {
     PutI32(&s, static_cast<int32_t>(r.op));
@@ -124,6 +125,7 @@ Status Parse(const std::string& buf, ResponseList* out) {
   out->shutdown = rd.I32() != 0;
   out->tuned_fusion = rd.I64();
   out->tuned_cycle_us = rd.I64();
+  out->tuned_hierarchical = rd.I64();
   int64_t n = rd.I64();
   if (n < 0 || n > (1 << 24)) return Status::Error("bad response count");
   out->responses.clear();

@@ -45,6 +45,7 @@ struct ResponseList {
   // (horovod/common/parameter_manager.cc:213-246).
   int64_t tuned_fusion = -1;
   int64_t tuned_cycle_us = -1;
+  int64_t tuned_hierarchical = -1;  // 0/1 when the autotuner owns the knob
 };
 
 // Serialization (little-endian host assumed; single-arch clusters).

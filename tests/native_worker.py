@@ -257,6 +257,30 @@ def scenario_mixed_fusion():
     print(f"rank {r}: mixed fusion OK", flush=True)
 
 
+def scenario_autotune_hier():
+    """Sustained traffic on a simulated 2x2-host topology with autotune on
+    and no hierarchical env pin: the tuner flips the algorithm mid-stream;
+    results must stay correct through every switch."""
+    r = int(os.environ["HOROVOD_TPU_RANK"])
+    os.environ["HOROVOD_TPU_HOST_HASH"] = f"simhost{r // 2}"
+    os.environ.pop("HOROVOD_TPU_HIERARCHICAL_ALLREDUCE", None)
+    os.environ.pop("HOROVOD_HIERARCHICAL_ALLREDUCE", None)
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    ranks_sum = n * (n - 1) / 2
+    for step in range(80):
+        handles = [
+            hvd.allreduce_async(np.full(256, float(r + i), np.float32),
+                                average=False, name=f"s{step}.g{i}")
+            for i in range(4)
+        ]
+        for i, h in enumerate(handles):
+            got = hvd.synchronize(h)
+            assert np.allclose(got, n * i + ranks_sum), (r, step, i)
+    hvd.shutdown()
+    print(f"rank {r}: autotune hier OK", flush=True)
+
+
 def scenario_skewed_shutdown():
     """Rank 0 lags its shutdown by seconds (checkpointing, logging...) while
     the peers shut down and exit immediately.  Regression: the engine's

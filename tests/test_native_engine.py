@@ -163,12 +163,39 @@ def test_autotune(tmp_path):
     })
     assert res.returncode == 0, res.stderr + res.stdout
     lines = log.read_text().strip().splitlines()
-    assert lines[0] == "fusion_threshold_bytes,cycle_time_us,score_bytes_per_us"
+    assert lines[0] == ("fusion_threshold_bytes,cycle_time_us,"
+                        "hierarchical_allreduce,score_bytes_per_us")
     rows = [l.split(",") for l in lines[1:]]
     assert len(rows) >= 3, lines
     # scores are positive and the knobs actually moved across steps
-    assert all(float(s) > 0 for _, _, s in rows)
-    assert len({f for f, _, _ in rows}) > 1 or len({c for _, c, _ in rows}) > 1
+    assert all(float(s) > 0 for _, _, _, s in rows)
+    assert (len({f for f, _, _, _ in rows}) > 1
+            or len({c for _, c, _, _ in rows}) > 1)
+    # single host: the hierarchical knob stays un-tuned (off)
+    assert {h for _, _, h, _ in rows} == {"0"}
+
+
+def test_autotune_tunes_hierarchical(tmp_path):
+    """On a (simulated) multi-host topology with no env pin, the
+    hierarchical-allreduce decision belongs to the autotuner: the CSV
+    must show it exploring both settings without wedging the world."""
+    log = tmp_path / "autotune.csv"
+    res = _run("autotune_hier", 4, timeout=180, env={
+        "HOROVOD_AUTOTUNE": "1",
+        "HOROVOD_AUTOTUNE_LOG": str(log),
+        "HOROVOD_TPU_AUTOTUNE_CYCLES_PER_SAMPLE": "2",
+        "HOROVOD_TPU_AUTOTUNE_SAMPLES_PER_STEP": "2",
+        "HOROVOD_TPU_AUTOTUNE_WARMUP_SAMPLES": "1",
+        "HOROVOD_TPU_CYCLE_TIME": "1",
+    })
+    assert res.returncode == 0, res.stderr + res.stdout
+    for r in range(4):
+        assert f"rank {r}: autotune hier OK" in res.stdout
+    rows = [l.split(",") for l in log.read_text().strip().splitlines()[1:]]
+    assert len(rows) >= 3, rows
+    assert {h for _, _, h, _ in rows} <= {"0", "1"}
+    # the explorer visited both algorithms across the run
+    assert len({h for _, _, h, _ in rows}) == 2, rows
 
 
 def test_worker_crash_kills_world():
