@@ -243,6 +243,22 @@ class Engine {
   int rank() const { return rank_; }
   int size() const { return size_; }
 
+  // two-level topology derived from the bootstrap host table — the
+  // engine-truth local/cross placement (reference: MPI_Comm_split_type
+  // derived ranks, operations.cc:1760-1797)
+  void Topo(int* local_rank, int* local_size, int* cross_rank,
+            int* cross_size) const {
+    *local_rank = static_cast<int>(
+        std::find(local_group_.begin(), local_group_.end(), rank_) -
+        local_group_.begin());
+    *local_size = static_cast<int>(local_group_.size());
+    *cross_size = static_cast<int>(host_groups_.size());
+    *cross_rank = 0;
+    for (size_t g = 0; g < host_groups_.size(); g++)
+      if (host_groups_[g].front() == local_group_.front())
+        *cross_rank = static_cast<int>(g);
+  }
+
  private:
   void BackgroundLoop();
   void CoordinatorTick(RequestList& local, ResponseList* out);
@@ -1543,6 +1559,16 @@ const char* hvd_error_str(int handle) {
 }
 
 void hvd_free_cstr(const char* p) { free(const_cast<char*>(p)); }
+
+void hvd_topology(int* local_rank, int* local_size, int* cross_rank,
+                  int* cross_size) {
+  if (!g_engine) {
+    *local_rank = *cross_rank = 0;
+    *local_size = *cross_size = 1;
+    return;
+  }
+  g_engine->Topo(local_rank, local_size, cross_rank, cross_size);
+}
 
 void hvd_release(int handle) {
   if (g_engine) g_engine->ReleaseHandle(handle);

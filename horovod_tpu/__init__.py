@@ -81,9 +81,10 @@ def allreduce(tensor, average: bool = True, name: str | None = None,
     arr = _as_numpy(tensor)
     comp, ctx = compression.compress(arr)
     if compression is Compression.int8:
-        # Per-rank int8 scales cannot be summed; model the quantization
-        # error locally and reduce in the original dtype.  (The native
-        # engine applies true shared-scale wire quantization internally.)
+        # Per-rank int8 scales cannot be summed, so the eager path models
+        # the quantization error locally and reduces in the original
+        # dtype; true shared-scale wire quantization would need a scale
+        # agreement round in the engine (not implemented).
         comp, ctx = compression.decompress(comp, ctx), None
     direct = out if compression is Compression.none else None
     res = _state.engine().allreduce(comp, _auto_name("allreduce", name),

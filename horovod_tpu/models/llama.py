@@ -228,9 +228,10 @@ def apply(params, tokens, config: LlamaConfig, positions=None,
 
 
 def loss_fn(params, tokens, config: LlamaConfig, positions=None,
-            attn_fn="auto"):
+            attn_fn="auto", remat: bool = True):
     """Next-token cross-entropy (shift-by-one inside)."""
-    logits = apply(params, tokens, config, positions=positions, attn_fn=attn_fn)
+    logits = apply(params, tokens, config, positions=positions,
+                   attn_fn=attn_fn, remat=remat)
     logp = jax.nn.log_softmax(logits[:, :-1])
     targets = tokens[:, 1:]
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)

@@ -136,6 +136,8 @@ def _bind(lib):
     lib.hvd_free_cstr.restype = None
     lib.hvd_release.argtypes = [ctypes.c_int]
     lib.hvd_release.restype = None
+    lib.hvd_topology.argtypes = [ctypes.POINTER(ctypes.c_int)] * 4
+    lib.hvd_topology.restype = None
     return lib
 
 
@@ -164,11 +166,6 @@ class NativeEngine(Engine):
 
     def __init__(self, topology, comm_ranks=None) -> None:
         super().__init__()
-        if comm_ranks is not None:
-            raise NotImplementedError(
-                "sub-communicators on the native engine are not implemented "
-                "yet; run the sub-world as its own launch instead"
-            )
         self._topology = topology
         self._dtype_by_handle: dict[int, np.dtype] = {}
         # result arrays the engine writes directly (allreduce/broadcast):
@@ -177,6 +174,17 @@ class NativeEngine(Engine):
         self._lock = threading.Lock()
         lib = _load_lib()
         host, port = rendezvous_addr()
+        if comm_ranks is not None:
+            # Sub-communicator (reference init(comm=[ranks...])): the
+            # re-ranked sub-world forms its own TCP star on a port offset
+            # by 1 + min(member ranks) — disjoint sub-worlds contain their
+            # own minima, so offsets can never collide, and the offset is
+            # bounded by world size.  The rendezvous host stays the
+            # launch's (fine on one host); multi-host sub-worlds must
+            # point HOROVOD_TPU_RENDEZVOUS at the sub-world's new rank 0.
+            port = port + 1 + min(int(r) for r in comm_ranks)
+            if port > 65535:
+                port = 1024 + port % 64000
         rc = lib.hvd_native_init(host.encode(), port, topology.rank,
                                  topology.size)
         if rc != 0:
@@ -185,6 +193,14 @@ class NativeEngine(Engine):
                 f"{topology.size}, rendezvous {host}:{port})"
             )
         self._lib = lib
+
+    def local_topology(self) -> tuple[int, int, int, int]:
+        """(local_rank, local_size, cross_rank, cross_size) from the
+        engine's bootstrap host table — the source of truth for sub-worlds
+        whose placement the launcher env can't describe."""
+        vals = [ctypes.c_int() for _ in range(4)]
+        self._lib.hvd_topology(*[ctypes.byref(v) for v in vals])
+        return tuple(v.value for v in vals)
 
     # -- async ops ---------------------------------------------------------
     def _enqueue(self, op: int, array, name: str, root_rank: int = -1,

@@ -49,7 +49,10 @@ def init(comm=None) -> None:
         if comm is not None:
             ranks = sorted(int(r) for r in comm)
             if topology.rank in ranks:
-                # re-rank inside the sub-world
+                # re-rank inside the sub-world; local/cross placement is
+                # provisional here and corrected below from the engine's
+                # bootstrap host table (the launcher env describes the
+                # full world, not this subset)
                 topology = Topology(
                     rank=ranks.index(topology.rank),
                     size=len(ranks),
@@ -78,6 +81,16 @@ def init(comm=None) -> None:
             engine = None
         else:
             engine = create_engine(topology, comm_ranks=comm)
+        if comm is not None and engine is not None and hasattr(
+                engine, "local_topology"):
+            lr, ls, cr, cs = engine.local_topology()
+            topology = Topology(
+                rank=topology.rank, size=topology.size,
+                local_rank=lr, local_size=ls,
+                cross_rank=cr, cross_size=cs,
+                num_local_devices=topology.num_local_devices,
+                platform=topology.platform,
+            )
         _state.topology = topology
         _state.engine = engine
         _state.initialized = True

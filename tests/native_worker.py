@@ -257,6 +257,37 @@ def scenario_mixed_fusion():
     print(f"rank {r}: mixed fusion OK", flush=True)
 
 
+def scenario_subworld():
+    """init(comm=[0, 2]) in a 4-proc launch: members form a re-ranked
+    2-world (reference init(comm=...) semantics); outsiders see size 0 and
+    an engine error on use."""
+    hvd.init(comm=[0, 2])
+    gr = int(os.environ["HOROVOD_TPU_RANK"])
+    if gr in (0, 2):
+        assert hvd.size() == 2, hvd.size()
+        assert hvd.rank() == (0 if gr == 0 else 1), (gr, hvd.rank())
+        # local placement from the engine's host table, not the launcher
+        # env (one host here: local == sub-world)
+        assert hvd.local_size() == 2 and hvd.local_rank() == hvd.rank(), (
+            hvd.local_rank(), hvd.local_size())
+        assert hvd.cross_size() == 1 and hvd.cross_rank() == 0
+        out = hvd.allreduce(np.full(5, float(gr), np.float32), average=False,
+                            name="sub")
+        assert np.allclose(out, 2.0), (gr, out)  # 0 + 2
+        got = hvd.broadcast(np.arange(3, dtype=np.float32) * (gr + 1),
+                            root_rank=1, name="subb")
+        assert np.allclose(got, np.arange(3) * 3), (gr, got)  # root = gr 2
+    else:
+        assert hvd.size() == 0 and hvd.rank() == -1
+        try:
+            hvd.allreduce(np.ones(2, np.float32))
+            raise SystemExit("expected RuntimeError outside sub-communicator")
+        except RuntimeError:
+            pass
+    hvd.shutdown()
+    print(f"rank {gr}: subworld OK", flush=True)
+
+
 def scenario_autotune_hier():
     """Sustained traffic on a simulated 2x2-host topology with autotune on
     and no hierarchical env pin: the tuner flips the algorithm mid-stream;

@@ -89,6 +89,15 @@ def test_mixed_dtype_fusion_lookahead(tmp_path):
     assert any(n.endswith(("mix1", "mix3", "mix5")) for n in fused), fused
 
 
+def test_subworld_communicator():
+    """init(comm=[0,2]) forms a re-ranked native sub-world while outsiders
+    get the size-0 state (reference init(comm=...) contract)."""
+    res = _run("subworld", 4, timeout=120)
+    assert res.returncode == 0, res.stderr + res.stdout
+    for r in range(4):
+        assert f"rank {r}: subworld OK" in res.stdout
+
+
 def test_log_level_env():
     """Leveled C++ logging: the topology debug line appears only when the
     env raises verbosity (reference logging.h:7-57 behavior)."""
