@@ -77,7 +77,9 @@ def main() -> None:
     # from rank 0's init (reference tensorflow_synthetic_benchmark.py:66-70)
     benchmark_step(True)
     hvd.broadcast_variables(model.variables, root_rank=0)
-    hvd.broadcast_variables(opt.variables(), root_rank=0)
+    # keras 3 exposes optimizer variables as a property, keras 2 as a method
+    opt_vars = opt.variables() if callable(opt.variables) else opt.variables
+    hvd.broadcast_variables(opt_vars, root_rank=0)
 
     for _ in range(args.num_warmup_batches):
         benchmark_step(False)

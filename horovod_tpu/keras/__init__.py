@@ -16,6 +16,13 @@ from typing import Any, Callable, Sequence
 
 import numpy as np
 
+# basics re-exported like every frontend namespace (reference
+# horovod/keras/__init__.py re-exports the HorovodBasics surface)
+from horovod_tpu import (  # noqa: F401
+    init, shutdown, is_initialized, rank, size, local_rank, local_size,
+    cross_rank, cross_size, mpi_threads_supported,
+    allreduce, allgather, broadcast,
+)
 from horovod_tpu.keras import callbacks as callbacks_lib
 from horovod_tpu.keras.callbacks import (
     BroadcastGlobalVariablesCallback,
@@ -306,4 +313,7 @@ __all__ = [
     "Callback", "BroadcastGlobalVariablesCallback", "MetricAverageCallback",
     "LearningRateScheduleCallback", "LearningRateWarmupCallback",
     "callbacks_lib",
+    "init", "shutdown", "is_initialized", "rank", "size", "local_rank",
+    "local_size", "cross_rank", "cross_size", "mpi_threads_supported",
+    "allreduce", "allgather", "broadcast",
 ]

@@ -111,6 +111,22 @@ def _fa_kernel(qs_ref, ks_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         lse_ref[0, 0] = jnp.broadcast_to(lse, lse_ref.shape[2:])
 
 
+
+def _fit_block(requested: int, dim: int) -> int:
+    """Largest block <= requested that divides dim (dims are multiples of
+    128 in practice, so this lands on a lane-aligned size).  Shapes that
+    would force a sub-128 block are rejected: a silently tiny block is an
+    order-of-magnitude perf cliff, not a convenience."""
+    b = max(1, min(requested, dim))
+    while dim % b:
+        b //= 2
+    if b < min(requested, 128, dim):
+        raise ValueError(
+            f"sequence length {dim} only tiles into {b}-wide blocks "
+            f"(requested {requested}); pad the sequence to a multiple of "
+            "128 or pass an explicitly dividing block size")
+    return b
+
 def _flash_fwd_pallas(q, k, v, q_start, k_start, causal, block_q, block_k,
                       interpret):
     """Returns (out [B,T,Hq,Dh] in q.dtype, lse [B,Hq,T] fp32)."""
@@ -120,10 +136,8 @@ def _flash_fwd_pallas(q, k, v, q_start, k_start, causal, block_q, block_k,
     B, T, Hq, Dh = q.shape
     S, Hkv = k.shape[1], k.shape[2]
     G = Hq // Hkv
-    bq = min(block_q, T)
-    bk = min(block_k, S)
-    if T % bq or S % bk:
-        raise ValueError(f"seq lens ({T},{S}) not divisible by blocks ({bq},{bk})")
+    bq = _fit_block(block_q, T)
+    bk = _fit_block(block_k, S)
     scale = float(1.0 / (Dh ** 0.5))
 
     qt = jnp.moveaxis(q, 2, 1)                            # [B, Hq, T, Dh]
@@ -278,8 +292,8 @@ def _flash_bwd_pallas(q, k, v, out, lse, do, dlse, q_start, k_start, causal,
     B, T, Hq, Dh = q.shape
     S, Hkv = k.shape[1], k.shape[2]
     G = Hq // Hkv
-    bq = min(block_q, T)
-    bk = min(block_k, S)
+    bq = _fit_block(block_q, T)
+    bk = _fit_block(block_k, S)
     scale = float(1.0 / (Dh ** 0.5))
 
     qt = jnp.moveaxis(q, 2, 1)                            # [B, Hq, T, Dh]
@@ -359,7 +373,7 @@ def _flash_bwd_pallas(q, k, v, out, lse, do, dlse, q_start, k_start, causal,
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
 def flash_attention_block(q, k, v, q_start=0, k_start=0, causal=True,
-                          block_q=128, block_k=128, interpret=False):
+                          block_q=512, block_k=1024, interpret=False):
     """Flash attention returning ``(out, lse)``.
 
     ``q``: [B, T, Hq, Dh]; ``k``/``v``: [B, S, Hkv, Dh] (GQA when
@@ -398,7 +412,7 @@ flash_attention_block.defvjp(_block_fwd, _block_bwd)
 
 
 def flash_attention(q, k, v, q_start=0, k_start=0, causal=True,
-                    block_q=128, block_k=128, interpret=False):
+                    block_q=512, block_k=1024, interpret=False):
     """Flash attention returning just the output [B, T, Hq, Dh]
     (:func:`flash_attention_block` without the log-sum-exp)."""
     out, _ = flash_attention_block(q, k, v, q_start, k_start, causal,
@@ -422,8 +436,8 @@ def merge_attention_blocks(o_a, lse_a, o_b, lse_b):
     return jnp.moveaxis(o, 1, 2).astype(o_a.dtype), lse_new
 
 
-def flash_attn_fn(causal: bool = True, block_q: int = 128,
-                  block_k: int = 128, interpret: bool = False):
+def flash_attn_fn(causal: bool = True, block_q: int = 512,
+                  block_k: int = 1024, interpret: bool = False):
     """Adapter producing the ``attn_fn(q, k, v, positions)`` callback used by
     :func:`horovod_tpu.models.llama.apply`.  ``positions`` must be a
     contiguous range (the model's default); its first element is the global

@@ -33,7 +33,7 @@ from horovod_tpu.parallel.ring_attention import _varying
 
 def ring_flash_attention(q, k, v, axis_name: str, q_positions,
                          kv_positions=None, causal: bool = True,
-                         block_q: int = 128, block_k: int = 128,
+                         block_q: int = 512, block_k: int = 512,
                          interpret: bool = False, remat: bool = True):
     """q: [B, T_local, Hq, Dh]; k/v: [B, S_local, Hkv, Dh]; positions are
     global token indices of the local block (must be contiguous).  Returns
@@ -69,8 +69,8 @@ def ring_flash_attention(q, k, v, axis_name: str, q_positions,
     return o.astype(q.dtype)
 
 
-def make_ring_flash_attn_fn(axis_name: str, block_q: int = 128,
-                            block_k: int = 128, interpret: bool = False):
+def make_ring_flash_attn_fn(axis_name: str, block_q: int = 512,
+                            block_k: int = 512, interpret: bool = False):
     """Adapter producing the ``attn_fn(q, k, v, positions)`` callback used by
     :func:`horovod_tpu.models.llama.apply` (inside a shard_map region)."""
 

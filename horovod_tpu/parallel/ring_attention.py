@@ -210,16 +210,16 @@ def allgather_kv_attention(q, k, v, axis_name: str, q_positions,
 
 
 def make_ring_attn_fn(axis_name: str, mode: str = "ring",
-                      block_q: int = 128, block_k: int = 128):
+                      block_q: int = 512, block_k: int = 512):
     """Adapter producing the ``attn_fn(q, k, v, positions)`` signature used
     by :func:`horovod_tpu.models.llama.apply`.
 
     ``mode="ring_pallas"`` routes each hop's block compute through the
     Pallas flash-attention kernel (Mosaic on TPU; add ``_interp`` suffix —
     ``"ring_pallas_interp"`` — for the interpreter on CPU tests).
-    ``block_q``/``block_k`` size the kernel blocks (clamped to the local
-    sequence length, which must be divisible by them) and are ignored by the
-    pure-jnp modes.
+    ``block_q``/``block_k`` size the kernel blocks (auto-fitted down to the
+    largest divisor of the local sequence length, which must tile into
+    >=128-wide blocks) and are ignored by the pure-jnp modes.
     """
     if mode.startswith("ring_pallas"):
         from horovod_tpu.ops.pallas.ring_flash import make_ring_flash_attn_fn
@@ -240,8 +240,8 @@ def make_ring_attn_fn(axis_name: str, mode: str = "ring",
 
 
 def sequence_parallel_attn_fn(mesh=None, axis_name: str = "sp",
-                              mode: str = "ring", block_q: int = 128,
-                              block_k: int = 128):
+                              mode: str = "ring", block_q: int = 512,
+                              block_k: int = 512):
     """Attention callback for ``llama.apply`` that runs **inside a normal
     GSPMD ``jit``**: only ``axis_name`` goes manual (shard_map with
     ``axis_names={axis_name}``); every other mesh axis (fsdp/tp/dp) stays

@@ -12,6 +12,8 @@ materialized on first attribute access.
 
 from __future__ import annotations
 
+import itertools
+
 from horovod_tpu.compression import Compression
 from horovod_tpu.runtime.state import (  # noqa: F401  (re-exported basics)
     init,
@@ -73,6 +75,11 @@ class DistributedGradientTape:
     """Eager-mode tape wrapper: ``gradient()`` allreduces every gradient
     (reference ``tensorflow/__init__.py:252-326``)."""
 
+    # advanced once per gradient() call (not per construction: a tape built
+    # on a subset of ranks, e.g. a rank-0 debug probe, must not desync the
+    # collective names of every later step)
+    _calls = itertools.count()
+
     def __init__(self, tape, compression=Compression.none,
                  device_dense: str = "", device_sparse: str = ""):
         self._tape = tape
@@ -91,6 +98,27 @@ class DistributedGradientTape:
     def gradient(self, target, sources, output_gradients=None):
         tf = _tf()
         grads = self._tape.gradient(target, sources, output_gradients)
+        flat = tf.nest.flatten(grads)
+        dense_eager = tf.executing_eagerly() and not any(
+            isinstance(g, tf.IndexedSlices) for g in flat if g is not None)
+        if dense_eager and self._compression is Compression.none:
+            # fused eager path: issue every allreduce before waiting so the
+            # engine overlaps and fuses them (the per-tensor op below would
+            # run one synchronous collective at a time)
+            import horovod_tpu as hvd
+
+            call = next(self._calls)
+            handles = [
+                None if g is None else hvd.allreduce_async(
+                    g.numpy(), average=True, name=f"tape.{call}.{i}")
+                for i, g in enumerate(flat)
+            ]
+            out = [
+                None if h is None else tf.convert_to_tensor(
+                    hvd.synchronize(h))
+                for h in handles
+            ]
+            return tf.nest.pack_sequence_as(grads, out)
         # mirror the sources structure (single tensor, list, nested dict)
         # exactly as tf.GradientTape does — reference uses nest.map_structure
         return tf.nest.map_structure(
