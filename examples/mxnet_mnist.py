@@ -1,0 +1,160 @@
+"""MXNet MNIST with horovod_tpu's MXNet frontend.
+
+TPU-native counterpart of ``/root/reference/examples/mxnet_mnist.py``:
+``DistributedOptimizer`` wrapping the Gluon trainer's update,
+``broadcast_parameters`` for start-up consistency, per-rank data
+sharding, lr scaled by world size.  MXNet is optional in this image: with
+it installed a Gluon MLP trains; without it the same frontend collectives
+(``broadcast_parameters`` + in-place ``allreduce_`` on every gradient,
+which is exactly what ``DistributedOptimizer.update`` does internally)
+drive a numpy softmax model, so the distributed plumbing runs end to end.
+
+Run:
+  python examples/mxnet_mnist.py
+  python -m horovod_tpu.run -np 2 python examples/mxnet_mnist.py
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+import horovod_tpu.mxnet as hvd
+
+
+class _NDArray:
+    """mx.nd.NDArray-shaped stand-in over numpy (used when MXNet is
+    absent; mirrors examples/mxnet_imagenet_resnet50.py)."""
+
+    def __init__(self, arr):
+        self._a = np.asarray(arr, np.float32)
+
+    def asnumpy(self):
+        return self._a
+
+    @property
+    def shape(self):
+        return self._a.shape
+
+    @property
+    def dtype(self):
+        return self._a.dtype
+
+    def __setitem__(self, key, value):
+        self._a[key] = value.asnumpy() if isinstance(value, _NDArray) \
+            else value
+
+    def __getitem__(self, key):
+        return self._a[key]
+
+
+def synthetic_mnist(n: int, seed: int):
+    rng = np.random.RandomState(seed)
+    labels = rng.randint(0, 10, n)
+    images = rng.rand(n, 784).astype(np.float32) * 0.1
+    for i, k in enumerate(labels):
+        images[i, (int(k) * 71) % 780:(int(k) * 71) % 780 + 4] += 1.0
+    return images, labels
+
+
+def softmax_xent_grad(w, b, x, y):
+    logits = x @ w + b
+    logits -= logits.max(1, keepdims=True)
+    p = np.exp(logits)
+    p /= p.sum(1, keepdims=True)
+    loss = -np.mean(np.log(p[np.arange(len(y)), y] + 1e-9))
+    g = (p - np.eye(10)[y]) / len(y)
+    return loss, x.T @ g, g.sum(0)
+
+
+def run_without_mxnet(args) -> None:
+    hvd.init()
+    rank, size = hvd.rank(), hvd.size()
+    xs, ys = synthetic_mnist(args.train_size, seed=11)
+    xs, ys = xs[rank::size], ys[rank::size]
+
+    prng = np.random.RandomState(100 + rank)  # divergent; broadcast fixes
+    params = {"w": _NDArray(prng.randn(784, 10) * 0.01),
+              "b": _NDArray(np.zeros(10))}
+    hvd.broadcast_parameters(params, root_rank=0)
+
+    lr = 0.1 * size
+    first = last = None
+    for epoch in range(args.epochs):
+        for lo in range(0, len(xs) - args.batch_size + 1, args.batch_size):
+            xb, yb = xs[lo:lo + args.batch_size], ys[lo:lo + args.batch_size]
+            loss, gw, gb = softmax_xent_grad(
+                params["w"].asnumpy(), params["b"].asnumpy(), xb, yb)
+            # what DistributedOptimizer.update does per parameter index
+            gw, gb = _NDArray(gw), _NDArray(gb)
+            hvd.allreduce_(gw, average=True, name="0")
+            hvd.allreduce_(gb, average=True, name="1")
+            params["w"].asnumpy()[...] -= lr * gw.asnumpy()
+            params["b"].asnumpy()[...] -= lr * gb.asnumpy()
+            last = loss
+            if first is None:
+                first = loss
+        if rank == 0:
+            print(f"epoch {epoch}: loss {last:.4f}", flush=True)
+
+    if rank == 0:
+        assert last < first, (first, last)
+        print(f"DONE loss {first:.4f} -> {last:.4f}", flush=True)
+    hvd.shutdown()
+
+
+def run_with_mxnet(args) -> None:
+    import mxnet as mx
+    from mxnet import autograd, gluon
+
+    hvd.init()
+    xs, ys = synthetic_mnist(args.train_size, seed=11)
+    xs, ys = xs[hvd.rank()::hvd.size()], ys[hvd.rank()::hvd.size()]
+
+    net = gluon.nn.Sequential()
+    net.add(gluon.nn.Dense(128, activation="relu"), gluon.nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+
+    opt = mx.optimizer.create("sgd", learning_rate=0.1 * hvd.size())
+    opt = hvd.DistributedOptimizer(opt)
+    params = net.collect_params()
+    hvd.broadcast_parameters(params, root_rank=0)
+    trainer = gluon.Trainer(params, opt, kvstore=None)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    first = last = None
+    for epoch in range(args.epochs):
+        for lo in range(0, len(xs) - args.batch_size + 1, args.batch_size):
+            data = mx.nd.array(xs[lo:lo + args.batch_size])
+            label = mx.nd.array(ys[lo:lo + args.batch_size])
+            with autograd.record():
+                loss = loss_fn(net(data), label)
+            loss.backward()
+            trainer.step(args.batch_size)
+            last = float(loss.mean().asnumpy())
+            if first is None:
+                first = last
+        if hvd.rank() == 0:
+            print(f"epoch {epoch}: loss {last:.4f}", flush=True)
+    if hvd.rank() == 0:
+        print(f"DONE loss {first:.4f} -> {last:.4f}", flush=True)
+    hvd.shutdown()
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--train-size", type=int, default=512)
+    args = ap.parse_args()
+    try:
+        import mxnet  # noqa: F401
+        has_mxnet = True
+    except ImportError:
+        has_mxnet = False
+    (run_with_mxnet if has_mxnet else run_without_mxnet)(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,160 @@
+"""PyTorch ImageNet ResNet-50 training with horovod_tpu.
+
+TPU-native counterpart of
+``/root/reference/examples/pytorch_imagenet_resnet50.py``: gradient
+accumulation via ``batches_per_allreduce``, lr scaled by the effective
+world batch, epoch-wise lr warmup + step decay, rank-0 checkpointing with
+**resume-epoch broadcast** (the reference broadcasts the resume epoch as a
+tensor, ``pytorch_imagenet_resnet50.py:79-81``), and allreduce-averaged
+validation metrics.  Data is synthetic unless torchvision + a dataset dir
+are available — the example demonstrates the distributed training loop,
+not the input pipeline.
+
+Run:
+  python examples/pytorch_imagenet_resnet50.py --epochs 2 --train-size 256
+  python -m horovod_tpu.run -np 2 python \
+      examples/pytorch_imagenet_resnet50.py --epochs 2 --train-size 256
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+import numpy as np
+import torch
+import torch.nn as nn
+import torch.nn.functional as F
+import torch.optim as optim
+
+import horovod_tpu.torch as hvd
+
+
+def build_model():
+    try:
+        from torchvision import models
+
+        return models.resnet50()
+    except ImportError:
+        return nn.Sequential(
+            nn.Conv2d(3, 16, 7, stride=4), nn.ReLU(),
+            nn.AdaptiveAvgPool2d((3, 3)), nn.Flatten(),
+            nn.Linear(16 * 3 * 3, 1000),
+        )
+
+
+def synthetic_batches(n, batch, size_px, seed):
+    rng = np.random.RandomState(seed)
+    labels = rng.randint(0, 1000, n)
+    images = rng.rand(n, 3, size_px, size_px).astype(np.float32) * 0.1
+    # class signal so losses actually move
+    images[np.arange(n), labels % 3, 0, 0] += 1.0
+    xs = torch.from_numpy(images)
+    ys = torch.from_numpy(labels.astype(np.int64))
+    return [(xs[i:i + batch], ys[i:i + batch])
+            for i in range(0, n - batch + 1, batch)]
+
+
+def adjust_lr(optimizer, epoch, base_lr, warmup_epochs=5):
+    """Reference lr schedule: linear warmup to base_lr * size, then /10
+    steps at 30/60/80 (pytorch_imagenet_resnet50.py:110-130)."""
+    if epoch < warmup_epochs:
+        lr = base_lr * (epoch * (hvd.size() - 1) / warmup_epochs + 1)
+    else:
+        decay = 10 ** -sum(epoch >= e for e in (30, 60, 80))
+        lr = base_lr * hvd.size() * decay
+    for group in optimizer.param_groups:
+        group["lr"] = lr
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--batches-per-allreduce", type=int, default=1,
+                    help="gradient accumulation factor")
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--base-lr", type=float, default=0.0125)
+    ap.add_argument("--train-size", type=int, default=256)
+    ap.add_argument("--image-size", type=int, default=32)
+    ap.add_argument("--checkpoint-format",
+                    default="checkpoint-{epoch}.pt")
+    ap.add_argument("--cleanup-checkpoints", action="store_true",
+                    help="delete checkpoints after a successful run")
+    ap.add_argument("--seed", type=int, default=42)
+    args = ap.parse_args()
+
+    hvd.init()
+    torch.manual_seed(args.seed)
+
+    model = build_model()
+    optimizer = optim.SGD(model.parameters(), lr=args.base_lr,
+                          momentum=0.9, weight_decay=5e-5)
+    optimizer = hvd.DistributedOptimizer(
+        optimizer, named_parameters=model.named_parameters(),
+        backward_passes_per_step=args.batches_per_allreduce)
+
+    # resume from the latest rank-0 checkpoint; every rank must agree on
+    # the epoch, so it is broadcast as a tensor like the reference
+    resume_epoch = 0
+    if hvd.rank() == 0:
+        for ep in range(args.epochs, 0, -1):
+            path = args.checkpoint_format.format(epoch=ep)
+            if os.path.exists(path):
+                ckpt = torch.load(path, weights_only=True)
+                model.load_state_dict(ckpt["model"])
+                resume_epoch = ep
+                break
+    resume_epoch = int(hvd.broadcast(
+        torch.tensor(resume_epoch), root_rank=0, name="resume_epoch"))
+
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    hvd.broadcast_optimizer_state(optimizer, root_rank=0)
+
+    batches = synthetic_batches(args.train_size, args.batch_size,
+                                args.image_size, args.seed)
+    my_batches = batches[hvd.rank()::hvd.size()]
+
+    # keep accumulation windows whole: a trailing partial window would
+    # leave the optimizer's backward-pass counter dangling into the next
+    # epoch (and its gradient never applied)
+    usable = len(my_batches) - len(my_batches) % args.batches_per_allreduce
+    my_batches = my_batches[:usable]
+
+    first = last = None
+    for epoch in range(resume_epoch, args.epochs):
+        model.train()
+        adjust_lr(optimizer, epoch, args.base_lr)
+        for i, (xs, ys) in enumerate(my_batches):
+            if i % args.batches_per_allreduce == 0:
+                optimizer.zero_grad()
+            loss = F.cross_entropy(model(xs), ys)
+            loss.backward()
+            if (i + 1) % args.batches_per_allreduce == 0:
+                optimizer.step()
+            last = float(loss.detach())
+            if first is None:
+                first = last
+        # allreduce-averaged "validation" metric (here: train loss)
+        val = float(hvd.allreduce(torch.tensor(last), average=True,
+                                  name=f"val.{epoch}"))
+        if hvd.rank() == 0:
+            print(f"epoch {epoch}: val-loss {val:.4f}", flush=True)
+            torch.save({"model": model.state_dict()},
+                       args.checkpoint_format.format(epoch=epoch + 1))
+
+    if hvd.rank() == 0:
+        if args.cleanup_checkpoints:
+            for ep in range(args.epochs + 1):
+                path = args.checkpoint_format.format(epoch=ep)
+                if os.path.exists(path):
+                    os.unlink(path)
+        if first is None:
+            print(f"DONE (resumed at epoch {resume_epoch}, nothing left "
+                  "to train)", flush=True)
+        else:
+            print(f"DONE loss {first:.4f} -> {last:.4f}", flush=True)
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

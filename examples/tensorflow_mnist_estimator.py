@@ -1,0 +1,95 @@
+"""TensorFlow Estimator MNIST with horovod_tpu.
+
+TPU-native counterpart of
+``/root/reference/examples/tensorflow_mnist_estimator.py``: an
+``tf.estimator.Estimator`` whose ``model_fn`` wraps the optimizer with
+``DistributedOptimizer``, with ``BroadcastGlobalVariablesHook`` in the
+train hooks and rank-0-only ``model_dir`` checkpointing.
+
+The Estimator API was removed from TensorFlow 2.16+; on such builds this
+example explains that and exits cleanly (the MonitoredTrainingSession
+variant in ``tensorflow_mnist.py`` covers the same hook surface).
+
+Run:
+  python examples/tensorflow_mnist_estimator.py
+  python -m horovod_tpu.run -np 2 python \
+      examples/tensorflow_mnist_estimator.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import tempfile
+
+import numpy as np
+
+
+def synthetic_mnist(n: int, seed: int):
+    rng = np.random.RandomState(seed)
+    labels = rng.randint(0, 10, n)
+    images = rng.rand(n, 28, 28, 1).astype(np.float32) * 0.1
+    for i, k in enumerate(labels):
+        r, c = divmod(int(k), 4)
+        images[i, 7 * r:7 * r + 7, 7 * c:7 * c + 7, 0] += 1.0
+    return images, labels.astype(np.int32)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--steps", type=int, default=60)
+    args = ap.parse_args()
+
+    import tensorflow as tf
+
+    import horovod_tpu.tensorflow as hvd
+
+    hvd.init()
+
+    if not hasattr(tf, "estimator"):
+        if hvd.rank() == 0:
+            print("tf.estimator was removed in TensorFlow 2.16+; see "
+                  "examples/tensorflow_mnist.py for the hook-based "
+                  "equivalent.", flush=True)
+            print("DONE (estimator unavailable)", flush=True)
+        hvd.shutdown()
+        return
+
+    def model_fn(features, labels, mode):
+        h = tf.compat.v1.layers.conv2d(features, 8, 5,
+                                       activation=tf.nn.relu)
+        h = tf.compat.v1.layers.max_pooling2d(h, 4, 4)
+        logits = tf.compat.v1.layers.dense(
+            tf.compat.v1.layers.flatten(h), 10)
+        loss = tf.reduce_mean(
+            tf.nn.sparse_softmax_cross_entropy_with_logits(
+                labels=labels, logits=logits))
+        opt = tf.compat.v1.train.GradientDescentOptimizer(
+            0.05 * hvd.size())
+        opt = hvd.DistributedOptimizer(opt)
+        train_op = opt.minimize(
+            loss, global_step=tf.compat.v1.train.get_global_step())
+        return tf.estimator.EstimatorSpec(mode, loss=loss,
+                                          train_op=train_op)
+
+    images, labels = synthetic_mnist(512, seed=1)
+    images = images[hvd.rank()::hvd.size()]
+    labels = labels[hvd.rank()::hvd.size()]
+
+    def input_fn():
+        ds = tf.data.Dataset.from_tensor_slices((images, labels))
+        return ds.repeat().shuffle(256).batch(args.batch_size)
+
+    # checkpoints on rank 0 only (reference :94-98)
+    model_dir = tempfile.mkdtemp() if hvd.rank() == 0 else None
+    est = tf.estimator.Estimator(model_fn=model_fn, model_dir=model_dir)
+    est.train(input_fn=input_fn, steps=max(1, args.steps // hvd.size()),
+              hooks=[hvd.BroadcastGlobalVariablesHook(0)])
+
+    if hvd.rank() == 0:
+        print("DONE", flush=True)
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

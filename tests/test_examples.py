@@ -94,6 +94,79 @@ def test_mxnet_example_2proc():
     _run(MXNET, np_procs=2)
 
 
+PYTORCH_SYN = [os.path.join(EXAMPLES, "pytorch_synthetic_benchmark.py"),
+               "--model", "small", "--batch-size", "4",
+               "--num-warmup-batches", "1", "--num-batches-per-iter", "2",
+               "--num-iters", "2"]
+PYTORCH_IMAGENET = [os.path.join(EXAMPLES, "pytorch_imagenet_resnet50.py"),
+                    "--epochs", "2", "--train-size", "128",
+                    "--batch-size", "16", "--batches-per-allreduce", "2"]
+TF_MNIST = [os.path.join(EXAMPLES, "tensorflow_mnist.py"),
+            "--steps", "20", "--train-size", "128", "--batch-size", "16"]
+TF_MNIST_EAGER = [os.path.join(EXAMPLES, "tensorflow_mnist_eager.py"),
+                  "--steps", "20", "--batch-size", "16"]
+TF_W2V = [os.path.join(EXAMPLES, "tensorflow_word2vec.py"),
+          "--steps", "30", "--batch-size", "32"]
+TF_ESTIMATOR = [os.path.join(EXAMPLES, "tensorflow_mnist_estimator.py"),
+                "--steps", "20"]
+KERAS_MNIST = [os.path.join(EXAMPLES, "keras_mnist.py"),
+               "--epochs", "6", "--train-size", "256", "--batch-size", "32"]
+KERAS_MNIST_ADV = [os.path.join(EXAMPLES, "keras_mnist_advanced.py"),
+                   "--epochs", "3", "--warmup-epochs", "1",
+                   "--train-size", "256", "--batch-size", "32"]
+MXNET_MNIST = [os.path.join(EXAMPLES, "mxnet_mnist.py"),
+               "--epochs", "2", "--train-size", "256", "--batch-size", "32"]
+KERAS_SPARK = [os.path.join(EXAMPLES, "keras_spark_mnist.py"),
+               "--num-proc", "2", "--epochs", "2", "--train-size", "256"]
+
+
+def test_pytorch_synthetic_2proc():
+    _run(PYTORCH_SYN, np_procs=2)
+
+
+def test_pytorch_imagenet_resume_2proc(tmp_path):
+    """Second run finds the first run's epoch-1 checkpoint, broadcasts the
+    resume epoch, and trains only the remaining epoch."""
+    fmt = os.path.join(str(tmp_path), "ckpt-{epoch}.pt")
+    _run(PYTORCH_IMAGENET + ["--epochs", "1", "--checkpoint-format", fmt],
+         np_procs=2)
+    assert os.path.exists(fmt.format(epoch=1))
+    _run(PYTORCH_IMAGENET + ["--epochs", "2", "--checkpoint-format", fmt],
+         np_procs=2)
+    # resuming a fully-trained run is a clean no-op, not a crash
+    out = _run(PYTORCH_IMAGENET + ["--epochs", "2",
+                                   "--checkpoint-format", fmt],
+               np_procs=2)
+    assert "nothing left to train" in out
+
+
+@pytest.mark.skipif(
+    not os.environ.get("HOROVOD_TPU_TEST_TF"),
+    reason="TF import is slow; set HOROVOD_TPU_TEST_TF=1 to include")
+@pytest.mark.parametrize("argv", [TF_MNIST, TF_MNIST_EAGER, TF_W2V,
+                                  TF_ESTIMATOR],
+                         ids=["graph", "eager", "word2vec", "estimator"])
+def test_tensorflow_mnist_variants_2proc(argv):
+    _run(argv, timeout=600, np_procs=2)
+
+
+def test_keras_mnist_2proc():
+    _run(KERAS_MNIST, np_procs=2)
+
+
+def test_keras_mnist_advanced_2proc():
+    _run(KERAS_MNIST_ADV, np_procs=2)
+
+
+def test_mxnet_mnist_2proc():
+    _run(MXNET_MNIST, np_procs=2)
+
+
+def test_keras_spark_mnist():
+    # launches its own 2 workers through the spark/local placement flow
+    _run(KERAS_SPARK, timeout=420)
+
+
 def test_jax_llama_fsdp():
     out = _run(JAX_LLAMA + ["--fsdp", "4", "--tp", "2"])
     assert "mesh fsdp=4 tp=2" in out
