@@ -250,26 +250,106 @@ def allreduce_worker(args):
     hvd.shutdown()
 
 
+def scaling_worker(args):
+    """Runs inside ``horovod_tpu.run``: a data-parallel train step (MLP on
+    synthetic data, fused gradient allreduce) timed per step."""
+    import numpy as np
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    rng = np.random.RandomState(hvd.rank())
+    D, H, C, B = 784, args.mlp_hidden, 10, 64
+    w1 = np.ascontiguousarray(rng.randn(D, H).astype(np.float32) * 0.05)
+    w2 = np.ascontiguousarray(rng.randn(H, C).astype(np.float32) * 0.05)
+    hvd.broadcast(w1, 0, name="w1", out=w1)
+    hvd.broadcast(w2, 0, name="w2", out=w2)
+    x = rng.rand(B, D).astype(np.float32)
+    y = rng.randint(0, C, B)
+    g1 = np.empty_like(w1)
+    g2 = np.empty_like(w2)
+
+    def step():
+        nonlocal w1, w2
+        h = np.maximum(x @ w1, 0.0)
+        logits = h @ w2
+        logits -= logits.max(1, keepdims=True)
+        p = np.exp(logits)
+        p /= p.sum(1, keepdims=True)
+        gl = (p - np.eye(C, dtype=np.float32)[y]) / B
+        gw2 = h.T @ gl
+        gh = (gl @ w2.T) * (h > 0)
+        gw1 = x.T @ gh
+        h1 = hvd.allreduce_async(gw1, average=True, name="g1", out=g1)
+        h2 = hvd.allreduce_async(gw2, average=True, name="g2", out=g2)
+        hvd.synchronize(h1)
+        hvd.synchronize(h2)
+        w1 -= 0.1 * g1
+        w2 -= 0.1 * g2
+
+    for _ in range(5):
+        step()
+    t0 = time.perf_counter()
+    for _ in range(args.scal_iters):
+        step()
+    dt = time.perf_counter() - t0
+    if hvd.rank() == 0:
+        print(json.dumps({"np": hvd.size(),
+                          "step_ms": round(1e3 * dt / args.scal_iters, 3)}),
+              flush=True)
+    hvd.shutdown()
+
+
+def _run_worker(n: int, worker_args: list) -> dict:
+    """Launch this file's worker mode under ``horovod_tpu.run -np n`` on
+    the CPU backend (the engine is host-side) and parse its JSON line."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    cmd = [sys.executable, "-m", "horovod_tpu.run", "-np", str(n),
+           sys.executable, os.path.abspath(__file__)] + worker_args
+    try:
+        out = subprocess.run(cmd, env=env, cwd=REPO, capture_output=True,
+                             text=True, timeout=300)
+        line = [ln for ln in out.stdout.splitlines()
+                if ln.startswith("{")][-1]
+        return json.loads(line)
+    except Exception as exc:  # noqa: BLE001 - report, don't die
+        return {"error": f"{type(exc).__name__}: {exc}"[:200]}
+
+
+def bench_scaling(args):
+    """Weak-scaling efficiency of the eager DP path: per-step time at
+    np=1 vs np=N on THIS host (loopback TCP + shared cores — a lower
+    bound on real multi-host ICI/DCN scaling, reported as such).
+    Efficiency = step_time(1) / step_time(N) with per-rank batch fixed."""
+    results = {}
+    t1 = None
+    for n in (1, 2, 4):
+        if n > args.ar_max_np:
+            continue
+        r = _run_worker(n, ["--scaling-worker",
+                            "--scal-iters", str(args.scal_iters),
+                            "--mlp-hidden", str(args.mlp_hidden)])
+        if "step_ms" in r:
+            if n == 1:
+                t1 = r["step_ms"]
+            r["weak_scaling_efficiency"] = (
+                round(t1 / r["step_ms"], 3) if t1 else None)
+        results[str(n)] = r
+    results["note"] = ("single-host loopback weak scaling (shared cores); "
+                       "lower bound for multi-host ICI/DCN")
+    return results
+
+
 def bench_allreduce(args):
     """Eager ring allreduce bus bandwidth at 2..8 processes."""
     results = {}
     for n in (2, 4, 8):
         if n > args.ar_max_np:
             continue
-        env = dict(os.environ)
-        env["JAX_PLATFORMS"] = "cpu"  # engine is host-side; keep TPU out
-        cmd = [sys.executable, "-m", "horovod_tpu.run", "-np", str(n),
-               sys.executable, os.path.abspath(__file__),
-               "--allreduce-worker", "--size-mb", str(args.size_mb),
-               "--ar-iters", str(args.ar_iters)]
-        try:
-            out = subprocess.run(cmd, env=env, cwd=REPO, capture_output=True,
-                                 text=True, timeout=300)
-            line = [ln for ln in out.stdout.splitlines()
-                    if ln.startswith("{")][-1]
-            results[str(n)] = json.loads(line)
-        except Exception as exc:  # noqa: BLE001 - report, don't die
-            results[str(n)] = {"error": f"{type(exc).__name__}: {exc}"[:200]}
+        results[str(n)] = _run_worker(n, ["--allreduce-worker",
+                                          "--size-mb", str(args.size_mb),
+                                          "--ar-iters", str(args.ar_iters)])
     return results
 
 
@@ -292,14 +372,22 @@ def main() -> None:
     ap.add_argument("--ar-max-np", type=int, default=8)
     ap.add_argument("--skip-llama", action="store_true")
     ap.add_argument("--skip-allreduce", action="store_true")
+    ap.add_argument("--skip-scaling", action="store_true")
     ap.add_argument("--allreduce-worker", action="store_true",
                     help=argparse.SUPPRESS)
+    ap.add_argument("--scaling-worker", action="store_true",
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--scal-iters", type=int, default=50)
+    ap.add_argument("--mlp-hidden", type=int, default=512)
     ap.add_argument("--cpu", action="store_true",
                     help="force the CPU backend (debug)")
     args = ap.parse_args()
 
     if args.allreduce_worker:
         allreduce_worker(args)
+        return
+    if args.scaling_worker:
+        scaling_worker(args)
         return
 
     # compiled-path fusion knob — the analog of HOROVOD_FUSION_THRESHOLD —
@@ -327,6 +415,7 @@ def main() -> None:
     if not args.skip_llama:
         models["llama"] = bench_llama(args, peak)
     allreduce = {} if args.skip_allreduce else bench_allreduce(args)
+    scaling = {} if args.skip_scaling else bench_scaling(args)
 
     primary = models["resnet50"]
     print(json.dumps({
@@ -342,6 +431,7 @@ def main() -> None:
             platform=backend if backend in ("tpu", "gpu") else "gpu"),
         "models": models,
         "allreduce_busbw": allreduce,
+        "eager_dp_scaling": scaling,
     }))
 
 
