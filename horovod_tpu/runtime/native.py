@@ -63,6 +63,12 @@ def _load_lib():
     with _build_lock:
         if _lib is not None:
             return _lib
+        # explicit override (e.g. the TSAN-instrumented build from
+        # `make -C csrc tsan`, loaded under LD_PRELOAD=libtsan.so)
+        override = os.environ.get("HOROVOD_TPU_NATIVE_LIB")
+        if override:
+            _lib = _bind(ctypes.CDLL(override))
+            return _lib
         so = _installed_so()
         if so is not None:
             _lib = _bind(ctypes.CDLL(so))

@@ -98,6 +98,41 @@ def test_subworld_communicator():
         assert f"rank {r}: subworld OK" in res.stdout
 
 
+def _libtsan():
+    import glob
+
+    hits = glob.glob("/usr/lib/gcc/*/*/libtsan.so")
+    return hits[0] if hits else None
+
+
+@pytest.mark.skipif(_libtsan() is None, reason="libtsan not available")
+def test_engine_race_free_under_tsan():
+    """ThreadSanitizer pass over the full collectives scenario: the
+    engine's background-thread/caller-thread handoffs (tensor table,
+    handles, buffer pool, cv) must produce zero race reports.  The
+    reference relies on design review for this (SURVEY §5 'race
+    detection: none in-tree'); here it is a test."""
+    mk = subprocess.run(["make", "-C", os.path.join(REPO, "csrc"), "tsan"],
+                        capture_output=True, text=True)
+    assert mk.returncode == 0, mk.stderr
+    res = _run("collectives", 2, timeout=300, env={
+        "HOROVOD_TPU_NATIVE_LIB": os.path.join(REPO, "csrc",
+                                               "libhvdtpu_tsan.so"),
+        "LD_PRELOAD": _libtsan(),
+        # exitcode=0: the preload also instruments CPython/BLAS, whose
+        # benign hand-rolled atomics can produce foreign reports — scope
+        # the verdict to reports naming OUR translation units below
+        "TSAN_OPTIONS": "exitcode=0 halt_on_error=0",
+    })
+    assert res.returncode == 0, res.stderr[-3000:] + res.stdout[-500:]
+    if "WARNING: ThreadSanitizer" in res.stderr:
+        ours = ("hvdtpu", "engine.cc", "socket.cc", "wire.cc",
+                "timeline.cc", "autotune.cc")
+        assert not any(t in res.stderr for t in ours), res.stderr[-4000:]
+    for r in range(2):
+        assert f"rank {r}: collectives OK" in res.stdout
+
+
 def test_log_level_env():
     """Leveled C++ logging: the topology debug line appears only when the
     env raises verbosity (reference logging.h:7-57 behavior)."""
