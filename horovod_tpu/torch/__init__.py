@@ -134,10 +134,19 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         can never silently apply un-averaged local gradients (reference
         ``torch/__init__.py:132-143``).
         """
+        # force-reduce EVERY registered param whose hook didn't fire —
+        # unconditionally, like the reference's _requires_update snapshot
+        # (torch/__init__.py:132-143).  A param may be unused in this
+        # rank's forward (grad None) or freshly frozen here while another
+        # rank's hook already enqueued its allreduce; filtering on live
+        # rank-local state (grad presence, requires_grad) makes collective
+        # counts diverge across ranks and deadlocks the negotiation, so
+        # the missing side contributes zeros instead.
         missing = [p for p in self._allreduce_delay
-                   if p.requires_grad and p.grad is not None
-                   and p not in self._handles]
+                   if p not in self._handles]
         for p in missing:
+            if p.grad is None:
+                p.grad = torch.zeros_like(p)
             self._handles[p] = self._allreduce_grad_async(p)
         for p, (handle, ctx, compressed) in self._handles.items():
             synchronize(handle)

@@ -42,3 +42,21 @@ def test_torch_broadcast_state():
     assert res.returncode == 0, res.stderr + res.stdout
     for r in range(2):
         assert f"rank {r}: torch state OK" in res.stdout
+
+
+def test_torch_model_parallelism():
+    """Reference test_torch.py:1109: shared layers stay in sync while
+    user-managed private layers diverge."""
+    res = _run("model_parallel", 2)
+    assert res.returncode == 0, res.stderr + res.stdout
+    for r in range(2):
+        assert f"rank {r}: model parallel OK" in res.stdout
+
+
+def test_torch_dynamic_requires_grad():
+    """Reference test_torch.py:1163: freezing parameters between steps
+    must not deadlock the gradient negotiation."""
+    res = _run("dynamic_requires_grad", 2)
+    assert res.returncode == 0, res.stderr + res.stdout
+    for r in range(2):
+        assert f"rank {r}: dynamic requires_grad OK" in res.stdout

@@ -104,6 +104,95 @@ def scenario_optimizer():
     print(f"rank {r}: torch optimizer OK", flush=True)
 
 
+def _assert_ranks_agree(params, prefix, exact=True):
+    """Allgather each param and assert every rank holds the same values."""
+    for i, p in enumerate(params):
+        gat = hvd.allgather(p.detach().reshape(1, -1), name=f"{prefix}{i}")
+        ref = gat[0].expand_as(gat)
+        ok = torch.equal(ref, gat) if exact \
+            else torch.allclose(gat, ref, atol=0)
+        assert ok, (prefix, i)
+
+
+def scenario_model_parallel():
+    """User-managed model parallelism (reference test_torch.py:1109): each
+    rank owns a PRIVATE layer plus a SHARED layer; only shared gradients
+    are allreduced.  Shared params must stay bitwise identical across
+    ranks while private params diverge."""
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    torch.manual_seed(0)
+    shared = torch.nn.Linear(4, 4)
+    torch.manual_seed(1000 + r)  # deliberately rank-divergent
+    private = torch.nn.Linear(4, 4)
+
+    opt = torch.optim.SGD([*shared.parameters(), *private.parameters()],
+                          lr=0.05)
+    torch.manual_seed(2000 + r)
+    for step in range(3):
+        opt.zero_grad()
+        x = torch.randn(6, 4)
+        (shared(private(x))).pow(2).mean().backward()
+        # allreduce ONLY the shared layer's grads
+        for i, p in enumerate(shared.parameters()):
+            hvd.allreduce_(p.grad, average=True, name=f"shared{step}.{i}")
+        opt.step()
+
+    # shared params bitwise equal everywhere, private ones not
+    _assert_ranks_agree(shared.parameters(), "ms")
+    div = 0
+    for i, p in enumerate(private.parameters()):
+        gat = hvd.allgather(p.detach().reshape(1, -1), name=f"mp{i}")
+        div += int(not torch.equal(gat[0].expand_as(gat), gat))
+    assert div > 0, "private layers unexpectedly converged"
+    hvd.shutdown()
+    print(f"rank {r}: model parallel OK", flush=True)
+
+
+def scenario_dynamic_requires_grad():
+    """Gradients appear and disappear between steps (reference
+    test_torch.py:1163): freezing a parameter on some steps, and skipping
+    a whole layer in the forward on others, must not deadlock.  The
+    skipped-layer steps leave live-requires_grad params with NO grad,
+    which drives the DistributedOptimizer's missing-grad force-reduce
+    path — every rank must still issue the same collectives."""
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    torch.manual_seed(0)
+    pre = torch.nn.Linear(4, 8)
+    post = torch.nn.Linear(8, 2)
+    proj = torch.nn.Linear(4, 8, bias=False)  # alternate route around pre
+    params = {**{f"pre.{k}": v for k, v in pre.named_parameters()},
+              **{f"post.{k}": v for k, v in post.named_parameters()},
+              **{f"proj.{k}": v for k, v in proj.named_parameters()}}
+    opt = hvd.DistributedOptimizer(
+        torch.optim.SGD(list(params.values()), lr=0.05),
+        named_parameters=params.items())
+    torch.manual_seed(300 + r)
+    for step in range(4):
+        # on odd steps the route is RANK-DEPENDENT: rank 0 drives `pre`
+        # while the others drive `proj`, so each side has live params with
+        # no grad that the other side DID produce — exactly the reference's
+        # force-allreduce deadlock scenario; the optimizer must contribute
+        # zeros for its missing grads so the collectives line up
+        use_pre = step % 2 == 0 or r == 0
+        route = pre if use_pre else proj
+        # rank-ASYMMETRIC freeze on step 2: the non-zero ranks flip
+        # requires_grad off on `proj` AFTER rank 0's hooks already fired —
+        # the force-reduce must ignore live requires_grad state or the
+        # collective counts diverge
+        for p in proj.parameters():
+            p.requires_grad_(not (step == 2 and r != 0))
+        opt.zero_grad()
+        post(route(torch.randn(5, 4))).pow(2).mean().backward()
+        opt.step()
+    for p in proj.parameters():
+        p.requires_grad_(True)
+    _assert_ranks_agree(params.values(), "dg", exact=False)
+    hvd.shutdown()
+    print(f"rank {r}: dynamic requires_grad OK", flush=True)
+
+
 def scenario_state():
     hvd.init()
     r, n = hvd.rank(), hvd.size()
