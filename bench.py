@@ -232,14 +232,17 @@ def allreduce_worker(args):
     nbytes = args.size_mb * 1024 * 1024
     out = {"np": n, "size_mb": args.size_mb}
     for dtype, tag in ((np.float32, "fp32"), (np.float16, "fp16")):
+        # in-place (out aliases the input): the zero-copy path — the ring
+        # runs directly on this buffer, no staging or copy-out.  Sum, not
+        # average: a host-side fp16 divide would dwarf the wire time.
+        # (values double per iteration; harmless for bandwidth)
         arr = np.ones(nbytes // np.dtype(dtype).itemsize, dtype)
-        res = np.empty_like(arr)  # reused result buffer: warm pages
         for _ in range(3):
-            hvd.allreduce(arr, average=False, name=f"warmup.{tag}", out=res)
+            hvd.allreduce(arr, average=False, name=f"warmup.{tag}", out=arr)
         t0 = time.perf_counter()
         for i in range(args.ar_iters):
             hvd.allreduce(arr, average=False, name=f"bench.{tag}.{i}",
-                          out=res)
+                          out=arr)
         dt = time.perf_counter() - t0
         # ring busbw convention: busbw = algbw * 2(n-1)/n
         algbw = nbytes * args.ar_iters / dt

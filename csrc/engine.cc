@@ -211,12 +211,19 @@ void Accumulate(void* dst, const void* src, int64_t n, DType d) {
 struct TensorEntry {
   Request req;
   std::vector<char> data;
+  size_t nbytes = 0;
   int handle = -1;
   // caller-owned output buffer (same shape as input): the engine writes
   // the result there on the background thread and skips the result-vector
   // stage entirely — the ≤1-copy-each-way eager path
   void* user_out = nullptr;
-  std::chrono::steady_clock::time_point enqueued_at;
+  // out aliases the input exactly (in-place op): no staging copy at all;
+  // the collective runs directly on the caller's buffer, which the caller
+  // keeps alive and treats as undefined until completion
+  bool inplace = false;
+  char* payload() {
+    return inplace ? static_cast<char*>(user_out) : data.data();
+  }
 };
 
 struct HandleState {
@@ -594,10 +601,19 @@ int Engine::Enqueue(OpType op, const std::string& name, DType dtype,
                     const std::vector<int64_t>& dims, const void* data,
                     int root_rank, void* user_out) {
   size_t nbytes = static_cast<size_t>(NumElems(dims)) * DTypeSize(dtype);
-  // stage the input outside the lock (pooled: warm pages after the first
-  // few ops instead of a fresh 64 MB fault storm per op)
-  std::vector<char> staged = PoolGet(nbytes);
-  std::memcpy(staged.data(), data, nbytes);
+  // user_out only makes sense for same-shape ops
+  if (op != OpType::kAllreduce && op != OpType::kBroadcast)
+    user_out = nullptr;
+  // in-place (out aliases input): no staging at all — the collective runs
+  // on the caller's buffer; otherwise stage the input outside the lock
+  // (pooled: warm pages after the first few ops instead of a fresh 64 MB
+  // fault storm per op)
+  bool inplace = user_out != nullptr && user_out == data;
+  std::vector<char> staged;
+  if (!inplace) {
+    staged = PoolGet(nbytes);
+    std::memcpy(staged.data(), data, nbytes);
+  }
   std::lock_guard<std::mutex> lk(mu_);
   int handle = next_handle_++;
   handles_[handle] = HandleState{};
@@ -625,9 +641,10 @@ int Engine::Enqueue(OpType op, const std::string& name, DType dtype,
   e.req.root_rank = root_rank;
   e.req.dims = dims;
   e.data = std::move(staged);
+  e.nbytes = nbytes;
   e.handle = handle;
   e.user_out = user_out;
-  e.enqueued_at = std::chrono::steady_clock::now();
+  e.inplace = inplace;
   queue_.push_back(e.req);
   tensor_table_.emplace(name, std::move(e));
   return handle;
@@ -1065,7 +1082,7 @@ void Engine::Execute(const Response& resp) {
   }
   if (entries.empty()) return;
   for (const TensorEntry& e : entries)
-    cycle_bytes_ += static_cast<int64_t>(e.data.size());
+    cycle_bytes_ += static_cast<int64_t>(e.nbytes);
   for (const std::string& name : resp.names)
     timeline_.Start(name, OpName(resp.op));
   switch (resp.op) {
@@ -1103,13 +1120,14 @@ void Engine::ExecuteAllreduce(const Response& resp,
   };
   const char* act = hierarchical_allreduce_ ? "HIERARCHICAL_ALLREDUCE"
                                             : "RING_ALLREDUCE";
-  // completes one entry: user_out callers get the result written into
-  // their buffer on this (background) thread; others get the vector moved
-  // into the handle state
+  // completes one entry: in-place callers already hold the result in
+  // their own buffer; non-aliased user_out callers get it copied there on
+  // this (background) thread; the rest get the vector moved into the
+  // handle state
   auto finish = [&](TensorEntry& e, const Status& st) {
     if (e.user_out) {
-      if (st.ok())
-        std::memcpy(e.user_out, e.data.data(), e.data.size());
+      if (st.ok() && !e.inplace)
+        std::memcpy(e.user_out, e.data.data(), e.nbytes);
       PoolPut(std::move(e.data));
       MarkDone(e.handle, st, e.req.dims, {});
     } else {
@@ -1117,10 +1135,10 @@ void Engine::ExecuteAllreduce(const Response& resp,
     }
   };
   if (entries.size() == 1) {
-    // no fusion copy needed: reduce in place on the entry buffer
+    // no fusion copy needed: reduce in place on the payload buffer
     TensorEntry& e = entries[0];
     act_start(act);
-    Status st = reduce(e.data.data(), NumElems(e.req.dims));
+    Status st = reduce(e.payload(), NumElems(e.req.dims));
     act_end();
     finish(e, st);
     if (!st.ok()) FailAll(st);
@@ -1128,14 +1146,14 @@ void Engine::ExecuteAllreduce(const Response& resp,
   }
   // fusion buffer (persistent across responses): pack, one allreduce, unpack
   size_t total = 0;
-  for (auto& e : entries) total += e.data.size();
+  for (auto& e : entries) total += e.nbytes;
   if (fusion_buf_.size() < total) fusion_buf_.resize(total);
   char* fused = fusion_buf_.data();
   size_t off = 0;
   act_start("MEMCPY_IN_FUSION_BUFFER");
   for (auto& e : entries) {
-    std::memcpy(fused + off, e.data.data(), e.data.size());
-    off += e.data.size();
+    std::memcpy(fused + off, e.payload(), e.nbytes);
+    off += e.nbytes;
   }
   act_end();
   act_start(act);
@@ -1147,9 +1165,9 @@ void Engine::ExecuteAllreduce(const Response& resp,
     // unpack straight into the caller's buffer when provided
     if (st.ok()) {
       char* dst = e.user_out ? static_cast<char*>(e.user_out) : e.data.data();
-      std::memcpy(dst, fused + off, e.data.size());
+      std::memcpy(dst, fused + off, e.nbytes);
     }
-    off += e.data.size();
+    off += e.nbytes;
   }
   act_end();
   for (auto& e : entries) {
@@ -1401,8 +1419,8 @@ Status Engine::TreeBroadcastGroup(char* buf, int64_t nbytes, int root,
 }
 
 void Engine::ExecuteBroadcast(const Response& resp, TensorEntry& entry) {
-  Status st = TreeBroadcast(entry.data.data(),
-                            static_cast<int64_t>(entry.data.size()),
+  Status st = TreeBroadcast(entry.payload(),
+                            static_cast<int64_t>(entry.nbytes),
                             resp.root_rank);
   if (!st.ok()) {
     Status err = Status::Error("broadcast failed: " + st.message);
@@ -1411,7 +1429,8 @@ void Engine::ExecuteBroadcast(const Response& resp, TensorEntry& entry) {
     return;
   }
   if (entry.user_out) {
-    std::memcpy(entry.user_out, entry.data.data(), entry.data.size());
+    if (!entry.inplace)
+      std::memcpy(entry.user_out, entry.data.data(), entry.nbytes);
     PoolPut(std::move(entry.data));
     MarkDone(entry.handle, Status::OK(), entry.req.dims, {});
     return;
