@@ -701,7 +701,15 @@ void Engine::MarkDone(int handle, Status st, std::vector<int64_t> dims,
   it->second.done = true;
   it->second.status = std::move(st);
   it->second.out_dims = std::move(dims);
-  it->second.result = std::move(result);
+  // an errored op has no meaningful output: recycle the buffer now so a
+  // caller that polls the error but never synchronizes can't hold pages
+  // hostage (only the small HandleState stays until hvd_release)
+  if (it->second.status.ok()) {
+    it->second.result = std::move(result);
+  } else {
+    it->second.result.clear();
+    PoolPutLocked(std::move(result));
+  }
   cv_.notify_all();
 }
 

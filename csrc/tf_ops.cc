@@ -1,0 +1,379 @@
+// TensorFlow custom AsyncOpKernels on the native eager engine.
+//
+// Role analog of the reference's TF C++ adapter
+// (/root/reference/horovod/tensorflow/mpi_ops.cc:276-463): each collective
+// is a real graph op whose kernel enqueues into the background engine and
+// completes the TF async `done` callback when the collective finishes, so
+// TF's executor can keep many collectives in flight (they negotiate and
+// fuse in the engine) and graphs containing them are serializable — none of
+// which the tf.py_function fallback bridge can do.
+//
+// Built separately from libhvdtpu.so (needs the installed TF's headers and
+// ABI flags; see horovod_tpu/tensorflow/_native.py). Rather than linking
+// against the engine, it dlopens the exact libhvdtpu.so the Python runtime
+// loaded (path in HOROVOD_TPU_NATIVE_LIB) so both views share one Engine.
+
+#include <dlfcn.h>
+
+#include <algorithm>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdlib>
+#include <deque>
+#include <functional>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "tensorflow/core/framework/op.h"
+#include "tensorflow/core/framework/op_kernel.h"
+#include "tensorflow/core/framework/shape_inference.h"
+
+namespace {
+
+using tensorflow::AsyncOpKernel;
+using tensorflow::DataType;
+using tensorflow::OpKernelConstruction;
+using tensorflow::OpKernelContext;
+using tensorflow::Tensor;
+using tensorflow::TensorShape;
+using tensorflow::errors::FailedPrecondition;
+using tensorflow::errors::InvalidArgument;
+using tensorflow::errors::Unknown;
+
+// ---------------------------------------------------------------------------
+// engine C API, resolved at first use from the already-loaded libhvdtpu.so
+// ---------------------------------------------------------------------------
+
+struct EngineApi {
+  int (*enqueue)(int, const char*, int, int, const int64_t*, const void*,
+                 int) = nullptr;
+  int (*enqueue_out)(int, const char*, int, int, const int64_t*, const void*,
+                     int, void*) = nullptr;
+  int (*wait)(int, double) = nullptr;
+  int (*result_ndim)(int) = nullptr;
+  void (*result_dims)(int, int64_t*) = nullptr;
+  int64_t (*result_nbytes)(int) = nullptr;
+  void (*result_copy)(int, void*) = nullptr;
+  const char* (*error_str)(int) = nullptr;
+  void (*free_cstr)(const char*) = nullptr;
+  void (*release)(int) = nullptr;
+  bool ok = false;
+  std::string err;
+};
+
+EngineApi LoadApi() {
+  EngineApi a;
+  const char* path = getenv("HOROVOD_TPU_NATIVE_LIB");
+  if (!path || !path[0]) {
+    a.err = "HOROVOD_TPU_NATIVE_LIB is not set; load these ops through "
+            "horovod_tpu.tensorflow (which points it at the engine library)";
+    return a;
+  }
+  void* h = dlopen(path, RTLD_NOW | RTLD_GLOBAL);
+  if (!h) {
+    a.err = std::string("dlopen(") + path + ") failed: " + dlerror();
+    return a;
+  }
+  auto sym = [&](const char* n) { return dlsym(h, n); };
+#define HVD_BIND(field, name)                                   \
+  *reinterpret_cast<void**>(&a.field) = sym(name);              \
+  if (!a.field) {                                               \
+    a.err = std::string("missing engine symbol ") + name;       \
+    return a;                                                   \
+  }
+  HVD_BIND(enqueue, "hvd_enqueue")
+  HVD_BIND(enqueue_out, "hvd_enqueue_out")
+  HVD_BIND(wait, "hvd_wait")
+  HVD_BIND(result_ndim, "hvd_result_ndim")
+  HVD_BIND(result_dims, "hvd_result_dims")
+  HVD_BIND(result_nbytes, "hvd_result_nbytes")
+  HVD_BIND(result_copy, "hvd_result_copy")
+  HVD_BIND(error_str, "hvd_error_str")
+  HVD_BIND(free_cstr, "hvd_free_cstr")
+  HVD_BIND(release, "hvd_release")
+#undef HVD_BIND
+  a.ok = true;
+  return a;
+}
+
+// Snapshot accessor; a failed load (e.g. a SavedModel executed these ops
+// before horovod_tpu.tensorflow set HOROVOD_TPU_NATIVE_LIB) is retried on
+// the next kernel execution rather than latched for process lifetime.
+EngineApi Api() {
+  static std::mutex mu;
+  static EngineApi api;
+  std::lock_guard<std::mutex> lk(mu);
+  if (!api.ok) api = LoadApi();
+  return api;
+}
+
+// DType codes of csrc/common.h (mirrored in runtime/native.py _DTYPES)
+int DTypeCode(DataType dt) {
+  switch (dt) {
+    case tensorflow::DT_UINT8: return 0;
+    case tensorflow::DT_INT8: return 1;
+    case tensorflow::DT_INT32: return 2;
+    case tensorflow::DT_INT64: return 3;
+    case tensorflow::DT_HALF: return 4;
+    case tensorflow::DT_BFLOAT16: return 5;
+    case tensorflow::DT_FLOAT: return 6;
+    case tensorflow::DT_DOUBLE: return 7;
+    default: return -1;
+  }
+}
+
+enum { kAllreduce = 0, kAllgather = 1, kBroadcast = 2 };
+
+std::vector<int64_t> DimsOf(const Tensor& t) {
+  std::vector<int64_t> dims;
+  for (int i = 0; i < t.dims(); i++) dims.push_back(t.dim_size(i));
+  if (dims.empty()) dims.push_back(1);  // engine wire has no 0-d tensors
+  return dims;
+}
+
+// One dedicated completion thread: the engine completes collectives in
+// negotiation order (FIFO across the world), so waiting on handles in
+// submission order adds no head-of-line blocking in practice, and TF's
+// inter-op threads never block inside hvd_wait.
+class Completer {
+ public:
+  static Completer& Get() {
+    static Completer* c = new Completer();  // leaked: process lifetime
+    return *c;
+  }
+
+  void Submit(std::function<void()> fn) {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      q_.push_back(std::move(fn));
+    }
+    cv_.notify_one();
+  }
+
+ private:
+  Completer() {
+    std::thread([this] { Loop(); }).detach();
+  }
+
+  void Loop() {
+    for (;;) {
+      std::function<void()> fn;
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        cv_.wait(lk, [&] { return !q_.empty(); });
+        fn = std::move(q_.front());
+        q_.pop_front();
+      }
+      fn();
+    }
+  }
+
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::deque<std::function<void()>> q_;
+};
+
+void FailCtx(OpKernelContext* ctx, EngineApi& api, int handle) {
+  const char* msg = api.error_str(handle);
+  ctx->SetStatus(Unknown("horovod_tpu collective failed: ",
+                         msg ? msg : "unknown error"));
+  if (msg) api.free_cstr(msg);
+}
+
+// ---------------------------------------------------------------------------
+// same-shape ops: allreduce, broadcast — the engine writes the result
+// straight into the pre-allocated TF output buffer (no copy-out)
+// ---------------------------------------------------------------------------
+
+class SameShapeCollectiveOp : public AsyncOpKernel {
+ public:
+  SameShapeCollectiveOp(OpKernelConstruction* c, int op, int root_rank)
+      : AsyncOpKernel(c), op_(op), root_rank_(root_rank) {
+    OP_REQUIRES_OK(c, c->GetAttr("tensor_name", &name_));
+  }
+
+  void ComputeAsync(OpKernelContext* ctx, DoneCallback done) override {
+    EngineApi api = Api();
+    OP_REQUIRES_ASYNC(ctx, api.ok, FailedPrecondition(api.err), done);
+    const Tensor& in = ctx->input(0);
+    Tensor* out = nullptr;
+    OP_REQUIRES_OK_ASYNC(ctx, ctx->allocate_output(0, in.shape(), &out),
+                         done);
+    int code = DTypeCode(in.dtype());
+    OP_REQUIRES_ASYNC(
+        ctx, code >= 0,
+        InvalidArgument("dtype not supported by the engine wire: ",
+                        tensorflow::DataTypeString(in.dtype())),
+        done);
+    std::vector<int64_t> dims = DimsOf(in);
+    // input is staged (copied) synchronously inside enqueue; the output
+    // buffer is written by the engine's background thread and stays alive
+    // until done() runs
+    int handle = api.enqueue_out(
+        op_, name_.c_str(), code, static_cast<int>(dims.size()), dims.data(),
+        in.tensor_data().data(), root_rank_,
+        const_cast<char*>(out->tensor_data().data()));
+    OP_REQUIRES_ASYNC(
+        ctx, handle >= 0,
+        FailedPrecondition("engine not initialized — call "
+                           "horovod_tpu.tensorflow.init() first"),
+        done);
+    Completer::Get().Submit([ctx, handle, done = std::move(done)]() {
+      EngineApi api = Api();
+      int rc = api.wait(handle, -1.0);
+      if (rc < 0) FailCtx(ctx, api, handle);
+      api.release(handle);
+      done();
+    });
+  }
+
+ private:
+  int op_;
+  int root_rank_;
+  std::string name_;
+};
+
+class HvdTpuAllreduceOp : public SameShapeCollectiveOp {
+ public:
+  explicit HvdTpuAllreduceOp(OpKernelConstruction* c)
+      : SameShapeCollectiveOp(c, kAllreduce, -1) {}
+};
+
+class HvdTpuBroadcastOp : public SameShapeCollectiveOp {
+ public:
+  explicit HvdTpuBroadcastOp(OpKernelConstruction* c)
+      : SameShapeCollectiveOp(c, kBroadcast, RootOf(c)) {}
+
+ private:
+  static int RootOf(OpKernelConstruction* c) {
+    int root = 0;
+    c->GetAttr("root_rank", &root).IgnoreError();
+    return root;
+  }
+};
+
+// ---------------------------------------------------------------------------
+// allgather: output shape is known only after the collective (ranks may
+// contribute different dim-0 sizes), so allocation happens at completion
+// ---------------------------------------------------------------------------
+
+class HvdTpuAllgatherOp : public AsyncOpKernel {
+ public:
+  explicit HvdTpuAllgatherOp(OpKernelConstruction* c) : AsyncOpKernel(c) {
+    OP_REQUIRES_OK(c, c->GetAttr("tensor_name", &name_));
+  }
+
+  void ComputeAsync(OpKernelContext* ctx, DoneCallback done) override {
+    EngineApi api = Api();
+    OP_REQUIRES_ASYNC(ctx, api.ok, FailedPrecondition(api.err), done);
+    const Tensor& in = ctx->input(0);
+    int code = DTypeCode(in.dtype());
+    OP_REQUIRES_ASYNC(
+        ctx, code >= 0,
+        InvalidArgument("dtype not supported by the engine wire: ",
+                        tensorflow::DataTypeString(in.dtype())),
+        done);
+    std::vector<int64_t> dims = DimsOf(in);
+    int handle = api.enqueue(kAllgather, name_.c_str(), code,
+                             static_cast<int>(dims.size()), dims.data(),
+                             in.tensor_data().data(), -1);
+    OP_REQUIRES_ASYNC(
+        ctx, handle >= 0,
+        FailedPrecondition("engine not initialized — call "
+                           "horovod_tpu.tensorflow.init() first"),
+        done);
+    Completer::Get().Submit([ctx, handle, done = std::move(done)]() {
+      EngineApi api = Api();
+      int rc = api.wait(handle, -1.0);
+      if (rc < 0) {
+        FailCtx(ctx, api, handle);
+        api.release(handle);
+        done();
+        return;
+      }
+      int ndim = api.result_ndim(handle);
+      std::vector<int64_t> out_dims(std::max(ndim, 1), 0);
+      api.result_dims(handle, out_dims.data());
+      TensorShape shape;
+      for (int i = 0; i < ndim; i++) shape.AddDim(out_dims[i]);
+      Tensor* out = nullptr;
+      auto st = ctx->allocate_output(0, shape, &out);
+      if (!st.ok()) {
+        ctx->SetStatus(st);
+      } else if (api.result_nbytes(handle) !=
+                 static_cast<int64_t>(out->tensor_data().size())) {
+        ctx->SetStatus(Unknown("allgather result size mismatch: wire ",
+                               api.result_nbytes(handle), " vs tensor ",
+                               out->tensor_data().size()));
+      } else {
+        api.result_copy(handle,
+                        const_cast<char*>(out->tensor_data().data()));
+      }
+      api.release(handle);
+      done();
+    });
+  }
+
+ private:
+  std::string name_;
+};
+
+// ---------------------------------------------------------------------------
+// registrations
+// ---------------------------------------------------------------------------
+
+constexpr char kDtypes[] =
+    "{uint8, int8, int32, int64, float16, bfloat16, float32, float64}";
+
+absl::Status UnchangedShape(tensorflow::shape_inference::InferenceContext* c) {
+  c->set_output(0, c->input(0));
+  return absl::OkStatus();
+}
+
+absl::Status AllgatherShape(tensorflow::shape_inference::InferenceContext* c) {
+  auto in = c->input(0);
+  if (!c->RankKnown(in)) {
+    c->set_output(0, c->UnknownShape());
+    return absl::OkStatus();
+  }
+  if (c->Rank(in) == 0) {  // scalars gather to [size]
+    c->set_output(0, c->Vector(c->UnknownDim()));
+    return absl::OkStatus();
+  }
+  tensorflow::shape_inference::ShapeHandle out;
+  TF_RETURN_IF_ERROR(c->ReplaceDim(in, 0, c->UnknownDim(), &out));
+  c->set_output(0, out);
+  return absl::OkStatus();
+}
+
+REGISTER_OP("HvdTpuAllreduce")
+    .Attr(std::string("T: ") + kDtypes)
+    .Attr("tensor_name: string")
+    .Input("tensor: T")
+    .Output("output: T")
+    .SetShapeFn(UnchangedShape);
+REGISTER_KERNEL_BUILDER(Name("HvdTpuAllreduce").Device(tensorflow::DEVICE_CPU),
+                        HvdTpuAllreduceOp);
+
+REGISTER_OP("HvdTpuBroadcast")
+    .Attr(std::string("T: ") + kDtypes)
+    .Attr("tensor_name: string")
+    .Attr("root_rank: int")
+    .Input("tensor: T")
+    .Output("output: T")
+    .SetShapeFn(UnchangedShape);
+REGISTER_KERNEL_BUILDER(Name("HvdTpuBroadcast").Device(tensorflow::DEVICE_CPU),
+                        HvdTpuBroadcastOp);
+
+REGISTER_OP("HvdTpuAllgather")
+    .Attr(std::string("T: ") + kDtypes)
+    .Attr("tensor_name: string")
+    .Input("tensor: T")
+    .Output("output: T")
+    .SetShapeFn(AllgatherShape);
+REGISTER_KERNEL_BUILDER(Name("HvdTpuAllgather").Device(tensorflow::DEVICE_CPU),
+                        HvdTpuAllgatherOp);
+
+}  // namespace

@@ -37,6 +37,16 @@ _OP_ALLREDUCE, _OP_ALLGATHER, _OP_BROADCAST, _OP_ALLTOALL = 0, 1, 2, 3
 
 _build_lock = threading.Lock()
 _lib = None
+_lib_path: str | None = None
+
+
+def lib_path() -> str:
+    """Path of the engine library this process loaded (loading it first if
+    needed) — the TF custom-op module dlopens the same file so both share
+    one Engine."""
+    _load_lib()
+    assert _lib_path is not None
+    return _lib_path
 
 
 def _csrc_dir() -> str:
@@ -59,7 +69,7 @@ def _installed_so() -> str | None:
 
 
 def _load_lib():
-    global _lib
+    global _lib, _lib_path
     with _build_lock:
         if _lib is not None:
             return _lib
@@ -68,10 +78,12 @@ def _load_lib():
         override = os.environ.get("HOROVOD_TPU_NATIVE_LIB")
         if override:
             _lib = _bind(ctypes.CDLL(override))
+            _lib_path = override
             return _lib
         so = _installed_so()
         if so is not None:
             _lib = _bind(ctypes.CDLL(so))
+            _lib_path = so
             return _lib
         so = os.path.join(_csrc_dir(), "libhvdtpu.so")
         sources = [
@@ -104,6 +116,7 @@ def _load_lib():
                 finally:
                     fcntl.flock(lk, fcntl.LOCK_UN)
         _lib = _bind(ctypes.CDLL(so))
+        _lib_path = so
         return _lib
 
 

@@ -1,0 +1,94 @@
+"""Build + load the TF custom-op library (csrc/tf_ops.cc).
+
+Role analog of the reference's compiled ``mpi_lib.so`` load
+(`/root/reference/horovod/tensorflow/mpi_ops.py:33-59`) — except the
+reference builds its TF extension at pip-install time against whatever TF
+was present, while this builds lazily against the *running* TF (compile
+flags from ``tf.sysconfig``), caching one library per TF version so a TF
+upgrade can never load an ABI-mismatched kernel.
+
+Falls back to ``None`` (callers use the tf.py_function bridge) when TF or
+the toolchain is unavailable, or when ``HOROVOD_TPU_TF_NATIVE=0``.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+import warnings
+
+_lock = threading.Lock()
+_mod = None
+_tried = False
+
+
+def get_ops():
+    """The loaded custom-op module, or None if unavailable."""
+    global _mod, _tried
+    with _lock:
+        if _tried:
+            return _mod
+        _tried = True
+        if os.environ.get("HOROVOD_TPU_TF_NATIVE", "1").lower() in (
+                "0", "false", "no"):
+            return None
+        try:
+            _mod = _build_and_load()
+        except Exception as e:  # noqa: BLE001 — any failure means fallback
+            warnings.warn(
+                f"horovod_tpu: native TF ops unavailable ({e}); using the "
+                "tf.py_function bridge (works, but collectives run "
+                "serialized). Set HOROVOD_TPU_TF_NATIVE=0 to silence.",
+                RuntimeWarning,
+            )
+            _mod = None
+        return _mod
+
+
+def _build_and_load():
+    import tensorflow as tf
+
+    from horovod_tpu.runtime import native as _rt
+
+    src_dir = _rt._csrc_dir()
+    src = os.path.join(src_dir, "tf_ops.cc")
+    ver = tf.__version__.replace("/", "_")
+    if os.path.exists(src):
+        out_dir = src_dir
+    else:  # installed package without a source tree: ship next to __init__
+        out_dir = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        src = os.path.join(out_dir, "tf_ops.cc")
+    so = os.path.join(out_dir, f"libhvdtpu_tf-{ver}.so")
+
+    if not os.path.exists(so) or (
+            os.path.exists(src)
+            and os.path.getmtime(src) > os.path.getmtime(so)):
+        if not os.path.exists(src):
+            raise FileNotFoundError(f"{src} missing and {so} not prebuilt")
+        import fcntl
+
+        with open(os.path.join(out_dir, ".tfop.build.lock"), "w") as lk:
+            fcntl.flock(lk, fcntl.LOCK_EX)
+            try:
+                if not os.path.exists(so) or \
+                        os.path.getmtime(src) > os.path.getmtime(so):
+                    tmp = so + f".tmp.{os.getpid()}"
+                    cmd = (
+                        ["g++", "-shared", "-fPIC", "-O2", src, "-o", tmp]
+                        + tf.sysconfig.get_compile_flags()
+                        + tf.sysconfig.get_link_flags()
+                        + ["-ldl"]
+                    )
+                    r = subprocess.run(cmd, capture_output=True, text=True)
+                    if r.returncode != 0:
+                        raise RuntimeError(
+                            "tf_ops.cc build failed:\n" + r.stderr[-2000:])
+                    os.replace(tmp, so)  # atomic: no rank loads a half-link
+            finally:
+                fcntl.flock(lk, fcntl.LOCK_UN)
+
+    # the op kernels dlopen the exact engine library this process uses, so
+    # C++ kernels and the ctypes bridge drive one shared Engine
+    os.environ["HOROVOD_TPU_NATIVE_LIB"] = _rt.lib_path()
+    return tf.load_op_library(so)

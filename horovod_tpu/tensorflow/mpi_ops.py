@@ -57,10 +57,43 @@ def _normalize(name: str | None, tensor, prefix: str) -> str:
     return f"{prefix}_{re.sub(r'[^A-Za-z0-9_]', '_', str(name))}"
 
 
+# TF dtypes the engine wire speaks (csrc/common.h DType) — anything else
+# rides the py_function bridge, which converts through numpy
+_NATIVE_DTYPES = ("uint8", "int8", "int32", "int64", "float16", "bfloat16",
+                  "float32", "float64")
+
+
+def _uses_native_engine() -> bool:
+    try:
+        from horovod_tpu.runtime.native import NativeEngine
+
+        return isinstance(_state.engine(), NativeEngine)
+    except Exception:
+        return False
+
+
 def _run_collective(kind: str, tensor, name: str, root_rank: int = 0):
-    """Bridge one collective through the eager engine via py_function so it
-    works inside tf.function graphs as well as eagerly."""
+    """Bridge one collective through the eager engine.
+
+    Fast path: real C++ AsyncOpKernels (csrc/tf_ops.cc) that enqueue into
+    the engine and complete TF's async callback — collectives overlap and
+    fuse, and graphs containing them serialize. Fallback: tf.py_function
+    (one synchronous Python callout per collective)."""
     tf = _tf()
+
+    # the C++ kernels drive the shared native Engine; size-1 worlds run on
+    # the pure-Python SingleProcessEngine, which the kernels can't see
+    if tensor.dtype.name in _NATIVE_DTYPES and _uses_native_engine():
+        from horovod_tpu.tensorflow import _native
+
+        mod = _native.get_ops()
+        if mod is not None:
+            if kind == "allreduce":
+                return mod.hvd_tpu_allreduce(tensor, tensor_name=name)
+            if kind == "allgather":
+                return mod.hvd_tpu_allgather(tensor, tensor_name=name)
+            return mod.hvd_tpu_broadcast(tensor, tensor_name=name,
+                                         root_rank=root_rank)
 
     def _op(x):
         arr = x.numpy() if hasattr(x, "numpy") else np.asarray(x)

@@ -91,6 +91,11 @@ class build_py(_build_py):
         super().run()
         out = os.path.join(self.build_lib, "horovod_tpu")
         _build_native(out)
+        # the TF custom-op kernels compile lazily at runtime against the
+        # *running* TF's ABI (tensorflow/_native.py), so installs ship the
+        # source next to the package instead of a prebuilt .so
+        shutil.copy2(os.path.join(CSRC, "tf_ops.cc"),
+                     os.path.join(out, "tf_ops.cc"))
 
 
 setup(cmdclass={"build_py": build_py, "build_native": build_native})
