@@ -156,4 +156,10 @@ inline bool EnvFlag(const char* name) {
   return v && v[0] && strcmp(v, "0") != 0;
 }
 
+// True only when the knob is explicitly set to 0 (default-on features).
+inline bool EnvFlagIsZero(const char* name) {
+  const char* v = getenv(name);
+  return v && strcmp(v, "0") == 0;
+}
+
 }  // namespace hvdtpu

@@ -41,6 +41,7 @@
 #include "autotune.h"
 #include "common.h"
 #include "logging.h"
+#include "shm.h"
 #include "socket.h"
 #include "timeline.h"
 #include "wire.h"
@@ -294,6 +295,16 @@ class Engine {
   }
   Status TreeBroadcastGroup(char* buf, int64_t nbytes, int root,
                             const std::vector<int>& members);
+  // same-host shared-memory data plane (shm.h); falls back to the TCP
+  // peer sockets pair-by-pair when segments can't be set up
+  void SetupShm(const std::string& token);
+  Status PeerSendAll(int r, const void* data, size_t n);
+  Status PeerRecvAll(int r, void* data, size_t n);
+  Status PeerSendRecv(int r_send, const void* send_buf, size_t send_n,
+                      int r_recv, void* recv_buf, size_t recv_n);
+  Status PeerSendRecvReduce(int r_send, const void* send_buf, size_t send_n,
+                            int r_recv, char* dst, int64_t nelems,
+                            DType dtype);
   void MarkDone(int handle, Status st, std::vector<int64_t> dims,
                 std::vector<char> result);
   void FailAll(const Status& st);
@@ -367,6 +378,9 @@ class Engine {
   Socket coord_;                        // worker->coordinator (rank != 0)
   std::vector<Socket> workers_;         // coordinator->worker (rank 0)
   std::vector<Socket> peers_;           // data plane, by rank
+  // same-host fast path: one SPSC shm ring per direction per local peer
+  // (tx: this rank produces; rx: this rank consumes); null => TCP
+  std::vector<std::unique_ptr<ShmRing>> shm_tx_, shm_rx_;
   Listener data_listener_;
 
   std::mutex mu_;
@@ -445,6 +459,11 @@ Status Engine::Init(const std::string& host, int port, int rank, int size) {
   }
 
   std::vector<std::string> hashes(size_, my_hash);
+  std::string shm_token;  // job-unique, rank-0 generated, shipped in the table
+  // rank 0 decides and the table ships the decision: a per-rank env read
+  // would let divergent environments skip the flag handshake on one side
+  // and corrupt the peer byte stream
+  int shm_on = EnvFlagIsZero("HOROVOD_TPU_SHM") ? 0 : 1;
   if (size_ > 1) {
     // data-plane listener first, so peers can connect whenever they learn
     // our address
@@ -483,7 +502,15 @@ Status Engine::Init(const std::string& host, int port, int rank, int size) {
         hashes[r] = hash.empty() ? h : hash;
         workers_[r] = std::move(sock);
       }
+      // job-unique token namespacing the shm segments (several engines /
+      // jobs may share a host)
+      shm_token = std::to_string(getpid()) + "." +
+                  std::to_string(std::chrono::steady_clock::now()
+                                     .time_since_epoch()
+                                     .count() &
+                                 0xffffff);
       std::ostringstream table;
+      table << shm_token << " " << shm_on << " ";
       for (int i = 0; i < size_; i++)
         table << hosts[i] << " " << ports[i] << " " << hashes[i] << " ";
       for (int i = 1; i < size_; i++) {
@@ -505,6 +532,7 @@ Status Engine::Init(const std::string& host, int port, int rank, int size) {
       s = coord_.RecvFrame(&table);
       if (!s.ok()) return s;
       std::istringstream is(table);
+      is >> shm_token >> shm_on;
       for (int i = 0; i < size_; i++) is >> hosts[i] >> ports[i] >> hashes[i];
     }
 
@@ -568,6 +596,12 @@ Status Engine::Init(const std::string& host, int port, int rank, int size) {
                          << " local group size " << local_group_.size()
                          << ", hierarchical allreduce "
                          << (hierarchical_allreduce_ ? "on" : "off");
+  // same-host peers get a shared-memory data plane (loopback TCP moves
+  // every byte through the kernel twice; a mapped ring moves it at memcpy
+  // speed) — the eager analog of the reference's intra-node shared-memory
+  // staging (operations.cc:929-1033). Kill-switch: HOROVOD_TPU_SHM=0 on
+  // the launcher/rank 0 (the table ships the decision to every rank).
+  if (size_ > 1 && shm_on) SetupShm(shm_token);
   // the autotuner owns the hierarchical decision when the env didn't pin
   // it (reference parameter_manager.cc:42-43 categorical param)
   if (rank_ == 0)
@@ -1193,6 +1227,299 @@ void Engine::ExecuteAllreduce(const Response& resp,
 // allgather over the member ring — the classic bandwidth-optimal algorithm
 // (2(m-1)/m bytes per element on the wire), operating on the (possibly
 // fused) contiguous buffer.  members must be identical on every member.
+// ---------------------------------------------------------------------------
+// same-host shared-memory data plane
+// ---------------------------------------------------------------------------
+
+void Engine::SetupShm(const std::string& token) {
+  shm_tx_.resize(size_);
+  shm_rx_.resize(size_);
+  size_t ring_bytes = static_cast<size_t>(
+      EnvInt64("HOROVOD_TPU_SHM_RING_BYTES", 8 << 20));
+  auto ring_name = [&](int src, int dst) {
+    return "/hvdtpu_" + token + "_" + std::to_string(src) + "_" +
+           std::to_string(dst);
+  };
+  std::vector<int> local_peers;
+  for (int j : local_group_)
+    if (j != rank_) local_peers.push_back(j);
+  if (local_peers.empty()) return;
+
+  // Four flag passes over all peers (tiny sends never block, so the
+  // all-send-then-all-recv pattern is deadlock-free regardless of the
+  // order ranks reach their pairs):
+  //   1. create my tx ring per peer, send created-flag
+  //   2. recv peer's created-flag
+  //   3. attach peer's ring where created, send attached-flag
+  //   4. recv peer's attached-flag; keep tx only where the peer attached
+  std::map<int, uint8_t> created, peer_created, attached, peer_attached;
+  for (int j : local_peers) {
+    auto tx = std::make_unique<ShmRing>();
+    Status s = tx->Create(ring_name(rank_, j), ring_bytes);
+    created[j] = s.ok() ? 1 : 0;
+    if (s.ok()) {
+      shm_tx_[j] = std::move(tx);
+    } else {
+      LOG_RANK(Warning, rank_)
+          << "shm ring to rank " << j << " unavailable (" << s.message
+          << "); pair falls back to TCP";
+    }
+    if (!peers_[j].SendAll(&created[j], 1).ok()) created[j] = 0;
+  }
+  for (int j : local_peers) {
+    uint8_t f = 0;
+    if (!peers_[j].RecvAll(&f, 1).ok()) f = 0;
+    peer_created[j] = f;
+  }
+  for (int j : local_peers) {
+    uint8_t f = 0;
+    if (peer_created[j]) {
+      auto rx = std::make_unique<ShmRing>();
+      if (rx->Attach(ring_name(j, rank_)).ok()) {
+        shm_rx_[j] = std::move(rx);
+        f = 1;
+      }
+    }
+    attached[j] = f;
+    if (!peers_[j].SendAll(&f, 1).ok()) attached[j] = 0;
+  }
+  int active = 0;
+  for (int j : local_peers) {
+    uint8_t f = 0;
+    if (!peers_[j].RecvAll(&f, 1).ok()) f = 0;
+    peer_attached[j] = f;
+    if (!f) shm_tx_[j].reset();  // peer can't read it: direction is TCP
+    if (!attached[j]) shm_rx_[j].reset();
+    // both sides hold the mapping now (or the ring was dropped): drop the
+    // filesystem name so a SIGKILL'd job cannot leak /dev/shm segments
+    if (shm_tx_[j]) shm_tx_[j]->Unlink();
+    active += shm_tx_[j] != nullptr;
+  }
+  LOG_RANK(Debug, rank_) << "shm data plane: " << active << "/"
+                         << local_peers.size() << " same-host tx rings ("
+                         << (ring_bytes >> 20) << " MB each)";
+}
+
+namespace {
+// Backoff for the shm/TCP progress loops: stay hot briefly (ring partners
+// are usually mid-memcpy), then yield, then sleep with escalation — the
+// data plane must not pin a core while a peer negotiates its next
+// response or runs a long cross-host phase.
+struct Backoff {
+  int idle = 0;
+  void Progress() { idle = 0; }
+  void Wait() {
+    idle++;
+    if (idle < 64) return;                    // spin
+    if (idle < 512) {
+      std::this_thread::yield();
+      return;
+    }
+    // warm wait -> cold wait: a peer seconds away (e.g. the local root
+    // mid cross-host ring) should cost ~1k wakeups/s, not ~20k
+    std::this_thread::sleep_for(
+        std::chrono::microseconds(idle < 4096 ? 50 : 1000));
+  }
+};
+
+// Stall bound for the peer progress loops, counted from the LAST byte of
+// progress (a steadily-moving transfer never times out, however large).
+// 0 disables, matching Socket::SendAll's block-forever contract.
+double DataPlaneTimeoutS() {
+  static double t = static_cast<double>(
+      EnvInt64("HOROVOD_TPU_DATA_PLANE_TIMEOUT_SECS", 60));
+  return t;
+}
+
+bool TimedOut(std::chrono::steady_clock::time_point last_progress) {
+  double limit = DataPlaneTimeoutS();
+  if (limit <= 0) return false;
+  return std::chrono::duration<double>(std::chrono::steady_clock::now() -
+                                       last_progress)
+             .count() > limit;
+}
+}  // namespace
+
+Status Engine::PeerSendAll(int r, const void* data, size_t n) {
+  ShmRing* tx = r < static_cast<int>(shm_tx_.size()) ? shm_tx_[r].get()
+                                                     : nullptr;
+  if (!tx) return peers_[r].SendAll(data, n);
+  const char* p = static_cast<const char*>(data);
+  auto last_prog = std::chrono::steady_clock::now();
+  Backoff bo;
+  while (n > 0) {
+    size_t k = tx->TryPush(p, n);
+    if (k > 0) {
+      p += k;
+      n -= k;
+      bo.Progress();
+      last_prog = std::chrono::steady_clock::now();
+      continue;
+    }
+    bo.Wait();
+    if (TimedOut(last_prog))
+      return Status::Error("shm send made no progress inside the timeout");
+  }
+  return Status::OK();
+}
+
+Status Engine::PeerRecvAll(int r, void* data, size_t n) {
+  ShmRing* rx = r < static_cast<int>(shm_rx_.size()) ? shm_rx_[r].get()
+                                                     : nullptr;
+  if (!rx) return peers_[r].RecvAll(data, n);
+  char* p = static_cast<char*>(data);
+  auto last_prog = std::chrono::steady_clock::now();
+  Backoff bo;
+  while (n > 0) {
+    size_t k = rx->TryPop(p, n);
+    if (k > 0) {
+      p += k;
+      n -= k;
+      bo.Progress();
+      last_prog = std::chrono::steady_clock::now();
+      continue;
+    }
+    bo.Wait();
+    if (TimedOut(last_prog))
+      return Status::Error("shm recv made no progress inside the timeout");
+  }
+  return Status::OK();
+}
+
+Status Engine::PeerSendRecv(int r_send, const void* send_buf, size_t send_n,
+                            int r_recv, void* recv_buf, size_t recv_n) {
+  ShmRing* tx = r_send < static_cast<int>(shm_tx_.size())
+                    ? shm_tx_[r_send].get()
+                    : nullptr;
+  ShmRing* rx = r_recv < static_cast<int>(shm_rx_.size())
+                    ? shm_rx_[r_recv].get()
+                    : nullptr;
+  if (!tx && !rx)
+    return Socket::SendRecv(peers_[r_send], send_buf, send_n, peers_[r_recv],
+                            recv_buf, recv_n);
+  const char* sp = static_cast<const char*>(send_buf);
+  char* rp = static_cast<char*>(recv_buf);
+  size_t sleft = send_n, rleft = recv_n;
+  auto last_prog = std::chrono::steady_clock::now();
+  Backoff bo;
+  while (sleft > 0 || rleft > 0) {
+    bool prog = false;
+    if (sleft > 0) {
+      if (tx) {
+        size_t k = tx->TryPush(sp, sleft);
+        sp += k;
+        sleft -= k;
+        prog |= k > 0;
+      } else {
+        int k = peers_[r_send].SendSome(sp, sleft);
+        if (k < 0) return Status::Error("peer send failed");
+        sp += k;
+        sleft -= static_cast<size_t>(k);
+        prog |= k > 0;
+      }
+    }
+    if (rleft > 0) {
+      if (rx) {
+        size_t k = rx->TryPop(rp, rleft);
+        rp += k;
+        rleft -= k;
+        prog |= k > 0;
+      } else {
+        int k = peers_[r_recv].RecvSome(rp, rleft);
+        if (k < 0) return Status::Error("peer recv failed or closed");
+        rp += k;
+        rleft -= static_cast<size_t>(k);
+        prog |= k > 0;
+      }
+    }
+    if (prog) {
+      bo.Progress();
+      last_prog = std::chrono::steady_clock::now();
+      continue;
+    }
+    bo.Wait();
+    if (TimedOut(last_prog))
+      return Status::Error("peer send_recv made no progress inside the timeout");
+  }
+  return Status::OK();
+}
+
+// Reduce-scatter step with the accumulate fused into the receive: when the
+// peer is reachable over shm, pops arrive in cache-sized bites that are
+// added straight into dst — the full-chunk staging write+read disappears.
+// TCP receive sides keep the stage-then-accumulate shape.
+Status Engine::PeerSendRecvReduce(int r_send, const void* send_buf,
+                                  size_t send_n, int r_recv, char* dst,
+                                  int64_t nelems, DType dtype) {
+  size_t esize = DTypeSize(dtype);
+  ShmRing* rx = r_recv < static_cast<int>(shm_rx_.size())
+                    ? shm_rx_[r_recv].get()
+                    : nullptr;
+  if (!rx) {
+    size_t rn = static_cast<size_t>(nelems) * esize;
+    if (ring_scratch_.size() < rn) ring_scratch_.resize(rn);
+    Status st = PeerSendRecv(r_send, send_buf, send_n, r_recv,
+                             ring_scratch_.data(), rn);
+    if (!st.ok()) return st;
+    Accumulate(dst, ring_scratch_.data(), nelems, dtype);
+    return Status::OK();
+  }
+  ShmRing* tx = r_send < static_cast<int>(shm_tx_.size())
+                    ? shm_tx_[r_send].get()
+                    : nullptr;
+  constexpr size_t kBite = 1 << 20;
+  if (ring_scratch_.size() < kBite + 8) ring_scratch_.resize(kBite + 8);
+  char* scratch = ring_scratch_.data();
+  const char* sp = static_cast<const char*>(send_buf);
+  size_t sleft = send_n;
+  size_t rleft = static_cast<size_t>(nelems) * esize;
+  size_t carry = 0;       // partial-element bytes awaiting their tail
+  int64_t done_el = 0;    // elements already accumulated into dst
+  auto last_prog = std::chrono::steady_clock::now();
+  Backoff bo;
+  while (sleft > 0 || rleft > 0) {
+    bool prog = false;
+    if (sleft > 0) {
+      if (tx) {
+        size_t k = tx->TryPush(sp, sleft);
+        sp += k;
+        sleft -= k;
+        prog |= k > 0;
+      } else {
+        int k = peers_[r_send].SendSome(sp, sleft);
+        if (k < 0) return Status::Error("peer send failed");
+        sp += k;
+        sleft -= static_cast<size_t>(k);
+        prog |= k > 0;
+      }
+    }
+    if (rleft > 0) {
+      size_t want = kBite - carry < rleft ? kBite - carry : rleft;
+      size_t k = rx->TryPop(scratch + carry, want);
+      if (k > 0) {
+        rleft -= k;
+        size_t have = carry + k;
+        int64_t whole = static_cast<int64_t>(have / esize);
+        Accumulate(dst + done_el * esize, scratch, whole, dtype);
+        done_el += whole;
+        carry = have - static_cast<size_t>(whole) * esize;
+        if (carry) std::memmove(scratch, scratch + whole * esize, carry);
+        prog = true;
+      }
+    }
+    if (prog) {
+      bo.Progress();
+      last_prog = std::chrono::steady_clock::now();
+      continue;
+    }
+    bo.Wait();
+    if (TimedOut(last_prog))
+      return Status::Error(
+          "shm send_recv_reduce made no progress inside the timeout");
+  }
+  return Status::OK();
+}
+
 Status Engine::RingAllreduceGroup(char* buf, int64_t nelems, DType dtype,
                                   const std::vector<int>& members) {
   int m = static_cast<int>(members.size());
@@ -1201,32 +1528,27 @@ Status Engine::RingAllreduceGroup(char* buf, int64_t nelems, DType dtype,
       std::find(members.begin(), members.end(), rank_) - members.begin());
   if (me == m) return Status::Error("rank not in ring group");
   size_t esize = DTypeSize(dtype);
-  Socket& right = peers_[members[(me + 1) % m]];
-  Socket& left = peers_[members[(me + m - 1) % m]];
+  int right = members[(me + 1) % m];
+  int left = members[(me + m - 1) % m];
   auto chunk_lo = [&](int c) { return nelems * c / m; };
-  size_t scratch = static_cast<size_t>(
-      (nelems / m + 1) * static_cast<int64_t>(esize));
-  if (ring_scratch_.size() < scratch) ring_scratch_.resize(scratch);
-  char* tmp = ring_scratch_.data();
 
   for (int step = 0; step < m - 1; step++) {
     int send_c = (me - step + 2 * m) % m;
     int recv_c = (me - step - 1 + 2 * m) % m;
     int64_t s_lo = chunk_lo(send_c), s_hi = chunk_lo(send_c + 1);
     int64_t r_lo = chunk_lo(recv_c), r_hi = chunk_lo(recv_c + 1);
-    Status st = Socket::SendRecv(
+    Status st = PeerSendRecvReduce(
         right, buf + s_lo * esize, (s_hi - s_lo) * esize,
-        left, tmp, (r_hi - r_lo) * esize);
+        left, buf + r_lo * esize, r_hi - r_lo, dtype);
     if (!st.ok())
       return Status::Error("ring allreduce failed: " + st.message);
-    Accumulate(buf + r_lo * esize, tmp, r_hi - r_lo, dtype);
   }
   for (int step = 0; step < m - 1; step++) {
     int send_c = (me + 1 - step + 2 * m) % m;
     int recv_c = (me - step + 2 * m) % m;
     int64_t s_lo = chunk_lo(send_c), s_hi = chunk_lo(send_c + 1);
     int64_t r_lo = chunk_lo(recv_c), r_hi = chunk_lo(recv_c + 1);
-    Status st = Socket::SendRecv(
+    Status st = PeerSendRecv(
         right, buf + s_lo * esize, (s_hi - s_lo) * esize,
         left, buf + r_lo * esize, (r_hi - r_lo) * esize);
     if (!st.ok())
@@ -1268,12 +1590,12 @@ Status Engine::RingAllgatherGroup(const std::vector<int>& members,
   if (me == m) return Status::Error("rank not in allgather group");
   std::vector<size_t> off(m + 1, 0);
   for (int i = 0; i < m; i++) off[i + 1] = off[i] + member_bytes[i];
-  Socket& right = peers_[members[(me + 1) % m]];
-  Socket& left = peers_[members[(me + m - 1) % m]];
+  int right = members[(me + 1) % m];
+  int left = members[(me + m - 1) % m];
   for (int step = 0; step < m - 1; step++) {
     int send_b = (me - step + 2 * m) % m;
     int recv_b = (me - step - 1 + 2 * m) % m;
-    Status st = Socket::SendRecv(
+    Status st = PeerSendRecv(
         right, concat + off[send_b], member_bytes[send_b],
         left, concat + off[recv_b], member_bytes[recv_b]);
     if (!st.ok())
@@ -1407,7 +1729,7 @@ Status Engine::TreeBroadcastGroup(char* buf, int64_t nbytes, int root,
   while (mask < m) {
     if (vrank & mask) {
       int parent = members[((vrank ^ mask) + ri) % m];
-      Status st = peers_[parent].RecvAll(buf, static_cast<size_t>(nbytes));
+      Status st = PeerRecvAll(parent, buf, static_cast<size_t>(nbytes));
       if (!st.ok()) return st;
       break;
     }
@@ -1419,7 +1741,7 @@ Status Engine::TreeBroadcastGroup(char* buf, int64_t nbytes, int root,
     int child_v = vrank | mask;
     if (child_v < m) {
       int child = members[(child_v + ri) % m];
-      Status st = peers_[child].SendAll(buf, static_cast<size_t>(nbytes));
+      Status st = PeerSendAll(child, buf, static_cast<size_t>(nbytes));
       if (!st.ok()) return st;
     }
   }
@@ -1471,9 +1793,9 @@ void Engine::ExecuteAlltoall(const Response& resp, TensorEntry& entry) {
   for (int step = 1; step < size_; step++) {
     int to = (rank_ + step) % size_;
     int from = (rank_ - step + size_) % size_;
-    Status st = Socket::SendRecv(
-        peers_[to], entry.data.data() + to * blk, static_cast<size_t>(blk),
-        peers_[from], out.data() + recv_off[from] * esize,
+    Status st = PeerSendRecv(
+        to, entry.data.data() + to * blk, static_cast<size_t>(blk),
+        from, out.data() + recv_off[from] * esize,
         static_cast<size_t>(recv_rows[from] * stride) * esize);
     if (!st.ok()) {
       Status err = Status::Error("alltoall failed: " + st.message);

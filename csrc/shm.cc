@@ -1,0 +1,125 @@
+#include "shm.h"
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <cstring>
+#include <new>
+
+namespace hvdtpu {
+
+namespace {
+Status Errno(const std::string& what) {
+  return Status::Error(what + ": " + strerror(errno));
+}
+}  // namespace
+
+Status ShmRing::Create(const std::string& name, size_t capacity) {
+  Close();
+  shm_unlink(name.c_str());  // clear a stale segment from a crashed run
+  int fd = shm_open(name.c_str(), O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) return Errno("shm_open(create " + name + ")");
+  size_t len = sizeof(ShmRingHdr) + capacity;
+  if (ftruncate(fd, static_cast<off_t>(len)) != 0) {
+    Status s = Errno("ftruncate(" + name + ")");
+    close(fd);
+    shm_unlink(name.c_str());
+    return s;
+  }
+  void* p = mmap(nullptr, len, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (p == MAP_FAILED) {
+    shm_unlink(name.c_str());
+    return Errno("mmap(" + name + ")");
+  }
+  hdr_ = new (p) ShmRingHdr();
+  hdr_->head.store(0, std::memory_order_relaxed);
+  hdr_->tail.store(0, std::memory_order_relaxed);
+  hdr_->capacity = capacity;
+  data_ = static_cast<char*>(p) + sizeof(ShmRingHdr);
+  map_len_ = len;
+  name_ = name;
+  owner_ = true;
+  return Status::OK();
+}
+
+Status ShmRing::Attach(const std::string& name) {
+  Close();
+  int fd = shm_open(name.c_str(), O_RDWR, 0600);
+  if (fd < 0) return Errno("shm_open(attach " + name + ")");
+  struct stat st;
+  if (fstat(fd, &st) != 0 ||
+      st.st_size < static_cast<off_t>(sizeof(ShmRingHdr))) {
+    close(fd);
+    return Status::Error("shm segment " + name + " too small");
+  }
+  size_t len = static_cast<size_t>(st.st_size);
+  void* p = mmap(nullptr, len, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (p == MAP_FAILED) return Errno("mmap(" + name + ")");
+  hdr_ = static_cast<ShmRingHdr*>(p);
+  if (hdr_->capacity != len - sizeof(ShmRingHdr)) {
+    munmap(p, len);
+    hdr_ = nullptr;
+    return Status::Error("shm segment " + name + " capacity mismatch");
+  }
+  data_ = static_cast<char*>(p) + sizeof(ShmRingHdr);
+  map_len_ = len;
+  name_ = name;
+  owner_ = false;
+  return Status::OK();
+}
+
+void ShmRing::Unlink() {
+  if (hdr_ && owner_) {
+    shm_unlink(name_.c_str());
+    owner_ = false;
+  }
+}
+
+void ShmRing::Close() {
+  if (hdr_) {
+    if (owner_) shm_unlink(name_.c_str());
+    munmap(hdr_, map_len_);
+  }
+  hdr_ = nullptr;
+  data_ = nullptr;
+  map_len_ = 0;
+  owner_ = false;
+}
+
+size_t ShmRing::TryPush(const void* buf, size_t n) {
+  uint64_t head = hdr_->head.load(std::memory_order_relaxed);
+  uint64_t tail = hdr_->tail.load(std::memory_order_acquire);
+  size_t cap = hdr_->capacity;
+  size_t free_b = cap - static_cast<size_t>(head - tail);
+  size_t k = n < free_b ? n : free_b;
+  if (k == 0) return 0;
+  size_t pos = static_cast<size_t>(head % cap);
+  size_t first = k < cap - pos ? k : cap - pos;
+  std::memcpy(data_ + pos, buf, first);
+  if (k > first)
+    std::memcpy(data_, static_cast<const char*>(buf) + first, k - first);
+  hdr_->head.store(head + k, std::memory_order_release);
+  return k;
+}
+
+size_t ShmRing::TryPop(void* buf, size_t n) {
+  uint64_t tail = hdr_->tail.load(std::memory_order_relaxed);
+  uint64_t head = hdr_->head.load(std::memory_order_acquire);
+  size_t avail = static_cast<size_t>(head - tail);
+  size_t k = n < avail ? n : avail;
+  if (k == 0) return 0;
+  size_t cap = hdr_->capacity;
+  size_t pos = static_cast<size_t>(tail % cap);
+  size_t first = k < cap - pos ? k : cap - pos;
+  std::memcpy(buf, data_ + pos, first);
+  if (k > first)
+    std::memcpy(static_cast<char*>(buf) + first, data_, k - first);
+  hdr_->tail.store(tail + k, std::memory_order_release);
+  return k;
+}
+
+}  // namespace hvdtpu

@@ -1,0 +1,63 @@
+// Same-host shared-memory data plane: one SPSC byte ring per directed
+// peer pair, layered under the TCP mesh.
+//
+// Role analog of the reference's intra-node shared-memory path — the MPI
+// shared-memory window its hierarchical allgather stages through
+// (/root/reference/horovod/common/operations.cc:929-1033) and the shm BTL
+// MPI itself uses for same-host ranks.  Loopback TCP moves every byte
+// through the kernel twice and collapses under full-duplex load; a mapped
+// ring moves it producer->ring->consumer at memcpy speed.
+
+#pragma once
+
+#include <atomic>
+#include <cstddef>
+#include <cstdint>
+#include <string>
+
+#include "common.h"
+
+namespace hvdtpu {
+
+struct ShmRingHdr {
+  std::atomic<uint64_t> head;  // producer-advanced, monotonic byte count
+  char pad0[64 - sizeof(std::atomic<uint64_t>)];
+  std::atomic<uint64_t> tail;  // consumer-advanced, monotonic byte count
+  char pad1[64 - sizeof(std::atomic<uint64_t>)];
+  uint64_t capacity;
+};
+
+// Single-producer single-consumer byte ring in a POSIX shm segment.
+// Producer calls Create + TryPush; consumer calls Attach + TryPop.  Both
+// sides make progress without syscalls; blocking/backoff lives in the
+// engine's progress loops.
+class ShmRing {
+ public:
+  ShmRing() = default;
+  ~ShmRing() { Close(); }
+  ShmRing(const ShmRing&) = delete;
+  ShmRing& operator=(const ShmRing&) = delete;
+
+  Status Create(const std::string& name, size_t capacity);  // producer side
+  Status Attach(const std::string& name);                   // consumer side
+  // Drop the filesystem name while keeping the mapping: once both sides
+  // are attached the name serves no purpose, and an unlinked segment
+  // cannot leak past process death (SIGKILL'd jobs included).
+  void Unlink();
+  void Close();
+
+  // Copy up to n bytes in/out; returns bytes moved (0 = ring full/empty).
+  size_t TryPush(const void* buf, size_t n);
+  size_t TryPop(void* buf, size_t n);
+
+  bool valid() const { return hdr_ != nullptr; }
+
+ private:
+  ShmRingHdr* hdr_ = nullptr;
+  char* data_ = nullptr;
+  size_t map_len_ = 0;
+  std::string name_;
+  bool owner_ = false;
+};
+
+}  // namespace hvdtpu

@@ -82,6 +82,27 @@ Status Socket::RecvAll(void* data, size_t n) {
   return Status::OK();
 }
 
+int Socket::SendSome(const void* data, size_t n) {
+  while (true) {
+    ssize_t k = ::send(fd_, data, n, MSG_NOSIGNAL | MSG_DONTWAIT);
+    if (k >= 0) return static_cast<int>(k);
+    if (errno == EINTR) continue;
+    if (errno == EAGAIN || errno == EWOULDBLOCK) return 0;
+    return -1;
+  }
+}
+
+int Socket::RecvSome(void* data, size_t n) {
+  while (true) {
+    ssize_t k = ::recv(fd_, data, n, MSG_DONTWAIT);
+    if (k > 0) return static_cast<int>(k);
+    if (k == 0) return -1;  // EOF mid-transfer is an error on the data plane
+    if (errno == EINTR) continue;
+    if (errno == EAGAIN || errno == EWOULDBLOCK) return 0;
+    return -1;
+  }
+}
+
 Status Socket::SendRecv(Socket& send_sock, const void* send_buf, size_t send_n,
                         Socket& recv_sock, void* recv_buf, size_t recv_n) {
   const char* sp = static_cast<const char*>(send_buf);

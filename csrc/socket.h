@@ -36,6 +36,13 @@ class Socket {
   static Status SendRecv(Socket& send_sock, const void* send_buf, size_t send_n,
                          Socket& recv_sock, void* recv_buf, size_t recv_n);
 
+  // Nonblocking partial transfers for the engine's mixed shm/TCP progress
+  // loops: bytes moved, 0 when the kernel would block, -1 on error (for
+  // RecvSome also on orderly peer close — the data plane never expects EOF
+  // mid-transfer).
+  int SendSome(const void* data, size_t n);
+  int RecvSome(void* data, size_t n);
+
   // Length-prefixed frames.
   Status SendFrame(const std::string& payload);
   Status RecvFrame(std::string* payload);

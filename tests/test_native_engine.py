@@ -248,3 +248,23 @@ def test_worker_crash_kills_world():
     # launcher must propagate the failing exit code and kill the sleepers
     assert res.returncode == 3, (res.returncode, res.stderr)
     assert time.monotonic() - t0 < 25, "launcher failed to kill surviving workers"
+
+
+def test_shm_data_plane_active_and_optional():
+    """Same-host peers ride the shared-memory rings (csrc/shm.cc) — the
+    eager analog of the reference's intra-node shared-memory staging
+    (operations.cc:929-1033).  Asserts the rings actually engage (debug
+    log), that results stay correct, and that HOROVOD_TPU_SHM=0 falls the
+    pair back to TCP."""
+    res = _run("collectives", 2, env={"HOROVOD_TPU_LOG_LEVEL": "debug"})
+    assert res.returncode == 0, res.stderr + res.stdout
+    assert "shm data plane: 1/1 same-host tx rings" in res.stderr, res.stderr
+    for r in range(2):
+        assert f"rank {r}: collectives OK" in res.stdout
+
+    res_off = _run("collectives", 2, env={
+        "HOROVOD_TPU_LOG_LEVEL": "debug", "HOROVOD_TPU_SHM": "0"})
+    assert res_off.returncode == 0, res_off.stderr + res_off.stdout
+    assert "shm data plane" not in res_off.stderr
+    for r in range(2):
+        assert f"rank {r}: collectives OK" in res_off.stdout
