@@ -36,6 +36,8 @@
 #include <unordered_map>
 #include <vector>
 
+#include <fcntl.h>
+#include <poll.h>
 #include <unistd.h>
 
 #include "autotune.h"
@@ -236,6 +238,13 @@ struct HandleState {
 
 class Engine {
  public:
+  // pipe fds close at destruction, not Shutdown: a late Enqueue's Wake()
+  // may race Shutdown, and writing to a drained-but-open pipe is harmless
+  // while writing to a closed (possibly reused) fd is not
+  ~Engine() {
+    for (int fd : wake_pipe_)
+      if (fd >= 0) close(fd);
+  }
   Status Init(const std::string& host, int port, int rank, int size);
   void Shutdown();
 
@@ -269,6 +278,8 @@ class Engine {
 
  private:
   void BackgroundLoop();
+  void WaitForWork(std::chrono::microseconds max_wait);
+  void Wake();
   void CoordinatorTick(RequestList& local, ResponseList* out);
   void HandleArrivedRequests(const RequestList& list, ResponseList* out);
   void FuseReady(ResponseList* out);
@@ -382,6 +393,9 @@ class Engine {
   // (tx: this rank produces; rx: this rank consumes); null => TCP
   std::vector<std::unique_ptr<ShmRing>> shm_tx_, shm_rx_;
   Listener data_listener_;
+  // self-pipe waking the background thread the moment work arrives, so
+  // the cycle time is a maximum batching window, not a fixed latency tax
+  int wake_pipe_[2] = {-1, -1};
 
   std::mutex mu_;
   std::condition_variable cv_;
@@ -609,9 +623,60 @@ Status Engine::Init(const std::string& host, int port, int rank, int size) {
                    /*tune_hierarchical=*/dflt && !(ha && ha[0]),
                    hierarchical_allreduce_);
 
+  if (pipe2(wake_pipe_, O_NONBLOCK | O_CLOEXEC) != 0) {
+    wake_pipe_[0] = wake_pipe_[1] = -1;  // degrade to pure cycle ticks
+  }
   running_ = true;
   bg_ = std::thread(&Engine::BackgroundLoop, this);
   return Status::OK();
+}
+
+// Wake the background thread immediately (submission/shutdown path).  A
+// full pipe means a wake is already pending — exactly what we need.
+void Engine::Wake() {
+  if (wake_pipe_[1] >= 0) {
+    char b = 1;
+    ssize_t r = write(wake_pipe_[1], &b, 1);
+    (void)r;
+  }
+}
+
+// End-of-cycle wait: sleep until the cycle budget expires OR work arrives —
+// a local enqueue (self-pipe) or a control-plane frame (coordinator: any
+// worker socket; worker: the coordinator socket).  After a wake, a short
+// burst window lets the rest of a gradient burst arrive so the coordinator
+// still sees fusable batches (the reference gets this batching from its
+// fixed 5 ms sleep, operations.cc:2030; here the 5 ms is only the maximum).
+void Engine::WaitForWork(std::chrono::microseconds max_wait) {
+  if (wake_pipe_[0] < 0) {
+    std::this_thread::sleep_for(max_wait);
+    return;
+  }
+  std::vector<struct pollfd> pfds;
+  pfds.push_back({wake_pipe_[0], POLLIN, 0});
+  if (rank_ == 0) {
+    for (auto& w : workers_)
+      if (w.valid()) pfds.push_back({w.fd(), POLLIN, 0});
+  } else if (coord_.valid()) {
+    pfds.push_back({coord_.fd(), POLLIN, 0});
+  }
+  int ms = static_cast<int>(max_wait.count() / 1000);
+  if (ms == 0) {
+    std::this_thread::sleep_for(max_wait);  // sub-ms remainder: just sleep
+    return;
+  }
+  int rc = ::poll(pfds.data(), static_cast<nfds_t>(pfds.size()), ms);
+  if (rc <= 0) return;  // timeout/EINTR: run the tick
+  if (pfds[0].revents & POLLIN) {
+    char buf[256];
+    while (read(wake_pipe_[0], buf, sizeof buf) > 0) {
+    }
+  }
+  static const int64_t burst_us =
+      EnvInt64("HOROVOD_TPU_BURST_WINDOW_US", 1000);
+  if (burst_us > 0)
+    std::this_thread::sleep_for(std::chrono::microseconds(
+        std::min<int64_t>(burst_us, max_wait.count())));
 }
 
 void Engine::Shutdown() {
@@ -619,6 +684,7 @@ void Engine::Shutdown() {
     std::lock_guard<std::mutex> lk(mu_);
     shutdown_requested_ = true;
   }
+  Wake();
   // Always join, even when the loop already stopped on its own (a peer's
   // shutdown propagated and set running_ = false): skipping the join there
   // would leave bg_ joinable and its destruction at process exit would
@@ -681,6 +747,7 @@ int Engine::Enqueue(OpType op, const std::string& name, DType dtype,
   e.inplace = inplace;
   queue_.push_back(e.req);
   tensor_table_.emplace(name, std::move(e));
+  Wake();
   return handle;
 }
 
@@ -856,7 +923,9 @@ void Engine::BackgroundLoop() {
     if (!stop) {
       auto elapsed = std::chrono::steady_clock::now() - cycle_start;
       auto budget = std::chrono::microseconds(cycle_us_);
-      if (elapsed < budget) std::this_thread::sleep_for(budget - elapsed);
+      if (elapsed < budget)
+        WaitForWork(std::chrono::duration_cast<std::chrono::microseconds>(
+            budget - elapsed));
     }
     if (rank_ == 0 && pm_.active()) {
       double secs = std::chrono::duration<double>(
@@ -1234,8 +1303,12 @@ void Engine::ExecuteAllreduce(const Response& resp,
 void Engine::SetupShm(const std::string& token) {
   shm_tx_.resize(size_);
   shm_rx_.resize(size_);
-  size_t ring_bytes = static_cast<size_t>(
-      EnvInt64("HOROVOD_TPU_SHM_RING_BYTES", 8 << 20));
+  int64_t rb = EnvInt64("HOROVOD_TPU_SHM_RING_BYTES", 8 << 20);
+  // clamp: 0 would stall every transfer, a negative value would overflow
+  // the segment-length arithmetic into out-of-bounds ring writes
+  if (rb < (64 << 10)) rb = 64 << 10;
+  if (rb > (1 << 30)) rb = 1 << 30;
+  size_t ring_bytes = static_cast<size_t>(rb);
   auto ring_name = [&](int src, int dst) {
     return "/hvdtpu_" + token + "_" + std::to_string(src) + "_" +
            std::to_string(dst);
@@ -1252,7 +1325,7 @@ void Engine::SetupShm(const std::string& token) {
   //   2. recv peer's created-flag
   //   3. attach peer's ring where created, send attached-flag
   //   4. recv peer's attached-flag; keep tx only where the peer attached
-  std::map<int, uint8_t> created, peer_created, attached, peer_attached;
+  std::map<int, uint8_t> created, peer_created, attached;
   for (int j : local_peers) {
     auto tx = std::make_unique<ShmRing>();
     Status s = tx->Create(ring_name(rank_, j), ring_bytes);
@@ -1285,9 +1358,8 @@ void Engine::SetupShm(const std::string& token) {
   }
   int active = 0;
   for (int j : local_peers) {
-    uint8_t f = 0;
+    uint8_t f = 0;  // peer's attached-flag for my ring
     if (!peers_[j].RecvAll(&f, 1).ok()) f = 0;
-    peer_attached[j] = f;
     if (!f) shm_tx_[j].reset();  // peer can't read it: direction is TCP
     if (!attached[j]) shm_rx_[j].reset();
     // both sides hold the mapping now (or the ring was dropped): drop the
@@ -1322,17 +1394,28 @@ struct Backoff {
   }
 };
 
-// Stall bound for the peer progress loops, counted from the LAST byte of
+// Stall bounds for the peer progress loops, counted from the LAST byte of
 // progress (a steadily-moving transfer never times out, however large).
-// 0 disables, matching Socket::SendAll's block-forever contract.
-double DataPlaneTimeoutS() {
-  static double t = static_cast<double>(
-      EnvInt64("HOROVOD_TPU_DATA_PLANE_TIMEOUT_SECS", 60));
+// 0 disables.  Two defaults preserve the TCP contracts each path replaces:
+// duplex loops inherit Socket::SendRecv's 60 s poll bound; unidirectional
+// waits inherit SendAll/RecvAll's block-forever (a tree-broadcast child
+// legitimately idles while its local root runs a long cross-host phase).
+struct DataPlaneTimeouts {
+  double duplex;
+  double oneway;
+};
+const DataPlaneTimeouts& Timeouts() {
+  static DataPlaneTimeouts t = {
+      static_cast<double>(
+          EnvInt64("HOROVOD_TPU_DATA_PLANE_TIMEOUT_SECS", 60)),
+      static_cast<double>(
+          EnvInt64("HOROVOD_TPU_DATA_PLANE_TIMEOUT_SECS", 0)),
+  };
   return t;
 }
 
-bool TimedOut(std::chrono::steady_clock::time_point last_progress) {
-  double limit = DataPlaneTimeoutS();
+bool Stalled(std::chrono::steady_clock::time_point last_progress,
+             double limit) {
   if (limit <= 0) return false;
   return std::chrono::duration<double>(std::chrono::steady_clock::now() -
                                        last_progress)
@@ -1357,7 +1440,7 @@ Status Engine::PeerSendAll(int r, const void* data, size_t n) {
       continue;
     }
     bo.Wait();
-    if (TimedOut(last_prog))
+    if (Stalled(last_prog, Timeouts().oneway))
       return Status::Error("shm send made no progress inside the timeout");
   }
   return Status::OK();
@@ -1380,7 +1463,7 @@ Status Engine::PeerRecvAll(int r, void* data, size_t n) {
       continue;
     }
     bo.Wait();
-    if (TimedOut(last_prog))
+    if (Stalled(last_prog, Timeouts().oneway))
       return Status::Error("shm recv made no progress inside the timeout");
   }
   return Status::OK();
@@ -1438,7 +1521,7 @@ Status Engine::PeerSendRecv(int r_send, const void* send_buf, size_t send_n,
       continue;
     }
     bo.Wait();
-    if (TimedOut(last_prog))
+    if (Stalled(last_prog, Timeouts().duplex))
       return Status::Error("peer send_recv made no progress inside the timeout");
   }
   return Status::OK();
@@ -1513,7 +1596,7 @@ Status Engine::PeerSendRecvReduce(int r_send, const void* send_buf,
       continue;
     }
     bo.Wait();
-    if (TimedOut(last_prog))
+    if (Stalled(last_prog, Timeouts().duplex))
       return Status::Error(
           "shm send_recv_reduce made no progress inside the timeout");
   }

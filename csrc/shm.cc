@@ -28,6 +28,17 @@ Status ShmRing::Create(const std::string& name, size_t capacity) {
     shm_unlink(name.c_str());
     return s;
   }
+  // ftruncate leaves the segment sparse: an over-committed /dev/shm (64 MB
+  // Docker default) would pass every Create and SIGBUS mid-collective.
+  // Materialize the pages now so ENOSPC surfaces here and the pair falls
+  // back to TCP instead.
+  int rc = posix_fallocate(fd, 0, static_cast<off_t>(len));
+  if (rc != 0) {
+    close(fd);
+    shm_unlink(name.c_str());
+    return Status::Error("posix_fallocate(" + name + "): " + strerror(rc) +
+                         " (is /dev/shm large enough for the rings?)");
+  }
   void* p = mmap(nullptr, len, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
   close(fd);
   if (p == MAP_FAILED) {
