@@ -1405,11 +1405,13 @@ struct DataPlaneTimeouts {
   double oneway;
 };
 const DataPlaneTimeouts& Timeouts() {
+  // separate knobs: overriding the duplex bound must not silently
+  // re-impose a timeout on the deliberately-unbounded one-way waits
   static DataPlaneTimeouts t = {
       static_cast<double>(
           EnvInt64("HOROVOD_TPU_DATA_PLANE_TIMEOUT_SECS", 60)),
       static_cast<double>(
-          EnvInt64("HOROVOD_TPU_DATA_PLANE_TIMEOUT_SECS", 0)),
+          EnvInt64("HOROVOD_TPU_DATA_PLANE_ONEWAY_TIMEOUT_SECS", 0)),
   };
   return t;
 }

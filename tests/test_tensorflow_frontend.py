@@ -218,3 +218,48 @@ def test_tf_native_kernels_multiprocess():
         assert r["gathered_rows"] == 3          # 1 + 2 rows
         assert r["gathered_sum"] == pytest.approx(1 * 2 * 1.0 + 2 * 2 * 2.0)
         assert r["error"] == "op_error"
+
+
+def _tf_savedmodel_worker_fn():
+    """Graphs containing the native collective kernels serialize to
+    SavedModel and reload — impossible with the py_function bridge (its
+    EagerPyFunc captures a process-local Python callable)."""
+    import tempfile
+
+    import tensorflow as tf
+
+    import horovod_tpu.tensorflow as hvd
+    from horovod_tpu.tensorflow import mpi_ops
+
+    hvd.init()
+    try:
+        assert mpi_ops._uses_native_engine()
+
+        class Averager(tf.Module):
+            @tf.function(input_signature=[
+                tf.TensorSpec([3], tf.float32)])
+            def __call__(self, x):
+                return mpi_ops._allreduce(x, name="saved_allreduce")
+
+        m = Averager()
+        x = tf.constant([1.0, 2.0, 3.0]) * (hvd.rank() + 1)
+        before = m(x).numpy()
+
+        with tempfile.TemporaryDirectory() as d:
+            tf.saved_model.save(m, d)
+            m2 = tf.saved_model.load(d)
+            after = m2(x).numpy()
+        return {"rank": hvd.rank(), "before": before.tolist(),
+                "after": after.tolist()}
+    finally:
+        hvd.shutdown()
+
+
+def test_tf_native_ops_serialize_to_savedmodel():
+    from horovod_tpu.spark import run_local
+
+    res = run_local(_tf_savedmodel_worker_fn, num_proc=2, start_timeout=300)
+    for r in res:
+        # sum over ranks of [1,2,3]*(rank+1) = [3,6,9]
+        assert r["before"] == pytest.approx([3.0, 6.0, 9.0])
+        assert r["after"] == pytest.approx([3.0, 6.0, 9.0])
