@@ -307,8 +307,46 @@ def _state_signature(tree) -> str:
     return f"{treedef}|" + ";".join(_sig(l) for l in leaves)
 
 
+def DistributedOptimizer(optimizer, name=None, device_dense="",
+                         device_sparse="", compression=None,
+                         sparse_as_dense=False):
+    """Wrap a standalone-keras (keras 3) optimizer so every gradient is
+    averaged across ranks before it is applied — signature parity with the
+    reference's ``horovod.keras.DistributedOptimizer``
+    (``/root/reference/horovod/keras/__init__.py:32-59``).
+
+    Keras 3 shares one optimizer class hierarchy with ``tf.keras``, so this
+    delegates to the tf.keras wrapper (subclasses the optimizer at its
+    ``apply()`` funnel).  For the JAX-native training loop use
+    :func:`create_distributed_optimizer` / :class:`Trainer` instead.
+    """
+    from horovod_tpu.compression import Compression as _C
+    from horovod_tpu.tensorflow.keras import (
+        DistributedOptimizer as _tfk_distributed_optimizer,
+    )
+
+    return _tfk_distributed_optimizer(
+        optimizer, name=name, device_dense=device_dense,
+        device_sparse=device_sparse,
+        compression=compression if compression is not None else _C.none,
+        sparse_as_dense=sparse_as_dense)
+
+
+def broadcast_global_variables(root_rank: int = 0):
+    """Broadcast all TF global variables from ``root_rank`` (reference
+    ``horovod/keras/__init__.py:62-70``).  Graph-mode concept: in keras 3
+    prefer :class:`BroadcastGlobalVariablesCallback`, which broadcasts the
+    model's weights at train start."""
+    from horovod_tpu.tensorflow import (
+        broadcast_global_variables as _tf_broadcast_global_variables,
+    )
+
+    return _tf_broadcast_global_variables(root_rank)
+
+
 __all__ = [
-    "Trainer", "create_distributed_optimizer",
+    "Trainer", "create_distributed_optimizer", "DistributedOptimizer",
+    "broadcast_global_variables",
     "save_model", "load_model",
     "Callback", "BroadcastGlobalVariablesCallback", "MetricAverageCallback",
     "LearningRateScheduleCallback", "LearningRateWarmupCallback",

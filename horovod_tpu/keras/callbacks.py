@@ -1,40 +1,98 @@
 """Training-loop callbacks — the TPU-native analog of the reference's Keras
 callback suite (``/root/reference/horovod/_keras/callbacks.py``).
 
-The reference hooks Keras's fit loop; here the same four behaviors hook
-:class:`horovod_tpu.keras.Trainer` (a minimal fit loop over a jitted step):
+Each class serves TWO loops with one object:
 
-* :class:`BroadcastGlobalVariablesCallback` — start-of-training consistency
-  (reference ``callbacks.py:20-30``).
-* :class:`MetricAverageCallback` — epoch metrics averaged across workers
-  (reference ``callbacks.py:33-67``).
-* :class:`LearningRateScheduleCallback` / :class:`LearningRateWarmupCallback`
-  — LR scaling schedule with momentum correction (reference
-  ``callbacks.py:70-168``; warmup rule from the "Accurate, Large Minibatch
-  SGD" recipe).
+* :class:`horovod_tpu.keras.Trainer` (the JAX fit loop) attaches itself via
+  ``set_trainer``; the trainer-mode logic lives in the subclass hooks here.
+* standalone **keras 3** ``model.fit`` duck-types the same object: keras's
+  ``CallbackList`` calls ``set_model``/``set_params`` and the
+  ``on_train_batch_*`` hook names.  In that mode every hook forwards to a
+  sibling from :mod:`horovod_tpu.tensorflow.keras.callbacks`, which carries
+  the fit-loop-correct semantics (first-batch broadcast so lazily-built
+  optimizer slots are included, assign-aware LR/momentum writes) — the same
+  delegation pattern as :func:`horovod_tpu.keras.DistributedOptimizer`.
 """
 
 from __future__ import annotations
 
-from typing import Any, Callable
+from typing import Any
 
 import numpy as np
 
 
 class Callback:
-    """Base callback; the trainer is attached before on_train_begin."""
+    """Dual-protocol base: Trainer hooks + keras-3 CallbackList surface."""
 
     trainer: Any = None
+    model: Any = None
+    params: Any = None
+    _sibling: Any = None  # tf.keras-side implementation, keras mode only
 
     def set_trainer(self, trainer) -> None:
         self.trainer = trainer
 
-    def on_train_begin(self, logs=None): ...
-    def on_train_end(self, logs=None): ...
-    def on_epoch_begin(self, epoch, logs=None): ...
-    def on_epoch_end(self, epoch, logs=None): ...
-    def on_batch_begin(self, batch, logs=None): ...
-    def on_batch_end(self, batch, logs=None): ...
+    # -- keras CallbackList protocol ---------------------------------------
+    def _make_keras_sibling(self):
+        """Subclasses return the tf.keras callback carrying this behavior
+        for keras's fit loop; None means the callback is Trainer-only."""
+        return None
+
+    def _keras_mode(self) -> bool:
+        return self.trainer is None and self._sibling is not None
+
+    def set_model(self, model) -> None:
+        self.model = model
+        if self._sibling is None:
+            self._sibling = self._make_keras_sibling()
+        if self._sibling is not None:
+            self._sibling.set_model(model)
+
+    def set_params(self, params) -> None:
+        self.params = params
+        if self._sibling is not None:
+            self._sibling.set_params(params)
+
+    # -- hooks: keras mode forwards to the sibling, Trainer mode no-ops ----
+    def on_train_begin(self, logs=None):
+        if self._keras_mode():
+            self._sibling.on_train_begin(logs)
+
+    def on_train_end(self, logs=None):
+        if self._keras_mode():
+            self._sibling.on_train_end(logs)
+
+    def on_epoch_begin(self, epoch, logs=None):
+        if self._keras_mode():
+            self._sibling.on_epoch_begin(epoch, logs)
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self._keras_mode():
+            self._sibling.on_epoch_end(epoch, logs)
+
+    def on_batch_begin(self, batch, logs=None):
+        if self._keras_mode():
+            self._sibling.on_batch_begin(batch, logs)
+
+    def on_batch_end(self, batch, logs=None):
+        if self._keras_mode():
+            self._sibling.on_batch_end(batch, logs)
+
+    # keras 3 batch-hook names alias the classic ones
+    def on_train_batch_begin(self, batch, logs=None):
+        self.on_batch_begin(batch, logs)
+
+    def on_train_batch_end(self, batch, logs=None):
+        self.on_batch_end(batch, logs)
+
+    def on_test_begin(self, logs=None): ...
+    def on_test_end(self, logs=None): ...
+    def on_test_batch_begin(self, batch, logs=None): ...
+    def on_test_batch_end(self, batch, logs=None): ...
+    def on_predict_begin(self, logs=None): ...
+    def on_predict_end(self, logs=None): ...
+    def on_predict_batch_begin(self, batch, logs=None): ...
+    def on_predict_batch_end(self, batch, logs=None): ...
 
 
 class BroadcastGlobalVariablesCallback(Callback):
@@ -45,7 +103,14 @@ class BroadcastGlobalVariablesCallback(Callback):
     def __init__(self, root_rank: int = 0):
         self.root_rank = root_rank
 
+    def _make_keras_sibling(self):
+        from horovod_tpu.tensorflow.keras import callbacks as tfk
+
+        return tfk.BroadcastGlobalVariablesCallback(self.root_rank)
+
     def on_train_begin(self, logs=None):
+        if self._keras_mode():
+            return self._sibling.on_train_begin(logs)
         import horovod_tpu.jax as hvd
 
         self.trainer.params = hvd.broadcast_parameters(
@@ -58,7 +123,14 @@ class MetricAverageCallback(Callback):
     """Average epoch metrics over all workers in place (sorted by name for
     cross-rank op-ordering consistency, like the reference)."""
 
+    def _make_keras_sibling(self):
+        from horovod_tpu.tensorflow.keras import callbacks as tfk
+
+        return tfk.MetricAverageCallback()
+
     def on_epoch_end(self, epoch, logs=None):
+        if self._keras_mode():
+            return self._sibling.on_epoch_end(epoch, logs)
         if not logs:
             return
         import horovod_tpu as hvd
@@ -107,7 +179,18 @@ class LearningRateScheduleCallback(Callback):
         self.current_epoch = 0
         self._restore_momentum = None
 
+    def _make_keras_sibling(self):
+        from horovod_tpu.tensorflow.keras import callbacks as tfk
+
+        return tfk.LearningRateScheduleCallback(
+            self.multiplier, start_epoch=self.start_epoch,
+            end_epoch=self.end_epoch, staircase=self.staircase,
+            momentum_correction=self.momentum_correction,
+            steps_per_epoch=self.steps_per_epoch)
+
     def on_train_begin(self, logs=None):
+        if self._keras_mode():
+            return self._sibling.on_train_begin(logs)
         self.initial_lr = self.trainer.lr
         if not self.staircase and not self.steps_per_epoch:
             self.steps_per_epoch = self.trainer.steps_per_epoch
@@ -117,6 +200,8 @@ class LearningRateScheduleCallback(Callback):
                     "schedules (could not autodetect from the trainer)")
 
     def on_epoch_begin(self, epoch, logs=None):
+        if self._keras_mode():
+            return self._sibling.on_epoch_begin(epoch, logs)
         self.current_epoch = epoch
 
     def _adjust(self, epoch_float):
@@ -129,6 +214,8 @@ class LearningRateScheduleCallback(Callback):
             self.trainer.momentum = self._restore_momentum * new_lr / old_lr
 
     def on_batch_begin(self, batch, logs=None):
+        if self._keras_mode():
+            return self._sibling.on_batch_begin(batch, logs)
         if (self.current_epoch < self.start_epoch or
                 (self.end_epoch is not None and
                  self.current_epoch >= self.end_epoch)):
@@ -139,11 +226,15 @@ class LearningRateScheduleCallback(Callback):
             self._adjust(self.current_epoch + batch / self.steps_per_epoch)
 
     def on_batch_end(self, batch, logs=None):
+        if self._keras_mode():
+            return self._sibling.on_batch_end(batch, logs)
         if self._restore_momentum is not None:
             self.trainer.momentum = self._restore_momentum
             self._restore_momentum = None
 
     def on_epoch_end(self, epoch, logs=None):
+        if self._keras_mode():
+            return self._sibling.on_epoch_end(epoch, logs)
         if logs is not None:
             logs["lr"] = self.trainer.lr
 
@@ -166,10 +257,20 @@ class LearningRateWarmupCallback(LearningRateScheduleCallback):
                          staircase=False,
                          momentum_correction=momentum_correction,
                          steps_per_epoch=steps_per_epoch)
+        self.warmup_epochs = warmup_epochs
         self.verbose = verbose
+
+    def _make_keras_sibling(self):
+        from horovod_tpu.tensorflow.keras import callbacks as tfk
+
+        return tfk.LearningRateWarmupCallback(
+            warmup_epochs=self.warmup_epochs,
+            momentum_correction=self.momentum_correction,
+            steps_per_epoch=self.steps_per_epoch, verbose=self.verbose)
 
     def on_epoch_end(self, epoch, logs=None):
         super().on_epoch_end(epoch, logs)
-        if epoch == (self.end_epoch or 0) - 1 and self.verbose:
+        if self.trainer is not None and self.verbose and \
+                epoch == (self.end_epoch or 0) - 1:
             print(f"\nEpoch {epoch + 1}: finished gradual learning rate "
                   f"warmup to {self.trainer.lr:g}.")

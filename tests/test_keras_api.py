@@ -113,3 +113,62 @@ def test_checkpoint_roundtrip(hvd_single, tmp_path):
     trainer2.opt_state = opt_state2
     h = trainer2.fit(batches, epochs=1)
     assert np.isfinite(h[0]["loss"])
+
+
+def test_standalone_keras_distributed_optimizer_parity():
+    """horovod_tpu.keras.DistributedOptimizer wraps a standalone keras-3
+    optimizer (reference horovod/keras/__init__.py:32-59 parity): the
+    wrapped class keeps its name, and a one-process fit() converges."""
+    keras = pytest.importorskip("keras")
+    import numpy as np
+
+    import horovod_tpu.keras as hvd_keras
+
+    hvd_keras.init()
+    try:
+        opt = hvd_keras.DistributedOptimizer(keras.optimizers.SGD(0.1))
+        assert type(opt).__name__ == "SGD"
+        assert getattr(type(opt), "_hvd_wrapped", False)
+
+        model = keras.Sequential([keras.layers.Dense(1, input_shape=(4,))])
+        model.compile(optimizer=opt, loss="mse")
+        rng = np.random.RandomState(0)
+        X = rng.rand(128, 4).astype("float32")
+        y = X @ np.array([[1.0], [-1.0], [0.5], [2.0]], "float32")
+        h = model.fit(X, y, epochs=15, batch_size=32, verbose=0)
+        assert h.history["loss"][-1] < 0.2 * h.history["loss"][0]
+    finally:
+        hvd_keras.shutdown()
+
+
+def test_callbacks_dual_protocol_with_keras_fit():
+    """The horovod_tpu.keras callbacks duck-type keras 3's CallbackList
+    (set_model/set_params/on_train_batch_*), so the same classes serve the
+    JAX Trainer and standalone keras fit (reference horovod/keras/callbacks
+    hook keras's loop)."""
+    keras = pytest.importorskip("keras")
+    import numpy as np
+
+    import horovod_tpu.keras as hvd_keras
+
+    hvd_keras.init()
+    try:
+        model = keras.Sequential([keras.Input((4,)), keras.layers.Dense(1)])
+        model.compile(optimizer=keras.optimizers.SGD(0.4), loss="mse")
+        rng = np.random.RandomState(0)
+        X = rng.rand(64, 4).astype("float32")
+        y = (X @ np.ones((4, 1), "float32"))
+        cbs = [
+            hvd_keras.BroadcastGlobalVariablesCallback(0),
+            hvd_keras.MetricAverageCallback(),
+            hvd_keras.LearningRateWarmupCallback(warmup_epochs=3),
+        ]
+        h = model.fit(X, y, epochs=4, batch_size=16, verbose=0,
+                      callbacks=cbs)
+        # size-1 warmup multiplier is 1.0 throughout: lr unchanged by end
+        assert float(np.asarray(model.optimizer.learning_rate)) == \
+            pytest.approx(0.4, rel=1e-5)
+        assert "lr" in h.history or h.history["loss"][-1] < \
+            h.history["loss"][0]
+    finally:
+        hvd_keras.shutdown()
