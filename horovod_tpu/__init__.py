@@ -93,11 +93,17 @@ def allreduce(tensor, average: bool = True, name: str | None = None,
     if average:
         if direct is not None:
             # keep the caller's buffer authoritative for every dtype (the
-            # quotient is cast back into out's dtype — bf16 included)
-            np.divide(res, size(), out=direct, casting="unsafe")
-            res = direct
+            # quotient is cast back into out's dtype — bf16 included); a
+            # 0-d out divides through a (1,) view since the engine result
+            # rides the wire as [1]
+            target = direct.reshape(1) if direct.ndim == 0 and \
+                np.ndim(res) == 1 else direct
+            np.divide(res, size(), out=target, casting="unsafe")
         else:
             res = res / size()
+    if direct is not None:
+        # the caller's buffer (original shape, 0-d included) is the result
+        return direct
     return res
 
 
@@ -112,9 +118,12 @@ def broadcast(tensor, root_rank: int, name: str | None = None,
               out=None) -> np.ndarray:
     """Every process receives root_rank's value.  ``out`` as in
     :func:`allreduce` (pass the input itself for in-place)."""
-    return _state.engine().broadcast(
+    res = _state.engine().broadcast(
         _as_numpy(tensor), root_rank, _auto_name("broadcast", name), out=out
     )
+    # the caller's buffer (original shape — 0-d rides the wire as [1]) is
+    # the result when provided
+    return out if out is not None else res
 
 
 def alltoall(tensor, name: str | None = None) -> np.ndarray:

@@ -226,6 +226,11 @@ class NativeEngine(Engine):
                  out: np.ndarray | None = None) -> int:
         arr, dtype = _np_view(np.asarray(array))
         if out is not None:
+            if out.ndim == 0 and arr.shape == (1,):
+                # the wire has no 0-d tensors (_np_view lifts scalars to
+                # [1]); lift the output the same way — a reshape view, so
+                # the caller's buffer is still written in place
+                out = out.reshape(1)
             if (out.dtype != arr.dtype or out.shape != arr.shape
                     or not out.flags.c_contiguous):
                 raise ValueError(

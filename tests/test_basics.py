@@ -118,3 +118,34 @@ def test_alltoall_single(hvd_single):
 
 def test_barrier(hvd_single):
     hvd.barrier()  # must not deadlock single-process
+
+
+def test_scalar_inplace_collectives_multiproc():
+    """0-d tensors with out= (the scalar-wrapping pattern
+    broadcast_optimizer_state uses): the wire lifts scalars to [1]; the
+    caller's 0-d buffer must be written in place and returned 0-d, for both
+    allreduce average modes and broadcast."""
+    from horovod_tpu.spark import run_local
+
+    def fn():
+        import numpy as np
+
+        import horovod_tpu as hvd
+
+        hvd.init()
+        try:
+            r, n = hvd.rank(), hvd.size()
+            s = np.array(float(r + 1), np.float32)
+            res = hvd.allreduce(s, average=True, name="s_avg", out=s)
+            assert res.ndim == 0 and float(res) == (n * (n + 1) / 2) / n
+            t = np.array(float(r + 1), np.float32)
+            res = hvd.allreduce(t, average=False, name="s_sum", out=t)
+            assert res.ndim == 0 and float(res) == n * (n + 1) / 2
+            b = np.array(float(r * 7 + 3), np.float32)
+            rb = hvd.broadcast(b, 0, name="s_bc", out=b)
+            assert rb.ndim == 0 and float(rb) == 3.0 and float(b) == 3.0
+            return True
+        finally:
+            hvd.shutdown()
+
+    assert run_local(fn, num_proc=2, start_timeout=300) == [True, True]

@@ -227,6 +227,42 @@ def scenario_state():
         model(torch.randn(2, 3)).sum().backward()
     opt2.step()  # must not hang: exactly one allreduce per param happened
 
+    # reference discipline (test_torch.py:802,936): broadcast_optimizer_state
+    # must round-trip EVERY stock optimizer's state layout — tensor slots,
+    # python-scalar steps, per-group hyperparameters
+    opt_classes = [
+        ("Adam", torch.optim.Adam, {"lr": 0.01 * (r + 1)}),
+        ("AdamW", torch.optim.AdamW, {"lr": 0.02 * (r + 1)}),
+        ("RMSprop", torch.optim.RMSprop,
+         {"lr": 0.03 * (r + 1), "momentum": 0.5}),
+        ("Adagrad", torch.optim.Adagrad, {"lr": 0.04 * (r + 1)}),
+        ("Adadelta", torch.optim.Adadelta, {"lr": 0.05 * (r + 1)}),
+        ("ASGD", torch.optim.ASGD, {"lr": 0.06 * (r + 1)}),
+        ("Adamax", torch.optim.Adamax, {"lr": 0.07 * (r + 1)}),
+    ]
+    for name, cls, kwargs in opt_classes:
+        torch.manual_seed(100 + r)  # divergent state before broadcast
+        m = torch.nn.Linear(3, 2)
+        o = cls(m.parameters(), **kwargs)
+        m(torch.randn(2, 3)).sum().backward()
+        o.step()
+        hvd.broadcast_optimizer_state(o, root_rank=0)
+        base_lr = kwargs["lr"] / (r + 1)  # rank 0's value
+        assert abs(o.param_groups[0]["lr"] - base_lr) < 1e-12, (name, r)
+        slots = []
+        for p in m.parameters():
+            st = o.state.get(p, {})
+            for key in sorted(st):
+                v = st[key]
+                if torch.is_tensor(v):
+                    slots.append(v.float().reshape(1, -1))
+                else:
+                    slots.append(torch.tensor([[float(v)]]))
+        flat = torch.cat(slots, dim=1)
+        gat = hvd.allgather(flat, name=f"state.{name}")
+        assert torch.allclose(gat, gat[0].expand_as(gat), atol=1e-6), \
+            (name, r)
+
     hvd.shutdown()
     print(f"rank {r}: torch state OK", flush=True)
 
