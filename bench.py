@@ -441,6 +441,12 @@ def main() -> None:
     try:
         xla_flags.set_combine_threshold(platform="tpu")
         xla_flags.set_combine_threshold(platform="gpu")
+        # grad allreduces overlap backward compute (async collective
+        # fusion / latency hiding) — the compiled-path analog of the
+        # reference's background-thread overlap; both flag families, like
+        # the combine threshold above (each is inert on the other platform)
+        xla_flags.enable_async_collectives(platform="tpu")
+        xla_flags.enable_async_collectives(platform="gpu")
     except RuntimeError:
         pass  # backend already up (e.g. under a test harness)
 

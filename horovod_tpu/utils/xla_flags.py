@@ -57,11 +57,14 @@ def _flag_env(name: str) -> str:
     return "LIBTPU_INIT_ARGS" if name.startswith("xla_tpu") else "XLA_FLAGS"
 
 
-def _set_flag(name: str, value: int) -> None:
+def _set_flag(name: str, value) -> None:
+    """Append --name=value to the platform's flag env, replacing any prior
+    setting of the same flag.  ``value`` renders via str(): ints and the
+    strings "true"/"false" both ride through."""
     env = _flag_env(name)
     flags = os.environ.get(env, "")
     parts = [f for f in flags.split() if not f.startswith(f"--{name}=")]
-    parts.append(f"--{name}={int(value)}")
+    parts.append(f"--{name}={value}")
     os.environ[env] = " ".join(parts)
 
 
@@ -98,11 +101,11 @@ def set_combine_threshold(nbytes: int = DEFAULT_THRESHOLD,
         flag = table.get(c)
         if flag is None:
             raise ValueError(f"unknown collective {c!r}; choose from {sorted(table)}")
-        _set_flag(flag, nbytes)
+        _set_flag(flag, int(nbytes))
         applied[flag] = int(nbytes)
     if platform == "tpu" and "allreduce" in collectives:
         # cross-slice (DCN) level of hierarchical allreduce
-        _set_flag(_TPU_FLAGS["allreduce_dcn"], nbytes)
+        _set_flag(_TPU_FLAGS["allreduce_dcn"], int(nbytes))
         applied[_TPU_FLAGS["allreduce_dcn"]] = int(nbytes)
     return applied
 
@@ -118,3 +121,46 @@ def get_combine_threshold(platform: str | None = None,
         if part.startswith(f"--{flag}="):
             return int(part.split("=", 1)[1])
     return None
+
+
+# -- compute/communication overlap ------------------------------------------
+
+_TPU_ASYNC_FLAGS = (
+    # NOT in this set: xla_tpu_enable_async_collective_fusion_fuse_all_gather
+    # — an enum (not bool) on current libtpu, so setting it =true aborts
+    # compilation; the three below are plain bools across versions
+    "xla_tpu_enable_async_collective_fusion",
+    "xla_tpu_enable_async_collective_fusion_multiple_steps",
+    "xla_tpu_overlap_compute_collective_tc",
+)
+_GPU_ASYNC_FLAGS = (
+    "xla_gpu_enable_latency_hiding_scheduler",
+)
+
+
+def enable_async_collectives(platform: str | None = None,
+                             force: bool = False) -> dict:
+    """Turn on XLA's async-collective fusion / latency-hiding scheduling so
+    gradient allreduces overlap backward compute inside compiled steps —
+    the compiled-path analog of the reference's background-thread overlap
+    (the entire point of its design: >90% scaling needs communication
+    hidden behind compute, SURVEY.md §7 hard parts).
+
+    Flag names are libtpu/XLA-version dependent; this sets the widely
+    supported set.  Must run before backend init, like
+    :func:`set_combine_threshold`.  Returns the ``{flag: value}`` applied.
+    """
+    if platform is None:
+        platform = os.environ.get("HOROVOD_TPU_PLATFORM", "tpu")
+    if _backend_initialized() and not force:
+        raise RuntimeError(
+            "enable_async_collectives must run before the first JAX "
+            "computation; call it at program start or pass force=True to "
+            "set the env for child processes"
+        )
+    names = _TPU_ASYNC_FLAGS if platform == "tpu" else _GPU_ASYNC_FLAGS
+    applied = {}
+    for name in names:
+        _set_flag(name, "true")
+        applied[name] = True
+    return applied

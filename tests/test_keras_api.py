@@ -126,6 +126,7 @@ def test_standalone_keras_distributed_optimizer_parity():
 
     hvd_keras.init()
     try:
+        keras.utils.set_random_seed(0)  # deterministic init/trajectory
         opt = hvd_keras.DistributedOptimizer(keras.optimizers.SGD(0.1))
         assert type(opt).__name__ == "SGD"
         assert getattr(type(opt), "_hvd_wrapped", False)

@@ -90,3 +90,24 @@ def test_topology_cross_fallback_without_env(monkeypatch):
     monkeypatch.setenv("HOROVOD_TPU_LOCAL_SIZE", "4")
     t = topo.detect_topology()
     assert (t.cross_rank, t.cross_size) == (1, 2)
+
+
+def test_enable_async_collectives_flags(clean_env):
+    """Async-collective overlap flags route to LIBTPU_INIT_ARGS (tpu) or
+    XLA_FLAGS (gpu) and replace idempotently."""
+    from horovod_tpu.utils import xla_flags
+
+    applied = xla_flags.enable_async_collectives(platform="tpu", force=True)
+    args = os.environ["LIBTPU_INIT_ARGS"]
+    assert "--xla_tpu_enable_async_collective_fusion=true" in args
+    assert "--xla_tpu_overlap_compute_collective_tc=true" in args
+    assert "fuse_all_gather" not in args  # enum on current libtpu, not bool
+    assert all(v is True for v in applied.values())
+    # idempotent: calling twice doesn't duplicate flags
+    xla_flags.enable_async_collectives(platform="tpu", force=True)
+    args = os.environ["LIBTPU_INIT_ARGS"]
+    assert args.count("--xla_tpu_enable_async_collective_fusion=") == 1
+
+    xla_flags.enable_async_collectives(platform="gpu", force=True)
+    assert "--xla_gpu_enable_latency_hiding_scheduler=true" in \
+        os.environ["XLA_FLAGS"]
