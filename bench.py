@@ -218,10 +218,16 @@ def bench_llama(args, peak_tflops):
     opt = optax.sgd(1e-3)
     opt_state = opt.init(params)
 
+    vb = args.llama_vocab_block  # 0 = dense loss; >0 = blockwise CE
+    if vb < 0:
+        from horovod_tpu.ops.chunked_ce import auto_block
+        vb = auto_block(cfg.vocab_size)
+
     @partial(jax.jit, donate_argnums=(0, 1))
     def train_step(params, opt_state, tokens):
         # attn_fn="auto" -> Pallas flash-attention kernels (fwd + bwd) on TPU
-        loss, grads = jax.value_and_grad(llama.loss_fn)(params, tokens, cfg)
+        loss, grads = jax.value_and_grad(llama.loss_fn)(
+            params, tokens, cfg, vocab_block=vb or None)
         updates, opt_state = opt.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt_state, loss
 
@@ -249,6 +255,7 @@ def bench_llama(args, peak_tflops):
         # ask the resolver, not the backend: "auto" falls back to the dense
         # path when T doesn't tile into 128-wide Mosaic blocks
         "flash_attention": llama._resolve_attn_fn("auto", T) is not None,
+        "vocab_block": vb or None,
         "model_tflops_per_step": round(flops_per_step / 1e12, 3),
         "sustained_tflops": round(sustained_tflops, 2),
         "mfu": (round(sustained_tflops / peak_tflops, 4)
@@ -410,6 +417,9 @@ def main() -> None:
     ap.add_argument("--llama-d-ff", type=int, default=8192)
     ap.add_argument("--llama-batch", type=int, default=8)
     ap.add_argument("--llama-seq", type=int, default=2048)
+    ap.add_argument("--llama-vocab-block", type=int, default=0,
+                    help="0=dense loss, -1=auto block, >0=vocab block size "
+                         "for the chunked cross-entropy")
     ap.add_argument("--size-mb", type=int, default=64)
     ap.add_argument("--ar-iters", type=int, default=10)
     ap.add_argument("--ar-max-np", type=int, default=8)

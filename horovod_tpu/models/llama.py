@@ -206,6 +206,16 @@ def apply(params, tokens, config: LlamaConfig, positions=None,
     ``remat`` checkpoints each layer (recompute in backward — the standard
     HBM-for-FLOPs trade on TPU).
     """
+    x = apply_hidden(params, tokens, config, positions=positions,
+                     attn_fn=attn_fn, remat=remat)
+    return (x @ params["lm_head"].astype(x.dtype)).astype(jnp.float32)
+
+
+def apply_hidden(params, tokens, config: LlamaConfig, positions=None,
+                 attn_fn="auto", remat: bool = True):
+    """Forward pass up to (and including) the final norm — hidden states
+    [B, T, D] in compute dtype, without the lm_head projection.  The
+    chunked-CE loss path projects blockwise instead (ops/chunked_ce.py)."""
     c = config
     B, T = tokens.shape
     attn_fn = _resolve_attn_fn(attn_fn, T)
@@ -224,12 +234,31 @@ def apply(params, tokens, config: LlamaConfig, positions=None,
         body = jax.checkpoint(body)
     x, _ = lax.scan(body, x, layer_stack)
     x = _rms_norm(x, params["final_norm"], c.rms_eps)
-    return (x @ params["lm_head"].astype(x.dtype)).astype(jnp.float32)
+    return x
 
 
 def loss_fn(params, tokens, config: LlamaConfig, positions=None,
-            attn_fn="auto", remat: bool = True):
-    """Next-token cross-entropy (shift-by-one inside)."""
+            attn_fn="auto", remat: bool = True,
+            vocab_block: int | None = None):
+    """Next-token cross-entropy (shift-by-one inside).
+
+    ``vocab_block`` switches to the blockwise loss (ops/chunked_ce.py):
+    the fp32 ``[B, T, V]`` logits tensor is never materialized — peak
+    loss-side memory is ``[B*T, vocab_block]`` — at the cost of
+    recomputing block logits in the backward.  The block must divide the
+    vocab (``chunked_ce.auto_block`` picks one)."""
+    if vocab_block:
+        from horovod_tpu.ops.chunked_ce import (auto_block,
+                                                chunked_cross_entropy)
+
+        if int(vocab_block) < 0:  # -1 = auto, the bench flag convention
+            vocab_block = auto_block(config.vocab_size)
+        x = apply_hidden(params, tokens, config, positions=positions,
+                         attn_fn=attn_fn, remat=remat)
+        h = x[:, :-1].reshape(-1, x.shape[-1])
+        targets = tokens[:, 1:].reshape(-1)
+        return chunked_cross_entropy(h, params["lm_head"], targets,
+                                     int(vocab_block))
     logits = apply(params, tokens, config, positions=positions,
                    attn_fn=attn_fn, remat=remat)
     logp = jax.nn.log_softmax(logits[:, :-1])

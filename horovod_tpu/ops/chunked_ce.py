@@ -1,0 +1,150 @@
+"""Blockwise (chunked) cross-entropy over a large vocabulary.
+
+The naive LM loss materializes fp32 logits of shape ``[tokens, vocab]`` —
+at seq 16k x vocab 32k that is ~2 GB of HBM for ONE batch element, before
+the backward doubles it.  This computes the same mean NLL with an online
+logsumexp over vocab blocks (the softmax analog of flash attention's
+streaming max/sum), so peak memory is ``[tokens, block]`` regardless of
+vocab size, and each block's ``[N, D] @ [D, block]`` matmul tiles straight
+onto the MXU.
+
+Vocab sizes that don't divide by the block are handled with an
+overlapping, column-masked final block — no padding copies of the head.
+
+Role analog: the reference has no large-vocab path (2018-era CNNs); this
+serves the framework's long-context/LLM capability the way the Pallas
+flash-attention kernels serve attention.  The backward is a custom VJP
+that recomputes each block's logits (remat: FLOPs traded for HBM) and
+accumulates ``dh`` / ``dW`` inside the same scan.
+
+Everything is ``lax.scan``-based jittable code — no Pallas needed here
+because the hot op is a plain matmul XLA already schedules optimally; the
+win is purely the memory shape of the program.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _check_block(block: int, v: int) -> int:
+    if int(block) < 1:
+        raise ValueError(f"vocab block must be >= 1, got {block}; pass "
+                         "auto_block(vocab) or a positive tile width")
+    return min(int(block), v)
+
+
+def _block_bounds(i, block, v):
+    """Start of block i, clamped so the slice stays in range; the column
+    validity mask drops the overlap with the previous block."""
+    lo_i = i * block
+    lo = jnp.minimum(lo_i, v - block)
+    cols = lo + jnp.arange(block)
+    valid = cols >= lo_i  # only columns not covered by earlier blocks
+    return lo, lo_i, valid
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3,))
+def chunked_cross_entropy(h, lm_head, targets, block: int = 8192):
+    """Mean next-token NLL without materializing full logits.
+
+    Args:
+      h: ``[N, D]`` hidden states (any float dtype; block logits are fp32).
+      lm_head: ``[D, V]`` head weights (any ``V >= 1``).
+      targets: ``[N]`` int32 target ids in ``[0, V)``.
+      block: vocab tile width (static, clamped to ``V``).
+
+    Returns the scalar mean of ``logsumexp(logits) - logits[target]``.
+    """
+    m, s, t = _forward_scan(h, lm_head, targets, block)
+    return jnp.mean(m + jnp.log(s) - t)
+
+
+def _forward_scan(h, lm_head, targets, block):
+    n, d = h.shape
+    v = lm_head.shape[1]
+    block = _check_block(block, v)
+    nblocks = -(-v // block)  # ceil: last block overlaps when v % block
+
+    def body(carry, i):
+        m, s, t = carry
+        lo, lo_i, valid = _block_bounds(i, block, v)
+        z = (h @ lax.dynamic_slice_in_dim(lm_head, lo, block, axis=1)
+             .astype(h.dtype)).astype(jnp.float32)        # [N, block]
+        z = jnp.where(valid[None, :], z, -jnp.inf)
+        m_new = jnp.maximum(m, z.max(axis=-1))
+        s = s * jnp.exp(m - m_new) + jnp.exp(
+            z - m_new[:, None]).sum(axis=-1)
+        # target logit if it lives in this block's NEW columns
+        idx = targets - lo
+        in_blk = (targets >= lo_i) & (idx >= 0) & (idx < block)
+        picked = jnp.take_along_axis(
+            z, jnp.clip(idx, 0, block - 1)[:, None], axis=-1)[:, 0]
+        t = jnp.where(in_blk, picked, t)
+        return (m_new, s, t), None
+
+    init = (jnp.full((n,), -jnp.inf, jnp.float32),
+            jnp.zeros((n,), jnp.float32),
+            jnp.zeros((n,), jnp.float32))
+    (m, s, t), _ = lax.scan(body, init, jnp.arange(nblocks))
+    return m, s, t
+
+
+def _fwd(h, lm_head, targets, block):
+    m, s, t = _forward_scan(h, lm_head, targets, block)
+    loss = jnp.mean(m + jnp.log(s) - t)
+    # residuals: the streaming stats ([N] each) — tiny vs the logits
+    return loss, (h, lm_head, targets, m, s)
+
+
+def _bwd(block, res, g):
+    h, lm_head, targets, m, s = res
+    n, d = h.shape
+    v = lm_head.shape[1]
+    block = _check_block(block, v)
+    nblocks = -(-v // block)
+    lse = m + jnp.log(s)                                  # [N]
+    scale = g / n                                         # d(mean)/d(nll)
+
+    def body(carry, i):
+        dh, dw = carry
+        lo, lo_i, valid = _block_bounds(i, block, v)
+        w_b = lax.dynamic_slice_in_dim(lm_head, lo, block, axis=1)
+        z = (h @ w_b.astype(h.dtype)).astype(jnp.float32)
+        p = jnp.exp(z - lse[:, None])                     # softmax block
+        p = jnp.where(valid[None, :], p, 0.0)
+        idx = targets - lo
+        in_blk = (targets >= lo_i) & (idx >= 0) & (idx < block)
+        onehot = (jnp.clip(idx, 0, block - 1)[:, None] ==
+                  jnp.arange(block)[None, :]) & in_blk[:, None]
+        dz = (p - onehot.astype(p.dtype)) * scale         # [N, block] fp32
+        dz_c = dz.astype(h.dtype)
+        dh = dh + dz_c @ w_b.astype(h.dtype).T
+        dw_b = (h.T @ dz_c).astype(lm_head.dtype)         # [D, block]
+        dw = lax.dynamic_update_slice_in_dim(
+            dw, lax.dynamic_slice_in_dim(dw, lo, block, axis=1) + dw_b,
+            lo, axis=1)
+        return (dh, dw), None
+
+    init = (jnp.zeros_like(h), jnp.zeros_like(lm_head))
+    (dh, dw), _ = lax.scan(body, init, jnp.arange(nblocks))
+    return dh, dw, None
+
+
+chunked_cross_entropy.defvjp(_fwd, _bwd)
+
+
+def auto_block(vocab: int, target: int = 8192) -> int:
+    """A good vocab tile width: the largest divisor of ``vocab`` within
+    ``[target/2, target]`` (aligned blocks, no overlap) when one exists —
+    32000 -> 8000 — else just ``min(target, vocab)`` (the kernel masks a
+    final overlapping block, so divisibility is a preference, not a
+    requirement)."""
+    for b in range(min(target, vocab), max(target // 2, 1) - 1, -1):
+        if vocab % b == 0:
+            return b
+    return min(target, vocab)
