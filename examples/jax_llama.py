@@ -37,6 +37,9 @@ def main() -> None:
     ap.add_argument("--vocab-size", type=int, default=32000)
     ap.add_argument("--seq", type=int, default=2048)
     ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--vocab-block", type=int, default=0,
+                    help="0=dense loss, -1=auto, >0=block size for the "
+                         "chunked cross-entropy (ops/chunked_ce.py)")
     ap.add_argument("--steps", type=int, default=10)
     ap.add_argument("--lr", type=float, default=3e-4)
     ap.add_argument("--cpu-devices", type=int, default=8,
@@ -96,7 +99,8 @@ def main() -> None:
 
     @jax.jit
     def train_step(params, opt_state, tokens):
-        loss, grads = jax.value_and_grad(llama.loss_fn)(params, tokens, cfg)
+        loss, grads = jax.value_and_grad(llama.loss_fn)(
+            params, tokens, cfg, vocab_block=args.vocab_block or None)
         updates, opt_state = opt.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt_state, loss
 

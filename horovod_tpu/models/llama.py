@@ -245,8 +245,9 @@ def loss_fn(params, tokens, config: LlamaConfig, positions=None,
     ``vocab_block`` switches to the blockwise loss (ops/chunked_ce.py):
     the fp32 ``[B, T, V]`` logits tensor is never materialized — peak
     loss-side memory is ``[B*T, vocab_block]`` — at the cost of
-    recomputing block logits in the backward.  The block must divide the
-    vocab (``chunked_ce.auto_block`` picks one)."""
+    recomputing block logits in the backward.  Any block size works
+    (non-dividing vocabs get a column-masked final block); ``-1`` picks
+    one via ``chunked_ce.auto_block``."""
     if vocab_block:
         from horovod_tpu.ops.chunked_ce import (auto_block,
                                                 chunked_cross_entropy)

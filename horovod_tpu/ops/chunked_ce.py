@@ -123,16 +123,19 @@ def _bwd(block, res, g):
                   jnp.arange(block)[None, :]) & in_blk[:, None]
         dz = (p - onehot.astype(p.dtype)) * scale         # [N, block] fp32
         dz_c = dz.astype(h.dtype)
-        dh = dh + dz_c @ w_b.astype(h.dtype).T
+        # fp32 carry: with bf16 h and many blocks, accumulating partials
+        # in compute dtype would drift from the dense path's single
+        # fp32-accumulated matmul exactly at large vocab
+        dh = dh + (dz_c @ w_b.astype(h.dtype).T).astype(jnp.float32)
         dw_b = (h.T @ dz_c).astype(lm_head.dtype)         # [D, block]
         dw = lax.dynamic_update_slice_in_dim(
             dw, lax.dynamic_slice_in_dim(dw, lo, block, axis=1) + dw_b,
             lo, axis=1)
         return (dh, dw), None
 
-    init = (jnp.zeros_like(h), jnp.zeros_like(lm_head))
+    init = (jnp.zeros(h.shape, jnp.float32), jnp.zeros_like(lm_head))
     (dh, dw), _ = lax.scan(body, init, jnp.arange(nblocks))
-    return dh, dw, None
+    return dh.astype(h.dtype), dw, None
 
 
 chunked_cross_entropy.defvjp(_fwd, _bwd)

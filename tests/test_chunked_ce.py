@@ -13,11 +13,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+# CPU backend + 'highest' matmul precision come from tests/conftest.py
 from horovod_tpu.ops.chunked_ce import auto_block, chunked_cross_entropy
-from horovod_tpu.utils import force_cpu_backend
-
-force_cpu_backend()
-jax.config.update("jax_default_matmul_precision", "highest")
 
 
 def _dense(h, W, t):
@@ -98,3 +95,22 @@ def test_llama_vocab_block_auto():
     l_auto = llama.loss_fn(params, toks, cfg, attn_fn=None, vocab_block=-1)
     l_dense = llama.loss_fn(params, toks, cfg, attn_fn=None)
     assert np.allclose(l_auto, l_dense, rtol=1e-5)
+
+
+def test_bf16_hidden_states_grad_accumulation():
+    """bf16 h with many blocks: the fp32 dh carry keeps chunked gradients
+    close to the dense fp32 reference (compute-dtype accumulation would
+    drift with block count)."""
+    rng = np.random.RandomState(4)
+    N, D, V = 32, 16, 512
+    h32 = jnp.asarray(rng.randn(N, D), jnp.float32)
+    W = jnp.asarray(rng.randn(D, V) * 0.1, jnp.float32)
+    t = jnp.asarray(rng.randint(0, V, N), jnp.int32)
+    h16 = h32.astype(jnp.bfloat16)
+    # many small blocks maximizes accumulation steps
+    _, (dh_c, _) = jax.value_and_grad(
+        lambda h, W: chunked_cross_entropy(h, W, t, 32), (0, 1))(h16, W)
+    _, (dh_d, _) = jax.value_and_grad(_dense, (0, 1))(h32, W, t)
+    assert dh_c.dtype == jnp.bfloat16
+    # bf16 inputs bound the precision; the carry must not add drift on top
+    assert np.allclose(dh_c.astype(np.float32), dh_d, rtol=0.05, atol=2e-4)

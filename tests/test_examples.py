@@ -177,3 +177,11 @@ def test_jax_llama_fsdp_2proc():
     just fans them out; SPMD meshes are per-process on CPU)."""
     _run(JAX_LLAMA + ["--fsdp", "2", "--tp", "1", "--cpu-devices", "2"],
          np_procs=2)
+
+
+def test_jax_llama_fsdp_chunked_ce():
+    """FSDP mesh + blockwise cross-entropy: the chunked loss composes with
+    sharded params (the lm_head block slices re-shard under GSPMD)."""
+    out = _run(JAX_LLAMA + ["--fsdp", "4", "--tp", "2",
+                            "--vocab-block", "64"])
+    assert "mesh fsdp=4 tp=2" in out
