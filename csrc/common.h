@@ -6,6 +6,8 @@
 // this serves Horovod's *dynamic* named-tensor semantics for host tensors.
 #pragma once
 
+#include <strings.h>  // strcasecmp — not guaranteed via <cstring>
+
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
@@ -153,13 +155,21 @@ inline int64_t EnvInt64(const char* name, int64_t dflt) {
 
 inline bool EnvFlag(const char* name) {
   const char* v = getenv(name);
-  return v && v[0] && strcmp(v, "0") != 0;
+  if (!v || !v[0]) return false;
+  // same falsey spellings as EnvFlagIsZero below, so FLAG=false never
+  // means "flag set" anywhere in the engine
+  return strcmp(v, "0") != 0 && strcasecmp(v, "false") != 0 &&
+         strcasecmp(v, "no") != 0 && strcasecmp(v, "off") != 0;
 }
 
-// True only when the knob is explicitly set to 0 (default-on features).
+// True only when the knob is explicitly disabled (default-on features).
+// Accepts the common falsey spellings so HOROVOD_TPU_SHM=false behaves
+// like =0 (kill-switch semantics match tensorflow/_native.py).
 inline bool EnvFlagIsZero(const char* name) {
   const char* v = getenv(name);
-  return v && strcmp(v, "0") == 0;
+  if (!v) return false;
+  return strcmp(v, "0") == 0 || strcasecmp(v, "false") == 0 ||
+         strcasecmp(v, "no") == 0 || strcasecmp(v, "off") == 0;
 }
 
 }  // namespace hvdtpu

@@ -71,7 +71,9 @@ Status ShmRing::Attach(const std::string& name) {
   close(fd);
   if (p == MAP_FAILED) return Errno("mmap(" + name + ")");
   hdr_ = static_cast<ShmRingHdr*>(p);
-  if (hdr_->capacity != len - sizeof(ShmRingHdr)) {
+  // capacity == 0 would pass the size check for a header-only segment and
+  // later SIGFPE on head % capacity — reject stale/foreign segments here.
+  if (hdr_->capacity == 0 || hdr_->capacity != len - sizeof(ShmRingHdr)) {
     munmap(p, len);
     hdr_ = nullptr;
     return Status::Error("shm segment " + name + " capacity mismatch");

@@ -126,6 +126,24 @@ int DTypeCode(DataType dt) {
 
 enum { kAllreduce = 0, kAllgather = 1, kBroadcast = 2 };
 
+// Bounded wait loop: one collective that never completes (e.g. a tensor
+// enqueued on only some ranks) must not silently block the Completer for
+// every subsequent TF collective — log a stall warning naming the tensor
+// every 60 s so the hang is diagnosable from the TF side too (rank 0's
+// engine stall checker only sees its own queue).
+int WaitLogged(const EngineApi& api, int handle, const std::string& name) {
+  int waited = 0;
+  for (;;) {
+    int rc = api.wait(handle, 60.0);
+    if (rc != 0) return rc;
+    waited += 60;
+    fprintf(stderr,
+            "[hvd-tpu tf] WARNING: collective '%s' not complete after %d s; "
+            "still waiting (possible missing enqueue on another rank)\n",
+            name.c_str(), waited);
+  }
+}
+
 std::vector<int64_t> DimsOf(const Tensor& t) {
   std::vector<int64_t> dims;
   for (int i = 0; i < t.dims(); i++) dims.push_back(t.dim_size(i));
@@ -220,9 +238,10 @@ class SameShapeCollectiveOp : public AsyncOpKernel {
         FailedPrecondition("engine not initialized — call "
                            "horovod_tpu.tensorflow.init() first"),
         done);
-    Completer::Get().Submit([ctx, handle, done = std::move(done)]() {
+    Completer::Get().Submit([ctx, handle, name = name_,
+                             done = std::move(done)]() {
       EngineApi api = Api();
-      int rc = api.wait(handle, -1.0);
+      int rc = WaitLogged(api, handle, name);
       if (rc < 0) FailCtx(ctx, api, handle);
       api.release(handle);
       done();
@@ -284,9 +303,10 @@ class HvdTpuAllgatherOp : public AsyncOpKernel {
         FailedPrecondition("engine not initialized — call "
                            "horovod_tpu.tensorflow.init() first"),
         done);
-    Completer::Get().Submit([ctx, handle, done = std::move(done)]() {
+    Completer::Get().Submit([ctx, handle, name = name_,
+                             done = std::move(done)]() {
       EngineApi api = Api();
-      int rc = api.wait(handle, -1.0);
+      int rc = WaitLogged(api, handle, name);
       if (rc < 0) {
         FailCtx(ctx, api, handle);
         api.release(handle);

@@ -20,30 +20,47 @@ import warnings
 
 _lock = threading.Lock()
 _mod = None
-_tried = False
+_failures = 0
+# Transient load failures (import races, filesystem hiccups) retry on the
+# next call — mirroring tf_ops.cc Api(), which re-attempts a failed dlopen
+# on the next kernel execution — up to this many attempts.  A *compile*
+# failure is persistent (the toolchain won't heal between steps) and
+# latches immediately so training steps don't stall re-running g++.
+_MAX_TRIES = 3
 
 
 def get_ops():
     """The loaded custom-op module, or None if unavailable."""
-    global _mod, _tried
+    global _mod, _failures
     with _lock:
-        if _tried:
+        if _mod is not None or _failures >= _MAX_TRIES:
             return _mod
-        _tried = True
         if os.environ.get("HOROVOD_TPU_TF_NATIVE", "1").lower() in (
-                "0", "false", "no"):
+                "0", "false", "no", "off"):
+            _failures = _MAX_TRIES  # explicit opt-out: latch immediately
             return None
         try:
             _mod = _build_and_load()
+            _failures = 0
         except Exception as e:  # noqa: BLE001 — any failure means fallback
-            warnings.warn(
-                f"horovod_tpu: native TF ops unavailable ({e}); using the "
-                "tf.py_function bridge (works, but collectives run "
-                "serialized). Set HOROVOD_TPU_TF_NATIVE=0 to silence.",
-                RuntimeWarning,
-            )
-            _mod = None
+            persistent = isinstance(e, _BuildFailed)
+            first = _failures == 0
+            _failures = _MAX_TRIES if persistent else _failures + 1
+            # warn on the first failure AND whenever the fallback latches —
+            # the latching error (e.g. the g++ log) is the one that names
+            # the real cause
+            if first or _failures >= _MAX_TRIES:
+                warnings.warn(
+                    f"horovod_tpu: native TF ops unavailable ({e}); using "
+                    "the tf.py_function bridge (works, but collectives run "
+                    "serialized). Set HOROVOD_TPU_TF_NATIVE=0 to silence.",
+                    RuntimeWarning,
+                )
         return _mod
+
+
+class _BuildFailed(RuntimeError):
+    """The g++ compile itself failed — not worth retrying per-step."""
 
 
 def _build_and_load():
@@ -82,7 +99,7 @@ def _build_and_load():
                     )
                     r = subprocess.run(cmd, capture_output=True, text=True)
                     if r.returncode != 0:
-                        raise RuntimeError(
+                        raise _BuildFailed(
                             "tf_ops.cc build failed:\n" + r.stderr[-2000:])
                     os.replace(tmp, so)  # atomic: no rank loads a half-link
             finally:
