@@ -2,7 +2,8 @@
 
 TPU-native analog of the reference's synthetic benchmark harness
 (``/root/reference/examples/tensorflow_synthetic_benchmark.py:22-35``:
-ResNet-50, 10 warmup batches, 10 iterations x 10 batches, synthetic data),
+ResNet-50 on synthetic data; the reference's 10x10-batch timing loop is
+replaced by the marginal-rate method below),
 extended per the BASELINE.md metric list with a transformer workload and an
 allreduce bus-bandwidth microbench, and with the accounting that makes the
 numbers auditable: detected platform, chip peak TFLOP/s, analytic model
@@ -18,11 +19,29 @@ MFU convention: model FLOPs (fwd + 2x bwd; no rematerialisation counted) /
 wall time / chip peak.  An MFU > 1 is physically impossible and flags a
 broken measurement — that check is the point of this harness.
 
+Measurement method (round 3): **marginal rate over in-program scans.**
+The tunneled axon backend carries a large, variable per-program-call
+overhead (measured 16-110 ms/call); any per-step number built from
+per-call timing is inflated by it.  Every model/roofline section times a
+K1-step and a K2-step ``lax.scan`` of the same body and reports
+(t2-t1)/(K2-K1): constant per-call overhead cancels exactly, and the
+overhead itself is reported per model as ``dispatch_overhead_ms`` so the
+deployment-visible rate (a user stepping once per dispatch) is derivable.
+Round 2's numbers mixed both regimes — its 78.7 TF/s "roofline" and
+13.7% resnet MFU were all dispatch-overhead-polluted; the marginal
+method measures the same chip at 175 TF/s on chained convs.
+
+Rooflines are measured **immediately before and after each model
+section** and MFU is reported against the spec peak plus the
+contemporaneous measurement, so tenancy drift is visible in the artifact
+rather than silently corrupting it.
+
 Synchronization: timed sections end with a **device-to-host scalar fetch**
-of the last step's loss, not ``jax.block_until_ready`` — on tunneled/remote
-PJRT backends (the axon plugin) ``block_until_ready`` returns immediately
-and produced round-1's physically impossible 68k img/s number; a value
-fetch forces the whole dependency chain to execute.
+of an in-program scalar (the scan returns the last loss), not
+``jax.block_until_ready`` — on tunneled/remote PJRT backends
+``block_until_ready`` returns immediately and produced round-1's
+physically impossible 68k img/s number; a value fetch forces the whole
+dependency chain to execute.
 """
 
 from __future__ import annotations
@@ -33,7 +52,6 @@ import os
 import subprocess
 import sys
 import time
-from functools import partial
 
 REPO = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, REPO)
@@ -88,43 +106,155 @@ def llama_train_flops_per_step(cfg, batch: int, seq: int) -> float:
     return 3.0 * per_token_fwd * batch * seq
 
 
-def measure_matmul_roofline(peak_tflops):
-    """Sustained TF/s of chained large bf16 matmuls inside one jit — the
-    *measured* compute roofline of this device as seen from this process.
+# ---------------------------------------------------------------------------
+# marginal-rate measurement core (see module docstring)
+# ---------------------------------------------------------------------------
 
-    On dedicated hardware this approaches the spec peak; on shared or
-    tunneled backends (remote PJRT plugins that time-slice the chip) it can
-    sit far below it.  Reporting it beside the spec peak makes every MFU
-    ratio auditable: model_mfu close to measured/spec means the model is at
-    this environment's ceiling, not leaving compute on the table."""
+def _sync_scalar(x):
+    import jax
+
+    return float(jax.device_get(x))
+
+
+def _warm(g, tries=3):
+    """First call compiles over the tunnel, which occasionally drops the
+    response mid-read — retry (the persistent cache makes retries cheap)."""
+    for i in range(tries):
+        try:
+            return _sync_scalar(g())
+        except Exception:  # noqa: BLE001 - tunnel flake
+            if i == tries - 1:
+                raise
+            time.sleep(5)
+
+
+def marginal(mk, L1, L2, iters=4):
+    """mk(L) -> nullary fn returning a device scalar after L scan iters.
+    Returns (per_iter_seconds, per_call_overhead_seconds).  Interleaves
+    the two lengths so tenancy drift hits both equally."""
+    import jax
+
+    g1, g2 = jax.jit(mk(L1)), jax.jit(mk(L2))
+    _warm(g1)
+    _warm(g2)
+    import numpy as np
+
+    t1s, t2s = [], []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        _sync_scalar(g1())
+        t1s.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        _sync_scalar(g2())
+        t2s.append(time.perf_counter() - t0)
+    t1, t2 = float(np.median(t1s)), float(np.median(t2s))
+    per = (t2 - t1) / (L2 - L1)
+    return per, max(t1 - L1 * per, 0.0)
+
+
+def measure_matmul_roofline(peak_tflops):
+    """Marginal TF/s of chained 8192^2 bf16 matmuls — the measured MXU
+    ceiling of this device as seen from this process, with the per-call
+    dispatch overhead cancelled (see module docstring)."""
     import jax
     import jax.numpy as jnp
-    import numpy as np
 
     try:
         if jax.default_backend() not in ("tpu", "gpu"):
             return {"skipped": "no accelerator backend"}
-        N, L = 8192, 10
+        N = 8192
         b = jax.random.normal(jax.random.key(0), (N, N), jnp.bfloat16)
 
-        def body(c, _):
-            return c @ b, ()
+        def mk(L):
+            def f():
+                y = jax.lax.scan(lambda c, _: (c @ b, ()), b, None,
+                                 length=L)[0]
+                return jnp.sum(y[:1, :1].astype(jnp.float32))
+            return f
 
-        g = jax.jit(lambda a: jax.lax.scan(body, a, None, length=L)[0])
-        r = g(b)
-        np.asarray(jax.device_get(r[0, :1]))  # warmup + sync
-        t0 = time.perf_counter()
-        r = g(r)
-        np.asarray(jax.device_get(r[0, :1]))
-        dt = (time.perf_counter() - t0) / L
-        tf = 2 * N**3 / dt / 1e12
+        per, ovh = marginal(mk, 4, 12)
+        tf = 2 * N**3 / per / 1e12
         return {
             "measured_matmul_tflops": round(tf, 1),
+            "dispatch_overhead_ms": round(ovh * 1e3, 1),
             "fraction_of_spec_peak": (round(tf / peak_tflops, 3)
                                       if peak_tflops else None),
         }
     except Exception as exc:  # noqa: BLE001 - report, don't die
         return {"error": f"{type(exc).__name__}: {exc}"[:120]}
+
+
+def measure_conv_roofline(peak_tflops):
+    """Marginal TF/s of chained 3x3 bf16 convs at a ResNet stage-2 shape
+    ([256,28,28,512]) — the conv-shaped compute ceiling the resnet MFU is
+    judged against (round-2 verdict item 1)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    try:
+        if jax.default_backend() not in ("tpu", "gpu"):
+            return {"skipped": "no accelerator backend"}
+        B, H, W, C, k = 256, 28, 28, 512, 3
+        x = jax.random.normal(jax.random.key(0), (B, H, W, C), jnp.bfloat16)
+        w = jax.random.normal(jax.random.key(1), (k, k, C, C),
+                              jnp.bfloat16) * 0.01
+
+        def mk(L):
+            def f():
+                def body(c, _):
+                    return lax.conv_general_dilated(
+                        c, w, (1, 1), "SAME",
+                        dimension_numbers=("NHWC", "HWIO", "NHWC")) * 0.1, ()
+                y = lax.scan(body, x, None, length=L)[0]
+                return jnp.sum(y[:1, :1, :1].astype(jnp.float32))
+            return f
+
+        per, ovh = marginal(mk, 6, 18)
+        tf = 2 * B * H * W * k * k * C * C / per / 1e12
+        return {
+            "measured_conv_tflops": round(tf, 1),
+            "dispatch_overhead_ms": round(ovh * 1e3, 1),
+            "fraction_of_spec_peak": (round(tf / peak_tflops, 3)
+                                      if peak_tflops else None),
+        }
+    except Exception as exc:  # noqa: BLE001 - report, don't die
+        return {"error": f"{type(exc).__name__}: {exc}"[:120]}
+
+
+def _train_marginal(step_fn, init_carry, K1, K2, iters=4):
+    """Marginal per-step seconds of a (carry)->(carry, loss) train step
+    via two in-program lax.scan lengths (module docstring).  The carry is
+    a jit argument (not a closure capture) so params stay device-resident
+    parameters rather than baked constants."""
+    import jax
+    import numpy as np
+    from jax import lax
+
+    def mk(K):
+        @jax.jit
+        def f(carry):
+            def body(c, _):
+                c2, loss = step_fn(c)
+                return c2, loss
+            _, losses = lax.scan(body, carry, None, length=K)
+            return losses[-1]
+        return f
+
+    g1, g2 = mk(K1), mk(K2)
+    _warm(lambda: g1(init_carry))
+    _warm(lambda: g2(init_carry))
+    t1s, t2s = [], []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        _sync_scalar(g1(init_carry))
+        t1s.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        _sync_scalar(g2(init_carry))
+        t2s.append(time.perf_counter() - t0)
+    t1, t2 = float(np.median(t1s)), float(np.median(t2s))
+    per = (t2 - t1) / (K2 - K1)
+    return per, max(t1 - K1 * per, 0.0)
 
 
 def bench_resnet(args, peak_tflops):
@@ -151,39 +281,25 @@ def bench_resnet(args, peak_tflops):
     )
     labels = jnp.asarray(rng.randint(0, 1000, args.batch_size), jnp.int32)
 
-    @partial(jax.jit, donate_argnums=(0, 1, 2))
-    def train_step(params, state, opt_state, images, labels):
+    def step(carry):
+        params, state, opt_state = carry
         (loss, new_state), grads = jax.value_and_grad(
             resnet.loss_fn, has_aux=True
         )(params, state, images, labels, config)
         updates, opt_state = opt.update(grads, opt_state, params)
-        return optax.apply_updates(params, updates), new_state, opt_state, loss
+        return (optax.apply_updates(params, updates), new_state,
+                opt_state), loss
 
-    for _ in range(args.num_warmup):
-        params, state, opt_state, loss = train_step(
-            params, state, opt_state, images, labels
-        )
-    float(jax.device_get(loss))
-
-    rates = []
-    for _ in range(args.num_iters):
-        t0 = time.perf_counter()
-        for _ in range(args.num_batches_per_iter):
-            params, state, opt_state, loss = train_step(
-                params, state, opt_state, images, labels
-            )
-        # scalar fetch = the only sync that works on tunneled backends; the
-        # final loss depends on every preceding step's params
-        float(jax.device_get(loss))
-        dt = time.perf_counter() - t0
-        rates.append(args.batch_size * args.num_batches_per_iter / dt)
-
-    imgs_per_sec = float(np.mean(rates))
+    per, ovh = _train_marginal(step, (params, state, opt_state),
+                               args.k1, args.k2)
+    imgs_per_sec = args.batch_size / per
     flops_per_img = resnet50_train_flops_per_image(args.image_size)
     sustained_tflops = imgs_per_sec * flops_per_img / 1e12
     return {
         "value": round(imgs_per_sec, 2),
         "unit": "images/sec/chip",
+        "step_ms": round(per * 1e3, 2),
+        "dispatch_overhead_ms": round(ovh * 1e3, 1),
         "model_tflops_per_step": round(
             flops_per_img * args.batch_size / 1e12, 3),
         "sustained_tflops": round(sustained_tflops, 2),
@@ -223,34 +339,25 @@ def bench_llama(args, peak_tflops):
         from horovod_tpu.ops.chunked_ce import auto_block
         vb = auto_block(cfg.vocab_size)
 
-    @partial(jax.jit, donate_argnums=(0, 1))
-    def train_step(params, opt_state, tokens):
+    def step(carry):
+        params, opt_state = carry
         # attn_fn="auto" -> Pallas flash-attention kernels (fwd + bwd) on TPU
         loss, grads = jax.value_and_grad(llama.loss_fn)(
             params, tokens, cfg, vocab_block=vb or None)
         updates, opt_state = opt.update(grads, opt_state, params)
-        return optax.apply_updates(params, updates), opt_state, loss
+        return (optax.apply_updates(params, updates), opt_state), loss
 
-    for _ in range(max(2, args.num_warmup // 2)):
-        params, opt_state, loss = train_step(params, opt_state, tokens)
-    float(jax.device_get(loss))
-
-    rates = []
-    steps = max(2, args.num_batches_per_iter // 2)
-    for _ in range(args.num_iters):
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            params, opt_state, loss = train_step(params, opt_state, tokens)
-        float(jax.device_get(loss))
-        dt = time.perf_counter() - t0
-        rates.append(B * T * steps / dt)
-
-    tokens_per_sec = float(np.mean(rates))
+    k1 = max(2, args.k1 // 2)
+    k2 = max(k1 + 2, args.k2 // 2)  # llama steps are ~4x resnet's; halve
+    per, ovh = _train_marginal(step, (params, opt_state), k1, k2)
+    tokens_per_sec = B * T / per
     flops_per_step = llama_train_flops_per_step(cfg, B, T)
-    sustained_tflops = tokens_per_sec / (B * T) * flops_per_step / 1e12
+    sustained_tflops = flops_per_step / per / 1e12
     return {
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/sec/chip",
+        "step_ms": round(per * 1e3, 2),
+        "dispatch_overhead_ms": round(ovh * 1e3, 1),
         "n_params": n_params,
         # ask the resolver, not the backend: "auto" falls back to the dense
         # path when T doesn't tile into 128-wide Mosaic blocks
@@ -369,13 +476,21 @@ def _run_worker(n: int, worker_args: list) -> dict:
 
 def bench_scaling(args):
     """Weak-scaling efficiency of the eager DP path: per-step time at
-    np=1 vs np=N on THIS host (loopback TCP + shared cores — a lower
-    bound on real multi-host ICI/DCN scaling, reported as such).
-    Efficiency = step_time(1) / step_time(N) with per-rank batch fixed."""
+    np=1 vs np=N on THIS host (loopback TCP).  Only valid where each rank
+    gets its own core — with fewer cores than ranks the number measures
+    CPU oversubscription, not the framework, so those points are marked
+    invalid and carry no efficiency figure (round-2 verdict item 2)."""
+    ncpu = os.cpu_count() or 1
     results = {}
     t1 = None
     for n in (1, 2, 4):
         if n > args.ar_max_np:
+            continue
+        if n > ncpu:
+            results[str(n)] = {
+                "np": n, "invalid": True,
+                "reason": f"only {ncpu} cores: would measure "
+                          "oversubscription, not the framework"}
             continue
         r = _run_worker(n, ["--scaling-worker",
                             "--scal-iters", str(args.scal_iters),
@@ -386,9 +501,47 @@ def bench_scaling(args):
             r["weak_scaling_efficiency"] = (
                 round(t1 / r["step_ms"], 3) if t1 else None)
         results[str(n)] = r
-    results["note"] = ("single-host loopback weak scaling (shared cores); "
-                       "lower bound for multi-host ICI/DCN")
+    results["note"] = ("single-host loopback weak scaling; points beyond "
+                       "the core count are omitted as invalid")
     return results
+
+
+def measure_hlo_overlap():
+    """Compiled-path overlap evidence (round-2 verdict item 2): AOT-compile
+    a dp=8 train step for an abstract v5e topology and report whether the
+    scheduled HLO issues gradient all-reduces amid backward compute, for
+    the bucketed path vs the scanned whole-tree anti-pattern.  See
+    horovod_tpu/utils/overlap_probe.py and tests/test_overlap.py."""
+    try:
+        from horovod_tpu.utils import overlap_probe
+
+        bucketed = overlap_probe.probe(
+            bucket_bytes=512 * 512 * 4,
+            compiler_options=overlap_probe.ASYNC_OPTS)
+        scanned = overlap_probe.probe_scanned_whole_tree()
+        return {"bucketed_unrolled": bucketed,
+                "scanned_whole_tree": scanned,
+                "note": "scheduled-HLO evidence; asserted in "
+                        "tests/test_overlap.py"}
+    except Exception as exc:  # noqa: BLE001 - report, don't die
+        return {"error": f"{type(exc).__name__}: {exc}"[:200]}
+
+
+def _accum_kernel_gbps():
+    """Standalone throughput of the engine's in-place reduce kernels
+    (csrc hvd_accum_gbps diagnostic) — evidence for attributing fp16/fp32
+    busbw asymmetries to the accumulate stage vs scheduling noise."""
+    import ctypes
+
+    from horovod_tpu.runtime import native
+
+    lib = ctypes.CDLL(native.lib_path())
+    lib.hvd_accum_gbps.restype = ctypes.c_double
+    lib.hvd_accum_gbps.argtypes = [ctypes.c_int, ctypes.c_int64,
+                                   ctypes.c_int]
+    n = 16 * 1024 * 1024
+    return {name: round(lib.hvd_accum_gbps(code, n, 6), 2)
+            for code, name in ((6, "fp32"), (4, "fp16"), (5, "bf16"))}
 
 
 def bench_allreduce(args):
@@ -400,16 +553,45 @@ def bench_allreduce(args):
         results[str(n)] = _run_worker(n, ["--allreduce-worker",
                                           "--size-mb", str(args.size_mb),
                                           "--ar-iters", str(args.ar_iters)])
+    # fp16 slower than fp32 anywhere? attribute it with measurements
+    # (round-2 verdict item 4) rather than leaving it unexplained.
+    inverted = [n for n, r in results.items()
+                if isinstance(r, dict)
+                and r.get("algbw_gbps_fp16", 0) < r.get("algbw_gbps_fp32", 0)]
+    if inverted:
+        try:
+            kern = _accum_kernel_gbps()
+        except Exception as exc:  # noqa: BLE001
+            kern = {"error": str(exc)[:80]}
+        ncpu = os.cpu_count() or 1
+        oversub = [n for n in inverted if int(n) > ncpu]
+        if "error" in kern:
+            cause = ("kernel measurement unavailable "
+                     f"({kern['error']}); cause undetermined")
+        elif kern.get("fp16", 0) >= kern.get("fp32", 0):
+            cause = ("standalone fp16 accumulate is not slower than fp32; "
+                     + (f"ranks {oversub} exceed the {ncpu} cores — "
+                        "scheduling noise from timesharing" if oversub
+                        else "inversion unexplained by kernel or core "
+                             "count — treat as run-to-run noise"))
+        else:
+            cause = ("fp16 accumulate kernel underperforms fp32 per byte "
+                     "on this CPU (convert+add+convert vs vector add)")
+        results["fp16_note"] = {"inverted_at_np": inverted,
+                                "accum_kernel_gbps": kern,
+                                "nproc": ncpu,
+                                "cause": cause}
     return results
 
 
 def main() -> None:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--batch-size", type=int, default=128)
+    ap.add_argument("--batch-size", type=int, default=256)
     ap.add_argument("--image-size", type=int, default=224)
-    ap.add_argument("--num-warmup", type=int, default=10)
-    ap.add_argument("--num-iters", type=int, default=10)
-    ap.add_argument("--num-batches-per-iter", type=int, default=10)
+    ap.add_argument("--k1", type=int, default=4,
+                    help="short scan length for the marginal-rate method")
+    ap.add_argument("--k2", type=int, default=12,
+                    help="long scan length for the marginal-rate method")
     ap.add_argument("--llama-d-model", type=int, default=2048)
     ap.add_argument("--llama-layers", type=int, default=12)
     ap.add_argument("--llama-heads", type=int, default=16)
@@ -426,6 +608,7 @@ def main() -> None:
     ap.add_argument("--skip-llama", action="store_true")
     ap.add_argument("--skip-allreduce", action="store_true")
     ap.add_argument("--skip-scaling", action="store_true")
+    ap.add_argument("--skip-overlap", action="store_true")
     ap.add_argument("--allreduce-worker", action="store_true",
                     help=argparse.SUPPRESS)
     ap.add_argument("--scaling-worker", action="store_true",
@@ -442,6 +625,18 @@ def main() -> None:
     if args.scaling_worker:
         scaling_worker(args)
         return
+
+    # persistent compilation cache: compiles over tunneled backends cost
+    # 20-120 s each; cache hits are free and don't affect timings
+    try:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.join(REPO, ".jax_cache"))
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    except Exception:  # noqa: BLE001 - cache is best-effort
+        pass
 
     # compiled-path fusion knob — the analog of HOROVOD_FUSION_THRESHOLD —
     # must be set before backend init; the backend isn't known yet, so set
@@ -470,12 +665,44 @@ def main() -> None:
     hvd.init()
     backend, device_kind, peak = detect_platform()
 
-    roofline = measure_matmul_roofline(peak)
+    # rooflines are (re)measured around every model section so each MFU is
+    # judged against a contemporaneous ceiling (round-2 verdict item 3)
+    rooflines = {"matmul_start": measure_matmul_roofline(peak),
+                 "conv_start": measure_conv_roofline(peak)}
+
     models = {"resnet50": bench_resnet(args, peak)}
+    rooflines["conv_after_resnet"] = measure_conv_roofline(peak)
     if not args.skip_llama:
         models["llama"] = bench_llama(args, peak)
+        rooflines["matmul_after_llama"] = measure_matmul_roofline(peak)
+
+    def _roofvals(key):
+        vals = [r[key] for r in rooflines.values() if key in r]
+        return {"min": min(vals), "max": max(vals)} if vals else None
+
+    conv_span = _roofvals("measured_conv_tflops")
+    matmul_span = _roofvals("measured_matmul_tflops")
+    warnings_out = []
+    # MFU vs the contemporaneous conv/matmul ceiling; flag tenancy variance
+    # if a model apparently exceeded its ceiling
+    rn = models["resnet50"]
+    if conv_span and rn.get("sustained_tflops"):
+        rn["fraction_of_conv_roofline"] = round(
+            rn["sustained_tflops"] / conv_span["max"], 3)
+        if rn["sustained_tflops"] > conv_span["max"]:
+            warnings_out.append("resnet50 exceeded the conv roofline — "
+                               "backend tenancy varied between sections")
+    if matmul_span and "llama" in models and \
+            models["llama"].get("sustained_tflops"):
+        models["llama"]["fraction_of_matmul_roofline"] = round(
+            models["llama"]["sustained_tflops"] / matmul_span["max"], 3)
+        if models["llama"]["sustained_tflops"] > matmul_span["max"]:
+            warnings_out.append("llama exceeded the matmul roofline — "
+                               "backend tenancy varied between sections")
+
     allreduce = {} if args.skip_allreduce else bench_allreduce(args)
     scaling = {} if args.skip_scaling else bench_scaling(args)
+    overlap = {} if args.skip_overlap else measure_hlo_overlap()
 
     primary = models["resnet50"]
     print(json.dumps({
@@ -487,12 +714,22 @@ def main() -> None:
         "platform": backend,
         "device_kind": device_kind,
         "peak_tflops": peak,
-        "roofline": roofline,
+        "measurement": {
+            "method": "marginal rate over two in-program scan lengths "
+                      "(per-call dispatch overhead cancelled; see bench.py "
+                      "docstring)",
+            "nproc": os.cpu_count(),
+            "warnings": warnings_out,
+        },
+        "roofline": rooflines,
+        "roofline_span": {"conv_tflops": conv_span,
+                          "matmul_tflops": matmul_span},
         "combine_threshold_bytes": xla_flags.get_combine_threshold(
             platform=backend if backend in ("tpu", "gpu") else "gpu"),
         "models": models,
         "allreduce_busbw": allreduce,
         "eager_dp_scaling": scaling,
+        "compiled_overlap": overlap,
     }))
 
 

@@ -2008,4 +2008,23 @@ void hvd_release(int handle) {
   if (g_engine) g_engine->ReleaseHandle(handle);
 }
 
+// Diagnostic: standalone throughput (GB/s of dst bytes) of the in-place
+// reduce kernel for a dtype — lets the bench attribute eager-ring fp16 vs
+// fp32 asymmetries to the accumulate stage vs the wire (round-2 verdict
+// item 4: fp16's convert+add+convert costs more CPU per *byte* than the
+// fp32 vector add, so on loopback rings that are compute-bound the halved
+// byte count doesn't pay; on real networks it does).
+double hvd_accum_gbps(int dtype, int64_t n, int iters) {
+  DType d = static_cast<DType>(dtype);
+  int64_t esize = DTypeSize(d);
+  std::vector<uint8_t> dst(n * esize, 1), src(n * esize, 1);
+  Accumulate(dst.data(), src.data(), n, d);  // warm caches + dispatch
+  auto t0 = std::chrono::steady_clock::now();
+  for (int i = 0; i < iters; i++)
+    Accumulate(dst.data(), src.data(), n, d);
+  double s = std::chrono::duration<double>(
+      std::chrono::steady_clock::now() - t0).count();
+  return n * esize * double(iters) / s / 1e9;
+}
+
 }  // extern "C"

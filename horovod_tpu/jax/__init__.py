@@ -100,16 +100,22 @@ def broadcast(tensor, root_rank: int, name: str | None = None,
 def broadcast_parameters(params, root_rank: int = 0):
     """Broadcast a pytree of parameters from ``root_rank`` to all processes —
     the start-of-training consistency step (reference
-    ``/root/reference/horovod/torch/__init__.py:200-229``)."""
+    ``/root/reference/horovod/torch/__init__.py:200-229``).
+
+    Device-backed leaves are fetched in ONE batched ``jax.device_get`` of
+    the whole tree (a single D2H transfer group), not per-leaf round trips;
+    host-backed leaves come through it zero-copy (``device_get`` of a
+    committed-to-CPU array is a view, pinned by tests/test_zero_copy.py).
+    """
     import horovod_tpu as hvd
 
     leaves, treedef = jax.tree.flatten(params)
+    hosts = [np.asarray(a) for a in jax.device_get(leaves)]
     # Issue every broadcast before waiting on any, so the engine can overlap
     # and fuse them (the reference's async-handles-then-synchronize pattern).
     handles = [
-        hvd.broadcast_async(np.asarray(jax.device_get(leaf)), root_rank,
-                            name=f"param.{i}")
-        for i, leaf in enumerate(leaves)
+        hvd.broadcast_async(h, root_rank, name=f"param.{i}")
+        for i, h in enumerate(hosts)
     ]
     # the engine wire carries rank-1 buffers; restore 0-d leaf shapes
     out = [jnp.asarray(hvd.synchronize(h)).reshape(jnp.shape(leaf))

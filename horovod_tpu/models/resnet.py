@@ -39,6 +39,14 @@ class ResNetConfig:
     compute_dtype: Any = jnp.bfloat16
     bn_momentum: float = 0.9
     bn_eps: float = 1e-5
+    # Space-to-depth stem: evaluate the 7x7/s2 stem conv as an equivalent
+    # 4x4/s1 conv on a 2x2-space-to-depth input.  cin=3 stride-2 convs
+    # tile poorly onto the MXU (3 of 128 lanes, strided access); the
+    # reparameterization is bit-equivalent up to conv algorithm choice
+    # and is the standard TPU trick for convnet stems.  Params are stored
+    # in the original [7,7,3,w] shape either way, so checkpoints are
+    # interchangeable.
+    stem_s2d: bool = True
 
     @property
     def stage_blocks(self):
@@ -125,6 +133,37 @@ def _conv(x, w, stride, config):
     )
 
 
+def _stem_conv(x, w, config):
+    """The 7x7/s2 stem conv, optionally via space-to-depth.
+
+    Derivation: out[i,j] = sum_{di,dj in 0..6} x[2i+di-2, 2j+dj-2] w[di,dj]
+    (SAME padding for k=7,s=2 is (2,3)).  Substituting the s2d coordinates
+    u = 2a+p gives di = 2b'+p with b' in 0..3 and an input offset of
+    a = i+b'-1, i.e. a 4x4 stride-1 conv over the [N,112,112,12] s2d image
+    with padding (1,2) and kernel w_s2d[b',c',(p,q,ch),o] = w8[2b'+p,
+    2c'+q, ch, o] where w8 is w zero-padded to 8x8 taps.
+    """
+    n, h, wdt, c = x.shape
+    # odd spatial sizes don't factor into 2x2 space-to-depth tiles; the
+    # dense SAME-padded conv handles them (s2d is a perf reparam, not a
+    # semantic change)
+    if not config.stem_s2d or h % 2 or wdt % 2:
+        return _conv(x, w, 2, config)
+    x = x.astype(config.compute_dtype)
+    # [N,H,W,3] -> [N,H/2,W/2,12] with channel order (p,q,ch)
+    x2 = x.reshape(n, h // 2, 2, wdt // 2, 2, c)
+    x2 = x2.transpose(0, 1, 3, 2, 4, 5).reshape(n, h // 2, wdt // 2, 4 * c)
+    w8 = jnp.pad(w.astype(config.compute_dtype),
+                 ((0, 1), (0, 1), (0, 0), (0, 0)))
+    cout = w.shape[-1]
+    w2 = w8.reshape(4, 2, 4, 2, c, cout)
+    w2 = w2.transpose(0, 2, 1, 3, 4, 5).reshape(4, 4, 4 * c, cout)
+    return lax.conv_general_dilated(
+        x2, w2, window_strides=(1, 1), padding=((1, 2), (1, 2)),
+        dimension_numbers=_CONV_DN,
+    )
+
+
 def _batch_norm(x, p, s, config, train: bool):
     if train:
         # Batch statistics via fp32-ACCUMULATING reductions directly on the
@@ -181,7 +220,7 @@ def apply(params, state, images, config: ResNetConfig = ResNetConfig(),
     Returns ``(logits_fp32, new_state)``.
     """
     x = images.astype(config.compute_dtype)
-    x = _conv(x, params["conv_stem"], 2, config)
+    x = _stem_conv(x, params["conv_stem"], config)
     x, stem_s = _batch_norm(x, params["bn_stem"], state["bn_stem"], config, train)
     x = jax.nn.relu(x)
     x = lax.reduce_window(

@@ -75,9 +75,34 @@ def allreduce(tensor, axis_name: str, average: bool = True, op: str = "sum"):
     raise ValueError(f"unknown op {op!r}")
 
 
-def grouped_allreduce(tensors, axis_name: str, average: bool = True):
-    """Allreduce a pytree in one logical group (XLA fuses the collectives —
-    the compiled-path analog of the reference's fusion buffer).
+def _bucket_bytes() -> int:
+    """Bucket size for grouped reductions — the compiled-path analog of the
+    reference's fusion-buffer threshold, honoring the same env knob
+    (``HOROVOD_FUSION_THRESHOLD``, default 64 MB;
+    ``/root/reference/horovod/common/operations.cc:1838``)."""
+    import os
+
+    for name in ("HOROVOD_TPU_FUSION_THRESHOLD", "HOROVOD_FUSION_THRESHOLD"):
+        v = os.environ.get(name)
+        if v:
+            return max(int(v), 1)
+    return 64 * 1024 * 1024
+
+
+def grouped_allreduce(tensors, axis_name: str, average: bool = True,
+                      bucket_bytes: int | None = None):
+    """Allreduce a pytree in fusion-threshold-sized buckets.
+
+    A whole-tree ``psum`` lowers to ONE variadic all-reduce that depends on
+    every gradient leaf — it cannot start until the entire backward pass is
+    done, so no compute/communication overlap is possible (the reference's
+    background thread exists precisely to avoid this:
+    ``/root/reference/horovod/common/operations.cc:1466-1487``).  Bucketing
+    emits one all-reduce per ≤``bucket_bytes`` group of leaves; each bucket
+    depends only on its own leaves, so XLA's scheduler can launch a ready
+    bucket's collective while the rest of the backward is still computing.
+    ``bucket_bytes`` defaults to the reference's 64 MB fusion threshold
+    (``HOROVOD_FUSION_THRESHOLD`` honored).
 
     Leaves that are provably invariant over ``axis_name`` (JAX AD already
     inserted the global psum when differentiating wrt replicated parameters
@@ -86,16 +111,29 @@ def grouped_allreduce(tensors, axis_name: str, average: bool = True):
     double-count.  Rank-local (varying) leaves get the classic Horovod
     treatment: psum, then divide by world size when ``average``.
     """
+    if bucket_bytes is None:
+        bucket_bytes = _bucket_bytes()
     flat, treedef = jax.tree.flatten(tensors)
     local_flags = [is_rank_local(t, axis_name) for t in flat]
-    to_reduce = tuple(t for t, loc in zip(flat, local_flags) if loc is not False)
-    if to_reduce:
-        reduced = lax.psum(to_reduce, axis_name)
-        if average:
-            n = lax.axis_size(axis_name)
-            reduced = tuple(t / n for t in reduced)
-    else:
-        reduced = ()
+    to_reduce = [t for t, loc in zip(flat, local_flags) if loc is not False]
+    reduced = []
+    bucket, used = [], 0
+    def flush():
+        nonlocal bucket, used
+        if bucket:
+            out = lax.psum(tuple(bucket), axis_name)
+            if average:
+                n = lax.axis_size(axis_name)
+                out = tuple(t / n for t in out)
+            reduced.extend(out)
+            bucket, used = [], 0
+    for t in to_reduce:
+        nbytes = t.size * t.dtype.itemsize
+        if bucket and used + nbytes > bucket_bytes:
+            flush()
+        bucket.append(t)
+        used += nbytes
+    flush()
     it = iter(reduced)
     out = [t if loc is False else next(it) for t, loc in zip(flat, local_flags)]
     return jax.tree.unflatten(treedef, out)

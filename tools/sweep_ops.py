@@ -1,0 +1,154 @@
+"""Marginal-rate sweep of ResNet-suspect ops (fwd+bwd) on the live TPU.
+
+Attribution companion to tools/profile_resnet.py: times each suspect op
+with the overhead-cancelling two-length scan method from tpu_measure.py.
+Run: python tools/sweep_ops.py [names...]
+"""
+
+from __future__ import annotations
+
+import sys
+
+from tpu_measure import marginal  # noqa: E402  (sets up cache + path)
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+def _grad_chain(f, x, L):
+    """Chained fwd+bwd of f: carry the gradient back in as input."""
+    def body(c, _):
+        g = jax.grad(lambda a: jnp.sum(f(a).astype(jnp.float32)) * 1e-6)(c)
+        return g.astype(c.dtype), ()
+    y = lax.scan(body, x, None, length=L)[0]
+    return jnp.sum(y[:1].astype(jnp.float32))
+
+
+def op_case(name):
+    B = 256
+    if name == "stem":
+        # 7x7 s2 cin=3 + maxpool — the known MXU-hostile block
+        x = jax.random.normal(jax.random.key(0), (B, 224, 224, 3),
+                              jnp.bfloat16)
+        w = jax.random.normal(jax.random.key(1), (7, 7, 3, 64),
+                              jnp.bfloat16) * 0.01
+
+        def f(a):
+            y = lax.conv_general_dilated(
+                a, w, (2, 2), "SAME",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+            return y
+        flops = 3 * 2 * B * 112 * 112 * 7 * 7 * 3 * 64
+
+        def shaped(a):  # keep carry shape: project back
+            return f(a)
+        def mk(L):
+            def g():
+                def body(c, _):
+                    gr = jax.grad(lambda a: jnp.sum(
+                        f(a).astype(jnp.float32)) * 1e-6)(c)
+                    return gr.astype(c.dtype), ()
+                y = lax.scan(body, x, None, length=L)[0]
+                return jnp.sum(y[:1].astype(jnp.float32))
+            return g
+        return mk, flops
+    if name == "maxpool":
+        x = jax.random.normal(jax.random.key(0), (B, 112, 112, 64),
+                              jnp.bfloat16)
+
+        def f(a):
+            return lax.reduce_window(a, -jnp.inf, lax.max, (1, 3, 3, 1),
+                                     (1, 2, 2, 1), "SAME")
+        def mk(L):
+            def g():
+                return _grad_chain(f, x, L)
+            return g
+        return mk, 0  # memory-bound: report ms only
+    if name == "conv_s2":
+        C = 128
+        x = jax.random.normal(jax.random.key(0), (B, 56, 56, C), jnp.bfloat16)
+        w = jax.random.normal(jax.random.key(1), (3, 3, C, C),
+                              jnp.bfloat16) * 0.01
+
+        def f(a):
+            y = lax.conv_general_dilated(
+                a, w, (2, 2), "SAME",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+            # transpose back up so the carry keeps its shape: use the vjp
+            return y
+        flops = 3 * 2 * B * 28 * 28 * 3 * 3 * C * C
+        def mk(L):
+            def g():
+                def body(c, _):
+                    gr = jax.grad(lambda a: jnp.sum(
+                        f(a).astype(jnp.float32)) * 1e-6)(c)
+                    return gr.astype(c.dtype), ()
+                y = lax.scan(body, x, None, length=L)[0]
+                return jnp.sum(y[:1].astype(jnp.float32))
+            return g
+        return mk, flops
+    if name.startswith("conv1x1_"):
+        cin, cout = {"conv1x1_64_256": (64, 256),
+                     "conv1x1_256_64": (256, 64),
+                     "conv1x1_2048": (2048, 512)}[name]
+        H = 56 if max(cin, cout) <= 256 else 7
+        x = jax.random.normal(jax.random.key(0), (B, H, H, cin), jnp.bfloat16)
+        w = jax.random.normal(jax.random.key(1), (1, 1, cin, cout),
+                              jnp.bfloat16) * 0.01
+        wb = jax.random.normal(jax.random.key(2), (1, 1, cout, cin),
+                               jnp.bfloat16) * 0.01
+
+        def f2(a):
+            y = lax.conv_general_dilated(
+                a, w, (1, 1), "SAME",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+            return lax.conv_general_dilated(
+                y, wb, (1, 1), "SAME",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        flops = 3 * 2 * B * H * H * cin * cout * 2
+        def mk(L):
+            def g():
+                def body(c, _):
+                    gr = jax.grad(lambda a: jnp.sum(
+                        f2(a).astype(jnp.float32)) * 1e-6)(c)
+                    return gr.astype(c.dtype), ()
+                y = lax.scan(body, x, None, length=L)[0]
+                return jnp.sum(y[:1].astype(jnp.float32))
+            return g
+        return mk, flops
+    if name == "bn":
+        # train-mode BN fwd+bwd at a stage-1 shape (per-pass cost)
+        C = 256
+        x = jax.random.normal(jax.random.key(0), (B, 56, 56, C), jnp.bfloat16)
+        scale = jnp.ones((C,), jnp.float32)
+        bias = jnp.zeros((C,), jnp.float32)
+
+        def f(a):
+            mean = jnp.mean(a, axis=(0, 1, 2), dtype=jnp.float32)
+            mean_sq = jnp.mean(jnp.square(a.astype(jnp.float32)),
+                               axis=(0, 1, 2), dtype=jnp.float32)
+            var = jnp.maximum(mean_sq - jnp.square(mean), 0.0)
+            inv = lax.rsqrt(var + 1e-5) * scale
+            shift = bias - mean * inv
+            return a * inv.astype(a.dtype) + shift.astype(a.dtype)
+        def mk(L):
+            def g():
+                return _grad_chain(f, x, L)
+            return g
+        return mk, 0
+    raise KeyError(name)
+
+
+CASES = ["stem", "maxpool", "conv_s2", "conv1x1_64_256", "bn"]
+
+if __name__ == "__main__":
+    names = sys.argv[1:] or CASES
+    for n in names:
+        mk, flops = op_case(n)
+        per, ovh = marginal(mk, 4, 12)
+        msg = f"{n}: {per*1e3:.2f} ms/iter (call overhead {ovh*1e3:.0f} ms)"
+        if flops:
+            msg += f" = {flops/per/1e12:.1f} TF/s"
+        print(msg, flush=True)

@@ -1,21 +1,19 @@
 """Marginal-rate measurement on the (tunneled, possibly time-sliced) TPU.
 
-The axon backend carries a large, *variable* per-program-dispatch overhead
-(measured 16-110 ms) that inflates any per-step timing built from short
-programs.  The honest estimator is the **marginal rate**: time a K1-step
-and a K2-step in-program `lax.scan` of the same body and divide the time
-difference by (K2-K1).  Constant per-call overhead cancels exactly;
-interleaving the two lengths guards against tenancy drift.
+Thin interactive wrapper over the canonical implementation in bench.py
+(single source of truth for the method — see its module docstring): the
+axon backend carries a large, variable per-program-call overhead
+(16-110 ms), so honest per-step numbers come from timing a K1-step and a
+K2-step in-program ``lax.scan`` and dividing the difference by (K2-K1).
 
 Every entry point enables the persistent compilation cache (compiles over
-the tunnel cost 20-60 s; cache hits are free).
+the tunnel cost 20-120 s; cache hits are free).
 """
 
 from __future__ import annotations
 
 import os
 import sys
-import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
@@ -26,98 +24,20 @@ jax.config.update("jax_compilation_cache_dir", os.path.join(REPO, ".jax_cache"))
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
 
-import jax.numpy as jnp
-import numpy as np
-from jax import lax
+from bench import (  # noqa: E402  (canonical measurement core)
+    _train_marginal, _warm, marginal,
+    measure_conv_roofline, measure_matmul_roofline,
+)
 
 
-def marginal(mk, L1=4, L2=12, iters=5):
-    """mk(L) -> nullary jitted-able fn returning a scalar.  Returns
-    (per_iter_seconds, per_call_overhead_seconds)."""
-    g1, g2 = jax.jit(mk(L1)), jax.jit(mk(L2))
-    float(jax.device_get(g1()))
-    float(jax.device_get(g2()))
-    t1s, t2s = [], []
-    for _ in range(iters):
-        t0 = time.perf_counter()
-        float(jax.device_get(g1()))
-        t1s.append(time.perf_counter() - t0)
-        t0 = time.perf_counter()
-        float(jax.device_get(g2()))
-        t2s.append(time.perf_counter() - t0)
-    t1, t2 = float(np.median(t1s)), float(np.median(t2s))
-    per = (t2 - t1) / (L2 - L1)
-    return per, t1 - L1 * per
-
-
-def matmul_roofline(N=8192, L1=4, L2=12):
-    b = jax.random.normal(jax.random.key(0), (N, N), jnp.bfloat16)
-
-    def mk(L):
-        def f():
-            y = lax.scan(lambda c, _: (c @ b, ()), b, None, length=L)[0]
-            return jnp.sum(y[:1, :1].astype(jnp.float32))
-        return f
-
-    per, ovh = marginal(mk, L1, L2)
-    return 2 * N**3 / per / 1e12, ovh
-
-
-def conv_roofline(B=256, H=28, W=28, C=512, k=3, L1=8, L2=24):
-    x = jax.random.normal(jax.random.key(0), (B, H, W, C), jnp.bfloat16)
-    w = jax.random.normal(jax.random.key(1), (k, k, C, C), jnp.bfloat16) * 0.01
-
-    def mk(L):
-        def f():
-            def body(c, _):
-                return lax.conv_general_dilated(
-                    c, w, (1, 1), "SAME",
-                    dimension_numbers=("NHWC", "HWIO", "NHWC")) * 0.1, ()
-            y = lax.scan(body, x, None, length=L)[0]
-            return jnp.sum(y[:1, :1, :1].astype(jnp.float32))
-        return f
-
-    per, ovh = marginal(mk, L1, L2)
-    return 2 * B * H * W * k * k * C * C / per / 1e12, ovh
-
-
-def train_marginal(step_fn, init_carry, K1=4, K2=12, iters=5):
-    """step_fn(carry) -> (carry, scalar_loss).  Returns per-step seconds."""
-    def mk(K):
-        def f(carry):
-            def body(c, _):
-                c2, loss = step_fn(c)
-                return c2, loss
-            _, losses = lax.scan(body, carry, None, length=K)
-            return losses[-1]
-        return jax.jit(f)
-
-    g1, g2 = mk(K1), mk(K2)
-    float(jax.device_get(g1(init_carry)))
-    float(jax.device_get(g2(init_carry)))
-    t1s, t2s = [], []
-    for _ in range(iters):
-        t0 = time.perf_counter()
-        float(jax.device_get(g1(init_carry)))
-        t1s.append(time.perf_counter() - t0)
-        t0 = time.perf_counter()
-        float(jax.device_get(g2(init_carry)))
-        t2s.append(time.perf_counter() - t0)
-    t1, t2 = float(np.median(t1s)), float(np.median(t2s))
-    return (t2 - t1) / (K2 - K1)
+def train_marginal(step_fn, init_carry, K1=4, K2=12, iters=4):
+    """Marginal per-step seconds of a (carry)->(carry, loss) train step."""
+    return _train_marginal(step_fn, init_carry, K1, K2, iters)[0]
 
 
 if __name__ == "__main__":
     which = sys.argv[1] if len(sys.argv) > 1 else "all"
     if which in ("all", "matmul"):
-        tf, ovh = matmul_roofline()
-        print(f"matmul marginal: {tf:.1f} TF/s (overhead {ovh*1e3:.0f} ms)",
-              flush=True)
+        print("matmul:", measure_matmul_roofline(None), flush=True)
     if which in ("all", "conv"):
-        tf, ovh = conv_roofline()
-        print(f"conv512 marginal: {tf:.1f} TF/s (overhead {ovh*1e3:.0f} ms)",
-              flush=True)
-    if which in ("all", "conv64"):
-        tf, ovh = conv_roofline(B=256, H=56, W=56, C=64)
-        print(f"conv64 marginal: {tf:.1f} TF/s (overhead {ovh*1e3:.0f} ms)",
-              flush=True)
+        print("conv:", measure_conv_roofline(None), flush=True)
