@@ -457,6 +457,18 @@ def scaling_worker(args):
     hvd.shutdown()
 
 
+def _run_json_subprocess(cmd: list, env: dict, timeout: int = 300) -> dict:
+    """Run a worker subprocess and parse the last JSON line it prints."""
+    try:
+        out = subprocess.run(cmd, env=env, cwd=REPO, capture_output=True,
+                             text=True, timeout=timeout)
+        line = [ln for ln in out.stdout.splitlines()
+                if ln.startswith("{")][-1]
+        return json.loads(line)
+    except Exception as exc:  # noqa: BLE001 - report, don't die
+        return {"error": f"{type(exc).__name__}: {exc}"[:200]}
+
+
 def _run_worker(n: int, worker_args: list) -> dict:
     """Launch this file's worker mode under ``horovod_tpu.run -np n`` on
     the CPU backend (the engine is host-side) and parse its JSON line."""
@@ -464,14 +476,7 @@ def _run_worker(n: int, worker_args: list) -> dict:
     env["JAX_PLATFORMS"] = "cpu"
     cmd = [sys.executable, "-m", "horovod_tpu.run", "-np", str(n),
            sys.executable, os.path.abspath(__file__)] + worker_args
-    try:
-        out = subprocess.run(cmd, env=env, cwd=REPO, capture_output=True,
-                             text=True, timeout=300)
-        line = [ln for ln in out.stdout.splitlines()
-                if ln.startswith("{")][-1]
-        return json.loads(line)
-    except Exception as exc:  # noqa: BLE001 - report, don't die
-        return {"error": f"{type(exc).__name__}: {exc}"[:200]}
+    return _run_json_subprocess(cmd, env)
 
 
 def bench_scaling(args):
@@ -504,6 +509,72 @@ def bench_scaling(args):
     results["note"] = ("single-host loopback weak scaling; points beyond "
                        "the core count are omitted as invalid")
     return results
+
+
+def pipeline_worker(args):
+    """Subprocess (CPU, 8 virtual devices): compare GPipe vs 1F1B pipeline
+    schedules at pp=2 — step time, compiled temp memory at two microbatch
+    counts (1F1B's activation footprint must stay flat in M), and the
+    closed-form bubble fractions."""
+    import jax
+    import jax.numpy as jnp
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from horovod_tpu import parallel
+
+    mesh = parallel.make_mesh({"pp": 2}, jax.devices("cpu")[:2])
+    D, M, B = 128, 16, 8
+
+    def stage_fn(w, x):
+        return jnp.tanh(jnp.tanh(x @ w[0]) @ w[0].T)
+
+    def loss_fn(y, t):
+        return jnp.mean((y - t) ** 2)
+
+    def make(schedule):
+        return jax.jit(shard_map(
+            lambda w, x, t: parallel.pipeline_train(
+                stage_fn, loss_fn, w, x, t, "pp", schedule=schedule),
+            mesh=mesh, in_specs=(P("pp"), P(), P()),
+            out_specs=(P(), P("pp")), check_vma=False))
+
+    ws = jax.random.normal(jax.random.key(0), (2, D, D), jnp.float32) * 0.1
+    out = {}
+    for sched in ("gpipe", "1f1b"):
+        f = make(sched)
+        xs = jax.random.normal(jax.random.key(1), (M, B, D), jnp.float32)
+        ts = jax.random.normal(jax.random.key(2), (M, B, D), jnp.float32)
+        _, g = f(ws, xs, ts)
+        jax.block_until_ready(g)
+        t0 = time.perf_counter()
+        for _ in range(10):
+            _, g = f(ws, xs, ts)
+        jax.block_until_ready(g)
+        entry = {"step_ms": round((time.perf_counter() - t0) / 10 * 1e3, 2),
+                 "bubble_fraction": round(
+                     parallel.bubble_fraction(2, M, sched), 4)}
+        mems = {}
+        for m in (8, 32):
+            xs2 = jnp.zeros((m, B, D), jnp.float32)
+            ts2 = jnp.zeros((m, B, D), jnp.float32)
+            mem = make(sched).lower(ws, xs2, ts2).compile().memory_analysis()
+            mems[str(m)] = getattr(mem, "temp_size_in_bytes", None)
+        entry["temp_bytes_by_microbatches"] = mems
+        out[sched] = entry
+    print(json.dumps(out), flush=True)
+
+
+def bench_pipeline():
+    """Run the pipeline-schedule comparison in a CPU subprocess (the main
+    process owns the TPU backend; the virtual 8-device mesh needs
+    xla_force_host_platform_device_count before jax init)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = ("--xla_force_host_platform_device_count=8 "
+                        + env.get("XLA_FLAGS", ""))
+    cmd = [sys.executable, os.path.abspath(__file__), "--pipeline-worker"]
+    return _run_json_subprocess(cmd, env, timeout=600)
 
 
 def measure_hlo_overlap():
@@ -613,6 +684,9 @@ def main() -> None:
                     help=argparse.SUPPRESS)
     ap.add_argument("--scaling-worker", action="store_true",
                     help=argparse.SUPPRESS)
+    ap.add_argument("--pipeline-worker", action="store_true",
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--skip-pipeline", action="store_true")
     ap.add_argument("--scal-iters", type=int, default=50)
     ap.add_argument("--mlp-hidden", type=int, default=512)
     ap.add_argument("--cpu", action="store_true",
@@ -624,6 +698,9 @@ def main() -> None:
         return
     if args.scaling_worker:
         scaling_worker(args)
+        return
+    if args.pipeline_worker:
+        pipeline_worker(args)
         return
 
     # persistent compilation cache: compiles over tunneled backends cost
@@ -703,6 +780,7 @@ def main() -> None:
     allreduce = {} if args.skip_allreduce else bench_allreduce(args)
     scaling = {} if args.skip_scaling else bench_scaling(args)
     overlap = {} if args.skip_overlap else measure_hlo_overlap()
+    pipeline = {} if args.skip_pipeline else bench_pipeline()
 
     primary = models["resnet50"]
     print(json.dumps({
@@ -730,6 +808,7 @@ def main() -> None:
         "allreduce_busbw": allreduce,
         "eager_dp_scaling": scaling,
         "compiled_overlap": overlap,
+        "pipeline_schedules": pipeline,
     }))
 
 

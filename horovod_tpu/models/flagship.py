@@ -77,7 +77,8 @@ def param_specs(config: FlagshipConfig, pp="pp", fsdp="fsdp", tp="tp",
                 ep="sp"):
     """PartitionSpec pytree: llama specs with the layer-stack dim re-labeled
     ``pp`` (each stage owns its layer slice), MoE experts sharded over the
-    ``ep`` alias axis."""
+    ``ep`` axis — by default the conventional alias onto ``sp``; pass
+    ``ep="ep"`` for a dedicated expert axis (meshes with ep > 1)."""
     specs = llama.param_specs(config.llama, fsdp=fsdp, tp=tp)
     # vocab-sharded embedding + token gather trips an XLA SPMD partitioner
     # CHECK on some backends; shard the feature dim instead (same memory
@@ -95,7 +96,10 @@ def param_specs(config: FlagshipConfig, pp="pp", fsdp="fsdp", tp="tp",
 
 
 def data_specs(batch_axes=("dp", "fsdp"), sp="sp"):
-    """tokens [B, T]: batch over the data axes, sequence over sp."""
+    """tokens [B, T]: batch over the data axes, sequence over sp.  With a
+    dedicated expert axis include it in the batch group
+    (``batch_axes=("dp", "fsdp", "ep")``) so expert all-to-alls route
+    between batch shards."""
     return P(batch_axes, sp)
 
 
@@ -114,6 +118,9 @@ def build_train_step(mesh, config: FlagshipConfig, optimizer,
     c = config.llama
     n_stages = mesh.shape["pp"]
     M = config.microbatches
+    # dedicated expert axis when the mesh carries one; otherwise the
+    # conventional alias onto sp (the expert group = the sequence group)
+    distinct_ep = dict(mesh.shape).get("ep", 1) > 1
     if attn_mode == "auto":
         try:
             import jax as _jax
@@ -148,18 +155,23 @@ def build_train_step(mesh, config: FlagshipConfig, optimizer,
 
         x, _ = lax.scan(jax.checkpoint(body), x, dense_stack)
 
-        # MoE FFN with expert parallelism over the sp axis group (nested
-        # sp-manual region; context mesh).  The load-balancing aux loss is
-        # dropped here — GPipe stages can only forward activations, and the
-        # flagship step optimizes the LM loss (use moe_layer directly for
-        # aux-weighted training).
+        # MoE FFN: expert parallelism over a DEDICATED ep axis when the
+        # mesh has one (tokens route between batch shards — the expert
+        # group is its own gang), else the conventional alias onto the sp
+        # axis group (nested manual region; context mesh).  The
+        # load-balancing aux loss is dropped here — GPipe stages can only
+        # forward activations, and the flagship step optimizes the LM loss
+        # (use moe_layer directly for aux-weighted training).
         moe_params = jax.tree.map(lambda p: p[0], stage_params["moe"])
+        ep_axis = "ep" if distinct_ep else "sp"
+        x_spec = P("ep", None) if distinct_ep else P(None, "sp")
         y, _ = jax.shard_map(
-            lambda mp, x: moe_lib.moe_layer(mp, x, moe_cfg, axis_name="sp"),
-            in_specs=({"gate": P(), "w_in": P("sp"), "w_out": P("sp")},
-                      P(None, "sp")),
-            out_specs=(P(None, "sp"), P()),
-            axis_names=frozenset({"sp"}),
+            lambda mp, x: moe_lib.moe_layer(mp, x, moe_cfg,
+                                            axis_name=ep_axis),
+            in_specs=({"gate": P(), "w_in": P(ep_axis),
+                       "w_out": P(ep_axis)}, x_spec),
+            out_specs=(x_spec, P()),
+            axis_names=frozenset({ep_axis}),
             check_vma=False,
             **({} if smap_mesh is None else {"mesh": smap_mesh}),
         )(moe_params, x)

@@ -33,8 +33,10 @@ from horovod_tpu.parallel.ring_attention import (
     ulysses_attention,
 )
 from horovod_tpu.parallel.pipeline import (
+    bubble_fraction,
     pipeline_apply,
     pipeline_loss,
+    pipeline_train,
     stage_split,
 )
 from horovod_tpu.parallel import moe
@@ -45,6 +47,7 @@ __all__ = [
     "shard",
     "allgather_kv_attention", "local_flash_attention", "make_ring_attn_fn",
     "ring_attention", "sequence_parallel_attn_fn", "ulysses_attention",
-    "pipeline_apply", "pipeline_loss", "stage_split",
+    "bubble_fraction", "pipeline_apply", "pipeline_loss", "pipeline_train",
+    "stage_split",
     "moe",
 ]

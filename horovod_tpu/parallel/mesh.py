@@ -14,10 +14,11 @@ Axis vocabulary (canonical order, outermost/slowest first):
 * ``dp``   — pure data parallelism (gradient psum)
 * ``fsdp`` — data parallel with ZeRO-3 parameter sharding (all-gather heavy)
 * ``sp``   — sequence/context parallelism (ring attention traffic)
+* ``ep``   — expert parallelism (alltoall traffic: keep on fast ICI, next
+  to tp; may also be aliased onto the fsdp/sp axis group instead of being
+  a separate axis — both arrangements are supported)
 * ``tp``   — tensor parallelism (activation allreduce every layer: keep on
   fastest ICI, so innermost)
-* ``ep``   — expert parallelism (alltoall; conventionally aliased onto the
-  fsdp/sp axis group rather than a separate one)
 """
 
 from __future__ import annotations
@@ -28,7 +29,7 @@ from typing import Mapping, Sequence
 
 import numpy as np
 
-AXIS_ORDER = ("pp", "dp", "fsdp", "sp", "tp")
+AXIS_ORDER = ("pp", "dp", "fsdp", "sp", "ep", "tp")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -44,11 +45,12 @@ class MeshSpec:
     dp: int = 1
     fsdp: int = 1
     sp: int = 1
+    ep: int = 1
     tp: int = 1
 
     @property
     def size(self) -> int:
-        return self.pp * self.dp * self.fsdp * self.sp * self.tp
+        return self.pp * self.dp * self.fsdp * self.sp * self.ep * self.tp
 
     def axis_sizes(self) -> dict[str, int]:
         return {a: getattr(self, a) for a in AXIS_ORDER}
@@ -59,17 +61,18 @@ class MeshSpec:
         return _topo_make_mesh(self.axis_sizes(), devices)
 
 
-def auto_spec(n_devices: int, *, pp: int = 1, sp: int = 1, tp: int = 1,
-              prefer_fsdp: bool = True) -> MeshSpec:
+def auto_spec(n_devices: int, *, pp: int = 1, sp: int = 1, ep: int = 1,
+              tp: int = 1, prefer_fsdp: bool = True) -> MeshSpec:
     """Factor ``n_devices`` into a :class:`MeshSpec`, fixing any axes given
     and assigning the remainder to fsdp (ZeRO-3 default) or dp."""
-    fixed = pp * sp * tp
+    fixed = pp * sp * ep * tp
     if n_devices % fixed != 0:
-        raise ValueError(f"{n_devices} devices not divisible by pp*sp*tp={fixed}")
+        raise ValueError(
+            f"{n_devices} devices not divisible by pp*sp*ep*tp={fixed}")
     rest = n_devices // fixed
     if prefer_fsdp:
-        return MeshSpec(pp=pp, dp=1, fsdp=rest, sp=sp, tp=tp)
-    return MeshSpec(pp=pp, dp=rest, fsdp=1, sp=sp, tp=tp)
+        return MeshSpec(pp=pp, dp=1, fsdp=rest, sp=sp, ep=ep, tp=tp)
+    return MeshSpec(pp=pp, dp=rest, fsdp=1, sp=sp, ep=ep, tp=tp)
 
 
 def make_mesh(axes: Mapping[str, int] | MeshSpec | None = None,

@@ -21,13 +21,17 @@ def _setup(mesh, batch: int = 4):
     cfg = flagship.FlagshipConfig(llama=lc, n_experts=4, d_ff_moe=32,
                                   microbatches=2)
     params = flagship.init(jax.random.key(0), cfg, n_stages=mesh.shape["pp"])
-    params = parallel.shard(params, flagship.param_specs(cfg), mesh)
+    distinct_ep = dict(mesh.shape).get("ep", 1) > 1
+    ep = "ep" if distinct_ep else "sp"
+    batch_axes = ("dp", "fsdp", "ep") if distinct_ep else ("dp", "fsdp")
+    params = parallel.shard(params, flagship.param_specs(cfg, ep=ep), mesh)
     opt = optax.adam(1e-2)
     opt_state = opt.init(params)
     tokens = jnp.asarray(
         np.random.RandomState(0).randint(0, 128, (batch, 16)), jnp.int32)
     tokens = jax.device_put(
-        tokens, NamedSharding(mesh, flagship.data_specs()))
+        tokens,
+        NamedSharding(mesh, flagship.data_specs(batch_axes=batch_axes)))
     return cfg, params, opt, opt_state, tokens
 
 
@@ -54,6 +58,38 @@ def test_flagship_dp_fsdp_trains(cpu8):
         losses.append(float(loss))
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0], losses
+
+
+def test_flagship_distinct_expert_axis_trains(cpu8):
+    """Dedicated ep axis (dp2 x ep2 x tp2): the MoE all_to_all routes
+    across its own gang, not the sp group (round-2 verdict item 8)."""
+    mesh = parallel.MeshSpec(pp=1, dp=2, fsdp=1, sp=1, ep=2, tp=2).build(cpu8)
+    assert dict(mesh.shape)["ep"] == 2
+    cfg, params, opt, opt_state, tokens = _setup(mesh, batch=8)
+    step = jax.jit(flagship.build_train_step(mesh, cfg, opt))
+    losses = []
+    for _ in range(10):
+        params, opt_state, loss = step(params, opt_state, tokens)
+        losses.append(float(loss))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses
+
+
+def test_flagship_ep_matches_aliased(cpu8):
+    """First-step loss agrees between a dedicated-ep mesh and an
+    sp-aliased mesh — the expert-axis choice is a layout decision, not a
+    semantic one."""
+    mesh_ep = parallel.MeshSpec(pp=1, dp=2, fsdp=1, sp=1, ep=2,
+                                tp=2).build(cpu8)
+    mesh_sp = parallel.MeshSpec(pp=1, dp=2, fsdp=1, sp=2, ep=1,
+                                tp=2).build(cpu8)
+    losses = []
+    for mesh in (mesh_ep, mesh_sp):
+        cfg, params, opt, opt_state, tokens = _setup(mesh, batch=8)
+        step = jax.jit(flagship.build_train_step(mesh, cfg, opt))
+        _, _, loss = step(params, opt_state, tokens)
+        losses.append(float(loss))
+    assert abs(losses[0] - losses[1]) < 1e-3, losses
 
 
 def test_flagship_matches_across_meshes(cpu8):
