@@ -1,3 +1,33 @@
+# ---------------------------------------------------------------------------
+# frontends-ci: real-MXNet + real-pyspark validation stage
+# (round-2 verdict #6: mxnet has no py3.12 wheels — the project is retired,
+# 1.9.x supports <=3.10 — and pyspark needs a JVM; neither can run in the
+# py3.12/no-JVM dev image, so this stage is the reproducible home for those
+# suites: build with  docker build --target frontends-ci .
+# ---------------------------------------------------------------------------
+FROM python:3.10-slim-bookworm AS frontends-ci
+
+RUN apt-get update && apt-get install -y --no-install-recommends \
+        g++ make default-jre-headless \
+    && rm -rf /var/lib/apt/lists/*
+
+# mxnet 1.9.x needs numpy<2; pyspark local[2] needs only the JRE above
+RUN pip install --no-cache-dir "numpy<2" "mxnet==1.9.1" pyspark \
+        jax optax orbax-checkpoint ml_dtypes einops pytest
+
+WORKDIR /horovod_tpu
+COPY . .
+RUN pip install --no-cache-dir .
+
+# the suites the dev image must skip: real-Gluon frontend bindings, the
+# Spark launcher over a local[2] SparkContext, and their examples (the
+# TF-gated tests in these files self-skip — no TF in this stage)
+RUN python -m pytest tests/test_tf_mxnet_frontends.py \
+        tests/test_mxnet_conformance.py tests/test_spark_launcher.py -q \
+    && python -m pytest "tests/test_examples.py::test_mxnet_example_single" \
+        "tests/test_examples.py::test_mxnet_mnist_2proc" \
+        "tests/test_examples.py::test_keras_spark_mnist" -q
+
 # horovod_tpu runtime image.
 #
 # Role analog of the reference's Dockerfile (CUDA + framework + OpenMPI

@@ -512,10 +512,10 @@ def bench_scaling(args):
 
 
 def pipeline_worker(args):
-    """Subprocess (CPU, 8 virtual devices): compare GPipe vs 1F1B pipeline
-    schedules at pp=2 — step time, compiled temp memory at two microbatch
-    counts (1F1B's activation footprint must stay flat in M), and the
-    closed-form bubble fractions."""
+    """Subprocess (CPU backend): compare GPipe vs 1F1B pipeline schedules
+    on a 2-device pp=2 mesh — step time, compiled temp memory at two
+    microbatch counts (1F1B's activation footprint must stay flat in M),
+    and the closed-form bubble fractions."""
     import jax
     import jax.numpy as jnp
     from jax import shard_map
@@ -571,8 +571,12 @@ def bench_pipeline():
     xla_force_host_platform_device_count before jax init)."""
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
-    env["XLA_FLAGS"] = ("--xla_force_host_platform_device_count=8 "
-                        + env.get("XLA_FLAGS", ""))
+    # strip any inherited device-count flag: XLA flag parsing is
+    # last-occurrence-wins, so a pre-existing value would override ours
+    inherited = [f for f in env.get("XLA_FLAGS", "").split()
+                 if not f.startswith("--xla_force_host_platform_device_count")]
+    env["XLA_FLAGS"] = " ".join(
+        inherited + ["--xla_force_host_platform_device_count=8"])
     cmd = [sys.executable, os.path.abspath(__file__), "--pipeline-worker"]
     return _run_json_subprocess(cmd, env, timeout=600)
 

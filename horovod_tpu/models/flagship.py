@@ -12,10 +12,10 @@ on a MoE-augmented Llama-style transformer:
   shard_map bound to the context mesh).
 * **tp**   — Megatron-style head/ffn sharding via the llama param specs
   (auto axis; XLA inserts the activation psums).
-* **ep**   — each stage ends with a mixture-of-experts FFN whose experts
-  shard over the ``sp`` axis group (the conventional aliasing of expert
-  parallelism onto the sequence/data axis group), tokens routed by
-  ``all_to_all``.
+* **ep**   — each stage ends with a mixture-of-experts FFN; experts shard
+  over a dedicated ``ep`` mesh axis when the mesh carries one (tokens
+  batch-sharded over the expert gang), else over the ``sp`` axis group
+  (the conventional aliasing), tokens routed by ``all_to_all`` either way.
 
 The reference framework has exactly one of these axes (dp); this module is
 the capability bar for the rest (SURVEY.md §2.3, §5 long-context).

@@ -62,19 +62,27 @@ def pipeline_apply(stage_fn, stage_params, microbatches, axis_name: str,
     return outs[n - 1:]                               # [M, ...]
 
 
-def pipeline_loss(stage_fn, loss_fn, stage_params, microbatches, targets,
-                  axis_name: str, remat: bool = True):
-    """Pipelined forward + mean loss, replicated to all stages via psum so
-    every rank's gradient graph agrees.  ``loss_fn(y, target) -> scalar``."""
+def _local_pipeline_loss(stage_fn, loss_fn, stage_params, microbatches,
+                         targets, axis_name: str, remat: bool = True):
+    """Pre-psum local loss: the full mean loss on the last stage, 0.0
+    elsewhere.  Select, don't multiply: loss_fn may be non-finite on the
+    zero placeholder outputs of earlier stages, and inf * 0 = NaN would
+    poison the psum."""
     n = lax.axis_size(axis_name)
     stage = lax.axis_index(axis_name)
     outs = pipeline_apply(stage_fn, stage_params, microbatches, axis_name,
                           remat=remat)
     per_mb = jax.vmap(loss_fn)(outs, targets)         # [M]
-    # select, don't multiply: loss_fn may be non-finite on the zero
-    # placeholder outputs of earlier stages, and inf * 0 = NaN would
-    # poison the psum
-    local = jnp.where(stage == n - 1, jnp.mean(per_mb), 0.0)
+    return jnp.where(stage == n - 1, jnp.mean(per_mb), 0.0)
+
+
+def pipeline_loss(stage_fn, loss_fn, stage_params, microbatches, targets,
+                  axis_name: str, remat: bool = True):
+    """Pipelined forward + mean loss, replicated to all stages via psum so
+    every rank's gradient graph agrees.  ``loss_fn(y, target) -> scalar``."""
+    local = _local_pipeline_loss(stage_fn, loss_fn, stage_params,
+                                 microbatches, targets, axis_name,
+                                 remat=remat)
     return lax.psum(local, axis_name)
 
 
@@ -124,15 +132,10 @@ def pipeline_train(stage_fn, loss_fn, stage_params, microbatches, targets,
         # would scale every gradient by axis_size.  The cotangent seeded at
         # the last stage flows back to every stage through the reversed
         # ppermutes; the psum below only replicates the value.
-        nn = lax.axis_size(axis_name)
-        st = lax.axis_index(axis_name)
-
-        def local_loss(p):
-            outs = pipeline_apply(stage_fn, p, microbatches, axis_name)
-            per_mb = jax.vmap(loss_fn)(outs, targets)
-            return jnp.where(st == nn - 1, jnp.mean(per_mb), 0.0)
-
-        local, grads = jax.value_and_grad(local_loss)(stage_params)
+        local, grads = jax.value_and_grad(
+            lambda p: _local_pipeline_loss(stage_fn, loss_fn, p,
+                                           microbatches, targets,
+                                           axis_name))(stage_params)
         return lax.psum(local, axis_name), grads
     if schedule != "1f1b":
         raise ValueError(f"unknown schedule {schedule!r}")
@@ -157,10 +160,12 @@ def pipeline_train(stage_fn, loss_fn, stage_params, microbatches, targets,
         x_in = jnp.where(stage == 0, microbatches[fic], fwd_buf)
         y = stage_fn(stage_params, x_in)
         slot = t % R
-        xsave = jnp.where(do_f, xsave.at[slot].set(x_in), xsave)
+        # gate the slot, not the whole ring: where() over the full buffer
+        # would copy+select all R activations every tick
+        xsave = xsave.at[slot].set(jnp.where(do_f, x_in, xsave[slot]))
         l_mb = loss_fn(y, targets[fic])
-        loss_buf = jnp.where(jnp.logical_and(do_f, last),
-                             loss_buf.at[fic].set(l_mb), loss_buf)
+        loss_buf = loss_buf.at[fic].set(
+            jnp.where(jnp.logical_and(do_f, last), l_mb, loss_buf[fic]))
         fwd_next = lax.ppermute(y, axis_name, fwd)
 
         # ---- backward slot: microbatch bi = t - 2(n-1) + stage ----
