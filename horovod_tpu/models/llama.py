@@ -24,6 +24,7 @@ import dataclasses
 from typing import Any
 
 import jax
+import jax.ad_checkpoint
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
@@ -162,6 +163,10 @@ def _block(x, layer_params, cos, sin, positions, config, attn_fn):
         attn = _attention(q, k, v, positions)
     else:
         attn = attn_fn(q, k, v, positions)
+    # named for remat policies: saving just this tensor lets the layer
+    # recompute in backward WITHOUT re-running the attention forward
+    # (B*T*D bf16 per layer — cheap to keep, expensive to recompute)
+    attn = jax.ad_checkpoint.checkpoint_name(attn, "attn_out")
     x = x + attn @ layer_params["wo"].astype(x.dtype)
     h = _rms_norm(x, layer_params["mlp_norm"], c.rms_eps)
     gate = jax.nn.silu(h @ layer_params["w_gate"].astype(h.dtype))
@@ -195,7 +200,7 @@ def _resolve_attn_fn(attn_fn, seq_len: int):
 
 
 def apply(params, tokens, config: LlamaConfig, positions=None,
-          attn_fn="auto", remat: bool = True):
+          attn_fn="auto", remat="full"):
     """Forward pass.  ``tokens``: [B, T] int32 -> logits [B, T, V] (fp32).
 
     ``positions`` defaults to 0..T-1; pass global positions when the
@@ -211,11 +216,35 @@ def apply(params, tokens, config: LlamaConfig, positions=None,
     return (x @ params["lm_head"].astype(x.dtype)).astype(jnp.float32)
 
 
+def _remat_wrap(body, remat):
+    """Per-layer rematerialisation modes:
+
+    * ``True``/"full"  — checkpoint everything (minimum HBM, recompute all)
+    * ``"save_attn"``  — checkpoint, but keep each layer's attention
+      OUTPUT (named ``attn_out`` in :func:`_block`): backward recompute
+      skips re-running the (flash-)attention forward, trading
+      ~B*T*D bf16 per layer of HBM for the attention FLOPs
+    * ``False``        — no remat (O(layers) activations; biggest models
+      won't fit)
+    """
+    if remat is True or remat == "full":
+        return jax.checkpoint(body)
+    if remat == "save_attn":
+        return jax.checkpoint(
+            body,
+            policy=jax.checkpoint_policies.save_only_these_names(
+                "attn_out"))
+    if remat is False or remat is None:
+        return body
+    raise ValueError(f"unknown remat mode {remat!r}")
+
+
 def apply_hidden(params, tokens, config: LlamaConfig, positions=None,
-                 attn_fn="auto", remat: bool = True):
+                 attn_fn="auto", remat="full"):
     """Forward pass up to (and including) the final norm — hidden states
     [B, T, D] in compute dtype, without the lm_head projection.  The
-    chunked-CE loss path projects blockwise instead (ops/chunked_ce.py)."""
+    chunked-CE loss path projects blockwise instead (ops/chunked_ce.py).
+    ``remat`` modes: see :func:`_remat_wrap`."""
     c = config
     B, T = tokens.shape
     attn_fn = _resolve_attn_fn(attn_fn, T)
@@ -230,15 +259,13 @@ def apply_hidden(params, tokens, config: LlamaConfig, positions=None,
         out = _block(carry, layer_params, cos, sin, positions, c, attn_fn)
         return out, None
 
-    if remat:
-        body = jax.checkpoint(body)
-    x, _ = lax.scan(body, x, layer_stack)
+    x, _ = lax.scan(_remat_wrap(body, remat), x, layer_stack)
     x = _rms_norm(x, params["final_norm"], c.rms_eps)
     return x
 
 
 def loss_fn(params, tokens, config: LlamaConfig, positions=None,
-            attn_fn="auto", remat: bool = True,
+            attn_fn="auto", remat="full",
             vocab_block: int | None = None):
     """Next-token cross-entropy (shift-by-one inside).
 

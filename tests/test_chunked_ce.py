@@ -114,3 +114,34 @@ def test_bf16_hidden_states_grad_accumulation():
     assert dh_c.dtype == jnp.bfloat16
     # bf16 inputs bound the precision; the carry must not add drift on top
     assert np.allclose(dh_c.astype(np.float32), dh_d, rtol=0.05, atol=2e-4)
+
+
+def test_llama_remat_modes_agree():
+    """remat="full" / "save_attn" / False compute identical losses and
+    gradients — rematerialisation is a memory schedule, not math."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from horovod_tpu.models import llama
+
+    cfg = llama.LlamaConfig.tiny()
+    params = llama.init(jax.random.key(0), cfg)
+    tokens = jnp.asarray(
+        np.random.RandomState(0).randint(0, cfg.vocab_size, (2, 16)),
+        jnp.int32)
+
+    outs = {}
+    for mode in ("full", "save_attn", False):
+        loss, grads = jax.value_and_grad(llama.loss_fn)(
+            params, tokens, cfg, remat=mode)
+        outs[mode] = (float(loss), grads)
+    for mode in ("save_attn", False):
+        # differently-compiled programs: equal math, possibly different
+        # vectorization — compare to tight tolerance, not bitwise
+        np.testing.assert_allclose(outs[mode][0], outs["full"][0],
+                                   rtol=1e-6)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6),
+            outs[mode][1], outs["full"][1])

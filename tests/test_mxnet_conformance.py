@@ -21,9 +21,9 @@ import horovod_tpu.mxnet as hvd_mx
 
 
 class StrictNDArray:
-    """NDArray stand-in that permits ONLY the contract surface."""
-
-    _ALLOWED = {"asnumpy", "wait_to_read", "_buf", "_waited"}
+    """NDArray stand-in that permits ONLY the contract surface (the
+    methods defined on this class); __getattr__ rejects everything
+    else."""
 
     def __init__(self, arr):
         object.__setattr__(self, "_buf", np.array(arr, np.float32))
