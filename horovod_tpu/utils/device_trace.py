@@ -1,0 +1,112 @@
+"""Compiled-path per-op profiling: capture a ``jax.profiler`` device trace
+and aggregate device time per fusion/op category.
+
+The eager engine has the Chrome-tracing Timeline (``csrc/timeline.cc``,
+the reference's ``horovod/common/timeline.cc`` analog); compiled XLA
+programs need the device-side story instead — which fusions the step's
+time actually goes to.  This module wraps the capture + the aggregation
+used to attribute the ResNet-50 step in ``docs/benchmarks.md`` (the
+round-3 per-op trace): collect with :func:`trace`, reduce with
+:func:`aggregate`.
+
+Works on any backend jax.profiler supports, including tunneled PJRT
+plugins (verified on the axon TPU backend) and CPU.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import glob
+import gzip
+import json
+import os
+import re
+import tempfile
+
+
+@contextlib.contextmanager
+def trace(trace_dir: str | None = None):
+    """Context manager: profile the enclosed device work.  Yields a dict
+    that gains ``trace_dir`` (and is consumable by :func:`aggregate`)
+    after the block exits."""
+    import jax
+
+    d = trace_dir or tempfile.mkdtemp(prefix="hvd_trace_")
+    out = {"trace_dir": d}
+    with jax.profiler.trace(d):
+        yield out
+
+
+def _trace_events(trace_dir: str) -> list:
+    """All events from every trace file under the directory (multi-host
+    captures write one file per host; merging keeps the attribution
+    complete rather than silently reporting one arbitrary host)."""
+    files = sorted(glob.glob(os.path.join(trace_dir, "**", "*.trace.json.gz"),
+                             recursive=True))
+    if not files:
+        raise FileNotFoundError(f"no trace.json.gz under {trace_dir}")
+    events = []
+    for f in files:
+        events.extend(json.load(gzip.open(f))["traceEvents"])
+    return events
+
+
+def aggregate(trace_dir: str, top: int = 20, per_step_divisor: int = 1):
+    """Aggregate device-side op time from a captured trace.
+
+    Returns ``{"device_total_ms", "by_category": [{name, ms,
+    calls_total}...], "by_op": [...]}`` where *category* strips trailing
+    op numbers (``multiply_reduce_fusion.147`` -> ``multiply_reduce_fusion``)
+    — the granularity the benchmarks doc's attribution table uses.
+    ``per_step_divisor`` divides the **times** when the traced block ran
+    N steps; ``calls_total`` stays the raw occurrence count across the
+    whole capture (ms * per_step_divisor / calls_total = avg per call).
+    """
+    events = _trace_events(trace_dir)
+    # device pids announce themselves via process_name metadata
+    device_pids = {
+        e.get("pid") for e in events
+        if e.get("ph") == "M" and e.get("name") == "process_name"
+        and "device" in str((e.get("args") or {}).get("name", "")).lower()
+    }
+    def _sweep(restrict_pids):
+        cat = collections.Counter()
+        cat_n = collections.Counter()
+        ops = collections.Counter()
+        total = 0.0
+        for e in events:
+            if e.get("ph") != "X" or "dur" not in e:
+                continue
+            if restrict_pids and e.get("pid") not in restrict_pids:
+                continue
+            name = e.get("name", "")
+            # skip program/loop envelopes (double-count) and the host-side
+            # python bookkeeping tracks ($api, $array, np, ...)
+            if name.startswith(("jit_", "while", "0", "PjitFunction", "$",
+                                "np ", "np.")):
+                continue
+            base = re.sub(r"\.\d+$", "", name)
+            cat[base] += e["dur"]
+            cat_n[base] += 1
+            ops[name] += e["dur"]
+            total += e["dur"]
+        return cat, cat_n, ops, total
+
+    cat, cat_n, ops, total = _sweep(device_pids)
+    if not cat:
+        # device-track naming varies by PJRT plugin; fall back to all
+        # tracks with the host bookkeeping filtered by name above
+        cat, cat_n, ops, total = _sweep(None)
+    div = max(per_step_divisor, 1) * 1e3  # us -> ms, per step
+    return {
+        "device_total_ms": round(total / div, 3),
+        "by_category": [
+            {"name": n, "ms": round(us / div, 3), "calls_total": cat_n[n]}
+            for n, us in cat.most_common(top)
+        ],
+        "by_op": [
+            {"name": n, "ms": round(us / div, 3)}
+            for n, us in ops.most_common(top)
+        ],
+    }
