@@ -38,18 +38,15 @@ def trace(trace_dir: str | None = None):
         yield out
 
 
-def _trace_events(trace_dir: str) -> list:
-    """All events from every trace file under the directory (multi-host
-    captures write one file per host; merging keeps the attribution
-    complete rather than silently reporting one arbitrary host)."""
+def _trace_event_files(trace_dir: str) -> list:
+    """Per-file event lists (multi-host captures write one file per host;
+    Chrome-trace pids are only unique WITHIN a file, so callers must
+    resolve device tracks per file, then merge aggregates)."""
     files = sorted(glob.glob(os.path.join(trace_dir, "**", "*.trace.json.gz"),
                              recursive=True))
     if not files:
         raise FileNotFoundError(f"no trace.json.gz under {trace_dir}")
-    events = []
-    for f in files:
-        events.extend(json.load(gzip.open(f))["traceEvents"])
-    return events
+    return [json.load(gzip.open(f))["traceEvents"] for f in files]
 
 
 def aggregate(trace_dir: str, top: int = 20, per_step_divisor: int = 1):
@@ -63,14 +60,7 @@ def aggregate(trace_dir: str, top: int = 20, per_step_divisor: int = 1):
     N steps; ``calls_total`` stays the raw occurrence count across the
     whole capture (ms * per_step_divisor / calls_total = avg per call).
     """
-    events = _trace_events(trace_dir)
-    # device pids announce themselves via process_name metadata
-    device_pids = {
-        e.get("pid") for e in events
-        if e.get("ph") == "M" and e.get("name") == "process_name"
-        and "device" in str((e.get("args") or {}).get("name", "")).lower()
-    }
-    def _sweep(restrict_pids):
+    def _sweep(events, restrict_pids):
         cat = collections.Counter()
         cat_n = collections.Counter()
         ops = collections.Counter()
@@ -93,11 +83,27 @@ def aggregate(trace_dir: str, top: int = 20, per_step_divisor: int = 1):
             total += e["dur"]
         return cat, cat_n, ops, total
 
-    cat, cat_n, ops, total = _sweep(device_pids)
-    if not cat:
-        # device-track naming varies by PJRT plugin; fall back to all
-        # tracks with the host bookkeeping filtered by name above
-        cat, cat_n, ops, total = _sweep(None)
+    # resolve device tracks PER FILE (pids are file-local), then merge
+    cat = collections.Counter()
+    cat_n = collections.Counter()
+    ops = collections.Counter()
+    total = 0.0
+    for events in _trace_event_files(trace_dir):
+        # device pids announce themselves via process_name metadata
+        device_pids = {
+            e.get("pid") for e in events
+            if e.get("ph") == "M" and e.get("name") == "process_name"
+            and "device" in str((e.get("args") or {}).get("name", "")).lower()
+        }
+        c, cn, o, t = _sweep(events, device_pids)
+        if not c:
+            # device-track naming varies by PJRT plugin; fall back to all
+            # tracks with the host bookkeeping filtered by name above
+            c, cn, o, t = _sweep(events, None)
+        cat.update(c)
+        cat_n.update(cn)
+        ops.update(o)
+        total += t
     div = max(per_step_divisor, 1) * 1e3  # us -> ms, per step
     return {
         "device_total_ms": round(total / div, 3),
