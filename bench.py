@@ -389,6 +389,12 @@ def allreduce_worker(args):
         rank = int(os.environ.get("HOROVOD_TPU_RANK", "0"))
         os.environ["HOROVOD_TPU_HOST_HASH"] = (
             f"simhost{rank % args.sim_hosts}")
+        # pin the two-level path: inherited env (=0, or autotune owning
+        # the knob) could silently measure the flat ring under a
+        # hierarchical label
+        os.environ["HOROVOD_TPU_HIERARCHICAL_ALLREDUCE"] = "1"
+        os.environ.pop("HOROVOD_TPU_AUTOTUNE", None)
+        os.environ.pop("HOROVOD_AUTOTUNE", None)
     hvd.init()
     n = hvd.size()
     nbytes = args.size_mb * 1024 * 1024

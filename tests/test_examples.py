@@ -60,6 +60,9 @@ KERAS = [os.path.join(EXAMPLES, "keras_imagenet_resnet50.py"),
          "--batches-per-epoch", "2"]
 MXNET = [os.path.join(EXAMPLES, "mxnet_imagenet_resnet50.py"),
          "--steps", "2", "--batch-size", "2", "--image-size", "64"]
+JAX_PIPELINE = [os.path.join(EXAMPLES, "jax_pipeline.py"),
+                "--stages", "2", "--microbatches", "4", "--d-model", "16",
+                "--mb-size", "4", "--steps", "10"]
 JAX_LLAMA = [os.path.join(EXAMPLES, "jax_llama.py"),
              "--layers", "2", "--d-model", "64", "--d-ff", "128",
              "--heads", "4", "--kv-heads", "2", "--vocab-size", "256",
@@ -170,6 +173,11 @@ def test_mxnet_mnist_2proc():
 def test_keras_spark_mnist():
     # launches its own 2 workers through the spark/local placement flow
     _run(KERAS_SPARK, timeout=420)
+
+
+def test_jax_pipeline_example():
+    out = _run(JAX_PIPELINE)
+    assert "gpipe:" in out and "1f1b:" in out
 
 
 def test_jax_llama_fsdp():
