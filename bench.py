@@ -254,7 +254,9 @@ def _train_marginal(step_fn, init_carry, K1, K2, iters=4):
         t2s.append(time.perf_counter() - t0)
     t1, t2 = float(np.median(t1s)), float(np.median(t2s))
     per = (t2 - t1) / (K2 - K1)
-    return per, max(t1 - K1 * per, 0.0)
+    # g1 (the compiled K1-step program) rides along so callers can reuse
+    # it (e.g. for --trace) without re-tracing an identical scan
+    return per, max(t1 - K1 * per, 0.0), g1
 
 
 def bench_resnet(args, peak_tflops):
@@ -290,12 +292,12 @@ def bench_resnet(args, peak_tflops):
         return (optax.apply_updates(params, updates), new_state,
                 opt_state), loss
 
-    per, ovh = _train_marginal(step, (params, state, opt_state),
-                               args.k1, args.k2)
+    per, ovh, run_k1 = _train_marginal(step, (params, state, opt_state),
+                                       args.k1, args.k2)
     imgs_per_sec = args.batch_size / per
     flops_per_img = resnet50_train_flops_per_image(args.image_size)
     sustained_tflops = imgs_per_sec * flops_per_img / 1e12
-    return {
+    out = {
         "value": round(imgs_per_sec, 2),
         "unit": "images/sec/chip",
         "step_ms": round(per * 1e3, 2),
@@ -306,6 +308,19 @@ def bench_resnet(args, peak_tflops):
         "mfu": (round(sustained_tflops / peak_tflops, 4)
                 if peak_tflops else None),
     }
+    if args.trace:
+        # per-op attribution (the docs/benchmarks.md table, reproducible
+        # with --trace): reuse the already-compiled K1-step program from
+        # the marginal measurement, one profiler capture
+        from horovod_tpu.utils import device_trace
+
+        carry = (params, state, opt_state)
+        _warm(lambda: run_k1(carry))
+        with device_trace.trace() as t:
+            _sync_scalar(run_k1(carry))
+        out["trace_by_category"] = device_trace.aggregate(
+            t["trace_dir"], top=8, per_step_divisor=args.k1)["by_category"]
+    return out
 
 
 def bench_llama(args, peak_tflops):
@@ -349,7 +364,7 @@ def bench_llama(args, peak_tflops):
 
     k1 = max(2, args.k1 // 2)
     k2 = max(k1 + 2, args.k2 // 2)  # llama steps are ~4x resnet's; halve
-    per, ovh = _train_marginal(step, (params, opt_state), k1, k2)
+    per, ovh, _ = _train_marginal(step, (params, opt_state), k1, k2)
     tokens_per_sec = B * T / per
     flops_per_step = llama_train_flops_per_step(cfg, B, T)
     sustained_tflops = flops_per_step / per / 1e12
@@ -727,6 +742,9 @@ def main() -> None:
     ap.add_argument("--pipeline-worker", action="store_true",
                     help=argparse.SUPPRESS)
     ap.add_argument("--skip-pipeline", action="store_true")
+    ap.add_argument("--trace", action="store_true",
+                    help="attach a per-op device-trace attribution to the "
+                         "resnet section (docs/benchmarks.md table)")
     ap.add_argument("--scal-iters", type=int, default=50)
     ap.add_argument("--mlp-hidden", type=int, default=512)
     ap.add_argument("--cpu", action="store_true",

@@ -71,10 +71,10 @@ def aggregate(trace_dir: str, top: int = 20, per_step_divisor: int = 1):
             if restrict_pids and e.get("pid") not in restrict_pids:
                 continue
             name = e.get("name", "")
-            # skip program/loop envelopes (double-count) and the host-side
-            # python bookkeeping tracks ($api, $array, np, ...)
+            # skip program/loop/executor envelopes (they'd double-count
+            # their contents) and host-side python bookkeeping tracks
             if name.startswith(("jit_", "while", "0", "PjitFunction", "$",
-                                "np ", "np.")):
+                                "np ", "np.", "ThunkExecutor")):
                 continue
             base = re.sub(r"\.\d+$", "", name)
             cat[base] += e["dur"]
