@@ -310,16 +310,21 @@ def bench_resnet(args, peak_tflops):
     }
     if args.trace:
         # per-op attribution (the docs/benchmarks.md table, reproducible
-        # with --trace): reuse the already-compiled K1-step program from
-        # the marginal measurement, one profiler capture
-        from horovod_tpu.utils import device_trace
+        # with --trace): reuse the already-compiled-and-warmed K1-step
+        # program from the marginal measurement, one profiler capture.
+        # An optional extra must not destroy the measured results —
+        # failures attach as an error field.
+        try:
+            from horovod_tpu.utils import device_trace
 
-        carry = (params, state, opt_state)
-        _warm(lambda: run_k1(carry))
-        with device_trace.trace() as t:
-            _sync_scalar(run_k1(carry))
-        out["trace_by_category"] = device_trace.aggregate(
-            t["trace_dir"], top=8, per_step_divisor=args.k1)["by_category"]
+            with device_trace.trace() as t:
+                _sync_scalar(run_k1((params, state, opt_state)))
+            out["trace_by_category"] = device_trace.aggregate(
+                t["trace_dir"], top=8,
+                per_step_divisor=args.k1)["by_category"]
+        except Exception as exc:  # noqa: BLE001 - report, don't die
+            out["trace_by_category"] = {
+                "error": f"{type(exc).__name__}: {exc}"[:150]}
     return out
 
 

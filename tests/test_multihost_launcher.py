@@ -1,0 +1,73 @@
+"""Multi-host launcher flow, simulated with two launchers on one machine.
+
+`hvdrun --hosts h1:s1,h2:s2 --host-index i` runs one launcher per host;
+the ranks rendezvous at host 0's TCP port.  Here both "hosts" are
+localhost: two concurrently-started launchers must form one world, agree
+on rank/size/cross topology, and complete collectives across the
+launcher boundary.  Reference analog: multi-host `mpirun -H a:2,b:2`
+(``/root/reference/README.md:164-184``).
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+from horovod_tpu.utils import net
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = textwrap.dedent("""
+    import numpy as np
+    import horovod_tpu as hvd
+
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    assert n == 4, n
+    # topology: 2 simulated hosts x 2 ranks (launcher-provided env)
+    assert hvd.cross_size() == 2, hvd.cross_size()
+    assert hvd.local_size() == 2, hvd.local_size()
+    out = hvd.allreduce(np.array([float(r + 1)], np.float32),
+                        average=False, name="mh")
+    assert out[0] == 1 + 2 + 3 + 4, out
+    g = hvd.allgather(np.array([[r]], np.int64), name="mhg")
+    assert [int(x) for x in g.ravel()] == [0, 1, 2, 3], g
+    print(f"MH OK rank {r} local {hvd.local_rank()} "
+          f"cross {hvd.cross_rank()}", flush=True)
+    hvd.shutdown()
+""")
+
+
+def test_two_launchers_form_one_world(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER)
+    port = net.free_port()
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+
+    def launcher(host_index):
+        return subprocess.Popen(
+            [sys.executable, "-m", "horovod_tpu.run", "-np", "4",
+             "--hosts", "127.0.0.1:2,127.0.0.1:2",
+             "--host-index", str(host_index),
+             "--rendezvous-port", str(port),
+             sys.executable, str(script)],
+            cwd=REPO, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True)
+
+    procs = [launcher(0), launcher(1)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=180)
+            outs.append(out)
+            assert p.returncode == 0, out[-2000:]
+    finally:
+        # on hang/failure, don't leak launchers + their worker children
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    joined = "\n".join(outs)
+    for r in range(4):
+        assert f"MH OK rank {r}" in joined, joined[-2000:]
