@@ -381,7 +381,7 @@ def bench_llama(args, peak_tflops):
         "n_params": n_params,
         # ask the resolver, not the backend: "auto" falls back to the dense
         # path when T doesn't tile into 128-wide Mosaic blocks
-        "flash_attention": llama._resolve_attn_fn("auto", T) is not None,
+        "flash_attention": llama._resolve_attn_fn("auto") is not None,
         "vocab_block": vb or None,
         "model_tflops_per_step": round(flops_per_step / 1e12, 3),
         "sustained_tflops": round(sustained_tflops, 2),

@@ -179,11 +179,12 @@ _LAYER_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down",
                "attn_norm", "mlp_norm")
 
 
-def _resolve_attn_fn(attn_fn, seq_len: int):
+def _resolve_attn_fn(attn_fn):
     """``attn_fn="auto"``: Pallas flash attention on TPU (the hot op gets
-    the Mosaic kernel), dense jnp attention elsewhere.  The kernel needs the
-    sequence to tile into (..., 128) Mosaic blocks: T a multiple of 128, or
-    a single equal-to-dim block."""
+    the Mosaic kernel), dense jnp attention elsewhere.  Sequences that
+    don't tile into 128-wide Mosaic lanes are zero-padded inside
+    ``flash_attn_fn`` (exact under the causal mask), so every length
+    routes through the kernel."""
     if attn_fn != "auto":
         return attn_fn
     try:
@@ -192,7 +193,7 @@ def _resolve_attn_fn(attn_fn, seq_len: int):
         on_tpu = jax.default_backend() == "tpu"
     except Exception:
         on_tpu = False
-    if on_tpu and (seq_len % 128 == 0 or seq_len < 128):
+    if on_tpu:
         from horovod_tpu.ops.pallas import flash_attn_fn
 
         return flash_attn_fn()
@@ -247,7 +248,7 @@ def apply_hidden(params, tokens, config: LlamaConfig, positions=None,
     ``remat`` modes: see :func:`_remat_wrap`."""
     c = config
     B, T = tokens.shape
-    attn_fn = _resolve_attn_fn(attn_fn, T)
+    attn_fn = _resolve_attn_fn(attn_fn)
     if positions is None:
         positions = jnp.arange(T, dtype=jnp.int32)
     x = params["embed"][tokens].astype(c.compute_dtype)

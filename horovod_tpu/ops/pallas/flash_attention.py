@@ -441,13 +441,31 @@ def flash_attn_fn(causal: bool = True, block_q: int = 512,
     """Adapter producing the ``attn_fn(q, k, v, positions)`` callback used by
     :func:`horovod_tpu.models.llama.apply`.  ``positions`` must be a
     contiguous range (the model's default); its first element is the global
-    offset."""
+    offset.
+
+    Sequence lengths that don't tile into 128-wide Mosaic lanes are
+    zero-padded up to the next multiple (and sliced back): padded KEY rows
+    sit at positions beyond every real query, so the causal mask excludes
+    them, and padded QUERY rows are discarded by the slice — the result is
+    exact, not approximate.  (Padding requires ``causal=True``; the
+    non-causal path would attend to the zero keys.)
+    """
 
     def attn_fn(q, k, v, positions):
         start = positions[0]
+        B, T, Hq, Dh = q.shape
+        pad = (-T) % 128
+        if pad and not causal:
+            raise ValueError(
+                "flash_attn_fn padding requires causal=True for "
+                f"non-128-multiple seq length {T}")
+        if pad:
+            cfg = [(0, 0), (0, pad), (0, 0), (0, 0)]
+            q, k, v = (jnp.pad(a, cfg) for a in (q, k, v))
         out = flash_attention(q, k, v, start, start, causal,
                               block_q, block_k, interpret)
-        B, T, Hq, Dh = out.shape
+        if pad:
+            out = out[:, :T]
         return out.reshape(B, T, Hq * Dh)
 
     return attn_fn

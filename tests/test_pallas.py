@@ -214,3 +214,33 @@ def test_flash_attn_fn_in_llama():
                                             interpret=True))
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("T", [100, 300])
+def test_flash_attn_fn_pads_odd_lengths(T):
+    """Non-128-multiple sequence lengths zero-pad through the kernel and
+    match dense attention exactly under the causal mask (fwd + grad)."""
+    from horovod_tpu.models.llama import _attention
+
+    B, Hq, Hkv, Dh = 2, 4, 2, 8
+    kq, kk, kv = jax.random.split(jax.random.key(1), 3)
+    q = jax.random.normal(kq, (B, T, Hq, Dh), jnp.float32) * 0.3
+    k = jax.random.normal(kk, (B, T, Hkv, Dh), jnp.float32) * 0.3
+    v = jax.random.normal(kv, (B, T, Hkv, Dh), jnp.float32) * 0.3
+    positions = jnp.arange(T, dtype=jnp.int32)
+    fa = flash_attn_fn(block_q=8, block_k=8, interpret=True)
+    out_f = fa(q, k, v, positions)
+    out_d = _attention(q, k, v, positions)
+    np.testing.assert_allclose(np.asarray(out_f), np.asarray(out_d),
+                               rtol=2e-4, atol=2e-4)
+    # gradients wrt q AND k/v: the pad VJP must slice dk/dv back and
+    # padded-query rows (zero cotangent after the slice) must contribute
+    # nothing to them
+    g_f = jax.grad(lambda qkv: jnp.sum(jnp.square(fa(*qkv, positions))))(
+        (q, k, v))
+    g_d = jax.grad(lambda qkv: jnp.sum(jnp.square(
+        _attention(*qkv, positions))))((q, k, v))
+    for a, b in zip(g_f, g_d):
+        assert a.shape == b.shape
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-3)
