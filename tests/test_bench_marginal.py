@@ -1,0 +1,101 @@
+"""The marginal-rate measurement core must be self-auditing.
+
+Round-3 verdict item 5: the whole perf story rests on the assumption that
+the tunneled backend's per-dispatch overhead is constant per call.  The
+bench now *checks* that with a three-point K-sweep — these tests pin the
+fit, the residual, and the reject-to-raw fallback (including the advisor's
+t2<=t1 timing-noise case, which previously produced negative rates).
+"""
+
+import math
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench
+
+
+def test_fit_line_exact_linear():
+    # t = 0.05 + 0.01*K  ->  slope/intercept recovered, residual ~0
+    ks = [4, 8, 12]
+    ts = [0.05 + 0.01 * k for k in ks]
+    per, ovh, resid = bench._fit_line(ks, ts)
+    assert math.isclose(per, 0.01, rel_tol=1e-9)
+    assert math.isclose(ovh, 0.05, rel_tol=1e-9)
+    assert resid < 1e-9
+
+
+def test_fit_line_nonlinear_residual_flagged():
+    # overhead grows with K (size-dependent dispatch cost): the middle
+    # point sags far below the endpoint line -> large relative residual
+    ks = [4, 8, 12]
+    ts = [0.10, 0.11, 0.30]
+    per, ovh, resid = bench._fit_line(ks, ts)
+    assert resid > bench.MARGINAL_RESIDUAL_LIMIT
+
+
+def test_fit_line_negative_slope_is_inf():
+    # the advisor's t2 <= t1 case: longer scan measured *faster* (pure
+    # noise).  Must not return a usable rate.
+    per, ovh, resid = bench._fit_line([4, 8, 12], [0.30, 0.20, 0.10])
+    assert per <= 0
+    assert resid == float("inf")
+
+
+def test_marginal_fields_accepts_linear():
+    fields = bench._marginal_fields(ovh=0.05, resid=0.02, rejected=False)
+    assert fields["marginal_fit_residual"] == 0.02
+    assert "marginal_rejected" not in fields
+
+
+def test_marginal_fields_rejected_carries_warning():
+    fields = bench._marginal_fields(ovh=0.0, resid=0.5, rejected=True)
+    assert "marginal_rejected" in fields
+    assert "non-linear" in fields["marginal_rejected"]
+
+
+def test_marginal_fields_inf_residual_is_json_safe():
+    import json
+
+    fields = bench._marginal_fields(ovh=0.0, resid=float("inf"),
+                                    rejected=True)
+    assert fields["marginal_fit_residual"] == "inf"
+    # the artifact must stay strict JSON — no bare Infinity token
+    assert "Infinity" not in json.dumps(fields, allow_nan=False)
+
+
+def test_marginal_end_to_end_on_cpu():
+    """marginal() on a real (CPU) jit scan: rate positive, and rejection
+    (if any, from CPU timing noise) reports the raw fallback honestly."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    def mk(L):
+        def f():
+            x = jnp.ones((256, 256), jnp.float32)
+            y = lax.scan(lambda c, _: (c @ x * 1e-3, ()), x, None,
+                         length=L)[0]
+            return jnp.sum(y[:1, :1])
+        return jax.jit(f)
+
+    per, ovh, resid, rejected = bench.marginal(mk, 8, 16, 24, iters=3)
+    assert per > 0
+    assert ovh >= 0
+    if not rejected:
+        assert resid <= bench.MARGINAL_RESIDUAL_LIMIT
+
+
+def test_train_marginal_delegates_and_returns_compiled_program():
+    import jax.numpy as jnp
+
+    def step(carry):
+        return carry * 0.5, jnp.sum(carry)
+
+    per, ovh, g1, resid, rejected = bench._train_marginal(
+        step, jnp.ones((16,)), 2, 6, iters=2)
+    assert per > 0
+    # the rode-along compiled program is callable with a fresh carry
+    out = g1(jnp.ones((16,)))
+    assert float(out) != 0.0

@@ -147,8 +147,14 @@ if __name__ == "__main__":
     names = sys.argv[1:] or CASES
     for n in names:
         mk, flops = op_case(n)
-        per, ovh = marginal(mk, 4, 12)
+        import jax
+
+        per, ovh, resid, rejected = marginal(lambda L: jax.jit(mk(L)),
+                                             4, 8, 12)
         msg = f"{n}: {per*1e3:.2f} ms/iter (call overhead {ovh*1e3:.0f} ms)"
         if flops:
             msg += f" = {flops/per/1e12:.1f} TF/s"
+        if rejected:
+            msg += (f"  [MARGINAL REJECTED resid={resid:.3f}: raw "
+                    "overhead-inflated rate]")
         print(msg, flush=True)
