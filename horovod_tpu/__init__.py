@@ -46,7 +46,12 @@ Average = "avg"
 
 
 def _as_numpy(tensor) -> np.ndarray:
-    arr = np.asarray(tensor)
+    # DLPack-first ingest: host-backed framework tensors (torch CPU, jax
+    # committed-to-CPU) enter as zero-copy views; device-backed jax pays
+    # its one D2H transfer (see runtime/ingest.py)
+    from horovod_tpu.runtime import ingest
+
+    arr = ingest.to_wire(tensor)
     if arr.dtype == object:
         raise TypeError(f"unsupported tensor type {type(tensor)!r}")
     return arr

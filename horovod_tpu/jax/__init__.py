@@ -72,8 +72,11 @@ def allreduce(tensor, average: bool = True, name: str | None = None,
         out = _ops.allreduce(comp, axis_name, average=average)
         return compression.decompress(out, ctx)
     import horovod_tpu as hvd
+    from horovod_tpu.runtime import ingest
 
-    arr = np.asarray(jax.device_get(tensor))
+    # zero-copy DLPack view for host-backed arrays; D2H only when the
+    # array actually lives on a device (runtime/ingest.py)
+    arr = ingest.to_wire(tensor)
     return jnp.asarray(hvd.allreduce(arr, average=average, name=name,
                                      compression=compression))
 
@@ -82,8 +85,9 @@ def allgather(tensor, name: str | None = None, axis_name: str | None = None):
     if axis_name is not None and _in_trace(tensor):
         return _ops.allgather(tensor, axis_name)
     import horovod_tpu as hvd
+    from horovod_tpu.runtime import ingest
 
-    return jnp.asarray(hvd.allgather(np.asarray(jax.device_get(tensor)), name=name))
+    return jnp.asarray(hvd.allgather(ingest.to_wire(tensor), name=name))
 
 
 def broadcast(tensor, root_rank: int, name: str | None = None,
@@ -91,9 +95,10 @@ def broadcast(tensor, root_rank: int, name: str | None = None,
     if axis_name is not None and _in_trace(tensor):
         return _ops.broadcast(tensor, root_rank, axis_name)
     import horovod_tpu as hvd
+    from horovod_tpu.runtime import ingest
 
     return jnp.asarray(
-        hvd.broadcast(np.asarray(jax.device_get(tensor)), root_rank, name=name)
+        hvd.broadcast(ingest.to_wire(tensor), root_rank, name=name)
     )
 
 
@@ -104,13 +109,14 @@ def broadcast_parameters(params, root_rank: int = 0):
 
     Device-backed leaves are fetched in ONE batched ``jax.device_get`` of
     the whole tree (a single D2H transfer group), not per-leaf round trips;
-    host-backed leaves come through it zero-copy (``device_get`` of a
-    committed-to-CPU array is a view, pinned by tests/test_zero_copy.py).
+    host-backed leaves enter as zero-copy DLPack views
+    (runtime/ingest.py, pinned by tests/test_zero_copy.py).
     """
     import horovod_tpu as hvd
+    from horovod_tpu.runtime import ingest
 
     leaves, treedef = jax.tree.flatten(params)
-    hosts = [np.asarray(a) for a in jax.device_get(leaves)]
+    hosts = ingest.leaves_to_wire(leaves)
     # Issue every broadcast before waiting on any, so the engine can overlap
     # and fuse them (the reference's async-handles-then-synchronize pattern).
     handles = [
@@ -118,6 +124,32 @@ def broadcast_parameters(params, root_rank: int = 0):
         for i, h in enumerate(hosts)
     ]
     # the engine wire carries rank-1 buffers; restore 0-d leaf shapes
+    out = [jnp.asarray(hvd.synchronize(h)).reshape(jnp.shape(leaf))
+           for h, leaf in zip(handles, leaves)]
+    return jax.tree.unflatten(treedef, out)
+
+
+def allreduce_parameters(tree, average: bool = True, name: str = "grads"):
+    """Eagerly allreduce a pytree (e.g. host-side gradients) as one fused
+    group: ingest is ONE batched ``jax.device_get`` for every
+    device-backed leaf + zero-copy DLPack views for host-backed leaves,
+    then every allreduce is issued async before any is awaited so the
+    engine fuses and overlaps them — the eager analog of
+    :func:`allreduce_gradients` (which is the compiled-path version).
+
+    Reference analog: the per-fused-group staging in
+    ``/root/reference/horovod/torch/mpi_ops_v2.cc:78-110`` (one device
+    staging copy per fusion buffer, not per tensor).
+    """
+    import horovod_tpu as hvd
+    from horovod_tpu.runtime import ingest
+
+    leaves, treedef = jax.tree.flatten(tree)
+    hosts = ingest.leaves_to_wire(leaves)
+    handles = [
+        hvd.allreduce_async(h, average=average, name=f"{name}.{i}")
+        for i, h in enumerate(hosts)
+    ]
     out = [jnp.asarray(hvd.synchronize(h)).reshape(jnp.shape(leaf))
            for h, leaf in zip(handles, leaves)]
     return jax.tree.unflatten(treedef, out)
@@ -209,6 +241,7 @@ __all__ = [
     "allreduce_p", "allgather_p", "broadcast_p", "reducescatter_p",
     "alltoall_p", "grouped_allreduce_p",
     "broadcast_parameters", "broadcast_optimizer_state",
+    "allreduce_parameters",
     "allreduce_gradients", "DistributedOptimizer", "DistributedGradientTape",
     "Compression",
 ]

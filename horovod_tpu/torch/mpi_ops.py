@@ -38,15 +38,15 @@ def _handle_map(engine) -> dict:
     return m
 
 
-def _to_numpy(tensor: torch.Tensor) -> np.ndarray:
-    """Host numpy view of a torch tensor; bf16 rides the wire as bf16 via a
-    bit-level reinterpretation (numpy has no native bfloat16)."""
-    t = tensor.detach().contiguous().cpu()
-    if t.dtype == torch.bfloat16:
-        import ml_dtypes
+def _to_numpy(tensor: torch.Tensor, writable: bool = False) -> np.ndarray:
+    """Host numpy view of a torch tensor via the shared DLPack-first
+    ingest (runtime/ingest.py): zero-copy for contiguous CPU tensors,
+    bf16 as a bit-level reinterpretation (numpy has no native bfloat16).
+    ``writable=True`` selects torch's writable ``.numpy()`` view — the
+    in-place variants use the same buffer as the engine output."""
+    from horovod_tpu.runtime import ingest
 
-        return t.view(torch.uint16).numpy().view(ml_dtypes.bfloat16)
-    return t.numpy()
+    return ingest.to_wire(tensor, writable=writable)
 
 
 def _from_numpy(arr: np.ndarray, dtype: torch.dtype) -> torch.Tensor:
@@ -77,7 +77,7 @@ def allreduce_async_(tensor, average=True, name=None) -> int:
     """In-place: on synchronize, the reduced values overwrite ``tensor``.
     For contiguous CPU tensors the engine writes the result directly into
     the tensor's memory (the numpy view doubles as the output buffer)."""
-    arr = _to_numpy(tensor)
+    arr = _to_numpy(tensor, writable=True)
     handle = _state.engine().allreduce_async(
         arr, _name("allreduce", name), out=arr)
     return _register(handle, tensor, average, tensor.dtype)
@@ -153,7 +153,7 @@ def broadcast_async(tensor, root_rank, name=None) -> int:
 
 
 def broadcast_async_(tensor, root_rank, name=None) -> int:
-    arr = _to_numpy(tensor)
+    arr = _to_numpy(tensor, writable=True)
     handle = _state.engine().broadcast_async(
         arr, root_rank, _name("broadcast", name), out=arr)
     return _register(handle, tensor, False, tensor.dtype)

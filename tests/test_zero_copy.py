@@ -1,13 +1,16 @@
-"""Zero-copy ingest contracts for the eager engine (round-2 verdict #5).
+"""Zero-copy ingest contracts for the eager engine (round-2 verdict #5,
+round-3 verdict #3: DLPack-first ingest).
 
 The eager data plane is host-side; the contract is that host-backed
 tensors enter and leave it without redundant copies:
 
 * a contiguous CPU torch tensor's wire view aliases its storage,
-* a committed-to-CPU jax array's ``device_get``/``asarray`` is a view,
+* a committed-to-CPU jax array enters as a zero-copy **DLPack** view
+  (``np.from_dlpack``), with no ``device_get`` round trip at all,
 * the engine's in-place ``out=`` writes land in the caller's buffer,
-* ``broadcast_parameters`` fetches device trees in ONE batched
-  ``device_get`` (one D2H group), not per-leaf round trips.
+* ``broadcast_parameters`` / ``allreduce_parameters`` fetch device trees
+  in at most ONE batched ``device_get`` (one D2H group) — zero calls
+  when every leaf is host-backed.
 
 Reference analog: the adapters operate on framework memory directly
 (``/root/reference/horovod/torch/mpi_ops_v2.cc:52-76``); staging copies
@@ -20,6 +23,8 @@ import pytest
 
 import jax
 import jax.numpy as jnp
+
+from horovod_tpu.runtime import ingest
 
 
 def _ptr(a: np.ndarray) -> int:
@@ -79,8 +84,141 @@ def test_broadcast_parameters_batches_device_get(monkeypatch):
         tree = {"a": jnp.ones((8, 8)), "b": {"c": jnp.zeros((4,)),
                                              "d": jnp.full((2, 2), 3.0)}}
         out = hvd_jax.broadcast_parameters(tree, root_rank=0)
-        assert calls["n"] == 1  # one batched fetch for the whole tree
+        # at most one batched fetch for the whole tree; ZERO when every
+        # leaf is host-backed (the DLPack view path)
+        assert calls["n"] <= 1
         jax.tree.map(lambda x, y: np.testing.assert_array_equal(
+            np.asarray(x), np.asarray(y)), tree, out)
+    finally:
+        hvd.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# DLPack-first ingest (round-3 verdict #3)
+# ---------------------------------------------------------------------------
+
+def test_jax_cpu_array_ingests_without_device_get(monkeypatch):
+    """A committed-to-CPU jax array enters the wire as a DLPack view of
+    the same buffer — and jax.device_get is never called."""
+    cpu = jax.devices("cpu")[0]
+    x = jax.device_put(jnp.arange(64, dtype=jnp.float32), cpu)
+
+    def boom(_):
+        raise AssertionError("device_get called for a host-backed array")
+
+    monkeypatch.setattr(jax, "device_get", boom)
+    view = ingest.to_wire(x)
+    assert _ptr(view) == _ptr(np.asarray(x))
+
+
+def test_torch_cpu_tensor_dlpack_ingest_is_zero_copy():
+    import torch
+
+    t = torch.arange(64, dtype=torch.float32)
+    view = ingest.to_wire(t)
+    assert _ptr(view) == t.data_ptr()
+    # writable path (in-place variants) aliases the same storage too
+    w = ingest.to_wire(t, writable=True)
+    assert _ptr(w) == t.data_ptr()
+    assert w.flags.writeable
+
+
+def test_to_wire_writable_jax_is_a_safe_copy():
+    """writable=True on an immutable producer (jax) must hand back a
+    writable COPY — never a writable view of the jax buffer, which a
+    cached jit trace may alias."""
+    cpu = jax.devices("cpu")[0]
+    x = jax.device_put(jnp.arange(8, dtype=jnp.float32), cpu)
+    w = ingest.to_wire(x, writable=True)
+    assert w.flags.writeable
+    w[0] = 99.0
+    assert float(np.asarray(x)[0]) == 0.0  # original untouched
+
+
+def test_torch_noncontiguous_copies_to_contiguous():
+    import torch
+
+    t = torch.arange(64, dtype=torch.float32).reshape(8, 8).T
+    view = ingest.to_wire(t)
+    assert view.flags["C_CONTIGUOUS"]
+    np.testing.assert_array_equal(view, t.numpy())
+
+
+def test_bf16_ingest_bit_view():
+    import torch
+
+    t = torch.arange(16, dtype=torch.float32).to(torch.bfloat16)
+    view = ingest.to_wire(t)
+    assert view.dtype.name == "bfloat16"
+    assert _ptr(view) == t.data_ptr()  # still aliases the storage
+
+
+def test_engine_accepts_framework_tensors_directly():
+    """hvd.allreduce takes jax arrays and torch tensors with no manual
+    numpy conversion (the reference adapters' calling convention)."""
+    import torch
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    try:
+        cpu = jax.devices("cpu")[0]
+        x = jax.device_put(jnp.arange(8, dtype=jnp.float32), cpu)
+        np.testing.assert_array_equal(
+            hvd.allreduce(x, average=False, name="zc.jax"),
+            np.arange(8, dtype=np.float32))
+        t = torch.arange(8, dtype=torch.float32)
+        np.testing.assert_array_equal(
+            hvd.allreduce(t, average=False, name="zc.torch"),
+            np.arange(8, dtype=np.float32))
+    finally:
+        hvd.shutdown()
+
+
+def test_leaves_to_wire_single_batched_transfer(monkeypatch):
+    """Mixed pytree: host-backed leaves are views; device-backed leaves
+    ride ONE jax.device_get call."""
+    calls = {"n": 0}
+    real = jax.device_get
+
+    def counting(x):
+        calls["n"] += 1
+        return real(x)
+
+    monkeypatch.setattr(jax, "device_get", counting)
+    cpu = jax.devices("cpu")[0]
+    host = jax.device_put(jnp.arange(16, dtype=jnp.float32), cpu)
+    leaves = [np.ones(4, np.float32), host, jnp.zeros((3,)), jnp.ones((2, 2))]
+    # force the last two to be "device-backed" from ingest's viewpoint by
+    # making _host_backed say no (the CPU test env has no real TPU)
+    monkeypatch.setattr(ingest, "_host_backed",
+                        lambda t: t is host)
+    out = ingest.leaves_to_wire(leaves)
+    assert calls["n"] == 1  # one batched fetch for the two device leaves
+    assert _ptr(out[1]) == _ptr(np.asarray(host))  # host leaf is a view
+    for a, b in zip(out, leaves):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_allreduce_parameters_fused_group(monkeypatch):
+    import horovod_tpu as hvd
+    import horovod_tpu.jax as hvd_jax
+
+    hvd.init()
+    try:
+        calls = {"n": 0}
+        real = jax.device_get
+
+        def counting(x):
+            calls["n"] += 1
+            return real(x)
+
+        monkeypatch.setattr(jax, "device_get", counting)
+        tree = {"w": jnp.full((8, 8), 2.0), "b": jnp.ones((8,)),
+                "s": jnp.float32(4.0)}
+        out = hvd_jax.allreduce_parameters(tree, average=True)
+        assert calls["n"] <= 1
+        jax.tree.map(lambda x, y: np.testing.assert_allclose(
             np.asarray(x), np.asarray(y)), tree, out)
     finally:
         hvd.shutdown()
