@@ -59,6 +59,12 @@ def aggregate(trace_dir: str, top: int = 20, per_step_divisor: int = 1):
     ``per_step_divisor`` divides the **times** when the traced block ran
     N steps; ``calls_total`` stays the raw occurrence count across the
     whole capture (ms * per_step_divisor / calls_total = avg per call).
+
+    ``track_resolution`` records, per trace file, whether the sweep used
+    the reliable ``device-pid`` mode (tracks whose ``process_name``
+    metadata names a device) or the ``fallback`` all-tracks mode (PJRT
+    plugins with different track naming) — consumers of the attribution
+    table can see when the less-reliable path produced it.
     """
     def _sweep(events, restrict_pids):
         cat = collections.Counter()
@@ -88,6 +94,7 @@ def aggregate(trace_dir: str, top: int = 20, per_step_divisor: int = 1):
     cat_n = collections.Counter()
     ops = collections.Counter()
     total = 0.0
+    modes = []
     for events in _trace_event_files(trace_dir):
         # device pids announce themselves via process_name metadata
         device_pids = {
@@ -95,11 +102,17 @@ def aggregate(trace_dir: str, top: int = 20, per_step_divisor: int = 1):
             if e.get("ph") == "M" and e.get("name") == "process_name"
             and "device" in str((e.get("args") or {}).get("name", "")).lower()
         }
-        c, cn, o, t = _sweep(events, device_pids)
+        c = None
+        mode = "fallback"
+        if device_pids:  # empty set would sweep unrestricted — that's
+            c, cn, o, t = _sweep(events, device_pids)  # the fallback mode
+            mode = "device-pid"
         if not c:
             # device-track naming varies by PJRT plugin; fall back to all
             # tracks with the host bookkeeping filtered by name above
             c, cn, o, t = _sweep(events, None)
+            mode = "fallback"
+        modes.append(mode)
         cat.update(c)
         cat_n.update(cn)
         ops.update(o)
@@ -107,6 +120,7 @@ def aggregate(trace_dir: str, top: int = 20, per_step_divisor: int = 1):
     div = max(per_step_divisor, 1) * 1e3  # us -> ms, per step
     return {
         "device_total_ms": round(total / div, 3),
+        "track_resolution": modes,
         "by_category": [
             {"name": n, "ms": round(us / div, 3), "calls_total": cat_n[n]}
             for n, us in cat.most_common(top)
