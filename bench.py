@@ -452,6 +452,69 @@ def bench_llama(args, peak_tflops):
     }
 
 
+def bench_projected_scaling(args, models):
+    """The north-star metric the reference publishes as a measured table
+    (90% @ 512 GPUs, ``/root/reference/docs/benchmarks.md:5-38``) and
+    BASELINE.md targets at >90% @ 64 chips: here a PROJECTION with
+    auditable inputs, since the environment has one physical chip.
+
+    Collective bytes come from the AOT-compiled, unrolled, optimized HLO
+    of the real train steps (utils/scaling_projection.py — the
+    bytes-vs-analytic cross-check is asserted in
+    tests/test_scaling_projection.py); compute time is this run's
+    measured marginal step time; link bandwidths are the public per-link
+    ICI figures.  Both the fully-overlapped and fully-serial bounds are
+    reported — measured scheduled-HLO overlap evidence
+    (tests/test_overlap.py) supports the overlapped bound.
+    """
+    from horovod_tpu.utils import scaling_projection as sp
+
+    cache = os.path.join(REPO, ".scaling_cache.json")
+    peaks = dict(_PEAK_TFLOPS)
+    v5e_over_v5p = peaks["v5e"] / peaks["v5p"]  # one source: _PEAK_TFLOPS
+    out = {"method": "HLO collective bytes x published ICI link bandwidth "
+                     "vs measured marginal step time; see "
+                     "docs/scaling_projection.md"}
+    try:
+        rn = sp.cached_analysis(cache, "resnet_dp", sp.analyze_resnet_dp,
+                                n=8, batch_per_chip=8)
+        step_s = models["resnet50"]["step_ms"] / 1e3
+        out["resnet50_dp"] = {
+            "collective_bytes": {k: rn[k] for k in
+                                 ("by_op", "full_bytes_total", "analytic")},
+            "per_chip_batch": args.batch_size,
+            "projection_v5e": sp.project(step_s, rn["by_op"], chip="v5e"),
+            "projection_v5p": sp.project(
+                step_s * v5e_over_v5p, rn["by_op"], chip="v5p"),
+            "v5p_note": "v5p step time scaled by spec-peak ratio "
+                        "(MFU-preserving assumption)",
+        }
+    except Exception as exc:  # noqa: BLE001 - report, don't die
+        out["resnet50_dp"] = {"error": f"{type(exc).__name__}: {exc}"[:200]}
+    try:
+        if "llama" in models and "step_ms" in models.get("llama", {}):
+            ll = sp.cached_analysis(
+                cache, "llama_fsdp", sp.analyze_llama_fsdp,
+                d_model=args.llama_d_model, d_ff=args.llama_d_ff,
+                n_heads=args.llama_heads, n_kv_heads=args.llama_kv_heads,
+                target_layers=args.llama_layers)
+            step_s = models["llama"]["step_ms"] / 1e3
+            out["llama_fsdp"] = {
+                "collective_bytes": {k: ll[k] for k in
+                                     ("by_op", "full_bytes_total",
+                                      "probe_totals", "analytic")},
+                "projection_v5e": sp.project(step_s, ll["by_op"],
+                                             chip="v5e"),
+                "projection_v5p": sp.project(
+                    step_s * v5e_over_v5p, ll["by_op"], chip="v5p"),
+                "v5p_note": "v5p step time scaled by spec-peak ratio "
+                            "(MFU-preserving assumption)",
+            }
+    except Exception as exc:  # noqa: BLE001 - report, don't die
+        out["llama_fsdp"] = {"error": f"{type(exc).__name__}: {exc}"[:200]}
+    return out
+
+
 def bench_eager_ingest(args):
     """Ingest-cost lane (round-3 verdict item 3): what it costs to get
     tensors INTO the eager engine.
@@ -885,6 +948,7 @@ def main() -> None:
                     help=argparse.SUPPRESS)
     ap.add_argument("--skip-pipeline", action="store_true")
     ap.add_argument("--skip-ingest", action="store_true")
+    ap.add_argument("--skip-projection", action="store_true")
     ap.add_argument("--trace", action="store_true",
                     help="attach a per-op device-trace attribution to the "
                          "resnet section (docs/benchmarks.md table)")
@@ -979,6 +1043,8 @@ def main() -> None:
                                "backend tenancy varied between sections")
 
     ingest_lane = {} if args.skip_ingest else bench_eager_ingest(args)
+    projected = {} if args.skip_projection else \
+        bench_projected_scaling(args, models)
     allreduce = {} if args.skip_allreduce else bench_allreduce(args)
     scaling = {} if args.skip_scaling else bench_scaling(args)
     overlap = {} if args.skip_overlap else measure_hlo_overlap()
@@ -1008,6 +1074,7 @@ def main() -> None:
         "combine_threshold_bytes": xla_flags.get_combine_threshold(
             platform=backend if backend in ("tpu", "gpu") else "gpu"),
         "models": models,
+        "projected_scaling": projected,
         "eager_ingest": ingest_lane,
         "allreduce_busbw": allreduce,
         "eager_dp_scaling": scaling,
