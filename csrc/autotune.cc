@@ -89,11 +89,20 @@ void GaussianProcess::Fit(const std::vector<std::vector<double>>& x,
     for (int j = 0; j < n; j++)
       chol_[i * n + j] = Kernel(x_[i], x_[j]) + (i == j ? noise_ : 0.0);
   if (!Cholesky(chol_, n)) {
-    // fall back to stronger regularization
+    // fall back to regularization STRONGER than the primary noise term
+    // (a weaker retry could only be worse-conditioned than what failed)
+    double jitter = noise_ * 10 + 1e-2;
     for (int i = 0; i < n; i++)
       for (int j = 0; j < n; j++)
-        chol_[i * n + j] = Kernel(x_[i], x_[j]) + (i == j ? 1e-2 : 0.0);
-    Cholesky(chol_, n);
+        chol_[i * n + j] = Kernel(x_[i], x_[j]) + (i == j ? jitter : 0.0);
+    if (!Cholesky(chol_, n)) {
+      // still not PD (pathological duplicates): drop to the prior —
+      // Predict()'s n==0 path — instead of solving against garbage
+      x_.clear();
+      y_.clear();
+      alpha_.clear();
+      return;
+    }
   }
   alpha_ = y_;
   ForwardSolve(chol_, n, alpha_);
@@ -135,9 +144,22 @@ void BayesianOptimization::AddSample(const std::vector<double>& x, double y) {
 
 std::vector<double> BayesianOptimization::Best() const {
   if (ys_.empty()) return std::vector<double>(dims_, 0.5);
+  // Converge to the argmax of the GP POSTERIOR MEAN at the observed
+  // points, not of the raw samples: scores are noisy medians of short
+  // timing windows, and raw argmax hands the final decision to one
+  // lucky window.  The posterior (with the kernel's noise term) shrinks
+  // outliers toward what neighboring observations support, so the
+  // converged point follows the central tendency of the evidence.
   size_t best = 0;
-  for (size_t i = 1; i < ys_.size(); i++)
-    if (ys_[i] > ys_[best]) best = i;
+  double best_mean = -1e300;
+  for (size_t i = 0; i < xs_.size(); i++) {
+    double m, v;
+    gp_.Predict(xs_[i], &m, &v);
+    if (m > best_mean) {
+      best_mean = m;
+      best = i;
+    }
+  }
   return xs_[best];
 }
 
@@ -161,6 +183,11 @@ std::vector<double> BayesianOptimization::NextSample() {
     std::vector<double> p(dims_, 0.5);
     for (int d = 0; d < std::min(dims_, 2); d++)
       p[d] = kSeeds[xs_.size()][d];
+    // categorical third dim (hierarchical on/off): alternate it across
+    // the seeds so BOTH algorithms are measured before EI takes over —
+    // 0.5 for every seed would leave the off side unexplored whenever
+    // the budget is short
+    if (dims_ > 2) p[2] = (xs_.size() % 2) ? 1.0 : 0.0;
     return p;
   }
   double best = *std::max_element(ys_.begin(), ys_.end());

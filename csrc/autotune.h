@@ -15,6 +15,7 @@
 #ifndef HVDTPU_AUTOTUNE_H_
 #define HVDTPU_AUTOTUNE_H_
 
+#include <atomic>
 #include <cstdint>
 #include <string>
 #include <vector>
@@ -36,7 +37,11 @@ class GaussianProcess {
 
   double length_scale_ = 0.3;
   double signal_var_ = 1.0;
-  double noise_ = 1e-4;
+  // measurement noise on the (unit-normalized) scores: timing-window
+  // medians on shared hosts vary a few percent; 5% keeps the posterior
+  // from interpolating outliers while letting real 1.5-2x algorithm
+  // differences dominate (Best() relies on this shrinkage)
+  double noise_ = 0.05;
   std::vector<std::vector<double>> x_;
   std::vector<double> y_;
   double y_mean_ = 0.0, y_std_ = 1.0;
@@ -76,6 +81,9 @@ class ParameterManager {
   void Initialize(int64_t fusion0, int64_t cycle_us0,
                   bool tune_hierarchical = false, bool hier0 = false);
   bool active() const { return active_; }
+  // Diagnostic read from any thread (the bg loop owns the write): has the
+  // search finished and applied bo_.Best()?
+  bool Converged() const { return converged_.load(std::memory_order_relaxed); }
 
   // Returns true when new parameter values should be applied (and synced).
   bool RecordCycle(int64_t bytes, double cycle_secs, int64_t* fusion_out,
@@ -104,7 +112,8 @@ class ParameterManager {
   std::vector<double> scores_;
   int warmup_left_ = 0;
   int steps_ = 0;
-  bool converged_ = false;
+  std::atomic<bool> converged_{false};  // written by the bg loop; read by
+                                        // the hvd_autotune_converged API
   std::string log_path_;
 };
 

@@ -276,6 +276,14 @@ class Engine {
         *cross_rank = static_cast<int>(g);
   }
 
+  // Introspection for tests/diagnostics: the allreduce algorithm the
+  // engine is CURRENTLY using (flips when autotune responses apply) and
+  // whether rank 0's autotuner search has finished — together they make
+  // the tuner's converged decision directly observable instead of
+  // inferred from exploration logs.
+  bool Hierarchical() const { return hierarchical_allreduce_.load(); }
+  bool AutotuneConverged() const { return pm_.Converged(); }
+
  private:
   void BackgroundLoop();
   void WaitForWork(std::chrono::microseconds max_wait);
@@ -332,7 +340,9 @@ class Engine {
   std::vector<int> local_group_;        // ranks sharing my host hash, sorted
   std::vector<int> cross_group_;        // local roots (min rank per host)
   std::vector<std::vector<int>> host_groups_;  // all groups, by min rank
-  bool hierarchical_allreduce_ = false;
+  // written by the bg loop (autotune responses) after bootstrap; atomic
+  // so the hvd_hierarchical diagnostic API may read it from any thread
+  std::atomic<bool> hierarchical_allreduce_{false};
   bool hierarchical_allgather_ = false;
 
   // persistent data-plane scratch (background thread only): fusion buffer
@@ -588,6 +598,24 @@ Status Engine::Init(const std::string& host, int port, int rank, int size) {
     for (auto& [h, g] : groups)
       if (g.front() == root) host_groups_.push_back(g);
   bool multi_host = groups.size() > 1;
+  // cross-host egress pacing (userspace token bucket, socket.cc): models
+  // asymmetric intra/inter-host link cost — the condition the
+  // hierarchical two-level paths exist for — on a single test machine,
+  // and throttles real WAN egress.  Applies only to peers on OTHER
+  // hosts; same-host traffic (shm or loopback TCP) stays at full speed.
+  double pace_mbps = 0.0;
+  if (const char* pc = getenv("HOROVOD_TPU_CROSS_HOST_PACE_MBPS"))
+    if (pc[0]) pace_mbps = atof(pc);
+  if (pace_mbps > 0) {
+    int paced = 0;
+    for (int j = 0; j < size_; j++)
+      if (j != rank_ && hashes[j] != hashes[rank_]) {
+        peers_[j].SetPacing(pace_mbps * 1e6);
+        paced++;
+      }
+    LOG_RANK(Debug, rank_) << "cross-host pacing " << pace_mbps << " MB/s on "
+                           << paced << " peer socket(s)";
+  }
   // hierarchical data plane: local ring -> cross ring on local roots ->
   // local broadcast (the eager analog of the reference's two-level path,
   // operations.cc:1284-1446); default on exactly when the topology is
@@ -604,7 +632,7 @@ Status Engine::Init(const std::string& host, int port, int rank, int size) {
   const char* hg = getenv("HOROVOD_TPU_HIERARCHICAL_ALLGATHER");
   if (!hg || !hg[0]) hg = getenv("HOROVOD_HIERARCHICAL_ALLGATHER");
   hierarchical_allgather_ = (hg && hg[0]) ? (strcmp(hg, "0") != 0) : false;
-  hierarchical_allreduce_ &= multi_host;
+  hierarchical_allreduce_ = hierarchical_allreduce_.load() && multi_host;
   hierarchical_allgather_ &= multi_host;
   LOG_RANK(Debug, rank_) << "topology: " << groups.size() << " host group(s),"
                          << " local group size " << local_group_.size()
@@ -2006,6 +2034,19 @@ void hvd_topology(int* local_rank, int* local_size, int* cross_rank,
 
 void hvd_release(int handle) {
   if (g_engine) g_engine->ReleaseHandle(handle);
+}
+
+// Diagnostics: current allreduce algorithm (1 = hierarchical two-level,
+// 0 = flat ring, -1 = engine down) and whether this rank's autotuner has
+// converged (meaningful on rank 0, which owns the search).  Tests assert
+// the tuner's FINAL decision through these instead of re-deriving it
+// from the exploration CSV.
+int hvd_hierarchical() {
+  return g_engine ? (g_engine->Hierarchical() ? 1 : 0) : -1;
+}
+
+int hvd_autotune_converged() {
+  return g_engine ? (g_engine->AutotuneConverged() ? 1 : 0) : -1;
 }
 
 // Diagnostic: standalone throughput (GB/s of dst bytes) of the in-place

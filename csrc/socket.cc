@@ -12,6 +12,7 @@
 #include <unistd.h>
 
 #include <chrono>
+#include <cstdlib>
 #include <thread>
 
 namespace hvdtpu {
@@ -20,6 +21,20 @@ namespace {
 
 Status Errno(const std::string& what) {
   return Status::Error(what + ": " + strerror(errno));
+}
+
+// Duplex no-progress bound, shared with the engine's mixed shm/TCP
+// progress loops.  Parsed with strtoll (integer seconds, empty/unset ->
+// 60, 0 disables) to match engine.cc Timeouts()'s EnvInt64 exactly — the
+// pure-TCP and shm-mixed paths must stall out identically.
+double DuplexTimeoutSecs() {
+  static double t = [] {
+    const char* v = getenv("HOROVOD_TPU_DATA_PLANE_TIMEOUT_SECS");
+    long long secs = 60;
+    if (v && v[0]) secs = strtoll(v, nullptr, 10);
+    return static_cast<double>(secs);
+  }();
+  return t;
 }
 
 void SetNoDelay(int fd) {
@@ -39,9 +54,36 @@ Socket& Socket::operator=(Socket&& o) noexcept {
   if (this != &o) {
     Close();
     fd_ = o.fd_;
+    pace_rate_ = o.pace_rate_;
+    pace_tokens_ = o.pace_tokens_;
+    pace_last_ = o.pace_last_;
     o.fd_ = -1;
   }
   return *this;
+}
+
+void Socket::SetPacing(double bytes_per_sec) {
+  pace_rate_ = bytes_per_sec > 0 ? bytes_per_sec : 0.0;
+  pace_tokens_ = 0.0;
+  pace_last_ = std::chrono::steady_clock::now();
+}
+
+size_t Socket::PaceAllowance(size_t want) {
+  if (pace_rate_ <= 0) return want;
+  auto now = std::chrono::steady_clock::now();
+  double dt = std::chrono::duration<double>(now - pace_last_).count();
+  pace_last_ = now;
+  // burst cap ~20 ms of line rate (min 64 KB so tiny rates still move
+  // whole control messages): bounds the backlog a sleepy sender can dump
+  double burst = pace_rate_ * 0.020;
+  if (burst < 64 * 1024) burst = 64 * 1024;
+  pace_tokens_ += pace_rate_ * dt;
+  if (pace_tokens_ > burst) pace_tokens_ = burst;
+  if (pace_tokens_ < 1.0) return 0;
+  double allowed = pace_tokens_ < static_cast<double>(want)
+                       ? pace_tokens_
+                       : static_cast<double>(want);
+  return static_cast<size_t>(allowed);
 }
 
 Socket::~Socket() { Close(); }
@@ -56,11 +98,17 @@ void Socket::Close() {
 Status Socket::SendAll(const void* data, size_t n) {
   const char* p = static_cast<const char*>(data);
   while (n > 0) {
-    ssize_t k = ::send(fd_, p, n, MSG_NOSIGNAL);
+    size_t chunk = PaceAllowance(n);
+    if (chunk == 0) {  // paced out: wait for the bucket to refill
+      std::this_thread::sleep_for(std::chrono::milliseconds(1));
+      continue;
+    }
+    ssize_t k = ::send(fd_, p, chunk, MSG_NOSIGNAL);
     if (k < 0) {
       if (errno == EINTR) continue;
       return Errno("send");
     }
+    ConsumePace(static_cast<size_t>(k));
     p += k;
     n -= static_cast<size_t>(k);
   }
@@ -83,9 +131,14 @@ Status Socket::RecvAll(void* data, size_t n) {
 }
 
 int Socket::SendSome(const void* data, size_t n) {
+  size_t chunk = PaceAllowance(n);
+  if (chunk == 0) return 0;  // paced out == would-block to callers
   while (true) {
-    ssize_t k = ::send(fd_, data, n, MSG_NOSIGNAL | MSG_DONTWAIT);
-    if (k >= 0) return static_cast<int>(k);
+    ssize_t k = ::send(fd_, data, chunk, MSG_NOSIGNAL | MSG_DONTWAIT);
+    if (k >= 0) {
+      ConsumePace(static_cast<size_t>(k));
+      return static_cast<int>(k);
+    }
     if (errno == EINTR) continue;
     if (errno == EAGAIN || errno == EWOULDBLOCK) return 0;
     return -1;
@@ -108,15 +161,25 @@ Status Socket::SendRecv(Socket& send_sock, const void* send_buf, size_t send_n,
   const char* sp = static_cast<const char*>(send_buf);
   char* rp = static_cast<char*>(recv_buf);
   size_t sleft = send_n, rleft = recv_n;
+  // No progress on either direction for the (configurable) duplex bound
+  // is the failure condition; a paced sender waiting on its token bucket
+  // is NOT stuck, so the deadline resets on progress rather than being
+  // one fixed poll timeout.
+  const double limit_s = DuplexTimeoutSecs();
+  auto last_progress = std::chrono::steady_clock::now();
   while (sleft > 0 || rleft > 0) {
+    size_t schunk = 0;
     struct pollfd fds[2];
     int nf = 0;
     int si = -1, ri = -1;
     if (sleft > 0) {
-      si = nf;
-      fds[nf].fd = send_sock.fd_;
-      fds[nf].events = POLLOUT;
-      nf++;
+      schunk = send_sock.PaceAllowance(sleft);
+      if (schunk > 0) {
+        si = nf;
+        fds[nf].fd = send_sock.fd_;
+        fds[nf].events = POLLOUT;
+        nf++;
+      }
     }
     if (rleft > 0) {
       ri = nf;
@@ -124,31 +187,51 @@ Status Socket::SendRecv(Socket& send_sock, const void* send_buf, size_t send_n,
       fds[nf].events = POLLIN;
       nf++;
     }
-    int rc = ::poll(fds, nf, 60000);
-    if (rc < 0) {
-      if (errno == EINTR) continue;
-      return Errno("poll");
-    }
-    if (rc == 0) return Status::Error("send_recv timed out after 60s");
-    if (si >= 0 && (fds[si].revents & (POLLOUT | POLLERR | POLLHUP))) {
-      ssize_t k = ::send(send_sock.fd_, sp, sleft, MSG_NOSIGNAL | MSG_DONTWAIT);
-      if (k < 0 && errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR)
-        return Errno("send");
-      if (k > 0) {
-        sp += k;
-        sleft -= static_cast<size_t>(k);
+    if (nf == 0) {  // only a paced-out send remains: wait for tokens
+      std::this_thread::sleep_for(std::chrono::milliseconds(1));
+    } else {
+      // short poll when the send side is paced out so it re-checks the
+      // bucket promptly instead of sitting in a long POLLIN wait; cap
+      // by the configured no-progress bound so a short bound is
+      // enforced promptly, not after a 60 s poll
+      int base_ms = 60000;
+      if (limit_s > 0 && limit_s * 1000 < base_ms)
+        base_ms = static_cast<int>(limit_s * 1000) + 1;
+      int timeout_ms = (sleft > 0 && si < 0) ? 5 : base_ms;
+      int rc = ::poll(fds, nf, timeout_ms);
+      if (rc < 0) {
+        if (errno == EINTR) continue;
+        return Errno("poll");
+      }
+      if (si >= 0 && (fds[si].revents & (POLLOUT | POLLERR | POLLHUP))) {
+        ssize_t k =
+            ::send(send_sock.fd_, sp, schunk, MSG_NOSIGNAL | MSG_DONTWAIT);
+        if (k < 0 && errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR)
+          return Errno("send");
+        if (k > 0) {
+          send_sock.ConsumePace(static_cast<size_t>(k));
+          sp += k;
+          sleft -= static_cast<size_t>(k);
+          last_progress = std::chrono::steady_clock::now();
+        }
+      }
+      if (ri >= 0 && (fds[ri].revents & (POLLIN | POLLERR | POLLHUP))) {
+        ssize_t k = ::recv(recv_sock.fd_, rp, rleft, MSG_DONTWAIT);
+        if (k < 0 && errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR)
+          return Errno("recv");
+        if (k == 0) return Status::Error("peer closed connection");
+        if (k > 0) {
+          rp += k;
+          rleft -= static_cast<size_t>(k);
+          last_progress = std::chrono::steady_clock::now();
+        }
       }
     }
-    if (ri >= 0 && (fds[ri].revents & (POLLIN | POLLERR | POLLHUP))) {
-      ssize_t k = ::recv(recv_sock.fd_, rp, rleft, MSG_DONTWAIT);
-      if (k < 0 && errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR)
-        return Errno("recv");
-      if (k == 0) return Status::Error("peer closed connection");
-      if (k > 0) {
-        rp += k;
-        rleft -= static_cast<size_t>(k);
-      }
-    }
+    if (limit_s > 0 &&
+        std::chrono::duration<double>(std::chrono::steady_clock::now() -
+                                      last_progress)
+                .count() > limit_s)
+      return Status::Error("send_recv made no progress inside the timeout");
   }
   return Status::OK();
 }

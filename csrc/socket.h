@@ -4,6 +4,7 @@
 // pattern (/root/reference/horovod/spark/util/network.py) re-done natively.
 #pragma once
 
+#include <chrono>
 #include <cstdint>
 #include <string>
 #include <vector>
@@ -18,7 +19,11 @@ class Socket {
   explicit Socket(int fd) : fd_(fd) {}
   Socket(const Socket&) = delete;
   Socket& operator=(const Socket&) = delete;
-  Socket(Socket&& o) noexcept : fd_(o.fd_) { o.fd_ = -1; }
+  Socket(Socket&& o) noexcept
+      : fd_(o.fd_), pace_rate_(o.pace_rate_), pace_tokens_(o.pace_tokens_),
+        pace_last_(o.pace_last_) {
+    o.fd_ = -1;
+  }
   Socket& operator=(Socket&& o) noexcept;
   ~Socket();
 
@@ -56,8 +61,25 @@ class Socket {
   // other hosts can reach us at (multi-host data-plane advertising).
   std::string LocalAddr() const;
 
+  // Userspace token-bucket egress pacing (0 disables).  The engine
+  // applies it to CROSS-HOST peer sockets when
+  // HOROVOD_TPU_CROSS_HOST_PACE_MBPS is set: on a single test machine it
+  // models the asymmetric intra/inter-host link cost the hierarchical
+  // paths exist for (reference rationale: operations.cc two-level
+  // allreduce), and on real fabrics it doubles as an egress throttle.
+  // Single-threaded per socket, like every other Socket method here.
+  void SetPacing(double bytes_per_sec);
+
  private:
+  // Refill the bucket and return how many of `want` bytes may be sent
+  // now (0 = caller should back off); ConsumePace after the real send.
+  size_t PaceAllowance(size_t want);
+  void ConsumePace(size_t sent) { pace_tokens_ -= static_cast<double>(sent); }
+
   int fd_ = -1;
+  double pace_rate_ = 0.0;    // bytes/sec; 0 = unpaced
+  double pace_tokens_ = 0.0;  // current bucket fill (bytes)
+  std::chrono::steady_clock::time_point pace_last_{};
 };
 
 class Listener {

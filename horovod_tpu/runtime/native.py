@@ -157,6 +157,8 @@ def _bind(lib):
     lib.hvd_release.restype = None
     lib.hvd_topology.argtypes = [ctypes.POINTER(ctypes.c_int)] * 4
     lib.hvd_topology.restype = None
+    lib.hvd_hierarchical.restype = ctypes.c_int
+    lib.hvd_autotune_converged.restype = ctypes.c_int
     return lib
 
 
@@ -212,6 +214,16 @@ class NativeEngine(Engine):
                 f"{topology.size}, rendezvous {host}:{port})"
             )
         self._lib = lib
+
+    def diagnostics(self) -> dict:
+        """Engine introspection: the allreduce algorithm currently in use
+        and whether this rank's autotuner finished its search (rank 0
+        owns the search) — lets tests assert the tuner's converged
+        decision directly."""
+        return {
+            "hierarchical": int(self._lib.hvd_hierarchical()),
+            "autotune_converged": int(self._lib.hvd_autotune_converged()),
+        }
 
     def local_topology(self) -> tuple[int, int, int, int]:
         """(local_rank, local_size, cross_rank, cross_size) from the

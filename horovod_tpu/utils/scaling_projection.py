@@ -100,6 +100,26 @@ def _group_size(line: str) -> int | None:
     return 1
 
 
+def _operand_count(line: str) -> int:
+    """Number of operands in an HLO op call: top-level comma count inside
+    the first parenthesized group after the op name.  Operand names never
+    contain commas or parens; 0 when the group can't be found."""
+    i = line.find("(", line.find(" all-"))
+    if i < 0:
+        return 0
+    depth, count = 0, 1
+    for ch in line[i:]:
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+            if depth == 0:
+                return count
+        elif ch == "," and depth == 1:
+            count += 1
+    return 0
+
+
 def parse_collective_bytes(hlo_text: str,
                            default_group_size: int | None = None) -> dict:
     """Collective traffic of one compiled program, from its HLO text.
@@ -147,13 +167,15 @@ def parse_collective_bytes(hlo_text: str,
         elif start and op in ("all-gather", "all-to-all"):
             payload = max(sizes)  # (input, output): output is the payload
         elif start and op == "all-reduce":
-            # shape is either just the result, or an (operands...,
-            # results...) tuple whose halves mirror each other — detect
-            # the mirrored form instead of assuming it
+            # shape is either the results alone (variadic: one element
+            # per operand) or an (operands..., results...) tuple (twice
+            # as many elements as operands).  Equal byte-sums of the two
+            # halves can't distinguish these — a variadic reduce of two
+            # equal-shaped grads looks mirrored too — so count the
+            # actual operands in the call
             payload = sum(sizes)
-            h = len(sizes) // 2
-            if h and len(sizes) % 2 == 0 and \
-                    sum(sizes[:h]) == sum(sizes[h:]):
+            n_operands = _operand_count(line)
+            if n_operands and len(sizes) == 2 * n_operands:
                 payload //= 2
         else:
             payload = sum(sizes)  # sync form: result tuple == payload

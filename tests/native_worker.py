@@ -312,6 +312,46 @@ def scenario_autotune_hier():
     print(f"rank {r}: autotune hier OK", flush=True)
 
 
+def scenario_autotune_hier_converge():
+    """Sustained SIZEABLE traffic on a simulated 2x2-host topology with
+    autotune owning the hierarchical knob.  The test harness optionally
+    sets HOROVOD_TPU_CROSS_HOST_PACE_MBPS (asymmetric links: two-level
+    should score best) or leaves links symmetric (flat should score
+    best); this worker just generates the load and keeps results
+    correct."""
+    r = int(os.environ["HOROVOD_TPU_RANK"])
+    os.environ["HOROVOD_TPU_HOST_HASH"] = f"simhost{r // 2}"
+    os.environ.pop("HOROVOD_TPU_HIERARCHICAL_ALLREDUCE", None)
+    os.environ.pop("HOROVOD_HIERARCHICAL_ALLREDUCE", None)
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    # payload sized by the test per fabric (HVD_TEST_AR_FLOATS): the
+    # algorithm choice must move round time well above the 1-core box's
+    # scheduling noise — paced legs need ~256 KB tensors (pacing sets
+    # the scale), symmetric legs ~1 MB (shm memcpy sets it)
+    floats = int(os.environ.get("HVD_TEST_AR_FLOATS", "65536"))
+    data = np.full(floats, float(r), np.float32)
+    expect = float(sum(range(n)))
+    for step in range(60):
+        handles = [
+            hvd.allreduce_async(data, average=False, name=f"s{step}.g{i}")
+            for i in range(4)
+        ]
+        for h in handles:
+            got = hvd.synchronize(h)
+            assert np.allclose(got, expect), (r, step, got[0])
+    # rank 0 owns the search: report the engine's ACTUAL post-convergence
+    # state (the applied bo_.Best() decision), not an inference from logs
+    if r == 0:
+        from horovod_tpu.runtime import state as _state
+
+        d = _state.engine().diagnostics()
+        print(f"rank 0: converged={d['autotune_converged']} "
+              f"hier={d['hierarchical']}", flush=True)
+    hvd.shutdown()
+    print(f"rank {r}: autotune converge OK", flush=True)
+
+
 def scenario_skewed_shutdown():
     """Rank 0 lags its shutdown by seconds (checkpointing, logging...) while
     the peers shut down and exit immediately.  Regression: the engine's

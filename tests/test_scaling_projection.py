@@ -65,15 +65,25 @@ def test_async_start_forms():
 ENTRY %main {
   %ars = bf16[1024]{0} all-reduce-start(%x), replica_groups={{0,1,2,3,4,5,6,7}}, to_apply=%add
   %arm = (f32[64]{0}, f32[64]{0}) all-reduce-start(%y), replica_groups={{0,1,2,3,4,5,6,7}}, to_apply=%add
+  %arv = (bf16[256]{0}, bf16[256]{0}) all-reduce-start(%a, %b), replica_groups={{0,1,2,3,4,5,6,7}}, to_apply=%add
   %ags = (bf16[4,8]{1,0}, bf16[32,8]{1,0}) all-gather-start(%z), replica_groups=[1,8]<=[8], dimensions={0}
 }
 """
     by = sp.parse_collective_bytes(txt)["by_op"]
-    # plain-result start form counts the full reduced tensor
-    # (1024*2) + mirrored-tuple form counts one half (64*4)
-    assert by["all-reduce"]["full_bytes"] == 1024 * 2 + 64 * 4
+    # plain-result start (1024*2) + (operand, result) pair counted once
+    # (64*4) + VARIADIC start of two equal grads counted in full
+    # (2*256*2 — equal-halves alone can't identify the pair form; the
+    # operand count disambiguates)
+    assert by["all-reduce"]["full_bytes"] == 1024 * 2 + 64 * 4 + 2 * 256 * 2
     # all-gather-start (in, out): out is the payload
     assert by["all-gather"]["full_bytes"] == 32 * 8 * 2
+
+
+def test_operand_count():
+    assert sp._operand_count(
+        "%a = f32[4]{0} all-reduce-start(%x), replica_groups={{0,1}}") == 1
+    assert sp._operand_count(
+        "%a = (f32[4]{0}) all-reduce-start(%x, %y, %z), to_apply=%f") == 3
 
 
 def test_empty_replica_groups_need_default():
