@@ -24,16 +24,12 @@ Status Errno(const std::string& what) {
 }
 
 // Duplex no-progress bound, shared with the engine's mixed shm/TCP
-// progress loops.  Parsed with strtoll (integer seconds, empty/unset ->
-// 60, 0 disables) to match engine.cc Timeouts()'s EnvInt64 exactly — the
-// pure-TCP and shm-mixed paths must stall out identically.
+// progress loops: the SAME EnvInt64 parse as engine.cc Timeouts()
+// (unset -> 60, "" -> 0 -> disabled), so the pure-TCP and shm-mixed
+// paths stall out identically.
 double DuplexTimeoutSecs() {
-  static double t = [] {
-    const char* v = getenv("HOROVOD_TPU_DATA_PLANE_TIMEOUT_SECS");
-    long long secs = 60;
-    if (v && v[0]) secs = strtoll(v, nullptr, 10);
-    return static_cast<double>(secs);
-  }();
+  static double t = static_cast<double>(
+      EnvInt64("HOROVOD_TPU_DATA_PLANE_TIMEOUT_SECS", 60));
   return t;
 }
 
