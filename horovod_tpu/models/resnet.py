@@ -47,6 +47,17 @@ class ResNetConfig:
     # in the original [7,7,3,w] shape either way, so checkpoints are
     # interchangeable.
     stem_s2d: bool = True
+    # Rematerialisation: "none" stores every activation for backward;
+    # "blocks" checkpoints each bottleneck block (recompute its interior
+    # in backward — the HBM-for-FLOPs trade the round-3 trace motivates:
+    # the step is HBM-bound, ~79 ms/step of activation traffic vs 18 ms
+    # of conv FLOPs).  Whether it wins is measured, not assumed — see
+    # docs/benchmarks.md.
+    remat: str = "none"
+
+    def __post_init__(self):
+        if self.remat not in ("none", "blocks"):
+            raise ValueError(f"unknown remat mode {self.remat!r}")
 
     @property
     def stage_blocks(self):
@@ -227,11 +238,17 @@ def apply(params, state, images, config: ResNetConfig = ResNetConfig(),
         x, -jnp.inf, lax.max, (1, 3, 3, 1), (1, 2, 2, 1), "SAME"
     )
     new_state: dict = {"bn_stem": stem_s}
+    block = _bottleneck_apply
+    if config.remat == "blocks":  # validated in ResNetConfig.__post_init__
+        # static args (stride/config/train) by closure would retrace per
+        # call site anyway; checkpoint the 5-arg form with them static
+        block = jax.checkpoint(_bottleneck_apply,
+                               static_argnums=(3, 4, 5))
     for i in range(len(config.stage_blocks)):
         stage_s = []
         for b, (p, s) in enumerate(zip(params[f"stage{i}"], state[f"stage{i}"])):
             stride = 2 if (b == 0 and i > 0) else 1
-            x, ns = _bottleneck_apply(x, p, s, stride, config, train)
+            x, ns = block(x, p, s, stride, config, train)
             stage_s.append(ns)
         new_state[f"stage{i}"] = stage_s
     x = jnp.mean(x.astype(jnp.float32), axis=(1, 2))

@@ -87,3 +87,33 @@ def test_train_step_decreases_loss():
         params, state, opt_state, loss = step(params, state, opt_state)
         losses.append(float(loss))
     assert losses[-1] < losses[0]
+
+
+def test_remat_blocks_matches_none(monkeypatch):
+    """remat="blocks" must be a pure memory/recompute trade: identical
+    loss and matching fp32 gradients vs remat="none" (tight allclose —
+    XLA may reassociate the recompute subgraph differently, bitwise
+    equality is not a guaranteed invariant)."""
+    monkeypatch.setitem(resnet.STAGE_BLOCKS, 8, (1, 1, 1, 1))  # tiny: CPU
+    outs = []
+    for mode in ("none", "blocks"):
+        cfg = resnet.ResNetConfig(depth=8, num_classes=16, width=8,
+                                  compute_dtype=jnp.float32, remat=mode)
+        params, state = resnet.init(jax.random.key(0), cfg)
+        rng = np.random.RandomState(0)
+        images = jnp.asarray(rng.rand(2, 32, 32, 3), jnp.float32)
+        labels = jnp.asarray(rng.randint(0, 16, 2), jnp.int32)
+        (loss, _), grads = jax.value_and_grad(
+            resnet.loss_fn, has_aux=True)(params, state, images, labels,
+                                          cfg)
+        outs.append((float(loss), grads))
+    np.testing.assert_allclose(outs[0][0], outs[1][0], rtol=1e-6)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-7),
+        outs[0][1], outs[1][1])
+
+
+def test_remat_unknown_mode_raises_at_config():
+    with np.testing.assert_raises(ValueError):
+        resnet.ResNetConfig(depth=50, num_classes=8, width=8,
+                            remat="everything")
