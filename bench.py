@@ -517,6 +517,11 @@ def bench_projected_scaling(args, models):
             "projection_v5e": sp.project(step_s, rn["by_op"], chip="v5e"),
             "projection_v5p": sp.project(
                 step_s * v5e_over_v5p, rn["by_op"], chip="v5p"),
+            # DP ACROSS hosts: intra-host ICI leg + per-host DCN leg —
+            # the fabric the hierarchical algorithm exists for
+            "projection_v5e_multihost_dcn": sp.project_multihost(
+                step_s, rn["by_op"], chip="v5e", chips_per_host=4,
+                hosts=(2, 4, 16)),
             "v5p_note": "v5p step time scaled by spec-peak ratio "
                         "(MFU-preserving assumption)",
         }

@@ -436,12 +436,16 @@ def merge_attention_blocks(o_a, lse_a, o_b, lse_b):
     return jnp.moveaxis(o, 1, 2).astype(o_a.dtype), lse_new
 
 
-def flash_attn_fn(causal: bool = True, block_q: int = 512,
+def flash_attn_fn(causal: bool = True, block_q: int | None = None,
                   block_k: int = 1024, interpret: bool = False):
     """Adapter producing the ``attn_fn(q, k, v, positions)`` callback used by
     :func:`horovod_tpu.models.llama.apply`.  ``positions`` must be a
     contiguous range (the model's default); its first element is the global
     offset.
+
+    ``block_q=None`` picks per shape: 1024 when the (padded) length is a
+    >=2048 multiple of 1024 (measured +0.9% over 512 on the bench llama
+    at seq 2048 — block-size sweep in docs/benchmarks.md), else 512.
 
     Sequence lengths that don't tile into 128-wide Mosaic lanes are
     zero-padded up to the next multiple (and sliced back): padded KEY rows
@@ -462,8 +466,12 @@ def flash_attn_fn(causal: bool = True, block_q: int = 512,
         if pad:
             cfg = [(0, 0), (0, pad), (0, 0), (0, 0)]
             q, k, v = (jnp.pad(a, cfg) for a in (q, k, v))
+        bq = block_q
+        if bq is None:
+            Tp = T + pad
+            bq = 1024 if (Tp >= 2048 and Tp % 1024 == 0) else 512
         out = flash_attention(q, k, v, start, start, causal,
-                              block_q, block_k, interpret)
+                              bq, block_k, interpret)
         if pad:
             out = out[:, :T]
         return out.reshape(B, T, Hq * Dh)

@@ -216,6 +216,18 @@ def bus_bytes_per_chip(by_op: dict, n: int) -> float:
     return sum(d["full_bytes"] * factors[op] for op, d in by_op.items())
 
 
+def _efficiency_entry(step_time_s: float, t_comm: float) -> dict:
+    """The shared per-point efficiency fields: fully-overlapped bound
+    (comm hides behind compute) and fully-serial floor."""
+    return {
+        "t_comm_ms": round(t_comm * 1e3, 3),
+        "efficiency_overlapped": round(
+            step_time_s / max(step_time_s, t_comm), 4),
+        "efficiency_serial": round(
+            step_time_s / (step_time_s + t_comm), 4),
+    }
+
+
 def project(step_time_s: float, by_op: dict, chip: str = "v5p",
             chips=(8, 16, 64), axes_used: int = 1) -> dict:
     """Weak-scaling efficiency projection.
@@ -241,11 +253,50 @@ def project(step_time_s: float, by_op: dict, chip: str = "v5p",
         t_comm = bus_bytes_per_chip(by_op, n) / bw
         out["per_chips"][str(n)] = {
             "bus_bytes_per_chip": int(bus_bytes_per_chip(by_op, n)),
-            "t_comm_ms": round(t_comm * 1e3, 3),
-            "efficiency_overlapped": round(
-                step_time_s / max(step_time_s, t_comm), 4),
-            "efficiency_serial": round(
-                step_time_s / (step_time_s + t_comm), 4),
+            **_efficiency_entry(step_time_s, t_comm),
+        }
+    return out
+
+
+def project_multihost(step_time_s: float, by_op: dict, chip: str = "v5p",
+                      chips_per_host: int = 4, hosts=(2, 4, 16)) -> dict:
+    """Weak-scaling projection for data parallelism ACROSS hosts: the
+    two-level collective the eager engine's hierarchical path (and
+    GSPMD's hierarchical lowering) implements — an intra-host leg over
+    ICI at group size ``chips_per_host``, then an inter-host leg over
+    each host's DCN NIC (``DCN_HOST_GBPS``) at group size = host count.
+
+    This is the fabric where the hierarchical algorithm earns its keep
+    (cf. the paced-socket bench lane): the DCN leg moves the payload
+    once per host rather than once per chip.  The model-parallel axes
+    (FSDP/TP/SP) are assumed to stay inside the ICI domain — the layout
+    ``hybrid_mesh`` produces — so only the DP-gradient traffic crosses
+    DCN.
+    """
+    other = {k: v["full_bytes"] for k, v in by_op.items()
+             if k != "all-reduce" and v.get("full_bytes", 0) > 0}
+    if other:
+        raise ValueError(
+            "project_multihost models DP-gradient (all-reduce) traffic "
+            f"crossing DCN; got model-parallel collectives {sorted(other)} "
+            "— those axes belong inside the ICI domain (hybrid_mesh); "
+            "pass only the DP all-reduce traffic")
+    link = ICI_LINKS[chip]
+    w_ici = link["gbps_oneway"] * 1e9
+    w_dcn = DCN_HOST_GBPS * 1e9
+    c = chips_per_host
+    out = {"chip": chip, "chips_per_host": c,
+           "dcn_gbps_per_host": DCN_HOST_GBPS,
+           "step_time_ms": round(step_time_s * 1e3, 2), "per_hosts": {}}
+    t_intra = bus_bytes_per_chip(by_op, c) / w_ici if c > 1 else 0.0
+    for h in hosts:
+        # inter leg: each host's local root moves factor(h)*payload
+        # through the NIC (per-HOST bandwidth, not per-chip)
+        t_inter = bus_bytes_per_chip(by_op, h) / w_dcn if h > 1 else 0.0
+        out["per_hosts"][str(h)] = {
+            "chips_total": c * h,
+            "t_dcn_ms": round(t_inter * 1e3, 3),
+            **_efficiency_entry(step_time_s, t_intra + t_inter),
         }
     return out
 

@@ -144,6 +144,28 @@ def test_projection_known_value_and_monotonicity():
         10 / p["t_comm_ms"], rel=0.01)
 
 
+def test_multihost_dcn_projection():
+    # 100 MB allreduce, 4 chips/host over v5e ICI + per-host 25 GB/s DCN
+    by_op = {"all-reduce": {"count": 1, "full_bytes": 100e6}}
+    out = sp.project_multihost(0.100, by_op, chip="v5e", chips_per_host=4,
+                               hosts=(2, 16))
+    p2 = out["per_hosts"]["2"]
+    # intra: 2*(3/4)*100e6/45e9 = 3.333ms; inter: 2*(1/2)*100e6/25e9 = 4ms
+    assert p2["t_comm_ms"] == pytest.approx(7.333, abs=0.05)
+    assert p2["t_dcn_ms"] == pytest.approx(4.0, abs=0.05)
+    assert p2["chips_total"] == 8
+    # the DCN leg grows with (h-1)/h but stays bounded: efficiency at 16
+    # hosts (64 chips) still within a few points of 2 hosts
+    p16 = out["per_hosts"]["16"]
+    assert p16["efficiency_serial"] > 0.85
+    assert p16["efficiency_serial"] <= p2["efficiency_serial"]
+    # model-parallel collectives must be REJECTED, not silently routed
+    # over the 25 GB/s NIC (FSDP belongs inside the ICI domain)
+    with pytest.raises(ValueError, match="ICI domain"):
+        sp.project_multihost(0.1, {"all-gather": {"count": 1,
+                                                  "full_bytes": 1e9}})
+
+
 # ---------------------------------------------------------------------------
 # bytes-vs-analytic on a real AOT-compiled step (the verdict's check)
 # ---------------------------------------------------------------------------
