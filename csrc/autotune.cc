@@ -134,7 +134,8 @@ void GaussianProcess::Predict(const std::vector<double>& x, double* mean,
 // BayesianOptimization
 // ---------------------------------------------------------------------------
 
-BayesianOptimization::BayesianOptimization(int dims) : dims_(dims) {}
+BayesianOptimization::BayesianOptimization(int dims, int categorical_dim)
+    : dims_(dims), categorical_dim_(categorical_dim) {}
 
 void BayesianOptimization::AddSample(const std::vector<double>& x, double y) {
   xs_.push_back(x);
@@ -181,13 +182,25 @@ std::vector<double> BayesianOptimization::NextSample() {
       {0.25, 0.25}, {0.75, 0.25}, {0.25, 0.75}, {0.75, 0.75}};
   if (xs_.size() < 4) {
     std::vector<double> p(dims_, 0.5);
-    for (int d = 0; d < std::min(dims_, 2); d++)
-      p[d] = kSeeds[xs_.size()][d];
-    // categorical third dim (hierarchical on/off): alternate it across
-    // the seeds so BOTH algorithms are measured before EI takes over —
-    // 0.5 for every seed would leave the off side unexplored whenever
-    // the budget is short
-    if (dims_ > 2) p[2] = (xs_.size() % 2) ? 1.0 : 0.0;
+    int cont_dims = dims_ - (categorical_dim_ >= 0 ? 1 : 0);
+    int j = 0;
+    for (int d = 0; d < dims_; d++) {
+      if (d == categorical_dim_) {
+        // categorical (hierarchical on/off): alternate it across the
+        // seeds so BOTH algorithms are measured before EI takes over —
+        // 0.5 for every seed would leave the off side unexplored
+        // whenever the budget is short
+        p[d] = (xs_.size() % 2) ? 1.0 : 0.0;
+      } else if (j < 2) {
+        // a single continuous dim (others env-pinned) gets 4 DISTINCT
+        // seed values — the 2-D grid would duplicate points and waste
+        // half the pre-EI budget on re-measurement
+        p[d] = cont_dims == 1
+                   ? 0.2 + 0.2 * static_cast<double>(xs_.size())
+                   : kSeeds[xs_.size()][j];
+        j++;
+      }
+    }
     return p;
   }
   double best = *std::max_element(ys_.begin(), ys_.end());
@@ -218,7 +231,8 @@ constexpr double kCycleMinUs = 1e3, kCycleMaxUs = 1e5;  // 1..100 ms
 }  // namespace
 
 void ParameterManager::Initialize(int64_t fusion0, int64_t cycle_us0,
-                                  bool tune_hierarchical, bool hier0) {
+                                  bool tune_hierarchical, bool hier0,
+                                  bool tune_fusion, bool tune_cycle) {
   const char* on = getenv("HOROVOD_AUTOTUNE");
   if (!on || !on[0] || !strcmp(on, "0")) on = getenv("HOROVOD_TPU_AUTOTUNE");
   active_ = on && on[0] && strcmp(on, "0") != 0;
@@ -227,7 +241,22 @@ void ParameterManager::Initialize(int64_t fusion0, int64_t cycle_us0,
   tune_hier_ = tune_hierarchical;
   hier_ = hier0;
   if (!active_) return;
-  if (tune_hier_) bo_ = BayesianOptimization(3);
+  // env-pinned knobs leave the search space entirely (reference
+  // fixed=true semantics): the GP never spends a dimension on them and
+  // SetPoint can never move them off the pinned value
+  knobs_.clear();
+  if (tune_fusion) knobs_.push_back(kFusion);
+  if (tune_cycle) knobs_.push_back(kCycle);
+  int cat = -1;
+  if (tune_hier_) {
+    cat = static_cast<int>(knobs_.size());
+    knobs_.push_back(kHier);
+  }
+  if (knobs_.empty()) {  // everything pinned: nothing to tune
+    active_ = false;
+    return;
+  }
+  bo_ = BayesianOptimization(static_cast<int>(knobs_.size()), cat);
   const char* log = getenv("HOROVOD_AUTOTUNE_LOG");
   log_path_ = log ? log : "";
   cycles_per_sample_ =
@@ -238,10 +267,17 @@ void ParameterManager::Initialize(int64_t fusion0, int64_t cycle_us0,
       static_cast<int>(EnvInt64("HOROVOD_TPU_AUTOTUNE_WARMUP_SAMPLES", 3));
   max_steps_ = static_cast<int>(EnvInt64("HOROVOD_TPU_AUTOTUNE_MAX_STEPS", 20));
   warmup_left_ = warmup_samples_;
-  current_unit_ = {std::min(1.0, static_cast<double>(fusion0) / kFusionMax),
-                   (static_cast<double>(cycle_us0) - kCycleMinUs) /
-                       (kCycleMaxUs - kCycleMinUs)};
-  if (tune_hier_) current_unit_.push_back(hier0 ? 1.0 : 0.0);
+  current_unit_.clear();
+  for (int k : knobs_) {
+    if (k == kFusion)
+      current_unit_.push_back(
+          std::min(1.0, static_cast<double>(fusion0) / kFusionMax));
+    else if (k == kCycle)
+      current_unit_.push_back((static_cast<double>(cycle_us0) - kCycleMinUs) /
+                              (kCycleMaxUs - kCycleMinUs));
+    else
+      current_unit_.push_back(hier0 ? 1.0 : 0.0);
+  }
   if (!log_path_.empty()) {
     FILE* f = fopen(log_path_.c_str(), "w");
     if (f) {
@@ -263,10 +299,15 @@ void ParameterManager::Log(double score) {
 
 void ParameterManager::SetPoint(const std::vector<double>& unit) {
   current_unit_ = unit;
-  fusion_ = static_cast<int64_t>(unit[0] * kFusionMax);
-  cycle_us_ = static_cast<int64_t>(kCycleMinUs +
-                                   unit[1] * (kCycleMaxUs - kCycleMinUs));
-  if (tune_hier_ && unit.size() > 2) hier_ = unit[2] >= 0.5;
+  for (size_t i = 0; i < knobs_.size() && i < unit.size(); i++) {
+    if (knobs_[i] == kFusion)
+      fusion_ = static_cast<int64_t>(unit[i] * kFusionMax);
+    else if (knobs_[i] == kCycle)
+      cycle_us_ = static_cast<int64_t>(
+          kCycleMinUs + unit[i] * (kCycleMaxUs - kCycleMinUs));
+    else
+      hier_ = unit[i] >= 0.5;
+  }
 }
 
 bool ParameterManager::RecordCycle(int64_t bytes, double cycle_secs,

@@ -52,7 +52,10 @@ class GaussianProcess {
 // Expected-improvement Bayesian maximizer over the unit hypercube.
 class BayesianOptimization {
  public:
-  explicit BayesianOptimization(int dims);
+  // ``categorical_dim``: index of a 0/1 categorical dimension (or -1);
+  // seed points alternate it so both categories are measured even on
+  // short budgets.
+  explicit BayesianOptimization(int dims, int categorical_dim = -1);
   void AddSample(const std::vector<double>& x, double y);
   // Next point to evaluate: seed points first, then argmax-EI over random
   // candidates (deterministic LCG so runs are reproducible).
@@ -63,6 +66,7 @@ class BayesianOptimization {
   double ExpectedImprovement(const std::vector<double>& x, double best) const;
 
   int dims_;
+  int categorical_dim_;
   uint64_t rng_ = 0x9e3779b97f4a7c15ull;
   std::vector<std::vector<double>> xs_;
   std::vector<double> ys_;
@@ -78,8 +82,13 @@ class BayesianOptimization {
 // writes the new values (*hier_out is -1 when the knob isn't tuned).
 class ParameterManager {
  public:
+  // ``tune_fusion``/``tune_cycle`` false = the env pinned that knob: it
+  // stays at its initial value and leaves the search space entirely (the
+  // reference's ParameterManager fixed=true semantics,
+  // parameter_manager.h:67-81).
   void Initialize(int64_t fusion0, int64_t cycle_us0,
-                  bool tune_hierarchical = false, bool hier0 = false);
+                  bool tune_hierarchical = false, bool hier0 = false,
+                  bool tune_fusion = true, bool tune_cycle = true);
   bool active() const { return active_; }
   // Diagnostic read from any thread (the bg loop owns the write): has the
   // search finished and applied bo_.Best()?
@@ -96,6 +105,10 @@ class ParameterManager {
   bool active_ = false;
   bool tune_hier_ = false;
   bool hier_ = false;
+  // which knobs the search owns, in unit-vector order (fixed knobs are
+  // excluded — not merely held, so the GP never wastes a dimension)
+  enum Knob { kFusion, kCycle, kHier };
+  std::vector<int> knobs_;
   BayesianOptimization bo_{2};
   std::vector<double> current_unit_;
   int64_t fusion_ = 64 << 20;

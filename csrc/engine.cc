@@ -644,12 +644,24 @@ Status Engine::Init(const std::string& host, int port, int rank, int size) {
   // staging (operations.cc:929-1033). Kill-switch: HOROVOD_TPU_SHM=0 on
   // the launcher/rank 0 (the table ships the decision to every rank).
   if (size_ > 1 && shm_on) SetupShm(shm_token);
-  // the autotuner owns the hierarchical decision when the env didn't pin
-  // it (reference parameter_manager.cc:42-43 categorical param)
+  // the autotuner owns knobs the env did NOT pin (reference
+  // parameter_manager fixed=true semantics): an explicit
+  // HOROVOD[_TPU]_FUSION_THRESHOLD / CYCLE_TIME / HIERARCHICAL_* stays
+  // at its set value and leaves the search space
+  // mirrors EnvInt64's shadow semantics exactly (non-null wins, empty
+  // included): pinned iff the parse above consumed a user-set var, so
+  // the pinned value is always the one the parse produced
+  auto env_set = [](const char* a, const char* b) {
+    return getenv(a) != nullptr || getenv(b) != nullptr;
+  };
   if (rank_ == 0)
     pm_.Initialize(fusion_threshold_, cycle_us_,
                    /*tune_hierarchical=*/dflt && !(ha && ha[0]),
-                   hierarchical_allreduce_);
+                   hierarchical_allreduce_,
+                   /*tune_fusion=*/!env_set("HOROVOD_TPU_FUSION_THRESHOLD",
+                                            "HOROVOD_FUSION_THRESHOLD"),
+                   /*tune_cycle=*/!env_set("HOROVOD_TPU_CYCLE_TIME",
+                                           "HOROVOD_CYCLE_TIME"));
 
   if (pipe2(wake_pipe_, O_NONBLOCK | O_CLOEXEC) != 0) {
     wake_pipe_[0] = wake_pipe_[1] = -1;  // degrade to pure cycle ticks

@@ -242,6 +242,45 @@ def test_autotune_tunes_hierarchical(tmp_path):
     assert len({h for _, _, h, _ in rows}) == 2, rows
 
 
+def test_autotune_respects_pinned_knobs(tmp_path):
+    """An env-set fusion threshold is FIXED: the tuner moves the cycle
+    time but never the pinned knob (the reference ParameterManager's
+    fixed=true contract, parameter_manager.h:67-81)."""
+    log = tmp_path / "autotune.csv"
+    res = _run("autotune", 2, env={
+        "HOROVOD_AUTOTUNE": "1",
+        "HOROVOD_AUTOTUNE_LOG": str(log),
+        "HOROVOD_FUSION_THRESHOLD": "4194304",  # pinned
+        "HOROVOD_TPU_AUTOTUNE_CYCLES_PER_SAMPLE": "2",
+        "HOROVOD_TPU_AUTOTUNE_SAMPLES_PER_STEP": "2",
+        "HOROVOD_TPU_AUTOTUNE_WARMUP_SAMPLES": "1",
+    })
+    assert res.returncode == 0, res.stderr + res.stdout
+    rows = [l.split(",") for l in log.read_text().strip().splitlines()[1:]]
+    assert len(rows) >= 2, rows
+    assert {f for f, _, _, _ in rows} == {"4194304"}  # never moved
+    assert len({c for _, c, _, _ in rows}) > 1  # cycle still explored
+
+
+def test_autotune_inert_when_everything_pinned(tmp_path):
+    """Fusion AND cycle pinned on a single host (no hierarchical knob):
+    nothing is tunable, so the tuner goes inert — no tuning rows, no
+    knob churn."""
+    log = tmp_path / "autotune.csv"
+    res = _run("autotune", 2, env={
+        "HOROVOD_AUTOTUNE": "1",
+        "HOROVOD_AUTOTUNE_LOG": str(log),
+        "HOROVOD_FUSION_THRESHOLD": "4194304",
+        "HOROVOD_TPU_CYCLE_TIME": "1",
+        "HOROVOD_TPU_AUTOTUNE_CYCLES_PER_SAMPLE": "2",
+        "HOROVOD_TPU_AUTOTUNE_SAMPLES_PER_STEP": "2",
+        "HOROVOD_TPU_AUTOTUNE_WARMUP_SAMPLES": "1",
+    })
+    assert res.returncode == 0, res.stderr + res.stdout
+    body = log.read_text().strip().splitlines()[1:] if log.exists() else []
+    assert body == [], body
+
+
 # payload per fabric: the paced leg needs ~1 MB fused rounds so pacing
 # (not scheduling noise) sets the time scale; the unpaced leg uses ~4 MB
 # fused, where measurement showed flat and two-level within ~5% of each
