@@ -201,7 +201,8 @@ def _resolve_attn_fn(attn_fn):
 
 
 def apply(params, tokens, config: LlamaConfig, positions=None,
-          attn_fn="auto", remat="full", unroll: int | bool = 1):
+          attn_fn="auto", remat="full", unroll: int | bool = 1,
+          split_transpose: bool = False):
     """Forward pass.  ``tokens``: [B, T] int32 -> logits [B, T, V] (fp32).
 
     ``positions`` defaults to 0..T-1; pass global positions when the
@@ -213,7 +214,8 @@ def apply(params, tokens, config: LlamaConfig, positions=None,
     HBM-for-FLOPs trade on TPU).
     """
     x = apply_hidden(params, tokens, config, positions=positions,
-                     attn_fn=attn_fn, remat=remat, unroll=unroll)
+                     attn_fn=attn_fn, remat=remat, unroll=unroll,
+                     split_transpose=split_transpose)
     return (x @ params["lm_head"].astype(x.dtype)).astype(jnp.float32)
 
 
@@ -241,14 +243,19 @@ def _remat_wrap(body, remat):
 
 
 def apply_hidden(params, tokens, config: LlamaConfig, positions=None,
-                 attn_fn="auto", remat="full", unroll: int | bool = 1):
+                 attn_fn="auto", remat="full", unroll: int | bool = 1,
+                 split_transpose: bool = False):
     """Forward pass up to (and including) the final norm — hidden states
     [B, T, D] in compute dtype, without the lm_head projection.  The
     chunked-CE loss path projects blockwise instead (ops/chunked_ce.py).
     ``remat`` modes: see :func:`_remat_wrap`.  ``unroll`` is the layer
     scan's unroll factor (``True`` = fully unrolled — larger program,
     more scheduling freedom; also what makes static-HLO collective
-    counting exact for utils/scaling_projection.py)."""
+    counting exact for utils/scaling_projection.py).  ``split_transpose``
+    asks XLA to split the scan's transpose (backward) into a separate
+    residual-forwarding scan — an alternative schedule for the
+    gradient-stack writes the per-op trace attributes ~19% of the step
+    to."""
     c = config
     B, T = tokens.shape
     attn_fn = _resolve_attn_fn(attn_fn)
@@ -263,14 +270,19 @@ def apply_hidden(params, tokens, config: LlamaConfig, positions=None,
         out = _block(carry, layer_params, cos, sin, positions, c, attn_fn)
         return out, None
 
-    x, _ = lax.scan(_remat_wrap(body, remat), x, layer_stack, unroll=unroll)
+    # _split_transpose is a private lax.scan kwarg: only pass it when the
+    # knob is on, so the default path never depends on the private API
+    scan_kw = {"_split_transpose": True} if split_transpose else {}
+    x, _ = lax.scan(_remat_wrap(body, remat), x, layer_stack, unroll=unroll,
+                    **scan_kw)
     x = _rms_norm(x, params["final_norm"], c.rms_eps)
     return x
 
 
 def loss_fn(params, tokens, config: LlamaConfig, positions=None,
             attn_fn="auto", remat="full",
-            vocab_block: int | None = None, unroll: int | bool = 1):
+            vocab_block: int | None = None, unroll: int | bool = 1,
+            split_transpose: bool = False):
     """Next-token cross-entropy (shift-by-one inside).
 
     ``vocab_block`` switches to the blockwise loss (ops/chunked_ce.py):
@@ -286,13 +298,15 @@ def loss_fn(params, tokens, config: LlamaConfig, positions=None,
         if int(vocab_block) < 0:  # -1 = auto, the bench flag convention
             vocab_block = auto_block(config.vocab_size)
         x = apply_hidden(params, tokens, config, positions=positions,
-                         attn_fn=attn_fn, remat=remat, unroll=unroll)
+                         attn_fn=attn_fn, remat=remat, unroll=unroll,
+                         split_transpose=split_transpose)
         h = x[:, :-1].reshape(-1, x.shape[-1])
         targets = tokens[:, 1:].reshape(-1)
         return chunked_cross_entropy(h, params["lm_head"], targets,
                                      int(vocab_block))
     logits = apply(params, tokens, config, positions=positions,
-                   attn_fn=attn_fn, remat=remat, unroll=unroll)
+                   attn_fn=attn_fn, remat=remat, unroll=unroll,
+                   split_transpose=split_transpose)
     logp = jax.nn.log_softmax(logits[:, :-1])
     targets = tokens[:, 1:]
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)

@@ -378,7 +378,8 @@ def analyze_resnet_dp(n: int = 8, batch_per_chip: int = 8,
     return stats
 
 
-def _llama_fsdp_bytes(cfg, n: int, batch_per_chip: int, seq: int) -> dict:
+def _llama_fsdp_bytes(cfg, n: int, batch_per_chip: int, seq: int,
+                      grad_dtype: str = "fp32") -> dict:
     import jax
     import jax.numpy as jnp
     import optax
@@ -424,7 +425,12 @@ def _llama_fsdp_bytes(cfg, n: int, batch_per_chip: int, seq: int) -> dict:
         return _jnp.mean(nll)
 
     def step(p, o, tok):
-        loss, g = jax.value_and_grad(loss_fn)(p, tok)
+        # mirror the bench lane's gradient dtype: bf16 grads mean the
+        # grad reduce-scatter rides the wire at half width, and the
+        # projection must count the bytes of the step that was timed
+        ph = (jax.tree.map(lambda x: x.astype(jnp.bfloat16), p)
+              if grad_dtype == "bf16" else p)
+        loss, g = jax.value_and_grad(loss_fn)(ph, tok)
         u, o = opt.update(g, o, p)
         return optax.apply_updates(p, u), o, loss
 
@@ -436,7 +442,8 @@ def analyze_llama_fsdp(d_model: int = 2048, d_ff: int = 8192,
                        n_heads: int = 16, n_kv_heads: int = 8,
                        vocab: int = 32000, target_layers: int = 12,
                        probe_layers=(1, 2), n: int = 8,
-                       batch_per_chip: int = 1, seq: int = 512) -> dict:
+                       batch_per_chip: int = 1, seq: int = 512,
+                       grad_dtype: str = "fp32") -> dict:
     """Collective bytes of one FSDP llama train step at ``target_layers``
     layers, extrapolated linearly from two unrolled probe depths
     (bytes(L) = fixed + per_layer*L — exact, since every layer
@@ -449,7 +456,8 @@ def analyze_llama_fsdp(d_model: int = 2048, d_ff: int = 8192,
         cfg = llama.LlamaConfig(
             vocab_size=vocab, d_model=d_model, n_layers=L, n_heads=n_heads,
             n_kv_heads=n_kv_heads, d_ff=d_ff)
-        stats[L] = _llama_fsdp_bytes(cfg, n, batch_per_chip, seq)
+        stats[L] = _llama_fsdp_bytes(cfg, n, batch_per_chip, seq,
+                                     grad_dtype=grad_dtype)
     L1, L2 = probe_layers
     by_op = {}
     ops = set(stats[L1]["by_op"]) | set(stats[L2]["by_op"])
@@ -484,6 +492,7 @@ def analyze_llama_fsdp(d_model: int = 2048, d_ff: int = 8192,
         "group_sizes": stats[L2]["group_sizes"],
         "probe_layers": list(probe_layers),
         "target_layers": target_layers,
+        "grad_dtype": grad_dtype,
         "mesh": {"axis": "data(fsdp)", "n": n},
         "probe_totals": {str(L): stats[L]["full_bytes_total"]
                          for L in probe_layers},

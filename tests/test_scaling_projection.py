@@ -211,3 +211,31 @@ def test_llama_fsdp_bytes_are_parameter_shaped():
     assert 0 < p1 < p2
     ratio = stats["analytic"]["ratio_vs_params"]
     assert 1.0 <= ratio <= 20.0, stats["analytic"]
+
+
+@pytest.mark.slow
+def test_llama_fsdp_grad_dtype_pairs_bytes_with_timed_step():
+    """``grad_dtype="bf16"`` mirrors the bench lane's mixed-precision
+    step (params cast outside value_and_grad) so the projection counts
+    the bytes of the step that was actually timed.  Measured fact this
+    pins: the collective traffic is nearly IDENTICAL across grad dtypes
+    — GSPMD reduces the gradients in fp32 either way (the cast's
+    transpose converts cotangents back to fp32 before the reduction),
+    so bf16 grads save on-chip HBM write traffic (+1.3% step time,
+    docs/benchmarks.md) but not wire bytes, and the fp32-based round-3
+    projection remains valid for the bf16-grad lane.  If a compiler
+    change ever makes the dtypes diverge materially, this assertion
+    fires and the projection docs must start distinguishing them."""
+    kw = dict(d_model=256, d_ff=1024, n_heads=8, n_kv_heads=4, vocab=2048,
+              target_layers=4, probe_layers=(1, 2), seq=128,
+              batch_per_chip=1)
+    try:
+        fp32 = sp.analyze_llama_fsdp(**kw)
+        bf16 = sp.analyze_llama_fsdp(grad_dtype="bf16", **kw)
+    except Exception as exc:  # pragma: no cover - no TPU topology client
+        pytest.skip(f"AOT topology compile unavailable: {exc}")
+    assert bf16["grad_dtype"] == "bf16"
+    assert bf16["full_bytes_total"] > 0
+    ratio = bf16["full_bytes_total"] / fp32["full_bytes_total"]
+    assert 0.9 <= ratio <= 1.1, (
+        bf16["full_bytes_total"], fp32["full_bytes_total"])

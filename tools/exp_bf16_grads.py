@@ -1,0 +1,66 @@
+"""A/B: fp32 vs bf16 gradient stacks (mixed-precision master params).
+
+The per-op trace attributes ~153 ms/step to dynamic-update-slice writes
+of the ``[L, ...]`` fp32 gradient stacks.  Casting params to bf16
+OUTSIDE ``value_and_grad`` makes every cotangent — including those
+stack writes — bf16, halving their HBM traffic; the optimizer still
+updates fp32 master params (standard mixed-precision).  This measures
+whether the saved bandwidth shows up at step level.
+
+Usage: python tools/exp_bf16_grads.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from bench import (_llama_cfg, _train_marginal, build_parser,
+                       llama_train_flops_per_step)
+    from horovod_tpu.models import llama
+
+    # the bench llama config + batch/seq, from their single construction
+    bench_args = build_parser().parse_args([])
+    cfg = _llama_cfg(bench_args)
+    B, T = bench_args.llama_batch, bench_args.llama_seq
+    params = llama.init(jax.random.key(0), cfg)
+    tokens = jnp.asarray(
+        np.random.RandomState(0).randint(0, cfg.vocab_size, (B, T)),
+        jnp.int32)
+    opt = optax.sgd(1e-3)
+    opt_state = opt.init(params)
+
+    def step_fp32(carry):
+        params, opt_state = carry
+        loss, grads = jax.value_and_grad(llama.loss_fn)(params, tokens, cfg)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return (optax.apply_updates(params, updates), opt_state), loss
+
+    def step_bf16(carry):
+        params, opt_state = carry
+        half = jax.tree.map(lambda p: p.astype(jnp.bfloat16), params)
+        loss, grads = jax.value_and_grad(llama.loss_fn)(half, tokens, cfg)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return (optax.apply_updates(params, updates), opt_state), loss
+
+    for name, step in (("fp32_grads", step_fp32), ("bf16_grads", step_bf16)):
+        per, ovh, _, resid, rejected = _train_marginal(
+            step, (params, opt_state), 2, 6)
+        toks = B * T / per
+        tf = llama_train_flops_per_step(cfg, B, T) / per / 1e12
+        print(f"{name}: {toks:,.0f} tok/s  {per * 1e3:.1f} ms/step  "
+              f"{tf:.1f} TF/s  residual={resid:.4f} rejected={rejected}",
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,68 @@
+"""A/B: layer-scan backward schedule — default vs ``_split_transpose``.
+
+The llama per-op trace (docs/benchmarks.md) attributes ~19% of the step
+to ``dynamic-update-slice`` writes of the ``[L, ...]`` gradient stacks
+inside the scan transpose.  ``lax.scan(_split_transpose=True)`` asks XLA
+for an alternative backward schedule (residual-forwarding split scan).
+This tool measures both on the bench llama config with the bench's own
+marginal-rate machinery (same K-sweep, same reject-to-raw semantics).
+
+Usage: python tools/exp_scan_transpose.py [--seq 2048] [--batch 8]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seq", type=int, default=2048)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--iters", type=int, default=4)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from bench import (_llama_cfg, _train_marginal, build_parser,
+                       llama_train_flops_per_step)
+    from horovod_tpu.models import llama
+
+    # the bench llama config, from its single construction site
+    cfg = _llama_cfg(build_parser().parse_args([]))
+    B, T = args.batch, args.seq
+    params = llama.init(jax.random.key(0), cfg)
+    tokens = jnp.asarray(
+        np.random.RandomState(0).randint(0, cfg.vocab_size, (B, T)),
+        jnp.int32)
+    opt = optax.sgd(1e-3)
+    opt_state = opt.init(params)
+
+    def make_step(split):
+        def step(carry):
+            params, opt_state = carry
+            loss, grads = jax.value_and_grad(llama.loss_fn)(
+                params, tokens, cfg, split_transpose=split)
+            updates, opt_state = opt.update(grads, opt_state, params)
+            return (optax.apply_updates(params, updates), opt_state), loss
+        return step
+
+    for split in (False, True):
+        per, ovh, _, resid, rejected = _train_marginal(
+            make_step(split), (params, opt_state), 2, 6, iters=args.iters)
+        toks = B * T / per
+        tf = llama_train_flops_per_step(cfg, B, T) / per / 1e12
+        print(f"split_transpose={split}: {toks:,.0f} tok/s  "
+              f"{per * 1e3:.1f} ms/step  {tf:.1f} TF/s  "
+              f"residual={resid:.4f} rejected={rejected}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
