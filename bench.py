@@ -91,10 +91,36 @@ def detect_platform():
     return backend, kind, peak
 
 
-def resnet50_train_flops_per_image(image_size: int = 224) -> float:
-    """Analytic ResNet-50 cost: ~4.09 GFLOP forward per 224x224 image
-    (multiply-add = 2 FLOPs), scaled by spatial area, x3 for fwd + 2x bwd."""
-    return 3 * 4.089e9 * (image_size / 224.0) ** 2
+def resnet_train_flops_per_image(depth: int = 50,
+                                 image_size: int = 224) -> float:
+    """Analytic ResNet-v1.5 training cost per image for any supported
+    depth (50/101/152): exact conv+fc multiply-add walk of the stage
+    layout in ``models/resnet.py`` (2 FLOPs per MAC, x3 for fwd + 2x
+    bwd).  Depth 50 at 224 comes out at the canonical ~4.1 GFLOP
+    forward."""
+    from horovod_tpu.models import resnet as _rn
+
+    cfg = _rn.ResNetConfig(depth=depth)
+    H = image_size // 2                       # stem: 7x7 stride-2
+    macs = 7 * 7 * 3 * cfg.width * H * H
+    H = (H + 1) // 2                          # 3x3/s2 maxpool, SAME
+    cin = cfg.width
+    for i, blocks in enumerate(cfg.stage_blocks):
+        cmid = cfg.width * (2 ** i)
+        cout = 4 * cmid
+        for b in range(blocks):
+            stride = 2 if (b == 0 and i > 0) else 1
+            Hout = H // stride
+            m = cin * cmid * H * H            # conv1 1x1 (input res)
+            m += 9 * cmid * cmid * Hout * Hout  # conv2 3x3, strided
+            m += cmid * cout * Hout * Hout    # conv3 1x1
+            if stride != 1 or cin != cout:
+                m += cin * cout * Hout * Hout  # projection shortcut
+            macs += m
+            H = Hout
+            cin = cout
+    macs += cin * cfg.num_classes             # fc
+    return 3.0 * 2.0 * macs
 
 
 def llama_train_flops_per_step(cfg, batch: int, seq: int) -> float:
@@ -285,6 +311,33 @@ def measure_conv_roofline(peak_tflops):
         return {"error": f"{type(exc).__name__}: {exc}"[:120]}
 
 
+def roofline_span(rooflines: dict, key: str, warnings_out: list) -> dict | None:
+    """min/max of a roofline reading across its (re)measurements.
+
+    A reading ABOVE the chip's spec peak is physically impossible —
+    tunnel tenancy / timing noise that slipped under the residual limit
+    — so it is excluded from the span models are judged against, marked
+    ``exceeds_spec_peak`` in place, and reported in ``warnings_out``
+    (the harness's "impossible number => broken measurement" creed must
+    apply to its own ceilings, not just model MFUs).  2% tolerance for
+    spec rounding."""
+    vals, dropped = [], []
+    for name, r in rooflines.items():
+        if key not in r:
+            continue
+        frac = r.get("fraction_of_spec_peak")
+        if frac is not None and frac > 1.02:
+            r["exceeds_spec_peak"] = True
+            dropped.append(f"{name}={r[key]}")
+            continue
+        vals.append(r[key])
+    if dropped:
+        warnings_out.append(
+            f"{key} readings above spec peak excluded from the roofline "
+            f"span (impossible => broken measurement): " + ", ".join(dropped))
+    return {"min": min(vals), "max": max(vals)} if vals else None
+
+
 def _train_marginal(step_fn, init_carry, K1, K2, iters=4):
     """Marginal per-step seconds of a (carry)->(carry, loss) train step
     via three in-program lax.scan lengths K1 < mid < K2, delegating the
@@ -328,7 +381,7 @@ def bench_resnet(args, peak_tflops):
     from horovod_tpu.models import resnet
 
     platform = jax.default_backend()
-    config = resnet.ResNetConfig(depth=50, num_classes=1000,
+    config = resnet.ResNetConfig(depth=args.resnet_depth, num_classes=1000,
                                  remat=args.resnet_remat)
     params, state = resnet.init(jax.random.key(0), config)
 
@@ -356,11 +409,13 @@ def bench_resnet(args, peak_tflops):
         step, (params, state, opt_state), args.k1, args.k2)
     mfields = _marginal_fields(ovh, resid, rejected)
     imgs_per_sec = args.batch_size / per
-    flops_per_img = resnet50_train_flops_per_image(args.image_size)
+    flops_per_img = resnet_train_flops_per_image(args.resnet_depth,
+                                                 args.image_size)
     sustained_tflops = imgs_per_sec * flops_per_img / 1e12
     out = {
         "value": round(imgs_per_sec, 2),
         "unit": "images/sec/chip",
+        "depth": args.resnet_depth,
         "step_ms": round(per * 1e3, 2),
         **mfields,
         "model_tflops_per_step": round(
@@ -369,12 +424,12 @@ def bench_resnet(args, peak_tflops):
         "mfu": (round(sustained_tflops / peak_tflops, 4)
                 if peak_tflops else None),
     }
-    if not args.skip_control:
+    if not args.skip_control and args.resnet_depth == 50:
         # round-3 verdict item 1a: an INDEPENDENT control implementation
-        # (flax.linen layers, tools/resnet_control.py) measured in the
-        # same session with the same marginal method — if it lands at the
-        # same rate, the MFU bar is the model's arithmetic intensity on
-        # this chip, not framework overhead
+        # (flax.linen layers, tools/resnet_control.py, depth-50 only)
+        # measured in the same session with the same marginal method —
+        # if it lands at the same rate, the MFU bar is the model's
+        # arithmetic intensity on this chip, not framework overhead
         try:
             from tools.resnet_control import make_train_step
 
@@ -451,6 +506,7 @@ def bench_llama(args, peak_tflops):
         vb = auto_block(cfg.vocab_size)
 
     bf16_grads = args.llama_grad_dtype == "bf16"
+    import horovod_tpu.jax as hvd
 
     def step(carry):
         params, opt_state = carry
@@ -460,8 +516,7 @@ def bench_llama(args, peak_tflops):
         # the step to — is bf16 (half the HBM write traffic); the
         # optimizer still updates the fp32 master params (standard
         # mixed-precision layout).  Measured +1.3% at this size.
-        p = (jax.tree.map(lambda x: x.astype(jnp.bfloat16), params)
-             if bf16_grads else params)
+        p = hvd.bf16_params(params) if bf16_grads else params
         # attn_fn="auto" -> Pallas flash-attention kernels (fwd + bwd) on TPU
         loss, grads = jax.value_and_grad(llama.loss_fn)(
             p, tokens, cfg, vocab_block=vb or None)
@@ -517,11 +572,16 @@ def bench_projected_scaling(args, models):
     out = {"method": "HLO collective bytes x published ICI link bandwidth "
                      "vs measured marginal step time; see "
                      "docs/scaling_projection.md"}
+    rkey = f"resnet{args.resnet_depth}"
     try:
+        # the analyzed model mirrors --resnet-depth so the counted
+        # gradient-allreduce bytes belong to the step whose time is
+        # being projected (deeper variants carry more parameters)
         rn = sp.cached_analysis(cache, "resnet_dp", sp.analyze_resnet_dp,
-                                n=8, batch_per_chip=8)
-        step_s = models["resnet50"]["step_ms"] / 1e3
-        out["resnet50_dp"] = {
+                                n=8, batch_per_chip=8,
+                                depth=args.resnet_depth)
+        step_s = models[rkey]["step_ms"] / 1e3
+        out[f"{rkey}_dp"] = {
             "collective_bytes": {k: rn[k] for k in
                                  ("by_op", "full_bytes_total", "analytic")},
             "per_chip_batch": args.batch_size,
@@ -537,7 +597,7 @@ def bench_projected_scaling(args, models):
                         "(MFU-preserving assumption)",
         }
     except Exception as exc:  # noqa: BLE001 - report, don't die
-        out["resnet50_dp"] = {"error": f"{type(exc).__name__}: {exc}"[:200]}
+        out[f"{rkey}_dp"] = {"error": f"{type(exc).__name__}: {exc}"[:200]}
     try:
         if "llama" in models and "step_ms" in models.get("llama", {}):
             lc = _llama_cfg(args)  # the same model the llama section ran
@@ -1267,6 +1327,11 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--skip-control", action="store_true",
                     help="skip the independent flax ResNet-50 control lane")
     ap.add_argument("--skip-long-context", action="store_true")
+    ap.add_argument("--resnet-depth", type=int, default=50,
+                    choices=[50, 101, 152],
+                    help="ResNet depth for the resnet section; 101 is the "
+                         "model behind the reference's published scaling "
+                         "table (docs/benchmarks.md)")
     ap.add_argument("--resnet-remat", default="none",
                     choices=["none", "blocks"],
                     help="rematerialisation mode for the resnet section")
@@ -1337,7 +1402,8 @@ def main() -> None:
     rooflines = {"matmul_start": measure_matmul_roofline(peak),
                  "conv_start": measure_conv_roofline(peak)}
 
-    models = {"resnet50": bench_resnet(args, peak)}
+    rkey = f"resnet{args.resnet_depth}"  # one model identity everywhere
+    models = {rkey: bench_resnet(args, peak)}
     rooflines["conv_after_resnet"] = measure_conv_roofline(peak)
     if not args.skip_llama:
         models["llama"] = bench_llama(args, peak)
@@ -1345,22 +1411,20 @@ def main() -> None:
     long_context = {} if args.skip_long_context else \
         bench_long_context(args, peak)
 
-    def _roofvals(key):
-        vals = [r[key] for r in rooflines.values() if key in r]
-        return {"min": min(vals), "max": max(vals)} if vals else None
-
-    conv_span = _roofvals("measured_conv_tflops")
-    matmul_span = _roofvals("measured_matmul_tflops")
     warnings_out = []
+    conv_span = roofline_span(rooflines, "measured_conv_tflops",
+                              warnings_out)
+    matmul_span = roofline_span(rooflines, "measured_matmul_tflops",
+                                warnings_out)
     # MFU vs the contemporaneous conv/matmul ceiling; flag tenancy variance
     # if a model apparently exceeded its ceiling
-    rn = models["resnet50"]
+    rn = models[rkey]
     if conv_span and rn.get("sustained_tflops"):
         rn["fraction_of_conv_roofline"] = round(
             rn["sustained_tflops"] / conv_span["max"], 3)
         if rn["sustained_tflops"] > conv_span["max"]:
-            warnings_out.append("resnet50 exceeded the conv roofline — "
-                               "backend tenancy varied between sections")
+            warnings_out.append(f"{rkey} exceeded the conv roofline — "
+                                "backend tenancy varied between sections")
     if matmul_span and "llama" in models and \
             models["llama"].get("sustained_tflops"):
         models["llama"]["fraction_of_matmul_roofline"] = round(
@@ -1377,13 +1441,17 @@ def main() -> None:
     overlap = {} if args.skip_overlap else measure_hlo_overlap()
     pipeline = {} if args.skip_pipeline else bench_pipeline()
 
-    primary = models["resnet50"]
+    primary = models[rkey]
     print(json.dumps({
-        "metric": "resnet50_images_per_sec_per_chip",
+        "metric": f"resnet{args.resnet_depth}_images_per_sec_per_chip",
         "value": primary["value"],
         "unit": "images/sec/chip",
         "vs_baseline": round(
             primary["value"] / REFERENCE_IMAGES_PER_SEC_PER_DEVICE, 3),
+        # the reference's 1656.82/16 figure is its ResNet-101 table row
+        # (BASELINE.md): exact model match at --resnet-depth 101, a
+        # cross-model convention (kept from earlier rounds) at 50
+        "vs_baseline_model": "resnet101 (reference tf_cnn_benchmarks row)",
         "platform": backend,
         "device_kind": device_kind,
         "peak_tflops": peak,

@@ -233,6 +233,30 @@ def DistributedGradientTape(loss_fn: Callable, axis_name: str = "hvd",
     return wrapped
 
 
+def bf16_params(params):
+    """Cast the fp32 leaves of a params pytree to bf16 for the gradient
+    pass — the mixed-precision layout the bench llama lane measures at
+    +1.3% (docs/benchmarks.md):
+
+        half = hvd.bf16_params(params)          # outside value_and_grad
+        loss, grads = jax.value_and_grad(loss_fn)(half, batch)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)   # fp32 masters
+
+    Differentiating w.r.t. the bf16 COPY makes every cotangent —
+    including the ``[L, ...]`` gradient-stack writes of scanned-layer
+    models — bf16, halving their HBM write traffic; the fp32 master
+    params are updated with the bf16 grads as usual.  (Wrapping the cast
+    *inside* the differentiated function would convert the grads back to
+    fp32 at the boundary — an extra param-sized HBM pass — so the cast
+    must stay outside, as above.)  Non-fp32 leaves pass through.
+    """
+    return jax.tree.map(
+        lambda x: x.astype(jnp.bfloat16)
+        if hasattr(x, "dtype") and x.dtype == jnp.float32 else x,
+        params)
+
+
 __all__ = [
     "init", "shutdown", "is_initialized",
     "rank", "size", "local_rank", "local_size", "cross_rank", "cross_size",
@@ -243,5 +267,6 @@ __all__ = [
     "broadcast_parameters", "broadcast_optimizer_state",
     "allreduce_parameters",
     "allreduce_gradients", "DistributedOptimizer", "DistributedGradientTape",
+    "bf16_params",
     "Compression",
 ]

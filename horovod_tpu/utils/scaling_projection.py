@@ -321,7 +321,7 @@ def _topology_mesh(n: int, topology_name: str | None = None):
 
 def analyze_resnet_dp(n: int = 8, batch_per_chip: int = 8,
                       image_size: int = 224, width: int = 64,
-                      num_classes: int = 1000) -> dict:
+                      num_classes: int = 1000, depth: int = 50) -> dict:
     """Collective bytes of one DP-resnet50 train step (grad allreduce is
     the only traffic; payload must track parameter bytes — the analytic
     cross-check; XLA reduces the bf16 compute-dtype grads, so the
@@ -336,7 +336,7 @@ def analyze_resnet_dp(n: int = 8, batch_per_chip: int = 8,
     from horovod_tpu.models import resnet
 
     mesh = _topology_mesh(n)
-    config = resnet.ResNetConfig(depth=50, num_classes=num_classes,
+    config = resnet.ResNetConfig(depth=depth, num_classes=num_classes,
                                  width=width)
     params, state = jax.eval_shape(
         lambda: resnet.init(jax.random.key(0), config))

@@ -176,3 +176,28 @@ def test_compressed_allreduce_in_jit(mesh8):
     np.testing.assert_allclose(out, np.full(8, np.sum(np.linspace(-2, 2, 8),
                                                       dtype=np.float32)),
                                atol=0.1)
+
+
+def test_bf16_params_casts_fp32_leaves_only():
+    """hvd.bf16_params is the documented mixed-precision entry (bench
+    llama lane, +1.3%): fp32 leaves -> bf16, everything else untouched,
+    and grads taken against the cast copy come out bf16 (the layout's
+    whole point — bf16 gradient-stack writes)."""
+    import jax
+    import jax.numpy as jnp
+
+    import horovod_tpu.jax as hvd
+
+    params = {"w": jnp.ones((4, 4), jnp.float32),
+              "idx": jnp.arange(4, dtype=jnp.int32),
+              "h": jnp.ones((2,), jnp.bfloat16)}
+    half = hvd.bf16_params(params)
+    assert half["w"].dtype == jnp.bfloat16
+    assert half["idx"].dtype == jnp.int32
+    assert half["h"].dtype == jnp.bfloat16
+
+    def loss(p):
+        return jnp.sum(p["w"].astype(jnp.float32) ** 2)
+
+    g = jax.grad(loss)({"w": half["w"]})
+    assert g["w"].dtype == jnp.bfloat16

@@ -117,3 +117,21 @@ def test_remat_unknown_mode_raises_at_config():
     with np.testing.assert_raises(ValueError):
         resnet.ResNetConfig(depth=50, num_classes=8, width=8,
                             remat="everything")
+
+
+def test_resnet101_and_152_apply():
+    """The depth variants behind the reference's published scaling table
+    (ResNet-101, ``/root/reference/docs/benchmarks.md:22-38``) must
+    build and run, not just sit in STAGE_BLOCKS: stage layouts
+    (3,4,23,3) / (3,8,36,3), logits shape, finite output."""
+    for depth in (101, 152):
+        cfg = resnet.ResNetConfig(depth=depth, num_classes=8, width=8)
+        assert sum(cfg.stage_blocks) == {101: 33, 152: 50}[depth]
+        params, state = resnet.init(jax.random.key(0), cfg)
+        images = jnp.asarray(
+            np.random.RandomState(0).rand(1, 32, 32, 3), jnp.float32)
+        logits, new_state = resnet.apply(params, state, images, cfg,
+                                         train=True)
+        assert logits.shape == (1, 8)
+        assert np.isfinite(np.asarray(logits)).all()
+        assert jax.tree.structure(new_state) == jax.tree.structure(state)
