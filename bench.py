@@ -725,7 +725,13 @@ def bench_long_context(args, peak_tflops):
     cfg = _llama_cfg(args)
     params = llama.init(jax.random.key(0), cfg)
     opt = optax.sgd(1e-3)
-    out = {}
+    # Deliberately fp32 grads here, NOT the main lane's bf16 default:
+    # bf16_params materializes a transient bf16 copy of the params
+    # (+1.77 GB) which at these HBM-tightest shapes measured seq-16384
+    # collapsing 8x (14.4 s/step, marginal fit rejected); 32k gained
+    # 5-8% but one flag must not trade a working lane for it
+    # (docs/benchmarks.md).
+    out = {"grad_dtype": "fp32"}
     for seq, batch in ((8192, 2), (16384, 1), (32768, 1)):
         try:
             tokens = jnp.asarray(

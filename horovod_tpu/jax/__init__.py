@@ -250,6 +250,12 @@ def bf16_params(params):
     *inside* the differentiated function would convert the grads back to
     fp32 at the boundary — an extra param-sized HBM pass — so the cast
     must stay outside, as above.)  Non-fp32 leaves pass through.
+
+    Cost to know about: the cast materializes a transient bf16 COPY of
+    the params (half the param bytes of extra HBM).  On HBM-tight
+    configurations that copy can flip the trade — measured on the bench
+    llama at seq 16384: an 8x collapse from pathological allocation
+    (docs/benchmarks.md).  Use when HBM is slack; measure when it isn't.
     """
     return jax.tree.map(
         lambda x: x.astype(jnp.bfloat16)
