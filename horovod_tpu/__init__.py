@@ -37,7 +37,7 @@ from horovod_tpu.runtime.state import (
     mpi_threads_supported,
 )
 
-__version__ = "0.4.0"
+__version__ = "0.5.0"
 
 # Average is the default for gradient allreduce, matching the reference
 # (`/root/reference/horovod/torch/mpi_ops.py:86-121`).

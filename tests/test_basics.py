@@ -149,3 +149,19 @@ def test_scalar_inplace_collectives_multiproc():
             hvd.shutdown()
 
     assert run_local(fn, num_proc=2, start_timeout=300) == [True, True]
+
+
+def test_version_matches_package_metadata():
+    """__version__ (the reference exposes horovod.__version__ the same
+    way) must agree with the pyproject version — two construction sites
+    that have already drifted once."""
+    import os
+    import re
+
+    import horovod_tpu
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(root, "pyproject.toml")) as f:
+        m = re.search(r'^version = "([^"]+)"$', f.read(), re.M)
+    assert m, "pyproject.toml version line not found"
+    assert horovod_tpu.__version__ == m.group(1)
