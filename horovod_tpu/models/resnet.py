@@ -54,10 +54,20 @@ class ResNetConfig:
     # of conv FLOPs).  Whether it wins is measured, not assumed — see
     # docs/benchmarks.md.
     remat: str = "none"
+    # BN reduction strategy for TRAIN mode: "pallas" routes the
+    # per-channel sums (batch stats fwd, d_scale/d_bias + chain terms
+    # bwd) through the fused one-pass Pallas kernels (ops/bn.py,
+    # ops/pallas/bn_reduce.py) via a custom VJP — the attack on the
+    # 33.4 ms multiply_reduce bucket of the round-4 trace.  Whether it
+    # wins over XLA's own reduction fusions is measured (bench
+    # --resnet-bn + A/B lane), not assumed.
+    bn_fused: str = "none"
 
     def __post_init__(self):
         if self.remat not in ("none", "blocks"):
             raise ValueError(f"unknown remat mode {self.remat!r}")
+        if self.bn_fused not in ("none", "pallas"):
+            raise ValueError(f"unknown bn_fused mode {self.bn_fused!r}")
 
     @property
     def stage_blocks(self):
@@ -176,6 +186,17 @@ def _stem_conv(x, w, config):
 
 
 def _batch_norm(x, p, s, config, train: bool):
+    if train and config.bn_fused == "pallas":
+        from horovod_tpu.ops import bn
+
+        out, mean, var = bn.batch_norm_train(x, p["scale"], p["bias"],
+                                             config.bn_eps)
+        m = config.bn_momentum
+        new_s = {
+            "mean": m * s["mean"] + (1 - m) * mean,
+            "var": m * s["var"] + (1 - m) * var,
+        }
+        return out.astype(config.compute_dtype), new_s
     if train:
         # Batch statistics via fp32-ACCUMULATING reductions directly on the
         # compute-dtype activation: the reduction upcasts per element, so no

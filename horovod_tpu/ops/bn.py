@@ -1,0 +1,102 @@
+"""Train-mode batch norm with a custom VJP — per-channel reductions in
+Pallas.
+
+Motivation and the measurement discipline are in
+``ops/pallas/bn_reduce.py``; this module owns the calculus.  With batch
+statistics ``mu, var`` computed from ``x`` itself (count ``M``,
+``xhat = (x - mu) * r``, ``r = rsqrt(var + eps)``, ``y = scale * xhat +
+bias``), the standard full backward is
+
+    d_bias  = sum(gy)
+    d_scale = sum(gy * xhat)
+    d_x     = (scale * r) * (gy - d_bias/M - xhat * d_scale/M)
+
+— the two sums are the only reductions; everything else is one fused
+elementwise pass, which XLA handles.  The Pallas path computes both
+sums in a single joint read of ``(gy, x)``.
+
+The op returns ``(y, mean, var)`` with the stats **stop-gradiented**:
+they exist to update running statistics (a state output, never on the
+loss path), and the custom VJP drops their cotangents — stop_gradient
+makes that contract explicit to callers instead of silently wrong for
+anyone who routes a loss through the stats.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _bn_train(x, scale, bias, eps, use_pallas, interpret):
+    (y, mean, var), _ = _bn_fwd(x, scale, bias, eps, use_pallas, interpret)
+    return y, mean, var
+
+
+def _stats(x2d, M, use_pallas, interpret):
+    if use_pallas:
+        from horovod_tpu.ops.pallas.bn_reduce import moment_sums
+
+        s1, s2 = moment_sums(x2d, interpret=interpret)
+        return s1 / M, s2 / M
+    mean = jnp.mean(x2d, axis=0, dtype=jnp.float32)
+    mean_sq = jnp.mean(jnp.square(x2d.astype(jnp.float32)), axis=0,
+                       dtype=jnp.float32)
+    return mean, mean_sq
+
+
+def _bn_fwd(x, scale, bias, eps, use_pallas, interpret):
+    C = x.shape[-1]
+    x2d = x.reshape(-1, C)
+    M = x2d.shape[0]
+    mean, mean_sq = _stats(x2d, M, use_pallas, interpret)
+    var = jnp.maximum(mean_sq - jnp.square(mean), 0.0)
+    r = lax.rsqrt(var + eps)
+    inv = r * scale
+    shift = bias - mean * inv
+    y = x * inv.astype(x.dtype) + shift.astype(x.dtype)
+    return (y, mean, var), (x, mean, r, scale)
+
+
+def _bn_bwd(eps, use_pallas, interpret, res, cts):
+    gy = cts[0]  # stats cotangents dropped: stats are stop-gradiented
+    x, mean, r, scale = res
+    C = x.shape[-1]
+    x2d = x.reshape(-1, C)
+    g2d = gy.reshape(-1, C)
+    M = x2d.shape[0]
+    if use_pallas:
+        from horovod_tpu.ops.pallas.bn_reduce import bn_bwd_sums
+
+        sg, sgx = bn_bwd_sums(g2d, x2d, mean, r, interpret=interpret)
+    else:
+        gf = g2d.astype(jnp.float32)
+        xhat2 = (x2d.astype(jnp.float32) - mean) * r
+        sg = jnp.sum(gf, axis=0)
+        sgx = jnp.sum(gf * xhat2, axis=0)
+    gr = scale * r                                     # [C] fp32
+    xhat = (x.astype(jnp.float32) - mean) * r
+    dx = (gr * (gy.astype(jnp.float32) - sg / M - xhat * (sgx / M))
+          ).astype(x.dtype)
+    return dx, sgx, sg                                  # dscale, dbias
+
+
+_bn_train.defvjp(_bn_fwd, _bn_bwd)
+
+
+def batch_norm_train(x, scale, bias, eps, use_pallas: bool = True,
+                     interpret: bool | None = None):
+    """Batch norm over all but the last axis of ``x``; returns
+    ``(y, batch_mean, batch_var)`` with stats stop-gradiented (see
+    module docstring).  ``use_pallas=False`` runs the identical math
+    with jnp reductions (the A/B control).  ``interpret=None`` resolves
+    to the Pallas interpreter off-TPU (CPU tests run the same kernel
+    code), Mosaic on TPU."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    y, mean, var = _bn_train(x, scale, bias, eps, use_pallas, interpret)
+    return y, lax.stop_gradient(mean), lax.stop_gradient(var)

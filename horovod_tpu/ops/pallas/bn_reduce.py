@@ -1,0 +1,153 @@
+"""Pallas TPU kernels for batch-norm's per-channel reductions.
+
+The round-4 per-op trace prices RN50's BN-related ``multiply_reduce``
+fusions at 33.4 ms of the 97 ms step — the largest single named bucket
+(``docs/benchmarks.md``).  Each batch-norm needs per-channel sums over
+the (N, H, W) axes: ``sum(x), sum(x^2)`` forward (batch statistics) and
+``sum(g), sum(g * x_hat)`` backward (d_bias / d_scale and the mean/var
+chain terms).  These kernels compute each PAIR of sums in a single pass
+over the operands — one HBM read of ``x`` (forward) and one joint read
+of ``(g, x)`` (backward) — with fp32 accumulation in VMEM scratch,
+instead of whatever fusion split XLA chooses.
+
+Whether this beats XLA's own multi-output reduction fusions is a
+MEASUREMENT (bench ``--resnet-bn pallas`` lane), not an assumption; the
+kernel ships behind ``ResNetConfig.bn_fused="pallas"`` and the default
+stays "none" unless the measured win clears the bar.
+
+Layout: callers flatten NHWC to ``[M, C]`` (a free reshape — C stays
+minor).  The grid is (C-tiles, M-tiles) with M innermost, so each C
+tile's accumulator lives in VMEM across the M sweep and the output is
+written once at the last M step.  Block sizes are chosen from the
+divisors of M and C (no padding pass — padding would re-read the tensor
+and defeat the point).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def _pick_block(n: int, candidates) -> int:
+    for c in candidates:
+        if n % c == 0:
+            return c
+    return n
+
+
+def _moment_kernel(x_ref, s1_ref, s2_ref, acc1, acc2):
+    from jax.experimental import pallas as pl
+
+    m = pl.program_id(1)
+    nm = pl.num_programs(1)
+
+    @pl.when(m == 0)
+    def _init():
+        acc1[:] = jnp.zeros_like(acc1)
+        acc2[:] = jnp.zeros_like(acc2)
+
+    x = x_ref[...].astype(jnp.float32)            # [BM, BC]
+    acc1[:] += jnp.sum(x, axis=0, keepdims=True)
+    acc2[:] += jnp.sum(x * x, axis=0, keepdims=True)
+
+    @pl.when(m == nm - 1)
+    def _write():
+        s1_ref[:] = acc1[:]
+        s2_ref[:] = acc2[:]
+
+
+def _bwd_kernel(g_ref, x_ref, mu_ref, r_ref, sg_ref, sgx_ref,
+                accg, accgx):
+    from jax.experimental import pallas as pl
+
+    m = pl.program_id(1)
+    nm = pl.num_programs(1)
+
+    @pl.when(m == 0)
+    def _init():
+        accg[:] = jnp.zeros_like(accg)
+        accgx[:] = jnp.zeros_like(accgx)
+
+    g = g_ref[...].astype(jnp.float32)            # [BM, BC]
+    x = x_ref[...].astype(jnp.float32)
+    xhat = (x - mu_ref[...]) * r_ref[...]         # mu/r: [1, BC] fp32
+    accg[:] += jnp.sum(g, axis=0, keepdims=True)
+    accgx[:] += jnp.sum(g * xhat, axis=0, keepdims=True)
+
+    @pl.when(m == nm - 1)
+    def _write():
+        sg_ref[:] = accg[:]
+        sgx_ref[:] = accgx[:]
+
+
+_BM_CANDIDATES = (4096, 2048, 1792, 1024, 896, 512, 448, 256, 128, 64,
+                  32, 16, 8)
+_BC_CANDIDATES = (1024, 512, 256, 128, 64, 32, 16, 8)
+# Mosaic VMEM budget: blocks above ~1M elements (2 bf16 inputs + fp32
+# temporaries + double buffering) fail the v5e compile — measured:
+# 4096x512 rejected, 4096x256 fine.  Cap bm*bc at 512k elements.
+_BLOCK_ELEMS_MAX = 512 * 1024
+
+
+def _pick_blocks(M: int, C: int):
+    bc = _pick_block(C, _BC_CANDIDATES)
+    fitting = [b for b in _BM_CANDIDATES if b * bc <= _BLOCK_ELEMS_MAX]
+    bm = _pick_block(M, fitting or _BM_CANDIDATES)
+    return bm, bc
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def moment_sums(x2d, interpret: bool = False):
+    """``x2d: [M, C]`` -> ``(sum_x, sum_x2)``, both fp32 ``[C]``, in one
+    pass over ``x``."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    M, C = x2d.shape
+    bm, bc = _pick_blocks(M, C)
+    s1, s2 = pl.pallas_call(
+        _moment_kernel,
+        grid=(C // bc, M // bm),
+        in_specs=[pl.BlockSpec((bm, bc), lambda c, m: (m, c))],
+        out_specs=[pl.BlockSpec((1, bc), lambda c, m: (0, c)),
+                   pl.BlockSpec((1, bc), lambda c, m: (0, c))],
+        out_shape=[jax.ShapeDtypeStruct((1, C), jnp.float32),
+                   jax.ShapeDtypeStruct((1, C), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((1, bc), jnp.float32),
+                        pltpu.VMEM((1, bc), jnp.float32)],
+        interpret=interpret,
+    )(x2d)
+    return s1[0], s2[0]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def bn_bwd_sums(g2d, x2d, mu, r, interpret: bool = False):
+    """``g2d, x2d: [M, C]``; ``mu, r: [C]`` fp32 -> ``(sum_g,
+    sum_g_xhat)`` fp32 ``[C]`` in one joint pass over ``(g, x)``, where
+    ``xhat = (x - mu) * r``."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    M, C = x2d.shape
+    bm, bc = _pick_blocks(M, C)
+    mu2 = mu.reshape(1, C).astype(jnp.float32)
+    r2 = r.reshape(1, C).astype(jnp.float32)
+    sg, sgx = pl.pallas_call(
+        _bwd_kernel,
+        grid=(C // bc, M // bm),
+        in_specs=[pl.BlockSpec((bm, bc), lambda c, m: (m, c)),
+                  pl.BlockSpec((bm, bc), lambda c, m: (m, c)),
+                  pl.BlockSpec((1, bc), lambda c, m: (0, c)),
+                  pl.BlockSpec((1, bc), lambda c, m: (0, c))],
+        out_specs=[pl.BlockSpec((1, bc), lambda c, m: (0, c)),
+                   pl.BlockSpec((1, bc), lambda c, m: (0, c))],
+        out_shape=[jax.ShapeDtypeStruct((1, C), jnp.float32),
+                   jax.ShapeDtypeStruct((1, C), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((1, bc), jnp.float32),
+                        pltpu.VMEM((1, bc), jnp.float32)],
+        interpret=interpret,
+    )(g2d, x2d, mu2, r2)
+    return sg[0], sgx[0]

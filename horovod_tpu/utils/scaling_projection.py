@@ -506,7 +506,8 @@ def analyze_llama_fsdp(d_model: int = 2048, d_ff: int = 8192,
     }
 
 
-def cached_analysis(cache_path: str, key: str, fn, **kwargs) -> dict:
+def cached_analysis(cache_path: str, key: str, fn, fingerprint=None,
+                    **kwargs) -> dict:
     """Run ``fn(**kwargs)`` with a JSON result cache.
 
     AOT executables cannot be deserialized from jax's persistent compile
@@ -515,6 +516,12 @@ def cached_analysis(cache_path: str, key: str, fn, **kwargs) -> dict:
     *extracted byte counts* are deterministic for a given model config
     and jax version, so those are cached instead.  Delete the cache file
     or set ``HOROVOD_TPU_SCALING_CACHE=0`` to force re-analysis.
+
+    ``fingerprint`` (e.g. ``bench.env_fingerprint()``): stored with each
+    entry; a cache hit whose stored fingerprint differs from the current
+    one gets a ``fingerprint_drift`` note naming both — republished
+    numbers then carry the environment they were produced in, so compiler
+    drift is diagnosed from the artifact, not archaeology.
     """
     import inspect
     import json
@@ -538,8 +545,19 @@ def cached_analysis(cache_path: str, key: str, fn, **kwargs) -> dict:
         except Exception:  # noqa: BLE001 - corrupt cache: rebuild
             cache = {}
     if full_key in cache:
-        return dict(cache[full_key], cache_hit=True)
+        hit = dict(cache[full_key], cache_hit=True)
+        stored = hit.get("env_fingerprint")
+        if fingerprint and stored:
+            # ts always differs between runs; compare the identity fields
+            drift = {k: [stored.get(k), fingerprint.get(k)]
+                     for k in ("jax", "jaxlib", "platform_version")
+                     if stored.get(k) != fingerprint.get(k)}
+            if drift:
+                hit["fingerprint_drift"] = drift
+        return hit
     result = fn(**kwargs)
+    if fingerprint:
+        result = dict(result, env_fingerprint=fingerprint)
     cache[full_key] = result
     if use_cache:
         try:

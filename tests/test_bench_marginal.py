@@ -143,3 +143,122 @@ def test_roofline_span_excludes_impossible_readings():
                "fraction_of_spec_peak": 1.5}},
         "measured_matmul_tflops", warnings_out2)
     assert span2 is None and warnings_out2
+
+
+def _fake_full_results():
+    """A representative full-results tree (shapes from BENCH_r04 plus the
+    round-5 sections) for exercising the compact summary."""
+    lane = {"tokens_per_sec": 11295.4, "mfu": 0.3514,
+            "marginal_fit_residual": 0.0921, "step_ms": 1450.6}
+    proj_chips = {str(n): {"bus_bytes_per_chip": 54_000_000,
+                           "t_comm_ms": 1.9, "efficiency_serial": 0.975,
+                           "efficiency_overlapped": 1.0}
+                  for n in (8, 16, 64)}
+    return {
+        "metric": "resnet50_images_per_sec_per_chip", "value": 2665.3,
+        "unit": "images/sec/chip", "vs_baseline": 25.738,
+        "vs_baseline_cross_model": True,
+        "device_kind": "TPU v5 lite", "peak_tflops": 197.0,
+        "env": {"jax": "0.9.0", "jaxlib": "0.9.0",
+                "platform_version": "libtpu 0.0.30 build-abcdef0123456789",
+                "ts": "2026-07-31T12:00:00+00:00"},
+        "measurement": {"warnings": ["one roofline warning"]},
+        "models": {
+            "resnet50": {"value": 2665.3, "unit": "images/sec/chip",
+                         "mfu": 0.332, "marginal_fit_residual": 0.0105,
+                         "vs_control": 1.04,
+                         "control": {"images_per_sec": 2580.0}},
+            "llama": {"value": 20821.3, "unit": "tokens/sec/chip",
+                      "mfu": 0.5523, "marginal_fit_residual": 0.003},
+        },
+        "long_context": {"grad_dtype": "fp32",
+                         "seq8192_b2": dict(lane),
+                         "seq16384_b1": dict(lane),
+                         "seq32768_b1": dict(lane, error="example OOM")},
+        "projected_scaling": {
+            "resnet50_dp": {"projection_v5e": {"per_chips": proj_chips}},
+            "llama_fsdp": {"projection_v5e": {"per_chips": {
+                "64": {"efficiency_serial": 0.656,
+                       "efficiency_estimated": 0.93,
+                       "efficiency_overlapped": 1.0}}}},
+            "llama3_8b": {"min_chips_fit": 16,
+                          "eff64_band": [0.91, 0.97, 1.0]},
+        },
+        "allreduce_busbw": {
+            "2": {"busbw_gbps_fp32": 1.31, "busbw_gbps_fp16": 1.52},
+            "4": {"busbw_gbps_fp32": 0.77}, "8": {"busbw_gbps_fp32": 0.57},
+            "4_paced50_2host": {"hierarchical_speedup": 1.43},
+            "eager_paced_scaling": {"busbw_flatness": 0.8},
+            "fp16_note": {"inverted_at_np": ["8"], "cause": "..."},
+        },
+        "pipeline_schedules": {
+            "gpipe": {}, "1f1b": {},
+            "tpu_memory": {"gpipe_hbm_limit_M": 61,
+                           "1f1b_hbm_limit_M": None}},
+        "compiled_overlap": {"bucketed_unrolled":
+                             {"scheduled_amid_compute": True}},
+        "eager_ingest": {"host_64mb": {"zero_copy_view": True}},
+        "roofline": {}, "eager_dp_scaling": {},
+    }
+
+
+def test_compact_summary_fits_driver_tail_and_carries_headlines():
+    """Round-4 verdict missing #3: the driver records only the last
+    ~2,000 stdout chars; the final line must be a <=1,900-char JSON
+    record carrying every headline claim and every failure flag."""
+    import json
+
+    full = _fake_full_results()
+    s = bench._compact_summary(full)
+    line = json.dumps(s)
+    assert len(line) <= 1900, len(line)
+    assert s["value"] == 2665.3 and s["vs_baseline"] == 25.738
+    assert s["vs_baseline_cross_model"] is True
+    assert s["models"]["llama"][0] == 20821.3          # rate
+    assert s["models"]["llama"][1] == 0.5523            # mfu
+    assert s["models"]["resnet50"][2] == 0.0105         # fit residual
+    assert s["vs_control"] == 1.04
+    assert s["long_context"]["seq8192_b2"] == [11295.4, 0.3514]
+    assert s["busbw_fp32"]["2"] == 1.31
+    assert s["hier_speedup_paced"] == 1.43
+    assert s["paced_flatness"] == 0.8
+    # projection headlines: [serial, estimated, overlapped] at 64 chips
+    assert s["proj64_v5e"]["resnet50"][0] == 0.975
+    assert s["proj64_v5e"]["llama"] == [0.656, 0.93, 1.0]
+    assert s["llama3_8b"] == {"min_chips_fit": 16,
+                              "eff64": [0.91, 0.97, 1.0]}
+    assert s["pipe_gpipe_hbm_M"] == 61
+    assert s["overlap_scheduled"] is True
+    # the failed lane is surfaced as a flag path
+    assert any("seq32768_b1.error" in f for f in s["flags"])
+    assert s["full"] == "BENCH_FULL.json"
+
+
+def test_compact_summary_over_budget_trims_to_fit():
+    import json
+
+    full = _fake_full_results()
+    # blow up the flags list with many long error paths
+    full["long_context"].update({
+        f"seq{n}_b1_very_long_lane_name_padding_padding": {
+            "error": "x" * 150, "tokens_per_sec": 1.0, "mfu": 0.1}
+        for n in range(12)})
+    line_obj = bench._compact_summary(full)
+    if len(json.dumps(line_obj)) > 1900:
+        # main() applies the trim; emulate its branch here
+        for k in ("flags", "long_context", "busbw_fp32"):
+            line_obj.pop(k, None)
+        line_obj["truncated"] = "see BENCH_FULL.json"
+    assert len(json.dumps(line_obj)) <= 1900
+
+
+def test_collect_errors_finds_nested_failure_flags():
+    tree = {"a": {"error": "boom"},
+            "b": {"c": {"marginal_rejected": "raw fallback"}},
+            "d": [{"compile_oom": "Ran out"}],
+            "ok": {"value": 1}}
+    flags = bench._collect_errors(tree)
+    assert "a.error" in flags
+    assert "b.c.marginal_rejected" in flags
+    assert any("compile_oom" in f for f in flags)
+    assert not any(f.startswith("ok") for f in flags)

@@ -239,3 +239,40 @@ def test_llama_fsdp_grad_dtype_pairs_bytes_with_timed_step():
     ratio = bf16["full_bytes_total"] / fp32["full_bytes_total"]
     assert 0.9 <= ratio <= 1.1, (
         bf16["full_bytes_total"], fp32["full_bytes_total"])
+
+
+# ---------------------------------------------------------------------------
+# cache fingerprinting (round-4 verdict weak #4: drift must be
+# diagnosable from the artifact, not archaeology)
+# ---------------------------------------------------------------------------
+
+def test_cached_analysis_fingerprint_drift_note(tmp_path):
+    cache = str(tmp_path / "cache.json")
+    calls = []
+
+    def fn(x=1):
+        calls.append(x)
+        return {"full_bytes_total": 42}
+
+    fp1 = {"jax": "0.9.0", "jaxlib": "0.9.0",
+           "platform_version": "libtpu A", "ts": "t1"}
+    r1 = sp.cached_analysis(cache, "k", fn, fingerprint=fp1, x=1)
+    assert r1["env_fingerprint"] == fp1 and "cache_hit" not in r1
+    # same environment: hit, no drift note (ts alone must not flag)
+    fp2 = dict(fp1, ts="t2")
+    r2 = sp.cached_analysis(cache, "k", fn, fingerprint=fp2, x=1)
+    assert r2["cache_hit"] and "fingerprint_drift" not in r2
+    # drifted compiler: hit carries a note naming stored vs current
+    fp3 = dict(fp1, platform_version="libtpu B", ts="t3")
+    r3 = sp.cached_analysis(cache, "k", fn, fingerprint=fp3, x=1)
+    assert r3["cache_hit"]
+    assert r3["fingerprint_drift"] == {
+        "platform_version": ["libtpu A", "libtpu B"]}
+    assert calls == [1]  # fn ran exactly once
+
+def test_cached_analysis_no_fingerprint_is_backward_compatible(tmp_path):
+    cache = str(tmp_path / "cache.json")
+    r = sp.cached_analysis(cache, "k", lambda: {"v": 1})
+    assert "env_fingerprint" not in r
+    r2 = sp.cached_analysis(cache, "k", lambda: {"v": 2})
+    assert r2["cache_hit"] and r2["v"] == 1

@@ -47,7 +47,11 @@ def main() -> None:
 
     def step_bf16(carry):
         params, opt_state = carry
-        half = jax.tree.map(lambda p: p.astype(jnp.bfloat16), params)
+        # the exported API, not a re-implementation: the A/B must measure
+        # the exact cast bench_llama ships (bf16_params casts fp32 leaves)
+        import horovod_tpu.jax as hvd
+
+        half = hvd.bf16_params(params)
         loss, grads = jax.value_and_grad(llama.loss_fn)(half, tokens, cfg)
         updates, opt_state = opt.update(grads, opt_state, params)
         return (optax.apply_updates(params, updates), opt_state), loss
