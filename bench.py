@@ -112,7 +112,8 @@ def env_fingerprint() -> dict:
     try:
         client = jax.devices()[0].client
         fp["backend"] = client.platform
-        fp["platform_version"] = str(client.platform_version)[:80]
+        fp["platform_version"] = " ".join(
+            str(client.platform_version).split())[:80]
     except Exception as exc:  # noqa: BLE001 - fingerprint is best-effort
         fp["backend_error"] = f"{type(exc).__name__}: {exc}"[:80]
     return fp
@@ -409,7 +410,8 @@ def bench_resnet(args, peak_tflops):
 
     platform = jax.default_backend()
     config = resnet.ResNetConfig(depth=args.resnet_depth, num_classes=1000,
-                                 remat=args.resnet_remat)
+                                 remat=args.resnet_remat,
+                                 bn_fused=args.resnet_bn)
     params, state = resnet.init(jax.random.key(0), config)
 
     opt = hvd.DistributedOptimizer(optax.sgd(0.01, momentum=0.9),
@@ -443,6 +445,7 @@ def bench_resnet(args, peak_tflops):
         "value": round(imgs_per_sec, 2),
         "unit": "images/sec/chip",
         "depth": args.resnet_depth,
+        "bn_fused": args.resnet_bn,
         "step_ms": round(per * 1e3, 2),
         **mfields,
         "model_tflops_per_step": round(
@@ -451,6 +454,38 @@ def bench_resnet(args, peak_tflops):
         "mfu": (round(sustained_tflops / peak_tflops, 4)
                 if peak_tflops else None),
     }
+    if not args.skip_bn_ab and platform == "tpu":
+        # A/B the Pallas fused-BN reductions against XLA's own fusion
+        # choices (round-4 verdict weak #6: the 33.4 ms multiply_reduce
+        # bucket was named, measured, and never attacked).  Same session,
+        # same marginal method; the kernel ships only if this lane shows
+        # it winning.
+        try:
+            import dataclasses
+
+            other = "pallas" if args.resnet_bn == "none" else "none"
+            cfg_b = dataclasses.replace(config, bn_fused=other)
+
+            def step_b(carry):
+                params, state, opt_state = carry
+                (loss, new_state), grads = jax.value_and_grad(
+                    resnet.loss_fn, has_aux=True
+                )(params, state, images, labels, cfg_b)
+                updates, opt_state = opt.update(grads, opt_state, params)
+                return (optax.apply_updates(params, updates), new_state,
+                        opt_state), loss
+
+            bper, bovh, _, bresid, brej = _train_marginal(
+                step_b, (params, state, opt_state), args.k1, args.k2)
+            out["bn_ab"] = {
+                "variant": f"bn_fused={other}",
+                "images_per_sec": round(args.batch_size / bper, 2),
+                "step_ms": round(bper * 1e3, 2),
+                **_marginal_fields(bovh, bresid, brej),
+                "speedup_vs_primary": round(per / bper, 4),
+            }
+        except Exception as exc:  # noqa: BLE001 - report, don't die
+            out["bn_ab"] = {"error": f"{type(exc).__name__}: {exc}"[:200]}
     if not args.skip_control and args.resnet_depth == 50:
         # round-3 verdict item 1a: an INDEPENDENT control implementation
         # (flax.linen layers, tools/resnet_control.py, depth-50 only)
@@ -640,21 +675,128 @@ def bench_projected_scaling(args, models):
                 n_heads=lc.n_heads, n_kv_heads=lc.n_kv_heads,
                 vocab=lc.vocab_size, target_layers=lc.n_layers,
                 grad_dtype=gd)
+            # quantified overlap fraction (round-4 verdict weak #1):
+            # replaces the boolean scheduled-amid-compute evidence with a
+            # per-window hideable-compute estimate from the same
+            # scheduled HLO (utils/overlap_fraction.py, tested)
+            ov = None
+            try:
+                from horovod_tpu.utils import overlap_fraction as ofrac
+
+                ovres = sp.cached_analysis(
+                    cache, "llama_fsdp_overlap",
+                    ofrac.analyze_llama_fsdp_overlap,
+                    fingerprint=env_fingerprint(),
+                    d_model=lc.d_model, d_ff=lc.d_ff,
+                    n_heads=lc.n_heads, n_kv_heads=lc.n_kv_heads,
+                    vocab=lc.vocab_size, grad_dtype=gd)
+                ov = ovres["overlap_fraction"]
+            except Exception as exc:  # noqa: BLE001 - keep the bounds
+                ovres = {"error": f"{type(exc).__name__}: {exc}"[:200]}
             step_s = models["llama"]["step_ms"] / 1e3
             out["llama_fsdp"] = {
                 "grad_dtype": gd,
                 "collective_bytes": {k: ll[k] for k in
                                      ("by_op", "full_bytes_total",
                                       "probe_totals", "analytic")},
+                "overlap_analysis": ovres,
                 "projection_v5e": sp.project(step_s, ll["by_op"],
-                                             chip="v5e"),
+                                             chip="v5e",
+                                             overlap_fraction=ov),
                 "projection_v5p": sp.project(
-                    step_s * v5e_over_v5p, ll["by_op"], chip="v5p"),
+                    step_s * v5e_over_v5p, ll["by_op"], chip="v5p",
+                    overlap_fraction=ov),
                 "v5p_note": "v5p step time scaled by spec-peak ratio "
                             "(MFU-preserving assumption)",
             }
     except Exception as exc:  # noqa: BLE001 - report, don't die
         out["llama_fsdp"] = {"error": f"{type(exc).__name__}: {exc}"[:200]}
+    try:
+        out["llama3_8b"] = _project_llama3_8b(args, models, cache)
+    except Exception as exc:  # noqa: BLE001 - report, don't die
+        out["llama3_8b"] = {"error": f"{type(exc).__name__}: {exc}"[:200]}
+    try:
+        out["sp_64k"] = sp.cached_analysis(
+            cache, "llama_sp_64k", sp.analyze_llama_sp_64k,
+            fingerprint=env_fingerprint())
+    except Exception as exc:  # noqa: BLE001 - report, don't die
+        out["sp_64k"] = {"error": f"{type(exc).__name__}: {exc}"[:200]}
+    return out
+
+
+def _project_llama3_8b(args, models, cache):
+    """Cost the ACTUAL Llama-3-8B north star (round-4 verdict missing
+    #1): collective bytes from probe-depth AOT compiles of the real 8B
+    config, per-chip HBM feasibility from full-depth compiled
+    executables, and weak-scaling efficiency at 16/32/64 chips.
+
+    The 8B step cannot run on this 16 GB chip, so its step time is
+    DERIVED, not measured: per-chip model FLOPs at the north-star shape
+    / (spec peak x the MFU the 886M bench lane measured this session) —
+    the one assumption, flagged in the artifact, with a sensitivity row
+    at a stressed (higher-MFU => comm-heavier) operating point.
+    """
+    from horovod_tpu.models import llama
+    from horovod_tpu.utils import scaling_projection as sp
+
+    cfg = llama.LlamaConfig.llama3_8b()
+    seq, bpc = 4096, 1
+    fp = env_fingerprint()
+    bytes_a = sp.cached_analysis(
+        cache, "llama3_8b_bytes", sp.analyze_llama3_8b_bytes,
+        fingerprint=fp, n=16, batch_per_chip=bpc, seq=seq,
+        grad_dtype="bf16")
+    hbm = sp.cached_analysis(
+        cache, "llama3_8b_hbm", sp.llama3_8b_hbm_feasibility,
+        fingerprint=fp, batch_per_chip=bpc, seq=seq)
+    ov = None
+    try:
+        from horovod_tpu.utils import overlap_fraction as ofrac
+
+        ovres = sp.cached_analysis(
+            cache, "llama3_8b_overlap", ofrac.analyze_llama_fsdp_overlap,
+            fingerprint=fp, d_model=cfg.d_model, d_ff=cfg.d_ff,
+            n_heads=cfg.n_heads, n_kv_heads=cfg.n_kv_heads,
+            vocab=cfg.vocab_size, probe_layers=(1, 2), n=16, seq=1024,
+            grad_dtype="bf16")
+        ov = ovres["overlap_fraction"]
+    except Exception as exc:  # noqa: BLE001 - keep the bounds
+        ovres = {"error": f"{type(exc).__name__}: {exc}"[:200]}
+    mfu = (models.get("llama") or {}).get("mfu")
+    peaks = dict(_PEAK_TFLOPS)
+    out = {"config": {"model": "llama3_8b", "seq": seq,
+                      "batch_per_chip": bpc, "grad_dtype": "bf16"},
+           "collective_bytes": {k: bytes_a[k] for k in
+                                ("by_op", "full_bytes_total",
+                                 "probe_totals", "analytic")},
+           "hbm_feasibility": hbm,
+           "overlap_analysis": ovres,
+           "min_chips_fit": hbm.get("min_chips_fit_v5e_adamw")
+           or hbm.get("min_chips_fit_v5e_sgd")}
+    if mfu:
+        flops_per_chip = llama_train_flops_per_step(cfg, bpc, seq)
+        for chip in ("v5e", "v5p"):
+            step_s = flops_per_chip / (peaks[chip] * 1e12 * mfu)
+            out[f"projection_{chip}"] = sp.project(
+                step_s, bytes_a["by_op"], chip=chip, chips=(16, 32, 64),
+                overlap_fraction=ov)
+            out[f"projection_{chip}"]["step_time_assumption"] = {
+                "mfu": mfu, "source": "886M bench lane measured this "
+                                      "session (spec-peak MFU)"}
+        # sensitivity: a BETTER-than-assumed 8B MFU shrinks compute and
+        # makes comm relatively heavier — stress the claim at +0.15 MFU
+        stress = min(mfu + 0.15, 0.85)
+        step_s = flops_per_chip / (peaks["v5e"] * 1e12 * stress)
+        p = sp.project(step_s, bytes_a["by_op"], chip="v5e", chips=(64,),
+                       overlap_fraction=ov)
+        out["mfu_sensitivity_v5e_64"] = {
+            "mfu": round(stress, 4), **p["per_chips"]["64"]}
+        e64 = out["projection_v5e"]["per_chips"]["64"]
+        out["eff64_band"] = [e64.get("efficiency_serial"),
+                             e64.get("efficiency_estimated"),
+                             e64.get("efficiency_overlapped")]
+    else:
+        out["note"] = "no measured llama MFU this run: bytes/HBM only"
     return out
 
 
@@ -1428,30 +1570,34 @@ def _collect_errors(node, path="", out=None, limit=12):
     """Recursive scan for ``error`` / ``marginal_rejected`` /
     ``compile_oom`` flags anywhere in the result tree — the compact
     summary must surface every claim that FAILED, not just the ones that
-    succeeded (round-4 verdict missing-evidence item 3a)."""
+    succeeded (round-4 verdict missing-evidence item 3a).  Beyond
+    ``limit`` paths the list ends with an explicit ``+N more`` marker
+    (never a silent cap: unshown failures must not read as successes)."""
+    top = out is None
     if out is None:
         out = []
-    if len(out) >= limit:
-        return out
     if isinstance(node, dict):
         for k, v in node.items():
             p = f"{path}.{k}" if path else str(k)
             if k in ("error", "marginal_rejected", "compile_oom",
-                     "fingerprint_drift") and len(out) < limit:
+                     "fingerprint_drift"):
                 out.append(p)
             else:
                 _collect_errors(v, p, out, limit)
     elif isinstance(node, (list, tuple)):
         for i, v in enumerate(node):
             _collect_errors(v, f"{path}[{i}]", out, limit)
+    if top and len(out) > limit:
+        return out[:limit] + [f"+{len(out) - limit} more in BENCH_FULL"]
     return out
 
 
 def _compact_summary(full: dict) -> dict:
-    """The <1,500-char driver-facing record: every headline number and
-    every failure flag, sized so a 2,000-char stdout tail always contains
-    it whole (round-4 verdict: the full artifact was amputated and the
-    round's claims were unverifiable from the driver's capture)."""
+    """The <=1,900-char driver-facing record (budget enforced by
+    :func:`_summary_line`): every headline number and every failure
+    flag, sized so a 2,000-char stdout tail always contains it whole
+    (round-4 verdict: the full artifact was amputated and the round's
+    claims were unverifiable from the driver's capture)."""
     def mv(m):  # model -> [value, mfu, fit_residual]
         return [m.get("value"), m.get("mfu"),
                 m.get("marginal_fit_residual")] if m else None
@@ -1469,15 +1615,26 @@ def _compact_summary(full: dict) -> dict:
     rn = next((v for k, v in models.items() if k.startswith("resnet")), {})
     if rn.get("vs_control"):
         s["vs_control"] = rn["vs_control"]
+    ab = rn.get("bn_ab")
+    if isinstance(ab, dict) and ab.get("speedup_vs_primary"):
+        # primary-time / variant-time: >1 means the variant lane is faster
+        s["bn_ab"] = [ab.get("variant"), ab["speedup_vs_primary"]]
     lc = full.get("long_context", {})
     s["long_context"] = {k: [v.get("tokens_per_sec"), v.get("mfu")]
                          for k, v in lc.items()
                          if isinstance(v, dict) and "tokens_per_sec" in v}
     ar = full.get("allreduce_busbw", {})
+    # plain per-np lanes only (pure-digit keys): the tagged lanes
+    # (4_paced50_2host, 8_interleaved_pair) use different methodology
+    # and must not masquerade as np points
     s["busbw_fp32"] = {k: v.get("busbw_gbps_fp32")
                        for k, v in ar.items()
                        if isinstance(v, dict) and "busbw_gbps_fp32" in v
-                       and not k.startswith("4_")}
+                       and k.isdigit()}
+    pair = ar.get("8_interleaved_pair")
+    if isinstance(pair, dict) and pair.get("busbw_gbps_fp32"):
+        s["busbw_pair8"] = [pair["busbw_gbps_fp32"],
+                            pair.get("busbw_gbps_fp16")]
     paced = ar.get("4_paced50_2host", {})
     if isinstance(paced, dict):
         s["hier_speedup_paced"] = paced.get("hierarchical_speedup")
@@ -1518,6 +1675,31 @@ def _compact_summary(full: dict) -> dict:
     s = {k: v for k, v in s.items() if v not in (None, {}, [])}
     s["full"] = "BENCH_FULL.json"
     return s
+
+
+SUMMARY_BUDGET_CHARS = 1900  # hard stop before the driver's 2,000-char tail
+
+
+def _summary_line(full: dict, budget: int = SUMMARY_BUDGET_CHARS) -> str:
+    """Serialize the compact summary, ENFORCING the budget: trim the
+    bulkiest optional keys first, then fall back to a minimal record —
+    an over-budget line would be amputated by the driver's stdout tail
+    exactly like the round-3/4 full-JSON prints were."""
+    s = _compact_summary(full)
+    line = json.dumps(s)
+    if len(line) <= budget:
+        return line
+    for k in ("flags", "long_context", "busbw_fp32"):
+        s.pop(k, None)
+    s["truncated"] = "see BENCH_FULL.json"
+    line = json.dumps(s)
+    if len(line) <= budget:
+        return line
+    return json.dumps({"metric": full["metric"], "value": full["value"],
+                       "unit": full["unit"],
+                       "vs_baseline": full["vs_baseline"],
+                       "truncated": "summary over budget",
+                       "full": "BENCH_FULL.json"})
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -1583,6 +1765,13 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--resnet-remat", default="none",
                     choices=["none", "blocks"],
                     help="rematerialisation mode for the resnet section")
+    ap.add_argument("--resnet-bn", default="none",
+                    choices=["none", "pallas"],
+                    help="BN reduction strategy for the primary resnet "
+                         "lane (ops/bn.py); the bn_ab lane measures the "
+                         "other variant in the same session")
+    ap.add_argument("--skip-bn-ab", action="store_true",
+                    help="skip the fused-BN A/B lane")
     ap.add_argument("--trace", action="store_true",
                     help="attach a per-op device-trace attribution to the "
                          "resnet section (docs/benchmarks.md table)")
@@ -1645,19 +1834,30 @@ def main() -> None:
     hvd.init()
     backend, device_kind, peak = detect_platform()
 
+    def _stamp(section):
+        # per-section environment fingerprint, captured THE MOMENT the
+        # section finishes (round-4 verdict weak #4) — a single
+        # end-of-run stamping pass would label early sections with a
+        # post-drift compiler identity, positively asserting the wrong
+        # producer for exactly the numbers drift corrupts
+        if isinstance(section, dict) and section:
+            section.setdefault("env", env_fingerprint())
+        return section
+
     # rooflines are (re)measured around every model section so each MFU is
     # judged against a contemporaneous ceiling (round-2 verdict item 3)
-    rooflines = {"matmul_start": measure_matmul_roofline(peak),
-                 "conv_start": measure_conv_roofline(peak)}
+    rooflines = {"matmul_start": _stamp(measure_matmul_roofline(peak)),
+                 "conv_start": _stamp(measure_conv_roofline(peak))}
 
     rkey = f"resnet{args.resnet_depth}"  # one model identity everywhere
-    models = {rkey: bench_resnet(args, peak)}
-    rooflines["conv_after_resnet"] = measure_conv_roofline(peak)
+    models = {rkey: _stamp(bench_resnet(args, peak))}
+    rooflines["conv_after_resnet"] = _stamp(measure_conv_roofline(peak))
     if not args.skip_llama:
-        models["llama"] = bench_llama(args, peak)
-        rooflines["matmul_after_llama"] = measure_matmul_roofline(peak)
+        models["llama"] = _stamp(bench_llama(args, peak))
+        rooflines["matmul_after_llama"] = _stamp(
+            measure_matmul_roofline(peak))
     long_context = {} if args.skip_long_context else \
-        bench_long_context(args, peak)
+        _stamp(bench_long_context(args, peak))
 
     warnings_out = []
     conv_span = roofline_span(rooflines, "measured_conv_tflops",
@@ -1681,27 +1881,18 @@ def main() -> None:
             warnings_out.append("llama exceeded the matmul roofline — "
                                "backend tenancy varied between sections")
 
-    ingest_lane = {} if args.skip_ingest else bench_eager_ingest(args)
+    ingest_lane = {} if args.skip_ingest else _stamp(bench_eager_ingest(args))
     projected = {} if args.skip_projection else \
-        bench_projected_scaling(args, models)
-    allreduce = {} if args.skip_allreduce else bench_allreduce(args)
-    scaling = {} if args.skip_scaling else bench_scaling(args)
-    overlap = {} if args.skip_overlap else measure_hlo_overlap()
-    pipeline = {} if args.skip_pipeline else bench_pipeline()
+        _stamp(bench_projected_scaling(args, models))
+    allreduce = {} if args.skip_allreduce else _stamp(bench_allreduce(args))
+    scaling = {} if args.skip_scaling else _stamp(bench_scaling(args))
+    overlap = {} if args.skip_overlap else _stamp(measure_hlo_overlap())
+    pipeline = {} if args.skip_pipeline else _stamp(bench_pipeline())
     if pipeline and isinstance(pipeline, dict) and "error" not in pipeline:
         # TPU-topology HBM analysis in THIS process (libtpu already
         # loaded here): the worker subprocess doing it collided with the
         # chip-holding parent on libtpu's multi-process lockfile
         pipeline["tpu_memory"] = bench_pipeline_tpu_memory()
-
-    # per-section environment fingerprints (round-4 verdict weak #4):
-    # the drift archaeology showed numbers must carry the compiler that
-    # produced them.  Sections measured above get stamped here, in run
-    # order; the ts granularity is the section sequence, not per-lane.
-    for section in (*models.values(), long_context, projected, allreduce,
-                    scaling, overlap, pipeline, ingest_lane, rooflines):
-        if isinstance(section, dict) and section:
-            section.setdefault("env", env_fingerprint())
 
     primary = models[rkey]
     full = {
@@ -1749,14 +1940,7 @@ def main() -> None:
     # that tail whole; the full tree is in BENCH_FULL.json next to it.
     with open(os.path.join(REPO, "BENCH_FULL.json"), "w") as f:
         json.dump(full, f, indent=1)
-    line = json.dumps(_compact_summary(full))
-    if len(line) > 1900:  # hard stop before the driver's 2,000-char tail
-        trimmed = _compact_summary(full)
-        for k in ("flags", "long_context", "busbw_fp32"):
-            trimmed.pop(k, None)
-        trimmed["truncated"] = "see BENCH_FULL.json"
-        line = json.dumps(trimmed)
-    print(line)
+    print(_summary_line(full))
 
 
 if __name__ == "__main__":

@@ -234,7 +234,10 @@ def test_compact_summary_fits_driver_tail_and_carries_headlines():
     assert s["full"] == "BENCH_FULL.json"
 
 
-def test_compact_summary_over_budget_trims_to_fit():
+def test_summary_line_enforces_budget_on_bloated_results():
+    """The budget is enforced by the SAME function main() prints — an
+    over-budget line is trimmed, and if still over, collapsed to a
+    minimal record (never printed over budget)."""
     import json
 
     full = _fake_full_results()
@@ -243,13 +246,15 @@ def test_compact_summary_over_budget_trims_to_fit():
         f"seq{n}_b1_very_long_lane_name_padding_padding": {
             "error": "x" * 150, "tokens_per_sec": 1.0, "mfu": 0.1}
         for n in range(12)})
-    line_obj = bench._compact_summary(full)
-    if len(json.dumps(line_obj)) > 1900:
-        # main() applies the trim; emulate its branch here
-        for k in ("flags", "long_context", "busbw_fp32"):
-            line_obj.pop(k, None)
-        line_obj["truncated"] = "see BENCH_FULL.json"
-    assert len(json.dumps(line_obj)) <= 1900
+    line = bench._summary_line(full)
+    assert len(line) <= bench.SUMMARY_BUDGET_CHARS
+    s = json.loads(line)
+    assert s["value"] == full["value"]          # headline survives any trim
+    assert s["full"] == "BENCH_FULL.json"
+    # pathological budget: the minimal-record fallback still parses
+    tiny = bench._summary_line(full, budget=10)
+    t = json.loads(tiny)
+    assert t["value"] == full["value"] and "truncated" in t
 
 
 def test_collect_errors_finds_nested_failure_flags():

@@ -276,3 +276,156 @@ def test_cached_analysis_no_fingerprint_is_backward_compatible(tmp_path):
     assert "env_fingerprint" not in r
     r2 = sp.cached_analysis(cache, "k", lambda: {"v": 2})
     assert r2["cache_hit"] and r2["v"] == 1
+
+
+def test_cached_analysis_legacy_entry_flags_unknown_origin(tmp_path):
+    """An entry written before fingerprinting (the real round-4 cache)
+    cannot be compared — the hit must SAY so, not silently skip the
+    drift check; and the unknown origin must never be back-filled with
+    today's environment."""
+    cache = str(tmp_path / "cache.json")
+    sp.cached_analysis(cache, "k", lambda: {"v": 1})  # legacy: no fp
+    fp = {"jax": "0.9.0", "platform_version": "libtpu B", "ts": "t"}
+    hit = sp.cached_analysis(cache, "k", lambda: {"v": 2}, fingerprint=fp)
+    assert hit["cache_hit"] and hit["v"] == 1
+    assert hit["fingerprint_unknown_origin"] is True
+    assert "env_fingerprint" not in hit
+    # a later hit still reports unknown origin (nothing was back-filled)
+    hit2 = sp.cached_analysis(cache, "k", lambda: {"v": 3}, fingerprint=fp)
+    assert hit2["fingerprint_unknown_origin"] is True
+
+
+# ---------------------------------------------------------------------------
+# north-star costing (round-5: Llama-3-8B bytes + HBM feasibility, 64k SP)
+# ---------------------------------------------------------------------------
+
+def test_llama3_8b_wrappers_pass_north_star_config(monkeypatch):
+    """The named 8B entry points must cost the ACTUAL north-star config
+    (d_model 4096, vocab 128256, 32 layers) — not a proxy."""
+    seen = {}
+
+    def fake_fsdp(**kw):
+        seen.update(kw)
+        return {"ok": True}
+
+    monkeypatch.setattr(sp, "analyze_llama_fsdp", fake_fsdp)
+    r = sp.analyze_llama3_8b_bytes(n=16, seq=4096)
+    assert r == {"ok": True}
+    assert seen["d_model"] == 4096 and seen["vocab"] == 128256
+    assert seen["target_layers"] == 32 and seen["d_ff"] == 14336
+    assert seen["n_heads"] == 32 and seen["n_kv_heads"] == 8
+    assert seen["seq"] == 4096 and seen["n"] == 16
+
+    seen2 = {}
+
+    def fake_hbm(cfg=None, **kw):
+        seen2["cfg"] = cfg
+        seen2.update(kw)
+        return {"ok": True}
+
+    monkeypatch.setattr(sp, "fsdp_hbm_feasibility", fake_hbm)
+    r2 = sp.llama3_8b_hbm_feasibility(chips=(8,), seq=4096)
+    assert r2 == {"ok": True}
+    assert seen2["cfg"] is None  # None => the 8B default inside
+    assert seen2["chips"] == (8,)
+
+
+@pytest.mark.slow
+def test_fsdp_hbm_feasibility_tiny_model():
+    """The feasibility machinery on a tiny llama: per-chip totals are
+    positive, SHRINK as the FSDP axis grows (parameter shards halve),
+    adamw costs more than sgd (2x fp32 param-sized state), and the
+    min-chips summary reflects the fits flags."""
+    from horovod_tpu.models import llama
+
+    cfg = llama.LlamaConfig(vocab_size=512, d_model=128, n_layers=2,
+                            n_heads=4, n_kv_heads=2, d_ff=256)
+    try:
+        out = sp.fsdp_hbm_feasibility(cfg=cfg, chips=(2, 4), seq=256,
+                                      batch_per_chip=1,
+                                      optimizers=("sgd", "adamw"))
+    except Exception as exc:  # pragma: no cover - no TPU topology client
+        pytest.skip(f"AOT topology compile unavailable: {exc}")
+    p2 = out["per_chips"]["2"]
+    p4 = out["per_chips"]["4"]
+    for opt in ("sgd", "adamw"):
+        assert p2[opt]["per_chip_total_bytes"] > 0
+        assert p2[opt]["fits_v5e_16gb"] is True  # tiny model always fits
+    # params+grads+state shard over the axis: arguments shrink with n
+    assert p4["sgd"]["argument_bytes"] < p2["sgd"]["argument_bytes"]
+    # adamw's m/v state costs more than sgd's empty state
+    assert (p2["adamw"]["argument_bytes"]
+            > p2["sgd"]["argument_bytes"])
+    assert out["min_chips_fit_v5e_sgd"] == 2
+    assert out["min_chips_fit_v5e_adamw"] == 2
+
+
+@pytest.mark.slow
+def test_sp_64k_machinery_on_tiny_shapes():
+    """The 64k-SP analysis code path (single-chip lane + sp=2 Pallas
+    ring lane, AOT memory analysis) at toy shapes: both lanes must
+    compile and report per-chip HBM; at toy size both fit, and the sp=2
+    lane's per-chip arguments are no larger than single-chip's."""
+    try:
+        out = sp.analyze_llama_sp_64k(
+            seq=1024, sp=2, d_model=128, n_layers=2, n_heads=4,
+            n_kv_heads=2, d_ff=256, vocab=512, batch=1, block=256)
+    except Exception as exc:  # pragma: no cover - no TPU topology client
+        pytest.skip(f"AOT topology compile unavailable: {exc}")
+    s, d = out["single_chip"], out["sp2_ring"]
+    assert s.get("per_chip_total_bytes", 0) > 0, s
+    assert d.get("per_chip_total_bytes", 0) > 0, d
+    assert s["fits_v5e_16gb"] and d["fits_v5e_16gb"]
+    assert "claim" in out
+
+
+@pytest.mark.slow
+def test_llama_fsdp_overlap_fraction_small():
+    """End-to-end overlap-fraction on a real scheduled probe compile:
+    fraction must be a valid [0,1] value with per-depth results and
+    nonzero total communication (the probe's FSDP all-gathers)."""
+    from horovod_tpu.utils import overlap_fraction as ofrac
+
+    try:
+        out = ofrac.analyze_llama_fsdp_overlap(
+            d_model=256, d_ff=1024, n_heads=8, n_kv_heads=4, vocab=2048,
+            probe_layers=(1, 2), n=8, seq=128, batch_per_chip=1)
+    except Exception as exc:  # pragma: no cover - no TPU topology client
+        pytest.skip(f"AOT topology compile unavailable: {exc}")
+    assert 0.0 <= out["overlap_fraction"] <= 1.0
+    assert set(out["per_probe_depth"]) == {"1", "2"}
+    for res in out["per_probe_depth"].values():
+        assert (res["t_comm_async_ms"] + res["t_comm_sync_ms"]) > 0
+    assert out["fraction_spread"] >= 0.0
+
+
+def test_reduce_scatter_start_counts_shard_payload():
+    """Async reduce-scatter-start carries an (input [N], shard [N/g])
+    tuple: the shard is the payload (x g = full), NOT input+shard — the
+    sync-branch fallback overcounted (g+1)x before round 5."""
+    txt = """
+ENTRY %main {
+  %rss = (bf16[4096]{0}, bf16[512]{0}) reduce-scatter-start(%x), replica_groups=[1,8]<=[8], to_apply=%add
+}
+"""
+    by = sp.parse_collective_bytes(txt)["by_op"]
+    # shard 512 * 2 bytes * g=8 = the full 4096*2 input payload
+    assert by["reduce-scatter"]["full_bytes"] == 4096 * 2
+
+
+def test_variadic_combined_async_starts():
+    """XLA's collective combiner emits variadic -start ops with
+    (operands..., results...) tuples; the result half must be identified
+    by half-sums — all-gather results are the larger half, reduce-scatter
+    shards the smaller — not by a single min/max element."""
+    txt = """
+ENTRY %main {
+  %ags = (bf16[8,4]{1,0}, bf16[2,2]{1,0}, bf16[64,4]{1,0}, bf16[16,2]{1,0}) all-gather-start(%a, %b), replica_groups=[1,8]<=[8], dimensions={0}
+  %rss = (bf16[4096]{0}, bf16[1024]{0}, bf16[512]{0}, bf16[128]{0}) reduce-scatter-start(%c, %d), replica_groups=[1,8]<=[8], to_apply=%add
+}
+"""
+    by = sp.parse_collective_bytes(txt)["by_op"]
+    # AG results: 64*4*2 + 16*2*2 = 576 bytes (the g x operands half)
+    assert by["all-gather"]["full_bytes"] == (64 * 4 + 16 * 2) * 2
+    # RS shards: (512 + 128)*2 bytes, x g=8 = the full input payload
+    assert by["reduce-scatter"]["full_bytes"] == (512 + 128) * 2 * 8
