@@ -58,10 +58,13 @@ def test_analyze_schedule_window_accounting():
     res = of.analyze_schedule(SCHED, chip="v5e", default_group=8)
     spec = of.CHIP_SPECS["v5e"]
     # async all-gather: gathered result bf16[512,512] = 512 KB payload,
-    # ring factor (8-1)/8
+    # ring factor (8-1)/8; window = start..done (done consumes start)
     full = 512 * 512 * 2
     t_comm = full * (7 / 8) / (spec["ici_gbps"] * 1e9)
-    assert math.isclose(res["t_comm_async_ms"], t_comm * 1e3, rel_tol=1e-3)
+    # sync all-reduce: no consumer, no compute after -> unhidden
+    ar_t = (1024 * 4) * 2 * (7 / 8) / (spec["ici_gbps"] * 1e9)
+    assert math.isclose(res["t_comm_total_ms"], (t_comm + ar_t) * 1e3,
+                        rel_tol=1e-3)
     # the fusion inside the window prices at max(flops/peak, bytes/hbm)
     flops_t = (2 * 512**3) / spec["peak_flops"]
     bytes_t = (3 * 512 * 512 * 2) / (spec["hbm_gbps"] * 1e9)
@@ -69,15 +72,55 @@ def test_analyze_schedule_window_accounting():
     expect_hidden = min(t_comm, t_hide)
     assert math.isclose(res["t_hidden_ms"], expect_hidden * 1e3,
                         rel_tol=1e-3)
-    # the sync all-reduce contributes unhidden time
-    ar_t = (1024 * 4) * 2 * (7 / 8) / (spec["ici_gbps"] * 1e9)
     # 6-decimal ms rounding in the artifact: compare at that precision
     assert math.isclose(res["t_comm_sync_ms"], ar_t * 1e3, rel_tol=5e-3)
-    assert res["n_async_windows"] == 1
+    assert res["n_windows"] == 2
     assert res["n_sync_collectives"] == 1
     expect_frac = expect_hidden / (t_comm + ar_t)
     assert math.isclose(res["overlap_fraction"], round(expect_frac, 4),
                         rel_tol=1e-3)
+
+
+def test_sync_collective_first_consumer_window():
+    """A plain sync collective (the only spelling this toolchain's AOT
+    TPU compiles emit) is hideable up to its FIRST CONSUMER: compute
+    scheduled between issue and consumer counts, compute after the
+    consumer does not, and view ops (gte/bitcast) extend the window
+    instead of closing it."""
+    hlo = """
+HloModule jit_s, is_scheduled=true
+
+%fused_computation.1 (param_0.1: bf16[512,512], param_1.2: bf16[512,512]) -> bf16[512,512] {
+  %param_0.1 = bf16[512,512]{1,0} parameter(0)
+  %param_1.2 = bf16[512,512]{1,0} parameter(1)
+  %dot.9 = bf16[512,512]{1,0} dot(%param_0.1, %param_1.2), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+}
+
+ENTRY %main.1 (p0: bf16[512,512], p1: bf16[512,512]) -> bf16[512,512] {
+  %p0 = bf16[512,512]{1,0} parameter(0)
+  %p1 = bf16[512,512]{1,0} parameter(1)
+  %ag = bf16[512,512]{1,0} all-gather(%p0), replica_groups={{0,1,2,3,4,5,6,7}}, dimensions={0}
+  %view = bf16[512,512]{1,0} bitcast(%ag)
+  %fusion.3 = bf16[512,512]{1,0} fusion(%p0, %p1), kind=kOutput, calls=%fused_computation.1
+  %use = bf16[512,512]{1,0} add(%view, %p1)
+  %fusion.4 = bf16[512,512]{1,0} fusion(%use, %p1), kind=kOutput, calls=%fused_computation.1
+  ROOT %out = bf16[512,512]{1,0} add(%fusion.4, %use)
+}
+"""
+    res = of.analyze_schedule(hlo, chip="v5e", default_group=8)
+    spec = of.CHIP_SPECS["v5e"]
+    t_comm = 512 * 512 * 2 * (7 / 8) / (spec["ici_gbps"] * 1e9)
+    flops_t = (2 * 512**3) / spec["peak_flops"]
+    bytes_t = (3 * 512 * 512 * 2) / (spec["hbm_gbps"] * 1e9)
+    one_fusion = max(flops_t, bytes_t)
+    # only fusion.3 (between %ag and its consumer %use, through the
+    # bitcast alias) hides; fusion.4 is after the consumer
+    expect_hidden = min(t_comm, one_fusion)
+    assert res["n_windows"] == 1 and res["n_sync_collectives"] == 1
+    assert math.isclose(res["t_hidden_ms"], expect_hidden * 1e3,
+                        rel_tol=1e-3)
+    assert math.isclose(res["overlap_fraction"],
+                        round(expect_hidden / t_comm, 4), rel_tol=1e-3)
 
 
 def test_compute_outside_window_hides_nothing():

@@ -304,17 +304,25 @@ def test_llama3_8b_wrappers_pass_north_star_config(monkeypatch):
     (d_model 4096, vocab 128256, 32 layers) — not a proxy."""
     seen = {}
 
-    def fake_fsdp(**kw):
+    def fake_fsdp_full(**kw):
         seen.update(kw)
-        return {"ok": True}
+        return {"by_op": {"all-gather": {"count": 1,
+                                         "full_bytes": 100 + kw["seq"]}},
+                "full_bytes_total": 100 + kw["seq"],
+                "group_sizes": [8],
+                "analytic": {"param_bytes": 50}}
 
-    monkeypatch.setattr(sp, "analyze_llama_fsdp", fake_fsdp)
-    r = sp.analyze_llama3_8b_bytes(n=16, seq=4096)
-    assert r == {"ok": True}
+    monkeypatch.setattr(sp, "analyze_llama_fsdp", fake_fsdp_full)
+    r = sp.analyze_llama3_8b_bytes(n=8, probe_seqs=(256, 512),
+                                   target_seq=4096)
     assert seen["d_model"] == 4096 and seen["vocab"] == 128256
     assert seen["target_layers"] == 32 and seen["d_ff"] == 14336
     assert seen["n_heads"] == 32 and seen["n_kv_heads"] == 8
-    assert seen["seq"] == 4096 and seen["n"] == 16
+    assert seen["n"] == 8
+    # linear-in-seq extrapolation: bytes(seq) = 100 + seq -> 4196 at 4096
+    assert r["by_op"]["all-gather"]["full_bytes"] == 100 + 4096
+    assert r["target_seq"] == 4096 and r["probe_seqs"] == [256, 512]
+    assert r["seq_dependence_fraction"] > 0
 
     seen2 = {}
 
@@ -395,7 +403,7 @@ def test_llama_fsdp_overlap_fraction_small():
     assert 0.0 <= out["overlap_fraction"] <= 1.0
     assert set(out["per_probe_depth"]) == {"1", "2"}
     for res in out["per_probe_depth"].values():
-        assert (res["t_comm_async_ms"] + res["t_comm_sync_ms"]) > 0
+        assert res["t_comm_total_ms"] > 0
     assert out["fraction_spread"] >= 0.0
 
 
