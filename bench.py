@@ -767,10 +767,14 @@ def _project_llama3_8b(args, models, cache):
     # each sub-analysis fails independently: a probe-compile problem in
     # one lane must not blank the whole north-star section
     try:
+        # probes run at batch_per_chip=1 x seq 512 (larger shapes
+        # re-trigger the windowed-einsum while loops); FSDP traffic is
+        # parameter-shaped, so the bytes transfer to the 16k-token step
+        # within token_dependent_share (~3e-5) — see the analyzer's
+        # docstring for why a cross-seq extrapolation was rejected
         bytes_a = sp.cached_analysis(
             cache, "llama3_8b_bytes", sp.analyze_llama3_8b_bytes,
-            fingerprint=fp, n=8, batch_per_chip=bpc, target_seq=seq,
-            grad_dtype="bf16")
+            fingerprint=fp, n=8, batch_per_chip=1, grad_dtype="bf16")
     except Exception as exc:  # noqa: BLE001
         bytes_a = {"error": f"{type(exc).__name__}: {exc}"[:200]}
     try:
@@ -804,11 +808,16 @@ def _project_llama3_8b(args, models, cache):
                bytes_a if "error" in bytes_a else
                {k: bytes_a[k] for k in
                 ("by_op", "full_bytes_total", "probe_totals",
-                 "seq_dependence_fraction", "analytic")}),
+                 "probe_vocabs", "token_dependent_share", "analytic")}),
            "hbm_feasibility": hbm,
            "overlap_analysis": ovres,
-           "min_chips_fit": hbm.get("min_chips_fit_v5e_adamw")
-           or hbm.get("min_chips_fit_v5e_sgd")}
+           # per-budget minimum chip counts (None = no tested count
+           # fits that budget at this per-chip token load)
+           "min_chips_fit": {
+               "v5e": hbm.get("min_chips_fit_v5e_adamw")
+               or hbm.get("min_chips_fit_v5e_sgd"),
+               "v5p": hbm.get("min_chips_fit_v5p_adamw")
+               or hbm.get("min_chips_fit_v5p_sgd")}}
     if mfu and "error" not in bytes_a:
         flops_per_chip = llama_train_flops_per_step(cfg, bpc, seq)
         for chip in ("v5e", "v5p"):
@@ -1699,8 +1708,11 @@ def _compact_summary(full: dict) -> dict:
                        for k, v in proj.items()
                        if isinstance(v, dict) and "projection_v5e" in v}
     l3 = proj.get("llama3_8b", {})
-    if isinstance(l3, dict) and l3.get("min_chips_fit"):
-        s["llama3_8b"] = {"min_chips_fit": l3.get("min_chips_fit"),
+    mcf = l3.get("min_chips_fit") if isinstance(l3, dict) else None
+    mcf_known = (any(v is not None for v in mcf.values())
+                 if isinstance(mcf, dict) else mcf is not None)
+    if isinstance(l3, dict) and (l3.get("eff64_band") or mcf_known):
+        s["llama3_8b"] = {"min_chips_fit": mcf,
                           "eff64": l3.get("eff64_band")}
     pipe = full.get("pipeline_schedules", {})
     tm = pipe.get("tpu_memory", {}) if isinstance(pipe, dict) else {}

@@ -510,17 +510,8 @@ def analyze_llama_fsdp(d_model: int = 2048, d_ff: int = 8192,
         stats[L] = _llama_fsdp_bytes(cfg, n, batch_per_chip, seq,
                                      grad_dtype=grad_dtype)
     L1, L2 = probe_layers
-    by_op = {}
-    ops = set(stats[L1]["by_op"]) | set(stats[L2]["by_op"])
-    for op in ops:
-        b1 = stats[L1]["by_op"].get(op, {}).get("full_bytes", 0)
-        b2 = stats[L2]["by_op"].get(op, {}).get("full_bytes", 0)
-        per_layer = (b2 - b1) / (L2 - L1)
-        fixed = b1 - per_layer * L1
-        by_op[op] = {
-            "count": stats[L2]["by_op"].get(op, {}).get("count", 0),
-            "full_bytes": int(max(fixed + per_layer * target_layers, 0)),
-        }
+    by_op = _extrapolate_by_op(stats[L1]["by_op"], stats[L2]["by_op"],
+                               L1, L2, target_layers)
     # analytic cross-check: FSDP traffic is parameter-shaped — all-gathers
     # of the (bf16-computed) weights in forward + backward-recompute, and
     # grad reduce-scatter/all-reduce; total collective bytes land in a
@@ -557,25 +548,54 @@ def analyze_llama_fsdp(d_model: int = 2048, d_ff: int = 8192,
     }
 
 
+def _extrapolate_by_op(lo: dict, hi: dict, x_lo: float, x_hi: float,
+                       x_target: float) -> dict:
+    """Per-op linear extrapolation ``bytes(x) = fixed + slope*x`` from
+    two measured ``by_op`` maps — the shared engine behind the depth
+    and vocab extrapolations."""
+    by_op = {}
+    for op in set(lo) | set(hi):
+        b1 = lo.get(op, {}).get("full_bytes", 0)
+        b2 = hi.get(op, {}).get("full_bytes", 0)
+        slope = (b2 - b1) / (x_hi - x_lo)
+        fixed = b1 - slope * x_lo
+        by_op[op] = {
+            "count": hi.get(op, {}).get("count",
+                                        lo.get(op, {}).get("count", 0)),
+            "full_bytes": int(max(fixed + slope * x_target, 0)),
+        }
+    return by_op
+
+
 def analyze_llama3_8b_bytes(n: int = 8, batch_per_chip: int = 1,
-                            probe_seqs=(256, 512), target_seq: int = 4096,
+                            probe_seq: int = 512,
+                            probe_vocabs=(16384, 32768),
                             grad_dtype: str = "bf16") -> dict:
     """Collective bytes of one FSDP train step of the ACTUAL north-star
     model — ``LlamaConfig.llama3_8b()`` (BASELINE.md; the reference costs
     its flagship models in ``/root/reference/docs/benchmarks.md:5-38``).
 
-    Two extrapolations, both linear and both probe-verified:
+    Two linear extrapolations, each probe-verified (two measured points
+    per axis, from real 8B-width compiles):
 
     * depth: ``bytes(L) = fixed + per_layer*L`` from unrolled L=1,2
       compiles (exact — every layer contributes identical collectives);
-    * sequence: ``bytes(seq) = fixed + per_token*seq`` from two probe
-      sequence lengths.  FSDP traffic is parameter-shaped (per_token ~ 0
-      up to small activation all-to-alls), but the component is measured
-      rather than assumed.  Probing at short seq keeps the HLO free of
-      the windowed-einsum ``while`` loops GSPMD introduces for the
-      [tokens, vocab] logits contraction at long seq / large mesh (this
-      libtpu exposes no compile option to disable them, and collective
-      bytes inside a loop body cannot be counted from static text).
+    * vocab: ``bytes(V) = fixed + per_row*V`` — embed/lm_head gathers
+      scale with V, layer weights don't.  Probing at 16k/32k vocab
+      keeps the HLO free of the windowed-einsum ``while`` loops GSPMD
+      introduces for the 2.1 GB gathered lm_head at vocab 128256 (this
+      libtpu exposes no option to disable them, and collective bytes
+      inside a loop body cannot be counted from static text).
+
+    Token count is NOT extrapolated: FSDP traffic is parameter-shaped —
+    the token-dependent component at the probe shape (activation
+    all-to-alls) is measured and reported as ``token_dependent_share``
+    (~3e-5 of total), so holding bytes constant from the probe's
+    512 tokens/chip to a production token load changes the projection
+    by well under a point.  (A cross-seq extrapolation was tried and
+    REJECTED: GSPMD's partitioning strategy for the vocab-extrapolated
+    fixed component is shape-regime dependent, producing negative
+    slopes — per-shape analyses are sane, cross-shape lines are not.)
 
     Group-size independence of the payloads makes the n=8 probe valid
     for projections at any chip count.
@@ -583,42 +603,35 @@ def analyze_llama3_8b_bytes(n: int = 8, batch_per_chip: int = 1,
     from horovod_tpu.models import llama
 
     cfg = llama.LlamaConfig.llama3_8b()
-    per_seq = {}
-    for s in probe_seqs:
-        per_seq[s] = analyze_llama_fsdp(
+    v1, v2 = probe_vocabs
+    per_v = {}
+    for v in probe_vocabs:
+        per_v[v] = analyze_llama_fsdp(
             d_model=cfg.d_model, d_ff=cfg.d_ff, n_heads=cfg.n_heads,
-            n_kv_heads=cfg.n_kv_heads, vocab=cfg.vocab_size,
+            n_kv_heads=cfg.n_kv_heads, vocab=v,
             target_layers=cfg.n_layers, probe_layers=(1, 2), n=n,
-            batch_per_chip=batch_per_chip, seq=s, grad_dtype=grad_dtype)
-    s1, s2 = probe_seqs
-    by_op = {}
-    ops = set(per_seq[s1]["by_op"]) | set(per_seq[s2]["by_op"])
-    for op in ops:
-        b1 = per_seq[s1]["by_op"].get(op, {}).get("full_bytes", 0)
-        b2 = per_seq[s2]["by_op"].get(op, {}).get("full_bytes", 0)
-        per_token = (b2 - b1) / (s2 - s1)
-        fixed = b1 - per_token * s1
-        by_op[op] = {
-            "count": per_seq[s2]["by_op"].get(op, {}).get("count", 0),
-            "full_bytes": int(max(fixed + per_token * target_seq, 0)),
-        }
+            batch_per_chip=batch_per_chip, seq=probe_seq,
+            grad_dtype=grad_dtype)
+    by_op = _extrapolate_by_op(
+        per_v[v1]["by_op"], per_v[v2]["by_op"], v1, v2, cfg.vocab_size)
     total = sum(d["full_bytes"] for d in by_op.values())
-    param_bytes = per_seq[s2]["analytic"]["param_bytes"]
+    token_dep = by_op.get("all-to-all", {}).get("full_bytes", 0)
+    import jax
+
+    pshape = jax.eval_shape(lambda: llama.init(jax.random.key(0), cfg))
+    param_bytes = sum(math.prod(x.shape) * x.dtype.itemsize
+                      for x in jax.tree.leaves(pshape))
     return {
         "by_op": by_op,
         "full_bytes_total": total,
-        "group_sizes": per_seq[s2]["group_sizes"],
-        "probe_seqs": list(probe_seqs),
-        "target_seq": target_seq,
+        "probe_seq": probe_seq,
+        "probe_vocabs": list(probe_vocabs),
         "target_layers": cfg.n_layers,
         "grad_dtype": grad_dtype,
         "mesh": {"axis": "data(fsdp)", "n": n},
-        "probe_totals": {str(s): per_seq[s]["full_bytes_total"]
-                         for s in probe_seqs},
-        "seq_dependence_fraction": round(
-            abs(per_seq[s2]["full_bytes_total"]
-                - per_seq[s1]["full_bytes_total"])
-            / max(per_seq[s2]["full_bytes_total"], 1), 4),
+        "probe_totals": {str(v): per_v[v]["full_bytes_total"]
+                         for v in probe_vocabs},
+        "token_dependent_share": round(token_dep / max(total, 1), 6),
         "analytic": {
             "param_bytes": param_bytes,
             "expected": "param all-gathers (fwd + bwd recompute, bf16) + "

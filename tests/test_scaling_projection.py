@@ -304,25 +304,34 @@ def test_llama3_8b_wrappers_pass_north_star_config(monkeypatch):
     (d_model 4096, vocab 128256, 32 layers) — not a proxy."""
     seen = {}
 
+    vocabs_probed = []
+
     def fake_fsdp_full(**kw):
         seen.update(kw)
-        return {"by_op": {"all-gather": {"count": 1,
-                                         "full_bytes": 100 + kw["seq"]}},
-                "full_bytes_total": 100 + kw["seq"],
+        vocabs_probed.append(kw["vocab"])
+        # bytes linear in BOTH vocab and seq: slope 2 per vocab row,
+        # 1 per token
+        b = 100 + 2 * kw["vocab"] + kw["seq"]
+        return {"by_op": {"all-gather": {"count": 1, "full_bytes": b}},
+                "full_bytes_total": b,
                 "group_sizes": [8],
                 "analytic": {"param_bytes": 50}}
 
     monkeypatch.setattr(sp, "analyze_llama_fsdp", fake_fsdp_full)
-    r = sp.analyze_llama3_8b_bytes(n=8, probe_seqs=(256, 512),
-                                   target_seq=4096)
-    assert seen["d_model"] == 4096 and seen["vocab"] == 128256
+    r = sp.analyze_llama3_8b_bytes(n=8, probe_seq=512,
+                                   probe_vocabs=(16384, 32768))
+    assert seen["d_model"] == 4096  # the real 8B width is probed
     assert seen["target_layers"] == 32 and seen["d_ff"] == 14336
     assert seen["n_heads"] == 32 and seen["n_kv_heads"] == 8
-    assert seen["n"] == 8
-    # linear-in-seq extrapolation: bytes(seq) = 100 + seq -> 4196 at 4096
-    assert r["by_op"]["all-gather"]["full_bytes"] == 100 + 4096
-    assert r["target_seq"] == 4096 and r["probe_seqs"] == [256, 512]
-    assert r["seq_dependence_fraction"] > 0
+    assert seen["n"] == 8 and seen["seq"] == 512
+    # probes run at the SMALL vocabs (the big one would emit whiles)...
+    assert set(vocabs_probed) == {16384, 32768}
+    # ...and the vocab extrapolation recovers bytes at V=128256
+    # (the fake is linear: 100 + 2V + seq)
+    assert r["by_op"]["all-gather"]["full_bytes"] == 100 + 2 * 128256 + 512
+    assert r["probe_vocabs"] == [16384, 32768]
+    assert r["probe_seq"] == 512
+    assert r["token_dependent_share"] == 0.0  # fake has no all-to-all
 
     seen2 = {}
 
