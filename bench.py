@@ -769,9 +769,10 @@ def _project_llama3_8b(args, models, cache):
     try:
         # probes run at batch_per_chip=1 x seq 512 (larger shapes
         # re-trigger the windowed-einsum while loops); FSDP traffic is
-        # parameter-shaped, so the bytes transfer to the 16k-token step
-        # within token_dependent_share (~3e-5) — see the analyzer's
-        # docstring for why a cross-seq extrapolation was rejected
+        # parameter-shaped, so holding bytes constant to the 16k-token
+        # step understates comm by ~32x token_dependent_share (~0.2%
+        # of total) — see the analyzer's docstring for why a cross-seq
+        # extrapolation was rejected
         bytes_a = sp.cached_analysis(
             cache, "llama3_8b_bytes", sp.analyze_llama3_8b_bytes,
             fingerprint=fp, n=8, batch_per_chip=1, grad_dtype="bf16")

@@ -33,13 +33,28 @@ from jax import lax
 _MASK = -1.0e30
 
 
-def _varying(x, axis_name):
-    """Mark a constant as device-varying over ``axis_name`` so shard_map's
-    VMA check accepts it as a scan carry alongside varying operands."""
+def _varying(x, axes):
+    """Mark a constant as device-varying over ``axes`` (a name or tuple
+    of names) so shard_map's VMA check accepts it as a scan carry
+    alongside varying operands."""
+    if isinstance(axes, str):
+        axes = (axes,)
     try:
-        return lax.pcast(x, (axis_name,), to="varying")
+        return lax.pcast(x, tuple(axes), to="varying")
     except (AttributeError, TypeError):  # older jax
-        return lax.pvary(x, (axis_name,))
+        return lax.pvary(x, tuple(axes))
+
+
+def _operand_vma(*arrays):
+    """Union of the varying-manual-axes of the operands (empty when VMA
+    tracking is unavailable or nothing varies)."""
+    axes: set = set()
+    for a in arrays:
+        try:
+            axes |= set(jax.typeof(a).vma)
+        except Exception:  # noqa: BLE001 - older jax: no vma tracking
+            pass
+    return tuple(sorted(axes))
 
 
 def _block_scores(q, k, q_pos, k_pos, scale, causal):
@@ -117,6 +132,14 @@ def local_flash_attention(q, k, v, q_positions=None, kv_positions=None,
     init = (jnp.zeros((B, Hkv, G, T, Dh), jnp.float32),
             jnp.full((B, Hkv, G, T), _MASK, jnp.float32),
             jnp.zeros((B, Hkv, G, T), jnp.float32))
+    # under shard_map any device-varying operand (sharded Q, gathered
+    # K/V, positions) makes the scan's carry OUTPUT varying; the
+    # constant init must be marked varying over the UNION of those axes
+    # or the VMA check rejects the scan (allgather_kv_attention with
+    # block_size inside shard_map — either side may be the varying one)
+    vma = _operand_vma(q, k, v, q_positions, kv_positions)
+    if vma:
+        init = tuple(_varying(a, vma) for a in init)
     (o, m, l), _ = lax.scan(
         body, init,
         (jnp.moveaxis(kb, 1, 0), jnp.moveaxis(vb, 1, 0), pb))
