@@ -88,6 +88,7 @@ void AccumT(T* dst, const T* src, int64_t n) {
 
 #if defined(__x86_64__) || defined(__i386__)
 #define HVDTPU_X86_SIMD 1
+#include <cpuid.h>
 #include <immintrin.h>
 
 // 8-wide fp16 accumulate: convert to fp32 (F16C), add, convert back.
@@ -143,8 +144,17 @@ void AccumBF16Simd(uint16_t* dst, const uint16_t* src, int64_t n) {
 
 bool CpuHasF16C() {
 #ifdef HVDTPU_X86_SIMD
+#if defined(__GNUC__) && !defined(__clang__) && __GNUC__ < 11
+  // gcc 10's __builtin_cpu_supports has no "f16c" — probe CPUID leaf 1
+  // ECX bit 29 directly
+  static bool ok = __builtin_cpu_supports("avx2") && [] {
+    unsigned a = 0, b = 0, c = 0, d = 0;
+    return __get_cpuid(1, &a, &b, &c, &d) && (c & (1u << 29));
+  }();
+#else
   static bool ok = __builtin_cpu_supports("avx2") &&
                    __builtin_cpu_supports("f16c");
+#endif
   return ok;
 #else
   return false;
@@ -283,6 +293,7 @@ class Engine {
   // inferred from exploration logs.
   bool Hierarchical() const { return hierarchical_allreduce_.load(); }
   bool AutotuneConverged() const { return pm_.Converged(); }
+  int64_t StallEvents() const { return stall_events_.load(); }
 
  private:
   void BackgroundLoop();
@@ -344,6 +355,10 @@ class Engine {
   // so the hvd_hierarchical diagnostic API may read it from any thread
   std::atomic<bool> hierarchical_allreduce_{false};
   bool hierarchical_allgather_ = false;
+  // stall warnings issued by the coordinator's StallCheck (rank 0 only;
+  // one per stalled tensor name); atomic so hvd_stall_events may read it
+  // from the Python diagnostics path while the bg loop counts
+  std::atomic<int64_t> stall_events_{0};
 
   // persistent data-plane scratch (background thread only): fusion buffer
   // kept across responses instead of a malloc per fused response (ref
@@ -1197,6 +1212,7 @@ void Engine::StallCheck() {
       os << "] — possible stall (one rank may have skipped this op)";
       LogWarn(os.str());
       neg.stall_warned = true;
+      stall_events_.fetch_add(1, std::memory_order_relaxed);
     }
   }
 }
@@ -2059,6 +2075,13 @@ int hvd_hierarchical() {
 
 int hvd_autotune_converged() {
   return g_engine ? (g_engine->AutotuneConverged() ? 1 : 0) : -1;
+}
+
+// Count of negotiation-stall warnings the coordinator has issued (rank 0
+// owns the stall check; other ranks report 0).  Python mirrors this into
+// the telemetry registry so stalls are queryable, not just stderr noise.
+int64_t hvd_stall_events() {
+  return g_engine ? g_engine->StallEvents() : -1;
 }
 
 // Diagnostic: standalone throughput (GB/s of dst bytes) of the in-place

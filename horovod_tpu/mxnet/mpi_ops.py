@@ -15,6 +15,7 @@ actionable ImportError.
 from __future__ import annotations
 
 from horovod_tpu import _auto_name as _name
+from horovod_tpu import telemetry as _telemetry
 from horovod_tpu.runtime import state as _state
 
 
@@ -36,11 +37,15 @@ def _run(kind: str, tensor, name: str, root_rank: int = 0):
     arr = tensor.asnumpy() if is_nd else np.asarray(tensor)
     eng = _state.engine()
     if kind == "allreduce":
-        out = eng.synchronize(eng.allreduce_async(arr, name))
+        handle = eng.allreduce_async(arr, name)
     elif kind == "allgather":
-        out = eng.synchronize(eng.allgather_async(arr, name))
+        handle = eng.allgather_async(arr, name)
     else:
-        out = eng.synchronize(eng.broadcast_async(arr, root_rank, name))
+        handle = eng.broadcast_async(arr, root_rank, name)
+    # time only the wait (not the submit) so the histogram means the
+    # same thing in every frontend: time blocked on the handle
+    with _telemetry.wait_timer("mxnet"):
+        out = eng.synchronize(handle)
     if kind != "allgather":
         # the wire flattens scalars to 1-element vectors; restore
         out = out.reshape(arr.shape)

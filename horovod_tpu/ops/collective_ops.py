@@ -27,6 +27,25 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from horovod_tpu import telemetry as _telemetry
+
+
+def _ledger(op: str, tensors) -> None:
+    """Trace-time logical-collective ledger.  Shapes are static under
+    ``jit``, so per-trace byte counts are exact; re-traces (new shape
+    signatures) re-count, executions of a cached trace do not — this
+    measures what the program *asks* the compiler to move, the compiled
+    twin of the eager engine's per-op byte counters."""
+    if not _telemetry.metrics_enabled():
+        return
+    nbytes = 0
+    for t in tensors:
+        try:
+            nbytes += int(t.size) * t.dtype.itemsize
+        except (AttributeError, TypeError):
+            pass  # abstract/dynamic dims: count the op, skip its bytes
+    _telemetry.record_compiled_collective(op, nbytes=nbytes)
+
 
 def axis_size(axis_name: str) -> int:
     return lax.axis_size(axis_name)
@@ -61,6 +80,7 @@ def axis_rank(axis_name: str):
 
 def allreduce(tensor, axis_name: str, average: bool = True, op: str = "sum"):
     """Sum (or average/min/max) across the named axis via ``psum``/``pmin``/…"""
+    _ledger("allreduce", [tensor])
     if op == "sum":
         out = lax.psum(tensor, axis_name)
         if average:
@@ -116,11 +136,18 @@ def grouped_allreduce(tensors, axis_name: str, average: bool = True,
     flat, treedef = jax.tree.flatten(tensors)
     local_flags = [is_rank_local(t, axis_name) for t in flat]
     to_reduce = [t for t, loc in zip(flat, local_flags) if loc is not False]
+    _ledger("grouped_allreduce", to_reduce)
+    record_fill = _telemetry.metrics_enabled()
     reduced = []
     bucket, used = [], 0
     def flush():
         nonlocal bucket, used
         if bucket:
+            if record_fill:
+                # bucket-fill fraction: how close each emitted all-reduce
+                # gets to the fusion threshold — persistently low fill means
+                # the threshold is oversized for this model's leaves
+                _telemetry.record_fusion_bucket(used, bucket_bytes)
             out = lax.psum(tuple(bucket), axis_name)
             if average:
                 n = lax.axis_size(axis_name)

@@ -46,8 +46,22 @@ def main(argv=None) -> int:
                     help="index of this host in --hosts")
     ap.add_argument("--rendezvous-port", type=int, default=None)
     ap.add_argument("--start-timeout", type=float, default=120.0)
+    ap.add_argument("--timeline", default=None, metavar="PATH",
+                    help="record Chrome-trace timelines (sets "
+                         "HOROVOD_TIMELINE for every worker; rank 0's "
+                         "native engine writes PATH, Python engines write "
+                         "PATH.pyrank<r>; merge with `python -m "
+                         "horovod_tpu.telemetry merge-timelines`)")
+    ap.add_argument("--metrics-dir", default=None, metavar="DIR",
+                    help="enable the metrics registry with periodic "
+                         "per-rank dumps into DIR (sets "
+                         "HOROVOD_TPU_METRICS_DIR; summarize with "
+                         "`python -m horovod_tpu.telemetry summarize DIR`)")
     ap.add_argument("command", nargs=argparse.REMAINDER)
     args = ap.parse_args(argv)
+
+    if args.metrics_dir:
+        os.makedirs(args.metrics_dir, exist_ok=True)
 
     if not args.command:
         ap.error("no command given")
@@ -116,6 +130,10 @@ def main(argv=None) -> int:
             # native engine bounds its rendezvous connect/accept by this
             "HOROVOD_TPU_START_TIMEOUT": str(int(args.start_timeout)),
         })
+        if args.timeline:
+            env["HOROVOD_TIMELINE"] = args.timeline
+        if args.metrics_dir:
+            env["HOROVOD_TPU_METRICS_DIR"] = args.metrics_dir
         # each worker leads its own process group so a stuck worker's whole
         # subtree can be killed
         procs.append(subprocess.Popen(cmd, env=env, start_new_session=True))

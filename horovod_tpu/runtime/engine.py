@@ -20,6 +20,7 @@ to a completion slot; ``poll`` and ``synchronize`` query it.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Callable
 
 import numpy as np
@@ -29,36 +30,42 @@ _AVG = "avg"
 
 
 class HandleManager:
-    """int handle -> (done, result, error) with mutex, reference-style."""
+    """int handle -> (done, result, error), reference-style.
+
+    Completion is signaled through a condition variable: ``wait`` sleeps
+    until ``mark_done`` notifies, so synchronize latency is wakeup-bound
+    (the reference's own handle_manager blocks on a cv too) rather than
+    bound by a poll interval, and ``wait(timeout=0)`` is a non-blocking
+    probe that raises immediately when the op is still in flight.
+    """
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._cv = threading.Condition()
         self._next = 0
         self._results: dict[int, tuple[bool, Any, Exception | None]] = {}
 
     def allocate(self) -> int:
-        with self._lock:
+        with self._cv:
             handle = self._next
             self._next += 1
             self._results[handle] = (False, None, None)
             return handle
 
     def mark_done(self, handle: int, result: Any = None, error: Exception | None = None):
-        with self._lock:
+        with self._cv:
             self._results[handle] = (True, result, error)
+            self._cv.notify_all()
 
     def poll(self, handle: int) -> bool:
-        with self._lock:
+        with self._cv:
             if handle not in self._results:
                 raise ValueError(f"unknown handle {handle}")
             return self._results[handle][0]
 
     def wait(self, handle: int, timeout: float | None = None) -> Any:
-        import time
-
         deadline = None if timeout is None else time.monotonic() + timeout
-        while True:
-            with self._lock:
+        with self._cv:
+            while True:
                 if handle not in self._results:
                     raise ValueError(f"unknown handle {handle}")
                 done, result, error = self._results[handle]
@@ -67,9 +74,12 @@ class HandleManager:
                     if error is not None:
                         raise error
                     return result
-            if deadline is not None and time.monotonic() > deadline:
-                raise TimeoutError(f"handle {handle} not complete")
-            time.sleep(0.0005)
+                if deadline is None:
+                    self._cv.wait()
+                else:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0 or not self._cv.wait(remaining):
+                        raise TimeoutError(f"handle {handle} not complete")
 
 
 class Engine:
@@ -82,23 +92,32 @@ class Engine:
         # handles whose results the frontend must divide by world size;
         # engine-scoped so ids can't leak across shutdown()/init() cycles
         self.average_handles: set[int] = set()
+        # span/counter recording for every engine (this base class included):
+        # wraps the instance's *_async submits and synchronize when metrics
+        # or a timeline are configured; installs nothing when disabled, so
+        # the hot path stays at its original cost
+        from horovod_tpu import telemetry
+
+        telemetry.instrument_engine(self)
 
     # -- sync API ----------------------------------------------------------
+    # (routed through self.synchronize, not handles.wait directly, so the
+    # telemetry wrapper sees completions from the sync variants too)
     def allreduce(self, array: np.ndarray, name: str, op: str = _SUM,
                   out: np.ndarray | None = None) -> np.ndarray:
-        return self.handles.wait(self.allreduce_async(array, name, op,
-                                                      out=out))
+        return self.synchronize(self.allreduce_async(array, name, op,
+                                                     out=out))
 
     def allgather(self, array: np.ndarray, name: str) -> np.ndarray:
-        return self.handles.wait(self.allgather_async(array, name))
+        return self.synchronize(self.allgather_async(array, name))
 
     def broadcast(self, array: np.ndarray, root_rank: int, name: str,
                   out: np.ndarray | None = None) -> np.ndarray:
-        return self.handles.wait(
+        return self.synchronize(
             self.broadcast_async(array, root_rank, name, out=out))
 
     def alltoall(self, array: np.ndarray, name: str) -> np.ndarray:
-        return self.handles.wait(self.alltoall_async(array, name))
+        return self.synchronize(self.alltoall_async(array, name))
 
     # -- async API (must be implemented) -----------------------------------
     # `out` (allreduce/broadcast): caller-owned result buffer of the

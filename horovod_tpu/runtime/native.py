@@ -159,6 +159,12 @@ def _bind(lib):
     lib.hvd_topology.restype = None
     lib.hvd_hierarchical.restype = ctypes.c_int
     lib.hvd_autotune_converged.restype = ctypes.c_int
+    try:
+        # added after the first release; a prebuilt .so pointed at via
+        # HOROVOD_TPU_NATIVE_LIB may predate it
+        lib.hvd_stall_events.restype = ctypes.c_int64
+    except AttributeError:
+        pass
     return lib
 
 
@@ -214,16 +220,61 @@ class NativeEngine(Engine):
                 f"{topology.size}, rendezvous {host}:{port})"
             )
         self._lib = lib
+        self._register_diagnostics_collector()
 
     def diagnostics(self) -> dict:
-        """Engine introspection: the allreduce algorithm currently in use
-        and whether this rank's autotuner finished its search (rank 0
-        owns the search) — lets tests assert the tuner's converged
-        decision directly."""
+        """Engine introspection: the allreduce algorithm currently in use,
+        whether this rank's autotuner finished its search (rank 0 owns the
+        search), and how many negotiation stalls the coordinator has warned
+        about — lets tests and monitors assert these directly instead of
+        scraping stderr."""
         return {
             "hierarchical": int(self._lib.hvd_hierarchical()),
             "autotune_converged": int(self._lib.hvd_autotune_converged()),
+            "stall_events": self._stall_events(),
         }
+
+    def _stall_events(self) -> int:
+        """Coordinator stall-warning count (rank 0 owns the check; other
+        ranks report 0).  0 when the loaded .so predates the counter."""
+        fn = getattr(self._lib, "hvd_stall_events", None)
+        if fn is None:
+            return 0
+        return max(int(fn()), 0)  # -1 = engine down
+
+    def _register_diagnostics_collector(self) -> None:
+        """Mirror the C engine's diagnostics into the telemetry registry so
+        metric dumps / Prometheus scrapes carry them without a Python-side
+        poll loop — the registry runs collectors before each export."""
+        from horovod_tpu import telemetry
+
+        if not telemetry.metrics_enabled():
+            return
+        reg = telemetry.registry()
+        # serializes the read-then-inc: the dump thread and a direct
+        # collector() call (shutdown, user snapshot) may race, and both
+        # seeing the same stale value would double-count a stall
+        mirror_lock = threading.Lock()
+        # per-ENGINE last-seen count, not a diff against the registry
+        # counter: the registry outlives shutdown()/init() cycles, and a
+        # fresh engine restarting at 0 must not mask its first stalls
+        # behind the previous engine's total
+        last_seen = [0]
+
+        def collect(self=self, reg=reg):
+            d = self.diagnostics()
+            reg.gauge(telemetry.NATIVE_HIERARCHICAL).set(
+                max(d["hierarchical"], 0))
+            reg.gauge(telemetry.NATIVE_AUTOTUNE_CONVERGED).set(
+                max(d["autotune_converged"], 0))
+            with mirror_lock:
+                delta = d["stall_events"] - last_seen[0]
+                if delta > 0:
+                    reg.counter(telemetry.NATIVE_STALL_EVENTS).inc(delta)
+                    last_seen[0] = d["stall_events"]
+
+        self._diagnostics_collector = collect
+        reg.register_collector(collect)
 
     def local_topology(self) -> tuple[int, int, int, int]:
         """(local_rank, local_size, cross_rank, cross_size) from the
@@ -362,4 +413,13 @@ class NativeEngine(Engine):
         return self.synchronize(self.alltoall_async(array, name))
 
     def shutdown(self) -> None:
+        collector = getattr(self, "_diagnostics_collector", None)
+        if collector is not None:
+            from horovod_tpu import telemetry
+
+            # final mirror while the engine is still up, then detach so the
+            # dump thread never polls a dead engine
+            collector()
+            telemetry.registry().unregister_collector(collector)
+            self._diagnostics_collector = None
         self._lib.hvd_native_shutdown()

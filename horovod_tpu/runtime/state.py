@@ -94,12 +94,31 @@ def init(comm=None) -> None:
         _state.topology = topology
         _state.engine = engine
         _state.initialized = True
+    # after the lock: the dump thread may itself call rank-reading APIs.
+    # Processes outside an active sub-communicator (rank -1, no engine)
+    # start no dumper — a rank0-named dump from them would clobber the
+    # real rank 0's file.
+    if topology.size > 0:
+        from horovod_tpu import telemetry
+
+        telemetry.on_init(topology.rank)
 
 
 def shutdown() -> None:
     with _state.lock:
         if not _state.initialized:
             return
+    from horovod_tpu import telemetry
+
+    # final metrics dump + timeline close (writes the trailing bracket so
+    # the trace file is strict JSON after a clean shutdown) BEFORE the
+    # engine goes down: the dump thread's collector calls the native
+    # engine's C getters, which read g_engine unsynchronized — a dump
+    # racing hvd_native_shutdown would be a use-after-free
+    telemetry.on_shutdown()
+    with _state.lock:
+        if not _state.initialized:
+            return  # concurrent shutdown finished first
         if _state.engine is not None:
             _state.engine.shutdown()
         _state.engine = None

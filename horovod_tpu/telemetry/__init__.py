@@ -1,0 +1,299 @@
+"""Unified telemetry layer: metrics registry + Python-path timeline.
+
+The reference system's observability is a Chrome-trace timeline plus stderr
+stall warnings, both living in the native background loop.  This package is
+the engine-agnostic superset:
+
+* :mod:`horovod_tpu.telemetry.registry` — process-local counters / gauges /
+  fixed-bucket histograms with JSON + Prometheus export and periodic
+  per-rank dumps to ``HOROVOD_TPU_METRICS_DIR``.
+* :mod:`horovod_tpu.telemetry.timeline` — a Python-side Chrome-trace writer
+  with the same event schema as ``csrc/timeline.cc``, honoring
+  ``HOROVOD_TIMELINE``, so pure-Python engine runs trace too.
+* ``python -m horovod_tpu.telemetry`` — cross-rank merge/summary CLI
+  (per-op p50/p99, bytes, rank skew; timeline merging).
+
+Enablement:
+
+* metrics: ``HOROVOD_TPU_METRICS=1`` or any ``HOROVOD_TPU_METRICS_DIR``.
+* timeline: ``HOROVOD_TIMELINE=/path`` (or ``HOROVOD_TPU_TIMELINE``).
+
+When neither is set the instrumentation hooks install **nothing**: engines
+run with their original unwrapped methods and frontends take a shared no-op
+context manager, so the disabled-mode overhead is one cached boolean check
+at setup points (asserted by ``tests/test_telemetry.py``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import time
+
+from horovod_tpu.telemetry import timeline
+from horovod_tpu.telemetry.registry import (  # noqa: F401  (re-exports)
+    Counter,
+    Gauge,
+    Histogram,
+    LATENCY_BUCKETS,
+    MetricsDumper,
+    MetricsRegistry,
+    RATIO_BUCKETS,
+    percentile_from_buckets,
+)
+
+# -- metric catalog (names shared with docs/observability.md and the CLI) ---
+EAGER_OPS_TOTAL = "hvdtpu_eager_ops_total"
+EAGER_BYTES_TOTAL = "hvdtpu_eager_bytes_total"
+EAGER_INFLIGHT = "hvdtpu_eager_inflight"
+EAGER_OP_LATENCY = "hvdtpu_eager_op_latency_seconds"
+HANDLE_WAIT = "hvdtpu_handle_wait_seconds"
+COMPILED_OPS_TOTAL = "hvdtpu_compiled_collectives_total"
+COMPILED_BYTES_TOTAL = "hvdtpu_compiled_bytes_total"
+FUSION_BUCKETS_TOTAL = "hvdtpu_fusion_buckets_total"
+FUSION_BUCKET_FILL = "hvdtpu_fusion_bucket_fill_ratio"
+NATIVE_HIERARCHICAL = "hvdtpu_native_hierarchical"
+NATIVE_AUTOTUNE_CONVERGED = "hvdtpu_native_autotune_converged"
+NATIVE_STALL_EVENTS = "hvdtpu_native_stall_events_total"
+
+_TRUTHY = ("1", "true", "yes", "on")
+
+_registry = MetricsRegistry()
+_lock = threading.Lock()
+_metrics_resolved = False
+_metrics_on = False
+_dumper: MetricsDumper | None = None
+
+
+def registry() -> MetricsRegistry:
+    """The process-global metrics registry (always usable; whether the
+    framework *feeds* it is governed by :func:`metrics_enabled`)."""
+    return _registry
+
+
+def metrics_enabled() -> bool:
+    """Cached enablement check — the only thing disabled-mode paths pay."""
+    global _metrics_resolved, _metrics_on
+    if not _metrics_resolved:
+        with _lock:
+            if not _metrics_resolved:
+                env = os.environ.get("HOROVOD_TPU_METRICS", "").lower()
+                _metrics_on = env in _TRUTHY or bool(
+                    os.environ.get("HOROVOD_TPU_METRICS_DIR"))
+                _metrics_resolved = True
+    return _metrics_on
+
+
+def set_metrics_enabled(value: bool) -> None:
+    """Programmatic override (tests, notebooks)."""
+    global _metrics_resolved, _metrics_on
+    with _lock:
+        _metrics_on = bool(value)
+        _metrics_resolved = True
+
+
+def reset() -> None:
+    """Drop all telemetry state and re-read the environment on next use.
+    Test plumbing — production code never needs this."""
+    global _metrics_resolved, _dumper
+    with _lock:
+        if _dumper is not None:
+            _dumper.stop(final_dump=False)
+            _dumper = None
+        _registry.clear()
+        _metrics_resolved = False
+    timeline.close()
+
+
+# ---------------------------------------------------------------------------
+# Lifecycle (called by runtime.state.init/shutdown)
+# ---------------------------------------------------------------------------
+
+def on_init(rank: int) -> None:
+    """Start the periodic per-rank dump thread when a metrics dir is set."""
+    global _dumper
+    directory = os.environ.get("HOROVOD_TPU_METRICS_DIR")
+    if not directory or not metrics_enabled():
+        return
+    # key dump files by the GLOBAL launcher rank when one exists: a
+    # sub-communicator init() re-bases `rank` per sub-world, and two
+    # sub-world rank 0s in one job would clobber each other's
+    # metrics.rank0.json (the timeline writer names files the same way)
+    from horovod_tpu.utils.topo import _RANK_ENV, _env_int
+
+    global_rank = _env_int(_RANK_ENV)
+    if global_rank is None:
+        global_rank = rank
+    with _lock:
+        if _dumper is None:
+            interval = float(
+                os.environ.get("HOROVOD_TPU_METRICS_INTERVAL", "30"))
+            _dumper = MetricsDumper(_registry, directory, global_rank,
+                                    interval)
+
+
+def on_shutdown() -> None:
+    """Final dump + stop the dumper; finalize the Python timeline file."""
+    global _dumper
+    with _lock:
+        if _dumper is not None:
+            _dumper.stop(final_dump=True)
+            _dumper = None
+    timeline.close()
+
+
+# ---------------------------------------------------------------------------
+# Engine instrumentation (installed once per engine when telemetry is on)
+# ---------------------------------------------------------------------------
+
+def instrument_engine(engine) -> bool:
+    """Wrap ``engine``'s async-submit and synchronize methods with span and
+    counter recording.  Returns True if anything was installed.
+
+    Records per op: submit count, input bytes, in-flight gauge, submit→done
+    latency histogram, and a timeline span on the tensor's lane from submit
+    to completion.  When telemetry is fully disabled this returns without
+    touching the engine — the zero-overhead contract.
+    """
+    tl = timeline.get()
+    reg = _registry if metrics_enabled() else None
+    if tl is None and reg is None:
+        return False
+
+    pending: dict[int, tuple[float, str, str]] = {}
+    plock = threading.Lock()
+    inflight = reg.gauge(EAGER_INFLIGHT) if reg is not None else None
+
+    def _submit(op: str, name: str, array, handle: int) -> None:
+        now = time.monotonic()
+        if reg is not None:
+            nbytes = getattr(array, "nbytes", 0)
+            reg.counter(EAGER_OPS_TOTAL, op=op).inc()
+            reg.counter(EAGER_BYTES_TOTAL, op=op).inc(nbytes)
+            inflight.inc()
+        if tl is not None and not tl.closed:
+            tl.begin(name, op.upper())
+        with plock:
+            pending[handle] = (now, op, name)
+
+    def _done(handle: int) -> None:
+        with plock:
+            info = pending.pop(handle, None)
+        if info is None:
+            return
+        t0, op, name = info
+        if reg is not None:
+            reg.histogram(EAGER_OP_LATENCY, op=op).observe(
+                time.monotonic() - t0)
+            inflight.dec()
+        if tl is not None and not tl.closed:
+            tl.end(name)
+
+    def wrap_submit(op: str, orig, name_pos: int):
+        def wrapped(*args, **kwargs):
+            handle = orig(*args, **kwargs)
+            name = kwargs.get("name") if "name" in kwargs else (
+                args[name_pos] if len(args) > name_pos else "?")
+            _submit(op, str(name), args[0] if args else None, handle)
+            return handle
+        wrapped.__name__ = orig.__name__
+        return wrapped
+
+    # (op label, method, positional index of `name` in the *_async signature)
+    engine.allreduce_async = wrap_submit(
+        "allreduce", engine.allreduce_async, 1)
+    engine.allgather_async = wrap_submit(
+        "allgather", engine.allgather_async, 1)
+    engine.broadcast_async = wrap_submit(
+        "broadcast", engine.broadcast_async, 2)
+    engine.alltoall_async = wrap_submit(
+        "alltoall", engine.alltoall_async, 1)
+
+    orig_sync = engine.synchronize
+
+    def synchronize(handle: int, timeout: float | None = None):
+        try:
+            result = orig_sync(handle, timeout)
+        except TimeoutError:
+            raise  # still in flight — keep the span open for the retry
+        except Exception:
+            _done(handle)
+            raise
+        _done(handle)
+        return result
+
+    engine.synchronize = synchronize
+    engine._telemetry_instrumented = True
+    return True
+
+
+# ---------------------------------------------------------------------------
+# Frontend wait timing (torch/tensorflow/mxnet synchronize paths)
+# ---------------------------------------------------------------------------
+
+_NULL_TIMER = contextlib.nullcontext()
+
+
+class _WaitTimer:
+    __slots__ = ("_hist", "_t0")
+
+    def __init__(self, hist: Histogram) -> None:
+        self._hist = hist
+
+    def __enter__(self):
+        self._t0 = time.monotonic()
+        return self
+
+    def __exit__(self, *exc):
+        self._hist.observe(time.monotonic() - self._t0)
+        return False
+
+
+def wait_timer(frontend: str):
+    """Context manager timing a frontend's handle wait into the
+    ``hvdtpu_handle_wait_seconds{frontend=...}`` histogram; a shared no-op
+    when metrics are disabled."""
+    if not metrics_enabled():
+        return _NULL_TIMER
+    return _WaitTimer(_registry.histogram(HANDLE_WAIT, frontend=frontend))
+
+
+# ---------------------------------------------------------------------------
+# Compiled-path (trace-time) ledger
+# ---------------------------------------------------------------------------
+
+def record_compiled_collective(op: str, nbytes: int = 0,
+                               count: int = 1) -> None:
+    """Ledger entry for a logical collective on the compiled path.  Shapes
+    are static at trace time, so byte counts are exact; callers guard with
+    :func:`metrics_enabled` to keep the disabled path allocation-free."""
+    _registry.counter(COMPILED_OPS_TOTAL, op=op).inc(count)
+    if nbytes:
+        _registry.counter(COMPILED_BYTES_TOTAL, op=op).inc(nbytes)
+
+
+def record_fusion_bucket(used_bytes: int, capacity_bytes: int) -> None:
+    """One grouped-allreduce bucket flushed: track how full it was."""
+    _registry.counter(FUSION_BUCKETS_TOTAL).inc()
+    if capacity_bytes > 0:
+        fill = min(used_bytes / capacity_bytes, 1.0)
+        _registry.histogram(FUSION_BUCKET_FILL,
+                            bounds=RATIO_BUCKETS).observe(fill)
+
+
+__all__ = [
+    "registry", "metrics_enabled", "set_metrics_enabled", "reset",
+    "on_init", "on_shutdown",
+    "instrument_engine", "wait_timer",
+    "record_compiled_collective", "record_fusion_bucket",
+    "timeline",
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "MetricsDumper",
+    "LATENCY_BUCKETS", "RATIO_BUCKETS", "percentile_from_buckets",
+    "EAGER_OPS_TOTAL", "EAGER_BYTES_TOTAL", "EAGER_INFLIGHT",
+    "EAGER_OP_LATENCY", "HANDLE_WAIT",
+    "COMPILED_OPS_TOTAL", "COMPILED_BYTES_TOTAL",
+    "FUSION_BUCKETS_TOTAL", "FUSION_BUCKET_FILL",
+    "NATIVE_HIERARCHICAL", "NATIVE_AUTOTUNE_CONVERGED",
+    "NATIVE_STALL_EVENTS",
+]

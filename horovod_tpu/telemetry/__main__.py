@@ -1,0 +1,94 @@
+"""``python -m horovod_tpu.telemetry`` — merge/summary CLI.
+
+Subcommands:
+
+* ``summarize <metrics-dir>`` — join every ``metrics.rank*.json`` dump in a
+  directory into one cross-rank report: per-op count / bytes / p50 / p99 and
+  a rank-skew column, frontend handle-wait percentiles, the compiled-path
+  ledger, fusion-bucket fill, and native stall/autotune diagnostics.
+  ``--steps N`` adds a bytes/step column; ``--prom`` emits the merged
+  counters in Prometheus text format instead of the table.
+* ``merge-timelines -o merged.json <trace...>`` — join per-rank Chrome
+  traces (native rank-0 file + Python ``.pyrank<r>`` files) into a single
+  Perfetto-loadable trace with one pid per rank.
+
+Pure Python over JSON files: runs anywhere, no native ``.so``, no JAX.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m horovod_tpu.telemetry",
+        description="merge and summarize per-rank telemetry")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    ap_sum = sub.add_parser(
+        "summarize", help="cross-rank report over a metrics dump directory")
+    ap_sum.add_argument("metrics_dir")
+    ap_sum.add_argument("--steps", type=int, default=None,
+                        help="training steps covered, for bytes/step")
+    ap_sum.add_argument("--prom", action="store_true",
+                        help="emit merged counters as Prometheus text")
+
+    ap_mt = sub.add_parser(
+        "merge-timelines", help="join per-rank Chrome traces into one file")
+    ap_mt.add_argument("traces", nargs="+")
+    ap_mt.add_argument("-o", "--output", required=True)
+
+    args = ap.parse_args(argv)
+    from horovod_tpu.telemetry import merge
+
+    if args.cmd == "summarize":
+        try:
+            if args.prom:
+                print(_merged_prometheus(args.metrics_dir), end="")
+            else:
+                print(merge.summarize(args.metrics_dir, steps=args.steps))
+        except FileNotFoundError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        return 0
+
+    # merge-timelines
+    n = merge.merge_timelines(args.traces, args.output)
+    print(f"wrote {n} events from {len(args.traces)} trace(s) "
+          f"to {args.output}")
+    return 0
+
+
+def _merged_prometheus(metrics_dir: str) -> str:
+    """Cross-rank dumps re-emitted as Prometheus text with a rank label —
+    what a sidecar exporter would scrape-convert."""
+    from horovod_tpu.telemetry import MetricsRegistry
+    from horovod_tpu.telemetry.merge import load_metric_dumps
+
+    reg = MetricsRegistry()
+    for doc in load_metric_dumps(metrics_dir):
+        rank = str(doc["rank"])
+        for m in doc.get("metrics", []):
+            labels = dict(m.get("labels", {}), rank=rank)
+            if m["type"] == "counter":
+                reg.counter(m["name"], **labels).inc(m["value"])
+            elif m["type"] == "gauge":
+                reg.gauge(m["name"], **labels).set(m["value"])
+            else:
+                h = reg.histogram(m["name"], bounds=tuple(m["bounds"]),
+                                  **labels)
+                # splice the dumped buckets in directly: re-observing one
+                # sample per count would loop per-observation (millions in a
+                # long run) for an identical result
+                with h._lock:
+                    h._counts = [a + b for a, b in
+                                 zip(h._counts, m["counts"])]
+                    h._sum += m["sum"]
+                    h._count += m["count"]
+    return reg.to_prometheus()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,286 @@
+"""Cross-rank merge tooling: join per-rank metric dumps and timelines.
+
+Per-rank inputs come from two producers:
+
+* ``MetricsRegistry.dump`` files (``metrics.rank<r>.json``) written
+  periodically when ``HOROVOD_TPU_METRICS_DIR`` is set;
+* Chrome-trace timelines — the native engine's rank-0 file plus the Python
+  writers' ``.pyrank<r>`` files.
+
+Counters merge by summation, gauges by per-rank listing (max reported),
+histograms by element-wise bucket-count summation — which is exactly why the
+registry uses fixed buckets: a merged p50/p99 is computable without ever
+shipping raw samples.  Rank skew is reported as ``(max - min) / mean`` of a
+metric's per-rank totals; a skew of 0 means perfectly balanced ranks, 1.0
+means one rank did a whole mean's worth more than another (straggler or
+missing-collective suspect).
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+
+from horovod_tpu.telemetry.registry import percentile_from_buckets
+
+
+# ---------------------------------------------------------------------------
+# metric dump loading/merging
+# ---------------------------------------------------------------------------
+
+def load_metric_dumps(directory: str) -> list[dict]:
+    """Load every ``metrics.rank*.json`` in ``directory``, sorted by rank."""
+    paths = glob.glob(os.path.join(directory, "metrics.rank*.json"))
+    if not paths:
+        raise FileNotFoundError(
+            f"no metrics.rank*.json files in {directory!r} — was the job "
+            "run with --metrics-dir / HOROVOD_TPU_METRICS_DIR?")
+    docs = []
+    for path in paths:
+        with open(path) as f:
+            doc = json.load(f)
+        if "rank" not in doc:
+            m = re.search(r"rank(\d+)", os.path.basename(path))
+            doc["rank"] = int(m.group(1)) if m else len(docs)
+        docs.append(doc)
+    docs.sort(key=lambda d: d["rank"])
+    return docs
+
+
+def _key(metric: dict) -> tuple:
+    return (metric["name"], tuple(sorted(metric.get("labels", {}).items())))
+
+
+def merge_metrics(docs: list[dict]) -> dict:
+    """Merge per-rank dumps into ``key -> merged`` where merged carries the
+    cross-rank total plus the per-rank series used for skew."""
+    merged: dict[tuple, dict] = {}
+    for doc in docs:
+        rank = doc["rank"]
+        for m in doc.get("metrics", []):
+            key = _key(m)
+            slot = merged.get(key)
+            if slot is None:
+                slot = merged[key] = {
+                    "name": m["name"],
+                    "labels": dict(m.get("labels", {})),
+                    "type": m["type"],
+                    "per_rank": {},
+                }
+                if m["type"] == "histogram":
+                    slot["bounds"] = list(m["bounds"])
+                    slot["counts"] = [0] * (len(m["bounds"]) + 1)
+                    slot["sum"] = 0.0
+                    slot["count"] = 0
+            if m["type"] == "histogram":
+                if m.get("bounds") != slot["bounds"]:
+                    continue  # bucket layouts differ; skip rather than lie
+                slot["counts"] = [a + b for a, b in
+                                  zip(slot["counts"], m["counts"])]
+                slot["sum"] += m["sum"]
+                slot["count"] += m["count"]
+                slot["per_rank"][rank] = m["count"]
+            else:
+                slot["per_rank"][rank] = m["value"]
+    for slot in merged.values():
+        if slot["type"] != "histogram":
+            slot["total"] = sum(slot["per_rank"].values())
+    return merged
+
+
+def rank_skew(per_rank: dict[int, float]) -> float:
+    """``(max - min) / mean`` over ranks; 0 for <2 ranks or zero mean."""
+    vals = list(per_rank.values())
+    if len(vals) < 2:
+        return 0.0
+    mean = sum(vals) / len(vals)
+    if mean == 0:
+        return 0.0
+    return (max(vals) - min(vals)) / mean
+
+
+def merged_percentile(slot: dict, q: float) -> float:
+    return percentile_from_buckets(
+        slot["bounds"], slot["counts"], slot["count"], q)
+
+
+# ---------------------------------------------------------------------------
+# summary report
+# ---------------------------------------------------------------------------
+
+def _fmt_bytes(n: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024
+    return f"{n:.1f}TiB"
+
+
+def _table(header: list[str], rows: list[list[str]]) -> str:
+    widths = [max(len(h), *(len(r[i]) for r in rows)) if rows else len(h)
+              for i, h in enumerate(header)]
+    out = ["  ".join(h.ljust(w) for h, w in zip(header, widths)).rstrip()]
+    out.append("  ".join("-" * w for w in widths))
+    for r in rows:
+        out.append("  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip())
+    return "\n".join(out)
+
+
+def summarize(directory: str, steps: int | None = None) -> str:
+    """Human-readable cross-rank report over a metrics dump directory."""
+    from horovod_tpu import telemetry as T
+
+    docs = load_metric_dumps(directory)
+    merged = merge_metrics(docs)
+    nranks = len(docs)
+    lines = [f"telemetry summary: {nranks} rank(s) from {directory}"]
+
+    def find(name: str, **labels) -> dict | None:
+        return merged.get((name, tuple(sorted(labels.items()))))
+
+    # -- eager per-op table --------------------------------------------------
+    ops = sorted({m["labels"]["op"] for m in merged.values()
+                  if m["name"] == T.EAGER_OPS_TOTAL})
+    rows = []
+    for op in ops:
+        count = find(T.EAGER_OPS_TOTAL, op=op)
+        nbytes = find(T.EAGER_BYTES_TOTAL, op=op)
+        lat = find(T.EAGER_OP_LATENCY, op=op)
+        skew_src = nbytes if nbytes and nbytes["total"] else count
+        row = [
+            op,
+            f"{int(count['total'])}" if count else "0",
+            _fmt_bytes(nbytes["total"]) if nbytes else "0B",
+            f"{merged_percentile(lat, 0.50) * 1e3:.3f}" if lat else "-",
+            f"{merged_percentile(lat, 0.99) * 1e3:.3f}" if lat else "-",
+            f"{rank_skew(skew_src['per_rank']):.2f}" if skew_src else "-",
+        ]
+        if steps:
+            row.append(_fmt_bytes((nbytes["total"] if nbytes else 0) / steps))
+        rows.append(row)
+    if rows:
+        header = ["op", "count", "bytes", "p50_ms", "p99_ms", "rank_skew"]
+        if steps:
+            header.append("bytes/step")
+        lines += ["", "eager collectives:", _table(header, rows)]
+
+    # -- frontend handle-wait table -----------------------------------------
+    fe_rows = []
+    for m in sorted(merged.values(), key=lambda s: str(s["labels"])):
+        if m["name"] != T.HANDLE_WAIT:
+            continue
+        fe_rows.append([
+            m["labels"].get("frontend", "?"),
+            f"{m['count']}",
+            f"{merged_percentile(m, 0.50) * 1e3:.3f}",
+            f"{merged_percentile(m, 0.99) * 1e3:.3f}",
+            f"{rank_skew(m['per_rank']):.2f}",
+        ])
+    if fe_rows:
+        lines += ["", "frontend handle waits:",
+                  _table(["frontend", "count", "p50_ms", "p99_ms",
+                          "rank_skew"], fe_rows)]
+
+    # -- compiled-path ledger -----------------------------------------------
+    comp_rows = []
+    for op in sorted({m["labels"]["op"] for m in merged.values()
+                      if m["name"] == T.COMPILED_OPS_TOTAL}):
+        count = find(T.COMPILED_OPS_TOTAL, op=op)
+        nbytes = find(T.COMPILED_BYTES_TOTAL, op=op)
+        comp_rows.append([
+            op,
+            f"{int(count['total'])}",
+            _fmt_bytes(nbytes["total"]) if nbytes else "0B",
+            f"{rank_skew(count['per_rank']):.2f}",
+        ])
+    if comp_rows:
+        lines += ["", "compiled-path logical collectives (trace-time):",
+                  _table(["op", "count", "bytes", "rank_skew"], comp_rows)]
+
+    fill = find(T.FUSION_BUCKET_FILL)
+    if fill and fill["count"]:
+        buckets = find(T.FUSION_BUCKETS_TOTAL)
+        lines.append(
+            f"fusion buckets: {int(buckets['total']) if buckets else 0} "
+            f"flushed, fill p50 {merged_percentile(fill, 0.5):.2f} / "
+            f"p99 {merged_percentile(fill, 0.99):.2f}")
+
+    # -- native engine diagnostics ------------------------------------------
+    stall = find(T.NATIVE_STALL_EVENTS)
+    if stall is not None:
+        lines.append(
+            f"native stall events: {int(stall['total'])} "
+            f"(per rank: { {r: int(v) for r, v in sorted(stall['per_rank'].items())} })")
+    hier = find(T.NATIVE_HIERARCHICAL)
+    conv = find(T.NATIVE_AUTOTUNE_CONVERGED)
+    if hier is not None or conv is not None:
+        lines.append(
+            "native engine: hierarchical="
+            f"{int(max(hier['per_rank'].values())) if hier else '-'} "
+            f"autotune_converged="
+            f"{int(max(conv['per_rank'].values())) if conv else '-'}")
+
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# timeline merging
+# ---------------------------------------------------------------------------
+
+def load_trace(path: str) -> list[dict]:
+    """Chrome-trace JSON array, tolerating the legally-unterminated form
+    both writers produce when a process dies mid-run."""
+    with open(path) as f:
+        text = f.read().strip()
+    if not text:
+        return []
+    try:
+        return json.loads(text)
+    except json.JSONDecodeError:
+        fixed = text.rstrip().rstrip(",")
+        if not fixed.endswith("]"):
+            fixed += "\n]"
+        return json.loads(fixed)
+
+
+def _rank_of(path: str, fallback: int) -> int:
+    m = re.search(r"rank(\d+)", os.path.basename(path))
+    return int(m.group(1)) if m else fallback
+
+
+def merge_timelines(paths: list[str], out_path: str) -> int:
+    """Join per-rank Chrome traces into one file with ``pid`` = rank, so
+    Perfetto shows one process group per rank.  Two traces from the same
+    rank (the native engine's file plus that rank's Python ``.pyrank<r>``
+    twin) get distinct pids — each writer allocates ``tid`` lanes in its own
+    first-sight order, so sharing a pid would cross-wire their lane-name
+    tables and span nesting.  Timestamps stay process-local (each writer's
+    monotonic epoch) — lanes align within a trace, and cross-rank alignment
+    is approximate, same as the reference.  Returns the number of events
+    written."""
+    events: list[dict] = []
+    used_pids: set[int] = set()
+    for i, path in enumerate(paths):
+        rank = _rank_of(path, i)
+        pid = rank
+        while pid in used_pids:
+            pid += len(paths)  # deterministic, never collides with a rank
+        used_pids.add(pid)
+        for ev in load_trace(path):
+            if not isinstance(ev, dict):
+                continue
+            ev = dict(ev)
+            ev["pid"] = pid
+            events.append(ev)
+        events.append({"name": "process_name", "ph": "M", "pid": pid,
+                       "tid": 0,
+                       "args": {"name": f"rank {rank} "
+                                        f"({os.path.basename(path)})"}})
+    with open(out_path, "w") as f:
+        f.write("[\n")
+        f.write(",\n".join(json.dumps(e, separators=(",", ":"))
+                           for e in events))
+        f.write("\n]\n")
+    return len(events)

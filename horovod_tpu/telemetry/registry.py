@@ -1,0 +1,344 @@
+"""Process-local metrics registry: counters, gauges, fixed-bucket histograms.
+
+Role analog: the aggregate view the reference never had — its observability
+surface is the Chrome-trace timeline (``csrc/timeline.cc`` here) plus stderr
+stall warnings.  This registry is the queryable side: every eager collective,
+compiled-path logical collective, and native-engine diagnostic lands in one
+thread-safe table exportable as JSON (per-rank dump files joined by
+``python -m horovod_tpu.telemetry``) or Prometheus text (scrape endpoint
+material).
+
+Design constraints:
+
+* **Near-zero overhead when disabled** — instrumentation call sites check
+  :func:`horovod_tpu.telemetry.metrics_enabled` once at setup (e.g. engine
+  construction) and install nothing when off; the registry itself is never
+  consulted on the hot path in disabled mode.
+* **Thread-safe** — one lock guards the metric table; each metric carries its
+  own lock for updates, so two threads bumping different counters don't
+  serialize on the table lock.
+* **Fixed buckets** — histograms are Prometheus-style cumulative-bucket
+  arrays, mergeable across ranks by summing counts (the basis of the
+  cross-rank p50/p99 in the summary CLI).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+# Default latency buckets (seconds): 10 µs .. 10 s, roughly ×2.5 spaced.
+LATENCY_BUCKETS = (
+    1e-5, 2.5e-5, 5e-5, 1e-4, 2.5e-4, 5e-4,
+    1e-3, 2.5e-3, 5e-3, 1e-2, 2.5e-2, 5e-2,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+# Fill-fraction buckets for the fusion-bucket ledger: deciles of [0, 1].
+RATIO_BUCKETS = (0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.7, 0.8, 0.9, 1.0)
+
+
+def _label_key(labels: dict[str, str]) -> tuple:
+    return tuple(sorted(labels.items()))
+
+
+class Counter:
+    """Monotonically-increasing counter."""
+
+    kind = "counter"
+
+    def __init__(self, name: str, labels: dict[str, str]):
+        self.name = name
+        self.labels = dict(labels)
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def inc(self, amount: float = 1.0) -> None:
+        if amount < 0:
+            raise ValueError("counters only go up; use a gauge")
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+    def to_dict(self) -> dict:
+        return {"name": self.name, "type": self.kind, "labels": self.labels,
+                "value": self.value}
+
+
+class Gauge:
+    """Point-in-time value (queue depth, converged flag, ...)."""
+
+    kind = "gauge"
+
+    def __init__(self, name: str, labels: dict[str, str]):
+        self.name = name
+        self.labels = dict(labels)
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = float(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value -= amount
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+    def to_dict(self) -> dict:
+        return {"name": self.name, "type": self.kind, "labels": self.labels,
+                "value": self.value}
+
+
+class Histogram:
+    """Fixed-bucket histogram (Prometheus-style cumulative export).
+
+    ``bounds`` are inclusive upper bounds; one implicit +Inf bucket catches
+    the tail.  Counts are stored per-bucket (non-cumulative) internally and
+    merged across ranks by element-wise summation.
+    """
+
+    kind = "histogram"
+
+    def __init__(self, name: str, labels: dict[str, str],
+                 bounds: tuple = LATENCY_BUCKETS):
+        self.name = name
+        self.labels = dict(labels)
+        self.bounds = tuple(float(b) for b in bounds)
+        if list(self.bounds) != sorted(self.bounds):
+            raise ValueError("histogram bounds must be sorted ascending")
+        self._lock = threading.Lock()
+        self._counts = [0] * (len(self.bounds) + 1)  # last = +Inf
+        self._sum = 0.0
+        self._count = 0
+
+    def observe(self, value: float) -> None:
+        # linear scan beats bisect for the short, mostly-low-bucket
+        # latency distributions this records
+        i = 0
+        bounds = self.bounds
+        n = len(bounds)
+        while i < n and value > bounds[i]:
+            i += 1
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += value
+            self._count += 1
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return self._sum
+
+    def snapshot(self) -> tuple[list[int], float, int]:
+        with self._lock:
+            return list(self._counts), self._sum, self._count
+
+    def percentile(self, q: float) -> float:
+        """Estimated q-quantile (q in [0, 1]) via linear interpolation inside
+        the containing bucket; the +Inf bucket reports its lower bound."""
+        counts, _, total = self.snapshot()
+        return percentile_from_buckets(self.bounds, counts, total, q)
+
+    def to_dict(self) -> dict:
+        counts, s, c = self.snapshot()
+        return {"name": self.name, "type": self.kind, "labels": self.labels,
+                "bounds": list(self.bounds), "counts": counts,
+                "sum": s, "count": c}
+
+
+def percentile_from_buckets(bounds, counts, total: int, q: float) -> float:
+    """Shared quantile estimator, also used by the cross-rank merge CLI."""
+    if not 0.0 <= q <= 1.0:
+        raise ValueError(f"quantile {q} outside [0, 1]")
+    if total <= 0:
+        return 0.0
+    target = q * total
+    cum = 0
+    lower = 0.0
+    for i, c in enumerate(counts):
+        upper = bounds[i] if i < len(bounds) else None
+        if cum + c >= target and c > 0:
+            if upper is None:
+                return lower  # +Inf bucket: best estimate is its floor
+            frac = (target - cum) / c
+            return lower + (upper - lower) * frac
+        cum += c
+        if upper is not None:
+            lower = upper
+    return lower
+
+
+class MetricsRegistry:
+    """Thread-safe name+labels -> metric table with export/dump plumbing."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._metrics: dict[tuple, object] = {}
+        self._collectors: list = []  # callables run before every export
+
+    # -- metric accessors (get-or-create) ----------------------------------
+    def _get(self, cls, name: str, labels: dict[str, str], **kw):
+        key = (name, _label_key(labels))
+        with self._lock:
+            m = self._metrics.get(key)
+            if m is None:
+                m = cls(name, labels, **kw)
+                self._metrics[key] = m
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as {m.kind}")
+            return m
+
+    def counter(self, name: str, **labels: str) -> Counter:
+        return self._get(Counter, name, labels)
+
+    def gauge(self, name: str, **labels: str) -> Gauge:
+        return self._get(Gauge, name, labels)
+
+    def histogram(self, name: str, bounds: tuple = LATENCY_BUCKETS,
+                  **labels: str) -> Histogram:
+        return self._get(Histogram, name, labels, bounds=bounds)
+
+    # -- collectors ---------------------------------------------------------
+    def register_collector(self, fn) -> None:
+        """``fn()`` runs before each export/dump — for sources polled rather
+        than pushed (the native engine's diagnostics)."""
+        with self._lock:
+            if fn not in self._collectors:
+                self._collectors.append(fn)
+
+    def unregister_collector(self, fn) -> None:
+        with self._lock:
+            if fn in self._collectors:
+                self._collectors.remove(fn)
+
+    def _run_collectors(self) -> None:
+        with self._lock:
+            collectors = list(self._collectors)
+        for fn in collectors:
+            try:
+                fn()
+            except Exception:
+                pass  # a dead engine must not break metric export
+
+    # -- export -------------------------------------------------------------
+    def snapshot(self) -> list[dict]:
+        self._run_collectors()
+        with self._lock:
+            metrics = list(self._metrics.values())
+        return [m.to_dict() for m in metrics]
+
+    def to_json(self, rank: int | None = None) -> str:
+        doc = {"schema": "horovod_tpu.telemetry/1",
+               "time_unix": time.time(),
+               "metrics": self.snapshot()}
+        if rank is not None:
+            doc["rank"] = int(rank)
+        return json.dumps(doc, indent=1)
+
+    def to_prometheus(self) -> str:
+        """Prometheus text exposition format, scrape-ready."""
+        lines: list[str] = []
+        seen_types: set[str] = set()
+
+        def fmt_labels(labels: dict, extra: dict | None = None) -> str:
+            items = dict(labels)
+            if extra:
+                items.update(extra)
+            if not items:
+                return ""
+            body = ",".join(f'{k}="{v}"' for k, v in sorted(items.items()))
+            return "{" + body + "}"
+
+        # group by family: the exposition format requires all samples of a
+        # metric name to be contiguous, and lazy metric creation interleaves
+        # families in insertion order
+        for m in sorted(self.snapshot(), key=lambda m: m["name"]):
+            name = m["name"]
+            if name not in seen_types:
+                lines.append(f"# TYPE {name} {m['type']}")
+                seen_types.add(name)
+            if m["type"] in ("counter", "gauge"):
+                lines.append(f"{name}{fmt_labels(m['labels'])} {m['value']:g}")
+            else:
+                cum = 0
+                for i, c in enumerate(m["counts"]):
+                    cum += c
+                    le = (f"{m['bounds'][i]:g}" if i < len(m["bounds"])
+                          else "+Inf")
+                    lines.append(
+                        f"{name}_bucket"
+                        f"{fmt_labels(m['labels'], {'le': le})} {cum}")
+                lines.append(
+                    f"{name}_sum{fmt_labels(m['labels'])} {m['sum']:g}")
+                lines.append(
+                    f"{name}_count{fmt_labels(m['labels'])} {m['count']}")
+        return "\n".join(lines) + "\n"
+
+    # -- per-rank dump files -------------------------------------------------
+    def dump(self, directory: str, rank: int) -> str:
+        """Write ``metrics.rank<r>.json`` atomically (tmp + rename) so the
+        merge CLI never reads a half-written file."""
+        os.makedirs(directory, exist_ok=True)
+        path = os.path.join(directory, f"metrics.rank{rank}.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(self.to_json(rank=rank))
+        os.replace(tmp, path)
+        return path
+
+    def clear(self) -> None:
+        with self._lock:
+            self._metrics.clear()
+            self._collectors.clear()
+
+
+class MetricsDumper:
+    """Daemon thread writing periodic per-rank dumps to a directory."""
+
+    def __init__(self, registry: MetricsRegistry, directory: str, rank: int,
+                 interval_s: float) -> None:
+        self._registry = registry
+        self._dir = directory
+        self._rank = rank
+        self._interval = max(float(interval_s), 0.1)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, name="hvdtpu-metrics-dump", daemon=True)
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._interval):
+            try:
+                self._registry.dump(self._dir, self._rank)
+            except OSError:
+                pass  # a full/readonly disk must not kill training
+
+    def stop(self, final_dump: bool = True) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5)
+        if final_dump:
+            try:
+                self._registry.dump(self._dir, self._rank)
+            except OSError:
+                pass

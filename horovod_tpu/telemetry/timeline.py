@@ -1,0 +1,227 @@
+"""Python-side Chrome-trace timeline writer.
+
+Event-schema twin of the native engine's ``csrc/timeline.cc``: one pid per
+process, one ``tid`` lane per named tensor (allocated on first sight, with a
+shared "other" overflow lane past the cap), ``ph: B/E`` spans and ``ph: i``
+instants, ``ts`` in microseconds from a monotonic epoch.  It honors the same
+``HOROVOD_TIMELINE`` / ``HOROVOD_TPU_TIMELINE`` env vars, which means the
+Python engines — :class:`~horovod_tpu.runtime.engine.SingleProcessEngine`
+runs, frontend-level spans, ``-np 1`` debug sessions — now produce traces
+only the native engine could before.
+
+File layout: in a size-1 world the file is written at the configured path
+exactly.  In a multi-process world rank 0's *native* engine owns that path
+(csrc initializes its timeline on rank 0 only), so each Python writer
+appends ``.pyrank<r>`` — ``python -m horovod_tpu.telemetry merge-timelines``
+joins them (and the native file) into one trace with pid = rank.
+
+Events stream to disk as they happen (line-buffered JSON array, one record
+per line).  The trailing ``]`` is written by :meth:`PyTimeline.close`
+(wired into ``horovod_tpu.shutdown`` and ``atexit``); Perfetto and
+``chrome://tracing`` both accept an unterminated array, matching the crash
+behavior of the native writer.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import threading
+import time
+
+# Lane cap, mirroring csrc/timeline.cc kMaxLanes: unbounded distinct tensor
+# names (e.g. "<op>.noname.<n>" streams) must not grow the lane table and
+# trace metadata forever.
+MAX_LANES = 256
+
+_OVERFLOW_LANE_NAME = "other"
+
+
+def timeline_path_from_env() -> str | None:
+    """Resolve the configured timeline path for THIS process, or None."""
+    base = os.environ.get("HOROVOD_TIMELINE") or \
+        os.environ.get("HOROVOD_TPU_TIMELINE")
+    if not base:
+        return None
+    # same launcher-env fallbacks as utils.topo (hvdrun, mpirun, PMI) —
+    # otherwise every mpirun rank would see size=1 and clobber `base`
+    from horovod_tpu.utils.topo import _RANK_ENV, _SIZE_ENV, _env_int
+
+    size = _env_int(_SIZE_ENV) or 1
+    rank = _env_int(_RANK_ENV) or 0
+    if size > 1:
+        # rank 0's native engine writes `base` itself
+        return f"{base}.pyrank{rank}"
+    return base
+
+
+class PyTimeline:
+    """Thread-safe streaming Chrome-trace writer (see module docstring)."""
+
+    def __init__(self, path: str, pid: int = 0) -> None:
+        self.path = path
+        self.pid = pid
+        self._lock = threading.Lock()
+        self._start_ns = time.monotonic_ns()
+        self._lanes: dict[str, int] = {}
+        self._next_lane = 1  # 0 reserved for process-level spans
+        self._overflow_lane = -1
+        self._closed = False
+        self._first = True
+        self._f = open(path, "w", buffering=1)  # events reach disk per write
+        self._f.write("[\n")
+        self._emit_locked({"name": "process_name", "ph": "M",
+                           "pid": self.pid, "tid": 0,
+                           "args": {"name": "horovod_tpu python"}})
+        self._emit_locked({"name": "thread_name", "ph": "M",
+                           "pid": self.pid, "tid": 0,
+                           "args": {"name": "process"}})
+
+    # -- low-level record plumbing ------------------------------------------
+    def _now_us(self) -> int:
+        return (time.monotonic_ns() - self._start_ns) // 1000
+
+    def _emit_locked(self, record: dict) -> None:
+        if self._closed:
+            return
+        sep = "" if self._first else ",\n"
+        self._first = False
+        self._f.write(sep + json.dumps(record, separators=(",", ":")))
+
+    def _emit(self, record: dict) -> None:
+        with self._lock:
+            self._emit_locked(record)
+
+    def _lane(self, tensor: str) -> int:
+        # caller holds self._lock
+        lane = self._lanes.get(tensor)
+        if lane is not None:
+            return lane
+        if len(self._lanes) >= MAX_LANES:
+            if self._overflow_lane < 0:
+                self._overflow_lane = self._next_lane
+                self._next_lane += 1
+                self._emit_locked({"name": "thread_name", "ph": "M",
+                                   "pid": self.pid,
+                                   "tid": self._overflow_lane,
+                                   "args": {"name": _OVERFLOW_LANE_NAME}})
+            return self._overflow_lane
+        lane = self._next_lane
+        self._next_lane += 1
+        self._lanes[tensor] = lane
+        self._emit_locked({"name": "thread_name", "ph": "M",
+                           "pid": self.pid, "tid": lane,
+                           "args": {"name": tensor}})
+        return lane
+
+    # -- event API (csrc/timeline.cc parity) --------------------------------
+    def begin(self, tensor: str, name: str) -> None:
+        """Open a span on the tensor's lane (``ph: B``)."""
+        with self._lock:
+            self._emit_locked({"name": name, "ph": "B", "pid": self.pid,
+                               "tid": self._lane(tensor),
+                               "ts": self._now_us()})
+
+    def end(self, tensor: str) -> None:
+        """Close the most recent open span on the tensor's lane (``ph: E``)."""
+        with self._lock:
+            self._emit_locked({"ph": "E", "pid": self.pid,
+                               "tid": self._lane(tensor),
+                               "ts": self._now_us()})
+
+    def instant(self, tensor: str, name: str) -> None:
+        with self._lock:
+            self._emit_locked({"name": name, "ph": "i", "s": "t",
+                               "pid": self.pid,
+                               "tid": self._lane(tensor),
+                               "ts": self._now_us()})
+
+    def span(self, tensor: str, name: str):
+        """``with tl.span("grad/w0", "ALLREDUCE"): ...``"""
+        return _Span(self, tensor, name)
+
+    # -- lifecycle -----------------------------------------------------------
+    def flush(self) -> None:
+        with self._lock:
+            if not self._closed:
+                self._f.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._f.write("\n]\n")
+            self._f.close()
+            self._closed = True
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+
+class _Span:
+    __slots__ = ("_tl", "_tensor", "_name")
+
+    def __init__(self, tl: PyTimeline, tensor: str, name: str) -> None:
+        self._tl = tl
+        self._tensor = tensor
+        self._name = name
+
+    def __enter__(self):
+        self._tl.begin(self._tensor, self._name)
+        return self
+
+    def __exit__(self, *exc):
+        self._tl.end(self._tensor)
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Process-global instance, resolved lazily from the environment
+# ---------------------------------------------------------------------------
+
+_lock = threading.Lock()
+_instance: PyTimeline | None = None
+_resolved = False
+
+
+def get() -> PyTimeline | None:
+    """The process-global timeline, or None when no timeline is configured.
+
+    Created on first call after ``HOROVOD_TIMELINE`` is seen; closed by
+    :func:`close` (called from ``horovod_tpu.shutdown``) or atexit.
+    """
+    global _instance, _resolved
+    with _lock:
+        if not _resolved:
+            path = timeline_path_from_env()
+            if path:
+                try:
+                    _instance = PyTimeline(path)
+                except OSError as e:
+                    import sys
+
+                    print(f"[hvdtpu] WARNING: cannot open timeline file "
+                          f"{path}: {e}", file=sys.stderr)
+                    _instance = None
+            _resolved = True
+        return _instance
+
+
+def enabled() -> bool:
+    return get() is not None
+
+
+def close() -> None:
+    """Finalize the trace file (writes the closing bracket) and allow a
+    later ``get()`` to open a fresh one (re-init after shutdown)."""
+    global _instance, _resolved
+    with _lock:
+        if _instance is not None:
+            _instance.close()
+        _instance = None
+        _resolved = False
+
+
+atexit.register(close)

@@ -22,6 +22,7 @@ import numpy as np
 import torch
 
 from horovod_tpu import _auto_name as _name  # shared "<op>.noname.<n>" scheme
+from horovod_tpu import telemetry as _telemetry
 from horovod_tpu.runtime import state as _state
 from horovod_tpu.torch.compression import Compression
 
@@ -211,7 +212,11 @@ def synchronize(handle: int) -> torch.Tensor:
         if handle not in hmap:
             raise ValueError(f"unknown handle {handle}")
         target, average, dtype = hmap.pop(handle)
-    arr = engine.synchronize(handle)
+    # how long the training loop actually blocked on this handle — the
+    # backward-overlap figure of merit (≈0 when communication fully hides
+    # behind compute; tail = the straggling bucket)
+    with _telemetry.wait_timer("torch"):
+        arr = engine.synchronize(handle)
     out = _from_numpy(arr, dtype)
     if average:
         import horovod_tpu as hvd

@@ -119,14 +119,31 @@ def scenario_errors():
 
 def scenario_stall():
     # rank 0 submits an op nobody else joins; the coordinator must warn
+    # AND count it — queryable via diagnostics() and, when metrics are on,
+    # mirrored into the telemetry registry by the export-time collector
     hvd.init()
     r = hvd.rank()
     if r == 0:
-        h = hvd.allreduce_async(np.ones(2, np.float32), name="lonely")
         import time
 
-        time.sleep(2.0)
+        from horovod_tpu import telemetry
+        from horovod_tpu.runtime import state as _state
+
+        h = hvd.allreduce_async(np.ones(2, np.float32), name="lonely")
+        deadline = time.monotonic() + 15.0
+        while (_state.engine().diagnostics()["stall_events"] < 1
+               and time.monotonic() < deadline):
+            time.sleep(0.25)
         assert not hvd.poll(h)
+        d = _state.engine().diagnostics()
+        assert d["stall_events"] >= 1, d
+        mirrored = 0
+        if telemetry.metrics_enabled():
+            for m in telemetry.registry().snapshot():
+                if m["name"] == telemetry.NATIVE_STALL_EVENTS:
+                    mirrored = int(m["value"])
+        print(f"rank 0: stall_events={d['stall_events']} "
+              f"mirrored={mirrored}", flush=True)
     else:
         import time
 

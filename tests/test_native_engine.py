@@ -156,10 +156,14 @@ def test_skewed_shutdown_exits_cleanly():
 
 
 def test_stall_warning():
-    res = _run("stall", 2, env={"HOROVOD_TPU_STALL_WARNING_SECS": "1"})
+    res = _run("stall", 2, env={"HOROVOD_TPU_STALL_WARNING_SECS": "1",
+                                "HOROVOD_TPU_METRICS": "1"})
     assert res.returncode == 0, res.stderr + res.stdout
     assert "possible stall" in res.stderr
     assert "lonely" in res.stderr
+    # the warning is queryable, not just stderr noise: diagnostics() counts
+    # it and the telemetry registry mirrors it at export time
+    assert "rank 0: stall_events=1 mirrored=1" in res.stdout, res.stdout
 
 
 def test_timeline(tmp_path):

@@ -1,0 +1,22 @@
+#!/usr/bin/env python
+"""Cross-rank telemetry report — thin wrapper over the package CLI.
+
+    python tools/telemetry_summary.py <metrics-dir> [--steps N] [--prom]
+
+Equivalent to ``python -m horovod_tpu.telemetry summarize ...``; exists so
+the report runs from a bare checkout (no install, no native .so, no JAX) —
+exercised as a tier-1 smoke test.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from horovod_tpu.telemetry.__main__ import main  # noqa: E402
+
+
+if __name__ == "__main__":
+    sys.exit(main(["summarize"] + sys.argv[1:]))
