@@ -1151,6 +1151,109 @@ def _run_worker(n: int, worker_args: list) -> dict:
     return _run_json_subprocess(cmd, env)
 
 
+def negotiation_worker(args):
+    """Subprocess under the launcher: hammer the negotiation control plane
+    with a FIXED named tensor set of tiny payloads (control-plane bound by
+    construction) and report rounds/sec plus per-rank control-plane bytes
+    from the engine's cache diagnostics."""
+    import numpy as np
+
+    import horovod_tpu as hvd
+    from horovod_tpu.runtime import state as _state
+
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    T = args.neg_tensors
+    data = [np.full(args.neg_elems, float(r + i), np.float32)
+            for i in range(T)]
+    eng = _state.engine()
+    # warmup rounds: populate the cache (or prove it disabled) and absorb
+    # first-touch costs on both paths
+    for _ in range(3):
+        hs = [hvd.allreduce_async(data[i], average=False, name=f"neg{i}")
+              for i in range(T)]
+        for h in hs:
+            hvd.synchronize(h)
+    d0 = eng.diagnostics()
+    t0 = time.perf_counter()
+    for _ in range(args.neg_steps):
+        hs = [hvd.allreduce_async(data[i], average=False, name=f"neg{i}")
+              for i in range(T)]
+        for h in hs:
+            hvd.synchronize(h)
+    dt = time.perf_counter() - t0
+    d1 = eng.diagnostics()
+    mine = [d1["negotiation_bytes_tx"] - d0["negotiation_bytes_tx"],
+            d1["negotiation_bytes_rx"] - d0["negotiation_bytes_rx"],
+            d1["cache_hits"] - d0["cache_hits"],
+            d1["cache_misses"] - d0["cache_misses"]]
+    per_rank = hvd.allgather(np.array([mine], np.int64), name="neg_stats")
+    if r == 0:
+        per_rank = per_rank.tolist()
+        workers = per_rank[1:] or per_rank  # rank 0 is the coordinator
+        steps = args.neg_steps
+        print(json.dumps({
+            "np": n, "steps": steps, "tensors_per_step": T,
+            "rounds_per_sec": round(steps / dt, 2),
+            "ctrl_bytes_per_round_worker": round(
+                sum(tx + rx for tx, rx, _, _ in workers)
+                / len(workers) / steps, 1),
+            "ctrl_bytes_per_round_coordinator": round(
+                (per_rank[0][0] + per_rank[0][1]) / steps, 1),
+            "cache_hits": int(sum(h for _, _, h, _ in per_rank)),
+            "cache_misses": int(sum(m for _, _, _, m in per_rank)),
+        }), flush=True)
+    hvd.shutdown()
+
+
+def bench_negotiation(args):
+    """Negotiation control-plane microbench: rounds/sec and control-plane
+    bytes with the response cache on (default capacity) vs off
+    (HOROVOD_TPU_CACHE_CAPACITY=0) at -np 4 and 8.
+
+    Payloads are tiny (``--neg-elems`` floats) so the wire cost under test
+    is the NEGOTIATION, not the data plane.  On a machine with fewer cores
+    than ranks the absolute rounds/sec measures oversubscription too, but
+    the bytes-per-round ratio — the number the response cache exists to
+    move — is scheduling-independent (counted, not timed)."""
+    results = {"config": {
+        "steps": args.neg_steps, "tensors_per_step": args.neg_tensors,
+        "elems_per_tensor": args.neg_elems, "nproc": os.cpu_count(),
+        "note": "bytes/round is counted (scheduling-independent); "
+                "rounds/sec beyond the core count varies tens of percent "
+                "run-to-run from oversubscription and is reported for "
+                "context only",
+    }}
+    for n in (4, 8):
+        if n > args.neg_max_np:
+            continue
+        point = {}
+        for label, cap in (("cache_on", None), ("cache_off", "0")):
+            env = dict(os.environ)
+            env["JAX_PLATFORMS"] = "cpu"
+            if cap is None:
+                env.pop("HOROVOD_TPU_CACHE_CAPACITY", None)  # default 1024
+            else:
+                env["HOROVOD_TPU_CACHE_CAPACITY"] = cap
+            cmd = [sys.executable, "-m", "horovod_tpu.run", "-np", str(n),
+                   sys.executable, os.path.abspath(__file__),
+                   "--negotiation-worker",
+                   "--neg-steps", str(args.neg_steps),
+                   "--neg-tensors", str(args.neg_tensors),
+                   "--neg-elems", str(args.neg_elems)]
+            point[label] = _run_json_subprocess(cmd, env, timeout=600)
+        on, off = point.get("cache_on", {}), point.get("cache_off", {})
+        if ("ctrl_bytes_per_round_worker" in on
+                and "ctrl_bytes_per_round_worker" in off):
+            point["ctrl_bytes_reduction_worker"] = round(
+                off["ctrl_bytes_per_round_worker"]
+                / max(on["ctrl_bytes_per_round_worker"], 1e-9), 2)
+            point["rounds_per_sec_speedup"] = round(
+                on["rounds_per_sec"] / max(off["rounds_per_sec"], 1e-9), 3)
+        results[f"np{n}"] = point
+    return results
+
+
 def bench_scaling(args):
     """Weak-scaling efficiency of the eager DP path: per-step time at
     np=1 vs np=N on THIS host (loopback TCP).  Only valid where each rank
@@ -1808,6 +1911,16 @@ def build_parser() -> argparse.ArgumentParser:
                     help=argparse.SUPPRESS)
     ap.add_argument("--scaling-worker", action="store_true",
                     help=argparse.SUPPRESS)
+    ap.add_argument("--negotiation", action="store_true",
+                    help="run ONLY the negotiation control-plane microbench "
+                         "(response cache on vs off at -np 4/8) and write "
+                         "BENCH_r06.json")
+    ap.add_argument("--negotiation-worker", action="store_true",
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--neg-steps", type=int, default=300)
+    ap.add_argument("--neg-tensors", type=int, default=32)
+    ap.add_argument("--neg-elems", type=int, default=16)
+    ap.add_argument("--neg-max-np", type=int, default=8)
     ap.add_argument("--pipeline-worker", action="store_true",
                     help=argparse.SUPPRESS)
     ap.add_argument("--skip-pipeline", action="store_true")
@@ -1852,6 +1965,22 @@ def main() -> None:
         return
     if args.pipeline_worker:
         pipeline_worker(args)
+        return
+    if args.negotiation_worker:
+        negotiation_worker(args)
+        return
+    if args.negotiation:
+        # control-plane only: no jax, no models, no roofline — runs in
+        # seconds and writes its own artifact
+        out = bench_negotiation(args)
+        with open(os.path.join(REPO, "BENCH_r06.json"), "w") as f:
+            json.dump(out, f, indent=1)
+        compact = {k: {kk: vv for kk, vv in v.items()
+                       if kk in ("ctrl_bytes_reduction_worker",
+                                 "rounds_per_sec_speedup")}
+                   for k, v in out.items() if k.startswith("np")}
+        print(json.dumps({"negotiation": compact,
+                          "full": "BENCH_r06.json"}))
         return
 
     # persistent compilation cache: compiles over tunneled backends cost

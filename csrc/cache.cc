@@ -1,0 +1,112 @@
+#include "cache.h"
+
+#include <algorithm>
+
+namespace hvdtpu {
+
+void ResponseCache::Init(int64_t capacity) {
+  // clamp: the bitvector wire format bounds claims to 8M slots; anything
+  // near that is a config error, not a workload
+  capacity_ = std::min<int64_t>(std::max<int64_t>(capacity, 0), 1 << 20);
+  slots_.assign(static_cast<size_t>(capacity_), CacheEntry{});
+  slot_epoch_.assign(static_cast<size_t>(capacity_), 0);
+  by_name_.clear();
+  epoch_ = 0;
+  lru_clock_ = 0;
+  entries_ = 0;
+  high_water_ = 0;
+  evictions_ = 0;
+}
+
+int ResponseCache::Lookup(const Request& req) const {
+  auto it = by_name_.find(req.name);
+  if (it == by_name_.end()) return -1;
+  const CacheEntry& e = slots_[it->second];
+  if (!e.valid || !e.local_valid) return -1;
+  if (e.op != req.op || e.dtype != req.dtype ||
+      e.root_rank != req.root_rank || e.my_dims != req.dims)
+    return -1;
+  return it->second;
+}
+
+int ResponseCache::SlotOf(const std::string& name) const {
+  auto it = by_name_.find(name);
+  return it == by_name_.end() ? -1 : it->second;
+}
+
+void ResponseCache::Touch(int s) {
+  if (!InRange(s) || !slots_[s].valid) return;
+  slots_[s].last_use = ++lru_clock_;
+}
+
+void ResponseCache::Upsert(const std::string& name, OpType op, DType dtype,
+                           int32_t root_rank,
+                           const std::vector<int64_t>& my_dims,
+                           bool local_valid,
+                           const std::vector<int64_t>& first_dims,
+                           std::vector<std::string>* displaced,
+                           std::vector<int>* mutated_slots) {
+  if (!enabled()) return;
+  int s;
+  auto it = by_name_.find(name);
+  if (it != by_name_.end()) {
+    // same name renegotiated (shape/dtype change, or an explicit full-path
+    // round): replace in place; the old signature is what got displaced
+    s = it->second;
+    displaced->push_back(name);
+  } else {
+    s = -1;
+    // lowest free slot, else evict the LRU entry (skip the free scan when
+    // the table is full — the common state under eviction churn)
+    if (entries_ < static_cast<int>(slots_.size())) {
+      for (int i = 0; i < static_cast<int>(slots_.size()); i++) {
+        if (!slots_[i].valid) {
+          s = i;
+          break;
+        }
+      }
+    }
+    if (s < 0) {
+      uint64_t best = ~0ull;
+      for (int i = 0; i < static_cast<int>(slots_.size()); i++) {
+        if (slots_[i].last_use < best) {
+          best = slots_[i].last_use;
+          s = i;
+        }
+      }
+      displaced->push_back(slots_[s].name);
+      by_name_.erase(slots_[s].name);
+      entries_--;
+      evictions_++;
+    }
+  }
+  CacheEntry& e = slots_[s];
+  if (!e.valid) entries_++;
+  e.valid = true;
+  e.name = name;
+  e.op = op;
+  e.dtype = dtype;
+  e.root_rank = root_rank;
+  e.my_dims = my_dims;
+  e.local_valid = local_valid;
+  e.first_dims = first_dims;
+  e.last_use = ++lru_clock_;
+  by_name_[name] = s;
+  high_water_ = std::max(high_water_, s + 1);
+  BumpSlot(s);
+  mutated_slots->push_back(s);
+}
+
+void ResponseCache::Remove(const std::string& name,
+                           std::vector<int>* mutated_slots) {
+  auto it = by_name_.find(name);
+  if (it == by_name_.end()) return;
+  int s = it->second;
+  slots_[s] = CacheEntry{};
+  by_name_.erase(it);
+  entries_--;
+  BumpSlot(s);
+  mutated_slots->push_back(s);
+}
+
+}  // namespace hvdtpu

@@ -1,0 +1,112 @@
+// Coordinator-replicated response cache for the negotiation control plane.
+//
+// Role analog: the reference-lineage bitvector response cache upstream
+// Horovod added after v0.15.2 (HOROVOD_CACHE_CAPACITY) — training negotiates
+// the SAME tensor set every step after step 1, so steady-state workers send
+// a fixed-size cache-hit bitvector instead of per-tensor Request frames and
+// the coordinator replies with compact "execute cached slots" frames.
+//
+// Replication contract: every rank holds a cache with IDENTICAL slot
+// assignments, LRU order, and epochs.  That holds because every mutation
+// (insert / replace / evict / remove) and every LRU touch is derived from
+// the coordinator's broadcast stream, which all ranks (coordinator
+// included) apply in the same order:
+//   * full-path ResponseList responses  -> Upsert per name (errors -> Remove)
+//   * CachedExec group decode           -> Touch per referenced slot
+// The only per-rank private field is my_dims (this rank's own request dims,
+// used for the local hit check); for allgather/alltoall each rank's dim0
+// legitimately differs, and the cached first_dims vector is only valid when
+// EVERY rank re-checks its own contribution — which is exactly what the
+// all-ranks-claimed condition guarantees.
+//
+// The epoch counts mutations.  A claim carries the claimer's epoch; the
+// coordinator rejects claims on slots mutated after it (slot_epoch > claim
+// epoch) — the claimer observes the same mutation in its broadcast stream
+// and falls back to a full request (engine.cc displacement handling).
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "wire.h"
+
+namespace hvdtpu {
+
+struct CacheEntry {
+  bool valid = false;
+  std::string name;
+  OpType op = OpType::kAllreduce;
+  DType dtype = DType::kFloat32;
+  int32_t root_rank = -1;
+  // this rank's request dims at insert time (empty + local_valid=false when
+  // the rank had no live tensor-table entry; such entries never hit locally
+  // but keep slot assignments replicated)
+  std::vector<int64_t> my_dims;
+  bool local_valid = false;
+  // negotiated per-rank first-dim contributions (allgather/alltoall)
+  std::vector<int64_t> first_dims;
+  uint64_t last_use = 0;  // deterministic LRU stamp
+};
+
+class ResponseCache {
+ public:
+  // capacity <= 0 disables the cache entirely.
+  void Init(int64_t capacity);
+  bool enabled() const { return capacity_ > 0; }
+  int64_t capacity() const { return capacity_; }
+  uint64_t epoch() const { return epoch_; }
+  uint64_t slot_epoch(int s) const {
+    return InRange(s) ? slot_epoch_[s] : ~0ull;
+  }
+  int entries() const { return entries_; }
+  int64_t evictions() const { return evictions_; }
+  // highest slot index ever used + 1 (bitvector sizing)
+  int high_water() const { return high_water_; }
+
+  // Local steady-state hit check: slot holding an entry that matches the
+  // request's full signature, or -1.  Does NOT touch LRU (local lookups
+  // are not replicated; only broadcast-stream events may move LRU state).
+  int Lookup(const Request& req) const;
+  // Slot holding a (possibly signature-mismatched) entry for name, or -1.
+  int SlotOf(const std::string& name) const;
+  const CacheEntry* At(int s) const {
+    return (InRange(s) && slots_[s].valid) ? &slots_[s] : nullptr;
+  }
+
+  // Replicated LRU touch (cached execution reference).
+  void Touch(int s);
+
+  // Replicated insert-or-replace of one negotiated tensor.  Same-name
+  // entries are replaced in place (shape/dtype change invalidation); new
+  // names take the lowest free slot or evict the LRU entry.  Displaced
+  // names (evicted or replaced) are appended to *displaced; every mutated
+  // slot id is appended to *mutated_slots (for claim bookkeeping).
+  void Upsert(const std::string& name, OpType op, DType dtype,
+              int32_t root_rank, const std::vector<int64_t>& my_dims,
+              bool local_valid, const std::vector<int64_t>& first_dims,
+              std::vector<std::string>* displaced,
+              std::vector<int>* mutated_slots);
+
+  // Replicated removal (error response for a cached name).
+  void Remove(const std::string& name, std::vector<int>* mutated_slots);
+
+ private:
+  bool InRange(int s) const {
+    return s >= 0 && s < static_cast<int>(slots_.size());
+  }
+  void BumpSlot(int s) { slot_epoch_[s] = ++epoch_; }
+
+  int64_t capacity_ = 0;
+  std::vector<CacheEntry> slots_;
+  std::vector<uint64_t> slot_epoch_;
+  std::unordered_map<std::string, int> by_name_;
+  uint64_t epoch_ = 0;
+  uint64_t lru_clock_ = 0;
+  int entries_ = 0;
+  int high_water_ = 0;
+  int64_t evictions_ = 0;
+};
+
+}  // namespace hvdtpu

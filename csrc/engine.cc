@@ -41,6 +41,7 @@
 #include <unistd.h>
 
 #include "autotune.h"
+#include "cache.h"
 #include "common.h"
 #include "logging.h"
 #include "shm.h"
@@ -295,14 +296,59 @@ class Engine {
   bool AutotuneConverged() const { return pm_.Converged(); }
   int64_t StallEvents() const { return stall_events_.load(); }
 
+  // Response-cache + control-plane counters, readable from any thread:
+  // {hits, misses, evictions, live entries, ctrl bytes sent, ctrl bytes
+  // received}.  Bytes count every negotiation frame (payload + the 4-byte
+  // socket length prefix) on the coordinator star, both directions.
+  void CacheStats(int64_t out[6]) const {
+    out[0] = cache_hits_.load(std::memory_order_relaxed);
+    out[1] = cache_misses_.load(std::memory_order_relaxed);
+    out[2] = cache_evictions_.load(std::memory_order_relaxed);
+    out[3] = cache_entries_.load(std::memory_order_relaxed);
+    out[4] = ctrl_tx_bytes_.load(std::memory_order_relaxed);
+    out[5] = ctrl_rx_bytes_.load(std::memory_order_relaxed);
+  }
+
  private:
   void BackgroundLoop();
   void WaitForWork(std::chrono::microseconds max_wait);
   void Wake();
-  void CoordinatorTick(RequestList& local, ResponseList* out);
+  bool CoordinatorTick(RequestList& local);  // returns true on shutdown
+  void WorkerTick(RequestList& local, bool* stop);
   void HandleArrivedRequests(const RequestList& list, ResponseList* out);
   void FuseReady(ResponseList* out);
   void StallCheck();
+  // -- response cache (negotiation control plane) -------------------------
+  // byte-counted control-plane send/recv (coordinator star only)
+  Status SendCtrl(Socket& sock, const std::string& frame);
+  Status RecvCtrl(Socket& sock, std::string* frame);
+  // split drained requests into cache claims (slot ids) vs full-path ones
+  void SplitRequests(std::vector<Request>& reqs, RequestList* full,
+                     std::vector<int>* claims);
+  // coordinator: account one rank's claim on a slot (the bitvector AND)
+  void RegisterClaim(int rank, int slot, uint64_t epoch, ResponseList* out);
+  // coordinator: feed a claim back into full negotiation as a synthesized
+  // Request (a full request arrived for the same cached name)
+  void SynthesizeClaimRequest(int rank, int slot, ResponseList* out);
+  // coordinator: a full request for a cached name invalidates the entry's
+  // steady-state path until the renegotiation resolves
+  void CheckCacheInvalidation(const Request& r, ResponseList* out);
+  // coordinator: drain fully-claimed slots into fused cached-exec groups
+  void BuildCachedExec(CachedExecFrame* ce);
+  // all ranks: cached-exec group -> executable Response (touches LRU)
+  Status DecodeCachedGroup(const std::vector<uint32_t>& group, Response* resp);
+  // all ranks: this rank's Request per response name, captured BEFORE
+  // execution erases the tensor-table entries (cache insertion input)
+  std::unordered_map<std::string, Request> SnapshotReqs(
+      const ResponseList& rl);
+  // all ranks: replicate insert/replace/evict/remove from a broadcast
+  // response list; resolves displaced claims (resend / claim clearing)
+  void ApplyCacheMutations(const ResponseList& rl,
+                           const std::unordered_map<std::string, Request>& snap);
+  // claims whose cache entry got displaced re-enter as full requests
+  void HandleDisplaced(const std::vector<std::string>& displaced);
+  // workers: adopt coordinator-tuned knobs from any response-side frame
+  void AdoptTuned(int64_t fusion, int64_t cycle_us, int64_t hier);
   void Execute(const Response& resp);
   void ExecuteAllreduce(const Response& resp,
                         std::vector<TensorEntry>& entries);
@@ -444,6 +490,37 @@ class Engine {
   std::deque<std::string> ready_;       // fully-subscribed names, FIFO
   std::deque<Response> error_ready_;    // validation failures to broadcast
 
+  // response cache (background thread only, except the atomic counters).
+  // cache_ is the coordinator-replicated slot table (cache.h documents the
+  // replication contract); the bookkeeping below implements the claim
+  // protocol around it.
+  ResponseCache cache_;
+  int64_t cache_capacity_ = 1024;       // rank 0 decides; table ships it
+  // this rank's claims sent (slot per name) awaiting cached execution or
+  // displacement; rank 0 tracks its own local claims here too
+  std::unordered_map<std::string, int> bits_inflight_;
+  // displaced claims re-entering the full path next cycle
+  std::vector<Request> resend_;
+  // coordinator: per-slot claim negotiation (the bitvector AND state)
+  struct CacheClaim {
+    std::set<int32_t> ranks;
+    std::chrono::steady_clock::time_point first_claim;
+    bool stall_warned = false;
+  };
+  std::map<int, CacheClaim> cache_claims_;
+  // slots whose entry is being renegotiated via the full path (a full
+  // request arrived for a cached name): claims convert to synthesized
+  // requests until the renegotiation's response mutates the slot
+  std::set<int> pending_invalid_;
+  std::deque<int> cached_ready_;        // fully-claimed slots, FIFO
+  // counters readable from the diagnostics thread
+  std::atomic<int64_t> cache_hits_{0};
+  std::atomic<int64_t> cache_misses_{0};
+  std::atomic<int64_t> cache_evictions_{0};
+  std::atomic<int64_t> cache_entries_{0};
+  std::atomic<int64_t> ctrl_tx_bytes_{0};
+  std::atomic<int64_t> ctrl_rx_bytes_{0};
+
   // chrome-tracing profiler, active on rank 0 when HOROVOD_TIMELINE is set;
   // emit calls outside the background thread are forbidden (SPSC ring)
   Timeline timeline_;
@@ -503,6 +580,11 @@ Status Engine::Init(const std::string& host, int port, int rank, int size) {
   // would let divergent environments skip the flag handshake on one side
   // and corrupt the peer byte stream
   int shm_on = EnvFlagIsZero("HOROVOD_TPU_SHM") ? 0 : 1;
+  // response-cache capacity: rank-0 decided and table-shipped for the same
+  // reason — divergent capacities would desynchronize the replicated slot
+  // tables and corrupt the claim protocol.  0 disables the cache.
+  cache_capacity_ = EnvInt64("HOROVOD_TPU_CACHE_CAPACITY",
+                             EnvInt64("HOROVOD_CACHE_CAPACITY", 1024));
   if (size_ > 1) {
     // data-plane listener first, so peers can connect whenever they learn
     // our address
@@ -548,8 +630,12 @@ Status Engine::Init(const std::string& host, int port, int rank, int size) {
                                      .time_since_epoch()
                                      .count() &
                                  0xffffff);
+      // version tag first: the table is the FIRST cross-.so exchange, so a
+      // mixed deployment must fail here with the same clean message the
+      // framed wire protocol gives, not with a misparsed host table
       std::ostringstream table;
-      table << shm_token << " " << shm_on << " ";
+      table << "HVDW" << kWireVersion << " " << shm_token << " " << shm_on
+            << " " << cache_capacity_ << " ";
       for (int i = 0; i < size_; i++)
         table << hosts[i] << " " << ports[i] << " " << hashes[i] << " ";
       for (int i = 1; i < size_; i++) {
@@ -571,7 +657,15 @@ Status Engine::Init(const std::string& host, int port, int rank, int size) {
       s = coord_.RecvFrame(&table);
       if (!s.ok()) return s;
       std::istringstream is(table);
-      is >> shm_token >> shm_on;
+      std::string tag;
+      is >> tag;
+      if (tag != "HVDW" + std::to_string(kWireVersion))
+        return Status::Error(
+            "wire protocol version mismatch at bootstrap: coordinator sent "
+            "table tag '" + tag + "', this engine expects 'HVDW" +
+            std::to_string(kWireVersion) +
+            "' — all ranks must load the same libhvdtpu.so");
+      is >> shm_token >> shm_on >> cache_capacity_;
       for (int i = 0; i < size_; i++) is >> hosts[i] >> ports[i] >> hashes[i];
     }
 
@@ -677,6 +771,10 @@ Status Engine::Init(const std::string& host, int port, int rank, int size) {
                                             "HOROVOD_FUSION_THRESHOLD"),
                    /*tune_cycle=*/!env_set("HOROVOD_TPU_CYCLE_TIME",
                                            "HOROVOD_CYCLE_TIME"));
+
+  cache_.Init(cache_capacity_);
+  LOG_RANK(Debug, rank_) << "response cache: capacity " << cache_.capacity()
+                         << (cache_.enabled() ? "" : " (disabled)");
 
   if (pipe2(wake_pipe_, O_NONBLOCK | O_CLOEXEC) != 0) {
     wake_pipe_[0] = wake_pipe_[1] = -1;  // degrade to pure cycle ticks
@@ -870,6 +968,10 @@ void Engine::MarkDone(int handle, Status st, std::vector<int64_t> dims,
 }
 
 void Engine::FailAll(const Status& st) {
+  // claim bookkeeping references the tensors being failed (bg thread owns
+  // all of it; FailAll only runs on the bg thread)
+  bits_inflight_.clear();
+  resend_.clear();
   std::lock_guard<std::mutex> lk(mu_);
   for (auto& [name, entry] : tensor_table_) {
     auto it = handles_.find(entry.handle);
@@ -906,13 +1008,21 @@ void Engine::BackgroundLoop() {
       }
     }
 
-    ResponseList to_execute;
     if (size_ == 1) {
-      // degenerate world: everything local is immediately ready
+      // degenerate world: everything local is immediately ready.  The
+      // cache has no wire to shrink here, but counting hits/misses and
+      // replicating insertions keeps the diagnostics meaningful at -np 1.
+      ResponseList to_execute;
       for (Request& r : local.requests) {
         timeline_.NegotiateStart(r.name, OpName(r.op));
         timeline_.NegotiateRankReady(r.name, 0);
         timeline_.NegotiateEnd(r.name);
+        if (cache_.enabled()) {
+          if (cache_.Lookup(r) >= 0)
+            cache_hits_.fetch_add(1, std::memory_order_relaxed);
+          else
+            cache_misses_.fetch_add(1, std::memory_order_relaxed);
+        }
         Response resp;
         resp.op = r.op;
         resp.names = {r.name};
@@ -921,61 +1031,25 @@ void Engine::BackgroundLoop() {
         to_execute.responses.push_back(std::move(resp));
       }
       to_execute.shutdown = local.shutdown;
+      auto snap = SnapshotReqs(to_execute);
+      for (const Response& resp : to_execute.responses) Execute(resp);
+      ApplyCacheMutations(to_execute, snap);
+      if (to_execute.shutdown) {
+        FailAll(Status::Shutdown());
+        stop = true;
+      }
     } else if (rank_ == 0) {
-      CoordinatorTick(local, &to_execute);
+      if (CoordinatorTick(local)) {
+        FailAll(Status::Shutdown());
+        stop = true;
+      }
     } else {
-      if (!local.requests.empty() || local.shutdown) {
-        Status s = coord_.SendFrame(Serialize(local));
-        if (!s.ok()) {
-          FailAll(Status::Error("lost coordinator: " + s.message));
-          break;
-        }
-      }
-      while (coord_.Readable(0)) {
-        std::string frame;
-        Status s = coord_.RecvFrame(&frame);
-        if (!s.ok()) {
-          FailAll(Status::Error("lost coordinator: " + s.message));
-          stop = true;
-          break;
-        }
-        ResponseList rl;
-        s = Parse(frame, &rl);
-        if (!s.ok()) {
-          FailAll(s);
-          stop = true;
-          break;
-        }
-        for (Response& r : rl.responses)
-          to_execute.responses.push_back(std::move(r));
-        to_execute.shutdown = to_execute.shutdown || rl.shutdown;
-        if (rl.tuned_fusion >= 0) to_execute.tuned_fusion = rl.tuned_fusion;
-        if (rl.tuned_cycle_us >= 0)
-          to_execute.tuned_cycle_us = rl.tuned_cycle_us;
-        if (rl.tuned_hierarchical >= 0)
-          to_execute.tuned_hierarchical = rl.tuned_hierarchical;
-      }
+      WorkerTick(local, &stop);
     }
 
-    // workers adopt coordinator-tuned knobs from the wire BEFORE executing
-    // the responses that carried them: the coordinator already runs the
-    // new values for these responses, and the hierarchical flag changes
-    // the collective algorithm itself — a one-response skew would make
-    // ranks exchange with incompatible patterns and hang
-    if (rank_ != 0) {
-      if (to_execute.tuned_fusion >= 0)
-        fusion_threshold_ = to_execute.tuned_fusion;
-      if (to_execute.tuned_cycle_us > 0) cycle_us_ = to_execute.tuned_cycle_us;
-      if (to_execute.tuned_hierarchical >= 0)
-        hierarchical_allreduce_ = to_execute.tuned_hierarchical != 0;
-    }
-    for (const Response& resp : to_execute.responses) Execute(resp);
-    if (to_execute.shutdown) {
-      FailAll(Status::Shutdown());
-      stop = true;
-    }
-
-    if (!stop) {
+    // a pending displaced-claim resend skips the wait: the full request
+    // should re-enter negotiation on the very next tick, not a cycle later
+    if (!stop && resend_.empty()) {
       auto elapsed = std::chrono::steady_clock::now() - cycle_start;
       auto budget = std::chrono::microseconds(cycle_us_);
       if (elapsed < budget)
@@ -1008,60 +1082,487 @@ void Engine::BackgroundLoop() {
   }
 }
 
-void Engine::CoordinatorTick(RequestList& local, ResponseList* out) {
-  // own requests
-  HandleArrivedRequests(local, out);
+Status Engine::SendCtrl(Socket& sock, const std::string& frame) {
+  ctrl_tx_bytes_.fetch_add(static_cast<int64_t>(frame.size()) + 4,
+                           std::memory_order_relaxed);
+  return sock.SendFrame(frame);
+}
+
+Status Engine::RecvCtrl(Socket& sock, std::string* frame) {
+  Status s = sock.RecvFrame(frame);
+  if (s.ok())
+    ctrl_rx_bytes_.fetch_add(static_cast<int64_t>(frame->size()) + 4,
+                             std::memory_order_relaxed);
+  return s;
+}
+
+void Engine::AdoptTuned(int64_t fusion, int64_t cycle_us, int64_t hier) {
+  // workers adopt coordinator-tuned knobs from the wire BEFORE executing
+  // the responses of the frame that carried them: the coordinator already
+  // runs the new values for those responses, and the hierarchical flag
+  // changes the collective algorithm itself — a one-response skew would
+  // make ranks exchange with incompatible patterns and hang
+  if (fusion >= 0) fusion_threshold_ = fusion;
+  if (cycle_us > 0) cycle_us_ = cycle_us;
+  if (hier >= 0) hierarchical_allreduce_ = hier != 0;
+}
+
+void Engine::SplitRequests(std::vector<Request>& reqs, RequestList* full,
+                           std::vector<int>* claims) {
+  for (Request& r : reqs) {
+    if (cache_.enabled()) {
+      int s = cache_.Lookup(r);
+      if (s >= 0) {
+        cache_hits_.fetch_add(1, std::memory_order_relaxed);
+        claims->push_back(s);
+        bits_inflight_[r.name] = s;
+        continue;
+      }
+      cache_misses_.fetch_add(1, std::memory_order_relaxed);
+    }
+    full->requests.push_back(std::move(r));
+  }
+}
+
+std::unordered_map<std::string, Request> Engine::SnapshotReqs(
+    const ResponseList& rl) {
+  std::unordered_map<std::string, Request> snap;
+  if (!cache_.enabled()) return snap;
+  std::lock_guard<std::mutex> lk(mu_);
+  for (const Response& r : rl.responses) {
+    if (r.op == OpType::kError) continue;
+    for (const std::string& nm : r.names) {
+      auto it = tensor_table_.find(nm);
+      if (it != tensor_table_.end()) snap.emplace(nm, it->second.req);
+    }
+  }
+  return snap;
+}
+
+void Engine::ApplyCacheMutations(
+    const ResponseList& rl,
+    const std::unordered_map<std::string, Request>& snap) {
+  if (!cache_.enabled()) return;
+  std::vector<std::string> displaced;
+  std::vector<int> mutated;
+  static const std::vector<int64_t> kNoDims;
+  for (const Response& r : rl.responses) {
+    if (r.op == OpType::kError) {
+      // a validation failure for a cached name removes the entry (the
+      // renegotiated signature proved stale) — replicated on every rank
+      for (const std::string& nm : r.names) {
+        bits_inflight_.erase(nm);
+        cache_.Remove(nm, &mutated);
+      }
+      continue;
+    }
+    if (r.op != OpType::kAllreduce && r.op != OpType::kAllgather &&
+        r.op != OpType::kBroadcast && r.op != OpType::kAlltoall)
+      continue;
+    for (const std::string& nm : r.names) {
+      auto it = snap.find(nm);
+      bool local = it != snap.end();
+      // a rank with no live tensor-table entry (caller released early)
+      // still inserts so slot assignments stay replicated; the entry is
+      // marked locally-unhittable
+      cache_.Upsert(nm, r.op, local ? it->second.dtype : DType::kFloat32,
+                    r.root_rank, local ? it->second.dims : kNoDims, local,
+                    r.first_dims, &displaced, &mutated);
+    }
+  }
+  cache_entries_.store(cache_.entries(), std::memory_order_relaxed);
+  cache_evictions_.store(cache_.evictions(), std::memory_order_relaxed);
+  if (rank_ == 0) {
+    // partial claims on a mutated slot are void: remote claimers observe
+    // the same mutation in their broadcast stream and re-send full
+    // requests (HandleDisplaced on their side); rank 0's own re-sends are
+    // driven by the displaced-name pass below
+    for (int s : mutated) {
+      cache_claims_.erase(s);
+      pending_invalid_.erase(s);
+    }
+  }
+  HandleDisplaced(displaced);
+}
+
+void Engine::HandleDisplaced(const std::vector<std::string>& displaced) {
+  for (const std::string& nm : displaced) {
+    auto it = bits_inflight_.find(nm);
+    if (it == bits_inflight_.end()) continue;  // no claim of ours pending
+    bits_inflight_.erase(it);
+    std::lock_guard<std::mutex> lk(mu_);
+    auto tt = tensor_table_.find(nm);
+    // still pending here (not covered by a response in this same batch):
+    // the claim died with the cache entry — fall back to the full path
+    if (tt != tensor_table_.end()) resend_.push_back(tt->second.req);
+  }
+}
+
+void Engine::SynthesizeClaimRequest(int rank, int slot, ResponseList* out) {
+  const CacheEntry* e = cache_.At(slot);
+  if (!e) return;
+  Request q;
+  q.rank = rank;
+  q.op = e->op;
+  q.dtype = e->dtype;
+  q.root_rank = e->root_rank;
+  q.name = e->name;
+  // dims[1:] are cross-rank-equal by the entry's own negotiation; dim0 is
+  // per-rank for allgather/alltoall and recorded in first_dims
+  q.dims = e->my_dims;
+  if ((e->op == OpType::kAllgather || e->op == OpType::kAlltoall) &&
+      !q.dims.empty() && rank < static_cast<int>(e->first_dims.size()))
+    q.dims[0] = e->first_dims[rank];
+  if (rank == rank_) bits_inflight_.erase(e->name);
+  RequestList rl;
+  rl.requests.push_back(std::move(q));
+  HandleArrivedRequests(rl, out);
+}
+
+void Engine::CheckCacheInvalidation(const Request& r, ResponseList* out) {
+  if (!cache_.enabled()) return;
+  int s = cache_.SlotOf(r.name);
+  if (s < 0 || pending_invalid_.count(s)) return;
+  // a full request for a cached name means some rank's signature changed
+  // (or its claim was displaced): route the WHOLE name through the full
+  // path — existing and future claims convert to synthesized requests so
+  // readiness accounting stays unified and mismatches error instead of
+  // deadlocking half-in-cache/half-in-table
+  pending_invalid_.insert(s);
+  auto it = cache_claims_.find(s);
+  if (it != cache_claims_.end()) {
+    std::set<int32_t> ranks = std::move(it->second.ranks);
+    cache_claims_.erase(it);
+    for (int32_t rk : ranks) SynthesizeClaimRequest(rk, s, out);
+  }
+}
+
+void Engine::RegisterClaim(int rank, int slot, uint64_t epoch,
+                           ResponseList* out) {
+  const CacheEntry* e = cache_.At(slot);
+  // stale claim: the slot mutated after the claimer's knowledge — drop it;
+  // the claimer observes the same mutation and re-sends the full request
+  if (!e || cache_.slot_epoch(slot) > epoch) return;
+  if (pending_invalid_.count(slot)) {
+    SynthesizeClaimRequest(rank, slot, out);
+    return;
+  }
+  CacheClaim& c = cache_claims_[slot];
+  if (c.ranks.count(rank)) {
+    Response err;
+    err.op = OpType::kError;
+    err.names = {e->name};
+    err.error_message = "rank " + std::to_string(rank) +
+                        " submitted op '" + e->name + "' twice";
+    error_ready_.push_back(std::move(err));
+    return;
+  }
+  if (c.ranks.empty()) {
+    c.first_claim = std::chrono::steady_clock::now();
+    timeline_.NegotiateStart(e->name, OpName(e->op));
+  }
+  c.ranks.insert(rank);
+  timeline_.NegotiateRankReady(e->name, rank);
+  if (static_cast<int>(c.ranks.size()) == size_) {
+    timeline_.NegotiateEnd(e->name);
+    cached_ready_.push_back(slot);
+    cache_claims_.erase(slot);
+  }
+}
+
+void Engine::BuildCachedExec(CachedExecFrame* ce) {
+  while (!cached_ready_.empty()) {
+    int lead = cached_ready_.front();
+    cached_ready_.pop_front();
+    const CacheEntry* e = cache_.At(lead);
+    if (!e) continue;  // mutated since completion (defensive)
+    std::vector<uint32_t> group{static_cast<uint32_t>(lead)};
+    if (e->op == OpType::kAllreduce) {
+      // fuse ready cached allreduces exactly like FuseReady: same-dtype
+      // look-ahead past non-matching slots up to the fusion threshold, so
+      // enabling the cache never UN-fuses the steady-state data plane
+      int64_t bytes = NumElems(e->my_dims) *
+                      static_cast<int64_t>(DTypeSize(e->dtype));
+      for (auto it = cached_ready_.begin();
+           it != cached_ready_.end() && bytes < fusion_threshold_;) {
+        const CacheEntry* n = cache_.At(*it);
+        if (!n) {
+          it = cached_ready_.erase(it);
+          continue;
+        }
+        if (n->op != OpType::kAllreduce || n->dtype != e->dtype) {
+          ++it;
+          continue;
+        }
+        int64_t nb = NumElems(n->my_dims) *
+                     static_cast<int64_t>(DTypeSize(n->dtype));
+        if (bytes + nb > fusion_threshold_) {
+          ++it;
+          continue;
+        }
+        bytes += nb;
+        group.push_back(static_cast<uint32_t>(*it));
+        it = cached_ready_.erase(it);
+      }
+    }
+    ce->groups.push_back(std::move(group));
+  }
+}
+
+Status Engine::DecodeCachedGroup(const std::vector<uint32_t>& group,
+                                 Response* resp) {
+  if (group.empty()) return Status::Error("empty cached-exec group");
+  for (uint32_t id : group) {
+    const CacheEntry* e = cache_.At(static_cast<int>(id));
+    if (!e)
+      return Status::Error(
+          "cached-exec referenced an empty cache slot — response cache "
+          "replica divergence");
+    if (resp->names.empty()) {
+      resp->op = e->op;
+      resp->root_rank = e->root_rank;
+      resp->first_dims = e->first_dims;
+    }
+    resp->names.push_back(e->name);
+    cache_.Touch(static_cast<int>(id));
+    bits_inflight_.erase(e->name);
+  }
+  return Status::OK();
+}
+
+void Engine::WorkerTick(RequestList& local, bool* stop) {
+  // displaced claims re-enter as full requests ahead of this cycle's batch
+  if (!resend_.empty()) {
+    local.requests.insert(local.requests.begin(),
+                          std::make_move_iterator(resend_.begin()),
+                          std::make_move_iterator(resend_.end()));
+    resend_.clear();
+  }
+  RequestList full;
+  full.shutdown = local.shutdown;
+  std::vector<int> claims;
+  SplitRequests(local.requests, &full, &claims);
+  if (!claims.empty()) {
+    CacheBitsFrame cb;
+    cb.rank = rank_;
+    cb.epoch = cache_.epoch();
+    cb.bits.assign(static_cast<size_t>(cache_.high_water() + 7) / 8, 0);
+    for (int s : claims) cb.bits[s >> 3] |= static_cast<uint8_t>(1u << (s & 7));
+    Status s = SendCtrl(coord_, Serialize(cb));
+    if (!s.ok()) {
+      FailAll(Status::Error("lost coordinator: " + s.message));
+      *stop = true;
+      return;
+    }
+  }
+  if (!full.requests.empty() || full.shutdown) {
+    Status s = SendCtrl(coord_, Serialize(full));
+    if (!s.ok()) {
+      FailAll(Status::Error("lost coordinator: " + s.message));
+      *stop = true;
+      return;
+    }
+  }
+  // frames execute strictly in arrival order — cached-exec groups decode
+  // against the cache state BEFORE any later frame's mutations apply,
+  // mirroring the coordinator's emit-then-mutate tick order
+  bool got_shutdown = false;
+  while (coord_.Readable(0)) {
+    std::string frame;
+    Status s = RecvCtrl(coord_, &frame);
+    if (!s.ok()) {
+      FailAll(Status::Error("lost coordinator: " + s.message));
+      *stop = true;
+      return;
+    }
+    FrameType ft = FrameTypeOf(frame);
+    if (ft == FrameType::kCachedExec) {
+      CachedExecFrame ce;
+      s = Parse(frame, &ce);
+      if (!s.ok()) {
+        FailAll(s);
+        *stop = true;
+        return;
+      }
+      AdoptTuned(ce.tuned_fusion, ce.tuned_cycle_us, ce.tuned_hierarchical);
+      for (const auto& g : ce.groups) {
+        Response resp;
+        s = DecodeCachedGroup(g, &resp);
+        if (!s.ok()) {
+          FailAll(s);
+          *stop = true;
+          return;
+        }
+        Execute(resp);
+      }
+    } else if (ft == FrameType::kResponseList) {
+      ResponseList rl;
+      s = Parse(frame, &rl);
+      if (!s.ok()) {
+        FailAll(s);
+        *stop = true;
+        return;
+      }
+      AdoptTuned(rl.tuned_fusion, rl.tuned_cycle_us, rl.tuned_hierarchical);
+      auto snap = SnapshotReqs(rl);
+      for (const Response& r : rl.responses) Execute(r);
+      ApplyCacheMutations(rl, snap);
+      got_shutdown = got_shutdown || rl.shutdown;
+    } else {
+      // surface the descriptive version-mismatch error, not just "invalid"
+      ResponseList probe;
+      Status ps = Parse(frame, &probe);
+      FailAll(ps.ok() ? Status::Error("unrecognized control frame") : ps);
+      *stop = true;
+      return;
+    }
+  }
+  if (got_shutdown) {
+    FailAll(Status::Shutdown());
+    *stop = true;
+  }
+}
+
+bool Engine::CoordinatorTick(RequestList& local) {
+  // displaced own-claims re-enter as full requests ahead of this batch
+  if (!resend_.empty()) {
+    local.requests.insert(local.requests.begin(),
+                          std::make_move_iterator(resend_.begin()),
+                          std::make_move_iterator(resend_.end()));
+    resend_.clear();
+  }
+  ResponseList out;
+  // own requests: cache claims register directly; misses negotiate fully
+  RequestList own_full;
+  std::vector<int> own_claims;
+  SplitRequests(local.requests, &own_full, &own_claims);
+  for (int s : own_claims) RegisterClaim(0, s, cache_.epoch(), &out);
+  for (const Request& r : own_full.requests) CheckCacheInvalidation(r, &out);
+  HandleArrivedRequests(own_full, &out);
   bool shutdown = local.shutdown;
-  // worker requests
+  // worker frames
   for (int i = 1; i < size_; i++) {
     while (workers_[i].valid() && workers_[i].Readable(0)) {
       std::string frame;
-      Status s = workers_[i].RecvFrame(&frame);
+      Status s = RecvCtrl(workers_[i], &frame);
       if (!s.ok()) {
         LogWarn("worker " + std::to_string(i) + " lost: " + s.message);
         workers_[i].Close();
         shutdown = true;
         break;
       }
-      RequestList rl;
-      s = Parse(frame, &rl);
-      if (!s.ok()) {
-        LogWarn("bad frame from worker: " + s.message);
+      FrameType ft = FrameTypeOf(frame);
+      if (ft == FrameType::kRequestList) {
+        RequestList rl;
+        s = Parse(frame, &rl);
+        if (!s.ok()) {
+          LogWarn("bad frame from worker: " + s.message);
+          shutdown = true;
+          break;
+        }
+        for (const Request& r : rl.requests) CheckCacheInvalidation(r, &out);
+        HandleArrivedRequests(rl, &out);
+        shutdown = shutdown || rl.shutdown;
+      } else if (ft == FrameType::kCacheBits) {
+        CacheBitsFrame cb;
+        s = Parse(frame, &cb);
+        if (!s.ok()) {
+          LogWarn("bad cache-bits frame from worker: " + s.message);
+          shutdown = true;
+          break;
+        }
+        for (size_t b = 0; b < cb.bits.size(); b++) {
+          uint8_t byte = cb.bits[b];
+          for (int k = 0; byte != 0; k++, byte >>= 1)
+            if (byte & 1u)
+              RegisterClaim(cb.rank, static_cast<int>(b * 8) + k, cb.epoch,
+                            &out);
+        }
+      } else {
+        RequestList probe;
+        Status ps = Parse(frame, &probe);
+        LogWarn(ps.ok() ? "unrecognized control frame from worker"
+                        : "bad frame from worker: " + ps.message);
         shutdown = true;
         break;
       }
-      HandleArrivedRequests(rl, out);
-      shutdown = shutdown || rl.shutdown;
     }
   }
-  FuseReady(out);
+  // globally-hit cache entries execute via compact slot groups...
+  CachedExecFrame ce;
+  BuildCachedExec(&ce);
+  // ...while misses take the full fuse path; stalls are watched on both
+  FuseReady(&out);
   if (stall_check_) StallCheck();
-  out->shutdown = shutdown;
-  if (pending_tuned_fusion_ >= 0 || pending_tuned_cycle_ >= 0 ||
-      pending_tuned_hier_ >= 0) {
-    out->tuned_fusion = pending_tuned_fusion_;
-    out->tuned_cycle_us = pending_tuned_cycle_;
-    out->tuned_hierarchical = pending_tuned_hier_;
+  out.shutdown = shutdown;
+  bool have_ce = !ce.groups.empty();
+  bool have_tuned = pending_tuned_fusion_ >= 0 || pending_tuned_cycle_ >= 0 ||
+                    pending_tuned_hier_ >= 0;
+  bool have_rl = !out.responses.empty() || out.shutdown ||
+                 (have_tuned && !have_ce);
+  if (have_tuned) {
+    // tuned knobs ride the FIRST frame sent this tick: workers adopt
+    // before executing that frame's responses, and the cached-exec frame
+    // precedes the response list — knobs on the later frame would let
+    // workers run the tick's cached groups under the old algorithm while
+    // rank 0 already runs the new one (the one-frame skew AdoptTuned's
+    // contract forbids).  On all-cached cycles this also keeps autotune
+    // sync from stalling behind a response list steady state no longer
+    // produces.
+    if (have_ce) {
+      ce.tuned_fusion = pending_tuned_fusion_;
+      ce.tuned_cycle_us = pending_tuned_cycle_;
+      ce.tuned_hierarchical = pending_tuned_hier_;
+    } else {
+      out.tuned_fusion = pending_tuned_fusion_;
+      out.tuned_cycle_us = pending_tuned_cycle_;
+      out.tuned_hierarchical = pending_tuned_hier_;
+    }
   }
-  if (!out->responses.empty() || out->shutdown ||
-      out->tuned_fusion >= 0 || out->tuned_cycle_us >= 0 ||
-      out->tuned_hierarchical >= 0) {
-    std::string frame = Serialize(*out);
-    bool sent = true;
+  bool sent = true;
+  if (have_ce) {
+    std::string frame = Serialize(ce);
     for (int i = 1; i < size_; i++) {
       if (!workers_[i].valid()) continue;
-      Status s = workers_[i].SendFrame(frame);
+      Status s = SendCtrl(workers_[i], frame);
       if (!s.ok()) {
         LogWarn("send to worker failed: " + s.message);
         sent = false;
       }
     }
-    if (sent) {
-      pending_tuned_fusion_ = -1;
-      pending_tuned_cycle_ = -1;
-      pending_tuned_hier_ = -1;
+  }
+  if (have_rl) {
+    std::string frame = Serialize(out);
+    for (int i = 1; i < size_; i++) {
+      if (!workers_[i].valid()) continue;
+      Status s = SendCtrl(workers_[i], frame);
+      if (!s.ok()) {
+        LogWarn("send to worker failed: " + s.message);
+        sent = false;
+      }
     }
   }
+  if (sent && have_tuned) {
+    pending_tuned_fusion_ = -1;
+    pending_tuned_cycle_ = -1;
+    pending_tuned_hier_ = -1;
+  }
+  // local execution mirrors the wire order exactly: cached groups first,
+  // then full responses, then the full responses' cache mutations
+  if (have_ce) timeline_.CachedNegotiation();
+  for (const auto& g : ce.groups) {
+    Response resp;
+    Status st = DecodeCachedGroup(g, &resp);
+    if (!st.ok()) {
+      FailAll(st);
+      return true;
+    }
+    Execute(resp);
+  }
+  auto snap = SnapshotReqs(out);
+  for (const Response& r : out.responses) Execute(r);
+  ApplyCacheMutations(out, snap);
+  return shutdown;
 }
 
 void Engine::HandleArrivedRequests(const RequestList& list,
@@ -1194,25 +1695,43 @@ void Engine::FuseReady(ResponseList* out) {
 
 void Engine::StallCheck() {
   auto now = std::chrono::steady_clock::now();
+  auto warn = [&](const std::string& what, const std::set<int32_t>& ranks) {
+    std::ostringstream os;
+    os << what << " for ranks [";
+    bool first = true;
+    for (int r = 0; r < size_; r++) {
+      if (!ranks.count(r)) {
+        os << (first ? "" : ",") << r;
+        first = false;
+      }
+    }
+    os << "] — possible stall (one rank may have skipped this op)";
+    LogWarn(os.str());
+    stall_events_.fetch_add(1, std::memory_order_relaxed);
+  };
   for (auto& [name, neg] : message_table_) {
     if (neg.stall_warned || neg.received.empty()) continue;
     double age =
         std::chrono::duration<double>(now - neg.first_arrival).count();
     if (age > stall_warn_s_) {
-      std::ostringstream os;
-      os << "op '" << name << "' has waited " << static_cast<int>(age)
-         << "s for ranks [";
-      bool first = true;
-      for (int r = 0; r < size_; r++) {
-        if (!neg.ranks.count(r)) {
-          os << (first ? "" : ",") << r;
-          first = false;
-        }
-      }
-      os << "] — possible stall (one rank may have skipped this op)";
-      LogWarn(os.str());
+      warn("op '" + name + "' has waited " +
+               std::to_string(static_cast<int>(age)) + "s",
+           neg.ranks);
       neg.stall_warned = true;
-      stall_events_.fetch_add(1, std::memory_order_relaxed);
+    }
+  }
+  // partially-claimed cache slots stall the same way a partially-arrived
+  // full negotiation does — same watchdog, same counter
+  for (auto& [slot, claim] : cache_claims_) {
+    if (claim.stall_warned || claim.ranks.empty()) continue;
+    double age =
+        std::chrono::duration<double>(now - claim.first_claim).count();
+    if (age > stall_warn_s_) {
+      const CacheEntry* e = cache_.At(slot);
+      warn("cached op '" + (e ? e->name : std::to_string(slot)) +
+               "' has waited " + std::to_string(static_cast<int>(age)) + "s",
+           claim.ranks);
+      claim.stall_warned = true;
     }
   }
 }
@@ -2082,6 +2601,19 @@ int hvd_autotune_converged() {
 // the telemetry registry so stalls are queryable, not just stderr noise.
 int64_t hvd_stall_events() {
   return g_engine ? g_engine->StallEvents() : -1;
+}
+
+// Response-cache + control-plane statistics for this rank, in order:
+// {cache hits, cache misses, evictions, live entries, control-plane bytes
+// sent, control-plane bytes received}.  All -1 when the engine is down.
+// Python mirrors these into the telemetry registry (hvd_cache_hits /
+// hvd_cache_misses / hvd_negotiation_bytes).
+void hvd_cache_stats(int64_t* out) {
+  if (!g_engine) {
+    for (int i = 0; i < 6; i++) out[i] = -1;
+    return;
+  }
+  g_engine->CacheStats(out);
 }
 
 // Diagnostic: standalone throughput (GB/s of dst bytes) of the in-place

@@ -132,6 +132,11 @@ void Timeline::MarkCycleStart() {
   Push(TimelineRecordType::kInstant, 0, "CYCLE_START");
 }
 
+void Timeline::CachedNegotiation() {
+  if (!enabled_) return;
+  Push(TimelineRecordType::kInstant, 0, "CACHED_NEGOTIATION");
+}
+
 void Timeline::WriterLoop() {
   FILE* f = fopen(path_.c_str(), "w");
   if (!f) {

@@ -58,6 +58,10 @@ class Timeline {
   void ActivityEnd(const std::string& tensor);
   void End(const std::string& tensor);
   void MarkCycleStart();
+  // Instant mark on the cycle lane when a tick executes response-cache
+  // groups — makes cached (bitvector-negotiated) cycles visible next to
+  // the full NEGOTIATE_* phases they replaced.
+  void CachedNegotiation();
 
  private:
   int64_t TensorLane(const std::string& tensor);

@@ -4,8 +4,11 @@ namespace hvdtpu {
 
 namespace {
 
+void PutU16(std::string* s, uint16_t v) { s->append(reinterpret_cast<char*>(&v), 2); }
+void PutU32(std::string* s, uint32_t v) { s->append(reinterpret_cast<char*>(&v), 4); }
 void PutI32(std::string* s, int32_t v) { s->append(reinterpret_cast<char*>(&v), 4); }
 void PutI64(std::string* s, int64_t v) { s->append(reinterpret_cast<char*>(&v), 8); }
+void PutU64(std::string* s, uint64_t v) { s->append(reinterpret_cast<char*>(&v), 8); }
 void PutStr(std::string* s, const std::string& v) {
   PutI64(s, static_cast<int64_t>(v.size()));
   s->append(v);
@@ -13,6 +16,11 @@ void PutStr(std::string* s, const std::string& v) {
 void PutDims(std::string* s, const std::vector<int64_t>& dims) {
   PutI64(s, static_cast<int64_t>(dims.size()));
   for (int64_t d : dims) PutI64(s, d);
+}
+void PutHeader(std::string* s, FrameType t) {
+  PutU32(s, kWireMagic);
+  PutU16(s, kWireVersion);
+  PutU16(s, static_cast<uint16_t>(t));
 }
 
 struct Reader {
@@ -27,6 +35,20 @@ struct Reader {
     }
     return true;
   }
+  uint16_t U16() {
+    if (!Need(2)) return 0;
+    uint16_t v;
+    std::memcpy(&v, buf.data() + off, 2);
+    off += 2;
+    return v;
+  }
+  uint32_t U32() {
+    if (!Need(4)) return 0;
+    uint32_t v;
+    std::memcpy(&v, buf.data() + off, 4);
+    off += 4;
+    return v;
+  }
   int32_t I32() {
     if (!Need(4)) return 0;
     int32_t v;
@@ -37,6 +59,13 @@ struct Reader {
   int64_t I64() {
     if (!Need(8)) return 0;
     int64_t v;
+    std::memcpy(&v, buf.data() + off, 8);
+    off += 8;
+    return v;
+  }
+  uint64_t U64() {
+    if (!Need(8)) return 0;
+    uint64_t v;
     std::memcpy(&v, buf.data() + off, 8);
     off += 8;
     return v;
@@ -64,10 +93,47 @@ struct Reader {
   }
 };
 
+// Consumes and validates the 8-byte frame header; mismatches become clean
+// errors instead of misparsed fields (the version guard).
+Status ReadHeader(Reader* rd, FrameType expect) {
+  uint32_t magic = rd->U32();
+  uint16_t version = rd->U16();
+  uint16_t type = rd->U16();
+  if (rd->fail || magic != kWireMagic)
+    return Status::Error("control frame lacks the HVDW wire magic");
+  if (version != kWireVersion)
+    return Status::Error("wire protocol version mismatch: peer speaks v" +
+                         std::to_string(version) + ", this engine v" +
+                         std::to_string(kWireVersion) +
+                         " — all ranks must load the same libhvdtpu.so");
+  if (type != static_cast<uint16_t>(expect))
+    return Status::Error("unexpected frame type " + std::to_string(type) +
+                         " (wanted " +
+                         std::to_string(static_cast<uint16_t>(expect)) + ")");
+  return Status::OK();
+}
+
 }  // namespace
+
+FrameType FrameTypeOf(const std::string& buf) {
+  Reader rd{buf};
+  uint32_t magic = rd.U32();
+  uint16_t version = rd.U16();
+  uint16_t type = rd.U16();
+  if (rd.fail || magic != kWireMagic || version != kWireVersion) {
+    // kInvalid also covers version skew; the typed Parse produces the
+    // descriptive error message
+    return FrameType::kInvalid;
+  }
+  if (type < static_cast<uint16_t>(FrameType::kRequestList) ||
+      type > static_cast<uint16_t>(FrameType::kCachedExec))
+    return FrameType::kInvalid;
+  return static_cast<FrameType>(type);
+}
 
 std::string Serialize(const RequestList& l) {
   std::string s;
+  PutHeader(&s, FrameType::kRequestList);
   PutI32(&s, l.shutdown ? 1 : 0);
   PutI64(&s, static_cast<int64_t>(l.requests.size()));
   for (const Request& r : l.requests) {
@@ -83,6 +149,8 @@ std::string Serialize(const RequestList& l) {
 
 Status Parse(const std::string& buf, RequestList* out) {
   Reader rd{buf};
+  Status hs = ReadHeader(&rd, FrameType::kRequestList);
+  if (!hs.ok()) return hs;
   out->shutdown = rd.I32() != 0;
   int64_t n = rd.I64();
   if (n < 0 || n > (1 << 24)) return Status::Error("bad request count");
@@ -104,6 +172,7 @@ Status Parse(const std::string& buf, RequestList* out) {
 
 std::string Serialize(const ResponseList& l) {
   std::string s;
+  PutHeader(&s, FrameType::kResponseList);
   PutI32(&s, l.shutdown ? 1 : 0);
   PutI64(&s, l.tuned_fusion);
   PutI64(&s, l.tuned_cycle_us);
@@ -122,6 +191,8 @@ std::string Serialize(const ResponseList& l) {
 
 Status Parse(const std::string& buf, ResponseList* out) {
   Reader rd{buf};
+  Status hs = ReadHeader(&rd, FrameType::kResponseList);
+  if (!hs.ok()) return hs;
   out->shutdown = rd.I32() != 0;
   out->tuned_fusion = rd.I64();
   out->tuned_cycle_us = rd.I64();
@@ -141,6 +212,74 @@ Status Parse(const std::string& buf, ResponseList* out) {
     r.first_dims = rd.Dims();
     if (rd.fail) return Status::Error("truncated response list");
     out->responses.push_back(std::move(r));
+  }
+  return Status::OK();
+}
+
+std::string Serialize(const CacheBitsFrame& f) {
+  std::string s;
+  PutHeader(&s, FrameType::kCacheBits);
+  PutI32(&s, f.rank);
+  PutU64(&s, f.epoch);
+  PutI64(&s, static_cast<int64_t>(f.bits.size()));
+  s.append(reinterpret_cast<const char*>(f.bits.data()), f.bits.size());
+  return s;
+}
+
+Status Parse(const std::string& buf, CacheBitsFrame* out) {
+  Reader rd{buf};
+  Status hs = ReadHeader(&rd, FrameType::kCacheBits);
+  if (!hs.ok()) return hs;
+  out->rank = rd.I32();
+  out->epoch = rd.U64();
+  int64_t n = rd.I64();
+  // 1 MB of bits = 8M cache slots: far beyond any sane capacity
+  if (rd.fail || n < 0 || n > (1 << 20) || !rd.Need(static_cast<size_t>(n)))
+    return Status::Error("truncated cache-bits frame");
+  out->bits.assign(buf.data() + rd.off, buf.data() + rd.off + n);
+  return Status::OK();
+}
+
+std::string Serialize(const CachedExecFrame& f) {
+  std::string s;
+  PutHeader(&s, FrameType::kCachedExec);
+  PutI64(&s, f.tuned_fusion);
+  PutI64(&s, f.tuned_cycle_us);
+  PutI64(&s, f.tuned_hierarchical);
+  PutI64(&s, static_cast<int64_t>(f.groups.size()));
+  for (const auto& g : f.groups) {
+    PutI64(&s, static_cast<int64_t>(g.size()));
+    for (uint32_t id : g) PutU32(&s, id);
+  }
+  return s;
+}
+
+Status Parse(const std::string& buf, CachedExecFrame* out) {
+  Reader rd{buf};
+  Status hs = ReadHeader(&rd, FrameType::kCachedExec);
+  if (!hs.ok()) return hs;
+  out->tuned_fusion = rd.I64();
+  out->tuned_cycle_us = rd.I64();
+  out->tuned_hierarchical = rd.I64();
+  int64_t ng = rd.I64();
+  // bound counts by what the buffer could possibly hold BEFORE reserving:
+  // a corrupt count must produce the clean parse error, not a multi-hundred
+  // MB reserve and bad_alloc (each group needs >= 8 bytes, each id 4)
+  if (rd.fail || ng < 0 ||
+      ng > static_cast<int64_t>((buf.size() - rd.off) / 8))
+    return Status::Error("bad cached group count");
+  out->groups.clear();
+  out->groups.reserve(static_cast<size_t>(ng));
+  for (int64_t i = 0; i < ng; i++) {
+    int64_t n = rd.I64();
+    if (rd.fail || n < 0 ||
+        n > static_cast<int64_t>((buf.size() - rd.off) / 4))
+      return Status::Error("bad cached id count");
+    std::vector<uint32_t> g;
+    g.reserve(static_cast<size_t>(n));
+    for (int64_t j = 0; j < n && !rd.fail; j++) g.push_back(rd.U32());
+    if (rd.fail) return Status::Error("truncated cached-exec frame");
+    out->groups.push_back(std::move(g));
   }
   return Status::OK();
 }

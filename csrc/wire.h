@@ -1,9 +1,16 @@
 // Control-plane wire protocol: worker->coordinator request lists and
-// coordinator->worker response lists.  Role analog: the reference's
-// MPIRequest/MPIResponse flatbuffers (horovod/common/mpi_message.h,
-// common/wire/mpi_message.fbs) — re-designed as a hand-rolled, dependency-
-// free, length-prefixed binary encoding (the schema is 6 fields; a
-// serialization library buys nothing here).
+// coordinator->worker response lists, plus the steady-state response-cache
+// frames.  Role analog: the reference's MPIRequest/MPIResponse flatbuffers
+// (horovod/common/mpi_message.h, common/wire/mpi_message.fbs) — re-designed
+// as a hand-rolled, dependency-free, length-prefixed binary encoding (the
+// schema is a handful of fields; a serialization library buys nothing here).
+//
+// Every frame starts with an 8-byte header {magic, version, frame type}.
+// The version guards a mixed deployment (one rank dlopening a stale .so):
+// a header mismatch parses into a clean error naming both versions instead
+// of silently misreading fields.  Python mirrors these constants in
+// horovod_tpu/runtime/wire_abi.py; tools/check_wire_abi.py asserts the two
+// stay in sync.
 #pragma once
 
 #include <cstdint>
@@ -13,6 +20,18 @@
 #include "common.h"
 
 namespace hvdtpu {
+
+// Bump kWireVersion on ANY layout change (header, field order, new frame).
+constexpr uint32_t kWireMagic = 0x48564457u;  // "HVDW" little-endian
+constexpr uint16_t kWireVersion = 2;          // v2: header + cache frames
+
+enum class FrameType : uint16_t {
+  kInvalid = 0,
+  kRequestList = 1,   // worker -> coordinator: full negotiation path
+  kResponseList = 2,  // coordinator -> worker: full responses + tuned knobs
+  kCacheBits = 3,     // worker -> coordinator: cache-hit bitvector claims
+  kCachedExec = 4,    // coordinator -> worker: execute cached slot groups
+};
 
 struct Request {
   int32_t rank = 0;
@@ -48,10 +67,41 @@ struct ResponseList {
   int64_t tuned_hierarchical = -1;  // 0/1 when the autotuner owns the knob
 };
 
+// Steady-state claim: "every cache slot whose bit is set holds an entry
+// matching one of my pending requests" — O(slots/8) bytes replacing
+// O(tensors x name-length) Request frames.  epoch is the sender's cache
+// epoch at claim time; the coordinator uses it to reject claims on slots
+// mutated after the sender's knowledge (the claimer re-sends the full
+// request once it applies the mutation).
+struct CacheBitsFrame {
+  int32_t rank = 0;
+  uint64_t epoch = 0;
+  std::vector<uint8_t> bits;  // bit s => claim on cache slot s
+};
+
+// "Execute cached ids": each group is a list of cache slot ids executing
+// as one fused response, in coordinator-broadcast order.  Carries the same
+// tuned-knob sync as ResponseList so autotuner updates still ship on
+// all-cached cycles.
+struct CachedExecFrame {
+  std::vector<std::vector<uint32_t>> groups;
+  int64_t tuned_fusion = -1;
+  int64_t tuned_cycle_us = -1;
+  int64_t tuned_hierarchical = -1;
+};
+
+// Frame dispatch: the type a buffer claims to carry (kInvalid when the
+// buffer is too short or the magic/version doesn't match).
+FrameType FrameTypeOf(const std::string& buf);
+
 // Serialization (little-endian host assumed; single-arch clusters).
 std::string Serialize(const RequestList& l);
 std::string Serialize(const ResponseList& l);
+std::string Serialize(const CacheBitsFrame& f);
+std::string Serialize(const CachedExecFrame& f);
 Status Parse(const std::string& buf, RequestList* out);
 Status Parse(const std::string& buf, ResponseList* out);
+Status Parse(const std::string& buf, CacheBitsFrame* out);
+Status Parse(const std::string& buf, CachedExecFrame* out);
 
 }  // namespace hvdtpu

@@ -23,6 +23,8 @@ bound.  Horovod semantic notes:
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -95,17 +97,30 @@ def allreduce(tensor, axis_name: str, average: bool = True, op: str = "sum"):
     raise ValueError(f"unknown op {op!r}")
 
 
+@functools.lru_cache(maxsize=1)
 def _bucket_bytes() -> int:
     """Bucket size for grouped reductions — the compiled-path analog of the
     reference's fusion-buffer threshold, honoring the same env knob
     (``HOROVOD_FUSION_THRESHOLD``, default 64 MB;
-    ``/root/reference/horovod/common/operations.cc:1838``)."""
+    ``/root/reference/horovod/common/operations.cc:1838``).
+
+    Parsed once per process (``lru_cache``): this runs inside ``jit``
+    tracing of every grouped allreduce, so re-reading the environment per
+    call is pure overhead.  Tests that change the env call
+    ``_bucket_bytes.cache_clear()``.
+    """
     import os
 
     for name in ("HOROVOD_TPU_FUSION_THRESHOLD", "HOROVOD_FUSION_THRESHOLD"):
         v = os.environ.get(name)
         if v:
-            return max(int(v), 1)
+            try:
+                return max(int(v), 1)
+            except ValueError:
+                raise ValueError(
+                    f"{name}={v!r} is not an integer byte count; set it to "
+                    "e.g. 67108864 (64 MB) or unset it for the default"
+                ) from None
     return 64 * 1024 * 1024
 
 

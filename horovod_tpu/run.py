@@ -57,6 +57,15 @@ def main(argv=None) -> int:
                          "per-rank dumps into DIR (sets "
                          "HOROVOD_TPU_METRICS_DIR; summarize with "
                          "`python -m horovod_tpu.telemetry summarize DIR`)")
+    ap.add_argument("--cache-capacity", type=int, default=None,
+                    metavar="N",
+                    help="negotiation response-cache capacity in entries "
+                         "(sets HOROVOD_TPU_CACHE_CAPACITY for every "
+                         "worker; 0 disables the cache, default 1024). "
+                         "Steady-state training negotiates the same "
+                         "tensors every step — cached cycles swap the "
+                         "per-tensor name lists for fixed-size bitvector "
+                         "frames")
     ap.add_argument("command", nargs=argparse.REMAINDER)
     args = ap.parse_args(argv)
 
@@ -134,6 +143,8 @@ def main(argv=None) -> int:
             env["HOROVOD_TIMELINE"] = args.timeline
         if args.metrics_dir:
             env["HOROVOD_TPU_METRICS_DIR"] = args.metrics_dir
+        if args.cache_capacity is not None:
+            env["HOROVOD_TPU_CACHE_CAPACITY"] = str(args.cache_capacity)
         # each worker leads its own process group so a stuck worker's whole
         # subtree can be killed
         procs.append(subprocess.Popen(cmd, env=env, start_new_session=True))

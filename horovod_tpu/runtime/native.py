@@ -56,6 +56,20 @@ def _csrc_dir() -> str:
     )
 
 
+def stale_sources(csrc_dir: str, so_path: str) -> list[str]:
+    """Source files newer than the built library — the single staleness
+    predicate shared by the on-demand rebuild below and the test suite's
+    skip guard (``tests/conftest.py::native_so_status``), so the two can
+    never drift on what counts as a source."""
+    if not os.path.exists(so_path):
+        return ["<library missing>"]
+    so_mtime = os.path.getmtime(so_path)
+    return sorted(
+        f for f in os.listdir(csrc_dir)
+        if (f.endswith((".cc", ".h")) or f == "Makefile")
+        and os.path.getmtime(os.path.join(csrc_dir, f)) > so_mtime)
+
+
 def _installed_so() -> str | None:
     """`pip install` ships the engine as package data next to horovod_tpu's
     __init__ (built by setup.py's build_py); prefer it when there is no
@@ -86,15 +100,7 @@ def _load_lib():
             _lib_path = so
             return _lib
         so = os.path.join(_csrc_dir(), "libhvdtpu.so")
-        sources = [
-            os.path.join(_csrc_dir(), f)
-            for f in os.listdir(_csrc_dir())
-            if f.endswith((".cc", ".h")) or f == "Makefile"
-        ]
-        stale = not os.path.exists(so) or any(
-            os.path.getmtime(src) > os.path.getmtime(so) for src in sources
-        )
-        if stale:
+        if stale_sources(_csrc_dir(), so):
             # (re)build on demand; the toolchain is a framework requirement.
             # flock serializes concurrently-launched worker processes (all
             # ranks hit this path after a source edit) so only one make runs
@@ -104,11 +110,8 @@ def _load_lib():
             with open(os.path.join(_csrc_dir(), ".build.lock"), "w") as lk:
                 fcntl.flock(lk, fcntl.LOCK_EX)
                 try:
-                    still_stale = not os.path.exists(so) or any(
-                        os.path.getmtime(src) > os.path.getmtime(so)
-                        for src in sources
-                    )
-                    if still_stale:
+                    # re-check under the lock: another rank may have built
+                    if stale_sources(_csrc_dir(), so):
                         subprocess.run(
                             ["make", "-C", _csrc_dir()], check=True,
                             capture_output=True,
@@ -163,6 +166,12 @@ def _bind(lib):
         # added after the first release; a prebuilt .so pointed at via
         # HOROVOD_TPU_NATIVE_LIB may predate it
         lib.hvd_stall_events.restype = ctypes.c_int64
+    except AttributeError:
+        pass
+    try:
+        # response-cache stats (PR 2); same prebuilt-.so caveat
+        lib.hvd_cache_stats.argtypes = [ctypes.POINTER(ctypes.c_int64)]
+        lib.hvd_cache_stats.restype = None
     except AttributeError:
         pass
     return lib
@@ -225,14 +234,31 @@ class NativeEngine(Engine):
     def diagnostics(self) -> dict:
         """Engine introspection: the allreduce algorithm currently in use,
         whether this rank's autotuner finished its search (rank 0 owns the
-        search), and how many negotiation stalls the coordinator has warned
-        about — lets tests and monitors assert these directly instead of
-        scraping stderr."""
-        return {
+        search), how many negotiation stalls the coordinator has warned
+        about, and the response-cache/control-plane counters — lets tests
+        and monitors assert these directly instead of scraping stderr."""
+        d = {
             "hierarchical": int(self._lib.hvd_hierarchical()),
             "autotune_converged": int(self._lib.hvd_autotune_converged()),
             "stall_events": self._stall_events(),
         }
+        d.update(self._cache_stats())
+        return d
+
+    def _cache_stats(self) -> dict:
+        """Response-cache and control-plane counters for THIS rank (hits
+        and misses count this rank's own steady-state lookups; negotiation
+        bytes cover every frame this rank sent/received on the coordinator
+        star).  Zeros when the loaded .so predates the cache."""
+        fn = getattr(self._lib, "hvd_cache_stats", None)
+        keys = ("cache_hits", "cache_misses", "cache_evictions",
+                "cache_entries", "negotiation_bytes_tx",
+                "negotiation_bytes_rx")
+        if fn is None:
+            return dict.fromkeys(keys, 0)
+        vals = (ctypes.c_int64 * 6)()
+        fn(vals)
+        return {k: max(int(v), 0) for k, v in zip(keys, vals)}
 
     def _stall_events(self) -> int:
         """Coordinator stall-warning count (rank 0 owns the check; other
@@ -255,23 +281,36 @@ class NativeEngine(Engine):
         # collector() call (shutdown, user snapshot) may race, and both
         # seeing the same stale value would double-count a stall
         mirror_lock = threading.Lock()
-        # per-ENGINE last-seen count, not a diff against the registry
-        # counter: the registry outlives shutdown()/init() cycles, and a
-        # fresh engine restarting at 0 must not mask its first stalls
-        # behind the previous engine's total
-        last_seen = [0]
+        # per-ENGINE last-seen counts, not diffs against the registry
+        # counters: the registry outlives shutdown()/init() cycles, and a
+        # fresh engine restarting at 0 must not mask its first events
+        # behind the previous engine's totals
+        last_seen = {"stall_events": 0, "cache_hits": 0, "cache_misses": 0,
+                     "cache_evictions": 0, "negotiation_bytes": 0}
+        cumulative = (
+            ("stall_events", telemetry.NATIVE_STALL_EVENTS),
+            ("cache_hits", telemetry.NATIVE_CACHE_HITS),
+            ("cache_misses", telemetry.NATIVE_CACHE_MISSES),
+            ("cache_evictions", telemetry.NATIVE_CACHE_EVICTIONS),
+            ("negotiation_bytes", telemetry.NATIVE_NEGOTIATION_BYTES),
+        )
 
         def collect(self=self, reg=reg):
             d = self.diagnostics()
+            d["negotiation_bytes"] = (d["negotiation_bytes_tx"]
+                                      + d["negotiation_bytes_rx"])
             reg.gauge(telemetry.NATIVE_HIERARCHICAL).set(
                 max(d["hierarchical"], 0))
             reg.gauge(telemetry.NATIVE_AUTOTUNE_CONVERGED).set(
                 max(d["autotune_converged"], 0))
+            reg.gauge(telemetry.NATIVE_CACHE_ENTRIES).set(
+                d["cache_entries"])
             with mirror_lock:
-                delta = d["stall_events"] - last_seen[0]
-                if delta > 0:
-                    reg.counter(telemetry.NATIVE_STALL_EVENTS).inc(delta)
-                    last_seen[0] = d["stall_events"]
+                for key, metric in cumulative:
+                    delta = d[key] - last_seen[key]
+                    if delta > 0:
+                        reg.counter(metric).inc(delta)
+                        last_seen[key] = d[key]
 
         self._diagnostics_collector = collect
         reg.register_collector(collect)

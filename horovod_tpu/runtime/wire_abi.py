@@ -1,0 +1,61 @@
+"""Python mirror of the native control-plane wire ABI (``csrc/wire.h``).
+
+These constants exist so Python-side tooling (diagnostics, the negotiation
+bench, future pure-Python workers) can reason about frame headers without
+loading the .so — and so the build can FAIL when the two sides drift:
+``tools/check_wire_abi.py`` (wired into the test suite as
+``tests/test_wire_abi.py``) parses the C++ headers and asserts every value
+below matches.  If you bump ``kWireVersion`` or add a frame type in
+``csrc/wire.h``, update this file in the same commit.
+"""
+
+from __future__ import annotations
+
+# csrc/wire.h — frame header
+WIRE_MAGIC = 0x48564457  # "HVDW" little-endian
+WIRE_VERSION = 2         # v2: 8-byte header + response-cache frames
+
+# csrc/wire.h — FrameType
+FRAME_INVALID = 0
+FRAME_REQUEST_LIST = 1
+FRAME_RESPONSE_LIST = 2
+FRAME_CACHE_BITS = 3
+FRAME_CACHED_EXEC = 4
+
+FRAME_TYPES = {
+    "kInvalid": FRAME_INVALID,
+    "kRequestList": FRAME_REQUEST_LIST,
+    "kResponseList": FRAME_RESPONSE_LIST,
+    "kCacheBits": FRAME_CACHE_BITS,
+    "kCachedExec": FRAME_CACHED_EXEC,
+}
+
+# csrc/common.h — OpType (the request/response op codes on the wire)
+OP_ALLREDUCE = 0
+OP_ALLGATHER = 1
+OP_BROADCAST = 2
+OP_ALLTOALL = 3
+OP_ERROR = 4
+OP_SHUTDOWN = 5
+
+OP_TYPES = {
+    "kAllreduce": OP_ALLREDUCE,
+    "kAllgather": OP_ALLGATHER,
+    "kBroadcast": OP_BROADCAST,
+    "kAlltoall": OP_ALLTOALL,
+    "kError": OP_ERROR,
+    "kShutdown": OP_SHUTDOWN,
+}
+
+# csrc/common.h — DType codes (also mirrored by runtime/native.py _DTYPES,
+# which the checker cross-validates)
+DTYPES = {
+    "uint8": 0,
+    "int8": 1,
+    "int32": 2,
+    "int64": 3,
+    "float16": 4,
+    "bfloat16": 5,
+    "float32": 6,
+    "float64": 7,
+}

@@ -56,6 +56,13 @@ FUSION_BUCKET_FILL = "hvdtpu_fusion_bucket_fill_ratio"
 NATIVE_HIERARCHICAL = "hvdtpu_native_hierarchical"
 NATIVE_AUTOTUNE_CONVERGED = "hvdtpu_native_autotune_converged"
 NATIVE_STALL_EVENTS = "hvdtpu_native_stall_events_total"
+# negotiation response cache (csrc control plane, PR 2): hit/miss/evict
+# counts per rank plus total control-plane bytes on the coordinator star
+NATIVE_CACHE_HITS = "hvd_cache_hits"
+NATIVE_CACHE_MISSES = "hvd_cache_misses"
+NATIVE_CACHE_EVICTIONS = "hvd_cache_evictions"
+NATIVE_CACHE_ENTRIES = "hvd_cache_entries"
+NATIVE_NEGOTIATION_BYTES = "hvd_negotiation_bytes"
 
 _TRUTHY = ("1", "true", "yes", "on")
 

@@ -222,6 +222,26 @@ def summarize(directory: str, steps: int | None = None) -> str:
             f"autotune_converged="
             f"{int(max(conv['per_rank'].values())) if conv else '-'}")
 
+    # -- negotiation response cache -----------------------------------------
+    hits = find(T.NATIVE_CACHE_HITS)
+    misses = find(T.NATIVE_CACHE_MISSES)
+    if hits is not None or misses is not None:
+        h = hits["total"] if hits else 0
+        m = misses["total"] if misses else 0
+        rate = h / (h + m) if h + m else 0.0
+        evic = find(T.NATIVE_CACHE_EVICTIONS)
+        nbytes = find(T.NATIVE_NEGOTIATION_BYTES)
+        # per-rank breakdown from whichever counter exists, labeled as such
+        # (a run that never hits has no lazily-created hits counter)
+        src, label = (hits, "hits") if hits is not None else (misses, "misses")
+        per_rank = {r: int(v) for r, v in sorted(src["per_rank"].items())}
+        lines.append(
+            f"negotiation cache: hit rate {rate:.1%} "
+            f"({int(h)} hits / {int(m)} misses, "
+            f"{int(evic['total']) if evic else 0} evictions; "
+            f"{label} per rank: {per_rank}); control-plane bytes "
+            f"{_fmt_bytes(nbytes['total']) if nbytes else '0B'}")
+
     return "\n".join(lines)
 
 

@@ -44,6 +44,37 @@ except Exception:
 jax.config.update("jax_default_matmul_precision", "highest")
 
 
+def native_so_status() -> str | None:
+    """None when ``csrc/libhvdtpu.so`` is present and current; otherwise a
+    human-readable skip reason.
+
+    Tests that spawn native-engine workers call this at module import and
+    SKIP instead of letting ``runtime/native.py`` rebuild the .so mid-run:
+    an in-suite ``make`` blows the tier-1 time budget, and a parallel
+    rebuild racing already-running workers can dlopen a half-linked
+    library.  Rebuild explicitly (``make -C csrc``) before the run.
+    """
+    from horovod_tpu.runtime.native import stale_sources
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    csrc = os.path.join(repo, "csrc")
+    pinned = os.environ.get("HOROVOD_TPU_NATIVE_LIB")
+    if pinned:
+        # an env-pinned library is loaded as-is by runtime/native.py (no
+        # staleness check, no rebuild) — mirror that: existence only
+        return (None if os.path.exists(pinned)
+                else f"HOROVOD_TPU_NATIVE_LIB={pinned} does not exist")
+    so = os.path.join(csrc, "libhvdtpu.so")
+    if not os.path.exists(so):
+        return "native engine library missing — run `make -C csrc` first"
+    if os.path.isdir(csrc):
+        stale = stale_sources(csrc, so)
+        if stale:
+            return ("native engine library stale vs " + ", ".join(stale)
+                    + " — run `make -C csrc` first")
+    return None
+
+
 @pytest.fixture(scope="session")
 def cpu8():
     import jax

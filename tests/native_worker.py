@@ -369,6 +369,143 @@ def scenario_autotune_hier_converge():
     print(f"rank {r}: autotune converge OK", flush=True)
 
 
+def _diag():
+    from horovod_tpu.runtime import state as _state
+
+    return _state.engine().diagnostics()
+
+
+def scenario_cache_steady():
+    """Same named tensor set every step: step 1 misses populate the cache,
+    every later step rides bitvector claims + cached-exec frames.  Asserts
+    hits grow, misses stop (misses are exactly what emits full Request
+    frames), and results stay correct across allreduce (fused), broadcast,
+    and variable-first-dim allgather."""
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    steps = int(os.environ.get("HVD_TEST_STEPS", "20"))
+    ranks_sum = n * (n - 1) / 2
+    for step in range(steps):
+        handles = [
+            hvd.allreduce_async(np.full(32, float(r + i), np.float32),
+                                average=False, name=f"g{i}")
+            for i in range(8)
+        ]
+        for i, h in enumerate(handles):
+            got = hvd.synchronize(h)
+            assert np.allclose(got, n * i + ranks_sum), (r, step, i, got)
+        b = hvd.broadcast(np.arange(4, dtype=np.float32) * (r + 1),
+                          root_rank=0, name="bc")
+        assert np.allclose(b, np.arange(4, dtype=np.float32)), (r, step, b)
+        g = hvd.allgather(np.full((r + 1, 2), float(r), np.int32), name="ag")
+        expect = np.concatenate(
+            [np.full((k + 1, 2), k, np.int32) for k in range(n)])
+        assert np.array_equal(g, expect), (r, step)
+    d = _diag()
+    # 10 ops/step; only the first step (plus rare displacement re-sends)
+    # may miss — a miss is precisely a full Request frame on the wire
+    assert d["cache_hits"] >= 10 * (steps - 2), (r, d)
+    assert d["cache_misses"] <= 20, (r, d)
+    assert d["cache_entries"] == 10, (r, d)
+    print(f"rank {r}: hits={d['cache_hits']} misses={d['cache_misses']} "
+          f"tx={d['negotiation_bytes_tx']}", flush=True)
+    hvd.shutdown()
+    print(f"rank {r}: cache steady OK", flush=True)
+
+
+def scenario_cache_disabled():
+    """HOROVOD_TPU_CACHE_CAPACITY=0 (set by the test): every cycle takes
+    the full path, counters stay at zero, results identical."""
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    for step in range(6):
+        out = hvd.allreduce(np.full(16, float(r), np.float32),
+                            average=False, name="dis")
+        assert np.allclose(out, n * (n - 1) / 2), (r, step, out)
+    d = _diag()
+    assert d["cache_hits"] == 0 and d["cache_misses"] == 0, (r, d)
+    assert d["negotiation_bytes_tx"] + d["negotiation_bytes_rx"] > 0, (r, d)
+    hvd.shutdown()
+    print(f"rank {r}: cache disabled OK", flush=True)
+
+
+def scenario_cache_evict():
+    """Capacity 4 (set by the test) with 10 live tensors: constant LRU
+    churn, including eviction of slots with registered claims — the
+    displacement/re-send path — while every result stays correct."""
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    ranks_sum = n * (n - 1) / 2
+    for step in range(8):
+        handles = [
+            hvd.allreduce_async(np.full(8, float(r + i), np.float32),
+                                average=False, name=f"e{i}")
+            for i in range(10)
+        ]
+        for i, h in enumerate(handles):
+            got = hvd.synchronize(h)
+            assert np.allclose(got, n * i + ranks_sum), (r, step, i, got)
+    d = _diag()
+    assert d["cache_evictions"] > 0, (r, d)
+    assert d["cache_entries"] <= 4, (r, d)
+    hvd.shutdown()
+    print(f"rank {r}: cache evict OK", flush=True)
+
+
+def scenario_cache_invalidate():
+    """Shape and dtype changes under a cached name fall back to the full
+    path with correct results, then re-cache the new signature; a second
+    init() (engine re-init) starts from a cold cache and still works."""
+    for round_ in range(2):
+        hvd.init()
+        r, n = hvd.rank(), hvd.size()
+        for _ in range(3):
+            out = hvd.allreduce(np.ones(4, np.float32), average=False,
+                                name="chg")
+            assert np.allclose(out, n), (r, out)
+        hits_before = _diag()["cache_hits"]
+        # same name, new shape: local signature mismatch -> full request
+        out = hvd.allreduce(np.ones((2, 3), np.float32), average=False,
+                            name="chg")
+        assert out.shape == (2, 3) and np.allclose(out, n), (r, out)
+        # new signature now cached
+        out = hvd.allreduce(np.ones((2, 3), np.float32), average=False,
+                            name="chg")
+        assert np.allclose(out, n), (r, out)
+        # dtype change invalidates again
+        out = hvd.allreduce(np.ones((2, 3), np.float64), average=False,
+                            name="chg")
+        assert out.dtype == np.float64 and np.allclose(out, n), (r, out)
+        d = _diag()
+        assert d["cache_hits"] > hits_before, (r, round_, d)
+        assert d["cache_misses"] >= 3, (r, round_, d)
+        hvd.shutdown()
+    print(f"rank {r}: cache invalidate OK", flush=True)
+
+
+def scenario_cache_mixed_shape_error():
+    """The nastiest invalidation case: after a name is cached, rank 0
+    re-submits the cached shape (a bitvector claim) while the other ranks
+    submit a NEW shape (full requests).  The coordinator must unify the
+    claim with the renegotiation — a clean cross-rank mismatch error on
+    every rank, not a half-claimed deadlock — and stay healthy after."""
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    for _ in range(3):
+        out = hvd.allreduce(np.ones(4, np.float32), average=False, name="mx")
+        assert np.allclose(out, n), (r, out)
+    try:
+        arr = np.ones(4 if r == 0 else 5, np.float32)
+        hvd.allreduce(arr, average=False, name="mx")
+        raise SystemExit(f"rank {r}: expected mismatch error")
+    except RuntimeError as e:
+        assert "mismatch" in str(e), (r, str(e))
+    out = hvd.allreduce(np.ones(2, np.float32), average=False, name="after_mx")
+    assert np.allclose(out, n), (r, out)
+    hvd.shutdown()
+    print(f"rank {r}: cache mixed shape OK", flush=True)
+
+
 def scenario_skewed_shutdown():
     """Rank 0 lags its shutdown by seconds (checkpointing, logging...) while
     the peers shut down and exit immediately.  Regression: the engine's

@@ -30,6 +30,14 @@ _TF_GATE = pytest.mark.skipif(
 
 
 def _run(argv, timeout=240, np_procs=None):
+    if np_procs and np_procs > 1:
+        # multi-proc workers load the native engine: skip cleanly on a
+        # missing/stale .so rather than rebuilding it mid-run
+        from conftest import native_so_status
+
+        reason = native_so_status()
+        if reason is not None:
+            pytest.skip(reason)
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env.setdefault("XLA_FLAGS", "")

@@ -13,7 +13,14 @@ import subprocess
 import sys
 import textwrap
 
+import pytest
+
+from conftest import native_so_status
 from horovod_tpu.utils import net
+
+_SO_SKIP = native_so_status()
+pytestmark = pytest.mark.skipif(_SO_SKIP is not None,
+                                reason=_SO_SKIP or "native .so ready")
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 

@@ -9,8 +9,16 @@ import time
 
 import pytest
 
+from conftest import native_so_status
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = os.path.join(REPO, "tests", "native_worker.py")
+
+# missing/stale .so: skip cleanly instead of rebuilding mid-run (the
+# in-suite make wrecks the tier-1 budget and races parallel workers)
+_SO_SKIP = native_so_status()
+pytestmark = pytest.mark.skipif(_SO_SKIP is not None,
+                                reason=_SO_SKIP or "native .so ready")
 
 
 def _run(scenario: str, np_: int, timeout: float = 120.0, env=None):
@@ -25,8 +33,13 @@ def _run(scenario: str, np_: int, timeout: float = 120.0, env=None):
 
 
 # 6 exercises the non-power-of-two binomial broadcast tree (regression:
-# vrank 5's parent never forwarded with the old mask walk)
-@pytest.mark.parametrize("np_", [2, 3, 6])
+# vrank 5's parent never forwarded with the old mask walk).  The larger
+# worlds ride the slow lane: the full module overran the tier-1 870 s
+# ceiling (CHANGES.md PR 1 note), so tier 1 keeps one fast smoke per
+# mechanism and `-m slow` covers the rest.
+@pytest.mark.parametrize("np_", [2,
+                                 pytest.param(3, marks=pytest.mark.slow),
+                                 pytest.param(6, marks=pytest.mark.slow)])
 def test_collectives(np_):
     res = _run("collectives", np_)
     assert res.returncode == 0, res.stderr + res.stdout
@@ -43,7 +56,9 @@ def test_cross_rank_errors_do_not_hang():
         assert f"rank {r}: errors OK" in res.stdout
 
 
-@pytest.mark.parametrize("np_", [4, 3, 6])
+@pytest.mark.parametrize("np_", [4,
+                                 pytest.param(3, marks=pytest.mark.slow),
+                                 pytest.param(6, marks=pytest.mark.slow)])
 def test_hierarchical_two_level(np_):
     """Simulated multi-host topology (host-hash override, 2 ranks per
     host): the two-level allreduce/allgather paths must agree with the
@@ -54,7 +69,8 @@ def test_hierarchical_two_level(np_):
         assert f"rank {r}: hierarchical OK" in res.stdout
 
 
-@pytest.mark.parametrize("np_", [3, 5])
+@pytest.mark.parametrize("np_", [3,
+                                 pytest.param(5, marks=pytest.mark.slow)])
 def test_hierarchical_default_asymmetric(np_):
     """No env forcing, unequal ranks per simulated host: the hierarchical
     default must be derived from globally shared topology (regression: a
@@ -105,6 +121,7 @@ def _libtsan():
     return hits[0] if hits else None
 
 
+@pytest.mark.slow  # tsan build + instrumented run: minutes, not seconds
 @pytest.mark.skipif(_libtsan() is None, reason="libtsan not available")
 def test_engine_race_free_under_tsan():
     """ThreadSanitizer pass over the full collectives scenario: the
@@ -223,6 +240,7 @@ def test_autotune(tmp_path):
     assert {h for _, _, h, _ in rows} == {"0"}
 
 
+@pytest.mark.slow  # 4-proc 80-step sweep on a 2-core box
 def test_autotune_tunes_hierarchical(tmp_path):
     """On a (simulated) multi-host topology with no env pin, the
     hierarchical-allreduce decision belongs to the autotuner: the CSV
@@ -290,6 +308,7 @@ def test_autotune_inert_when_everything_pinned(tmp_path):
 # fused, where measurement showed flat and two-level within ~5% of each
 # other on this loopback-symmetric fabric (busbw lane: 0.425 vs 0.403
 # GB/s — cross-simhost pairs ride loopback TCP either way)
+@pytest.mark.slow  # two 4-proc 60-step convergence runs with MB payloads
 @pytest.mark.parametrize("pace_mbps,ar_floats,mode",
                          [("8", "65536", "hier_wins"),
                           ("", "262144", "no_hier_bias")])
@@ -356,6 +375,72 @@ def test_worker_crash_kills_world():
     # launcher must propagate the failing exit code and kill the sleepers
     assert res.returncode == 3, (res.returncode, res.stderr)
     assert time.monotonic() - t0 < 25, "launcher failed to kill surviving workers"
+
+
+# ---------------------------------------------------------------------------
+# negotiation response cache (coordinator-replicated bitvector cache)
+# ---------------------------------------------------------------------------
+
+def test_cache_steady_state(tmp_path):
+    """Unchanged tensor set: cycle 2+ rides bitvector claims + cached-id
+    frames.  The worker asserts hits grow while misses stop (a miss is
+    exactly what emits a full Request frame); the rank-0 timeline shows
+    the CACHED_NEGOTIATION cycles."""
+    import json
+
+    tl = tmp_path / "tl.json"
+    res = _run("cache_steady", 2, env={"HOROVOD_TIMELINE": str(tl)})
+    assert res.returncode == 0, res.stderr + res.stdout
+    for r in range(2):
+        assert f"rank {r}: cache steady OK" in res.stdout
+    events = json.loads(tl.read_text())
+    names = [e.get("name") for e in events]
+    assert "CACHED_NEGOTIATION" in names, set(names)
+    # the full path negotiated the first step, then went quiet
+    assert "NEGOTIATE_ALLREDUCE" in names
+
+
+def test_cache_disabled_by_env():
+    """HOROVOD_TPU_CACHE_CAPACITY=0: identical results, zero cache
+    activity — the acceptance baseline the bench compares against."""
+    res = _run("cache_disabled", 2,
+               env={"HOROVOD_TPU_CACHE_CAPACITY": "0"})
+    assert res.returncode == 0, res.stderr + res.stdout
+    for r in range(2):
+        assert f"rank {r}: cache disabled OK" in res.stdout
+
+
+def test_cache_lru_eviction():
+    """Capacity smaller than the live tensor set: constant LRU churn,
+    including eviction of partially-claimed slots (the displacement/
+    re-send path), with correct results throughout."""
+    res = _run("cache_evict", 2, env={"HOROVOD_TPU_CACHE_CAPACITY": "4"})
+    assert res.returncode == 0, res.stderr + res.stdout
+    for r in range(2):
+        assert f"rank {r}: cache evict OK" in res.stdout
+
+
+def test_cache_invalidation_and_reinit():
+    """Shape/dtype changes under a cached name fall back to the full path
+    with cache-off-identical results; a full engine re-init (second
+    hvd.init in the same process) starts cold and stays correct."""
+    res = _run("cache_invalidate", 2, timeout=180)
+    assert res.returncode == 0, res.stderr + res.stdout
+    for r in range(2):
+        assert f"rank {r}: cache invalidate OK" in res.stdout
+
+
+def test_cache_claim_vs_mismatched_request_errors():
+    """One rank re-submits the cached signature (a bitvector claim) while
+    the others submit a new shape (full requests): the coordinator must
+    unify both into one negotiation and produce the usual clean mismatch
+    error on EVERY rank — not a half-claimed deadlock."""
+    t0 = time.monotonic()
+    res = _run("cache_mixed_shape_error", 3)
+    assert res.returncode == 0, res.stderr + res.stdout
+    assert time.monotonic() - t0 < 60, "cache mismatch path took too long"
+    for r in range(3):
+        assert f"rank {r}: cache mixed shape OK" in res.stdout
 
 
 def test_shm_data_plane_active_and_optional():

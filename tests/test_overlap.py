@@ -14,6 +14,10 @@ import jax
 import jax.numpy as jnp
 
 
+import functools
+
+
+@functools.lru_cache(maxsize=1)
 def _have_topologies():
     try:
         from jax.experimental import topologies
@@ -24,7 +28,12 @@ def _have_topologies():
         return False
 
 
-needs_topo = pytest.mark.skipif(not _have_topologies(),
+# String condition => evaluated lazily at each test's setup, NOT at import:
+# the probe can take minutes in tunneled-backend containers, and paying it
+# during pytest COLLECTION stalled the whole tier-1 suite before a single
+# test ran.  The lru_cache bounds it to one probe per process, paid by the
+# first @needs_topo test only.
+needs_topo = pytest.mark.skipif("not _have_topologies()",
                                 reason="abstract TPU topology unavailable")
 
 
@@ -99,10 +108,29 @@ def test_grouped_allreduce_bucketing_numerics(cpu8):
 def test_fusion_threshold_env_honored(monkeypatch):
     from horovod_tpu.ops import collective_ops as co
 
+    # the parse is cached per process (it runs inside jit tracing);
+    # env changes require an explicit cache_clear
     monkeypatch.setenv("HOROVOD_FUSION_THRESHOLD", "12345")
+    co._bucket_bytes.cache_clear()
     assert co._bucket_bytes() == 12345
     monkeypatch.setenv("HOROVOD_TPU_FUSION_THRESHOLD", "777")
+    co._bucket_bytes.cache_clear()
     assert co._bucket_bytes() == 777  # TPU-specific override wins
     monkeypatch.delenv("HOROVOD_TPU_FUSION_THRESHOLD")
     monkeypatch.delenv("HOROVOD_FUSION_THRESHOLD")
+    co._bucket_bytes.cache_clear()
     assert co._bucket_bytes() == 64 * 1024 * 1024
+    assert co._bucket_bytes() == 64 * 1024 * 1024  # cached second read
+    co._bucket_bytes.cache_clear()
+
+
+def test_fusion_threshold_bad_value_names_env(monkeypatch):
+    import pytest
+
+    from horovod_tpu.ops import collective_ops as co
+
+    monkeypatch.setenv("HOROVOD_TPU_FUSION_THRESHOLD", "64MB")
+    co._bucket_bytes.cache_clear()
+    with pytest.raises(ValueError, match="HOROVOD_TPU_FUSION_THRESHOLD"):
+        co._bucket_bytes()
+    co._bucket_bytes.cache_clear()

@@ -9,6 +9,12 @@ import pytest
 
 pytest.importorskip("torch")
 
+from conftest import native_so_status  # noqa: E402
+
+_SO_SKIP = native_so_status()
+pytestmark = pytest.mark.skipif(_SO_SKIP is not None,
+                                reason=_SO_SKIP or "native .so ready")
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = os.path.join(REPO, "tests", "torch_worker.py")
 

@@ -1,0 +1,48 @@
+"""Wire-ABI sync guard: the Python-side frame-type/version constants must
+match ``csrc/wire.h`` (and the dtype/op tables ``csrc/common.h``), so new
+control-plane frames — like the response cache's — cannot silently drift.
+Thin wrapper over ``tools/check_wire_abi.py`` so the guard runs in tier 1;
+needs no compiler and no .so."""
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import check_wire_abi  # noqa: E402
+
+
+def _headers():
+    with open(os.path.join(REPO, "csrc", "wire.h")) as f:
+        wire_h = f.read()
+    with open(os.path.join(REPO, "csrc", "common.h")) as f:
+        common_h = f.read()
+    return wire_h, common_h
+
+
+def test_wire_abi_in_sync():
+    wire_h, common_h = _headers()
+    assert check_wire_abi.check(wire_h, common_h) == []
+
+
+def test_cli_exit_code():
+    assert check_wire_abi.main() == 0
+
+
+def test_checker_detects_version_drift():
+    """The guard must actually bite: a simulated version bump in wire.h
+    without a Python update is reported."""
+    wire_h, common_h = _headers()
+    tampered = wire_h.replace("kWireVersion = 2", "kWireVersion = 3")
+    assert tampered != wire_h, "kWireVersion moved; update this test"
+    problems = check_wire_abi.check(tampered, common_h)
+    assert any("kWireVersion" in p for p in problems), problems
+
+
+def test_checker_detects_new_frame_type():
+    wire_h, common_h = _headers()
+    tampered = wire_h.replace("kCachedExec = 4,",
+                              "kCachedExec = 4,\n  kNewFrame = 5,")
+    problems = check_wire_abi.check(tampered, common_h)
+    assert any("FrameType" in p for p in problems), problems

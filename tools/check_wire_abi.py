@@ -1,0 +1,119 @@
+#!/usr/bin/env python
+"""Assert the Python-side wire-ABI constants match ``csrc/wire.h``.
+
+The negotiation control plane is a hand-rolled binary protocol; the Python
+mirror (``horovod_tpu/runtime/wire_abi.py``, plus the dtype table in
+``runtime/native.py``) must track the C++ headers EXACTLY or the response
+cache's new frame types can drift silently — a stale mirror would misreport
+diagnostics today and corrupt any future Python-side frame producer.
+
+Run directly (``python tools/check_wire_abi.py``) or through the suite
+(``tests/test_wire_abi.py``).  Exit code 0 = in sync.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _parse_enum(text: str, enum_name: str) -> dict[str, int]:
+    """``enum class Name : type { kA = 0, kB = 1, ... }`` -> {kA: 0, ...}.
+    Only explicit ``= value`` entries are recognized — the wire enums pin
+    every value on purpose."""
+    m = re.search(r"enum\s+class\s+" + enum_name + r"[^{]*\{(.*?)\}",
+                  text, re.S)
+    if not m:
+        return {}
+    out = {}
+    for name, value in re.findall(r"(k\w+)\s*=\s*(\d+)", m.group(1)):
+        out[name] = int(value)
+    return out
+
+
+def _parse_constant(text: str, name: str) -> int | None:
+    m = re.search(r"constexpr\s+\w+(?:_t)?\s+" + name +
+                  r"\s*=\s*(0x[0-9a-fA-F]+|\d+)u?", text)
+    return int(m.group(1), 0) if m else None
+
+
+def check(wire_h: str, common_h: str) -> list[str]:
+    """All drift problems between the C++ headers' text and the Python
+    mirrors; empty list = in sync."""
+    from horovod_tpu.runtime import native, wire_abi
+
+    problems: list[str] = []
+
+    magic = _parse_constant(wire_h, "kWireMagic")
+    if magic != wire_abi.WIRE_MAGIC:
+        problems.append(
+            f"kWireMagic: wire.h has {magic:#x}, wire_abi.py has "
+            f"{wire_abi.WIRE_MAGIC:#x}")
+    version = _parse_constant(wire_h, "kWireVersion")
+    if version != wire_abi.WIRE_VERSION:
+        problems.append(
+            f"kWireVersion: wire.h has {version}, wire_abi.py has "
+            f"{wire_abi.WIRE_VERSION}")
+
+    frames = _parse_enum(wire_h, "FrameType")
+    if frames != wire_abi.FRAME_TYPES:
+        problems.append(
+            f"FrameType: wire.h has {frames}, wire_abi.py has "
+            f"{wire_abi.FRAME_TYPES}")
+
+    ops = _parse_enum(common_h, "OpType")
+    if ops != wire_abi.OP_TYPES:
+        problems.append(
+            f"OpType: common.h has {ops}, wire_abi.py has "
+            f"{wire_abi.OP_TYPES}")
+
+    # DType: common.h enum names are kUInt8-style; normalize to the
+    # numpy-style names the Python tables use
+    dtypes = _parse_enum(common_h, "DType")
+    want = wire_abi.DTYPES
+    cxx_dtypes = {}
+    alias = {"kUInt8": "uint8", "kInt8": "int8", "kInt32": "int32",
+             "kInt64": "int64", "kFloat16": "float16",
+             "kBFloat16": "bfloat16", "kFloat32": "float32",
+             "kFloat64": "float64"}
+    for k, v in dtypes.items():
+        cxx_dtypes[alias.get(k, k)] = v
+    if cxx_dtypes != want:
+        problems.append(
+            f"DType: common.h has {cxx_dtypes}, wire_abi.py has {want}")
+    if native._DTYPES != wire_abi.DTYPES:
+        problems.append(
+            f"native.py _DTYPES {native._DTYPES} != wire_abi.DTYPES "
+            f"{wire_abi.DTYPES}")
+    if (native._OP_ALLREDUCE, native._OP_ALLGATHER, native._OP_BROADCAST,
+            native._OP_ALLTOALL) != (wire_abi.OP_ALLREDUCE,
+                                     wire_abi.OP_ALLGATHER,
+                                     wire_abi.OP_BROADCAST,
+                                     wire_abi.OP_ALLTOALL):
+        problems.append("native.py _OP_* constants drifted from wire_abi")
+    return problems
+
+
+def main() -> int:
+    csrc = os.path.join(REPO, "csrc")
+    with open(os.path.join(csrc, "wire.h")) as f:
+        wire_h = f.read()
+    with open(os.path.join(csrc, "common.h")) as f:
+        common_h = f.read()
+    problems = check(wire_h, common_h)
+    if problems:
+        print("wire ABI drift between csrc headers and the Python mirror:")
+        for p in problems:
+            print(" -", p)
+        return 1
+    print("wire ABI in sync (version "
+          f"{_parse_constant(wire_h, 'kWireVersion')})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
