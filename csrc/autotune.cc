@@ -232,7 +232,8 @@ constexpr double kCycleMinUs = 1e3, kCycleMaxUs = 1e5;  // 1..100 ms
 
 void ParameterManager::Initialize(int64_t fusion0, int64_t cycle_us0,
                                   bool tune_hierarchical, bool hier0,
-                                  bool tune_fusion, bool tune_cycle) {
+                                  bool tune_fusion, bool tune_cycle,
+                                  bool tune_depth, int64_t depth0) {
   const char* on = getenv("HOROVOD_AUTOTUNE");
   if (!on || !on[0] || !strcmp(on, "0")) on = getenv("HOROVOD_TPU_AUTOTUNE");
   active_ = on && on[0] && strcmp(on, "0") != 0;
@@ -240,6 +241,8 @@ void ParameterManager::Initialize(int64_t fusion0, int64_t cycle_us0,
   cycle_us_ = cycle_us0;
   tune_hier_ = tune_hierarchical;
   hier_ = hier0;
+  tune_depth_ = tune_depth;
+  depth_ = depth0;
   if (!active_) return;
   // env-pinned knobs leave the search space entirely (reference
   // fixed=true semantics): the GP never spends a dimension on them and
@@ -247,6 +250,7 @@ void ParameterManager::Initialize(int64_t fusion0, int64_t cycle_us0,
   knobs_.clear();
   if (tune_fusion) knobs_.push_back(kFusion);
   if (tune_cycle) knobs_.push_back(kCycle);
+  if (tune_depth_) knobs_.push_back(kDepth);
   int cat = -1;
   if (tune_hier_) {
     cat = static_cast<int>(knobs_.size());
@@ -275,14 +279,22 @@ void ParameterManager::Initialize(int64_t fusion0, int64_t cycle_us0,
     else if (k == kCycle)
       current_unit_.push_back((static_cast<double>(cycle_us0) - kCycleMinUs) /
                               (kCycleMaxUs - kCycleMinUs));
+    else if (k == kDepth)
+      // {1,2,4} mapped to thirds of the unit interval; seed at the cell
+      // midpoint so the initial depth round-trips through SetPoint
+      current_unit_.push_back(
+          ((depth0 >= 4 ? 2 : depth0 >= 2 ? 1 : 0) + 0.5) / 3.0);
     else
       current_unit_.push_back(hier0 ? 1.0 : 0.0);
   }
   if (!log_path_.empty()) {
     FILE* f = fopen(log_path_.c_str(), "w");
     if (f) {
-      fputs("fusion_threshold_bytes,cycle_time_us,hierarchical_allreduce,"
-            "score_bytes_per_us\n", f);
+      // the depth column only appears when the knob is in the search, so
+      // default (static-depth) runs keep the historical 4-column format
+      fprintf(f, "fusion_threshold_bytes,cycle_time_us,"
+                 "hierarchical_allreduce,%sscore_bytes_per_us\n",
+              tune_depth_ ? "pipeline_depth," : "");
       fclose(f);
     }
   }
@@ -292,8 +304,13 @@ void ParameterManager::Log(double score) {
   if (log_path_.empty()) return;
   FILE* f = fopen(log_path_.c_str(), "a");
   if (!f) return;
-  fprintf(f, "%lld,%lld,%d,%.6f\n", static_cast<long long>(fusion_),
-          static_cast<long long>(cycle_us_), hier_ ? 1 : 0, score);
+  if (tune_depth_)
+    fprintf(f, "%lld,%lld,%d,%lld,%.6f\n", static_cast<long long>(fusion_),
+            static_cast<long long>(cycle_us_), hier_ ? 1 : 0,
+            static_cast<long long>(depth_), score);
+  else
+    fprintf(f, "%lld,%lld,%d,%.6f\n", static_cast<long long>(fusion_),
+            static_cast<long long>(cycle_us_), hier_ ? 1 : 0, score);
   fclose(f);
 }
 
@@ -305,6 +322,8 @@ void ParameterManager::SetPoint(const std::vector<double>& unit) {
     else if (knobs_[i] == kCycle)
       cycle_us_ = static_cast<int64_t>(
           kCycleMinUs + unit[i] * (kCycleMaxUs - kCycleMinUs));
+    else if (knobs_[i] == kDepth)
+      depth_ = int64_t{1} << std::min(static_cast<int>(unit[i] * 3.0), 2);
     else
       hier_ = unit[i] >= 0.5;
   }
@@ -312,7 +331,8 @@ void ParameterManager::SetPoint(const std::vector<double>& unit) {
 
 bool ParameterManager::RecordCycle(int64_t bytes, double cycle_secs,
                                    int64_t* fusion_out,
-                                   int64_t* cycle_us_out, int* hier_out) {
+                                   int64_t* cycle_us_out, int* hier_out,
+                                   int64_t* depth_out) {
   if (!active_ || converged_) return false;
   bytes_acc_ += bytes;
   secs_acc_ += cycle_secs;
@@ -345,6 +365,7 @@ bool ParameterManager::RecordCycle(int64_t bytes, double cycle_secs,
   *fusion_out = fusion_;
   *cycle_us_out = cycle_us_;
   *hier_out = tune_hier_ ? (hier_ ? 1 : 0) : -1;
+  if (depth_out) *depth_out = tune_depth_ ? depth_ : -1;
   return true;
 }
 

@@ -92,49 +92,74 @@ void Timeline::Push(TimelineRecordType type, int64_t tid,
 void Timeline::NegotiateStart(const std::string& tensor,
                               const std::string& op) {
   if (!enabled_) return;
+  std::lock_guard<std::mutex> lk(emit_mu_);
   Push(TimelineRecordType::kBegin, TensorLane(tensor), "NEGOTIATE_" + op);
 }
 
 void Timeline::NegotiateRankReady(const std::string& tensor, int rank) {
   if (!enabled_) return;
+  std::lock_guard<std::mutex> lk(emit_mu_);
   Push(TimelineRecordType::kInstant, TensorLane(tensor),
        std::to_string(rank) + "_READY");
 }
 
 void Timeline::NegotiateEnd(const std::string& tensor) {
   if (!enabled_) return;
+  std::lock_guard<std::mutex> lk(emit_mu_);
   Push(TimelineRecordType::kEnd, TensorLane(tensor), "");
 }
 
 void Timeline::Start(const std::string& tensor, const std::string& op) {
   if (!enabled_) return;
+  std::lock_guard<std::mutex> lk(emit_mu_);
   Push(TimelineRecordType::kBegin, TensorLane(tensor), op);
 }
 
 void Timeline::ActivityStart(const std::string& tensor,
                              const std::string& activity) {
   if (!enabled_) return;
+  std::lock_guard<std::mutex> lk(emit_mu_);
   Push(TimelineRecordType::kBegin, TensorLane(tensor), activity);
 }
 
 void Timeline::ActivityEnd(const std::string& tensor) {
   if (!enabled_) return;
+  std::lock_guard<std::mutex> lk(emit_mu_);
   Push(TimelineRecordType::kEnd, TensorLane(tensor), "");
 }
 
 void Timeline::End(const std::string& tensor) {
   if (!enabled_) return;
+  std::lock_guard<std::mutex> lk(emit_mu_);
   Push(TimelineRecordType::kEnd, TensorLane(tensor), "");
 }
 
 void Timeline::MarkCycleStart() {
   if (!enabled_ || !mark_cycles_) return;
+  std::lock_guard<std::mutex> lk(emit_mu_);
   Push(TimelineRecordType::kInstant, 0, "CYCLE_START");
 }
 
 void Timeline::CachedNegotiation() {
   if (!enabled_) return;
+  std::lock_guard<std::mutex> lk(emit_mu_);
   Push(TimelineRecordType::kInstant, 0, "CACHED_NEGOTIATION");
+}
+
+void Timeline::PipelineStart(int buf, const std::string& stage) {
+  if (!enabled_) return;
+  std::lock_guard<std::mutex> lk(emit_mu_);
+  std::string lane = buf >= 0 ? "pipeline/buf" + std::to_string(buf)
+                              : "pipeline/direct";
+  Push(TimelineRecordType::kBegin, TensorLane(lane), stage);
+}
+
+void Timeline::PipelineEnd(int buf) {
+  if (!enabled_) return;
+  std::lock_guard<std::mutex> lk(emit_mu_);
+  std::string lane = buf >= 0 ? "pipeline/buf" + std::to_string(buf)
+                              : "pipeline/direct";
+  Push(TimelineRecordType::kEnd, TensorLane(lane), "");
 }
 
 void Timeline::WriterLoop() {

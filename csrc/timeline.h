@@ -6,17 +6,21 @@
 // readiness ticks), the top-level op, and nested processing activities;
 // optional cycle markers via HOROVOD_TIMELINE_MARK_CYCLES.
 //
-// I/O is decoupled from the engine's background thread through a fixed-size
-// single-producer/single-consumer lock-free ring (the engine background
-// thread is the only producer; a dedicated writer thread is the consumer) —
-// same design point as the reference's boost::lockfree SPSC queue, done
-// with C++11 atomics instead of a vendored library.
+// I/O is decoupled from the engine's threads through a fixed-size ring
+// drained by a dedicated writer thread.  Since the pipelined data plane
+// (PR 3) the engine has TWO producers — the negotiation thread (pack/
+// unpack/negotiate marks) and the data-plane executor (wire marks) — so
+// emits serialize through a producer mutex in front of the ring; the
+// ring itself stays the same single-consumer design as the reference's
+// boost::lockfree queue, done with C++11 atomics instead of a vendored
+// library.  The mutex is only ever taken when the timeline is enabled.
 
 #ifndef HVDTPU_TIMELINE_H_
 #define HVDTPU_TIMELINE_H_
 
 #include <atomic>
 #include <cstdint>
+#include <mutex>
 #include <string>
 #include <thread>
 #include <unordered_map>
@@ -48,8 +52,8 @@ class Timeline {
   bool Enabled() const { return enabled_; }
   bool MarkCyclesEnabled() const { return enabled_ && mark_cycles_; }
 
-  // All emit methods must be called from ONE thread (the engine background
-  // thread) — the ring is SPSC.
+  // Emit methods may be called from the negotiation thread AND the
+  // data-plane executor; they serialize on the producer mutex.
   void NegotiateStart(const std::string& tensor, const std::string& op);
   void NegotiateRankReady(const std::string& tensor, int rank);
   void NegotiateEnd(const std::string& tensor);
@@ -62,6 +66,12 @@ class Timeline {
   // groups — makes cached (bitvector-negotiated) cycles visible next to
   // the full NEGOTIATE_* phases they replaced.
   void CachedNegotiation();
+  // Pipeline stage marks on a per-fusion-buffer lane ("pipeline/buf<k>",
+  // or "pipeline/direct" for unfused items, buf < 0): PACK and UNPACK
+  // come from the negotiation thread, WIRE from the data-plane executor —
+  // side by side they make the overlap (or its absence) visible.
+  void PipelineStart(int buf, const std::string& stage);
+  void PipelineEnd(int buf);
 
  private:
   int64_t TensorLane(const std::string& tensor);
@@ -80,7 +90,10 @@ class Timeline {
   int64_t next_lane_ = 1;  // lane 0 reserved for cycle markers
   int64_t overflow_lane_ = -1;
 
-  // SPSC ring
+  // serializes the two engine-side producers in front of the ring
+  std::mutex emit_mu_;
+
+  // multi-producer (serialized above) / single-consumer ring
   static constexpr size_t kCapacity = 1 << 16;
   std::vector<TimelineRecord> ring_;
   std::atomic<size_t> head_{0};  // consumer position

@@ -23,7 +23,8 @@ namespace hvdtpu {
 
 // Bump kWireVersion on ANY layout change (header, field order, new frame).
 constexpr uint32_t kWireMagic = 0x48564457u;  // "HVDW" little-endian
-constexpr uint16_t kWireVersion = 2;          // v2: header + cache frames
+constexpr uint16_t kWireVersion = 3;          // v3: pipeline depth (bootstrap
+                                              // table + tuned-knob frames)
 
 enum class FrameType : uint16_t {
   kInvalid = 0,
@@ -65,6 +66,7 @@ struct ResponseList {
   int64_t tuned_fusion = -1;
   int64_t tuned_cycle_us = -1;
   int64_t tuned_hierarchical = -1;  // 0/1 when the autotuner owns the knob
+  int64_t tuned_pipeline_depth = -1;  // >=1 when the autotuner owns the knob
 };
 
 // Steady-state claim: "every cache slot whose bit is set holds an entry
@@ -88,6 +90,7 @@ struct CachedExecFrame {
   int64_t tuned_fusion = -1;
   int64_t tuned_cycle_us = -1;
   int64_t tuned_hierarchical = -1;
+  int64_t tuned_pipeline_depth = -1;
 };
 
 // Frame dispatch: the type a buffer claims to carry (kInvalid when the

@@ -66,6 +66,13 @@ def main(argv=None) -> int:
                          "tensors every step — cached cycles swap the "
                          "per-tensor name lists for fixed-size bitvector "
                          "frames")
+    ap.add_argument("--pipeline-depth", type=int, default=None, metavar="N",
+                    help="data-plane pipeline depth (sets "
+                         "HOROVOD_TPU_PIPELINE_DEPTH for every worker; "
+                         "default 2). The native engine overlaps fusion-"
+                         "buffer packing, the wire, and unpacking across N "
+                         "buffers; 1 restores the fully serialized data "
+                         "plane")
     ap.add_argument("command", nargs=argparse.REMAINDER)
     args = ap.parse_args(argv)
 
@@ -145,6 +152,8 @@ def main(argv=None) -> int:
             env["HOROVOD_TPU_METRICS_DIR"] = args.metrics_dir
         if args.cache_capacity is not None:
             env["HOROVOD_TPU_CACHE_CAPACITY"] = str(args.cache_capacity)
+        if args.pipeline_depth is not None:
+            env["HOROVOD_TPU_PIPELINE_DEPTH"] = str(args.pipeline_depth)
         # each worker leads its own process group so a stuck worker's whole
         # subtree can be killed
         procs.append(subprocess.Popen(cmd, env=env, start_new_session=True))

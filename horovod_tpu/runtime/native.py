@@ -174,6 +174,12 @@ def _bind(lib):
         lib.hvd_cache_stats.restype = None
     except AttributeError:
         pass
+    try:
+        # data-plane pipeline stats (PR 3); same prebuilt-.so caveat
+        lib.hvd_pipeline_stats.argtypes = [ctypes.POINTER(ctypes.c_int64)]
+        lib.hvd_pipeline_stats.restype = None
+    except AttributeError:
+        pass
     return lib
 
 
@@ -243,6 +249,31 @@ class NativeEngine(Engine):
             "stall_events": self._stall_events(),
         }
         d.update(self._cache_stats())
+        d.update(self._pipeline_stats())
+        return d
+
+    def _pipeline_stats(self) -> dict:
+        """Data-plane pipeline counters for THIS rank.  ``pipeline_overlap_
+        fraction`` is the share of wire time during which the negotiation
+        thread was simultaneously packing or unpacking — 0 on the inline
+        (depth 1) path, > 0 exactly when the pipeline is earning its keep.
+        Zeros when the loaded .so predates the pipeline."""
+        fn = getattr(self._lib, "hvd_pipeline_stats", None)
+        keys = ("pipeline_depth", "pipeline_queue_depth", "pipeline_items",
+                "pipeline_packs", "pipeline_pack_ns", "pipeline_wire_ns",
+                "pipeline_unpack_ns", "pipeline_overlap_ns")
+        if fn is None:
+            d = dict.fromkeys(keys, 0)
+            d["pipeline_depth"] = 1
+            d["pipeline_overlap_fraction"] = 0.0
+            return d
+        vals = (ctypes.c_int64 * 8)()
+        fn(vals)
+        d = {k: max(int(v), 0) for k, v in zip(keys, vals)}
+        d["pipeline_depth"] = max(d["pipeline_depth"], 1)
+        d["pipeline_overlap_fraction"] = round(
+            min(d["pipeline_overlap_ns"] / max(d["pipeline_wire_ns"], 1), 1.0),
+            4)
         return d
 
     def _cache_stats(self) -> dict:
@@ -294,6 +325,13 @@ class NativeEngine(Engine):
             ("cache_evictions", telemetry.NATIVE_CACHE_EVICTIONS),
             ("negotiation_bytes", telemetry.NATIVE_NEGOTIATION_BYTES),
         )
+        # per-stage cumulative (ns, item count) at last collection: each
+        # collection observes the mean per-item stage latency of the
+        # window into the stage histogram
+        stage_seen = {"pack": (0, 0), "wire": (0, 0), "unpack": (0, 0)}
+        stage_keys = {"pack": ("pipeline_pack_ns", "pipeline_packs"),
+                      "wire": ("pipeline_wire_ns", "pipeline_items"),
+                      "unpack": ("pipeline_unpack_ns", "pipeline_items")}
 
         def collect(self=self, reg=reg):
             d = self.diagnostics()
@@ -305,12 +343,27 @@ class NativeEngine(Engine):
                 max(d["autotune_converged"], 0))
             reg.gauge(telemetry.NATIVE_CACHE_ENTRIES).set(
                 d["cache_entries"])
+            reg.gauge(telemetry.NATIVE_PIPELINE_OVERLAP).set(
+                d["pipeline_overlap_fraction"])
+            reg.gauge(telemetry.NATIVE_PIPELINE_QUEUE_DEPTH).set(
+                d["pipeline_queue_depth"])
+            reg.gauge(telemetry.NATIVE_PIPELINE_DEPTH).set(
+                d["pipeline_depth"])
             with mirror_lock:
                 for key, metric in cumulative:
                     delta = d[key] - last_seen[key]
                     if delta > 0:
                         reg.counter(metric).inc(delta)
                         last_seen[key] = d[key]
+                for stage, (ns_key, n_key) in stage_keys.items():
+                    ns0, n0 = stage_seen[stage]
+                    dns, dn = d[ns_key] - ns0, d[n_key] - n0
+                    if dn > 0 and dns >= 0:
+                        reg.histogram(
+                            telemetry.NATIVE_PIPELINE_STAGE_SECONDS,
+                            stage=stage,
+                        ).observe(dns / dn / 1e9)
+                        stage_seen[stage] = (d[ns_key], d[n_key])
 
         self._diagnostics_collector = collect
         reg.register_collector(collect)

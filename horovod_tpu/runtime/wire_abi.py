@@ -13,7 +13,7 @@ from __future__ import annotations
 
 # csrc/wire.h — frame header
 WIRE_MAGIC = 0x48564457  # "HVDW" little-endian
-WIRE_VERSION = 2         # v2: 8-byte header + response-cache frames
+WIRE_VERSION = 3         # v3: pipeline depth (bootstrap table + tuned frames)
 
 # csrc/wire.h — FrameType
 FRAME_INVALID = 0

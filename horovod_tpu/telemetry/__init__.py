@@ -63,6 +63,13 @@ NATIVE_CACHE_MISSES = "hvd_cache_misses"
 NATIVE_CACHE_EVICTIONS = "hvd_cache_evictions"
 NATIVE_CACHE_ENTRIES = "hvd_cache_entries"
 NATIVE_NEGOTIATION_BYTES = "hvd_negotiation_bytes"
+# data-plane pipeline (csrc executor thread, PR 3): overlap fraction is
+# overlapped-pack/unpack ns over wire ns — 0 on the inline depth-1 path,
+# > 0 exactly when pack/wire/unpack are actually running concurrently
+NATIVE_PIPELINE_OVERLAP = "hvd_pipeline_overlap_fraction"
+NATIVE_PIPELINE_QUEUE_DEPTH = "hvd_pipeline_queue_depth"
+NATIVE_PIPELINE_DEPTH = "hvd_pipeline_depth"
+NATIVE_PIPELINE_STAGE_SECONDS = "hvd_pipeline_stage_seconds"
 
 _TRUTHY = ("1", "true", "yes", "on")
 
@@ -303,4 +310,8 @@ __all__ = [
     "FUSION_BUCKETS_TOTAL", "FUSION_BUCKET_FILL",
     "NATIVE_HIERARCHICAL", "NATIVE_AUTOTUNE_CONVERGED",
     "NATIVE_STALL_EVENTS",
+    "NATIVE_CACHE_HITS", "NATIVE_CACHE_MISSES", "NATIVE_CACHE_EVICTIONS",
+    "NATIVE_CACHE_ENTRIES", "NATIVE_NEGOTIATION_BYTES",
+    "NATIVE_PIPELINE_OVERLAP", "NATIVE_PIPELINE_QUEUE_DEPTH",
+    "NATIVE_PIPELINE_DEPTH", "NATIVE_PIPELINE_STAGE_SECONDS",
 ]

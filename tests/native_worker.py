@@ -506,6 +506,131 @@ def scenario_cache_mixed_shape_error():
     print(f"rank {r}: cache mixed shape OK", flush=True)
 
 
+def scenario_pipeline_equiv():
+    """Deterministic mixed-size/mixed-dtype battery whose per-rank results
+    are dumped to HVD_TEST_OUT_DIR as raw bytes.  The test runs this twice
+    — pipeline depth 1 (inline serial data plane) and depth 2+ — and
+    asserts the dumps are BITWISE identical: the pipeline may only change
+    what runs concurrently, never the reduction order or rounding."""
+    import ml_dtypes
+
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    out_dir = os.environ["HVD_TEST_OUT_DIR"]
+    rng = np.random.default_rng(1234)  # same stream on every rank
+    chunks = []
+    for step in range(3):
+        handles = []
+        for i, (dtype, sz) in enumerate((
+                (np.float32, 1), (np.float16, 7), (np.float64, 1001),
+                (ml_dtypes.bfloat16, 513), (np.int32, 64),
+                (np.float32, 65536), (np.float16, 4096),
+                (np.float64, 333), (np.float32, 129))):
+            base = rng.standard_normal(sz)
+            arr = (base * (r + 1)).astype(dtype)
+            handles.append(hvd.allreduce_async(
+                arr, average=False, name=f"pe.s{step}.t{i}"))
+        for h in handles:
+            chunks.append(np.ascontiguousarray(hvd.synchronize(h)))
+        chunks.append(np.ascontiguousarray(hvd.broadcast(
+            (rng.standard_normal(17) * (r + 2)).astype(np.float32),
+            root_rank=n - 1, name=f"pe.bc{step}")))
+        chunks.append(np.ascontiguousarray(hvd.allgather(
+            (rng.standard_normal((r + 1, 3))).astype(np.float64),
+            name=f"pe.ag{step}")))
+        rows = 2 * n
+        chunks.append(np.ascontiguousarray(hvd.alltoall(
+            (rng.standard_normal((rows, 2)) + r).astype(np.float32),
+            name=f"pe.a2a{step}")))
+    blob = b"".join(c.tobytes() for c in chunks)
+    with open(os.path.join(out_dir, f"pipeline_equiv_r{r}.bin"), "wb") as f:
+        f.write(blob)
+    hvd.shutdown()
+    print(f"rank {r}: pipeline equiv OK ({len(blob)} bytes)", flush=True)
+
+
+def scenario_pipeline_inflight():
+    """Ordered completion under depth > 1: a deep stream of mixed-size
+    async ops (small fusion threshold so several fused groups coexist in
+    the executor queue) must all complete with correct values, and the
+    diagnostics must show the pipeline actually ran (items > 0; overlap
+    counters present)."""
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    ranks_sum = n * (n - 1) / 2
+    sizes = [64, 4096, 256, 16384, 1024, 8, 65536, 512]
+    for step in range(6):
+        handles = [
+            hvd.allreduce_async(
+                np.full(sizes[i % len(sizes)], float(r + i), np.float32),
+                average=False, name=f"pi.s{step}.g{i}")
+            for i in range(16)
+        ]
+        # synchronize in submit order: completions must arrive for every
+        # handle regardless of how deep the executor queue ran
+        for i, h in enumerate(handles):
+            got = hvd.synchronize(h)
+            assert np.allclose(got, n * i + ranks_sum), (r, step, i, got[0])
+    d = _diag()
+    assert d["pipeline_depth"] >= 2, d
+    assert d["pipeline_items"] > 0, d
+    assert d["pipeline_packs"] > 0, d
+    assert d["pipeline_wire_ns"] > 0, d
+    print(f"rank {r}: items={d['pipeline_items']} "
+          f"overlap={d['pipeline_overlap_fraction']}", flush=True)
+    hvd.shutdown()
+    print(f"rank {r}: pipeline inflight OK", flush=True)
+
+
+def scenario_pipeline_shutdown_inflight():
+    """Clean shutdown with work in flight: submit a pile of async ops and
+    shut down WITHOUT synchronizing.  The engine must drain the executor
+    queue before teardown (in-flight collectives finish on every rank) and
+    exit without hanging or aborting."""
+    hvd.init()
+    r = hvd.rank()
+    for i in range(12):
+        hvd.allreduce_async(np.full(1 << 18, float(r + i), np.float32),
+                            average=False, name=f"ps.g{i}")
+    hvd.shutdown()
+    print(f"rank {r}: pipeline shutdown OK", flush=True)
+
+
+def scenario_shm_carry():
+    """PeerSendRecvReduce's shm carry path: a deliberately small shm ring
+    (set by the test) fragments pops so the 1 MB accumulate bites split
+    elements mid-stream (fp64 / odd fp16 counts).  Per-rank results are
+    dumped to HVD_TEST_OUT_DIR; the test runs once over shm and once over
+    TCP staging (HOROVOD_TPU_SHM=0) and asserts bitwise identity — the
+    carry reassembly must never change the reduction arithmetic."""
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    out_dir = os.environ["HVD_TEST_OUT_DIR"]
+    rng = np.random.default_rng(77)
+    chunks = []
+    # > 1 MB payloads with odd element counts: fp64 (8 B elements split by
+    # arbitrary ring-pop boundaries), fp16 (2 B), and a fused fp64 group
+    for dtype, sz, name in ((np.float64, (1 << 17) + 7, "c64"),
+                            (np.float16, (1 << 19) + 3, "c16"),
+                            (np.float64, (1 << 16) + 1, "d64")):
+        arr = (rng.standard_normal(sz) * (r + 1)).astype(dtype)
+        chunks.append(np.ascontiguousarray(
+            hvd.allreduce(arr, average=False, name=name)))
+    handles = [
+        hvd.allreduce_async(
+            (rng.standard_normal((1 << 15) + 5) * (r + i)).astype(np.float64),
+            average=False, name=f"cf{i}")
+        for i in range(3)
+    ]
+    for h in handles:
+        chunks.append(np.ascontiguousarray(hvd.synchronize(h)))
+    blob = b"".join(c.tobytes() for c in chunks)
+    with open(os.path.join(out_dir, f"shm_carry_r{r}.bin"), "wb") as f:
+        f.write(blob)
+    hvd.shutdown()
+    print(f"rank {r}: shm carry OK ({len(blob)} bytes)", flush=True)
+
+
 def scenario_skewed_shutdown():
     """Rank 0 lags its shutdown by seconds (checkpointing, logging...) while
     the peers shut down and exit immediately.  Regression: the engine's

@@ -1,0 +1,123 @@
+"""tools/bench_compare.py on checked-in fixtures: perf numbers stop being
+write-only when a regression in a named series fails loudly."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOL = os.path.join(REPO, "tools", "bench_compare.py")
+OLD = os.path.join(REPO, "tests", "fixtures", "bench_old.json")
+NEW = os.path.join(REPO, "tests", "fixtures", "bench_new.json")
+
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import bench_compare  # noqa: E402
+
+
+def _load():
+    with open(OLD) as f:
+        old = json.load(f)
+    with open(NEW) as f:
+        new = json.load(f)
+    return old, new
+
+
+def test_no_regression_passes():
+    old, new = _load()
+    rows, code = bench_compare.compare(
+        old, new, ["np2.depth2.cycles_per_sec", "np2.speedup_d2_vs_d1"],
+        max_regression_pct=10.0)
+    assert code == 0, rows
+    assert all(not r["regressed"] for r in rows)
+
+
+def test_regression_detected_and_exit_nonzero():
+    old, new = _load()
+    # np4 speedup fell 1.5 -> 1.15 (-23%): beyond the 10% allowance
+    rows, code = bench_compare.compare(
+        old, new, ["np4.speedup_d2_vs_d1"], max_regression_pct=10.0)
+    assert code == 1
+    assert rows[0]["regressed"] and rows[0]["change_pct"] < -20
+
+
+def test_threshold_is_respected():
+    old, new = _load()
+    rows, code = bench_compare.compare(
+        old, new, ["np4.speedup_d2_vs_d1"], max_regression_pct=30.0)
+    assert code == 0, rows
+
+
+def test_lower_is_better_direction():
+    old, new = _load()
+    # wire ms/item rose 80 -> 95 (+18.75%): a regression under :lower
+    rows, code = bench_compare.compare(
+        old, new, ["np2.depth2.wire_ms_per_item:lower"],
+        max_regression_pct=10.0)
+    assert code == 1 and rows[0]["regressed"]
+    # the same series under the default higher-is-better is NOT flagged
+    rows, code = bench_compare.compare(
+        old, new, ["np2.depth2.wire_ms_per_item"], max_regression_pct=10.0)
+    assert code == 0, rows
+
+
+def test_list_index_paths():
+    old, new = _load()
+    rows, code = bench_compare.compare(
+        old, new, ["series_list.0.v"], max_regression_pct=10.0)
+    assert code == 0, rows
+    assert rows[0]["old"] == 3.5 and rows[0]["new"] == 3.4
+
+
+def test_zero_baseline_stays_json_safe():
+    old, new = _load()
+    # 0 -> 0.4 under higher-is-better: not a regression, and change_pct
+    # must be null (inf would be invalid JSON), not Infinity
+    rows, code = bench_compare.compare(
+        old, new, ["zero_base"], max_regression_pct=10.0)
+    assert code == 0 and rows[0]["change_pct"] is None, rows
+    json.dumps(rows)  # must serialize strictly
+    # the same move under lower-is-better IS a regression
+    rows, code = bench_compare.compare(
+        old, new, ["zero_base:lower"], max_regression_pct=10.0)
+    assert code == 1 and rows[0]["regressed"], rows
+
+
+def test_missing_series_exits_2():
+    old, new = _load()
+    rows, code = bench_compare.compare(
+        old, new, ["np2.depth9.cycles_per_sec"], max_regression_pct=10.0)
+    assert code == 2
+    assert "missing" in rows[0]["error"]
+
+
+def test_non_numeric_leaf_exits_2():
+    old, new = _load()
+    rows, code = bench_compare.compare(
+        old, new, ["config"], max_regression_pct=10.0)
+    assert code == 2
+
+
+def test_bad_direction_suffix_raises():
+    with pytest.raises(ValueError):
+        bench_compare.parse_series("a.b:sideways")
+
+
+def test_cli_end_to_end():
+    ok = subprocess.run(
+        [sys.executable, TOOL, OLD, NEW,
+         "--series", "np2.speedup_d2_vs_d1"],
+        capture_output=True, text=True)
+    assert ok.returncode == 0, ok.stdout + ok.stderr
+    assert "ok" in ok.stdout
+
+    bad = subprocess.run(
+        [sys.executable, TOOL, OLD, NEW,
+         "--series", "np4.speedup_d2_vs_d1", "--json"],
+        capture_output=True, text=True)
+    assert bad.returncode == 1, bad.stdout + bad.stderr
+    payload = json.loads(bad.stdout)
+    assert payload["rows"][0]["regressed"] is True

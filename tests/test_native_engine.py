@@ -443,6 +443,198 @@ def test_cache_claim_vs_mismatched_request_errors():
         assert f"rank {r}: cache mixed shape OK" in res.stdout
 
 
+# ---------------------------------------------------------------------------
+# pipelined data plane (executor thread + double-buffered fusion)
+# ---------------------------------------------------------------------------
+
+def _read_rank_files(out_dir, prefix, np_):
+    out = []
+    for r in range(np_):
+        with open(os.path.join(out_dir, f"{prefix}_r{r}.bin"), "rb") as f:
+            out.append(f.read())
+    return out
+
+
+@pytest.mark.parametrize("depth", [2, pytest.param(4, marks=pytest.mark.slow)])
+def test_pipeline_depth_equivalence_bitwise(tmp_path, depth):
+    """Depth 1 (inline serial data plane) vs depth N must produce BITWISE
+    identical results across mixed sizes and dtypes: the pipeline may only
+    change what runs concurrently, never the reduction order."""
+    blobs = {}
+    for d, sub in ((1, "d1"), (depth, f"d{depth}")):
+        out = tmp_path / sub
+        out.mkdir()
+        res = _run("pipeline_equiv", 2, env={
+            "HOROVOD_TPU_PIPELINE_DEPTH": str(d),
+            "HVD_TEST_OUT_DIR": str(out),
+            # pin the negotiation batching so both runs fuse IDENTICAL
+            # groups: fusion grouping follows cycle timing, and a group
+            # split moves ring chunk boundaries, which changes the fp
+            # addition order — a real (and acceptable) run-to-run
+            # variation that would mask what this test is after, namely
+            # that the PIPELINE itself never changes the arithmetic
+            "HOROVOD_TPU_CYCLE_TIME": "100",
+            "HOROVOD_TPU_BURST_WINDOW_US": "50000",
+        })
+        assert res.returncode == 0, res.stderr + res.stdout
+        for r in range(2):
+            assert f"rank {r}: pipeline equiv OK" in res.stdout
+        blobs[d] = _read_rank_files(str(out), "pipeline_equiv", 2)
+    for r in range(2):
+        assert blobs[1][r] == blobs[depth][r], (
+            f"rank {r}: depth {depth} results differ from depth 1")
+
+
+def test_pipeline_ordered_completion_deep_queue():
+    """Depth 4 with a tiny fusion threshold: several fused groups coexist
+    in the executor queue; completions must arrive for every handle in
+    submit order with correct values, and diagnostics must show the
+    pipeline actually ran."""
+    res = _run("pipeline_inflight", 2, timeout=180, env={
+        "HOROVOD_TPU_PIPELINE_DEPTH": "4",
+        "HOROVOD_TPU_FUSION_THRESHOLD": "65536",
+        "HOROVOD_TPU_CYCLE_TIME": "1",
+    })
+    assert res.returncode == 0, res.stderr + res.stdout
+    for r in range(2):
+        assert f"rank {r}: pipeline inflight OK" in res.stdout
+
+
+def test_pipeline_clean_shutdown_with_work_in_flight():
+    """shutdown() with a full executor queue must drain before teardown:
+    no hang, no 'terminate called', clean exit on every rank."""
+    t0 = time.monotonic()
+    res = _run("pipeline_shutdown_inflight", 2, env={
+        "HOROVOD_TPU_PIPELINE_DEPTH": "2",
+    })
+    assert res.returncode == 0, res.stderr + res.stdout
+    assert "terminate called" not in res.stderr
+    assert time.monotonic() - t0 < 90, "shutdown drain took suspiciously long"
+    for r in range(2):
+        assert f"rank {r}: pipeline shutdown OK" in res.stdout
+
+
+def test_pipeline_depth1_matches_inline_env():
+    """HOROVOD_TPU_PIPELINE_DEPTH=1 keeps the engine on the historical
+    inline path: the pipeline counters stay at zero while results hold
+    (collectives scenario)."""
+    res = _run("collectives", 2, env={
+        "HOROVOD_TPU_PIPELINE_DEPTH": "1",
+        "HOROVOD_TPU_LOG_LEVEL": "debug",
+    })
+    assert res.returncode == 0, res.stderr + res.stdout
+    assert "data plane: inline (depth 1)" in res.stderr, res.stderr[-2000:]
+    for r in range(2):
+        assert f"rank {r}: collectives OK" in res.stdout
+
+
+def test_shm_carry_path_bitwise_vs_tcp(tmp_path):
+    """PeerSendRecvReduce's shm carry reassembly (1 MB bites splitting
+    fp64 / odd fp16 elements on a deliberately tiny ring) must be bitwise
+    identical to the TCP staging path — same ring algorithm, same
+    accumulate order, different transport only."""
+    blobs = {}
+    for label, env in (("shm", {"HOROVOD_TPU_SHM_RING_BYTES": "65536"}),
+                       ("tcp", {"HOROVOD_TPU_SHM": "0"})):
+        out = tmp_path / label
+        out.mkdir()
+        env = dict(env, HVD_TEST_OUT_DIR=str(out))
+        res = _run("shm_carry", 2, timeout=180, env=env)
+        assert res.returncode == 0, res.stderr + res.stdout
+        for r in range(2):
+            assert f"rank {r}: shm carry OK" in res.stdout
+        blobs[label] = _read_rank_files(str(out), "shm_carry", 2)
+    for r in range(2):
+        assert blobs["shm"][r] == blobs["tcp"][r], (
+            f"rank {r}: shm carry path diverged from TCP staging")
+
+
+@pytest.mark.slow  # tsan build + instrumented run: minutes, not seconds
+@pytest.mark.skipif(_libtsan() is None, reason="libtsan not available")
+def test_pipeline_race_free_under_tsan():
+    """ThreadSanitizer pass over the deep-queue pipeline scenario: the
+    negotiation-thread/executor handoffs (work queue, buffer pool,
+    completion queue, overlap counters, timeline producers) must produce
+    zero race reports naming our translation units."""
+    mk = subprocess.run(["make", "-C", os.path.join(REPO, "csrc"), "tsan"],
+                        capture_output=True, text=True)
+    assert mk.returncode == 0, mk.stderr
+    res = _run("pipeline_inflight", 2, timeout=300, env={
+        "HOROVOD_TPU_NATIVE_LIB": os.path.join(REPO, "csrc",
+                                               "libhvdtpu_tsan.so"),
+        "LD_PRELOAD": _libtsan(),
+        "HOROVOD_TPU_PIPELINE_DEPTH": "4",
+        "HOROVOD_TPU_FUSION_THRESHOLD": "65536",
+        "TSAN_OPTIONS": "exitcode=0 halt_on_error=0",
+    })
+    assert res.returncode == 0, res.stderr[-3000:] + res.stdout[-500:]
+    if "WARNING: ThreadSanitizer" in res.stderr:
+        ours = ("hvdtpu", "engine.cc", "socket.cc", "wire.cc",
+                "timeline.cc", "autotune.cc")
+        assert not any(t in res.stderr for t in ours), res.stderr[-4000:]
+    for r in range(2):
+        assert f"rank {r}: pipeline inflight OK" in res.stdout
+
+
+def test_accum_blocked_kernels_match_scalar_bitwise():
+    """The blocked fp16/bf16 accumulate fallbacks must reproduce the
+    scalar helpers bit for bit across ALL 65536 input patterns (normals,
+    subnormals, zeros, inf, nan) — except bf16 NaN payloads, where the
+    vectorized add may legally propagate the other operand's NaN."""
+    import ctypes
+
+    import numpy as np
+
+    from horovod_tpu.runtime.native import lib_path
+
+    lib = ctypes.CDLL(lib_path())
+    lib.hvd_accum_apply.restype = ctypes.c_int
+    lib.hvd_accum_apply.argtypes = [ctypes.c_int, ctypes.c_int64,
+                                    ctypes.c_int, ctypes.c_void_p,
+                                    ctypes.c_void_p]
+
+    def apply(dtype_code, mode, dst, src):
+        d = dst.copy()
+        rc = lib.hvd_accum_apply(dtype_code, len(d), mode,
+                                 d.ctypes.data, src.ctypes.data)
+        assert rc == 0, (dtype_code, mode)
+        return d
+
+    rng = np.random.default_rng(0)
+    allbits = np.arange(65536, dtype=np.uint16)
+    for dtype_code in (4, 5):  # fp16, bf16
+        dst = rng.permutation(allbits)
+        src = rng.permutation(allbits)
+        scalar = apply(dtype_code, 1, dst, src)
+        blocked = apply(dtype_code, 2, dst, src)
+        neq = np.nonzero(scalar != blocked)[0]
+        if dtype_code == 4:
+            assert len(neq) == 0, neq[:10]
+        else:
+            # bf16: only NaN-involved lanes may differ, and both results
+            # must still be NaN
+            def is_nan(v):
+                return ((v & 0x7f80) == 0x7f80) & ((v & 0x7f) != 0)
+            for i in neq:
+                assert is_nan(dst[i]) or is_nan(src[i]), hex(int(dst[i]))
+                assert is_nan(scalar[i]) and is_nan(blocked[i]), i
+
+
+def test_hvd_pipeline_stats_api_shape():
+    """The pipeline-stats C API returns 8 well-formed counters (engine
+    down: all -1) and native.py derives a [0,1] overlap fraction."""
+    import ctypes
+
+    from horovod_tpu.runtime.native import lib_path
+
+    lib = ctypes.CDLL(lib_path())
+    lib.hvd_pipeline_stats.argtypes = [ctypes.POINTER(ctypes.c_int64)]
+    lib.hvd_pipeline_stats.restype = None
+    vals = (ctypes.c_int64 * 8)()
+    lib.hvd_pipeline_stats(vals)
+    assert all(int(v) == -1 for v in vals), list(vals)
+
+
 def test_shm_data_plane_active_and_optional():
     """Same-host peers ride the shared-memory rings (csrc/shm.cc) — the
     eager analog of the reference's intra-node shared-memory staging

@@ -1,0 +1,141 @@
+#!/usr/bin/env python
+"""Diff two BENCH_*.json artifacts and fail on regressions.
+
+Perf numbers stop being write-only the moment a checked-in artifact can
+gate a change: this tool compares named numeric series between an OLD and
+a NEW bench JSON and exits nonzero when any series regressed by more than
+the threshold.
+
+A *series* is a dotted path into the JSON tree (list indices allowed),
+optionally suffixed with a direction::
+
+    np4.depth2.cycles_per_sec            # higher is better (default)
+    np4.depth1.wire_ms_per_item:lower    # lower is better
+
+Usage::
+
+    python tools/bench_compare.py OLD.json NEW.json \
+        --series np4.speedup_d2_vs_d1 \
+        --series np2.depth2.cycles_per_sec \
+        --max-regression-pct 10
+
+Exit codes: 0 = no regression, 1 = at least one series regressed,
+2 = a requested series is missing/non-numeric in either file.
+
+Used by CI-style checks and the suite's fixture test
+(``tests/test_bench_compare.py``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def lookup(tree, dotted: str):
+    """Resolve ``a.b.0.c`` in nested dicts/lists; raises KeyError with the
+    failing segment so the error names what is actually missing."""
+    node = tree
+    for seg in dotted.split("."):
+        if isinstance(node, list):
+            try:
+                node = node[int(seg)]
+            except (ValueError, IndexError) as exc:
+                raise KeyError(f"{dotted!r}: bad list index {seg!r}") from exc
+        elif isinstance(node, dict):
+            if seg not in node:
+                raise KeyError(f"{dotted!r}: missing key {seg!r}")
+            node = node[seg]
+        else:
+            raise KeyError(f"{dotted!r}: {seg!r} reached a leaf")
+    return node
+
+
+def parse_series(spec: str) -> tuple[str, bool]:
+    """``path[:higher|lower]`` -> (path, higher_is_better)."""
+    path, _, direction = spec.partition(":")
+    if direction not in ("", "higher", "lower"):
+        raise ValueError(f"bad direction {direction!r} in {spec!r} "
+                         "(use :higher or :lower)")
+    return path, direction != "lower"
+
+
+def compare(old: dict, new: dict, series: list[str],
+            max_regression_pct: float) -> tuple[list[dict], int]:
+    """Evaluate every series; returns (rows, exit_code)."""
+    rows, code = [], 0
+    for spec in series:
+        path, higher = parse_series(spec)
+        row = {"series": path,
+               "direction": "higher" if higher else "lower"}
+        try:
+            a, b = lookup(old, path), lookup(new, path)
+            if not isinstance(a, (int, float)) or isinstance(a, bool) or \
+               not isinstance(b, (int, float)) or isinstance(b, bool):
+                raise KeyError(f"{path!r}: not numeric "
+                               f"({type(a).__name__}/{type(b).__name__})")
+        except KeyError as exc:
+            row["error"] = str(exc)
+            rows.append(row)
+            code = max(code, 2)
+            continue
+        row["old"], row["new"] = a, b
+        if a == 0:
+            # no meaningful percentage off a zero base (inf would also be
+            # invalid JSON): any move in the bad direction is a regression
+            row["change_pct"] = None
+            regressed = b < 0 if higher else b > 0
+        else:
+            change_pct = (b - a) / abs(a) * 100.0
+            row["change_pct"] = round(change_pct, 2)
+            regressed = (-change_pct if higher else change_pct) \
+                > max_regression_pct
+        row["regressed"] = bool(regressed)
+        if regressed:
+            code = max(code, 1)
+        rows.append(row)
+    return rows, code
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="bench_compare")
+    ap.add_argument("old", help="baseline BENCH_*.json")
+    ap.add_argument("new", help="candidate BENCH_*.json")
+    ap.add_argument("--series", action="append", required=True,
+                    metavar="PATH[:higher|lower]",
+                    help="dotted path to a numeric leaf; repeatable")
+    ap.add_argument("--max-regression-pct", type=float, default=10.0,
+                    help="allowed regression before failing (default 10)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the comparison as JSON instead of a table")
+    args = ap.parse_args(argv)
+
+    with open(args.old) as f:
+        old = json.load(f)
+    with open(args.new) as f:
+        new = json.load(f)
+    rows, code = compare(old, new, args.series, args.max_regression_pct)
+
+    if args.json:
+        print(json.dumps({"rows": rows, "exit_code": code}, indent=1))
+    else:
+        for r in rows:
+            if "error" in r:
+                print(f"MISSING  {r['series']}: {r['error']}")
+                continue
+            flag = "REGRESSED" if r["regressed"] else "ok"
+            pct = ("n/a (zero base)" if r["change_pct"] is None
+                   else f"{r['change_pct']:+.2f}%")
+            print(f"{flag:9s}{r['series']} ({r['direction']}): "
+                  f"{r['old']} -> {r['new']} ({pct})")
+        if code == 1:
+            print(f"FAIL: regression beyond {args.max_regression_pct}% "
+                  "in at least one series")
+        elif code == 2:
+            print("FAIL: missing/non-numeric series")
+    return code
+
+
+if __name__ == "__main__":
+    sys.exit(main())
