@@ -233,7 +233,8 @@ constexpr double kCycleMinUs = 1e3, kCycleMaxUs = 1e5;  // 1..100 ms
 void ParameterManager::Initialize(int64_t fusion0, int64_t cycle_us0,
                                   bool tune_hierarchical, bool hier0,
                                   bool tune_fusion, bool tune_cycle,
-                                  bool tune_depth, int64_t depth0) {
+                                  bool tune_depth, int64_t depth0,
+                                  bool tune_segment, int64_t segment0) {
   const char* on = getenv("HOROVOD_AUTOTUNE");
   if (!on || !on[0] || !strcmp(on, "0")) on = getenv("HOROVOD_TPU_AUTOTUNE");
   active_ = on && on[0] && strcmp(on, "0") != 0;
@@ -243,6 +244,8 @@ void ParameterManager::Initialize(int64_t fusion0, int64_t cycle_us0,
   hier_ = hier0;
   tune_depth_ = tune_depth;
   depth_ = depth0;
+  tune_seg_ = tune_segment;
+  segment_ = segment0;
   if (!active_) return;
   // env-pinned knobs leave the search space entirely (reference
   // fixed=true semantics): the GP never spends a dimension on them and
@@ -251,6 +254,7 @@ void ParameterManager::Initialize(int64_t fusion0, int64_t cycle_us0,
   if (tune_fusion) knobs_.push_back(kFusion);
   if (tune_cycle) knobs_.push_back(kCycle);
   if (tune_depth_) knobs_.push_back(kDepth);
+  if (tune_seg_) knobs_.push_back(kSegment);
   int cat = -1;
   if (tune_hier_) {
     cat = static_cast<int>(knobs_.size());
@@ -284,17 +288,24 @@ void ParameterManager::Initialize(int64_t fusion0, int64_t cycle_us0,
       // midpoint so the initial depth round-trips through SetPoint
       current_unit_.push_back(
           ((depth0 >= 4 ? 2 : depth0 >= 2 ? 1 : 0) + 0.5) / 3.0);
-    else
+    else if (k == kSegment) {
+      // {64,128,256,512,1024} KB mapped to fifths of the unit interval,
+      // seeded at the configured size's cell midpoint
+      int cell = 0;
+      while (cell < 4 && (int64_t{1} << (17 + cell)) <= segment0) cell++;
+      current_unit_.push_back((cell + 0.5) / 5.0);
+    } else
       current_unit_.push_back(hier0 ? 1.0 : 0.0);
   }
   if (!log_path_.empty()) {
     FILE* f = fopen(log_path_.c_str(), "w");
     if (f) {
-      // the depth column only appears when the knob is in the search, so
-      // default (static-depth) runs keep the historical 4-column format
+      // the depth/segment columns only appear when those knobs are in
+      // the search, so default runs keep the historical 4-column format
       fprintf(f, "fusion_threshold_bytes,cycle_time_us,"
-                 "hierarchical_allreduce,%sscore_bytes_per_us\n",
-              tune_depth_ ? "pipeline_depth," : "");
+                 "hierarchical_allreduce,%s%sscore_bytes_per_us\n",
+              tune_depth_ ? "pipeline_depth," : "",
+              tune_seg_ ? "ring_segment_bytes," : "");
       fclose(f);
     }
   }
@@ -304,13 +315,11 @@ void ParameterManager::Log(double score) {
   if (log_path_.empty()) return;
   FILE* f = fopen(log_path_.c_str(), "a");
   if (!f) return;
-  if (tune_depth_)
-    fprintf(f, "%lld,%lld,%d,%lld,%.6f\n", static_cast<long long>(fusion_),
-            static_cast<long long>(cycle_us_), hier_ ? 1 : 0,
-            static_cast<long long>(depth_), score);
-  else
-    fprintf(f, "%lld,%lld,%d,%.6f\n", static_cast<long long>(fusion_),
-            static_cast<long long>(cycle_us_), hier_ ? 1 : 0, score);
+  fprintf(f, "%lld,%lld,%d,", static_cast<long long>(fusion_),
+          static_cast<long long>(cycle_us_), hier_ ? 1 : 0);
+  if (tune_depth_) fprintf(f, "%lld,", static_cast<long long>(depth_));
+  if (tune_seg_) fprintf(f, "%lld,", static_cast<long long>(segment_));
+  fprintf(f, "%.6f\n", score);
   fclose(f);
 }
 
@@ -324,6 +333,9 @@ void ParameterManager::SetPoint(const std::vector<double>& unit) {
           kCycleMinUs + unit[i] * (kCycleMaxUs - kCycleMinUs));
     else if (knobs_[i] == kDepth)
       depth_ = int64_t{1} << std::min(static_cast<int>(unit[i] * 3.0), 2);
+    else if (knobs_[i] == kSegment)
+      segment_ = int64_t{1}
+                 << (16 + std::min(static_cast<int>(unit[i] * 5.0), 4));
     else
       hier_ = unit[i] >= 0.5;
   }
@@ -332,7 +344,8 @@ void ParameterManager::SetPoint(const std::vector<double>& unit) {
 bool ParameterManager::RecordCycle(int64_t bytes, double cycle_secs,
                                    int64_t* fusion_out,
                                    int64_t* cycle_us_out, int* hier_out,
-                                   int64_t* depth_out) {
+                                   int64_t* depth_out,
+                                   int64_t* segment_out) {
   if (!active_ || converged_) return false;
   bytes_acc_ += bytes;
   secs_acc_ += cycle_secs;
@@ -366,6 +379,7 @@ bool ParameterManager::RecordCycle(int64_t bytes, double cycle_secs,
   *cycle_us_out = cycle_us_;
   *hier_out = tune_hier_ ? (hier_ ? 1 : 0) : -1;
   if (depth_out) *depth_out = tune_depth_ ? depth_ : -1;
+  if (segment_out) *segment_out = tune_seg_ ? segment_ : -1;
   return true;
 }
 

@@ -78,24 +78,30 @@ class BayesianOptimization {
 // third dimension (unit value >= 0.5 maps to on; the reference tunes the
 // same knob, parameter_manager.cc:42-43) — plus, when the engine opts in
 // (HOROVOD_TPU_AUTOTUNE_PIPELINE_DEPTH=1 on a pipelined world), the
-// data-plane pipeline depth as a discrete {1,2,4} dimension — online from
-// observed throughput.  Call RecordCycle once per background-loop cycle
-// with the bytes processed that cycle; when a tuning step fires, returns
-// true and writes the new values (*hier_out / *depth_out are -1 when the
-// knob isn't tuned).
+// data-plane pipeline depth as a discrete {1,2,4} dimension — plus, when
+// the engine opts in (HOROVOD_TPU_AUTOTUNE_RING_SEGMENT=1 with
+// segmentation enabled), the ring segment size as a discrete
+// {64,128,256,512,1024} KB dimension — online from observed throughput.
+// Call RecordCycle once per background-loop cycle with the bytes
+// processed that cycle; when a tuning step fires, returns true and
+// writes the new values (*hier_out / *depth_out / *segment_out are -1
+// when the knob isn't tuned).
 class ParameterManager {
  public:
   // ``tune_fusion``/``tune_cycle`` false = the env pinned that knob: it
   // stays at its initial value and leaves the search space entirely (the
   // reference's ParameterManager fixed=true semantics,
-  // parameter_manager.h:67-81).  ``tune_depth`` is opt-in the other way
-  // around: the pipeline depth only enters the search when the engine
-  // explicitly asks (depth changes resize live buffer pools, so the
-  // default keeps it a static, table-shipped knob).
+  // parameter_manager.h:67-81).  ``tune_depth`` and ``tune_segment`` are
+  // opt-in the other way around: they only enter the search when the
+  // engine explicitly asks (depth resizes live buffer pools, segment
+  // size re-grains the hottest wire loop — the default keeps both
+  // static, table-shipped knobs).
   void Initialize(int64_t fusion0, int64_t cycle_us0,
                   bool tune_hierarchical = false, bool hier0 = false,
                   bool tune_fusion = true, bool tune_cycle = true,
-                  bool tune_depth = false, int64_t depth0 = 2);
+                  bool tune_depth = false, int64_t depth0 = 2,
+                  bool tune_segment = false,
+                  int64_t segment0 = 256 << 10);
   bool active() const { return active_; }
   // Diagnostic read from any thread (the bg loop owns the write): has the
   // search finished and applied bo_.Best()?
@@ -104,7 +110,8 @@ class ParameterManager {
   // Returns true when new parameter values should be applied (and synced).
   bool RecordCycle(int64_t bytes, double cycle_secs, int64_t* fusion_out,
                    int64_t* cycle_us_out, int* hier_out,
-                   int64_t* depth_out = nullptr);
+                   int64_t* depth_out = nullptr,
+                   int64_t* segment_out = nullptr);
 
  private:
   void Log(double score);
@@ -114,15 +121,17 @@ class ParameterManager {
   bool tune_hier_ = false;
   bool hier_ = false;
   bool tune_depth_ = false;
+  bool tune_seg_ = false;
   // which knobs the search owns, in unit-vector order (fixed knobs are
   // excluded — not merely held, so the GP never wastes a dimension)
-  enum Knob { kFusion, kCycle, kHier, kDepth };
+  enum Knob { kFusion, kCycle, kHier, kDepth, kSegment };
   std::vector<int> knobs_;
   BayesianOptimization bo_{2};
   std::vector<double> current_unit_;
   int64_t fusion_ = 64 << 20;
   int64_t cycle_us_ = 5000;
   int64_t depth_ = 2;
+  int64_t segment_ = 256 << 10;
 
   int cycles_per_sample_ = 10;
   int samples_per_step_ = 5;

@@ -116,30 +116,29 @@ void Accum16Blocked(uint16_t* dst, const uint16_t* src, int64_t n) {
 
 // fp16's portable converters are branchy (subnormal renormalization
 // loops, inf/nan cases), which blocks vectorization outright.  This
-// kernel scans each block for operands or results that need those paths
-// — subnormal/inf/nan inputs, sums leaving the fp16 normal range — and
-// runs the exact scalar helpers on such (rare in gradient traffic)
-// blocks.  Clean blocks take branch-free rebias/shift lanes whose
-// arithmetic, including the round-up carry, mirrors FloatToHalf /
-// HalfToFloat exactly, so both paths produce identical bits.
+// kernel runs branch-free rebias/shift lanes — whose arithmetic,
+// including the round-up carry, mirrors FloatToHalf / HalfToFloat
+// exactly — over EVERY lane, while building a per-lane "needs the scalar
+// path" mask: operands that are subnormal/inf/nan, or sums leaving the
+// fp16 normal range.  Flagged lanes (rare in gradient traffic) are
+// patched with the exact scalar helpers in a second pass, so a single
+// special no longer de-vectorizes its whole 256-element block; clean
+// blocks skip the patch pass entirely.  Both paths produce identical
+// bits — asserted over all 65536 input patterns by the test suite.
 __attribute__((optimize("O3", "tree-vectorize")))
 void AccumHalfBlocked(uint16_t* dst, const uint16_t* src, int64_t n) {
   constexpr int64_t kBlk = 256;
   float a[kBlk], b[kBlk];
+  uint16_t r[kBlk];
+  uint8_t fix[kBlk];
   for (int64_t i = 0; i < n; i += kBlk) {
     int64_t m = std::min<int64_t>(kBlk, n - i);
-    int specials = 0;
     for (int64_t j = 0; j < m; j++) {
       uint16_t x = dst[i + j], y = src[i + j];
       uint16_t ex = x & 0x7c00u, ey = y & 0x7c00u;
-      specials |= ((ex == 0) & ((x & 0x3ffu) != 0)) | (ex == 0x7c00u) |
-                  ((ey == 0) & ((y & 0x3ffu) != 0)) | (ey == 0x7c00u);
-    }
-    if (specials) {
-      for (int64_t j = 0; j < m; j++)
-        dst[i + j] =
-            FloatToHalf(HalfToFloat(dst[i + j]) + HalfToFloat(src[i + j]));
-      continue;
+      fix[j] = static_cast<uint8_t>(
+          ((ex == 0) & ((x & 0x3ffu) != 0)) | (ex == 0x7c00u) |
+          ((ey == 0) & ((y & 0x3ffu) != 0)) | (ey == 0x7c00u));
     }
     for (int64_t j = 0; j < m; j++) {
       uint16_t x = dst[i + j];
@@ -156,26 +155,31 @@ void AccumHalfBlocked(uint16_t* dst, const uint16_t* src, int64_t n) {
       std::memcpy(&b[j], &f, 4);
     }
     for (int64_t j = 0; j < m; j++) a[j] += b[j];
-    int bad = 0;
+    int patch = 0;
     for (int64_t j = 0; j < m; j++) {
       uint32_t u;
       std::memcpy(&u, &a[j], 4);
       uint32_t em = u & 0x7fffffffu;
-      bad |= ((em != 0) & (em < (113u << 23))) | (em >= (143u << 23));
-    }
-    if (bad) {
-      for (int64_t j = 0; j < m; j++) dst[i + j] = FloatToHalf(a[j]);
-      continue;
-    }
-    for (int64_t j = 0; j < m; j++) {
-      uint32_t u;
-      std::memcpy(&u, &a[j], 4);
-      uint32_t em = u & 0x7fffffffu;
+      // sums leaving the fp16 normal range need FloatToHalf's
+      // subnormal/overflow handling; for special INPUTS em is computed
+      // from a garbage rebias — irrelevant, those lanes are flagged above
+      fix[j] |= static_cast<uint8_t>(
+          ((em != 0) & (em < (113u << 23))) | (em >= (143u << 23)));
+      patch |= fix[j];
       uint32_t v = em - (112u << 23);
       uint16_t h =
           em ? static_cast<uint16_t>((v >> 13) + ((v >> 12) & 1u)) : 0u;
-      dst[i + j] = h | static_cast<uint16_t>((u >> 16) & 0x8000u);
+      r[j] = h | static_cast<uint16_t>((u >> 16) & 0x8000u);
     }
+    if (patch) {
+      // dst is still intact here — the scalar recompute reads the
+      // original operands, exactly as the all-scalar path would
+      for (int64_t j = 0; j < m; j++)
+        if (fix[j])
+          r[j] = FloatToHalf(HalfToFloat(dst[i + j]) +
+                             HalfToFloat(src[i + j]));
+    }
+    for (int64_t j = 0; j < m; j++) dst[i + j] = r[j];
   }
 }
 
@@ -317,6 +321,22 @@ void Accumulate(void* dst, const void* src, int64_t n, DType d) {
   }
 }
 
+// Ring-segment size sanitizer shared by the env parse, the bootstrap
+// table, and the tuned-knob adoption path.  0 keeps the monolithic
+// per-step ring; anything else is clamped and rounded UP to a 64-byte
+// multiple.  The alignment is load-bearing for bitwise equivalence: 64
+// bytes is a whole number of 8-element groups for every dtype (esize <=
+// 8), so segment boundaries never move the blocked/SIMD accumulate
+// kernels' group boundaries relative to the chunk base — the fp16
+// kernels are grouping-sensitive on rounding ties, and an unaligned
+// segment would change results vs the monolithic whole-chunk accumulate.
+int64_t NormalizeSegmentBytes(int64_t b) {
+  if (b <= 0) return 0;
+  if (b < (4 << 10)) b = 4 << 10;
+  if (b > (1 << 30)) b = 1 << 30;
+  return (b + 63) & ~int64_t{63};
+}
+
 // ---------------------------------------------------------------------------
 
 struct TensorEntry {
@@ -431,6 +451,25 @@ class Engine {
     out[7] = pipe_overlap_ns_.load(std::memory_order_relaxed);
   }
 
+  // Segmented-ring counters, readable from any thread: {configured
+  // segment bytes, segmented ring runs, monolithic ring runs, segments
+  // sent, payload bytes sent through the segmented loop, cumulative
+  // segmented-loop wall ns, no-progress (wire idle) ns inside that,
+  // reserved}.  Python derives hvd_ring_wire_idle_fraction =
+  // idle_ns / wall_ns.  Segments and bytes are COUNTED metrics — a pure
+  // function of (tensor sizes, ring size, segment size) — so they can
+  // gate CI on hosts whose wall-clock numbers cannot.
+  void RingStats(int64_t out[8]) const {
+    out[0] = ring_segment_bytes_.load(std::memory_order_relaxed);
+    out[1] = ring_runs_seg_.load(std::memory_order_relaxed);
+    out[2] = ring_runs_mono_.load(std::memory_order_relaxed);
+    out[3] = ring_segments_.load(std::memory_order_relaxed);
+    out[4] = ring_seg_payload_bytes_.load(std::memory_order_relaxed);
+    out[5] = ring_wire_ns_.load(std::memory_order_relaxed);
+    out[6] = ring_idle_ns_.load(std::memory_order_relaxed);
+    out[7] = 0;
+  }
+
  private:
   void BackgroundLoop();
   void WaitForWork(std::chrono::microseconds max_wait);
@@ -471,7 +510,7 @@ class Engine {
   void HandleDisplaced(const std::vector<std::string>& displaced);
   // workers: adopt coordinator-tuned knobs from any response-side frame
   void AdoptTuned(int64_t fusion, int64_t cycle_us, int64_t hier,
-                  int64_t depth);
+                  int64_t depth, int64_t seg_bytes);
   // -- pipelined data plane (see the member block below) -------------------
   struct PipeBuf {
     int id = 0;
@@ -511,6 +550,10 @@ class Engine {
   }
   Status RingAllreduceGroup(char* buf, int64_t nelems, DType dtype,
                             const std::vector<int>& members);
+  Status RingAllreduceGroupSegmented(char* buf, int64_t nelems, DType dtype,
+                                     const std::vector<int>& members,
+                                     int64_t seg_bytes);
+  void ApplyRingSegment(int64_t bytes);
   Status HierarchicalAllreduce(char* buf, int64_t nelems, DType dtype);
   Status RingAllgatherGroup(const std::vector<int>& members,
                             const std::vector<size_t>& member_bytes,
@@ -606,6 +649,25 @@ class Engine {
   // efficiency counter-part to pipe_wire_ns_ — logged at shutdown under
   // HOROVOD_TPU_PIPELINE_DEBUG to localize refill-chain stalls
   std::atomic<int64_t> pipe_idle_ns_{0};
+
+  // -- segmented ring (PR 4) ----------------------------------------------
+  // Segment size for the windowed ring allreduce (bytes; 0 = monolithic
+  // per-step exchange).  Rank 0 decides and the bootstrap table ships the
+  // value (like cache capacity and pipeline depth) so diagnostics and
+  // benches observe ONE size per job; the opt-in autotuner retunes it
+  // through the same tuned-knob frames.  Atomic: the bg loop writes
+  // (AdoptTuned), the wire thread reads per collective, diagnostics read
+  // from anywhere.  Always normalized to a 64-byte multiple — see
+  // NormalizeSegmentBytes for why that is load-bearing.
+  std::atomic<int64_t> ring_segment_bytes_{256 << 10};
+  std::atomic<int64_t> ring_runs_seg_{0}, ring_runs_mono_{0};
+  std::atomic<int64_t> ring_segments_{0}, ring_seg_payload_bytes_{0};
+  std::atomic<int64_t> ring_wire_ns_{0}, ring_idle_ns_{0};
+  // monolithic-ring idle accounting: set by the wire thread around the
+  // monolithic body so the shared Peer* progress loops attribute their
+  // no-progress waits to the ring (null outside it) — this is what makes
+  // hvd_ring_wire_idle_fraction comparable across the two ring modes
+  int64_t* ring_idle_sink_ = nullptr;
 
   // byte-buffer pool for entry/result staging (guarded by mu_): fresh
   // 64 MB allocations fault pages at a fraction of warm-copy bandwidth,
@@ -727,6 +789,7 @@ class Engine {
   int64_t pending_tuned_cycle_ = -1;
   int64_t pending_tuned_hier_ = -1;
   int64_t pending_tuned_depth_ = -1;
+  int64_t pending_tuned_segment_ = -1;
 };
 
 // Set for the lifetime of the data-plane executor thread: routes wire
@@ -792,6 +855,13 @@ Status Engine::Init(const std::string& host, int port, int rank, int size) {
   // opt-in depth autotuner all observe ONE depth per job
   int64_t depth = EnvInt64("HOROVOD_TPU_PIPELINE_DEPTH", 2);
   pipeline_depth_ = depth < 1 ? 1 : depth > 8 ? 8 : depth;
+  // ring segment size: rank-0 decided and table-shipped like the two
+  // knobs above.  Disagreement would not corrupt the byte stream (the
+  // segmented wire framing is headerless and order-identical to the
+  // monolithic ring), but one job must observe ONE size for diagnostics,
+  // benches, and the opt-in segment autotuner to mean anything.
+  ring_segment_bytes_ = NormalizeSegmentBytes(
+      EnvInt64("HOROVOD_TPU_RING_SEGMENT_BYTES", 256 << 10));
   if (size_ > 1) {
     // data-plane listener first, so peers can connect whenever they learn
     // our address
@@ -843,7 +913,7 @@ Status Engine::Init(const std::string& host, int port, int rank, int size) {
       std::ostringstream table;
       table << "HVDW" << kWireVersion << " " << shm_token << " " << shm_on
             << " " << cache_capacity_ << " " << pipeline_depth_.load()
-            << " ";
+            << " " << ring_segment_bytes_.load() << " ";
       for (int i = 0; i < size_; i++)
         table << hosts[i] << " " << ports[i] << " " << hashes[i] << " ";
       for (int i = 1; i < size_; i++) {
@@ -873,10 +943,12 @@ Status Engine::Init(const std::string& host, int port, int rank, int size) {
             "table tag '" + tag + "', this engine expects 'HVDW" +
             std::to_string(kWireVersion) +
             "' — all ranks must load the same libhvdtpu.so");
-      int64_t table_depth = 2;
-      is >> shm_token >> shm_on >> cache_capacity_ >> table_depth;
+      int64_t table_depth = 2, table_seg = 256 << 10;
+      is >> shm_token >> shm_on >> cache_capacity_ >> table_depth
+         >> table_seg;
       pipeline_depth_ = table_depth < 1 ? 1 : table_depth > 8 ? 8
                                                               : table_depth;
+      ring_segment_bytes_ = NormalizeSegmentBytes(table_seg);
       for (int i = 0; i < size_; i++) is >> hosts[i] >> ports[i] >> hashes[i];
     }
 
@@ -988,6 +1060,12 @@ Status Engine::Init(const std::string& host, int port, int rank, int size) {
                                               std::to_string(
                                                   pipeline_depth_.load())
                                         : "inline (depth 1)");
+  // ring-segment autotuning is opt-in the same way depth is: the knob
+  // only enters the search when asked, and never when segmentation is
+  // disabled outright (segment 0 pins the monolithic ring)
+  bool tune_segment = size_ > 1 &&
+                      EnvFlag("HOROVOD_TPU_AUTOTUNE_RING_SEGMENT") &&
+                      ring_segment_bytes_.load() > 0;
   if (rank_ == 0)
     pm_.Initialize(fusion_threshold_, cycle_us_,
                    /*tune_hierarchical=*/dflt && !(ha && ha[0]),
@@ -996,7 +1074,9 @@ Status Engine::Init(const std::string& host, int port, int rank, int size) {
                                             "HOROVOD_FUSION_THRESHOLD"),
                    /*tune_cycle=*/!env_set("HOROVOD_TPU_CYCLE_TIME",
                                            "HOROVOD_CYCLE_TIME"),
-                   /*tune_depth=*/tune_depth, pipeline_depth_.load());
+                   /*tune_depth=*/tune_depth, pipeline_depth_.load(),
+                   /*tune_segment=*/tune_segment,
+                   ring_segment_bytes_.load());
 
   cache_.Init(cache_capacity_);
   LOG_RANK(Debug, rank_) << "response cache: capacity " << cache_.capacity()
@@ -1346,9 +1426,10 @@ void Engine::BackgroundLoop() {
       double secs = std::chrono::duration<double>(
                         std::chrono::steady_clock::now() - cycle_start)
                         .count();
-      int64_t f, cus, dep;
+      int64_t f, cus, dep, segb;
       int hier;
-      if (pm_.RecordCycle(cycle_bytes_, secs, &f, &cus, &hier, &dep)) {
+      if (pm_.RecordCycle(cycle_bytes_, secs, &f, &cus, &hier, &dep,
+                          &segb)) {
         fusion_threshold_ = f;
         cycle_us_ = cus;
         pending_tuned_fusion_ = f;
@@ -1360,6 +1441,10 @@ void Engine::BackgroundLoop() {
         if (dep >= 1) {
           ApplyPipelineDepth(dep);
           pending_tuned_depth_ = dep;
+        }
+        if (segb >= 1) {
+          ApplyRingSegment(segb);
+          pending_tuned_segment_ = ring_segment_bytes_.load();
         }
       }
       cycle_bytes_ = 0;
@@ -1387,18 +1472,21 @@ Status Engine::RecvCtrl(Socket& sock, std::string* frame) {
 }
 
 void Engine::AdoptTuned(int64_t fusion, int64_t cycle_us, int64_t hier,
-                        int64_t depth) {
+                        int64_t depth, int64_t seg_bytes) {
   // workers adopt coordinator-tuned knobs from the wire BEFORE executing
   // the responses of the frame that carried them: the coordinator already
   // runs the new values for those responses, and the hierarchical flag
   // changes the collective algorithm itself — a one-response skew would
   // make ranks exchange with incompatible patterns and hang.  (The
-  // pipeline depth has no such constraint — it only sizes the local
-  // buffer pool — but adopting it here keeps every knob on one path.)
+  // pipeline depth and ring segment size have no such constraint — depth
+  // only sizes the local buffer pool, and the segmented wire framing is
+  // order-identical for any segment size — but adopting them here keeps
+  // every knob on one path.)
   if (fusion >= 0) fusion_threshold_ = fusion;
   if (cycle_us > 0) cycle_us_ = cycle_us;
   if (hier >= 0) hierarchical_allreduce_ = hier != 0;
   if (depth >= 1) ApplyPipelineDepth(depth);
+  if (seg_bytes >= 1) ApplyRingSegment(seg_bytes);
 }
 
 void Engine::SplitRequests(std::vector<Request>& reqs, RequestList* full,
@@ -1679,7 +1767,7 @@ void Engine::WorkerTick(RequestList& local, bool* stop) {
         return;
       }
       AdoptTuned(ce.tuned_fusion, ce.tuned_cycle_us, ce.tuned_hierarchical,
-                 ce.tuned_pipeline_depth);
+                 ce.tuned_pipeline_depth, ce.tuned_segment_bytes);
       for (const auto& g : ce.groups) {
         Response resp;
         s = DecodeCachedGroup(g, &resp);
@@ -1699,7 +1787,7 @@ void Engine::WorkerTick(RequestList& local, bool* stop) {
         return;
       }
       AdoptTuned(rl.tuned_fusion, rl.tuned_cycle_us, rl.tuned_hierarchical,
-                 rl.tuned_pipeline_depth);
+                 rl.tuned_pipeline_depth, rl.tuned_segment_bytes);
       auto snap = SnapshotReqs(rl);
       for (const Response& r : rl.responses) Dispatch(r);
       ApplyCacheMutations(rl, snap);
@@ -1793,7 +1881,8 @@ bool Engine::CoordinatorTick(RequestList& local) {
   out.shutdown = shutdown;
   bool have_ce = !ce.groups.empty();
   bool have_tuned = pending_tuned_fusion_ >= 0 || pending_tuned_cycle_ >= 0 ||
-                    pending_tuned_hier_ >= 0 || pending_tuned_depth_ >= 0;
+                    pending_tuned_hier_ >= 0 || pending_tuned_depth_ >= 0 ||
+                    pending_tuned_segment_ >= 0;
   bool have_rl = !out.responses.empty() || out.shutdown ||
                  (have_tuned && !have_ce);
   if (have_tuned) {
@@ -1810,11 +1899,13 @@ bool Engine::CoordinatorTick(RequestList& local) {
       ce.tuned_cycle_us = pending_tuned_cycle_;
       ce.tuned_hierarchical = pending_tuned_hier_;
       ce.tuned_pipeline_depth = pending_tuned_depth_;
+      ce.tuned_segment_bytes = pending_tuned_segment_;
     } else {
       out.tuned_fusion = pending_tuned_fusion_;
       out.tuned_cycle_us = pending_tuned_cycle_;
       out.tuned_hierarchical = pending_tuned_hier_;
       out.tuned_pipeline_depth = pending_tuned_depth_;
+      out.tuned_segment_bytes = pending_tuned_segment_;
     }
   }
   bool sent = true;
@@ -1845,6 +1936,7 @@ bool Engine::CoordinatorTick(RequestList& local) {
     pending_tuned_cycle_ = -1;
     pending_tuned_hier_ = -1;
     pending_tuned_depth_ = -1;
+    pending_tuned_segment_ = -1;
   }
   // local execution mirrors the wire order exactly: cached groups first,
   // then full responses, then the full responses' cache mutations
@@ -2312,6 +2404,11 @@ void Engine::ApplyPipelineDepth(int64_t d) {
   }
 }
 
+void Engine::ApplyRingSegment(int64_t bytes) {
+  ring_segment_bytes_.store(NormalizeSegmentBytes(bytes),
+                            std::memory_order_relaxed);
+}
+
 // Watchdog over the executor (runs on the negotiation thread every tick,
 // on every rank): one warning per wedged item, counted into the same
 // hvd_stall_events the negotiation watchdog feeds.
@@ -2698,6 +2795,37 @@ bool Stalled(std::chrono::steady_clock::time_point last_progress,
                                        last_progress)
              .count() > limit;
 }
+
+// Deterministic wait for progress loops whose blocked direction is a TCP
+// send (ROADMAP "paced/TCP waits still poll"): a paced-out sender knows
+// the token-bucket refill time — sleep exactly that, freeing the core
+// for accumulate/pack work instead of burning it on the spin/yield/sleep
+// ladder — and a kernel-buffer-full sender parks in poll(2) on
+// writability so the wakeup is the event itself, not a ladder guess.
+// ``fast_rx`` caps the wait when another (shm) direction still needs
+// polling service.  Callers fall back to Backoff::Wait() when the
+// blocked direction is not a TCP send.
+void SendBlockedWait(Backoff& bo, Socket& tx, size_t want, bool fast_rx) {
+  bo.idle++;
+  if (bo.idle < 8) return;  // stay hot: a near-empty bucket refills fast
+  double d = tx.PaceDelaySeconds(want);
+  if (d > 0) {
+    int64_t us = static_cast<int64_t>(d * 1e6);
+    int64_t cap = fast_rx ? 1000 : 50000;
+    std::this_thread::sleep_for(std::chrono::microseconds(
+        us < 20 ? 20 : us > cap ? cap : us));
+    return;
+  }
+  if (bo.idle < 64) {
+    std::this_thread::yield();
+    return;
+  }
+  struct pollfd p;
+  p.fd = tx.fd();
+  p.events = POLLOUT;
+  p.revents = 0;
+  ::poll(&p, 1, fast_rx ? 1 : 50);
+}
 }  // namespace
 
 Status Engine::PeerSendAll(int r, const void* data, size_t n) {
@@ -2756,11 +2884,20 @@ Status Engine::PeerSendRecv(int r_send, const void* send_buf, size_t send_n,
                     : nullptr;
   if (!tx && !rx)
     return Socket::SendRecv(peers_[r_send], send_buf, send_n, peers_[r_recv],
-                            recv_buf, recv_n);
+                            recv_buf, recv_n, ring_idle_sink_);
   const char* sp = static_cast<const char*>(send_buf);
   char* rp = static_cast<char*>(recv_buf);
   size_t sleft = send_n, rleft = recv_n;
   auto last_prog = std::chrono::steady_clock::now();
+  int64_t idle_since = 0;
+  // error exits must flush the open idle interval too — a 60 s stall is
+  // exactly when the ring idle fraction matters most
+  auto flush_idle = [&] {
+    if (idle_since) {
+      *ring_idle_sink_ += NowNs() - idle_since;
+      idle_since = 0;
+    }
+  };
   Backoff bo;
   while (sleft > 0 || rleft > 0) {
     bool prog = false;
@@ -2772,7 +2909,10 @@ Status Engine::PeerSendRecv(int r_send, const void* send_buf, size_t send_n,
         prog |= k > 0;
       } else {
         int k = peers_[r_send].SendSome(sp, sleft);
-        if (k < 0) return Status::Error("peer send failed");
+        if (k < 0) {
+          flush_idle();
+          return Status::Error("peer send failed");
+        }
         sp += k;
         sleft -= static_cast<size_t>(k);
         prog |= k > 0;
@@ -2786,20 +2926,30 @@ Status Engine::PeerSendRecv(int r_send, const void* send_buf, size_t send_n,
         prog |= k > 0;
       } else {
         int k = peers_[r_recv].RecvSome(rp, rleft);
-        if (k < 0) return Status::Error("peer recv failed or closed");
+        if (k < 0) {
+          flush_idle();
+          return Status::Error("peer recv failed or closed");
+        }
         rp += k;
         rleft -= static_cast<size_t>(k);
         prog |= k > 0;
       }
     }
     if (prog) {
+      flush_idle();
       bo.Progress();
       last_prog = std::chrono::steady_clock::now();
       continue;
     }
-    bo.Wait();
-    if (Stalled(last_prog, Timeouts().duplex))
+    if (ring_idle_sink_ && !idle_since) idle_since = NowNs();
+    if (!tx && sleft > 0)
+      SendBlockedWait(bo, peers_[r_send], sleft, /*fast_rx=*/rleft > 0);
+    else
+      bo.Wait();
+    if (Stalled(last_prog, Timeouts().duplex)) {
+      flush_idle();
       return Status::Error("peer send_recv made no progress inside the timeout");
+    }
   }
   return Status::OK();
 }
@@ -2836,6 +2986,13 @@ Status Engine::PeerSendRecvReduce(int r_send, const void* send_buf,
   size_t carry = 0;       // partial-element bytes awaiting their tail
   int64_t done_el = 0;    // elements already accumulated into dst
   auto last_prog = std::chrono::steady_clock::now();
+  int64_t idle_since = 0;
+  auto flush_idle = [&] {
+    if (idle_since) {
+      *ring_idle_sink_ += NowNs() - idle_since;
+      idle_since = 0;
+    }
+  };
   Backoff bo;
   while (sleft > 0 || rleft > 0) {
     bool prog = false;
@@ -2847,7 +3004,10 @@ Status Engine::PeerSendRecvReduce(int r_send, const void* send_buf,
         prog |= k > 0;
       } else {
         int k = peers_[r_send].SendSome(sp, sleft);
-        if (k < 0) return Status::Error("peer send failed");
+        if (k < 0) {
+          flush_idle();
+          return Status::Error("peer send failed");
+        }
         sp += k;
         sleft -= static_cast<size_t>(k);
         prog |= k > 0;
@@ -2868,14 +3028,21 @@ Status Engine::PeerSendRecvReduce(int r_send, const void* send_buf,
       }
     }
     if (prog) {
+      flush_idle();
       bo.Progress();
       last_prog = std::chrono::steady_clock::now();
       continue;
     }
-    bo.Wait();
-    if (Stalled(last_prog, Timeouts().duplex))
+    if (ring_idle_sink_ && !idle_since) idle_since = NowNs();
+    if (!tx && sleft > 0)
+      SendBlockedWait(bo, peers_[r_send], sleft, /*fast_rx=*/rleft > 0);
+    else
+      bo.Wait();
+    if (Stalled(last_prog, Timeouts().duplex)) {
+      flush_idle();
       return Status::Error(
           "shm send_recv_reduce made no progress inside the timeout");
+    }
   }
   return Status::OK();
 }
@@ -2884,6 +3051,15 @@ Status Engine::RingAllreduceGroup(char* buf, int64_t nelems, DType dtype,
                                   const std::vector<int>& members) {
   int m = static_cast<int>(members.size());
   if (m <= 1) return Status::OK();
+  int64_t seg = ring_segment_bytes_.load(std::memory_order_relaxed);
+  if (seg > 0)
+    return RingAllreduceGroupSegmented(buf, nelems, dtype, members, seg);
+  // HOROVOD_TPU_RING_SEGMENT_BYTES=0: the historical monolithic ring —
+  // one whole-chunk duplex exchange per step, barriering on each
+  // (bisection knob, and the reference the segmented loop must match
+  // bitwise).  Wall/idle time still feeds the ring counters so
+  // hvd_ring_wire_idle_fraction compares the two modes.
+  ring_runs_mono_.fetch_add(1, std::memory_order_relaxed);
   int me = static_cast<int>(
       std::find(members.begin(), members.end(), rank_) - members.begin());
   if (me == m) return Status::Error("rank not in ring group");
@@ -2892,7 +3068,10 @@ Status Engine::RingAllreduceGroup(char* buf, int64_t nelems, DType dtype,
   int left = members[(me + m - 1) % m];
   auto chunk_lo = [&](int c) { return nelems * c / m; };
 
-  for (int step = 0; step < m - 1; step++) {
+  int64_t idle = 0, t0 = NowNs();
+  ring_idle_sink_ = &idle;
+  Status result;
+  for (int step = 0; step < m - 1 && result.ok(); step++) {
     int send_c = (me - step + 2 * m) % m;
     int recv_c = (me - step - 1 + 2 * m) % m;
     int64_t s_lo = chunk_lo(send_c), s_hi = chunk_lo(send_c + 1);
@@ -2901,9 +3080,9 @@ Status Engine::RingAllreduceGroup(char* buf, int64_t nelems, DType dtype,
         right, buf + s_lo * esize, (s_hi - s_lo) * esize,
         left, buf + r_lo * esize, r_hi - r_lo, dtype);
     if (!st.ok())
-      return Status::Error("ring allreduce failed: " + st.message);
+      result = Status::Error("ring allreduce failed: " + st.message);
   }
-  for (int step = 0; step < m - 1; step++) {
+  for (int step = 0; step < m - 1 && result.ok(); step++) {
     int send_c = (me + 1 - step + 2 * m) % m;
     int recv_c = (me - step + 2 * m) % m;
     int64_t s_lo = chunk_lo(send_c), s_hi = chunk_lo(send_c + 1);
@@ -2912,8 +3091,287 @@ Status Engine::RingAllreduceGroup(char* buf, int64_t nelems, DType dtype,
         right, buf + s_lo * esize, (s_hi - s_lo) * esize,
         left, buf + r_lo * esize, (r_hi - r_lo) * esize);
     if (!st.ok())
-      return Status::Error("ring allreduce failed: " + st.message);
+      result = Status::Error("ring allreduce failed: " + st.message);
   }
+  ring_idle_sink_ = nullptr;
+  ring_wire_ns_.fetch_add(NowNs() - t0, std::memory_order_relaxed);
+  ring_idle_ns_.fetch_add(idle, std::memory_order_relaxed);
+  return result;
+}
+
+namespace {
+// Work-unit geometry for the segmented ring.  chunk c = elements
+// [nelems*c/m, nelems*(c+1)/m); chunks differ by at most one element, so
+// segmentation is derived per chunk.  Global step t runs 0..2m-3: t <
+// m-1 is the reduce-scatter phase, the rest the allgather phase.  The
+// chunk SENT at step t is exactly the chunk RECEIVED at step t-1 (both
+// phases), so "send unit (t,s) is eligible once recv unit (t-1,s)
+// landed" needs no chunk translation: a segment index means the same
+// byte range on both sides of the dependency.
+struct SegGeom {
+  int64_t nelems;
+  int m;
+  int me;
+  int64_t seg_elems;
+  int64_t chunk_lo(int c) const { return nelems * c / m; }
+  // One expression covers both phases: reduce-scatter step t sends
+  // (me - t), and allgather step k sends (me + 1 - k) = (me - t + m)
+  // for t = k + m - 1 — congruent mod m.
+  int send_chunk(int t) const { return ((me - t) % m + 2 * m) % m; }
+  int recv_chunk(int t) const { return send_chunk(t + 1); }
+  int64_t segs(int c) const {
+    int64_t len = chunk_lo(c + 1) - chunk_lo(c);
+    return len == 0 ? 1 : (len + seg_elems - 1) / seg_elems;
+  }
+  // absolute element bounds of segment s within chunk c
+  int64_t seg_lo(int c, int64_t s) const {
+    int64_t lo = chunk_lo(c) + s * seg_elems;
+    int64_t top = chunk_lo(c + 1);
+    return lo < top ? lo : top;
+  }
+  int64_t seg_hi(int c, int64_t s) const {
+    int64_t hi = chunk_lo(c) + (s + 1) * seg_elems;
+    int64_t top = chunk_lo(c + 1);
+    return hi < top ? hi : top;
+  }
+};
+}  // namespace
+
+// Segmented, windowed ring allreduce (NCCL-style chunk-internal
+// pipelining; ROADMAP "overlap the wire with itself").  The monolithic
+// ring barriers on whole chunks: step k+1's first byte cannot leave until
+// step k's LAST byte has arrived and accumulated, so the wire idles
+// through every tail accumulate — at pipeline depth 1 there is nothing
+// else to hide it behind.  Here both phases run as ONE sliding window
+// over (step, segment) units: a step-k+1 send of segment s launches the
+// moment that segment's step-k accumulate lands, and segment s+1 streams
+// through the transport (shm ring or kernel socket buffer) while segment
+// s accumulates.  There is no phase barrier either: the first allgather
+// send of a segment departs as soon as its final reduce-scatter
+// accumulate lands.
+//
+// Results are bitwise identical to the monolithic ring by construction:
+//  * the byte stream per neighbor is unchanged — segmentation moves WHEN
+//    bytes become eligible, never their order or content, so the
+//    headerless framing still needs no tags;
+//  * every element is accumulated exactly once per step in the same step
+//    order, so each element's float addition chain is untouched;
+//  * segments are 64-byte aligned (NormalizeSegmentBytes), so the
+//    blocked/SIMD accumulate kernels partition each chunk into the same
+//    8-element groups a whole-chunk Accumulate would — the fp16 kernels
+//    are grouping-sensitive on rounding ties, and this pins the grouping
+//    for ANY segment size (which is also what makes live segment
+//    retuning safe).
+Status Engine::RingAllreduceGroupSegmented(char* buf, int64_t nelems,
+                                           DType dtype,
+                                           const std::vector<int>& members,
+                                           int64_t seg_bytes) {
+  int m = static_cast<int>(members.size());
+  int me = static_cast<int>(
+      std::find(members.begin(), members.end(), rank_) - members.begin());
+  if (me == m) return Status::Error("rank not in ring group");
+  size_t esize = DTypeSize(dtype);
+  int right = members[(me + 1) % m];
+  int left = members[(me + m - 1) % m];
+  SegGeom g{nelems, m, me,
+            std::max<int64_t>(1, seg_bytes / static_cast<int64_t>(esize))};
+  const int last_step = 2 * m - 3;
+
+  ShmRing* tx = right < static_cast<int>(shm_tx_.size())
+                    ? shm_tx_[right].get()
+                    : nullptr;
+  ShmRing* rx = left < static_cast<int>(shm_rx_.size())
+                    ? shm_rx_[left].get()
+                    : nullptr;
+  Socket* txs = tx ? nullptr : &peers_[right];
+  Socket* rxs = rx ? nullptr : &peers_[left];
+
+  // reduce-scatter receives stage one segment before its single
+  // accumulate (bounded scratch; segment boundaries are element-aligned
+  // so no cross-pop element carry is ever needed)
+  int64_t max_chunk = (nelems + m - 1) / m;
+  size_t seg_cap = static_cast<size_t>(
+                       std::min<int64_t>(g.seg_elems, max_chunk)) * esize;
+  if (ring_scratch_.size() < seg_cap) ring_scratch_.resize(seg_cap);
+
+  // cursors: both sides walk units in the same global order, so the
+  // dependency test is one (step, segment) comparison
+  int st = 0;          // send step
+  int64_t ssg = 0;     // send segment within st
+  int64_t s_off = 0;   // bytes of the current send segment already pushed
+  int rt = 0;          // recv step
+  int64_t rsg = 0;     // segments fully landed (and accumulated) in rt
+  int64_t r_off = 0;   // bytes of the current recv segment already popped
+
+  int64_t segments = 0, payload = 0;   // flushed to the atomics at exit
+  int64_t idle_ns = 0, idle_since = 0;
+  auto last_prog = std::chrono::steady_clock::now();
+  int64_t t0 = NowNs();
+  Backoff bo;
+  Status err;
+
+  while (st <= last_step || rt <= last_step) {
+    bool prog = false;
+    size_t send_avail = 0;  // eligible-but-unpushed bytes (for the waits)
+
+    if (st <= last_step) {
+      int sc = g.send_chunk(st);
+      int64_t nsegs = g.segs(sc);
+      // segments of this step's chunk whose step-(t-1) accumulate landed
+      int64_t ready = st == 0 ? nsegs
+                      : rt > st - 1 ? nsegs
+                      : rt == st - 1 ? std::min(rsg, nsegs)
+                                     : 0;
+      if (ssg < ready) {
+        int64_t lo_b = (g.seg_lo(sc, ssg)) * static_cast<int64_t>(esize) +
+                       s_off;
+        int64_t hi_b = g.seg_hi(sc, ready - 1) * static_cast<int64_t>(esize);
+        send_avail = static_cast<size_t>(hi_b - lo_b);
+        if (send_avail == 0) {
+          // empty chunk (nelems < m): its placeholder segment completes
+          // without moving bytes
+          ssg = ready;
+          if (ssg >= nsegs) {
+            st++;
+            ssg = 0;
+            s_off = 0;
+          }
+          prog = true;
+        } else {
+          size_t k;
+          if (tx) {
+            k = tx->TryPush(buf + lo_b, send_avail);
+          } else {
+            int kk = txs->SendSome(buf + lo_b, send_avail);
+            if (kk < 0) {
+              err = Status::Error("segmented ring send failed");
+              break;
+            }
+            k = static_cast<size_t>(kk);
+          }
+          if (k > 0) {
+            if (s_off == 0) timeline_.RingSegStart("ring/send", "SEG_SEND");
+            s_off += static_cast<int64_t>(k);
+            payload += static_cast<int64_t>(k);
+            send_avail -= k;
+            prog = true;
+            // one push may complete several eligible segments
+            for (;;) {
+              int64_t seg_b = (g.seg_hi(sc, ssg) - g.seg_lo(sc, ssg)) *
+                              static_cast<int64_t>(esize);
+              if (s_off < seg_b) break;
+              s_off -= seg_b;
+              timeline_.RingSegEnd("ring/send");
+              segments++;
+              ssg++;
+              if (ssg >= nsegs) {
+                st++;
+                ssg = 0;
+                s_off = 0;  // provably 0 here (pushes stop at the chunk end)
+                break;
+              }
+              if (s_off > 0)
+                timeline_.RingSegStart("ring/send", "SEG_SEND");
+            }
+          }
+        }
+      }
+    }
+
+    if (rt <= last_step) {
+      int rc = g.recv_chunk(rt);
+      int64_t nsegs = g.segs(rc);
+      int64_t lo = g.seg_lo(rc, rsg), hi = g.seg_hi(rc, rsg);
+      int64_t seg_b = (hi - lo) * static_cast<int64_t>(esize);
+      if (seg_b == 0) {
+        rsg++;
+        if (rsg >= nsegs) {
+          rt++;
+          rsg = 0;
+        }
+        prog = true;
+      } else {
+        bool reduce_phase = rt < m - 1;
+        char* dst = reduce_phase
+                        ? ring_scratch_.data() + r_off
+                        : buf + lo * static_cast<int64_t>(esize) + r_off;
+        size_t want = static_cast<size_t>(seg_b - r_off);
+        size_t k;
+        if (rx) {
+          k = rx->TryPop(dst, want);
+        } else {
+          int kk = rxs->RecvSome(dst, want);
+          if (kk < 0) {
+            err = Status::Error("segmented ring recv failed or closed");
+            break;
+          }
+          k = static_cast<size_t>(kk);
+        }
+        if (k > 0) {
+          if (r_off == 0) timeline_.RingSegStart("ring/recv", "SEG_RECV");
+          r_off += static_cast<int64_t>(k);
+          prog = true;
+          if (r_off == seg_b) {
+            timeline_.RingSegEnd("ring/recv");
+            if (reduce_phase) {
+              // while this runs, the left neighbor keeps filling the
+              // transport with segment s+1 — the overlap this loop buys
+              timeline_.RingSegStart("ring/accum", "SEG_ACCUM");
+              Accumulate(buf + lo * static_cast<int64_t>(esize),
+                         ring_scratch_.data(), hi - lo, dtype);
+              timeline_.RingSegEnd("ring/accum");
+            }
+            r_off = 0;
+            rsg++;
+            if (rsg >= nsegs) {
+              rt++;
+              rsg = 0;
+            }
+          }
+        }
+      }
+    }
+
+    if (prog) {
+      if (idle_since) {
+        idle_ns += NowNs() - idle_since;
+        idle_since = 0;
+      }
+      bo.Progress();
+      last_prog = std::chrono::steady_clock::now();
+      continue;
+    }
+    if (!idle_since) idle_since = NowNs();
+    if (txs && send_avail > 0)
+      // TCP send is the blocker: deterministic paced sleep or
+      // poll(POLLOUT); capped short while a recv side still needs service
+      SendBlockedWait(bo, *txs, send_avail, /*fast_rx=*/rt <= last_step);
+    else if (rxs && rt <= last_step && bo.idle >= 64) {
+      // recv is the blocker and it is TCP: park in poll(POLLIN) instead
+      // of the sleep ladder; stay short while a full shm tx ring still
+      // needs push retries (the peer drains it on its own clock)
+      bo.idle++;
+      struct pollfd p;
+      p.fd = rxs->fd();
+      p.events = POLLIN;
+      p.revents = 0;
+      ::poll(&p, 1, (tx && send_avail > 0) ? 1 : 50);
+    } else {
+      bo.Wait();
+    }
+    if (Stalled(last_prog, Timeouts().duplex)) {
+      err = Status::Error("segmented ring made no progress inside the timeout");
+      break;
+    }
+  }
+
+  if (idle_since) idle_ns += NowNs() - idle_since;
+  ring_runs_seg_.fetch_add(1, std::memory_order_relaxed);
+  ring_segments_.fetch_add(segments, std::memory_order_relaxed);
+  ring_seg_payload_bytes_.fetch_add(payload, std::memory_order_relaxed);
+  ring_wire_ns_.fetch_add(NowNs() - t0, std::memory_order_relaxed);
+  ring_idle_ns_.fetch_add(idle_ns, std::memory_order_relaxed);
+  if (!err.ok()) return Status::Error("ring allreduce failed: " + err.message);
   return Status::OK();
 }
 
@@ -3327,6 +3785,21 @@ void hvd_pipeline_stats(int64_t* out) {
     return;
   }
   g_engine->PipelineStats(out);
+}
+
+// Segmented-ring statistics for this rank, in order: {configured segment
+// bytes, segmented ring runs, monolithic ring runs, segments sent,
+// payload bytes sent through the segmented loop, cumulative segmented-
+// loop wall ns, no-progress (wire idle) ns inside that, reserved}.  All
+// -1 when the engine is down.  Python derives hvd_ring_wire_idle_fraction
+// = idle_ns / wall_ns; segments and bytes are counted (scheduling-
+// independent) and gate CI where wall-clock series cannot.
+void hvd_ring_stats(int64_t* out) {
+  if (!g_engine) {
+    for (int i = 0; i < 8; i++) out[i] = -1;
+    return;
+  }
+  g_engine->RingStats(out);
 }
 
 // Diagnostic: standalone throughput (GB/s of dst bytes) of the in-place

@@ -64,6 +64,24 @@ void Socket::SetPacing(double bytes_per_sec) {
   pace_last_ = std::chrono::steady_clock::now();
 }
 
+double Socket::PaceDelaySeconds(size_t want) const {
+  if (pace_rate_ <= 0 || want == 0) return 0.0;
+  auto now = std::chrono::steady_clock::now();
+  double dt = std::chrono::duration<double>(now - pace_last_).count();
+  // mirror PaceAllowance's burst/quantum arithmetic WITHOUT mutating the
+  // bucket: the answer is "how long until PaceAllowance would say yes"
+  double burst = pace_rate_ * 0.020;
+  if (burst < 64 * 1024) burst = 64 * 1024;
+  double tokens = pace_tokens_ + pace_rate_ * dt;
+  if (tokens > burst) tokens = burst;
+  double quantum = 256.0 * 1024;
+  if (quantum > static_cast<double>(want)) quantum = static_cast<double>(want);
+  if (quantum > burst) quantum = burst;
+  if (quantum < 1.0) quantum = 1.0;
+  if (tokens >= quantum) return 0.0;
+  return (quantum - tokens) / pace_rate_;
+}
+
 size_t Socket::PaceAllowance(size_t want) {
   if (pace_rate_ <= 0) return want;
   auto now = std::chrono::steady_clock::now();
@@ -106,8 +124,13 @@ Status Socket::SendAll(const void* data, size_t n) {
   const char* p = static_cast<const char*>(data);
   while (n > 0) {
     size_t chunk = PaceAllowance(n);
-    if (chunk == 0) {  // paced out: wait for the bucket to refill
-      std::this_thread::sleep_for(std::chrono::milliseconds(1));
+    if (chunk == 0) {
+      // paced out: the refill time is known exactly — sleep it instead
+      // of a fixed 1 ms guess (bounded so a pathological rate can't park
+      // the control plane for seconds)
+      int64_t us = static_cast<int64_t>(PaceDelaySeconds(n) * 1e6);
+      std::this_thread::sleep_for(std::chrono::microseconds(
+          us < 50 ? 50 : us > 100000 ? 100000 : us));
       continue;
     }
     ssize_t k = ::send(fd_, p, chunk, MSG_NOSIGNAL);
@@ -164,7 +187,13 @@ int Socket::RecvSome(void* data, size_t n) {
 }
 
 Status Socket::SendRecv(Socket& send_sock, const void* send_buf, size_t send_n,
-                        Socket& recv_sock, void* recv_buf, size_t recv_n) {
+                        Socket& recv_sock, void* recv_buf, size_t recv_n,
+                        int64_t* idle_ns) {
+  auto now_ns = [] {
+    return std::chrono::duration_cast<std::chrono::nanoseconds>(
+               std::chrono::steady_clock::now().time_since_epoch())
+        .count();
+  };
   const char* sp = static_cast<const char*>(send_buf);
   char* rp = static_cast<char*>(recv_buf);
   size_t sleft = send_n, rleft = recv_n;
@@ -194,18 +223,34 @@ Status Socket::SendRecv(Socket& send_sock, const void* send_buf, size_t send_n,
       fds[nf].events = POLLIN;
       nf++;
     }
-    if (nf == 0) {  // only a paced-out send remains: wait for tokens
-      std::this_thread::sleep_for(std::chrono::milliseconds(1));
+    if (nf == 0) {
+      // only a paced-out send remains: sleep exactly the bucket-refill
+      // time instead of a fixed 1 ms tick
+      int64_t us =
+          static_cast<int64_t>(send_sock.PaceDelaySeconds(sleft) * 1e6);
+      int64_t w0 = idle_ns ? now_ns() : 0;
+      std::this_thread::sleep_for(std::chrono::microseconds(
+          us < 50 ? 50 : us > 100000 ? 100000 : us));
+      if (idle_ns) *idle_ns += now_ns() - w0;
     } else {
-      // short poll when the send side is paced out so it re-checks the
-      // bucket promptly instead of sitting in a long POLLIN wait; cap
-      // by the configured no-progress bound so a short bound is
-      // enforced promptly, not after a 60 s poll
+      // when the send side is paced out, poll only until the KNOWN
+      // bucket-refill time so it re-checks exactly then instead of a
+      // guessed 5 ms; cap by the configured no-progress bound so a
+      // short bound is enforced promptly, not after a 60 s poll
       int base_ms = 60000;
       if (limit_s > 0 && limit_s * 1000 < base_ms)
         base_ms = static_cast<int>(limit_s * 1000) + 1;
-      int timeout_ms = (sleft > 0 && si < 0) ? 5 : base_ms;
+      int timeout_ms = base_ms;
+      if (sleft > 0 && si < 0) {
+        timeout_ms = static_cast<int>(
+                         send_sock.PaceDelaySeconds(sleft) * 1000) + 1;
+        if (timeout_ms > base_ms) timeout_ms = base_ms;
+      }
+      // time inside poll is exactly time with no bytes moving on either
+      // direction — the wire-idle the segmented ring exists to shrink
+      int64_t w0 = idle_ns ? now_ns() : 0;
       int rc = ::poll(fds, nf, timeout_ms);
+      if (idle_ns) *idle_ns += now_ns() - w0;
       if (rc < 0) {
         if (errno == EINTR) continue;
         return Errno("poll");

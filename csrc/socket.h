@@ -38,8 +38,12 @@ class Socket {
   // Simultaneous send+recv via poll(): required by ring steps where every
   // rank sends to one neighbor while receiving from the other — pure
   // blocking send-then-recv deadlocks once payloads exceed kernel buffers.
+  // ``idle_ns``, when non-null, accumulates the time spent parked in
+  // poll()/sleep with neither direction moving — the engine's ring
+  // wire-idle accounting for the monolithic (unsegmented) path.
   static Status SendRecv(Socket& send_sock, const void* send_buf, size_t send_n,
-                         Socket& recv_sock, void* recv_buf, size_t recv_n);
+                         Socket& recv_sock, void* recv_buf, size_t recv_n,
+                         int64_t* idle_ns = nullptr);
 
   // Nonblocking partial transfers for the engine's mixed shm/TCP progress
   // loops: bytes moved, 0 when the kernel would block, -1 on error (for
@@ -69,6 +73,14 @@ class Socket {
   // allreduce), and on real fabrics it doubles as an egress throttle.
   // Single-threaded per socket, like every other Socket method here.
   void SetPacing(double bytes_per_sec);
+
+  // Seconds until the token bucket could cover a send of `want` bytes
+  // (quantum-batched, same arithmetic as PaceAllowance); 0 when unpaced
+  // or tokens are already available.  Pure read — the bucket state is
+  // untouched, so callers may sleep exactly this long instead of running
+  // the generic spin/yield/sleep backoff ladder (the refill time is the
+  // one wait the sender can compute instead of guess).
+  double PaceDelaySeconds(size_t want) const;
 
  private:
   // Refill the bucket and return how many of `want` bytes may be sent

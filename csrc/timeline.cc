@@ -162,6 +162,18 @@ void Timeline::PipelineEnd(int buf) {
   Push(TimelineRecordType::kEnd, TensorLane(lane), "");
 }
 
+void Timeline::RingSegStart(const char* lane, const char* stage) {
+  if (!enabled_) return;
+  std::lock_guard<std::mutex> lk(emit_mu_);
+  Push(TimelineRecordType::kBegin, TensorLane(lane), stage);
+}
+
+void Timeline::RingSegEnd(const char* lane) {
+  if (!enabled_) return;
+  std::lock_guard<std::mutex> lk(emit_mu_);
+  Push(TimelineRecordType::kEnd, TensorLane(lane), "");
+}
+
 void Timeline::WriterLoop() {
   FILE* f = fopen(path_.c_str(), "w");
   if (!f) {

@@ -72,6 +72,14 @@ class Timeline {
   // side by side they make the overlap (or its absence) visible.
   void PipelineStart(int buf, const std::string& stage);
   void PipelineEnd(int buf);
+  // Segmented-ring stage marks on fixed lanes ("ring/send", "ring/recv",
+  // "ring/accum"): per-segment SEG_SEND / SEG_RECV / SEG_ACCUM spans
+  // emitted by whichever thread runs the wire.  Side by side the three
+  // lanes show the windowed overlap — the next segment on the wire while
+  // the previous one accumulates — or, on the monolithic ring, its
+  // absence.
+  void RingSegStart(const char* lane, const char* stage);
+  void RingSegEnd(const char* lane);
 
  private:
   int64_t TensorLane(const std::string& tensor);

@@ -178,6 +178,7 @@ std::string Serialize(const ResponseList& l) {
   PutI64(&s, l.tuned_cycle_us);
   PutI64(&s, l.tuned_hierarchical);
   PutI64(&s, l.tuned_pipeline_depth);
+  PutI64(&s, l.tuned_segment_bytes);
   PutI64(&s, static_cast<int64_t>(l.responses.size()));
   for (const Response& r : l.responses) {
     PutI32(&s, static_cast<int32_t>(r.op));
@@ -199,6 +200,7 @@ Status Parse(const std::string& buf, ResponseList* out) {
   out->tuned_cycle_us = rd.I64();
   out->tuned_hierarchical = rd.I64();
   out->tuned_pipeline_depth = rd.I64();
+  out->tuned_segment_bytes = rd.I64();
   int64_t n = rd.I64();
   if (n < 0 || n > (1 << 24)) return Status::Error("bad response count");
   out->responses.clear();
@@ -249,6 +251,7 @@ std::string Serialize(const CachedExecFrame& f) {
   PutI64(&s, f.tuned_cycle_us);
   PutI64(&s, f.tuned_hierarchical);
   PutI64(&s, f.tuned_pipeline_depth);
+  PutI64(&s, f.tuned_segment_bytes);
   PutI64(&s, static_cast<int64_t>(f.groups.size()));
   for (const auto& g : f.groups) {
     PutI64(&s, static_cast<int64_t>(g.size()));
@@ -265,6 +268,7 @@ Status Parse(const std::string& buf, CachedExecFrame* out) {
   out->tuned_cycle_us = rd.I64();
   out->tuned_hierarchical = rd.I64();
   out->tuned_pipeline_depth = rd.I64();
+  out->tuned_segment_bytes = rd.I64();
   int64_t ng = rd.I64();
   // bound counts by what the buffer could possibly hold BEFORE reserving:
   // a corrupt count must produce the clean parse error, not a multi-hundred

@@ -23,8 +23,9 @@ namespace hvdtpu {
 
 // Bump kWireVersion on ANY layout change (header, field order, new frame).
 constexpr uint32_t kWireMagic = 0x48564457u;  // "HVDW" little-endian
-constexpr uint16_t kWireVersion = 3;          // v3: pipeline depth (bootstrap
-                                              // table + tuned-knob frames)
+constexpr uint16_t kWireVersion = 4;          // v4: ring segment bytes
+                                              // (bootstrap table +
+                                              // tuned-knob frames)
 
 enum class FrameType : uint16_t {
   kInvalid = 0,
@@ -67,6 +68,7 @@ struct ResponseList {
   int64_t tuned_cycle_us = -1;
   int64_t tuned_hierarchical = -1;  // 0/1 when the autotuner owns the knob
   int64_t tuned_pipeline_depth = -1;  // >=1 when the autotuner owns the knob
+  int64_t tuned_segment_bytes = -1;   // >=1 when the autotuner owns the knob
 };
 
 // Steady-state claim: "every cache slot whose bit is set holds an entry
@@ -91,6 +93,7 @@ struct CachedExecFrame {
   int64_t tuned_cycle_us = -1;
   int64_t tuned_hierarchical = -1;
   int64_t tuned_pipeline_depth = -1;
+  int64_t tuned_segment_bytes = -1;
 };
 
 // Frame dispatch: the type a buffer claims to carry (kInvalid when the

@@ -73,6 +73,15 @@ def main(argv=None) -> int:
                          "buffer packing, the wire, and unpacking across N "
                          "buffers; 1 restores the fully serialized data "
                          "plane")
+    ap.add_argument("--ring-segment-bytes", type=int, default=None,
+                    metavar="BYTES",
+                    help="ring allreduce segment size (sets "
+                         "HOROVOD_TPU_RING_SEGMENT_BYTES for every worker; "
+                         "default 262144). The native ring streams each "
+                         "chunk in BYTES-sized segments so the next segment "
+                         "is on the wire while the previous one "
+                         "accumulates; 0 restores the monolithic per-step "
+                         "ring (bisection)")
     ap.add_argument("command", nargs=argparse.REMAINDER)
     args = ap.parse_args(argv)
 
@@ -154,6 +163,9 @@ def main(argv=None) -> int:
             env["HOROVOD_TPU_CACHE_CAPACITY"] = str(args.cache_capacity)
         if args.pipeline_depth is not None:
             env["HOROVOD_TPU_PIPELINE_DEPTH"] = str(args.pipeline_depth)
+        if args.ring_segment_bytes is not None:
+            env["HOROVOD_TPU_RING_SEGMENT_BYTES"] = str(
+                args.ring_segment_bytes)
         # each worker leads its own process group so a stuck worker's whole
         # subtree can be killed
         procs.append(subprocess.Popen(cmd, env=env, start_new_session=True))

@@ -180,6 +180,12 @@ def _bind(lib):
         lib.hvd_pipeline_stats.restype = None
     except AttributeError:
         pass
+    try:
+        # segmented-ring stats (PR 4); same prebuilt-.so caveat
+        lib.hvd_ring_stats.argtypes = [ctypes.POINTER(ctypes.c_int64)]
+        lib.hvd_ring_stats.restype = None
+    except AttributeError:
+        pass
     return lib
 
 
@@ -250,6 +256,30 @@ class NativeEngine(Engine):
         }
         d.update(self._cache_stats())
         d.update(self._pipeline_stats())
+        d.update(self._ring_stats())
+        return d
+
+    def _ring_stats(self) -> dict:
+        """Segmented-ring counters for THIS rank.  ``ring_wire_idle_
+        fraction`` is the share of segmented-loop wall time spent making
+        no progress on either direction — the number the windowed ring
+        exists to shrink (the monolithic ring idles the wire through
+        every whole-chunk tail accumulate).  ``ring_segments`` /
+        ``ring_bytes`` are counted (scheduling-independent) and gate CI.
+        Zeros when the loaded .so predates the segmented ring."""
+        fn = getattr(self._lib, "hvd_ring_stats", None)
+        keys = ("ring_segment_bytes", "ring_collectives_segmented",
+                "ring_collectives_monolithic", "ring_segments",
+                "ring_bytes", "ring_wire_ns", "ring_wire_idle_ns")
+        if fn is None:
+            d = dict.fromkeys(keys, 0)
+            d["ring_wire_idle_fraction"] = 0.0
+            return d
+        vals = (ctypes.c_int64 * 8)()
+        fn(vals)
+        d = {k: max(int(v), 0) for k, v in zip(keys, vals)}
+        d["ring_wire_idle_fraction"] = round(
+            min(d["ring_wire_idle_ns"] / max(d["ring_wire_ns"], 1), 1.0), 4)
         return d
 
     def _pipeline_stats(self) -> dict:
@@ -317,13 +347,16 @@ class NativeEngine(Engine):
         # fresh engine restarting at 0 must not mask its first events
         # behind the previous engine's totals
         last_seen = {"stall_events": 0, "cache_hits": 0, "cache_misses": 0,
-                     "cache_evictions": 0, "negotiation_bytes": 0}
+                     "cache_evictions": 0, "negotiation_bytes": 0,
+                     "ring_segments": 0, "ring_bytes": 0}
         cumulative = (
             ("stall_events", telemetry.NATIVE_STALL_EVENTS),
             ("cache_hits", telemetry.NATIVE_CACHE_HITS),
             ("cache_misses", telemetry.NATIVE_CACHE_MISSES),
             ("cache_evictions", telemetry.NATIVE_CACHE_EVICTIONS),
             ("negotiation_bytes", telemetry.NATIVE_NEGOTIATION_BYTES),
+            ("ring_segments", telemetry.NATIVE_RING_SEGMENTS),
+            ("ring_bytes", telemetry.NATIVE_RING_BYTES),
         )
         # per-stage cumulative (ns, item count) at last collection: each
         # collection observes the mean per-item stage latency of the
@@ -349,6 +382,10 @@ class NativeEngine(Engine):
                 d["pipeline_queue_depth"])
             reg.gauge(telemetry.NATIVE_PIPELINE_DEPTH).set(
                 d["pipeline_depth"])
+            reg.gauge(telemetry.NATIVE_RING_WIRE_IDLE).set(
+                d["ring_wire_idle_fraction"])
+            reg.gauge(telemetry.NATIVE_RING_SEGMENT_BYTES).set(
+                d["ring_segment_bytes"])
             with mirror_lock:
                 for key, metric in cumulative:
                     delta = d[key] - last_seen[key]

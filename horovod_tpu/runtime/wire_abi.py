@@ -13,7 +13,8 @@ from __future__ import annotations
 
 # csrc/wire.h — frame header
 WIRE_MAGIC = 0x48564457  # "HVDW" little-endian
-WIRE_VERSION = 3         # v3: pipeline depth (bootstrap table + tuned frames)
+WIRE_VERSION = 4         # v4: ring segment bytes (bootstrap table +
+                         # tuned frames)
 
 # csrc/wire.h — FrameType
 FRAME_INVALID = 0

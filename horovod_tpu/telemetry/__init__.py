@@ -70,6 +70,14 @@ NATIVE_PIPELINE_OVERLAP = "hvd_pipeline_overlap_fraction"
 NATIVE_PIPELINE_QUEUE_DEPTH = "hvd_pipeline_queue_depth"
 NATIVE_PIPELINE_DEPTH = "hvd_pipeline_depth"
 NATIVE_PIPELINE_STAGE_SECONDS = "hvd_pipeline_stage_seconds"
+# segmented ring (csrc windowed data plane, PR 4): idle fraction is the
+# share of segmented-loop wall time with no progress on either wire
+# direction — the number segmentation exists to shrink vs the monolithic
+# per-step ring; segments/bytes are counted (scheduling-independent)
+NATIVE_RING_WIRE_IDLE = "hvd_ring_wire_idle_fraction"
+NATIVE_RING_SEGMENT_BYTES = "hvd_ring_segment_bytes"
+NATIVE_RING_SEGMENTS = "hvd_ring_segments_total"
+NATIVE_RING_BYTES = "hvd_ring_bytes_total"
 
 _TRUTHY = ("1", "true", "yes", "on")
 
@@ -314,4 +322,6 @@ __all__ = [
     "NATIVE_CACHE_ENTRIES", "NATIVE_NEGOTIATION_BYTES",
     "NATIVE_PIPELINE_OVERLAP", "NATIVE_PIPELINE_QUEUE_DEPTH",
     "NATIVE_PIPELINE_DEPTH", "NATIVE_PIPELINE_STAGE_SECONDS",
+    "NATIVE_RING_WIRE_IDLE", "NATIVE_RING_SEGMENT_BYTES",
+    "NATIVE_RING_SEGMENTS", "NATIVE_RING_BYTES",
 ]

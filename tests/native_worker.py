@@ -631,6 +631,83 @@ def scenario_shm_carry():
     print(f"rank {r}: shm carry OK ({len(blob)} bytes)", flush=True)
 
 
+def scenario_ring_equiv():
+    """Deterministic allreduce battery across dtypes and odd sizes whose
+    per-rank results are dumped to HVD_TEST_OUT_DIR as raw bytes.  The
+    test runs this under several HOROVOD_TPU_RING_SEGMENT_BYTES settings
+    (0 = monolithic, small = many segments per chunk, huge = one segment
+    per chunk) and asserts the dumps are BITWISE identical: segmentation
+    may only change when bytes move, never the reduction arithmetic.
+
+    fp16 joins only when HVD_TEST_RING_FP16=1: the fp16 accumulate
+    kernels are grouping-sensitive on rounding ties, and the MONOLITHIC
+    shm path accumulates at arbitrary pop boundaries (a pre-existing
+    hair's-breadth nondeterminism the segmented loop actually removes by
+    always accumulating whole aligned segments) — so fp16 is asserted on
+    the TCP leg, where the monolithic baseline stages whole chunks and
+    grouping is deterministic on both sides.
+
+    With HVD_TEST_EXPECT_SEGMENTED=1 the worker also asserts the
+    windowed loop engaged (segmented runs counted, no monolithic runs);
+    with =0 it asserts the opposite (the segment-0 bisection contract).
+    """
+    import ml_dtypes
+
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    out_dir = os.environ["HVD_TEST_OUT_DIR"]
+    rng = np.random.default_rng(42)  # same stream on every rank
+    dtypes = [np.float32, ml_dtypes.bfloat16, np.float64, np.int32]
+    if os.environ.get("HVD_TEST_RING_FP16") == "1":
+        dtypes.append(np.float16)
+    # odd sizes straddle chunk boundaries (nelems*c/m), the 65536-byte
+    # test segment, and the 8-wide SIMD groups; several don't divide by
+    # the ring size either
+    sizes = (1, 7, 1001, 32768, 65537, 131072 + 5)
+    chunks = []
+    for dtype in dtypes:
+        for sz in sizes:
+            base = rng.standard_normal(sz) * 3
+            arr = (base * (r + 1)).astype(dtype)
+            chunks.append(np.ascontiguousarray(hvd.allreduce(
+                arr, average=False,
+                name=f"re.{np.dtype(dtype).name}.{sz}")))
+    # fused batch through the pooled fusion buffer and the segmented loop
+    handles = [
+        hvd.allreduce_async(
+            (rng.standard_normal(8192 + 3) * (r + i)).astype(np.float32),
+            average=False, name=f"ref{i}")
+        for i in range(6)
+    ]
+    for h in handles:
+        chunks.append(np.ascontiguousarray(hvd.synchronize(h)))
+    expect = os.environ.get("HVD_TEST_EXPECT_SEGMENTED")
+    if expect is not None:
+        d = _diag()
+        if expect == "1":
+            assert d["ring_collectives_segmented"] > 0, d
+            assert d["ring_segments"] > 0, d
+            assert d["ring_collectives_monolithic"] == 0, d
+        else:
+            assert d["ring_collectives_segmented"] == 0, d
+            assert d["ring_collectives_monolithic"] > 0, d
+    blob = b"".join(c.tobytes() for c in chunks)
+    with open(os.path.join(out_dir, f"ring_equiv_r{r}.bin"), "wb") as f:
+        f.write(blob)
+    hvd.shutdown()
+    print(f"rank {r}: ring equiv OK ({len(blob)} bytes)", flush=True)
+
+
+def scenario_ring_equiv_hier():
+    """scenario_ring_equiv through the two-level path: simulated 2-rank
+    hosts with hierarchical allreduce forced on, so the segmented loop
+    runs inside BOTH the local rings and the cross-host root ring."""
+    r = int(os.environ["HOROVOD_TPU_RANK"])
+    os.environ["HOROVOD_TPU_HOST_HASH"] = f"simhost{r // 2}"
+    os.environ["HOROVOD_TPU_HIERARCHICAL_ALLREDUCE"] = "1"
+    scenario_ring_equiv()
+
+
 def scenario_skewed_shutdown():
     """Rank 0 lags its shutdown by seconds (checkpointing, logging...) while
     the peers shut down and exit immediately.  Regression: the engine's

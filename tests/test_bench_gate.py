@@ -1,0 +1,113 @@
+"""CI gate over the COUNTED bench series (ROADMAP: decide which
+BENCH_*.json series are stable enough to gate on shared hosts).
+
+Wall-clock series on this box need best-of-N and noisy-neighbor caveats —
+they stay out.  Counted series are pure functions of the workload and the
+protocol, so a fresh mini-measurement must land within a tight band of
+the checked-in artifact:
+
+* ``ctrl_bytes_per_round_worker`` (BENCH_r06): steady-state control-plane
+  bytes per negotiation round with the response cache on.  Per-round
+  bytes are step-count independent (one bitvector claim + one cached-exec
+  frame per round), so a 60-step run reproduces the 300-step artifact.
+  The band is 10%: a wire-version bump legitimately moves frames by a few
+  bytes (v4 added one tuned-knob i64), while a cache regression that
+  re-emits name lists moves them ~8x.
+
+* segmented-ring ``ring_segments_per_ring`` / ``ring_kb_per_ring``
+  (BENCH_r08): exact functions of (payload, ring size, segment size) —
+  drift means the windowing silently changed shape, gated at 1% both
+  directions.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from conftest import native_so_status
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import bench_compare  # noqa: E402
+
+_SO_SKIP = native_so_status()
+pytestmark = pytest.mark.skipif(_SO_SKIP is not None,
+                                reason=_SO_SKIP or "native .so ready")
+
+
+def _baseline(name):
+    path = os.path.join(REPO, name)
+    if not os.path.exists(path):
+        pytest.skip(f"{name} not checked in")
+    with open(path) as f:
+        return json.load(f)
+
+
+def _bench_worker_json(np_, worker_args, env_extra, timeout=240):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.update(env_extra)
+    cmd = [sys.executable, "-m", "horovod_tpu.run", "-np", str(np_),
+           sys.executable, os.path.join(REPO, "bench.py")] + worker_args
+    out = subprocess.run(cmd, env=env, cwd=REPO, capture_output=True,
+                         text=True, timeout=timeout)
+    assert out.returncode == 0, out.stderr[-2000:] + out.stdout[-500:]
+    line = [ln for ln in out.stdout.splitlines() if ln.startswith("{")][-1]
+    return json.loads(line)
+
+
+def test_ctrl_bytes_per_round_gate():
+    """Fresh steady-state negotiation rounds at -np 4 vs the BENCH_r06
+    artifact: the response cache's bytes-per-round must not regress.
+
+    The cycle time and burst window are pinned LONG so each round's 32
+    claims batch into one bitvector frame: under the bench's default
+    5 ms cycle, scheduler jitter on a 2-core box occasionally splits a
+    round's claims across two engine cycles, adding header-sized noise
+    to the per-round average.  Pinned batching makes the measurement a
+    floor of the artifact (which absorbed occasional splits), so
+    :lower with a 10% band cannot false-positive on jitter while a real
+    cache regression — per-tensor name lists are ~8x the bytes — still
+    fails loudly."""
+    old = _baseline("BENCH_r06.json")
+    point = _bench_worker_json(
+        4,
+        ["--negotiation-worker", "--neg-steps", "60",
+         "--neg-tensors", "32", "--neg-elems", "16"],
+        {"HOROVOD_TPU_CYCLE_TIME": "50",
+         "HOROVOD_TPU_BURST_WINDOW_US": "20000"})
+    new = {"np4": {"cache_on": point}}
+    rows, code = bench_compare.compare(
+        old, new, ["np4.cache_on.ctrl_bytes_per_round_worker:lower"],
+        max_regression_pct=10.0)
+    assert code == 0, rows
+
+
+def test_ring_counted_series_gate():
+    """Fresh segmented ring at the BENCH_r08 workload (-np 2, shm,
+    256 KB segments) vs the artifact: segments/ring and KB/ring are
+    deterministic — a drift beyond 1% in EITHER direction means the
+    windowing changed shape (finer/coarser segments, missing phase, or a
+    silently disabled loop), not noise."""
+    old = _baseline("BENCH_r08.json")
+    cfg = old.get("config", {})
+    point = _bench_worker_json(
+        2,
+        ["--ring-worker", "--ring-steps", "4",
+         "--ring-mb", str(cfg.get("mb", 64))],
+        {"HOROVOD_TPU_PIPELINE_DEPTH": "1",
+         "HOROVOD_TPU_RING_SEGMENT_BYTES":
+             str(cfg.get("segment_bytes", 262144)),
+         "HOROVOD_TPU_CYCLE_TIME": "1"})
+    assert point.get("mode") == "segmented", point
+    new = {"np2": {"shm": {"segmented": point}}}
+    series_base = ["np2.shm.segmented.ring_segments_per_ring",
+                   "np2.shm.segmented.ring_kb_per_ring"]
+    for direction in (":lower", ":higher"):
+        rows, code = bench_compare.compare(
+            old, new, [s + direction for s in series_base],
+            max_regression_pct=1.0)
+        assert code == 0, (direction, rows)

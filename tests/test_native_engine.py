@@ -549,6 +549,125 @@ def test_shm_carry_path_bitwise_vs_tcp(tmp_path):
             f"rank {r}: shm carry path diverged from TCP staging")
 
 
+# ---------------------------------------------------------------------------
+# segmented ring (windowed reduce-scatter/allgather inside one collective)
+# ---------------------------------------------------------------------------
+
+def _ring_equiv_blobs(tmp_path, scenario, np_, extra_env, configs):
+    """Run the ring-equivalence battery once per (label, segment-bytes,
+    expect-segmented) config; returns label -> per-rank result blobs.
+    Cycle batching is pinned so every config fuses IDENTICAL groups —
+    fusion grouping moves ring chunk boundaries, a real and acceptable
+    run-to-run variation that would mask what these tests are after:
+    that SEGMENTATION never changes the arithmetic."""
+    blobs = {}
+    for label, seg, expect in configs:
+        out = tmp_path / label
+        out.mkdir()
+        env = dict(extra_env)
+        env.update({
+            "HOROVOD_TPU_RING_SEGMENT_BYTES": seg,
+            "HVD_TEST_OUT_DIR": str(out),
+            "HVD_TEST_EXPECT_SEGMENTED": expect,
+            "HOROVOD_TPU_CYCLE_TIME": "100",
+            "HOROVOD_TPU_BURST_WINDOW_US": "50000",
+        })
+        res = _run(scenario, np_, timeout=240, env=env)
+        assert res.returncode == 0, res.stderr + res.stdout
+        for r in range(np_):
+            assert f"rank {r}: ring equiv OK" in res.stdout
+        blobs[label] = _read_rank_files(str(out), "ring_equiv", np_)
+    return blobs
+
+
+def _assert_blobs_equal(blobs, base, np_):
+    for label, ranks in blobs.items():
+        if label == base:
+            continue
+        for r in range(np_):
+            assert ranks[r] == blobs[base][r], (
+                f"rank {r}: config {label!r} results differ from {base!r}")
+
+
+def test_ring_segmented_bitwise_vs_monolithic_shm(tmp_path):
+    """Segment 0 (monolithic ring), 64 KB (many segments per chunk), and
+    1 GB (one segment per chunk — the 'huge degrades to monolithic'
+    contract) must produce bitwise identical results over the shm data
+    plane, across dtypes and sizes that divide by neither the segment
+    nor the ring size."""
+    blobs = _ring_equiv_blobs(
+        tmp_path, "ring_equiv", 2, {},
+        [("mono", "0", "0"), ("seg64k", "65536", "1"),
+         ("huge", str(1 << 30), "1")])
+    _assert_blobs_equal(blobs, "mono", 2)
+
+
+def test_ring_segmented_bitwise_vs_monolithic_tcp_fp16(tmp_path):
+    """Same equivalence over plain TCP (HOROVOD_TPU_SHM=0), with fp16
+    included: the monolithic TCP baseline stages whole chunks, so the
+    grouping-sensitive fp16 kernels are deterministic on both sides and
+    the comparison is exact (see the worker docstring for why the shm
+    leg leaves fp16 out)."""
+    blobs = _ring_equiv_blobs(
+        tmp_path, "ring_equiv", 2,
+        {"HOROVOD_TPU_SHM": "0", "HVD_TEST_RING_FP16": "1"},
+        [("mono", "0", "0"), ("seg64k", "65536", "1")])
+    _assert_blobs_equal(blobs, "mono", 2)
+
+
+def test_ring_segmented_bitwise_hierarchical_paced(tmp_path):
+    """Two-level allreduce on a simulated 2x2-host topology with paced
+    cross-host links: the segmented loop runs inside the local shm rings
+    AND the paced-TCP root ring (deterministic paced waits included),
+    and must still match the monolithic ring bitwise."""
+    blobs = _ring_equiv_blobs(
+        tmp_path, "ring_equiv_hier", 4,
+        {"HOROVOD_TPU_CROSS_HOST_PACE_MBPS": "200"},
+        [("mono", "0", "0"), ("seg64k", "65536", "1")])
+    _assert_blobs_equal(blobs, "mono", 4)
+
+
+def test_autotune_ring_segment_opt_in(tmp_path):
+    """HOROVOD_TPU_AUTOTUNE_RING_SEGMENT=1 adds the segment size to the
+    search ({64..1024} KB, CSV column included); values stay inside the
+    discrete set and results stay correct while sizes flip mid-stream
+    (the tuned-frame adoption path)."""
+    log = tmp_path / "autotune.csv"
+    res = _run("autotune", 2, env={
+        "HOROVOD_AUTOTUNE": "1",
+        "HOROVOD_AUTOTUNE_LOG": str(log),
+        "HOROVOD_TPU_AUTOTUNE_RING_SEGMENT": "1",
+        "HOROVOD_TPU_AUTOTUNE_CYCLES_PER_SAMPLE": "2",
+        "HOROVOD_TPU_AUTOTUNE_SAMPLES_PER_STEP": "2",
+        "HOROVOD_TPU_AUTOTUNE_WARMUP_SAMPLES": "1",
+        "HOROVOD_TPU_CYCLE_TIME": "1",
+    })
+    assert res.returncode == 0, res.stderr + res.stdout
+    lines = log.read_text().strip().splitlines()
+    assert lines[0] == ("fusion_threshold_bytes,cycle_time_us,"
+                        "hierarchical_allreduce,ring_segment_bytes,"
+                        "score_bytes_per_us")
+    rows = [l.split(",") for l in lines[1:]]
+    assert len(rows) >= 3, lines
+    cells = {int(r[3]) for r in rows}
+    assert cells <= {65536, 131072, 262144, 524288, 1048576}, cells
+
+
+def test_ring_stats_api_shape():
+    """The ring-stats C API returns 8 well-formed counters (engine down:
+    all -1) and native.py derives a [0,1] idle fraction."""
+    import ctypes
+
+    from horovod_tpu.runtime.native import lib_path
+
+    lib = ctypes.CDLL(lib_path())
+    lib.hvd_ring_stats.argtypes = [ctypes.POINTER(ctypes.c_int64)]
+    lib.hvd_ring_stats.restype = None
+    vals = (ctypes.c_int64 * 8)()
+    lib.hvd_ring_stats(vals)
+    assert all(int(v) == -1 for v in vals), list(vals)
+
+
 @pytest.mark.slow  # tsan build + instrumented run: minutes, not seconds
 @pytest.mark.skipif(_libtsan() is None, reason="libtsan not available")
 def test_pipeline_race_free_under_tsan():

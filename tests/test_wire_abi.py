@@ -34,7 +34,7 @@ def test_checker_detects_version_drift():
     """The guard must actually bite: a simulated version bump in wire.h
     without a Python update is reported."""
     wire_h, common_h = _headers()
-    tampered = wire_h.replace("kWireVersion = 2", "kWireVersion = 3")
+    tampered = wire_h.replace("kWireVersion = 4", "kWireVersion = 5")
     assert tampered != wire_h, "kWireVersion moved; update this test"
     problems = check_wire_abi.check(tampered, common_h)
     assert any("kWireVersion" in p for p in problems), problems
