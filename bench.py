@@ -1590,6 +1590,152 @@ def bench_ring(args):
     return results
 
 
+def fault_worker(args):
+    """Subprocess under the launcher: a steady fused-allreduce stream that
+    would run ~forever, for the fault bench's injected kills.  A survivor's
+    synchronize raises with the engine's abort message -> exit 7; the
+    injected rank never returns from its SIGKILL."""
+    import numpy as np
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    r = hvd.rank()
+    data = [np.full(args.fault_elems, float(r + i), np.float32)
+            for i in range(4)]
+    try:
+        for _ in range(100000):
+            hs = [hvd.allreduce_async(data[i], average=False, name=f"fb{i}")
+                  for i in range(4)]
+            for h in hs:
+                hvd.synchronize(h)
+    except RuntimeError as e:
+        print(f"rank {r}: FAULT: {e}", flush=True)
+        sys.exit(7)
+    print(f"rank {r}: fault bench ran dry", flush=True)
+
+
+def _run_fault_point(n, inject, elems, peer_timeout, extra_env=None):
+    """One chaos launch, stderr/stdout streamed so the injection marker
+    can be timestamped on ARRIVAL: ``detect_to_all_exited_s`` is the wall
+    from the victim's last words (written immediately before its SIGKILL /
+    hang) to the supervising launcher's exit — the operator-visible
+    "worker died -> job fully torn down" latency the fault domain bounds."""
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "HOROVOD_TPU_FAULT_INJECT": inject,
+        "HOROVOD_TPU_PEER_TIMEOUT_S": str(peer_timeout),
+    })
+    env.update(extra_env or {})
+    cmd = [sys.executable, "-m", "horovod_tpu.run", "-np", str(n),
+           "--grace-period", "1",
+           sys.executable, os.path.abspath(__file__),
+           "--fault-worker", "--fault-elems", str(elems)]
+    t0 = time.perf_counter()
+    proc = subprocess.Popen(cmd, env=env, cwd=REPO, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True)
+    t_fault = None
+    faulted_lines = 0
+    for line in proc.stdout:
+        now = time.perf_counter() - t0
+        if t_fault is None and "fault injection:" in line:
+            t_fault = now
+        if ": FAULT:" in line:
+            faulted_lines += 1
+    rc = proc.wait(timeout=300)
+    t_exit = time.perf_counter() - t0
+    return {
+        "inject": inject,
+        "exit_code": rc,
+        "survivors_faulted": faulted_lines,
+        "wall_s": round(t_exit, 2),
+        "detect_to_all_exited_s": (round(t_exit - t_fault, 2)
+                                   if t_fault is not None else None),
+    }
+
+
+def bench_fault(args):
+    """Fault-domain bench (BENCH_r09): detection->all-ranks-exited latency
+    for injected deaths at every engine phase (negotiation, pack, ring,
+    unpack; coordinator and non-coordinator) at -np 2 and 4, plus a hung
+    (alive-but-silent) rank caught by the heartbeat timeout, plus the
+    steady-state heartbeat overhead on the negotiation control plane.
+
+    The kill latencies measure the socket-reset detection path (near-
+    instant) + abort fan-out + launcher supervision; the hang latency is
+    dominated by the configured HOROVOD_TPU_PEER_TIMEOUT_S by design —
+    both must stay well under the classic outcome (a job that hangs until
+    a human kills it).  The overhead series reuses BENCH_r06's exact
+    steady-state workload: heartbeats piggyback on real traffic, so
+    bytes/round must match the r06 artifact inside the 1% CI gate
+    (tests/test_bench_gate.py::test_heartbeat_overhead_gate)."""
+    peer_timeout = args.fault_peer_timeout
+    results = {"config": {
+        "peer_timeout_s": peer_timeout, "fault_elems": args.fault_elems,
+        "grace_s": 1.0, "nproc": os.cpu_count(),
+        "note": "detect_to_all_exited_s spans the victim's last words to "
+                "launcher exit (includes survivors' abort drain, grace "
+                "escalation, and post-mortem). kill points detect via "
+                "socket reset; the hang point can only detect via the "
+                "heartbeat age, so its latency ~= peer_timeout_s",
+    }}
+    for n in (2, 4):
+        if n > args.fault_max_np:
+            continue
+        victim = n - 1
+        point = {}
+        for label, inject, elems in (
+                ("kill_negotiation", f"kill:rank={victim}:cycle=10", 4096),
+                ("kill_pack", f"kill:rank={victim}:phase=pack:hit=5", 65536),
+                ("kill_ring", f"kill:rank={victim}:phase=ring:hit=5",
+                 args.fault_elems),
+                ("kill_unpack", f"kill:rank={victim}:phase=unpack:hit=5",
+                 65536),
+                ("kill_coordinator", "kill:rank=0:phase=ring:hit=5",
+                 args.fault_elems),
+                ("hang_heartbeat", f"hang:rank={victim}:cycle=10", 4096),
+        ):
+            point[label] = _run_fault_point(n, inject, elems, peer_timeout)
+        lat = [p["detect_to_all_exited_s"] for p in point.values()
+               if p["detect_to_all_exited_s"] is not None]
+        if lat:
+            point["detect_to_all_exited_max_s"] = max(lat)
+        results[f"np{n}"] = point
+    # steady-state heartbeat overhead: BENCH_r06's negotiation workload
+    # with the fault domain at defaults — counted bytes/round, compared
+    # against the r06 artifact.  Batching is pinned (long cycle + burst
+    # window) exactly as in tests/test_bench_gate.py: the default 5 ms
+    # cycle lets scheduler jitter split a round's claims across engine
+    # cycles, adding header-sized noise that would drown the few-byte
+    # signal this series exists to bound (heartbeat frames sneaking into
+    # the steady state)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["HOROVOD_TPU_CYCLE_TIME"] = "50"
+    env["HOROVOD_TPU_BURST_WINDOW_US"] = "20000"
+    env.pop("HOROVOD_TPU_CACHE_CAPACITY", None)
+    cmd = [sys.executable, "-m", "horovod_tpu.run", "-np", "4",
+           sys.executable, os.path.abspath(__file__),
+           "--negotiation-worker", "--neg-steps", "120",
+           "--neg-tensors", "32", "--neg-elems", "16"]
+    hb = _run_json_subprocess(cmd, env, timeout=600)
+    overhead = {"ctrl_bytes_per_round_worker":
+                hb.get("ctrl_bytes_per_round_worker"),
+                "rounds_per_sec": hb.get("rounds_per_sec")}
+    r06_path = os.path.join(REPO, "BENCH_r06.json")
+    if os.path.exists(r06_path):
+        with open(r06_path) as f:
+            base = json.load(f)["np4"]["cache_on"][
+                "ctrl_bytes_per_round_worker"]
+        overhead["baseline_r06"] = base
+        if overhead["ctrl_bytes_per_round_worker"]:
+            overhead["vs_r06"] = round(
+                overhead["ctrl_bytes_per_round_worker"] / base, 4)
+    results["heartbeat_overhead"] = overhead
+    return results
+
+
 def bench_scaling(args):
     """Weak-scaling efficiency of the eager DP path: per-step time at
     np=1 vs np=N on THIS host (loopback TCP).  Only valid where each rank
@@ -2339,6 +2485,18 @@ def build_parser() -> argparse.ArgumentParser:
                     help="repeats per grid point; best run is reported "
                          "(shared-host noise stretches whole runs)")
     ap.add_argument("--ring-max-np", type=int, default=4)
+    ap.add_argument("--fault", action="store_true",
+                    help="run ONLY the fault-domain chaos bench "
+                         "(detection->all-exited latency per injection "
+                         "point + steady-state heartbeat overhead); "
+                         "writes BENCH_r09.json")
+    ap.add_argument("--fault-worker", action="store_true",
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--fault-elems", type=int, default=2000000,
+                    help="fp32 elements per tensor in the fault worker "
+                         "(big enough that ring-phase kills land mid-wire)")
+    ap.add_argument("--fault-peer-timeout", type=float, default=5.0)
+    ap.add_argument("--fault-max-np", type=int, default=4)
     ap.add_argument("--pipeline-worker", action="store_true",
                     help=argparse.SUPPRESS)
     ap.add_argument("--skip-pipeline", action="store_true")
@@ -2392,6 +2550,26 @@ def main() -> None:
         return
     if args.ring_worker:
         ring_worker(args)
+        return
+    if args.fault_worker:
+        fault_worker(args)
+        return
+    if args.fault:
+        # fault-domain only: chaos launches + one negotiation run — a few
+        # minutes, own artifact
+        out = bench_fault(args)
+        with open(os.path.join(REPO, "BENCH_r09.json"), "w") as f:
+            json.dump(out, f, indent=1)
+        compact = {}
+        for k, v in out.items():
+            if k.startswith("np"):
+                compact[k] = {
+                    "max_exit_s": v.get("detect_to_all_exited_max_s"),
+                    "hang_s": v.get("hang_heartbeat", {}).get(
+                        "detect_to_all_exited_s")}
+        compact["hb_vs_r06"] = out.get("heartbeat_overhead", {}).get(
+            "vs_r06")
+        print(json.dumps({"fault": compact, "full": "BENCH_r09.json"}))
         return
     if args.ring:
         # segmented-ring only: no jax models, no roofline — minutes, own

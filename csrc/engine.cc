@@ -43,6 +43,7 @@
 #include "autotune.h"
 #include "cache.h"
 #include "common.h"
+#include "fault.h"
 #include "logging.h"
 #include "shm.h"
 #include "socket.h"
@@ -53,6 +54,33 @@ namespace hvdtpu {
 namespace {
 
 void LogWarn(const std::string& msg) { LOG(Warning) << msg; }
+
+int64_t NowNs() {
+  return std::chrono::duration_cast<std::chrono::nanoseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+// Status for a transfer cancelled by the job-wide abort latch: once an
+// ABORT is initiated or received, every parked data-plane wait returns
+// this within one backoff step instead of waiting out its own timeout.
+Status AbortedStatus() {
+  return Status::Error(
+      "job abort in progress — transfer cancelled before completion");
+}
+
+// A data-plane no-progress bound expired: count it and name the peer(s),
+// so the surfaced handle error says WHO is presumed dead, not just that
+// something timed out.
+Status PeerDeadStatus(const std::string& what, const std::string& peers,
+                      double limit) {
+  Faults().peer_timeouts.fetch_add(1, std::memory_order_relaxed);
+  return Status::Error(
+      what + " made no progress with " + peers + " for " +
+      std::to_string(static_cast<int>(limit)) +
+      "s — peer presumed dead or wedged (tune HOROVOD_TPU_PEER_TIMEOUT_S; "
+      "0 disables the bound)");
+}
 
 int64_t NumElems(const std::vector<int64_t>& dims) {
   int64_t n = 1;
@@ -470,6 +498,12 @@ class Engine {
     out[7] = 0;
   }
 
+  // Oldest control-plane silence this rank observes, in ms: rank 0 reports
+  // the max over live workers, workers their coordinator's.  The heartbeat
+  // age the fault metrics export — under steady traffic it sits near 0,
+  // and a value approaching the peer timeout IS the detection in progress.
+  int64_t MaxPeerAgeMs() const;
+
  private:
   void BackgroundLoop();
   void WaitForWork(std::chrono::microseconds max_wait);
@@ -479,6 +513,25 @@ class Engine {
   void HandleArrivedRequests(const RequestList& list, ResponseList* out);
   void FuseReady(ResponseList* out);
   void StallCheck();
+  // -- fault domain (PR 5) -------------------------------------------------
+  // record a control frame from `rank` (heartbeat piggybacking: every
+  // frame refreshes liveness, explicit heartbeats only fill idle gaps)
+  void NoteSeen(int rank) {
+    hb_seen_[rank].store(NowNs(), std::memory_order_relaxed);
+  }
+  // coordinated abort: rank 0 broadcasts an ABORT frame first, then every
+  // rank fails outstanding handles with the cause, latches the abort so
+  // wedged transfers cancel, and stops the engine.  Returns true (stop).
+  bool AbortJob(const Status& st, int dead_rank);
+  // a local shutdown is already on the wire: a peer socket closing now is
+  // the job ENDING, not a death — suppress the abort path for that race
+  bool ShutdownInFlight() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return shutdown_sent_;
+  }
+  // per-tick liveness duties; true = aborted, stop the loop
+  bool CoordinatorFaultTick(bool shutdown_in_flight);
+  bool WorkerFaultTick(bool shutdown_in_flight);
   // -- response cache (negotiation control plane) -------------------------
   // byte-counted control-plane send/recv (coordinator star only)
   Status SendCtrl(Socket& sock, const std::string& frame);
@@ -558,6 +611,9 @@ class Engine {
   Status RingAllgatherGroup(const std::vector<int>& members,
                             const std::vector<size_t>& member_bytes,
                             char* concat);
+  Status RingAllgatherGroupSegmented(const std::vector<int>& members,
+                                     const std::vector<size_t>& member_bytes,
+                                     char* concat, int64_t seg_bytes);
   Status HierarchicalAllgather(const Response& resp, TensorEntry& entry,
                                int64_t stride, std::vector<char>* out);
   Status TreeBroadcast(char* buf, int64_t nbytes, int root) {
@@ -585,6 +641,27 @@ class Engine {
   double stall_warn_s_ = 60.0;
   bool stall_check_ = true;
   double start_timeout_s_ = 120.0;
+
+  // -- fault domain (PR 5) -------------------------------------------------
+  // Control-plane liveness: any received frame refreshes hb_seen_ for its
+  // sender (rank 0 indexes by worker rank, workers use slot 0 for the
+  // coordinator); explicit HEARTBEAT frames flow only on links idle past
+  // hb_interval_s_, so steady-state negotiation traffic carries detection
+  // for free.  An age beyond peer_timeout_s_ is a presumed death and
+  // triggers the coordinated abort.
+  double peer_timeout_s_ = 60.0;
+  double hb_interval_s_ = 5.0;
+  double stall_abort_s_ = 0.0;           // 0 = stalls stay warn-only
+  std::unique_ptr<std::atomic<int64_t>[]> hb_seen_;  // steady ns per peer
+  // rank 0: 1 while worker i's control socket is open.  The bg thread owns
+  // workers_ and checks valid() directly; this atomic shadow exists ONLY
+  // for MaxPeerAgeMs, which runs on the Python diagnostics thread and must
+  // not race a concurrent Close() on the non-atomic fd.
+  std::unique_ptr<std::atomic<uint8_t>[]> worker_live_;
+  int64_t hb_last_tx_ns_ = 0;            // bg thread only (idle-send pacing)
+  std::string stall_abort_msg_;          // watchdog escalation, bg thread
+  bool aborted_ = false;                 // guarded by mu_
+  Status abort_status_;                  // guarded by mu_ (sticky cause)
 
   // two-level topology, grouped by host hash at bootstrap
   std::vector<int> all_ranks_;          // 0..size-1
@@ -1082,6 +1159,32 @@ Status Engine::Init(const std::string& host, int port, int rank, int size) {
   LOG_RANK(Debug, rank_) << "response cache: capacity " << cache_.capacity()
                          << (cache_.enabled() ? "" : " (disabled)");
 
+  // fault domain: liveness config, chaos-test injection, and a fresh abort
+  // latch (a previous engine in this process may have aborted)
+  SetAborting(false);
+  FaultInjector::Get().Configure(rank_);
+  peer_timeout_s_ = PeerTimeoutSeconds();
+  hb_interval_s_ = HeartbeatIntervalSeconds();
+  stall_abort_s_ = StallAbortSeconds();
+  hb_seen_.reset(new std::atomic<int64_t>[static_cast<size_t>(
+      size_ > 0 ? size_ : 1)]);
+  worker_live_.reset(new std::atomic<uint8_t>[static_cast<size_t>(
+      size_ > 0 ? size_ : 1)]);
+  int64_t boot_ns = NowNs();
+  for (int i = 0; i < (size_ > 0 ? size_ : 1); i++) {
+    hb_seen_[i] = boot_ns;
+    worker_live_[i] = static_cast<uint8_t>(
+        rank_ == 0 && i > 0 && i < static_cast<int>(workers_.size()) &&
+        workers_[i].valid());
+  }
+  hb_last_tx_ns_ = boot_ns;
+  LOG_RANK(Debug, rank_) << "fault domain: peer timeout "
+                         << peer_timeout_s_ << "s, heartbeat interval "
+                         << hb_interval_s_ << "s, stall abort "
+                         << (stall_abort_s_ > 0
+                                 ? std::to_string(stall_abort_s_) + "s"
+                                 : std::string("off"));
+
   if (pipe2(wake_pipe_, O_NONBLOCK | O_CLOEXEC) != 0) {
     wake_pipe_[0] = wake_pipe_[1] = -1;  // degrade to pure cycle ticks
   }
@@ -1207,8 +1310,10 @@ int Engine::Enqueue(OpType op, const std::string& name, DType dtype,
   int handle = next_handle_++;
   handles_[handle] = HandleState{};
   if (!running_) {
+    // an aborted job surfaces its cause on every later submit too — the
+    // caller learns WHICH rank died, not just that the engine is down
     handles_[handle].done = true;
-    handles_[handle].status = Status::Shutdown();
+    handles_[handle].status = aborted_ ? abort_status_ : Status::Shutdown();
     PoolPutLocked(std::move(staged));
     return handle;
   }
@@ -1289,6 +1394,11 @@ void Engine::MarkDone(int handle, Status st, std::vector<int64_t> dims,
   auto it = handles_.find(handle);
   if (it == handles_.end()) return;  // caller released without waiting
   it->second.done = true;
+  // once the job is aborting, every failing handle reports the abort's
+  // CAUSE (which names the dead rank) — not the secondary transfer-
+  // cancelled/connection errors the abort itself provokes
+  if (!st.ok() && aborted_ && st.code != Status::kShutdown)
+    st = abort_status_;
   it->second.status = std::move(st);
   it->second.out_dims = std::move(dims);
   // an errored op has no meaningful output: recycle the buffer now so a
@@ -1347,6 +1457,9 @@ void Engine::BackgroundLoop() {
   while (!stop) {
     auto cycle_start = std::chrono::steady_clock::now();
     timeline_.MarkCycleStart();
+    // chaos hook: "kill:rank=R:cycle=N" fires here — the coordinator sees
+    // a mid-negotiation death exactly as a production SIGKILL would land
+    FaultInjector::Get().OnPhase(FaultPhase::kNegotiation);
 
     if (pipelined_) {
       // unpack/complete whatever the executor finished since last tick
@@ -1732,18 +1845,20 @@ void Engine::WorkerTick(RequestList& local, bool* stop) {
     for (int s : claims) cb.bits[s >> 3] |= static_cast<uint8_t>(1u << (s & 7));
     Status s = SendCtrl(coord_, Serialize(cb));
     if (!s.ok()) {
-      FailAll(Status::Error("lost coordinator: " + s.message));
-      *stop = true;
+      *stop = AbortJob(
+          Status::Error("lost coordinator (rank 0): " + s.message), 0);
       return;
     }
+    hb_last_tx_ns_ = NowNs();
   }
   if (!full.requests.empty() || full.shutdown) {
     Status s = SendCtrl(coord_, Serialize(full));
     if (!s.ok()) {
-      FailAll(Status::Error("lost coordinator: " + s.message));
-      *stop = true;
+      *stop = AbortJob(
+          Status::Error("lost coordinator (rank 0): " + s.message), 0);
       return;
     }
+    hb_last_tx_ns_ = NowNs();
   }
   // frames execute strictly in arrival order — cached-exec groups decode
   // against the cache state BEFORE any later frame's mutations apply,
@@ -1753,11 +1868,26 @@ void Engine::WorkerTick(RequestList& local, bool* stop) {
     std::string frame;
     Status s = RecvCtrl(coord_, &frame);
     if (!s.ok()) {
-      FailAll(Status::Error("lost coordinator: " + s.message));
-      *stop = true;
+      *stop = AbortJob(
+          Status::Error("lost coordinator (rank 0): " + s.message), 0);
       return;
     }
+    NoteSeen(0);  // any coordinator frame is a liveness proof
     FrameType ft = FrameTypeOf(frame);
+    if (ft == FrameType::kHeartbeat) {
+      Faults().heartbeats_rx.fetch_add(1, std::memory_order_relaxed);
+      continue;
+    }
+    if (ft == FrameType::kAbort) {
+      AbortFrame af;
+      s = Parse(frame, &af);
+      *stop = AbortJob(
+          Status::Error(s.ok() ? af.message
+                               : "job aborted by coordinator (unparseable "
+                                 "abort frame: " + s.message + ")"),
+          s.ok() ? af.dead_rank : -1);
+      return;
+    }
     if (ft == FrameType::kCachedExec) {
       CachedExecFrame ce;
       s = Parse(frame, &ce);
@@ -1804,7 +1934,9 @@ void Engine::WorkerTick(RequestList& local, bool* stop) {
   if (got_shutdown) {
     FailAll(Status::Shutdown());
     *stop = true;
+    return;
   }
+  if (WorkerFaultTick(local.shutdown)) *stop = true;
 }
 
 bool Engine::CoordinatorTick(RequestList& local) {
@@ -1830,12 +1962,25 @@ bool Engine::CoordinatorTick(RequestList& local) {
       std::string frame;
       Status s = RecvCtrl(workers_[i], &frame);
       if (!s.ok()) {
-        LogWarn("worker " + std::to_string(i) + " lost: " + s.message);
+        // with a shutdown already in flight this is just a finished worker
+        // closing its socket; otherwise it is a death, and the job must
+        // ABORT (every survivor errors and exits) rather than pretend the
+        // dead rank asked for a clean shutdown
+        worker_live_[i].store(0, std::memory_order_relaxed);
         workers_[i].Close();
-        shutdown = true;
-        break;
+        if (shutdown) break;
+        return AbortJob(
+            Status::Error("rank " + std::to_string(i) +
+                          " connection lost (" + s.message +
+                          ") — worker presumed dead; aborting job"),
+            i);
       }
+      NoteSeen(i);  // any worker frame is a liveness proof
       FrameType ft = FrameTypeOf(frame);
+      if (ft == FrameType::kHeartbeat) {
+        Faults().heartbeats_rx.fetch_add(1, std::memory_order_relaxed);
+        continue;
+      }
       if (ft == FrameType::kRequestList) {
         RequestList rl;
         s = Parse(frame, &rl);
@@ -1878,6 +2023,10 @@ bool Engine::CoordinatorTick(RequestList& local) {
   // ...while misses take the full fuse path; stalls are watched on both
   FuseReady(&out);
   if (stall_check_) StallCheck();
+  // fault domain BEFORE the send phase: an abort must precede any response
+  // broadcast this tick, or workers could start collectives the aborting
+  // coordinator will never join
+  if (CoordinatorFaultTick(shutdown)) return true;
   out.shutdown = shutdown;
   bool have_ce = !ce.groups.empty();
   bool have_tuned = pending_tuned_fusion_ >= 0 || pending_tuned_cycle_ >= 0 ||
@@ -1931,6 +2080,7 @@ bool Engine::CoordinatorTick(RequestList& local) {
       }
     }
   }
+  if (have_ce || have_rl) hb_last_tx_ns_ = NowNs();
   if (sent && have_tuned) {
     pending_tuned_fusion_ = -1;
     pending_tuned_cycle_ = -1;
@@ -2086,9 +2236,9 @@ void Engine::FuseReady(ResponseList* out) {
 
 void Engine::StallCheck() {
   auto now = std::chrono::steady_clock::now();
-  auto warn = [&](const std::string& what, const std::set<int32_t>& ranks) {
+  auto missing = [&](const std::set<int32_t>& ranks) {
     std::ostringstream os;
-    os << what << " for ranks [";
+    os << "[";
     bool first = true;
     for (int r = 0; r < size_; r++) {
       if (!ranks.count(r)) {
@@ -2096,48 +2246,214 @@ void Engine::StallCheck() {
         first = false;
       }
     }
-    os << "] — possible stall (one rank may have skipped this op)";
-    LogWarn(os.str());
+    os << "]";
+    return os.str();
+  };
+  auto warn = [&](const std::string& what, const std::set<int32_t>& ranks) {
+    LogWarn(what + " for ranks " + missing(ranks) +
+            " — possible stall (one rank may have skipped this op)");
     stall_events_.fetch_add(1, std::memory_order_relaxed);
   };
+  // escalation tier (HOROVOD_TPU_STALL_ABORT_S, default off): a stall
+  // older than the abort bound stops being a warning and becomes a
+  // coordinated abort — the message the fault tick broadcasts
+  auto escalate = [&](const std::string& what, double age,
+                      const std::set<int32_t>& ranks) {
+    if (stall_abort_s_ <= 0 || age <= stall_abort_s_ ||
+        !stall_abort_msg_.empty())
+      return;
+    stall_abort_msg_ =
+        what + " stalled for " + std::to_string(static_cast<int>(age)) +
+        "s waiting for ranks " + missing(ranks) +
+        " (HOROVOD_TPU_STALL_ABORT_S=" +
+        std::to_string(static_cast<int>(stall_abort_s_)) +
+        ") — aborting job";
+  };
   for (auto& [name, neg] : message_table_) {
-    if (neg.stall_warned || neg.received.empty()) continue;
+    if (neg.received.empty()) continue;
     double age =
         std::chrono::duration<double>(now - neg.first_arrival).count();
-    if (age > stall_warn_s_) {
+    if (!neg.stall_warned && age > stall_warn_s_) {
       warn("op '" + name + "' has waited " +
                std::to_string(static_cast<int>(age)) + "s",
            neg.ranks);
       neg.stall_warned = true;
     }
+    escalate("op '" + name + "'", age, neg.ranks);
   }
   // partially-claimed cache slots stall the same way a partially-arrived
   // full negotiation does — same watchdog, same counter
   for (auto& [slot, claim] : cache_claims_) {
-    if (claim.stall_warned || claim.ranks.empty()) continue;
+    if (claim.ranks.empty()) continue;
     double age =
         std::chrono::duration<double>(now - claim.first_claim).count();
-    if (age > stall_warn_s_) {
-      const CacheEntry* e = cache_.At(slot);
-      warn("cached op '" + (e ? e->name : std::to_string(slot)) +
-               "' has waited " + std::to_string(static_cast<int>(age)) + "s",
+    const CacheEntry* e = cache_.At(slot);
+    std::string nm = "cached op '" +
+                     (e ? e->name : std::to_string(slot)) + "'";
+    if (!claim.stall_warned && age > stall_warn_s_) {
+      warn(nm + " has waited " + std::to_string(static_cast<int>(age)) +
+               "s",
            claim.ranks);
       claim.stall_warned = true;
     }
+    escalate(nm, age, claim.ranks);
   }
+}
+
+// ---------------------------------------------------------------------------
+// fault domain: detection + coordinated abort
+// ---------------------------------------------------------------------------
+
+int64_t Engine::MaxPeerAgeMs() const {
+  if (size_ <= 1 || !hb_seen_) return 0;
+  int64_t now = NowNs();
+  int64_t mx = 0;
+  if (rank_ == 0) {
+    for (int i = 1; i < size_; i++) {
+      // atomic shadow of workers_[i].valid(): this runs on the Python
+      // diagnostics thread and must not race the bg thread's Close()
+      if (!worker_live_[i].load(std::memory_order_relaxed)) continue;
+      int64_t age = now - hb_seen_[i].load(std::memory_order_relaxed);
+      if (age > mx) mx = age;
+    }
+  } else {
+    mx = now - hb_seen_[0].load(std::memory_order_relaxed);
+  }
+  return mx / 1000000;
+}
+
+bool Engine::AbortJob(const Status& st, int dead_rank) {
+  if (ShutdownInFlight()) {
+    // the peer vanished because the job is tearing down around us (e.g.
+    // the coordinator broadcast shutdown and exited before our last
+    // frame): complete outstanding handles as a shutdown, not a fault
+    FailAll(Status::Shutdown());
+    return true;
+  }
+  int64_t t0 = NowNs();
+  Faults().aborts.fetch_add(1, std::memory_order_relaxed);
+  if (dead_rank >= 0) timeline_.FaultMark("PEER_DEAD");
+  timeline_.FaultMark("ABORT");
+  // latch FIRST: wedged data-plane transfers (ours and the executor's)
+  // poll this from every no-progress wait and cancel within one backoff
+  // step, which is what lets FailAll's pipeline drain below finish inside
+  // the detection bound instead of waiting out a second peer timeout
+  SetAborting(true);
+  LogWarn("ABORT: " + st.message);
+  if (rank_ == 0) {
+    AbortFrame af;
+    af.origin_rank = rank_;
+    af.dead_rank = dead_rank;
+    af.message = st.message;
+    std::string frame = Serialize(af);
+    for (int i = 1; i < size_; i++) {
+      if (!workers_[i].valid() || i == dead_rank) continue;
+      // best effort: a worker whose socket already broke is either dead
+      // (nothing to tell) or will hit its own coordinator-loss detection
+      (void)SendCtrl(workers_[i], frame);
+    }
+  }
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    aborted_ = true;
+    abort_status_ = st;
+  }
+  FailAll(st);
+  Faults().abort_latency_ns.fetch_add(NowNs() - t0,
+                                      std::memory_order_relaxed);
+  return true;
+}
+
+bool Engine::CoordinatorFaultTick(bool shutdown_in_flight) {
+  if (shutdown_in_flight) return false;
+  // watchdog escalation raised by StallCheck / PipelineStallCheck
+  if (!stall_abort_msg_.empty()) {
+    std::string m;
+    m.swap(stall_abort_msg_);
+    return AbortJob(Status::Error(m), -1);
+  }
+  int64_t now = NowNs();
+  if (peer_timeout_s_ > 0) {
+    for (int i = 1; i < size_; i++) {
+      if (!workers_[i].valid()) continue;
+      double age =
+          (now - hb_seen_[i].load(std::memory_order_relaxed)) / 1e9;
+      if (age > peer_timeout_s_) {
+        Faults().peer_timeouts.fetch_add(1, std::memory_order_relaxed);
+        return AbortJob(
+            Status::Error(
+                "rank " + std::to_string(i) + " sent no control frames "
+                "for " + std::to_string(static_cast<int>(age)) +
+                "s (HOROVOD_TPU_PEER_TIMEOUT_S=" +
+                std::to_string(static_cast<int>(peer_timeout_s_)) +
+                ") — worker presumed dead; aborting job"),
+            i);
+      }
+    }
+  }
+  // idle links get an explicit heartbeat so workers' coordinator-age and
+  // this rank's worker-ages stay fresh without any steady-state traffic
+  if (hb_interval_s_ > 0 && (now - hb_last_tx_ns_) / 1e9 > hb_interval_s_) {
+    HeartbeatFrame f;
+    f.rank = 0;
+    std::string frame = Serialize(f);
+    for (int i = 1; i < size_; i++) {
+      if (!workers_[i].valid()) continue;
+      if (!SendCtrl(workers_[i], frame).ok()) {
+        worker_live_[i].store(0, std::memory_order_relaxed);
+        workers_[i].Close();
+        return AbortJob(
+            Status::Error("rank " + std::to_string(i) +
+                          " unreachable on heartbeat — worker presumed "
+                          "dead; aborting job"),
+            i);
+      }
+      Faults().heartbeats_tx.fetch_add(1, std::memory_order_relaxed);
+    }
+    hb_last_tx_ns_ = now;
+  }
+  return false;
+}
+
+bool Engine::WorkerFaultTick(bool shutdown_in_flight) {
+  if (shutdown_in_flight) return false;
+  if (!stall_abort_msg_.empty()) {
+    std::string m;
+    m.swap(stall_abort_msg_);
+    return AbortJob(Status::Error(m), -1);
+  }
+  int64_t now = NowNs();
+  if (peer_timeout_s_ > 0) {
+    double age = (now - hb_seen_[0].load(std::memory_order_relaxed)) / 1e9;
+    if (age > peer_timeout_s_) {
+      Faults().peer_timeouts.fetch_add(1, std::memory_order_relaxed);
+      return AbortJob(
+          Status::Error(
+              "coordinator (rank 0) sent no control frames for " +
+              std::to_string(static_cast<int>(age)) +
+              "s (HOROVOD_TPU_PEER_TIMEOUT_S=" +
+              std::to_string(static_cast<int>(peer_timeout_s_)) +
+              ") — presumed dead; aborting"),
+          0);
+    }
+  }
+  if (hb_interval_s_ > 0 && (now - hb_last_tx_ns_) / 1e9 > hb_interval_s_) {
+    HeartbeatFrame f;
+    f.rank = rank_;
+    if (!SendCtrl(coord_, Serialize(f)).ok())
+      return AbortJob(
+          Status::Error("lost coordinator (rank 0) on heartbeat — "
+                        "presumed dead; aborting"),
+          0);
+    Faults().heartbeats_tx.fetch_add(1, std::memory_order_relaxed);
+    hb_last_tx_ns_ = now;
+  }
+  return false;
 }
 
 // ---------------------------------------------------------------------------
 // pipelined data plane
 // ---------------------------------------------------------------------------
-
-namespace {
-int64_t NowNs() {
-  return std::chrono::duration_cast<std::chrono::nanoseconds>(
-             std::chrono::steady_clock::now().time_since_epoch())
-      .count();
-}
-}  // namespace
 
 // Response execution entry point for the negotiation thread: errors always
 // complete inline (they never touch the wire, and their handles should not
@@ -2186,6 +2502,7 @@ void Engine::PipelineDispatch(const Response& resp) {
     for (auto& e : item.entries) total += e.nbytes;
     item.total = total;
     item.buf = AcquireBuf(total);  // backpressure: blocks at full depth
+    FaultInjector::Get().OnPhase(FaultPhase::kPack);
     auto t0 = std::chrono::steady_clock::now();
     int64_t busy0 = ExecutorBusyNs();
     timeline_.PipelineStart(item.buf->id, "PACK");
@@ -2322,6 +2639,7 @@ void Engine::FinishAllreduceEntry(TensorEntry& e, const Status& st,
 // executor handed back — while the executor is already mid-wire on the
 // NEXT item, which is the second half of the overlap.
 void Engine::CompleteItem(WorkItem& item) {
+  FaultInjector::Get().OnPhase(FaultPhase::kUnpack);
   auto t0 = std::chrono::steady_clock::now();
   int64_t busy0 = ExecutorBusyNs();
   int lane = item.buf ? item.buf->id : -1;
@@ -2415,10 +2733,9 @@ void Engine::ApplyRingSegment(int64_t bytes) {
 void Engine::PipelineStallCheck() {
   if (!stall_check_ || !dp_busy_.load(std::memory_order_acquire)) return;
   int64_t seq = dp_item_seq_.load(std::memory_order_relaxed);
-  if (seq == dp_stall_warned_seq_) return;
   double age =
       (NowNs() - dp_item_start_ns_.load(std::memory_order_relaxed)) / 1e9;
-  if (age > stall_warn_s_) {
+  if (seq != dp_stall_warned_seq_ && age > stall_warn_s_) {
     LogWarn("data-plane pipeline item #" + std::to_string(seq) +
             " has been on the wire for " +
             std::to_string(static_cast<int>(age)) +
@@ -2426,6 +2743,21 @@ void Engine::PipelineStallCheck() {
             "draining a much deeper queue)");
     stall_events_.fetch_add(1, std::memory_order_relaxed);
     dp_stall_warned_seq_ = seq;
+  }
+  // escalation tier: latch the abort NOW so the wedged transfer cancels
+  // (this may run from AcquireBuf/DrainPipeline parks, where the fault
+  // tick can't reach until the executor frees the negotiation thread —
+  // the latch is what breaks that cycle), and leave the message for the
+  // fault tick to broadcast/fail with
+  if (stall_abort_s_ > 0 && age > stall_abort_s_ &&
+      stall_abort_msg_.empty()) {
+    stall_abort_msg_ =
+        "data-plane pipeline item #" + std::to_string(seq) +
+        " wedged on the wire for " + std::to_string(static_cast<int>(age)) +
+        "s (HOROVOD_TPU_STALL_ABORT_S=" +
+        std::to_string(static_cast<int>(stall_abort_s_)) +
+        ") — aborting job";
+    SetAborting(true);
   }
 }
 
@@ -2629,6 +2961,7 @@ void Engine::ExecuteAllreduce(const Response& resp,
     return;
   }
   // fusion buffer (persistent across responses): pack, one allreduce, unpack
+  FaultInjector::Get().OnPhase(FaultPhase::kPack);
   size_t total = 0;
   for (auto& e : entries) total += e.nbytes;
   if (fusion_buf_.size() < total) fusion_buf_.resize(total);
@@ -2643,6 +2976,7 @@ void Engine::ExecuteAllreduce(const Response& resp,
   act_start(act);
   Status st = reduce(fused, static_cast<int64_t>(total / DTypeSize(dtype)));
   act_end();
+  FaultInjector::Get().OnPhase(FaultPhase::kUnpack);
   act_start("MEMCPY_OUT_FUSION_BUFFER");
   off = 0;
   for (auto& e : entries) {
@@ -2768,23 +3102,19 @@ struct Backoff {
 
 // Stall bounds for the peer progress loops, counted from the LAST byte of
 // progress (a steadily-moving transfer never times out, however large).
-// 0 disables.  Two defaults preserve the TCP contracts each path replaces:
-// duplex loops inherit Socket::SendRecv's 60 s poll bound; unidirectional
-// waits inherit SendAll/RecvAll's block-forever (a tree-broadcast child
-// legitimately idles while its local root runs a long cross-host phase).
+// 0 disables.  Since the fault domain (PR 5) BOTH directions default to
+// HOROVOD_TPU_PEER_TIMEOUT_S (default 60, 0 = off): a SIGKILLed peer must
+// bound EVERY wait, including the one-way tree-broadcast parks that
+// historically blocked forever.  The per-direction knobs remain as
+// explicit overrides (e.g. re-unbound one-way waits for multi-minute
+// cross-host phases without widening the duplex bound).
 struct DataPlaneTimeouts {
   double duplex;
   double oneway;
 };
 const DataPlaneTimeouts& Timeouts() {
-  // separate knobs: overriding the duplex bound must not silently
-  // re-impose a timeout on the deliberately-unbounded one-way waits
-  static DataPlaneTimeouts t = {
-      static_cast<double>(
-          EnvInt64("HOROVOD_TPU_DATA_PLANE_TIMEOUT_SECS", 60)),
-      static_cast<double>(
-          EnvInt64("HOROVOD_TPU_DATA_PLANE_ONEWAY_TIMEOUT_SECS", 0)),
-  };
+  static DataPlaneTimeouts t = {DuplexTimeoutSeconds(),
+                                OnewayTimeoutSeconds()};
   return t;
 }
 
@@ -2829,14 +3159,23 @@ void SendBlockedWait(Backoff& bo, Socket& tx, size_t want, bool fast_rx) {
 }  // namespace
 
 Status Engine::PeerSendAll(int r, const void* data, size_t n) {
+  FaultInjector::Get().OnLink(r);
   ShmRing* tx = r < static_cast<int>(shm_tx_.size()) ? shm_tx_[r].get()
                                                      : nullptr;
-  if (!tx) return peers_[r].SendAll(data, n);
   const char* p = static_cast<const char*>(data);
   auto last_prog = std::chrono::steady_clock::now();
   Backoff bo;
   while (n > 0) {
-    size_t k = tx->TryPush(p, n);
+    size_t k;
+    if (tx) {
+      k = tx->TryPush(p, n);
+    } else {
+      int kk = peers_[r].SendSome(p, n);
+      if (kk < 0)
+        return Status::Error("send to rank " + std::to_string(r) +
+                             " failed");
+      k = static_cast<size_t>(kk);
+    }
     if (k > 0) {
       p += k;
       n -= k;
@@ -2844,22 +3183,37 @@ Status Engine::PeerSendAll(int r, const void* data, size_t n) {
       last_prog = std::chrono::steady_clock::now();
       continue;
     }
-    bo.Wait();
+    if (Aborting()) return AbortedStatus();
+    if (tx)
+      bo.Wait();
+    else
+      SendBlockedWait(bo, peers_[r], n, /*fast_rx=*/false);
     if (Stalled(last_prog, Timeouts().oneway))
-      return Status::Error("shm send made no progress inside the timeout");
+      return PeerDeadStatus("peer send",
+                            "rank " + std::to_string(r),
+                            Timeouts().oneway);
   }
   return Status::OK();
 }
 
 Status Engine::PeerRecvAll(int r, void* data, size_t n) {
+  FaultInjector::Get().OnLink(r);
   ShmRing* rx = r < static_cast<int>(shm_rx_.size()) ? shm_rx_[r].get()
                                                      : nullptr;
-  if (!rx) return peers_[r].RecvAll(data, n);
   char* p = static_cast<char*>(data);
   auto last_prog = std::chrono::steady_clock::now();
   Backoff bo;
   while (n > 0) {
-    size_t k = rx->TryPop(p, n);
+    size_t k;
+    if (rx) {
+      k = rx->TryPop(p, n);
+    } else {
+      int kk = peers_[r].RecvSome(p, n);
+      if (kk < 0)
+        return Status::Error("recv from rank " + std::to_string(r) +
+                             " failed or closed");
+      k = static_cast<size_t>(kk);
+    }
     if (k > 0) {
       p += k;
       n -= k;
@@ -2867,24 +3221,49 @@ Status Engine::PeerRecvAll(int r, void* data, size_t n) {
       last_prog = std::chrono::steady_clock::now();
       continue;
     }
-    bo.Wait();
+    if (Aborting()) return AbortedStatus();
+    if (!rx && bo.idle >= 64) {
+      // recv-blocked TCP parks in poll(POLLIN); bounded so the abort
+      // latch and the no-progress clock are re-checked promptly
+      bo.idle++;
+      struct pollfd pf;
+      pf.fd = peers_[r].fd();
+      pf.events = POLLIN;
+      pf.revents = 0;
+      ::poll(&pf, 1, 50);
+    } else {
+      bo.Wait();
+    }
     if (Stalled(last_prog, Timeouts().oneway))
-      return Status::Error("shm recv made no progress inside the timeout");
+      return PeerDeadStatus("peer recv",
+                            "rank " + std::to_string(r),
+                            Timeouts().oneway);
   }
   return Status::OK();
 }
 
 Status Engine::PeerSendRecv(int r_send, const void* send_buf, size_t send_n,
                             int r_recv, void* recv_buf, size_t recv_n) {
+  FaultInjector::Get().OnLink(r_send);
+  if (r_recv != r_send) FaultInjector::Get().OnLink(r_recv);
   ShmRing* tx = r_send < static_cast<int>(shm_tx_.size())
                     ? shm_tx_[r_send].get()
                     : nullptr;
   ShmRing* rx = r_recv < static_cast<int>(shm_rx_.size())
                     ? shm_rx_[r_recv].get()
                     : nullptr;
-  if (!tx && !rx)
-    return Socket::SendRecv(peers_[r_send], send_buf, send_n, peers_[r_recv],
-                            recv_buf, recv_n, ring_idle_sink_);
+  if (!tx && !rx) {
+    Status st = Socket::SendRecv(peers_[r_send], send_buf, send_n,
+                                 peers_[r_recv], recv_buf, recv_n,
+                                 ring_idle_sink_);
+    if (!st.ok() && st.message.find("no progress") != std::string::npos)
+      return PeerDeadStatus("peer exchange",
+                            "rank " + std::to_string(r_send) +
+                                " (send) / rank " + std::to_string(r_recv) +
+                                " (recv)",
+                            Timeouts().duplex);
+    return st;
+  }
   const char* sp = static_cast<const char*>(send_buf);
   char* rp = static_cast<char*>(recv_buf);
   size_t sleft = send_n, rleft = recv_n;
@@ -2911,7 +3290,8 @@ Status Engine::PeerSendRecv(int r_send, const void* send_buf, size_t send_n,
         int k = peers_[r_send].SendSome(sp, sleft);
         if (k < 0) {
           flush_idle();
-          return Status::Error("peer send failed");
+          return Status::Error("send to rank " +
+                               std::to_string(r_send) + " failed");
         }
         sp += k;
         sleft -= static_cast<size_t>(k);
@@ -2928,7 +3308,9 @@ Status Engine::PeerSendRecv(int r_send, const void* send_buf, size_t send_n,
         int k = peers_[r_recv].RecvSome(rp, rleft);
         if (k < 0) {
           flush_idle();
-          return Status::Error("peer recv failed or closed");
+          return Status::Error("recv from rank " +
+                               std::to_string(r_recv) +
+                               " failed or closed");
         }
         rp += k;
         rleft -= static_cast<size_t>(k);
@@ -2942,13 +3324,21 @@ Status Engine::PeerSendRecv(int r_send, const void* send_buf, size_t send_n,
       continue;
     }
     if (ring_idle_sink_ && !idle_since) idle_since = NowNs();
+    if (Aborting()) {
+      flush_idle();
+      return AbortedStatus();
+    }
     if (!tx && sleft > 0)
       SendBlockedWait(bo, peers_[r_send], sleft, /*fast_rx=*/rleft > 0);
     else
       bo.Wait();
     if (Stalled(last_prog, Timeouts().duplex)) {
       flush_idle();
-      return Status::Error("peer send_recv made no progress inside the timeout");
+      return PeerDeadStatus("peer exchange",
+                            "rank " + std::to_string(r_send) +
+                                " (send) / rank " + std::to_string(r_recv) +
+                                " (recv)",
+                            Timeouts().duplex);
     }
   }
   return Status::OK();
@@ -2974,6 +3364,8 @@ Status Engine::PeerSendRecvReduce(int r_send, const void* send_buf,
     Accumulate(dst, ring_scratch_.data(), nelems, dtype);
     return Status::OK();
   }
+  FaultInjector::Get().OnLink(r_send);
+  if (r_recv != r_send) FaultInjector::Get().OnLink(r_recv);
   ShmRing* tx = r_send < static_cast<int>(shm_tx_.size())
                     ? shm_tx_[r_send].get()
                     : nullptr;
@@ -3006,7 +3398,8 @@ Status Engine::PeerSendRecvReduce(int r_send, const void* send_buf,
         int k = peers_[r_send].SendSome(sp, sleft);
         if (k < 0) {
           flush_idle();
-          return Status::Error("peer send failed");
+          return Status::Error("send to rank " +
+                               std::to_string(r_send) + " failed");
         }
         sp += k;
         sleft -= static_cast<size_t>(k);
@@ -3034,14 +3427,21 @@ Status Engine::PeerSendRecvReduce(int r_send, const void* send_buf,
       continue;
     }
     if (ring_idle_sink_ && !idle_since) idle_since = NowNs();
+    if (Aborting()) {
+      flush_idle();
+      return AbortedStatus();
+    }
     if (!tx && sleft > 0)
       SendBlockedWait(bo, peers_[r_send], sleft, /*fast_rx=*/rleft > 0);
     else
       bo.Wait();
     if (Stalled(last_prog, Timeouts().duplex)) {
       flush_idle();
-      return Status::Error(
-          "shm send_recv_reduce made no progress inside the timeout");
+      return PeerDeadStatus("reduce exchange",
+                            "rank " + std::to_string(r_send) +
+                                " (send) / rank " + std::to_string(r_recv) +
+                                " (recv)",
+                            Timeouts().duplex);
     }
   }
   return Status::OK();
@@ -3051,6 +3451,9 @@ Status Engine::RingAllreduceGroup(char* buf, int64_t nelems, DType dtype,
                                   const std::vector<int>& members) {
   int m = static_cast<int>(members.size());
   if (m <= 1) return Status::OK();
+  // chaos hook: "kill:rank=R:phase=ring" fires here — the survivors'
+  // ring loops park on a peer that will never answer
+  FaultInjector::Get().OnPhase(FaultPhase::kRing);
   int64_t seg = ring_segment_bytes_.load(std::memory_order_relaxed);
   if (seg > 0)
     return RingAllreduceGroupSegmented(buf, nelems, dtype, members, seg);
@@ -3173,6 +3576,8 @@ Status Engine::RingAllreduceGroupSegmented(char* buf, int64_t nelems,
   size_t esize = DTypeSize(dtype);
   int right = members[(me + 1) % m];
   int left = members[(me + m - 1) % m];
+  FaultInjector::Get().OnLink(right);
+  if (left != right) FaultInjector::Get().OnLink(left);
   SegGeom g{nelems, m, me,
             std::max<int64_t>(1, seg_bytes / static_cast<int64_t>(esize))};
   const int last_step = 2 * m - 3;
@@ -3244,7 +3649,8 @@ Status Engine::RingAllreduceGroupSegmented(char* buf, int64_t nelems,
           } else {
             int kk = txs->SendSome(buf + lo_b, send_avail);
             if (kk < 0) {
-              err = Status::Error("segmented ring send failed");
+              err = Status::Error("segmented ring send to rank " +
+                                  std::to_string(right) + " failed");
               break;
             }
             k = static_cast<size_t>(kk);
@@ -3302,7 +3708,9 @@ Status Engine::RingAllreduceGroupSegmented(char* buf, int64_t nelems,
         } else {
           int kk = rxs->RecvSome(dst, want);
           if (kk < 0) {
-            err = Status::Error("segmented ring recv failed or closed");
+            err = Status::Error("segmented ring recv from rank " +
+                                std::to_string(left) +
+                                " failed or closed");
             break;
           }
           k = static_cast<size_t>(kk);
@@ -3342,6 +3750,10 @@ Status Engine::RingAllreduceGroupSegmented(char* buf, int64_t nelems,
       continue;
     }
     if (!idle_since) idle_since = NowNs();
+    if (Aborting()) {
+      err = AbortedStatus();
+      break;
+    }
     if (txs && send_avail > 0)
       // TCP send is the blocker: deterministic paced sleep or
       // poll(POLLOUT); capped short while a recv side still needs service
@@ -3349,7 +3761,11 @@ Status Engine::RingAllreduceGroupSegmented(char* buf, int64_t nelems,
     else if (rxs && rt <= last_step && bo.idle >= 64) {
       // recv is the blocker and it is TCP: park in poll(POLLIN) instead
       // of the sleep ladder; stay short while a full shm tx ring still
-      // needs push retries (the peer drains it on its own clock)
+      // needs push retries (the peer drains it on its own clock).  The
+      // 50 ms bound doubles as the fault domain's re-check cadence: the
+      // abort latch and the no-progress clock above are consulted at
+      // least that often, so a dead neighbor can never park this loop
+      // past the peer timeout.
       bo.idle++;
       struct pollfd p;
       p.fd = rxs->fd();
@@ -3360,7 +3776,11 @@ Status Engine::RingAllreduceGroupSegmented(char* buf, int64_t nelems,
       bo.Wait();
     }
     if (Stalled(last_prog, Timeouts().duplex)) {
-      err = Status::Error("segmented ring made no progress inside the timeout");
+      err = PeerDeadStatus("segmented ring",
+                           "rank " + std::to_string(right) +
+                               " (send) / rank " + std::to_string(left) +
+                               " (recv)",
+                           Timeouts().duplex);
       break;
     }
   }
@@ -3403,6 +3823,9 @@ Status Engine::RingAllgatherGroup(const std::vector<int>& members,
                                  char* concat) {
   int m = static_cast<int>(members.size());
   if (m <= 1) return Status::OK();
+  int64_t seg = ring_segment_bytes_.load(std::memory_order_relaxed);
+  if (seg > 0)
+    return RingAllgatherGroupSegmented(members, member_bytes, concat, seg);
   int me = static_cast<int>(
       std::find(members.begin(), members.end(), rank_) - members.begin());
   if (me == m) return Status::Error("rank not in allgather group");
@@ -3419,6 +3842,233 @@ Status Engine::RingAllgatherGroup(const std::vector<int>& members,
     if (!st.ok())
       return Status::Error("ring allgather failed: " + st.message);
   }
+  return Status::OK();
+}
+
+// Segment-windowed ring allgather (ROADMAP open item: the standalone
+// allgather ran the monolithic exchange PR 4 removed from the allreduce
+// ring).  One sliding window over (step, segment) units replaces the m-1
+// whole-block duplex barriers: the block SENT at step t is exactly the
+// block RECEIVED at step t-1, so a step-t send of segment s departs the
+// moment that segment lands — segment s+1 streams through the transport
+// while s forwards, which smooths paced links exactly as the allreduce
+// window does.  There is no accumulate: bytes land straight in `concat`
+// at the block's offset, so results are bitwise identical to the
+// monolithic path for ANY segment size by construction (segmentation
+// moves WHEN bytes become eligible, never their order or content).
+// Blocks are caller-sized (variable first dims), so the geometry is
+// byte-based; the send block at step t and the recv block at step t-1
+// are the same block, hence the same segment count on both sides of the
+// dependency.
+Status Engine::RingAllgatherGroupSegmented(
+    const std::vector<int>& members, const std::vector<size_t>& member_bytes,
+    char* concat, int64_t seg_bytes) {
+  int m = static_cast<int>(members.size());
+  int me = static_cast<int>(
+      std::find(members.begin(), members.end(), rank_) - members.begin());
+  if (me == m) return Status::Error("rank not in allgather group");
+  std::vector<int64_t> off(m + 1, 0);
+  for (int i = 0; i < m; i++)
+    off[i + 1] = off[i] + static_cast<int64_t>(member_bytes[i]);
+  int right = members[(me + 1) % m];
+  int left = members[(me + m - 1) % m];
+  FaultInjector::Get().OnLink(right);
+  if (left != right) FaultInjector::Get().OnLink(left);
+
+  ShmRing* tx = right < static_cast<int>(shm_tx_.size())
+                    ? shm_tx_[right].get()
+                    : nullptr;
+  ShmRing* rx = left < static_cast<int>(shm_rx_.size())
+                    ? shm_rx_[left].get()
+                    : nullptr;
+  Socket* txs = tx ? nullptr : &peers_[right];
+  Socket* rxs = rx ? nullptr : &peers_[left];
+
+  // block travelling on step t: I send (me - t), receive (me - t - 1) —
+  // which is precisely my step-t+1 send, so recv progress gates sends
+  // with no block translation (same invariant as the allreduce window)
+  auto blk = [&](int t) { return ((me - t) % m + 2 * m) % m; };
+  auto bytes_of = [&](int b) {
+    return static_cast<int64_t>(member_bytes[b]);
+  };
+  auto nsegs = [&](int b) {
+    int64_t n = bytes_of(b);
+    return n == 0 ? int64_t{1} : (n + seg_bytes - 1) / seg_bytes;
+  };
+  auto seg_lo = [&](int b, int64_t s) {
+    return std::min(s * seg_bytes, bytes_of(b));
+  };
+  auto seg_hi = [&](int b, int64_t s) {
+    return std::min((s + 1) * seg_bytes, bytes_of(b));
+  };
+  const int last_step = m - 2;
+
+  int st = 0;          // send step
+  int64_t ssg = 0;     // send segment within st
+  int64_t s_off = 0;   // bytes of the current send segment already pushed
+  int rt = 0;          // recv step
+  int64_t rsg = 0;     // segments fully landed in rt
+  int64_t r_off = 0;   // bytes of the current recv segment already popped
+
+  int64_t segments = 0, payload = 0;
+  int64_t idle_ns = 0, idle_since = 0;
+  auto last_prog = std::chrono::steady_clock::now();
+  int64_t t0 = NowNs();
+  Backoff bo;
+  Status err;
+
+  while (st <= last_step || rt <= last_step) {
+    bool prog = false;
+    size_t send_avail = 0;
+
+    if (st <= last_step) {
+      int sb = blk(st);
+      int64_t ns = nsegs(sb);
+      // segments of this step's block already forwarded to us by step t-1
+      int64_t ready = st == 0 ? ns
+                      : rt > st - 1 ? ns
+                      : rt == st - 1 ? std::min(rsg, ns)
+                                     : 0;
+      if (ssg < ready) {
+        int64_t lo_b = off[sb] + seg_lo(sb, ssg) + s_off;
+        int64_t hi_b = off[sb] + seg_hi(sb, ready - 1);
+        send_avail = static_cast<size_t>(hi_b - lo_b);
+        if (send_avail == 0) {
+          // zero-byte block: its placeholder segment completes free
+          ssg = ready;
+          if (ssg >= ns) {
+            st++;
+            ssg = 0;
+            s_off = 0;
+          }
+          prog = true;
+        } else {
+          size_t k;
+          if (tx) {
+            k = tx->TryPush(concat + lo_b, send_avail);
+          } else {
+            int kk = txs->SendSome(concat + lo_b, send_avail);
+            if (kk < 0) {
+              err = Status::Error("segmented allgather send to rank " +
+                                  std::to_string(right) + " failed");
+              break;
+            }
+            k = static_cast<size_t>(kk);
+          }
+          if (k > 0) {
+            if (s_off == 0) timeline_.RingSegStart("ring/send", "SEG_SEND");
+            s_off += static_cast<int64_t>(k);
+            payload += static_cast<int64_t>(k);
+            prog = true;
+            for (;;) {
+              int64_t seg_b = seg_hi(sb, ssg) - seg_lo(sb, ssg);
+              if (s_off < seg_b) break;
+              s_off -= seg_b;
+              timeline_.RingSegEnd("ring/send");
+              segments++;
+              ssg++;
+              if (ssg >= ns) {
+                st++;
+                ssg = 0;
+                s_off = 0;  // pushes stop at the block end
+                break;
+              }
+              if (s_off > 0) timeline_.RingSegStart("ring/send", "SEG_SEND");
+            }
+          }
+        }
+      }
+    }
+
+    if (rt <= last_step) {
+      int rb = blk(rt + 1);
+      int64_t ns = nsegs(rb);
+      int64_t lo = seg_lo(rb, rsg), hi = seg_hi(rb, rsg);
+      int64_t seg_b = hi - lo;
+      if (seg_b == 0) {
+        rsg++;
+        if (rsg >= ns) {
+          rt++;
+          rsg = 0;
+        }
+        prog = true;
+      } else {
+        char* dst = concat + off[rb] + lo + r_off;
+        size_t want = static_cast<size_t>(seg_b - r_off);
+        size_t k;
+        if (rx) {
+          k = rx->TryPop(dst, want);
+        } else {
+          int kk = rxs->RecvSome(dst, want);
+          if (kk < 0) {
+            err = Status::Error("segmented allgather recv from rank " +
+                                std::to_string(left) +
+                                " failed or closed");
+            break;
+          }
+          k = static_cast<size_t>(kk);
+        }
+        if (k > 0) {
+          if (r_off == 0) timeline_.RingSegStart("ring/recv", "SEG_RECV");
+          r_off += static_cast<int64_t>(k);
+          prog = true;
+          if (r_off == seg_b) {
+            timeline_.RingSegEnd("ring/recv");
+            r_off = 0;
+            rsg++;
+            if (rsg >= ns) {
+              rt++;
+              rsg = 0;
+            }
+          }
+        }
+      }
+    }
+
+    if (prog) {
+      if (idle_since) {
+        idle_ns += NowNs() - idle_since;
+        idle_since = 0;
+      }
+      bo.Progress();
+      last_prog = std::chrono::steady_clock::now();
+      continue;
+    }
+    if (!idle_since) idle_since = NowNs();
+    if (Aborting()) {
+      err = AbortedStatus();
+      break;
+    }
+    if (txs && send_avail > 0)
+      SendBlockedWait(bo, *txs, send_avail, /*fast_rx=*/rt <= last_step);
+    else if (rxs && rt <= last_step && bo.idle >= 64) {
+      bo.idle++;
+      struct pollfd p;
+      p.fd = rxs->fd();
+      p.events = POLLIN;
+      p.revents = 0;
+      ::poll(&p, 1, (tx && send_avail > 0) ? 1 : 50);
+    } else {
+      bo.Wait();
+    }
+    if (Stalled(last_prog, Timeouts().duplex)) {
+      err = PeerDeadStatus("segmented allgather",
+                           "rank " + std::to_string(right) +
+                               " (send) / rank " + std::to_string(left) +
+                               " (recv)",
+                           Timeouts().duplex);
+      break;
+    }
+  }
+
+  if (idle_since) idle_ns += NowNs() - idle_since;
+  ring_runs_seg_.fetch_add(1, std::memory_order_relaxed);
+  ring_segments_.fetch_add(segments, std::memory_order_relaxed);
+  ring_seg_payload_bytes_.fetch_add(payload, std::memory_order_relaxed);
+  ring_wire_ns_.fetch_add(NowNs() - t0, std::memory_order_relaxed);
+  ring_idle_ns_.fetch_add(idle_ns, std::memory_order_relaxed);
+  if (!err.ok())
+    return Status::Error("ring allgather failed: " + err.message);
   return Status::OK();
 }
 
@@ -3887,6 +4537,79 @@ double hvd_accum_gbps(int dtype, int64_t n, int iters, int mode) {
 int hvd_accum_apply(int dtype, int64_t n, int mode, void* dst,
                     const void* src) {
   return RunAccumMode(static_cast<DType>(dtype), n, mode, dst, src) ? 0 : -1;
+}
+
+// Fault-domain statistics, in order: {max peer heartbeat age ms (-1 when
+// the engine is down), configured peer timeout ms, peer timeouts detected,
+// aborts initiated/received, cumulative detect->handles-failed abort
+// latency ns, heartbeat frames sent, heartbeat frames received, reserved}.
+// The counters are process-wide (they survive engine re-init, like the
+// telemetry registry they feed); only the age needs a live engine.
+void hvd_fault_stats(int64_t* out) {
+  out[0] = g_engine ? g_engine->MaxPeerAgeMs() : -1;
+  out[1] = static_cast<int64_t>(PeerTimeoutSeconds() * 1000);
+  out[2] = Faults().peer_timeouts.load(std::memory_order_relaxed);
+  out[3] = Faults().aborts.load(std::memory_order_relaxed);
+  out[4] = Faults().abort_latency_ns.load(std::memory_order_relaxed);
+  out[5] = Faults().heartbeats_tx.load(std::memory_order_relaxed);
+  out[6] = Faults().heartbeats_rx.load(std::memory_order_relaxed);
+  out[7] = 0;
+}
+
+// The control-plane wire version this .so speaks (kWireVersion mirror for
+// Python-side diagnostics and the ABI drift guard).
+int hvd_wire_version() { return static_cast<int>(kWireVersion); }
+
+// Parse probe for tests/tools: returns NULL when `buf` parses as a control
+// frame, else a malloc'd error string (free via hvd_free_cstr).  This is
+// how the suite asserts the v4<->v5 version-mismatch path produces the
+// descriptive both-versions message without standing up two engines.
+const char* hvd_frame_parse_error(const void* buf, int64_t len) {
+  if (!buf || len < 0) return strdup("null frame");
+  std::string s(static_cast<const char*>(buf), static_cast<size_t>(len));
+  FrameType ft = FrameTypeOf(s);
+  Status st;
+  switch (ft) {
+    case FrameType::kRequestList: {
+      RequestList rl;
+      st = Parse(s, &rl);
+      break;
+    }
+    case FrameType::kResponseList: {
+      ResponseList rl;
+      st = Parse(s, &rl);
+      break;
+    }
+    case FrameType::kCacheBits: {
+      CacheBitsFrame f;
+      st = Parse(s, &f);
+      break;
+    }
+    case FrameType::kCachedExec: {
+      CachedExecFrame f;
+      st = Parse(s, &f);
+      break;
+    }
+    case FrameType::kHeartbeat: {
+      HeartbeatFrame f;
+      st = Parse(s, &f);
+      break;
+    }
+    case FrameType::kAbort: {
+      AbortFrame f;
+      st = Parse(s, &f);
+      break;
+    }
+    default: {
+      // kInvalid covers version skew: re-run a typed parse so the caller
+      // gets the descriptive mismatch message, not just "invalid"
+      RequestList rl;
+      st = Parse(s, &rl);
+      if (st.ok()) st = Status::Error("unrecognized control frame");
+      break;
+    }
+  }
+  return st.ok() ? nullptr : strdup(st.message.c_str());
 }
 
 }  // extern "C"

@@ -1,0 +1,258 @@
+#include "fault.h"
+
+#include <signal.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <cstdio>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+#include "common.h"
+#include "logging.h"
+
+namespace hvdtpu {
+
+namespace {
+// float-aware env parse: the launcher flags are floats, and truncating
+// "0.5" to 0 would silently DISABLE the knob instead of tightening it
+double EnvDouble(const char* name, double dflt) {
+  const char* v = getenv(name);
+  if (!v || !v[0]) return dflt;
+  return atof(v);
+}
+}  // namespace
+
+double PeerTimeoutSeconds() {
+  static double t = [] {
+    double v = EnvDouble("HOROVOD_TPU_PEER_TIMEOUT_S", 60);
+    return v < 0 ? 0.0 : v;
+  }();
+  return t;
+}
+
+double DuplexTimeoutSeconds() {
+  static double t =
+      EnvDouble("HOROVOD_TPU_DATA_PLANE_TIMEOUT_SECS", PeerTimeoutSeconds());
+  return t;
+}
+
+double OnewayTimeoutSeconds() {
+  static double t = EnvDouble("HOROVOD_TPU_DATA_PLANE_ONEWAY_TIMEOUT_SECS",
+                              PeerTimeoutSeconds());
+  return t;
+}
+
+double HeartbeatIntervalSeconds() {
+  static double t = [] {
+    const char* v = getenv("HOROVOD_TPU_HEARTBEAT_S");
+    if (v && v[0]) {
+      double d = atof(v);
+      return d < 0 ? 0.0 : d;
+    }
+    // default: 4 probes per timeout window, capped at 5 s so the age
+    // metric stays fresh on long timeouts; detection off still
+    // heartbeats at 5 s (the age gauge is useful on its own)
+    double pt = PeerTimeoutSeconds();
+    double d = pt > 0 ? pt / 4 : 5.0;
+    return d > 5.0 ? 5.0 : d < 0.05 ? 0.05 : d;
+  }();
+  return t;
+}
+
+double StallAbortSeconds() {
+  static double t = [] {
+    double v = EnvDouble("HOROVOD_TPU_STALL_ABORT_S", 0);
+    return v < 0 ? 0.0 : v;
+  }();
+  return t;
+}
+
+namespace {
+std::atomic<bool> g_aborting{false};
+}
+
+void SetAborting(bool on) {
+  g_aborting.store(on, std::memory_order_release);
+}
+
+bool Aborting() { return g_aborting.load(std::memory_order_acquire); }
+
+FaultCounters& Faults() {
+  static FaultCounters c;
+  return c;
+}
+
+// ---------------------------------------------------------------------------
+// injector
+// ---------------------------------------------------------------------------
+
+FaultInjector& FaultInjector::Get() {
+  static FaultInjector inj;
+  return inj;
+}
+
+namespace {
+
+const char* PhaseName(FaultPhase p) {
+  switch (p) {
+    case FaultPhase::kNegotiation: return "negotiation";
+    case FaultPhase::kPack: return "pack";
+    case FaultPhase::kRing: return "ring";
+    case FaultPhase::kUnpack: return "unpack";
+  }
+  return "?";
+}
+
+// "key=value" fields of one spec, ':'-separated after the type word.
+struct SpecFields {
+  int64_t rank = -1;
+  FaultPhase phase = FaultPhase::kNegotiation;
+  int64_t hit = 1;
+  int64_t ms = 0;
+  int link_a = -1, link_b = -1;
+  bool ok = true;
+  std::string err;
+};
+
+SpecFields ParseFields(const std::string& body) {
+  SpecFields f;
+  size_t pos = 0;
+  while (pos < body.size()) {
+    size_t colon = body.find(':', pos);
+    std::string kv = body.substr(
+        pos, colon == std::string::npos ? std::string::npos : colon - pos);
+    pos = colon == std::string::npos ? body.size() : colon + 1;
+    size_t eq = kv.find('=');
+    if (eq == std::string::npos) {
+      f.ok = false;
+      f.err = "field '" + kv + "' lacks '='";
+      return f;
+    }
+    std::string k = kv.substr(0, eq), v = kv.substr(eq + 1);
+    if (k == "rank") {
+      f.rank = strtoll(v.c_str(), nullptr, 10);
+    } else if (k == "phase") {
+      if (v == "negotiation") f.phase = FaultPhase::kNegotiation;
+      else if (v == "pack") f.phase = FaultPhase::kPack;
+      else if (v == "ring") f.phase = FaultPhase::kRing;
+      else if (v == "unpack") f.phase = FaultPhase::kUnpack;
+      else {
+        f.ok = false;
+        f.err = "unknown phase '" + v + "'";
+        return f;
+      }
+    } else if (k == "cycle" || k == "hit") {
+      f.hit = strtoll(v.c_str(), nullptr, 10);
+      if (f.hit < 1) f.hit = 1;
+    } else if (k == "ms") {
+      f.ms = strtoll(v.c_str(), nullptr, 10);
+    } else if (k == "link") {
+      // "A-B"
+      size_t dash = v.find('-');
+      if (dash == std::string::npos) {
+        f.ok = false;
+        f.err = "link wants 'A-B', got '" + v + "'";
+        return f;
+      }
+      f.link_a = atoi(v.substr(0, dash).c_str());
+      f.link_b = atoi(v.substr(dash + 1).c_str());
+    } else {
+      f.ok = false;
+      f.err = "unknown field '" + k + "'";
+      return f;
+    }
+  }
+  return f;
+}
+
+}  // namespace
+
+void FaultInjector::Configure(int rank) {
+  rank_ = rank;
+  nspecs_ = 0;
+  armed_ = false;
+  delay_armed_ = false;
+  const char* env = getenv("HOROVOD_TPU_FAULT_INJECT");
+  if (!env || !env[0]) return;
+  std::string all(env);
+  size_t pos = 0;
+  while (pos < all.size()) {
+    size_t semi = all.find(';', pos);
+    std::string one = all.substr(
+        pos, semi == std::string::npos ? std::string::npos : semi - pos);
+    pos = semi == std::string::npos ? all.size() : semi + 1;
+    if (one.empty()) continue;
+    size_t colon = one.find(':');
+    std::string type = one.substr(0, colon);
+    std::string body =
+        colon == std::string::npos ? "" : one.substr(colon + 1);
+    SpecFields f = ParseFields(body);
+    if (!f.ok) {
+      LOG(Warning) << "fault injection: bad spec '" << one << "' ("
+                   << f.err << ") — IGNORED";
+      continue;
+    }
+    if (type == "kill" || type == "hang") {
+      if (f.rank < 0) {
+        LOG(Warning) << "fault injection: spec '" << one
+                     << "' lacks rank= — IGNORED";
+        continue;
+      }
+      if (f.rank != rank_) continue;  // armed on the named rank only
+      if (nspecs_ >= kMaxSpecs) continue;
+      Spec& s = specs_[nspecs_++];
+      s.kill = type == "kill";
+      s.phase = f.phase;
+      s.hit = f.hit;
+      armed_ = true;
+    } else if (type == "delay") {
+      if (f.link_a < 0 || f.link_b < 0 || f.ms <= 0) {
+        LOG(Warning) << "fault injection: spec '" << one
+                     << "' wants link=A-B and ms=N — IGNORED";
+        continue;
+      }
+      if (rank_ != f.link_a && rank_ != f.link_b) continue;
+      delay_peer_a_ = f.link_a;
+      delay_peer_b_ = f.link_b;
+      delay_ms_ = f.ms;
+      delay_armed_ = true;
+    } else {
+      LOG(Warning) << "fault injection: unknown type '" << type
+                   << "' — IGNORED";
+    }
+  }
+  if (armed_ || delay_armed_)
+    LOG_RANK(Warning, rank_) << "fault injection ARMED: " << all;
+}
+
+void FaultInjector::OnPhaseSlow(FaultPhase p) {
+  for (int i = 0; i < nspecs_; i++) {
+    Spec& s = specs_[i];
+    if (s.fired || s.phase != p) continue;
+    if (++s.seen < s.hit) continue;
+    s.fired = true;
+    if (s.kill) {
+      // async-signal-safe last words: SIGKILL flushes nothing
+      char buf[128];
+      int n = snprintf(buf, sizeof(buf),
+                       "[hvdtpu] fault injection: SIGKILL rank %d at %s #%lld\n",
+                       rank_, PhaseName(p), static_cast<long long>(s.hit));
+      ssize_t w = write(2, buf, static_cast<size_t>(n));
+      (void)w;
+      raise(SIGKILL);
+    }
+    LOG_RANK(Warning, rank_) << "fault injection: HANG at "
+                             << PhaseName(p) << " #" << s.hit;
+    for (;;) std::this_thread::sleep_for(std::chrono::seconds(3600));
+  }
+}
+
+void FaultInjector::OnLinkSlow(int peer) {
+  int other = rank_ == delay_peer_a_ ? delay_peer_b_ : delay_peer_a_;
+  if (peer != other) return;
+  std::this_thread::sleep_for(std::chrono::milliseconds(delay_ms_));
+}
+
+}  // namespace hvdtpu

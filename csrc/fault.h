@@ -1,0 +1,145 @@
+// Fault domain for the native collective engine: bounded-time peer-death
+// detection, job-wide coordinated abort, and deterministic fault injection.
+//
+// The reference system's famous operational hole (SURVEY: rank-0 negotiated
+// dynamically-ready tensors) is that a dead worker parks every other rank
+// inside a collective forever — MPI owns the transport, so Horovod can only
+// stall-WARN.  Here the engine owns every socket, so it can do better:
+//
+//  * liveness config — ``HOROVOD_TPU_PEER_TIMEOUT_S`` (default 60, 0 = off)
+//    bounds every data-plane no-progress wait and the control-plane
+//    heartbeat ages; ``HOROVOD_TPU_HEARTBEAT_S`` paces the idle-tick
+//    heartbeat frames (default min(5, timeout/4));
+//    ``HOROVOD_TPU_STALL_ABORT_S`` (default 0 = off) escalates a persistent
+//    negotiation/executor stall into the coordinated-abort path.
+//  * process-wide fault counters (peer timeouts, aborts, heartbeats,
+//    abort latency) exported through ``hvd_fault_stats`` — process-wide
+//    rather than engine members so a re-init (sub-worlds, tests) never
+//    zeroes history mid-scrape, mirroring how the telemetry registry
+//    outlives engines.
+//  * an "aborting" latch every no-progress wait polls, so an ABORT frame
+//    unwedges ring loops parked in poll() immediately instead of after
+//    their own peer timeout.
+//  * a deterministic fault injector (``HOROVOD_TPU_FAULT_INJECT``) that
+//    can SIGKILL or wedge a chosen rank at a chosen engine phase, and add
+//    latency to a chosen peer link — the machinery the chaos suite
+//    (tests/test_fault.py) drives to PROVE the three points above.
+//
+// Spec grammar (';'-separated specs, ':'-separated key=value fields):
+//    kill:rank=2:cycle=5            SIGKILL rank 2 at its 5th negotiation tick
+//    kill:rank=1:phase=ring         SIGKILL rank 1 entering its 1st ring
+//    kill:rank=1:phase=pack:hit=3   ... at the 3rd pack instead
+//    hang:rank=1:phase=unpack       wedge (sleep forever) instead of dying
+//    delay:link=0-1:ms=500          500 ms pause entering each 0<->1 transfer
+// Phases: negotiation (default), pack, ring, unpack.  ``cycle`` and ``hit``
+// are synonyms: the Nth entry of that phase on that rank (1-based).
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <string>
+
+namespace hvdtpu {
+
+// ---------------------------------------------------------------------------
+// liveness configuration (parsed once per process)
+// ---------------------------------------------------------------------------
+
+// HOROVOD_TPU_PEER_TIMEOUT_S: seconds of no progress / no frames from a
+// peer before it is presumed dead.  0 disables detection (restores the
+// historical block-forever waits — the bisection knob).  Parsed as a
+// DOUBLE: the launcher flag and the Python mirror accept fractions, and
+// an integer parse would silently turn 0.5 into detection-off.
+double PeerTimeoutSeconds();
+
+// Data-plane no-progress bounds: the per-direction env overrides
+// (HOROVOD_TPU_DATA_PLANE[_ONEWAY]_TIMEOUT_SECS) when set, else the peer
+// timeout.  Shared by engine.cc's progress loops and socket.cc's duplex
+// helper so the pure-TCP and shm-mixed paths stall out identically.
+double DuplexTimeoutSeconds();
+double OnewayTimeoutSeconds();
+
+// Idle-tick heartbeat period.  Steady-state traffic IS the heartbeat
+// (any control frame refreshes last-seen); explicit frames only flow on
+// idle links, so the steady-state negotiation bytes/cycle are unchanged.
+double HeartbeatIntervalSeconds();
+
+// HOROVOD_TPU_STALL_ABORT_S: age at which a stall warning escalates to a
+// coordinated abort.  0 (default) keeps stalls warn-only.
+double StallAbortSeconds();
+
+// ---------------------------------------------------------------------------
+// job-wide abort latch
+// ---------------------------------------------------------------------------
+
+// Set when this process initiates or receives a coordinated abort; every
+// data-plane no-progress wait polls it so wedged transfers fail in one
+// backoff step instead of waiting out their own peer timeout.  Reset by
+// engine (re-)init.
+void SetAborting(bool on);
+bool Aborting();
+
+// ---------------------------------------------------------------------------
+// process-wide fault counters (hvd_fault_stats)
+// ---------------------------------------------------------------------------
+
+struct FaultCounters {
+  std::atomic<int64_t> peer_timeouts{0};   // no-progress/heartbeat expiries
+  std::atomic<int64_t> aborts{0};          // aborts initiated or received
+  std::atomic<int64_t> abort_latency_ns{0};  // detect -> local handles failed
+  std::atomic<int64_t> heartbeats_tx{0};
+  std::atomic<int64_t> heartbeats_rx{0};
+};
+
+FaultCounters& Faults();
+
+// ---------------------------------------------------------------------------
+// deterministic fault injection
+// ---------------------------------------------------------------------------
+
+enum class FaultPhase : int { kNegotiation = 0, kPack = 1, kRing = 2,
+                              kUnpack = 3 };
+
+class FaultInjector {
+ public:
+  // Parses HOROVOD_TPU_FAULT_INJECT for this rank; malformed specs are a
+  // loud stderr warning (chaos tests must never silently not-inject).
+  void Configure(int rank);
+
+  // Phase hook: SIGKILLs / wedges the process when an armed spec's Nth
+  // occurrence is reached.  One branch on an armed flag when inactive.
+  void OnPhase(FaultPhase p) {
+    if (armed_) OnPhaseSlow(p);
+  }
+
+  // Link-delay hook: sleeps the configured latency when {rank_, peer} is
+  // the armed link (order-insensitive).
+  void OnLink(int peer) {
+    if (delay_armed_) OnLinkSlow(peer);
+  }
+
+  static FaultInjector& Get();
+
+ private:
+  void OnPhaseSlow(FaultPhase p);
+  void OnLinkSlow(int peer);
+
+  struct Spec {
+    bool kill = false;     // else hang
+    FaultPhase phase = FaultPhase::kNegotiation;
+    int64_t hit = 1;       // fire at the Nth phase entry (1-based)
+    int64_t seen = 0;
+    bool fired = false;
+  };
+  // at most a handful of specs; fixed storage keeps the hook allocation-free
+  static constexpr int kMaxSpecs = 8;
+  Spec specs_[kMaxSpecs];
+  int nspecs_ = 0;
+  bool armed_ = false;
+  bool delay_armed_ = false;
+  int delay_peer_a_ = -1, delay_peer_b_ = -1;
+  int64_t delay_ms_ = 0;
+  int rank_ = -1;
+};
+
+}  // namespace hvdtpu

@@ -15,6 +15,8 @@
 #include <cstdlib>
 #include <thread>
 
+#include "fault.h"
+
 namespace hvdtpu {
 
 namespace {
@@ -24,14 +26,11 @@ Status Errno(const std::string& what) {
 }
 
 // Duplex no-progress bound, shared with the engine's mixed shm/TCP
-// progress loops: the SAME EnvInt64 parse as engine.cc Timeouts()
-// (unset -> 60, "" -> 0 -> disabled), so the pure-TCP and shm-mixed
-// paths stall out identically.
-double DuplexTimeoutSecs() {
-  static double t = static_cast<double>(
-      EnvInt64("HOROVOD_TPU_DATA_PLANE_TIMEOUT_SECS", 60));
-  return t;
-}
+// progress loops via fault.cc's single parse chain (explicit
+// HOROVOD_TPU_DATA_PLANE_TIMEOUT_SECS wins, else the fault domain's
+// HOROVOD_TPU_PEER_TIMEOUT_S, default 60; 0 disables), so the pure-TCP
+// and shm-mixed paths stall out identically.
+double DuplexTimeoutSecs() { return DuplexTimeoutSeconds(); }
 
 void SetNoDelay(int fd) {
   int one = 1;
@@ -236,8 +235,11 @@ Status Socket::SendRecv(Socket& send_sock, const void* send_buf, size_t send_n,
       // when the send side is paced out, poll only until the KNOWN
       // bucket-refill time so it re-checks exactly then instead of a
       // guessed 5 ms; cap by the configured no-progress bound so a
-      // short bound is enforced promptly, not after a 60 s poll
-      int base_ms = 60000;
+      // short bound is enforced promptly, not after a 60 s poll.  The
+      // 1 s ceiling keeps the fault domain's abort latch checked at
+      // least once a second (a wedged peer's exchange must cancel fast
+      // once the job aborts) at a cost of ~1 wakeup/s.
+      int base_ms = 1000;
       if (limit_s > 0 && limit_s * 1000 < base_ms)
         base_ms = static_cast<int>(limit_s * 1000) + 1;
       int timeout_ms = base_ms;
@@ -279,6 +281,9 @@ Status Socket::SendRecv(Socket& send_sock, const void* send_buf, size_t send_n,
         }
       }
     }
+    if (Aborting())
+      return Status::Error(
+          "job abort in progress — transfer cancelled before completion");
     if (limit_s > 0 &&
         std::chrono::duration<double>(std::chrono::steady_clock::now() -
                                       last_progress)

@@ -174,6 +174,12 @@ void Timeline::RingSegEnd(const char* lane) {
   Push(TimelineRecordType::kEnd, TensorLane(lane), "");
 }
 
+void Timeline::FaultMark(const char* what) {
+  if (!enabled_) return;
+  std::lock_guard<std::mutex> lk(emit_mu_);
+  Push(TimelineRecordType::kInstant, TensorLane("fault"), what);
+}
+
 void Timeline::WriterLoop() {
   FILE* f = fopen(path_.c_str(), "w");
   if (!f) {

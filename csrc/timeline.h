@@ -80,6 +80,11 @@ class Timeline {
   // absence.
   void RingSegStart(const char* lane, const char* stage);
   void RingSegEnd(const char* lane);
+  // Fault-domain instant marks on a fixed "fault" lane: PEER_DEAD when a
+  // peer's death is detected, ABORT when the coordinated abort engages —
+  // next to the op lanes they show exactly which collectives the failure
+  // cut short.
+  void FaultMark(const char* what);
 
  private:
   int64_t TensorLane(const std::string& tensor);

@@ -126,7 +126,7 @@ FrameType FrameTypeOf(const std::string& buf) {
     return FrameType::kInvalid;
   }
   if (type < static_cast<uint16_t>(FrameType::kRequestList) ||
-      type > static_cast<uint16_t>(FrameType::kCachedExec))
+      type > static_cast<uint16_t>(FrameType::kAbort))
     return FrameType::kInvalid;
   return static_cast<FrameType>(type);
 }
@@ -289,6 +289,42 @@ Status Parse(const std::string& buf, CachedExecFrame* out) {
     if (rd.fail) return Status::Error("truncated cached-exec frame");
     out->groups.push_back(std::move(g));
   }
+  return Status::OK();
+}
+
+std::string Serialize(const HeartbeatFrame& f) {
+  std::string s;
+  PutHeader(&s, FrameType::kHeartbeat);
+  PutI32(&s, f.rank);
+  return s;
+}
+
+Status Parse(const std::string& buf, HeartbeatFrame* out) {
+  Reader rd{buf};
+  Status hs = ReadHeader(&rd, FrameType::kHeartbeat);
+  if (!hs.ok()) return hs;
+  out->rank = rd.I32();
+  if (rd.fail) return Status::Error("truncated heartbeat frame");
+  return Status::OK();
+}
+
+std::string Serialize(const AbortFrame& f) {
+  std::string s;
+  PutHeader(&s, FrameType::kAbort);
+  PutI32(&s, f.origin_rank);
+  PutI32(&s, f.dead_rank);
+  PutStr(&s, f.message);
+  return s;
+}
+
+Status Parse(const std::string& buf, AbortFrame* out) {
+  Reader rd{buf};
+  Status hs = ReadHeader(&rd, FrameType::kAbort);
+  if (!hs.ok()) return hs;
+  out->origin_rank = rd.I32();
+  out->dead_rank = rd.I32();
+  out->message = rd.Str();
+  if (rd.fail) return Status::Error("truncated abort frame");
   return Status::OK();
 }
 

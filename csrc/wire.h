@@ -23,9 +23,8 @@ namespace hvdtpu {
 
 // Bump kWireVersion on ANY layout change (header, field order, new frame).
 constexpr uint32_t kWireMagic = 0x48564457u;  // "HVDW" little-endian
-constexpr uint16_t kWireVersion = 4;          // v4: ring segment bytes
-                                              // (bootstrap table +
-                                              // tuned-knob frames)
+constexpr uint16_t kWireVersion = 5;          // v5: fault domain
+                                              // (HEARTBEAT/ABORT frames)
 
 enum class FrameType : uint16_t {
   kInvalid = 0,
@@ -33,6 +32,8 @@ enum class FrameType : uint16_t {
   kResponseList = 2,  // coordinator -> worker: full responses + tuned knobs
   kCacheBits = 3,     // worker -> coordinator: cache-hit bitvector claims
   kCachedExec = 4,    // coordinator -> worker: execute cached slot groups
+  kHeartbeat = 5,     // both ways: idle-tick liveness probe (fault domain)
+  kAbort = 6,         // coordinator -> worker: job-wide coordinated abort
 };
 
 struct Request {
@@ -96,6 +97,26 @@ struct CachedExecFrame {
   int64_t tuned_segment_bytes = -1;
 };
 
+// Idle-tick liveness probe (fault domain): any control frame refreshes the
+// receiver's last-seen clock for the sender, so steady-state traffic IS the
+// heartbeat; this frame only flows on links that sent nothing for a
+// heartbeat interval — the steady-state negotiation bytes/cycle stay
+// untouched.
+struct HeartbeatFrame {
+  int32_t rank = 0;
+};
+
+// Job-wide coordinated abort (coordinator -> workers): broadcast when a
+// peer's death is detected or a stall escalates, so every surviving rank
+// completes its outstanding handles with a descriptive error and exits
+// non-zero inside a bounded time instead of hanging in a collective.
+// ``dead_rank`` is -1 when the cause is not one identifiable peer.
+struct AbortFrame {
+  int32_t origin_rank = 0;  // who initiated the abort
+  int32_t dead_rank = -1;   // presumed-dead rank, when known
+  std::string message;      // human-readable cause, surfaced in handle errors
+};
+
 // Frame dispatch: the type a buffer claims to carry (kInvalid when the
 // buffer is too short or the magic/version doesn't match).
 FrameType FrameTypeOf(const std::string& buf);
@@ -105,9 +126,13 @@ std::string Serialize(const RequestList& l);
 std::string Serialize(const ResponseList& l);
 std::string Serialize(const CacheBitsFrame& f);
 std::string Serialize(const CachedExecFrame& f);
+std::string Serialize(const HeartbeatFrame& f);
+std::string Serialize(const AbortFrame& f);
 Status Parse(const std::string& buf, RequestList* out);
 Status Parse(const std::string& buf, ResponseList* out);
 Status Parse(const std::string& buf, CacheBitsFrame* out);
 Status Parse(const std::string& buf, CachedExecFrame* out);
+Status Parse(const std::string& buf, HeartbeatFrame* out);
+Status Parse(const std::string& buf, AbortFrame* out);
 
 }  // namespace hvdtpu

@@ -1,12 +1,15 @@
-"""``python -m horovod_tpu.run`` — the process launcher.
+"""``python -m horovod_tpu.run`` — the process launcher and supervisor.
 
 Role analog of the reference's launch story (external ``mpirun``,
 ``/root/reference/README.md:164-184``, plus the Spark launcher's process
 management ``/root/reference/horovod/spark/util/safe_shell_exec.py``) —
 except self-contained: no MPI.  It spawns N local worker processes with the
-rank/size/rendezvous environment the native engine bootstraps from, and
-kills the whole process tree if any worker dies or the launcher is
-interrupted (no orphans, no half-dead training jobs).
+rank/size/rendezvous environment the native engine bootstraps from, then
+SUPERVISES them: children are reaped as they exit, the first abnormal exit
+SIGTERMs the rest (SIGKILL after ``--grace-period``), the first failing
+exit code is propagated, and a one-line-per-rank post-mortem (exit cause,
+last heartbeat age, last timeline span) is printed so "which rank died and
+what was it doing" never requires log archaeology.
 
 Usage:
     python -m horovod_tpu.run -np 4 python train.py [args...]
@@ -23,7 +26,9 @@ import os
 import signal
 import subprocess
 import sys
+import time
 
+from horovod_tpu.runtime import fault as _fault
 from horovod_tpu.utils import net
 
 
@@ -82,8 +87,28 @@ def main(argv=None) -> int:
                          "is on the wire while the previous one "
                          "accumulates; 0 restores the monolithic per-step "
                          "ring (bisection)")
+    ap.add_argument("--peer-timeout", type=float, default=None, metavar="S",
+                    help="peer-death detection bound in seconds (sets "
+                         "HOROVOD_TPU_PEER_TIMEOUT_S for every worker; "
+                         "default 60, 0 disables). A rank silent past this "
+                         "bound triggers a job-wide coordinated abort "
+                         "instead of the classic everybody-hangs")
+    ap.add_argument("--grace-period", type=float,
+                    default=float(os.environ.get("HOROVOD_TPU_GRACE_S", 10)),
+                    metavar="S",
+                    help="after the first abnormal worker exit, surviving "
+                         "workers get SIGTERM and this many seconds to "
+                         "finish before SIGKILL (default 10, or "
+                         "HOROVOD_TPU_GRACE_S)")
     ap.add_argument("command", nargs=argparse.REMAINDER)
     args = ap.parse_args(argv)
+
+    # fail fast on a malformed chaos spec: the native injector warns and
+    # ignores, which is exactly wrong for a test that relies on the fault
+    try:
+        _fault.validate_inject_env()
+    except ValueError as e:
+        ap.error(f"bad {_fault.INJECT_ENV}: {e}")
 
     if args.metrics_dir:
         os.makedirs(args.metrics_dir, exist_ok=True)
@@ -123,19 +148,27 @@ def main(argv=None) -> int:
     procs: list[subprocess.Popen] = []
 
     def _kill_all(*_):
+        """SIGTERM every live worker tree, give the grace period, then
+        SIGKILL stragglers — a worker wedged in a dead collective (or one
+        trapping SIGTERM) must not outlive the job."""
         for p in procs:
             if p.poll() is None:
                 try:
                     os.killpg(p.pid, signal.SIGTERM)
                 except ProcessLookupError:
                     pass
+        deadline = time.monotonic() + max(args.grace_period, 0.1)
         for p in procs:
             try:
-                p.wait(timeout=5)
+                p.wait(timeout=max(deadline - time.monotonic(), 0.05))
             except subprocess.TimeoutExpired:
                 try:
                     os.killpg(p.pid, signal.SIGKILL)
                 except ProcessLookupError:
+                    pass
+                try:
+                    p.wait(timeout=5)
+                except subprocess.TimeoutExpired:
                     pass
 
     signal.signal(signal.SIGINT, lambda *a: (_kill_all(), sys.exit(130)))
@@ -166,11 +199,14 @@ def main(argv=None) -> int:
         if args.ring_segment_bytes is not None:
             env["HOROVOD_TPU_RING_SEGMENT_BYTES"] = str(
                 args.ring_segment_bytes)
+        if args.peer_timeout is not None:
+            env["HOROVOD_TPU_PEER_TIMEOUT_S"] = str(args.peer_timeout)
         # each worker leads its own process group so a stuck worker's whole
         # subtree can be killed
         procs.append(subprocess.Popen(cmd, env=env, start_new_session=True))
 
     exit_code = 0
+    failed = False
     remaining = set(range(local_n))
     try:
         while remaining:
@@ -181,20 +217,44 @@ def main(argv=None) -> int:
                 remaining.discard(i)
                 if rc != 0:
                     print(
-                        f"[horovod_tpu.run] rank {first_rank + i} exited "
-                        f"with code {rc}; terminating remaining workers",
+                        f"[horovod_tpu.run] rank {first_rank + i} "
+                        f"{_fault.describe_exit(rc)}; terminating remaining "
+                        f"workers (grace {args.grace_period:g}s)",
                         file=sys.stderr,
                     )
-                    exit_code = rc
+                    exit_code = rc if rc > 0 else 128 - rc
+                    failed = True
+                    # settle window: survivors detecting the same fault are
+                    # mid-abort and about to exit with their own descriptive
+                    # error — give them the grace period to do so before
+                    # SIGTERM truncates it; truly wedged ranks then get the
+                    # TERM->KILL escalation in _kill_all
+                    settle = time.monotonic() + max(args.grace_period, 0.1)
+                    while (time.monotonic() < settle
+                           and any(procs[j].poll() is None
+                                   for j in remaining if j != i)):
+                        time.sleep(0.05)
                     _kill_all()
                     remaining.clear()
                     break
             if remaining:
-                import time
-
                 time.sleep(0.05)
     finally:
         _kill_all()
+        if failed:
+            # one line per local rank: exit cause + whatever telemetry the
+            # job left behind (heartbeat age from the metrics dumps, last
+            # span from the timeline files) — 'n/a' when those were off
+            print("[horovod_tpu.run] post-mortem:", file=sys.stderr)
+            for i in range(local_n):
+                line = _fault.post_mortem_line(
+                    first_rank + i, procs[i].poll() if i < len(procs)
+                    else None,
+                    metrics_dir=args.metrics_dir
+                    or os.environ.get("HOROVOD_TPU_METRICS_DIR"),
+                    timeline_path=args.timeline
+                    or os.environ.get("HOROVOD_TIMELINE"))
+                print(f"[horovod_tpu.run]   {line}", file=sys.stderr)
     return exit_code
 
 

@@ -1,0 +1,226 @@
+"""Python side of the fault domain (``csrc/fault.{h,cc}``).
+
+Three jobs, all launcher/tooling-facing (the detection and abort machinery
+itself lives in the native engine):
+
+* **Injection-spec grammar** — parse/validate ``HOROVOD_TPU_FAULT_INJECT``
+  with the same grammar the C++ injector implements, so ``hvdrun`` and the
+  chaos tests can reject a typo loudly instead of silently not injecting.
+* **Knob accessors** — the peer-timeout / heartbeat / stall-abort values a
+  supervisor needs to size its own grace periods.
+* **Post-mortem helpers** — after a job dies, summarize each rank from
+  whatever evidence exists (exit status, metrics dumps, timeline files)
+  into the one-line-per-rank report ``hvdrun`` prints.
+
+Spec grammar (';'-separated specs, ':'-separated ``key=value`` fields)::
+
+    kill:rank=2:cycle=5            SIGKILL rank 2 at its 5th negotiation tick
+    kill:rank=1:phase=ring         SIGKILL rank 1 entering its 1st ring
+    hang:rank=1:phase=unpack       wedge (sleep forever) instead of dying
+    delay:link=0-1:ms=500          500 ms pause entering each 0<->1 transfer
+
+Phases: ``negotiation`` (default), ``pack``, ``ring``, ``unpack``.
+``cycle`` and ``hit`` are synonyms: the Nth entry of that phase (1-based).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import re
+import signal
+
+PHASES = ("negotiation", "pack", "ring", "unpack")
+
+PEER_TIMEOUT_ENV = "HOROVOD_TPU_PEER_TIMEOUT_S"
+HEARTBEAT_ENV = "HOROVOD_TPU_HEARTBEAT_S"
+STALL_ABORT_ENV = "HOROVOD_TPU_STALL_ABORT_S"
+INJECT_ENV = "HOROVOD_TPU_FAULT_INJECT"
+
+
+def peer_timeout_s() -> float:
+    """Mirror of csrc/fault.cc PeerTimeoutSeconds (default 60, 0 = off)."""
+    try:
+        v = float(os.environ.get(PEER_TIMEOUT_ENV, "") or 60)
+    except ValueError:
+        v = 60.0
+    return max(v, 0.0)
+
+
+def heartbeat_interval_s() -> float:
+    """Mirror of csrc/fault.cc HeartbeatIntervalSeconds."""
+    env = os.environ.get(HEARTBEAT_ENV, "")
+    if env:
+        try:
+            return max(float(env), 0.0)
+        except ValueError:
+            pass
+    pt = peer_timeout_s()
+    return min(5.0, max(pt / 4, 0.05)) if pt > 0 else 5.0
+
+
+def stall_abort_s() -> float:
+    """Mirror of csrc/fault.cc StallAbortSeconds (default 0 = off)."""
+    try:
+        v = float(os.environ.get(STALL_ABORT_ENV, "") or 0)
+    except ValueError:
+        v = 0.0
+    return max(v, 0.0)
+
+
+# ---------------------------------------------------------------------------
+# injection-spec grammar
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class FaultSpec:
+    """One parsed ``HOROVOD_TPU_FAULT_INJECT`` spec."""
+
+    kind: str                 # "kill" | "hang" | "delay"
+    rank: int | None = None   # kill/hang target
+    phase: str = "negotiation"
+    hit: int = 1
+    link: tuple[int, int] | None = None  # delay only
+    ms: int = 0                          # delay only
+
+
+def parse_inject_spec(text: str) -> list[FaultSpec]:
+    """Parse an injection string with the C++ injector's grammar; raises
+    ``ValueError`` with a field-naming message on anything the native
+    parser would ignore-with-a-warning, so launchers can fail fast."""
+    out: list[FaultSpec] = []
+    for one in filter(None, (s.strip() for s in text.split(";"))):
+        kind, _, body = one.partition(":")
+        if kind not in ("kill", "hang", "delay"):
+            raise ValueError(f"unknown fault type {kind!r} in {one!r} "
+                             "(want kill/hang/delay)")
+        spec = FaultSpec(kind=kind)
+        for field in filter(None, body.split(":")):
+            key, eq, val = field.partition("=")
+            if not eq:
+                raise ValueError(f"field {field!r} in {one!r} lacks '='")
+            if key == "rank":
+                spec.rank = int(val)
+            elif key == "phase":
+                if val not in PHASES:
+                    raise ValueError(
+                        f"unknown phase {val!r} in {one!r} (want one of "
+                        f"{'/'.join(PHASES)})")
+                spec.phase = val
+            elif key in ("cycle", "hit"):
+                spec.hit = max(int(val), 1)
+            elif key == "ms":
+                spec.ms = int(val)
+            elif key == "link":
+                m = re.fullmatch(r"(\d+)-(\d+)", val)
+                if not m:
+                    raise ValueError(
+                        f"link wants 'A-B' ranks in {one!r}, got {val!r}")
+                spec.link = (int(m.group(1)), int(m.group(2)))
+            else:
+                raise ValueError(f"unknown field {key!r} in {one!r}")
+        if kind in ("kill", "hang") and spec.rank is None:
+            raise ValueError(f"{one!r} lacks rank=")
+        if kind == "delay" and (spec.link is None or spec.ms <= 0):
+            raise ValueError(f"{one!r} wants link=A-B and ms=N")
+        out.append(spec)
+    return out
+
+
+def validate_inject_env(environ=os.environ) -> list[FaultSpec]:
+    """Validate ``HOROVOD_TPU_FAULT_INJECT`` from the environment (empty
+    list when unset); raises ``ValueError`` on a malformed spec."""
+    text = environ.get(INJECT_ENV, "")
+    return parse_inject_spec(text) if text else []
+
+
+# ---------------------------------------------------------------------------
+# post-mortem
+# ---------------------------------------------------------------------------
+
+def describe_exit(returncode: int | None) -> str:
+    """Human cause for a Popen returncode (negative = killed by signal)."""
+    if returncode is None:
+        return "still running"
+    if returncode == 0:
+        return "exit 0"
+    if returncode < 0:
+        try:
+            name = signal.Signals(-returncode).name
+        except ValueError:
+            name = f"signal {-returncode}"
+        return f"killed by {name}"
+    return f"exit {returncode}"
+
+
+def _last_metrics(metrics_dir: str | None, rank: int) -> dict | None:
+    """The rank's final metrics dump, if the job ran with a metrics dir."""
+    if not metrics_dir:
+        return None
+    path = os.path.join(metrics_dir, f"metrics.rank{rank}.json")
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def heartbeat_age_from_metrics(metrics_dir: str | None,
+                               rank: int) -> float | None:
+    """Last exported ``hvd_heartbeat_age_s`` for a rank, or None."""
+    dump = _last_metrics(metrics_dir, rank)
+    if not dump:
+        return None
+    for m in dump.get("metrics", []):
+        if m.get("name") == "hvd_heartbeat_age_s":
+            try:
+                return float(m.get("value"))
+            except (TypeError, ValueError):
+                return None
+    return None
+
+
+_SPAN_RE = re.compile(r'"name"\s*:\s*"([^"]+)"\s*,\s*"ph"\s*:\s*"[BX]"')
+
+
+def last_timeline_span(timeline_path: str | None,
+                       rank: int) -> str | None:
+    """Last span name a rank's timeline recorded before death.  A killed
+    rank leaves an unterminated JSON array, so this scans text rather than
+    parsing; rank 0 owns the native-engine file, other ranks may have
+    ``.pyrank<r>`` files from the Python-path writer."""
+    if not timeline_path:
+        return None
+    candidates = [timeline_path + f".pyrank{rank}"]
+    if rank == 0:
+        candidates.append(timeline_path)
+    for path in candidates:
+        try:
+            with open(path) as f:
+                # the tail holds the last spans; 64 KB is plenty
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                f.seek(max(size - 65536, 0))
+                tail = f.read()
+        except OSError:
+            continue
+        names = [n for n in _SPAN_RE.findall(tail)
+                 if n != "thread_name"]
+        if names:
+            return names[-1]
+    return None
+
+
+def post_mortem_line(rank: int, returncode: int | None,
+                     metrics_dir: str | None = None,
+                     timeline_path: str | None = None) -> str:
+    """One supervision report line for a rank: exit cause, last exported
+    heartbeat age, last timeline span — 'n/a' where the job ran without
+    that telemetry."""
+    age = heartbeat_age_from_metrics(metrics_dir, rank)
+    span = last_timeline_span(timeline_path, rank)
+    return (f"rank {rank}: {describe_exit(returncode)}, "
+            f"heartbeat_age={age if age is not None else 'n/a'}"
+            f"{'s' if age is not None else ''}, "
+            f"last_span={span or 'n/a'}")

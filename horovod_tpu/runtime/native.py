@@ -186,6 +186,16 @@ def _bind(lib):
         lib.hvd_ring_stats.restype = None
     except AttributeError:
         pass
+    try:
+        # fault-domain stats + wire probes (PR 5); same prebuilt-.so caveat
+        lib.hvd_fault_stats.argtypes = [ctypes.POINTER(ctypes.c_int64)]
+        lib.hvd_fault_stats.restype = None
+        lib.hvd_wire_version.restype = ctypes.c_int
+        lib.hvd_frame_parse_error.argtypes = [ctypes.c_void_p,
+                                              ctypes.c_int64]
+        lib.hvd_frame_parse_error.restype = ctypes.c_void_p  # manual free
+    except AttributeError:
+        pass
     return lib
 
 
@@ -257,6 +267,32 @@ class NativeEngine(Engine):
         d.update(self._cache_stats())
         d.update(self._pipeline_stats())
         d.update(self._ring_stats())
+        d.update(self._fault_stats())
+        return d
+
+    def _fault_stats(self) -> dict:
+        """Fault-domain counters.  ``heartbeat_age_s`` is the oldest
+        control-plane silence this rank observes (rank 0: worst worker;
+        workers: the coordinator) — near 0 under steady traffic, and a
+        value approaching ``peer_timeout_s`` is a detection in progress.
+        The counters are process-wide (they survive engine re-init).
+        Zeros when the loaded .so predates the fault domain."""
+        fn = getattr(self._lib, "hvd_fault_stats", None)
+        keys = ("heartbeat_age_ms", "peer_timeout_ms", "peer_timeouts",
+                "aborts", "abort_latency_ns", "heartbeats_tx",
+                "heartbeats_rx")
+        if fn is None:
+            d = dict.fromkeys(keys, 0)
+            age_ms = 0
+        else:
+            vals = (ctypes.c_int64 * 8)()
+            fn(vals)
+            d = {k: max(int(v), 0) for k, v in zip(keys, vals)}
+            age_ms = int(vals[0])  # -1 = engine down: NOT a healthy 0
+        d.pop("heartbeat_age_ms")
+        d["heartbeat_age_s"] = (round(age_ms / 1000.0, 3)
+                                if age_ms >= 0 else -1.0)
+        d["peer_timeout_s"] = round(d.pop("peer_timeout_ms") / 1000.0, 3)
         return d
 
     def _ring_stats(self) -> dict:
@@ -348,7 +384,9 @@ class NativeEngine(Engine):
         # behind the previous engine's totals
         last_seen = {"stall_events": 0, "cache_hits": 0, "cache_misses": 0,
                      "cache_evictions": 0, "negotiation_bytes": 0,
-                     "ring_segments": 0, "ring_bytes": 0}
+                     "ring_segments": 0, "ring_bytes": 0,
+                     "peer_timeouts": 0, "aborts": 0, "heartbeats_tx": 0,
+                     "heartbeats_rx": 0}
         cumulative = (
             ("stall_events", telemetry.NATIVE_STALL_EVENTS),
             ("cache_hits", telemetry.NATIVE_CACHE_HITS),
@@ -357,7 +395,23 @@ class NativeEngine(Engine):
             ("negotiation_bytes", telemetry.NATIVE_NEGOTIATION_BYTES),
             ("ring_segments", telemetry.NATIVE_RING_SEGMENTS),
             ("ring_bytes", telemetry.NATIVE_RING_BYTES),
+            ("peer_timeouts", telemetry.NATIVE_PEER_TIMEOUTS),
+            ("aborts", telemetry.NATIVE_ABORTS),
+            ("heartbeats_tx", telemetry.NATIVE_HEARTBEATS_TX),
+            ("heartbeats_rx", telemetry.NATIVE_HEARTBEATS_RX),
         )
+        # the FAULT counters are process-wide by design (fault.h: they
+        # survive engine re-init like the registry does) — seed their
+        # last-seen from the CURRENT values so a second init() in this
+        # process doesn't re-mirror the first engine's whole history
+        fault_now = self._fault_stats()
+        for k in ("peer_timeouts", "aborts", "heartbeats_tx",
+                  "heartbeats_rx"):
+            last_seen[k] = fault_now[k]
+        # abort latency: each collection observes the window's mean
+        # detect->handles-failed latency (cumulative ns / cumulative count
+        # deltas), same scheme as the pipeline stage histograms
+        abort_seen = [fault_now["abort_latency_ns"], fault_now["aborts"]]
         # per-stage cumulative (ns, item count) at last collection: each
         # collection observes the mean per-item stage latency of the
         # window into the stage histogram
@@ -386,6 +440,9 @@ class NativeEngine(Engine):
                 d["ring_wire_idle_fraction"])
             reg.gauge(telemetry.NATIVE_RING_SEGMENT_BYTES).set(
                 d["ring_segment_bytes"])
+            if d["heartbeat_age_s"] >= 0:  # -1 = engine down: keep the
+                reg.gauge(telemetry.NATIVE_HEARTBEAT_AGE).set(  # last real age
+                    d["heartbeat_age_s"])
             with mirror_lock:
                 for key, metric in cumulative:
                     delta = d[key] - last_seen[key]
@@ -401,6 +458,13 @@ class NativeEngine(Engine):
                             stage=stage,
                         ).observe(dns / dn / 1e9)
                         stage_seen[stage] = (d[ns_key], d[n_key])
+                dns = d["abort_latency_ns"] - abort_seen[0]
+                dn = d["aborts"] - abort_seen[1]
+                if dn > 0 and dns >= 0:
+                    reg.histogram(telemetry.NATIVE_ABORT_LATENCY).observe(
+                        dns / dn / 1e9)
+                    abort_seen[0] = d["abort_latency_ns"]
+                    abort_seen[1] = d["aborts"]
 
         self._diagnostics_collector = collect
         reg.register_collector(collect)

@@ -13,8 +13,7 @@ from __future__ import annotations
 
 # csrc/wire.h — frame header
 WIRE_MAGIC = 0x48564457  # "HVDW" little-endian
-WIRE_VERSION = 4         # v4: ring segment bytes (bootstrap table +
-                         # tuned frames)
+WIRE_VERSION = 5         # v5: fault domain (HEARTBEAT/ABORT frames)
 
 # csrc/wire.h — FrameType
 FRAME_INVALID = 0
@@ -22,6 +21,8 @@ FRAME_REQUEST_LIST = 1
 FRAME_RESPONSE_LIST = 2
 FRAME_CACHE_BITS = 3
 FRAME_CACHED_EXEC = 4
+FRAME_HEARTBEAT = 5
+FRAME_ABORT = 6
 
 FRAME_TYPES = {
     "kInvalid": FRAME_INVALID,
@@ -29,7 +30,20 @@ FRAME_TYPES = {
     "kResponseList": FRAME_RESPONSE_LIST,
     "kCacheBits": FRAME_CACHE_BITS,
     "kCachedExec": FRAME_CACHED_EXEC,
+    "kHeartbeat": FRAME_HEARTBEAT,
+    "kAbort": FRAME_ABORT,
 }
+
+
+def frame_header(version: int = WIRE_VERSION,
+                 frame_type: int = FRAME_REQUEST_LIST) -> bytes:
+    """The 8-byte control-frame header {magic, version, type} as the wire
+    carries it (little-endian) — lets tests and tools build probe frames
+    (e.g. a stale-version header for the mismatch-message test) without
+    loading the .so."""
+    import struct
+
+    return struct.pack("<IHH", WIRE_MAGIC, version, frame_type)
 
 # csrc/common.h — OpType (the request/response op codes on the wire)
 OP_ALLREDUCE = 0

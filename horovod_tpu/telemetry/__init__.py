@@ -78,6 +78,17 @@ NATIVE_RING_WIRE_IDLE = "hvd_ring_wire_idle_fraction"
 NATIVE_RING_SEGMENT_BYTES = "hvd_ring_segment_bytes"
 NATIVE_RING_SEGMENTS = "hvd_ring_segments_total"
 NATIVE_RING_BYTES = "hvd_ring_bytes_total"
+# fault domain (csrc peer-death detection + coordinated abort, PR 5):
+# heartbeat age is the oldest control-plane silence this rank observes
+# (an age approaching hvd_peer_timeout IS a detection in progress); the
+# counters cover detections, aborts, and the idle-tick heartbeat frames;
+# the latency histogram is detect -> local handles failed
+NATIVE_HEARTBEAT_AGE = "hvd_heartbeat_age_s"
+NATIVE_PEER_TIMEOUTS = "hvd_peer_timeouts_total"
+NATIVE_ABORTS = "hvd_aborts_total"
+NATIVE_ABORT_LATENCY = "hvd_abort_latency_seconds"
+NATIVE_HEARTBEATS_TX = "hvd_heartbeats_tx_total"
+NATIVE_HEARTBEATS_RX = "hvd_heartbeats_rx_total"
 
 _TRUTHY = ("1", "true", "yes", "on")
 
@@ -324,4 +335,6 @@ __all__ = [
     "NATIVE_PIPELINE_DEPTH", "NATIVE_PIPELINE_STAGE_SECONDS",
     "NATIVE_RING_WIRE_IDLE", "NATIVE_RING_SEGMENT_BYTES",
     "NATIVE_RING_SEGMENTS", "NATIVE_RING_BYTES",
+    "NATIVE_HEARTBEAT_AGE", "NATIVE_PEER_TIMEOUTS", "NATIVE_ABORTS",
+    "NATIVE_ABORT_LATENCY", "NATIVE_HEARTBEATS_TX", "NATIVE_HEARTBEATS_RX",
 ]

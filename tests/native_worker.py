@@ -681,6 +681,16 @@ def scenario_ring_equiv():
     ]
     for h in handles:
         chunks.append(np.ascontiguousarray(hvd.synchronize(h)))
+    # standalone allgather through the (maybe) segment-windowed exchange:
+    # variable rank-dependent first dims make the member blocks unequal,
+    # straddling the segment size (PR 5 satellite: allgather gets the same
+    # (step, segment) sliding window as the allreduce ring — byte moves
+    # only, so mono vs segmented must be bitwise identical)
+    for i, rows in enumerate((1, 29, 4097)):
+        arr = (rng.standard_normal((rows * (r + 1), 3)) * (r + 1)).astype(
+            np.float64)
+        chunks.append(np.ascontiguousarray(
+            hvd.allgather(arr, name=f"reg{i}")))
     expect = os.environ.get("HVD_TEST_EXPECT_SEGMENTED")
     if expect is not None:
         d = _diag()
@@ -734,6 +744,65 @@ def scenario_crash():
     import time
 
     time.sleep(30)  # must be killed by the launcher, not run to completion
+
+
+def scenario_fault_loop():
+    """Chaos-test workload: a steady fused-allreduce stream that would run
+    ~forever, under HOROVOD_TPU_FAULT_INJECT set by the test.  When the
+    injected death/hang is detected, every SURVIVOR's synchronize raises
+    with the engine's abort/peer-dead message — printed and converted to
+    exit 7 so the test can assert both the code and the rank-naming text.
+    HVD_TEST_ELEMS sizes the tensors (big => the kill lands mid-ring)."""
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    elems = int(os.environ.get("HVD_TEST_ELEMS", "4096"))
+    data = [np.full(elems, float(r + i), np.float32) for i in range(4)]
+    try:
+        for step in range(5000):
+            hs = [hvd.allreduce_async(data[i], average=False,
+                                      name=f"fl.g{i}")
+                  for i in range(4)]
+            for h in hs:
+                hvd.synchronize(h)
+    except RuntimeError as e:
+        print(f"rank {r}: FAULT: {e}", flush=True)
+        sys.exit(7)
+    print(f"rank {r}: fault loop ran dry with no fault", flush=True)
+
+
+def scenario_fault_idle():
+    """Chaos-test workload with an IDLE victim: rank 0 submits steadily
+    while the last rank naps between ops — detection must ride the
+    idle-tick heartbeats, not just collective traffic."""
+    import time
+
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    try:
+        for step in range(2000):
+            out = hvd.allreduce(np.full(64, float(r), np.float32),
+                                average=False, name="fi")
+            assert out is not None
+    except RuntimeError as e:
+        print(f"rank {r}: FAULT: {e}", flush=True)
+        sys.exit(7)
+    print(f"rank {r}: fault idle ran dry with no fault", flush=True)
+
+
+def scenario_fault_sigterm_stuck():
+    """Supervision test: rank 0 fails fast; the others trap SIGTERM and
+    refuse to die, so only the launcher's grace-then-SIGKILL escalation
+    can reap them."""
+    import signal as _signal
+    import time
+
+    r = int(os.environ["HOROVOD_TPU_RANK"])
+    if r == 0:
+        time.sleep(1.0)
+        sys.exit(3)
+    _signal.signal(_signal.SIGTERM, _signal.SIG_IGN)
+    print(f"rank {r}: ignoring SIGTERM", flush=True)
+    time.sleep(120)  # must be SIGKILLed by the launcher's grace escalation
 
 
 if __name__ == "__main__":

@@ -86,6 +86,47 @@ def test_ctrl_bytes_per_round_gate():
     assert code == 0, rows
 
 
+def test_heartbeat_overhead_gate():
+    """Fault-domain steady-state overhead (BENCH_r09) vs the pre-fault
+    control-plane artifact (BENCH_r06) at 1%: heartbeats piggyback on real
+    negotiation traffic, so arming the fault domain must add NO bytes to a
+    steady-state round — explicit HEARTBEAT frames may only flow on idle
+    links.  Artifact-vs-artifact keeps the comparison deterministic (the
+    pinned-batching floor still moves ~15% run-to-run on this host, so a
+    fresh measurement cannot carry a 1% band; the fresh 10% guard above
+    already runs the heartbeat-armed code path live)."""
+    old = _baseline("BENCH_r06.json")
+    r09 = _baseline("BENCH_r09.json")
+    hb = r09.get("heartbeat_overhead", {})
+    assert hb.get("ctrl_bytes_per_round_worker"), r09
+    new = {"np4": {"cache_on": {
+        "ctrl_bytes_per_round_worker": hb["ctrl_bytes_per_round_worker"]}}}
+    rows, code = bench_compare.compare(
+        old, new, ["np4.cache_on.ctrl_bytes_per_round_worker:lower"],
+        max_regression_pct=1.0)
+    assert code == 0, rows
+
+
+def test_fault_bench_detection_bounded():
+    """The r09 chaos points must show the fault domain WORKING: every
+    injected death/hang ended with a non-zero job exit, and the worst
+    detection->all-exited latency stayed within the configured peer
+    timeout + grace + margin (the no-hang contract, as measured)."""
+    r09 = _baseline("BENCH_r09.json")
+    bound = r09["config"]["peer_timeout_s"] + r09["config"]["grace_s"] + 5
+    points = 0
+    for np_key in ("np2", "np4"):
+        for label, p in r09.get(np_key, {}).items():
+            if not isinstance(p, dict) or "exit_code" not in p:
+                continue
+            points += 1
+            assert p["exit_code"] != 0, (np_key, label, p)
+            assert p["survivors_faulted"] >= 1, (np_key, label, p)
+            lat = p["detect_to_all_exited_s"]
+            assert lat is not None and lat < bound, (np_key, label, p)
+    assert points >= 10, f"only {points} chaos points in BENCH_r09"
+
+
 def test_ring_counted_series_gate():
     """Fresh segmented ring at the BENCH_r08 workload (-np 2, shm,
     256 KB segments) vs the artifact: segments/ring and KB/ring are

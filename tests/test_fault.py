@@ -1,0 +1,288 @@
+"""Fault-domain chaos suite: SIGKILL/hang a rank at injected engine phases
+and assert the job DIES WELL — every survivor exits non-zero with an error
+naming the dead rank, inside the detection bound, and ``hvdrun`` reaps the
+world and propagates a failing code.  This is the test the reference system
+cannot have (MPI owns its transport): the classic failure mode is every
+surviving rank parked in a collective forever.
+
+Driven by ``HOROVOD_TPU_FAULT_INJECT`` (csrc/fault.cc) through the
+``fault_loop`` worker scenario; detection knobs are pinned small so tier-1
+stays fast.  Long variants (TCP leg, np4, unpack phase) ride the slow lane.
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from conftest import native_so_status
+from horovod_tpu.runtime import fault as fault_mod
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "native_worker.py")
+
+_SO_SKIP = native_so_status()
+pytestmark = pytest.mark.skipif(_SO_SKIP is not None,
+                                reason=_SO_SKIP or "native .so ready")
+
+# every chaos run pins the detection bound; survivors must be OUT well
+# inside this wall (detection + drain + grace), jax import time included
+PEER_TIMEOUT_S = 8
+EXIT_WALL_S = 90
+
+
+def _run_chaos(scenario: str, np_: int, inject: str, extra_env=None,
+               grace: float = 3.0, timeout: float = EXIT_WALL_S + 30):
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "HOROVOD_TPU_FAULT_INJECT": inject,
+        "HOROVOD_TPU_PEER_TIMEOUT_S": str(PEER_TIMEOUT_S),
+    })
+    env.update(extra_env or {})
+    t0 = time.monotonic()
+    res = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.run", "-np", str(np_),
+         "--grace-period", str(grace),
+         sys.executable, WORKER, scenario],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=timeout,
+    )
+    res.elapsed = time.monotonic() - t0
+    return res
+
+
+def _assert_died_well(res, dead_rank: int, np_: int, needle: str = None):
+    """The acceptance shape: hvdrun non-zero, no hang (bounded wall), every
+    SURVIVOR printed a FAULT line whose message names the dead rank (or the
+    supplied needle), and the post-mortem identifies the death."""
+    assert res.returncode != 0, res.stdout + res.stderr
+    assert res.elapsed < EXIT_WALL_S, (
+        f"took {res.elapsed:.0f}s — detection bound not honored")
+    needle = needle or f"rank {dead_rank}"
+    survivors = [r for r in range(np_) if r != dead_rank]
+    faulted = [r for r in survivors
+               if f"rank {r}: FAULT:" in res.stdout]
+    # survivors the launcher reaped before their own exit are acceptable,
+    # but at least one must have surfaced the descriptive error, and every
+    # FAULT line must name the culprit
+    assert faulted, res.stdout + res.stderr
+    for line in res.stdout.splitlines():
+        if ": FAULT:" in line:
+            assert needle in line, line
+    assert "post-mortem" in res.stderr, res.stderr
+    assert "fault loop ran dry" not in res.stdout, "injection never fired"
+
+
+# ---------------------------------------------------------------------------
+# kill at each injected point
+# ---------------------------------------------------------------------------
+
+def test_kill_at_negotiation():
+    res = _run_chaos("fault_loop", 3, "kill:rank=1:cycle=15")
+    _assert_died_well(res, dead_rank=1, np_=3)
+    assert "SIGKILL rank 1 at negotiation" in res.stderr
+
+
+def test_kill_mid_ring_shm():
+    """Death inside the segmented ring over the shm data plane: survivors
+    are parked on rings a dead peer will never service; the control-plane
+    detection + abort latch must cancel them."""
+    res = _run_chaos("fault_loop", 2, "kill:rank=1:phase=ring:hit=8",
+                     extra_env={"HVD_TEST_ELEMS": "2000000"})
+    _assert_died_well(res, dead_rank=1, np_=2)
+
+
+def test_kill_mid_ring_tcp():
+    """Same death over plain TCP (HOROVOD_TPU_SHM=0): the peer socket
+    resets, so the wire error itself names the dead neighbor."""
+    res = _run_chaos("fault_loop", 2, "kill:rank=1:phase=ring:hit=8",
+                     extra_env={"HVD_TEST_ELEMS": "2000000",
+                                "HOROVOD_TPU_SHM": "0"})
+    _assert_died_well(res, dead_rank=1, np_=2)
+
+
+def test_kill_at_pack():
+    res = _run_chaos("fault_loop", 2, "kill:rank=1:phase=pack:hit=6")
+    _assert_died_well(res, dead_rank=1, np_=2)
+
+
+def test_coordinator_death():
+    """Rank 0 dies mid-ring: workers must self-abort via the lost-
+    coordinator path (socket reset or heartbeat age), not hang."""
+    res = _run_chaos("fault_loop", 3, "kill:rank=0:phase=ring:hit=8",
+                     extra_env={"HVD_TEST_ELEMS": "2000000"})
+    assert res.returncode != 0, res.stdout + res.stderr
+    assert res.elapsed < EXIT_WALL_S
+    assert "FAULT:" in res.stdout, res.stdout + res.stderr
+    for line in res.stdout.splitlines():
+        if ": FAULT:" in line:
+            assert "rank 0" in line, line
+
+
+@pytest.mark.slow  # 4-proc chaos on a 2-core box
+def test_kill_mid_ring_np4():
+    res = _run_chaos("fault_loop", 4, "kill:rank=2:phase=ring:hit=8",
+                     extra_env={"HVD_TEST_ELEMS": "1000000"})
+    _assert_died_well(res, dead_rank=2, np_=4)
+
+
+@pytest.mark.slow
+def test_kill_at_unpack():
+    res = _run_chaos("fault_loop", 2, "kill:rank=1:phase=unpack:hit=6")
+    _assert_died_well(res, dead_rank=1, np_=2)
+
+
+# ---------------------------------------------------------------------------
+# hang (process alive, engine wedged) — heartbeat + stall escalation
+# ---------------------------------------------------------------------------
+
+def test_hang_detected_by_heartbeat_timeout():
+    """A wedged-but-alive rank sends no frames: only the heartbeat age can
+    catch it (its sockets never close).  Survivors must exit non-zero with
+    the peer-timeout message naming the rank."""
+    res = _run_chaos("fault_loop", 3, "hang:rank=1:cycle=15")
+    _assert_died_well(res, dead_rank=1, np_=3)
+    assert "sent no control frames" in res.stdout, res.stdout
+
+
+def test_hang_escalates_via_stall_abort():
+    """Detection off (HOROVOD_TPU_PEER_TIMEOUT_S=0): the stall watchdog's
+    escalation tier (HOROVOD_TPU_STALL_ABORT_S) must convert the
+    persistent stall into the same coordinated abort."""
+    res = _run_chaos(
+        "fault_loop", 3, "hang:rank=1:cycle=15",
+        extra_env={"HOROVOD_TPU_PEER_TIMEOUT_S": "0",
+                   "HOROVOD_TPU_STALL_ABORT_S": "3",
+                   "HOROVOD_TPU_STALL_WARNING_SECS": "1"})
+    assert res.returncode != 0, res.stdout + res.stderr
+    assert res.elapsed < EXIT_WALL_S
+    assert "HOROVOD_TPU_STALL_ABORT_S" in res.stdout, (
+        res.stdout + res.stderr)
+    assert "post-mortem" in res.stderr
+
+
+# ---------------------------------------------------------------------------
+# delay injection (link latency, not death): must NOT abort
+# ---------------------------------------------------------------------------
+
+def test_delay_injection_slows_but_completes():
+    """A 30 ms injected link latency is chaos the job must SURVIVE: no
+    abort, exit 0 — the injector's delay spec models slow links, and the
+    detection machinery must not false-positive on them."""
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu",
+                "HOROVOD_TPU_FAULT_INJECT": "delay:link=0-1:ms=30",
+                "HOROVOD_TPU_PEER_TIMEOUT_S": str(PEER_TIMEOUT_S)})
+    res = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.run", "-np", "2",
+         sys.executable, WORKER, "collectives"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=180)
+    assert res.returncode == 0, res.stderr + res.stdout
+    for r in range(2):
+        assert f"rank {r}: collectives OK" in res.stdout
+
+
+# ---------------------------------------------------------------------------
+# hvdrun supervision: exit-code propagation, grace kill, post-mortem
+# ---------------------------------------------------------------------------
+
+def test_hvdrun_propagates_first_failing_code():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    t0 = time.monotonic()
+    res = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.run", "-np", "3",
+         "--grace-period", "2",
+         sys.executable, WORKER, "crash"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=120)
+    assert res.returncode == 3, (res.returncode, res.stderr)
+    assert time.monotonic() - t0 < 60
+    assert "exit 3" in res.stderr, res.stderr
+    assert "post-mortem" in res.stderr, res.stderr
+
+
+def test_hvdrun_grace_kill_sigterm_immune_worker():
+    """A worker trapping SIGTERM must be SIGKILLed after the grace period,
+    and the post-mortem must show both the failing exit and the kill."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    t0 = time.monotonic()
+    res = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.run", "-np", "3",
+         "--grace-period", "2",
+         sys.executable, WORKER, "fault_sigterm_stuck"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=120)
+    elapsed = time.monotonic() - t0
+    assert res.returncode == 3, (res.returncode, res.stderr)
+    # 2 s grace + margin, NOT the stuck worker's 120 s nap
+    assert elapsed < 60, f"grace escalation took {elapsed:.0f}s"
+    assert "rank 0: exit 3" in res.stderr, res.stderr
+    assert "killed by SIGKILL" in res.stderr, res.stderr
+
+
+def test_hvdrun_rejects_malformed_inject_spec():
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               HOROVOD_TPU_FAULT_INJECT="kill:rank=notanumber:bogus")
+    res = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.run", "-np", "1",
+         sys.executable, "-c", "print('should not run')"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=60)
+    assert res.returncode != 0
+    assert "HOROVOD_TPU_FAULT_INJECT" in res.stderr, res.stderr
+    assert "should not run" not in res.stdout
+
+
+# ---------------------------------------------------------------------------
+# spec grammar + post-mortem helpers (pure python, no .so needed)
+# ---------------------------------------------------------------------------
+
+def test_inject_spec_grammar():
+    specs = fault_mod.parse_inject_spec(
+        "kill:rank=2:cycle=5;hang:rank=1:phase=ring;delay:link=0-1:ms=500")
+    assert [s.kind for s in specs] == ["kill", "hang", "delay"]
+    assert specs[0].rank == 2 and specs[0].hit == 5
+    assert specs[0].phase == "negotiation"  # default
+    assert specs[1].phase == "ring"
+    assert specs[2].link == (0, 1) and specs[2].ms == 500
+    for bad in ("explode:rank=1", "kill:cycle=5", "kill:rank=1:phase=nope",
+                "delay:link=0:ms=5", "delay:link=0-1", "kill:rank"):
+        with pytest.raises(ValueError):
+            fault_mod.parse_inject_spec(bad)
+
+
+def test_post_mortem_line_formats(tmp_path):
+    assert fault_mod.describe_exit(0) == "exit 0"
+    assert fault_mod.describe_exit(7) == "exit 7"
+    assert fault_mod.describe_exit(-9) == "killed by SIGKILL"
+    # metrics dump feeding the heartbeat age
+    md = tmp_path / "m"
+    md.mkdir()
+    (md / "metrics.rank1.json").write_text(
+        '{"metrics": [{"name": "hvd_heartbeat_age_s", "value": 4.2}]}')
+    line = fault_mod.post_mortem_line(1, -9, metrics_dir=str(md))
+    assert "killed by SIGKILL" in line and "heartbeat_age=4.2" in line
+    # truncated timeline (a killed rank leaves unterminated JSON)
+    tl = tmp_path / "tl.json"
+    tl.write_text('[\n{"name":"thread_name","ph":"M","pid":0,"tid":0,'
+                  '"args":{"name":"cycles"}},\n'
+                  '{"name":"RING_ALLREDUCE","ph":"B","pid":0,"tid":3,'
+                  '"ts":12}')
+    line = fault_mod.post_mortem_line(0, 1, timeline_path=str(tl))
+    assert "last_span=RING_ALLREDUCE" in line, line
+
+
+def test_fault_stats_api_shape():
+    """hvd_fault_stats: engine down reports age -1 and the configured
+    timeout; counters are process-wide and well-formed."""
+    import ctypes
+
+    from horovod_tpu.runtime.native import lib_path
+
+    lib = ctypes.CDLL(lib_path())
+    lib.hvd_fault_stats.argtypes = [ctypes.POINTER(ctypes.c_int64)]
+    lib.hvd_fault_stats.restype = None
+    vals = (ctypes.c_int64 * 8)()
+    lib.hvd_fault_stats(vals)
+    assert vals[0] == -1            # no engine: no heartbeat age
+    assert vals[1] == 60 * 1000     # default peer timeout, ms
+    assert all(int(v) >= 0 for v in list(vals)[2:]), list(vals)

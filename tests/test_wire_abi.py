@@ -34,7 +34,7 @@ def test_checker_detects_version_drift():
     """The guard must actually bite: a simulated version bump in wire.h
     without a Python update is reported."""
     wire_h, common_h = _headers()
-    tampered = wire_h.replace("kWireVersion = 4", "kWireVersion = 5")
+    tampered = wire_h.replace("kWireVersion = 5", "kWireVersion = 6")
     assert tampered != wire_h, "kWireVersion moved; update this test"
     problems = check_wire_abi.check(tampered, common_h)
     assert any("kWireVersion" in p for p in problems), problems
@@ -42,7 +42,73 @@ def test_checker_detects_version_drift():
 
 def test_checker_detects_new_frame_type():
     wire_h, common_h = _headers()
-    tampered = wire_h.replace("kCachedExec = 4,",
-                              "kCachedExec = 4,\n  kNewFrame = 5,")
+    tampered = wire_h.replace("kAbort = 6,",
+                              "kAbort = 6,\n  kNewFrame = 7,")
     problems = check_wire_abi.check(tampered, common_h)
     assert any("FrameType" in p for p in problems), problems
+
+
+def test_v5_fault_frames_present():
+    """The fault domain's wire v5 collateral: HEARTBEAT/ABORT frame types
+    exist on both sides of the mirror at the pinned ids."""
+    from horovod_tpu.runtime import wire_abi
+
+    assert wire_abi.WIRE_VERSION == 5
+    assert wire_abi.FRAME_TYPES["kHeartbeat"] == wire_abi.FRAME_HEARTBEAT == 5
+    assert wire_abi.FRAME_TYPES["kAbort"] == wire_abi.FRAME_ABORT == 6
+    wire_h, _ = _headers()
+    assert "kHeartbeat = 5" in wire_h and "kAbort = 6" in wire_h
+
+
+def test_version_mismatch_message_names_both_versions():
+    """A v4 frame hitting a v5 engine must produce the descriptive
+    both-versions error — the operator-facing contract for a mixed .so
+    deployment — via the native parse probe.  Skips (not fails) when the
+    .so predates the probe."""
+    import ctypes
+
+    import pytest
+
+    from conftest import native_so_status
+    from horovod_tpu.runtime import wire_abi
+
+    if native_so_status() is not None:
+        pytest.skip(native_so_status())
+    from horovod_tpu.runtime.native import lib_path
+
+    lib = ctypes.CDLL(lib_path())
+    if not hasattr(lib, "hvd_frame_parse_error"):
+        pytest.skip("loaded .so predates hvd_frame_parse_error")
+    lib.hvd_frame_parse_error.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+    lib.hvd_frame_parse_error.restype = ctypes.c_void_p
+    lib.hvd_free_cstr.argtypes = [ctypes.c_void_p]
+    lib.hvd_wire_version.restype = ctypes.c_int
+
+    assert lib.hvd_wire_version() == wire_abi.WIRE_VERSION == 5
+
+    def parse_error(buf: bytes) -> str | None:
+        p = lib.hvd_frame_parse_error(buf, len(buf))
+        if not p:
+            return None
+        try:
+            return ctypes.cast(p, ctypes.c_char_p).value.decode()
+        finally:
+            lib.hvd_free_cstr(p)
+
+    # stale v4 header (old .so still running somewhere): both versions named
+    stale = wire_abi.frame_header(version=4) + b"\x00" * 16
+    msg = parse_error(stale)
+    assert msg is not None
+    assert "v4" in msg and "v5" in msg and "libhvdtpu.so" in msg, msg
+
+    # current-version garbage is a parse error, not a version error
+    import struct
+
+    bad = wire_abi.frame_header() + struct.pack("<iq", 0, -1)  # count -1
+    msg = parse_error(bad)
+    assert msg is not None and "version" not in msg, msg
+
+    # a well-formed v5 heartbeat frame parses clean
+    hb = wire_abi.frame_header(
+        frame_type=wire_abi.FRAME_HEARTBEAT) + struct.pack("<i", 3)
+    assert parse_error(hb) is None
