@@ -234,7 +234,8 @@ void ParameterManager::Initialize(int64_t fusion0, int64_t cycle_us0,
                                   bool tune_hierarchical, bool hier0,
                                   bool tune_fusion, bool tune_cycle,
                                   bool tune_depth, int64_t depth0,
-                                  bool tune_segment, int64_t segment0) {
+                                  bool tune_segment, int64_t segment0,
+                                  bool tune_stripes, int64_t stripes0) {
   const char* on = getenv("HOROVOD_AUTOTUNE");
   if (!on || !on[0] || !strcmp(on, "0")) on = getenv("HOROVOD_TPU_AUTOTUNE");
   active_ = on && on[0] && strcmp(on, "0") != 0;
@@ -246,6 +247,8 @@ void ParameterManager::Initialize(int64_t fusion0, int64_t cycle_us0,
   depth_ = depth0;
   tune_seg_ = tune_segment;
   segment_ = segment0;
+  tune_stripes_ = tune_stripes;
+  stripes_ = stripes0;
   if (!active_) return;
   // env-pinned knobs leave the search space entirely (reference
   // fixed=true semantics): the GP never spends a dimension on them and
@@ -255,6 +258,7 @@ void ParameterManager::Initialize(int64_t fusion0, int64_t cycle_us0,
   if (tune_cycle) knobs_.push_back(kCycle);
   if (tune_depth_) knobs_.push_back(kDepth);
   if (tune_seg_) knobs_.push_back(kSegment);
+  if (tune_stripes_) knobs_.push_back(kStripes);
   int cat = -1;
   if (tune_hier_) {
     cat = static_cast<int>(knobs_.size());
@@ -294,7 +298,11 @@ void ParameterManager::Initialize(int64_t fusion0, int64_t cycle_us0,
       int cell = 0;
       while (cell < 4 && (int64_t{1} << (17 + cell)) <= segment0) cell++;
       current_unit_.push_back((cell + 0.5) / 5.0);
-    } else
+    } else if (k == kStripes)
+      // {1,2,4} stripes mapped to thirds, like the depth knob
+      current_unit_.push_back(
+          ((stripes0 >= 4 ? 2 : stripes0 >= 2 ? 1 : 0) + 0.5) / 3.0);
+    else
       current_unit_.push_back(hier0 ? 1.0 : 0.0);
   }
   if (!log_path_.empty()) {
@@ -303,9 +311,10 @@ void ParameterManager::Initialize(int64_t fusion0, int64_t cycle_us0,
       // the depth/segment columns only appear when those knobs are in
       // the search, so default runs keep the historical 4-column format
       fprintf(f, "fusion_threshold_bytes,cycle_time_us,"
-                 "hierarchical_allreduce,%s%sscore_bytes_per_us\n",
+                 "hierarchical_allreduce,%s%s%sscore_bytes_per_us\n",
               tune_depth_ ? "pipeline_depth," : "",
-              tune_seg_ ? "ring_segment_bytes," : "");
+              tune_seg_ ? "ring_segment_bytes," : "",
+              tune_stripes_ ? "wire_stripes," : "");
       fclose(f);
     }
   }
@@ -319,6 +328,7 @@ void ParameterManager::Log(double score) {
           static_cast<long long>(cycle_us_), hier_ ? 1 : 0);
   if (tune_depth_) fprintf(f, "%lld,", static_cast<long long>(depth_));
   if (tune_seg_) fprintf(f, "%lld,", static_cast<long long>(segment_));
+  if (tune_stripes_) fprintf(f, "%lld,", static_cast<long long>(stripes_));
   fprintf(f, "%.6f\n", score);
   fclose(f);
 }
@@ -336,6 +346,8 @@ void ParameterManager::SetPoint(const std::vector<double>& unit) {
     else if (knobs_[i] == kSegment)
       segment_ = int64_t{1}
                  << (16 + std::min(static_cast<int>(unit[i] * 5.0), 4));
+    else if (knobs_[i] == kStripes)
+      stripes_ = int64_t{1} << std::min(static_cast<int>(unit[i] * 3.0), 2);
     else
       hier_ = unit[i] >= 0.5;
   }
@@ -345,7 +357,8 @@ bool ParameterManager::RecordCycle(int64_t bytes, double cycle_secs,
                                    int64_t* fusion_out,
                                    int64_t* cycle_us_out, int* hier_out,
                                    int64_t* depth_out,
-                                   int64_t* segment_out) {
+                                   int64_t* segment_out,
+                                   int64_t* stripes_out) {
   if (!active_ || converged_) return false;
   bytes_acc_ += bytes;
   secs_acc_ += cycle_secs;
@@ -380,6 +393,7 @@ bool ParameterManager::RecordCycle(int64_t bytes, double cycle_secs,
   *hier_out = tune_hier_ ? (hier_ ? 1 : 0) : -1;
   if (depth_out) *depth_out = tune_depth_ ? depth_ : -1;
   if (segment_out) *segment_out = tune_seg_ ? segment_ : -1;
+  if (stripes_out) *stripes_out = tune_stripes_ ? stripes_ : -1;
   return true;
 }
 

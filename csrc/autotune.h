@@ -81,7 +81,12 @@ class BayesianOptimization {
 // data-plane pipeline depth as a discrete {1,2,4} dimension — plus, when
 // the engine opts in (HOROVOD_TPU_AUTOTUNE_RING_SEGMENT=1 with
 // segmentation enabled), the ring segment size as a discrete
-// {64,128,256,512,1024} KB dimension — online from observed throughput.
+// {64,128,256,512,1024} KB dimension — plus, when the engine opts in
+// (HOROVOD_TPU_AUTOTUNE_WIRE_STRIPES=1 on a multi-process world), the
+// per-link TCP stripe count as a discrete {1,2,4} dimension (the links
+// pre-open enough stripes; tuning only moves the active cap, adopted at
+// collective boundaries so both ends of every link stay in lockstep) —
+// online from observed throughput.
 // Call RecordCycle once per background-loop cycle with the bytes
 // processed that cycle; when a tuning step fires, returns true and
 // writes the new values (*hier_out / *depth_out / *segment_out are -1
@@ -101,7 +106,8 @@ class ParameterManager {
                   bool tune_fusion = true, bool tune_cycle = true,
                   bool tune_depth = false, int64_t depth0 = 2,
                   bool tune_segment = false,
-                  int64_t segment0 = 256 << 10);
+                  int64_t segment0 = 256 << 10,
+                  bool tune_stripes = false, int64_t stripes0 = 1);
   bool active() const { return active_; }
   // Diagnostic read from any thread (the bg loop owns the write): has the
   // search finished and applied bo_.Best()?
@@ -111,7 +117,8 @@ class ParameterManager {
   bool RecordCycle(int64_t bytes, double cycle_secs, int64_t* fusion_out,
                    int64_t* cycle_us_out, int* hier_out,
                    int64_t* depth_out = nullptr,
-                   int64_t* segment_out = nullptr);
+                   int64_t* segment_out = nullptr,
+                   int64_t* stripes_out = nullptr);
 
  private:
   void Log(double score);
@@ -122,9 +129,10 @@ class ParameterManager {
   bool hier_ = false;
   bool tune_depth_ = false;
   bool tune_seg_ = false;
+  bool tune_stripes_ = false;
   // which knobs the search owns, in unit-vector order (fixed knobs are
   // excluded — not merely held, so the GP never wastes a dimension)
-  enum Knob { kFusion, kCycle, kHier, kDepth, kSegment };
+  enum Knob { kFusion, kCycle, kHier, kDepth, kSegment, kStripes };
   std::vector<int> knobs_;
   BayesianOptimization bo_{2};
   std::vector<double> current_unit_;
@@ -132,6 +140,7 @@ class ParameterManager {
   int64_t cycle_us_ = 5000;
   int64_t depth_ = 2;
   int64_t segment_ = 256 << 10;
+  int64_t stripes_ = 1;
 
   int cycles_per_sample_ = 10;
   int samples_per_step_ = 5;

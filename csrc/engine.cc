@@ -48,6 +48,7 @@
 #include "shm.h"
 #include "socket.h"
 #include "timeline.h"
+#include "topo.h"
 #include "wire.h"
 
 namespace hvdtpu {
@@ -366,6 +367,99 @@ int64_t NormalizeSegmentBytes(int64_t b) {
 }
 
 // ---------------------------------------------------------------------------
+// scatter-gather wire view (HOROVOD_TPU_SG_THRESHOLD_BYTES)
+// ---------------------------------------------------------------------------
+
+// The LOGICAL fused buffer a collective operates on, as an ordered list of
+// memory regions.  A single part is the historical packed case; with
+// scatter-gather, large tensors stay where they are (their staged payload
+// or the caller's in-place buffer) and only the small tail packs — the
+// wire walks the pieces with writev/readv, so the byte stream, the chunk
+// geometry, and every accumulate group are IDENTICAL to the packed layout
+// (regions only change where bytes LIVE, never their logical order), which
+// is what keeps SG on/off bitwise-equivalent.
+struct WireRegions {
+  struct Part {
+    char* p;
+    int64_t n;
+  };
+  std::vector<Part> parts;
+  std::vector<int64_t> off;  // prefix byte offsets; size parts.size()+1
+
+  WireRegions() : off(1, 0) {}
+  void Add(char* p, int64_t n) {
+    if (n <= 0) return;
+    // coalesce adjacent memory (consecutive packed entries) so the common
+    // all-packed group stays a single part with zero iovec overhead
+    if (!parts.empty() && parts.back().p + parts.back().n == p) {
+      parts.back().n += n;
+      off.back() += n;
+      return;
+    }
+    parts.push_back({p, n});
+    off.push_back(off.back() + n);
+  }
+  int64_t total() const { return off.back(); }
+  bool single() const { return parts.size() == 1; }
+  char* base() const { return parts.empty() ? nullptr : parts[0].p; }
+
+  // Apply `fn(char* piece, int64_t piece_len)` over the logical byte range
+  // [lo, hi); returns false early when fn returns false.
+  template <typename F>
+  bool ForRange(int64_t lo, int64_t hi, F&& fn) const {
+    if (hi <= lo) return true;
+    // locate the part containing lo
+    size_t i = static_cast<size_t>(
+        std::upper_bound(off.begin(), off.end(), lo) - off.begin());
+    if (i > 0) i--;
+    for (; i < parts.size() && off[i] < hi; i++) {
+      int64_t plo = std::max(lo, off[i]);
+      int64_t phi = std::min(hi, off[i + 1]);
+      if (phi <= plo) continue;
+      if (!fn(parts[i].p + (plo - off[i]), phi - plo)) return false;
+    }
+    return true;
+  }
+
+  // Build an iovec array (up to cap entries) covering [lo, hi); returns
+  // the entry count.  Partial coverage is fine — callers loop.
+  int Iovecs(int64_t lo, int64_t hi, struct iovec* iov, int cap) const {
+    int cnt = 0;
+    ForRange(lo, hi, [&](char* p, int64_t n) {
+      if (cnt >= cap) return false;
+      iov[cnt].iov_base = p;
+      iov[cnt].iov_len = static_cast<size_t>(n);
+      cnt++;
+      return true;
+    });
+    return cnt;
+  }
+};
+
+// Elementwise-accumulate src (contiguous) into the logical element range
+// [lo_el, lo_el+nelems) of the regions.  Region boundaries are 64-byte
+// aligned in the logical space (the SG eligibility rule), so splitting the
+// accumulate at them keeps the blocked/SIMD kernels' 8-element groups
+// exactly where the packed whole-range accumulate would put them.
+void AccumulateRegions(const WireRegions& wr, int64_t lo_el, const char* src,
+                       int64_t nelems, DType d) {
+  size_t esize = DTypeSize(d);
+  if (wr.single()) {
+    Accumulate(wr.parts[0].p + lo_el * static_cast<int64_t>(esize), src,
+               nelems, d);
+    return;
+  }
+  int64_t lo_b = lo_el * static_cast<int64_t>(esize);
+  int64_t hi_b = (lo_el + nelems) * static_cast<int64_t>(esize);
+  const char* s = src;
+  wr.ForRange(lo_b, hi_b, [&](char* p, int64_t n) {
+    Accumulate(p, s, n / static_cast<int64_t>(esize), d);
+    s += n;
+    return true;
+  });
+}
+
+// ---------------------------------------------------------------------------
 
 struct TensorEntry {
   Request req;
@@ -498,6 +592,47 @@ class Engine {
     out[7] = 0;
   }
 
+  // Striped-wire + scatter-gather counters, readable from any thread:
+  // {configured cross stripes, configured local stripes, live active-
+  // stripe cap, stripe quantum bytes, SG threshold bytes, SG bytes that
+  // skipped the pack memcpys, bytes packed into fusion buffers, windowed
+  // alltoall runs, per-stripe tx payload bytes [8]}.  The byte series are
+  // COUNTED (pure functions of workload + protocol) and gate CI.
+  void WireStats(int64_t out[16]) const {
+    out[0] = stripes_cross_ * nics_ > Link::kMaxStripes
+                 ? Link::kMaxStripes
+                 : stripes_cross_ * nics_;
+    out[1] = stripes_local_;
+    int64_t cap = wire_stripes_active_.load(std::memory_order_relaxed);
+    int active = 1;
+    for (const auto& l : peers_)
+      if (l.stripes() > 0) {
+        int k = l.stripes() < cap ? l.stripes() : static_cast<int>(cap);
+        if (k > active) active = k;
+      }
+    out[2] = active;
+    out[3] = stripe_quantum_;
+    out[4] = sg_threshold_;
+    out[5] = sg_bytes_total_.load(std::memory_order_relaxed);
+    out[6] = pack_bytes_total_.load(std::memory_order_relaxed);
+    out[7] = alltoall_windowed_.load(std::memory_order_relaxed);
+    for (int s = 0; s < Link::kMaxStripes; s++) {
+      int64_t b = 0;
+      for (const auto& l : peers_) b += l.stripe_tx_bytes(s);
+      out[8 + s] = b;
+    }
+  }
+
+  // Topology descriptor as JSON (diagnostics/tests).
+  std::string TopoJson() const { return topo_.DescribeJson(); }
+
+  // Chaos hook: half-close one stripe of the link to `peer` so transfers
+  // on it fail promptly (the dead-stripe chaos row).
+  void KillStripe(int peer, int stripe) {
+    if (peer >= 0 && peer < static_cast<int>(peers_.size()))
+      peers_[peer].KillStripe(stripe);
+  }
+
   // Oldest control-plane silence this rank observes, in ms: rank 0 reports
   // the max over live workers, workers their coordinator's.  The heartbeat
   // age the fault metrics export — under steady traffic it sits near 0,
@@ -563,7 +698,7 @@ class Engine {
   void HandleDisplaced(const std::vector<std::string>& displaced);
   // workers: adopt coordinator-tuned knobs from any response-side frame
   void AdoptTuned(int64_t fusion, int64_t cycle_us, int64_t hier,
-                  int64_t depth, int64_t seg_bytes);
+                  int64_t depth, int64_t seg_bytes, int64_t stripes);
   // -- pipelined data plane (see the member block below) -------------------
   struct PipeBuf {
     int id = 0;
@@ -572,9 +707,17 @@ class Engine {
   struct WorkItem {
     Response resp;
     std::vector<TensorEntry> entries;
-    std::unique_ptr<PipeBuf> buf;  // fused allreduce only
-    size_t total = 0;              // fused payload bytes
+    std::unique_ptr<PipeBuf> buf;  // fused allreduce only (packed subset)
+    size_t total = 0;              // fused payload bytes (packed + SG)
     bool hierarchical = false;     // algorithm captured in stream order
+    // scatter-gather wire view of a fused group (empty = single entry);
+    // packed[i] = entry i was staged into buf and needs the unpack memcpy
+    WireRegions regions;
+    std::vector<uint8_t> packed;
+    // active-stripe cap captured in stream order, like `hierarchical`:
+    // both ends of every link must apply the same cap at the same
+    // collective boundary or the striped streams reassemble wrong
+    int64_t wire_stripes = Link::kMaxStripes;
     Status status;                 // wire result (set by the executor)
   };
   void Dispatch(const Response& resp);          // inline or pipelined
@@ -592,22 +735,58 @@ class Engine {
   void ApplyPipelineDepth(int64_t d);
   void PipelineStallCheck();     // bg thread: watchdog over the executor
   bool PendingCompletions();
+  // Decide, per fused entry, whether it stages into the fusion buffer
+  // (packed[i] = 1) or wires scatter-gather straight from its payload;
+  // returns the packed byte total (what the fusion buffer must hold).
+  size_t PlanWireRegions(const std::vector<TensorEntry>& entries,
+                         std::vector<uint8_t>* packed);
+  // The wire view matching a plan: packed entries map to their packbuf
+  // slots (in entry order), SG entries to their payloads.
+  static WireRegions BuildRegions(std::vector<TensorEntry>& entries,
+                                  const std::vector<uint8_t>& packed,
+                                  char* packbuf) {
+    WireRegions wr;
+    size_t poff = 0;
+    for (size_t i = 0; i < entries.size(); i++) {
+      TensorEntry& e = entries[i];
+      if (packed[i]) {
+        wr.Add(packbuf + poff, static_cast<int64_t>(e.nbytes));
+        poff += e.nbytes;
+      } else {
+        wr.Add(e.payload(), static_cast<int64_t>(e.nbytes));
+      }
+    }
+    return wr;
+  }
+  // Apply the stream-order stripe cap to every peer link (wire thread
+  // or inline bg thread — whichever owns the data plane).
+  void SetLinksActiveStripes(int64_t cap) {
+    int k = static_cast<int>(cap < 1 ? 1 : cap);
+    for (auto& l : peers_)
+      if (l.stripes() > 0) l.SetActiveStripes(k);
+  }
   void Execute(const Response& resp);
   void ExecuteAllreduce(const Response& resp,
                         std::vector<TensorEntry>& entries);
   void ExecuteAllgather(const Response& resp, TensorEntry& entry);
   void ExecuteBroadcast(const Response& resp, TensorEntry& entry);
   void ExecuteAlltoall(const Response& resp, TensorEntry& entry);
-  Status RingAllreduce(char* buf, int64_t nelems, DType dtype) {
-    return RingAllreduceGroup(buf, nelems, dtype, all_ranks_);
+  // Flat allreduce ring visits ranks in the topology descriptor's
+  // host-contiguous order (ring_order_), not raw rank order: an n-rank
+  // ring then crosses hosts exactly h times.  Allgather/alltoall keep
+  // rank order (their concat layouts are rank-indexed).
+  Status RingAllreduce(const WireRegions& wr, int64_t nelems, DType dtype) {
+    return RingAllreduceGroup(wr, nelems, dtype, ring_order_);
   }
-  Status RingAllreduceGroup(char* buf, int64_t nelems, DType dtype,
-                            const std::vector<int>& members);
-  Status RingAllreduceGroupSegmented(char* buf, int64_t nelems, DType dtype,
+  Status RingAllreduceGroup(const WireRegions& wr, int64_t nelems,
+                            DType dtype, const std::vector<int>& members);
+  Status RingAllreduceGroupSegmented(const WireRegions& wr, int64_t nelems,
+                                     DType dtype,
                                      const std::vector<int>& members,
                                      int64_t seg_bytes);
   void ApplyRingSegment(int64_t bytes);
-  Status HierarchicalAllreduce(char* buf, int64_t nelems, DType dtype);
+  Status HierarchicalAllreduce(const WireRegions& wr, int64_t nelems,
+                               DType dtype);
   Status RingAllgatherGroup(const std::vector<int>& members,
                             const std::vector<size_t>& member_bytes,
                             char* concat);
@@ -621,6 +800,23 @@ class Engine {
   }
   Status TreeBroadcastGroup(char* buf, int64_t nbytes, int root,
                             const std::vector<int>& members);
+  // Region-aware broadcast: one-way transfers decompose into a per-part
+  // call sequence with an identical byte stream (no duplex deadlock risk).
+  Status TreeBroadcastRegions(const WireRegions& wr, int root,
+                              const std::vector<int>& members) {
+    for (const auto& part : wr.parts) {
+      Status st = TreeBroadcastGroup(part.p, part.n, root, members);
+      if (!st.ok()) return st;
+    }
+    return Status::OK();
+  }
+  // Segment-windowed pairwise alltoall (wire v6 satellite): all in-window
+  // step exchanges progress concurrently in segment-sized nibbles.
+  Status AlltoallWindowed(const char* send, int64_t blk,
+                          const std::vector<int64_t>& recv_off,
+                          const std::vector<int64_t>& recv_rows,
+                          int64_t stride, size_t esize, char* out,
+                          int64_t seg_bytes);
   // same-host shared-memory data plane (shm.h); falls back to the TCP
   // peer sockets pair-by-pair when segments can't be set up
   void SetupShm(const std::string& token);
@@ -740,6 +936,24 @@ class Engine {
   std::atomic<int64_t> ring_runs_seg_{0}, ring_runs_mono_{0};
   std::atomic<int64_t> ring_segments_{0}, ring_seg_payload_bytes_{0};
   std::atomic<int64_t> ring_wire_ns_{0}, ring_idle_ns_{0};
+
+  // -- striped wire + scatter-gather (wire v6) -----------------------------
+  // Stripe counts, NIC count, the round-robin quantum, and the SG
+  // threshold are rank-0-decided and bootstrap-shipped: both ends of a
+  // link must agree on the stripe layout or the streams reassemble wrong,
+  // and one job must observe ONE SG threshold for the counted pack-bytes
+  // series to mean anything.  wire_stripes_active_ is the live cap the
+  // opt-in autotuner moves; it is CAPTURED per work item in stream order
+  // (WorkItem::wire_stripes) so both ends flip at the same collective.
+  Topology topo_;
+  std::vector<int> ring_order_;          // flat-ring visit order
+  int stripes_cross_ = 1, stripes_local_ = 1, nics_ = 1;
+  int64_t stripe_quantum_ = 64 << 10;
+  int64_t sg_threshold_ = 4 << 20;       // 0 = scatter-gather off
+  std::atomic<int64_t> wire_stripes_active_{Link::kMaxStripes};
+  std::atomic<int64_t> pack_bytes_total_{0};  // bytes memcpy'd into fusion
+  std::atomic<int64_t> sg_bytes_total_{0};    // pack memcpys avoided
+  std::atomic<int64_t> alltoall_windowed_{0};
   // monolithic-ring idle accounting: set by the wire thread around the
   // monolithic body so the shared Peer* progress loops attribute their
   // no-progress waits to the ring (null outside it) — this is what makes
@@ -793,7 +1007,7 @@ class Engine {
 
   Socket coord_;                        // worker->coordinator (rank != 0)
   std::vector<Socket> workers_;         // coordinator->worker (rank 0)
-  std::vector<Socket> peers_;           // data plane, by rank
+  std::vector<Link> peers_;             // data plane, by rank (K stripes)
   // same-host fast path: one SPSC shm ring per direction per local peer
   // (tx: this rank produces; rx: this rank consumes); null => TCP
   std::vector<std::unique_ptr<ShmRing>> shm_tx_, shm_rx_;
@@ -867,6 +1081,7 @@ class Engine {
   int64_t pending_tuned_hier_ = -1;
   int64_t pending_tuned_depth_ = -1;
   int64_t pending_tuned_segment_ = -1;
+  int64_t pending_tuned_stripes_ = -1;
 };
 
 // Set for the lifetime of the data-plane executor thread: routes wire
@@ -939,6 +1154,30 @@ Status Engine::Init(const std::string& host, int port, int rank, int size) {
   // benches, and the opt-in segment autotuner to mean anything.
   ring_segment_bytes_ = NormalizeSegmentBytes(
       EnvInt64("HOROVOD_TPU_RING_SEGMENT_BYTES", 256 << 10));
+  // striped wire (v6): stripe counts, NIC multiplier, round-robin quantum
+  // and the scatter-gather threshold are all rank-0-decided and shipped in
+  // the table — both ends of every link must agree on the stripe layout
+  // (streams would reassemble wrong otherwise) and on the SG threshold
+  // (the counted pack-bytes series must mean one thing per job)
+  auto clamp_stripes = [](int64_t v) {
+    return static_cast<int>(v < 1 ? 1 : v > Link::kMaxStripes
+                                            ? Link::kMaxStripes : v);
+  };
+  stripes_cross_ = clamp_stripes(EnvInt64("HOROVOD_TPU_WIRE_STRIPES", 1));
+  stripes_local_ = clamp_stripes(
+      EnvInt64("HOROVOD_TPU_WIRE_STRIPES_LOCAL", stripes_cross_));
+  nics_ = clamp_stripes(EnvInt64("HOROVOD_TPU_NICS", 1));
+  stripe_quantum_ = EnvInt64("HOROVOD_TPU_STRIPE_QUANTUM_BYTES", 64 << 10);
+  if (stripe_quantum_ < (4 << 10)) stripe_quantum_ = 4 << 10;
+  if (stripe_quantum_ > (8 << 20)) stripe_quantum_ = 8 << 20;
+  sg_threshold_ = EnvInt64("HOROVOD_TPU_SG_THRESHOLD_BYTES", 4 << 20);
+  if (sg_threshold_ < 0) sg_threshold_ = 0;
+  // stripe autotuning changes how many sockets the mesh pre-opens, so
+  // the opt-in flag is rank-0-decided and table-shipped like the stripe
+  // counts themselves: a flag set on only one side would make connect
+  // and accept disagree on the per-link socket count and hang bootstrap
+  int tune_stripes_on =
+      EnvFlag("HOROVOD_TPU_AUTOTUNE_WIRE_STRIPES") ? 1 : 0;
   if (size_ > 1) {
     // data-plane listener first, so peers can connect whenever they learn
     // our address
@@ -990,7 +1229,10 @@ Status Engine::Init(const std::string& host, int port, int rank, int size) {
       std::ostringstream table;
       table << "HVDW" << kWireVersion << " " << shm_token << " " << shm_on
             << " " << cache_capacity_ << " " << pipeline_depth_.load()
-            << " " << ring_segment_bytes_.load() << " ";
+            << " " << ring_segment_bytes_.load() << " " << stripes_cross_
+            << " " << stripes_local_ << " " << nics_ << " "
+            << stripe_quantum_ << " " << sg_threshold_ << " "
+            << tune_stripes_on << " ";
       for (int i = 0; i < size_; i++)
         table << hosts[i] << " " << ports[i] << " " << hashes[i] << " ";
       for (int i = 1; i < size_; i++) {
@@ -1021,52 +1263,100 @@ Status Engine::Init(const std::string& host, int port, int rank, int size) {
             std::to_string(kWireVersion) +
             "' — all ranks must load the same libhvdtpu.so");
       int64_t table_depth = 2, table_seg = 256 << 10;
+      int64_t t_sc = 1, t_sl = 1, t_nics = 1, t_quant = 64 << 10,
+              t_sg = 4 << 20;
       is >> shm_token >> shm_on >> cache_capacity_ >> table_depth
-         >> table_seg;
+         >> table_seg >> t_sc >> t_sl >> t_nics >> t_quant >> t_sg
+         >> tune_stripes_on;
       pipeline_depth_ = table_depth < 1 ? 1 : table_depth > 8 ? 8
                                                               : table_depth;
       ring_segment_bytes_ = NormalizeSegmentBytes(table_seg);
+      stripes_cross_ = clamp_stripes(t_sc);
+      stripes_local_ = clamp_stripes(t_sl);
+      nics_ = clamp_stripes(t_nics);
+      stripe_quantum_ = t_quant;
+      sg_threshold_ = t_sg < 0 ? 0 : t_sg;
       for (int i = 0; i < size_; i++) is >> hosts[i] >> ports[i] >> hashes[i];
     }
 
-    // full data-plane mesh: connect to lower ranks, accept from higher ones
+    // topology descriptor first: the per-link stripe counts it derives
+    // from the shared table decide how many sockets the mesh opens per
+    // peer (both endpoints evaluate the same count by construction)
+    topo_.Build(rank_, size_, hashes, nics_, stripes_cross_, stripes_local_,
+                Link::kMaxStripes);
+    // the opt-in stripe autotuner pre-opens 4 stripes per link so the
+    // search can raise the active cap live without reconnecting
+    // (tune_stripes_on is the table-shipped decision, agreed everywhere)
+    auto opened = [&](int j) {
+      int k = topo_.LinkStripes(j);
+      if (tune_stripes_on && k < 4) k = 4;
+      return k;
+    };
+    // full data-plane mesh: connect to lower ranks, accept from higher
+    // ones — K striped sockets per logical link (wire v6), each announced
+    // with {rank, stripe} so one peer's stripes may accept in any order
     peers_.resize(size_);
+    for (int j = 0; j < size_; j++)
+      if (j != rank_) peers_[j].Configure(stripe_quantum_);
     for (int j = 0; j < rank_; j++) {
-      Socket sock;
-      s = Socket::Connect(hosts[j], ports[j], &sock, start_timeout_s_);
-      if (!s.ok()) return s;
-      int32_t me = rank_;
-      s = sock.SendAll(&me, sizeof(me));
-      if (!s.ok()) return s;
-      peers_[j] = std::move(sock);
+      for (int st = 0; st < opened(j); st++) {
+        Socket sock;
+        s = Socket::Connect(hosts[j], ports[j], &sock, start_timeout_s_);
+        if (!s.ok()) return s;
+        int32_t hello[2] = {rank_, st};
+        s = sock.SendAll(hello, sizeof(hello));
+        if (!s.ok()) return s;
+        peers_[j].SetStripe(st, std::move(sock));
+      }
     }
-    for (int j = rank_ + 1; j < size_; j++) {
+    int expect = 0;
+    for (int j = rank_ + 1; j < size_; j++) expect += opened(j);
+    for (int k = 0; k < expect; k++) {
       Socket sock;
       s = data_listener_.Accept(&sock, start_timeout_s_);
       if (!s.ok()) return s;
-      int32_t who = -1;
-      s = sock.RecvAll(&who, sizeof(who));
+      int32_t hello[2] = {-1, -1};
+      s = sock.RecvAll(hello, sizeof(hello));
       if (!s.ok()) return s;
-      if (who <= rank_ || who >= size_)
+      int who = hello[0], stripe = hello[1];
+      if (who <= rank_ || who >= size_ || stripe < 0 ||
+          stripe >= opened(who))
         return Status::Error("unexpected data-plane peer " +
-                             std::to_string(who));
-      peers_[who] = std::move(sock);
+                             std::to_string(who) + " stripe " +
+                             std::to_string(stripe));
+      peers_[who].SetStripe(stripe, std::move(sock));
     }
+    // initial active cap: tuned runs start at the LARGEST configured
+    // per-link count (the cap is global, so seeding below a configured
+    // local count would silently override it before the search even
+    // starts), clamped into the search space {1,2,4} — the GP attributes
+    // the first samples to the seed cell, so measuring outside the space
+    // (e.g. 8 = cross x NICs) would poison that cell's score; untuned
+    // runs leave every link at its opened count
+    wire_stripes_active_ =
+        tune_stripes_on
+            ? std::min<int64_t>(4, clamp_stripes(std::max(
+                  stripes_local_, stripes_cross_ * nics_)))
+            : Link::kMaxStripes;
+  } else {
+    // single-process world: no mesh, but the descriptor below still
+    // backs Topo()/hvd_topology_describe
+    topo_.Build(rank_, size_, hashes, nics_, stripes_cross_,
+                stripes_local_, Link::kMaxStripes);
   }
 
   // two-level topology from the agreed host hashes (identical on every
-  // rank: all derive it from the broadcast table)
+  // rank: all derive it from the broadcast table; built above — before
+  // the mesh, which needs the per-link stripe counts).  The descriptor
+  // also picks the FLAT ring's host-contiguous visit order — allgather
+  // and alltoall keep rank order (their concat layouts are rank-indexed).
   all_ranks_.resize(size_);
   for (int i = 0; i < size_; i++) all_ranks_[i] = i;
-  std::map<std::string, std::vector<int>> groups;
-  for (int i = 0; i < size_; i++) groups[hashes[i]].push_back(i);
-  local_group_ = groups[hashes[rank_]];
-  for (auto& [h, g] : groups) cross_group_.push_back(g.front());
-  std::sort(cross_group_.begin(), cross_group_.end());
-  for (int root : cross_group_)
-    for (auto& [h, g] : groups)
-      if (g.front() == root) host_groups_.push_back(g);
-  bool multi_host = groups.size() > 1;
+  local_group_ = topo_.local_group;
+  cross_group_ = topo_.cross_group;
+  host_groups_ = topo_.host_groups;
+  ring_order_ = topo_.RingOrder();
+  bool multi_host = topo_.multi_host();
   // cross-host egress pacing (userspace token bucket, socket.cc): models
   // asymmetric intra/inter-host link cost — the condition the
   // hierarchical two-level paths exist for — on a single test machine,
@@ -1103,10 +1393,14 @@ Status Engine::Init(const std::string& host, int port, int rank, int size) {
   hierarchical_allgather_ = (hg && hg[0]) ? (strcmp(hg, "0") != 0) : false;
   hierarchical_allreduce_ = hierarchical_allreduce_.load() && multi_host;
   hierarchical_allgather_ &= multi_host;
-  LOG_RANK(Debug, rank_) << "topology: " << groups.size() << " host group(s),"
+  LOG_RANK(Debug, rank_) << "topology: " << host_groups_.size()
+                         << " host group(s),"
                          << " local group size " << local_group_.size()
                          << ", hierarchical allreduce "
-                         << (hierarchical_allreduce_ ? "on" : "off");
+                         << (hierarchical_allreduce_ ? "on" : "off")
+                         << ", wire stripes " << stripes_cross_ << "x"
+                         << nics_ << " cross / " << stripes_local_
+                         << " local";
   // same-host peers get a shared-memory data plane (loopback TCP moves
   // every byte through the kernel twice; a mapped ring moves it at memcpy
   // speed) — the eager analog of the reference's intra-node shared-memory
@@ -1143,6 +1437,10 @@ Status Engine::Init(const std::string& host, int port, int rank, int size) {
   bool tune_segment = size_ > 1 &&
                       EnvFlag("HOROVOD_TPU_AUTOTUNE_RING_SEGMENT") &&
                       ring_segment_bytes_.load() > 0;
+  // stripe-count autotuning is opt-in the same way: the mesh pre-opened
+  // enough stripes above; the search only moves the active cap (the
+  // table-shipped decision, so it can never diverge from the mesh)
+  bool tune_stripes = size_ > 1 && tune_stripes_on != 0;
   if (rank_ == 0)
     pm_.Initialize(fusion_threshold_, cycle_us_,
                    /*tune_hierarchical=*/dflt && !(ha && ha[0]),
@@ -1153,7 +1451,9 @@ Status Engine::Init(const std::string& host, int port, int rank, int size) {
                                            "HOROVOD_CYCLE_TIME"),
                    /*tune_depth=*/tune_depth, pipeline_depth_.load(),
                    /*tune_segment=*/tune_segment,
-                   ring_segment_bytes_.load());
+                   ring_segment_bytes_.load(),
+                   /*tune_stripes=*/tune_stripes,
+                   wire_stripes_active_.load());
 
   cache_.Init(cache_capacity_);
   LOG_RANK(Debug, rank_) << "response cache: capacity " << cache_.capacity()
@@ -1539,10 +1839,10 @@ void Engine::BackgroundLoop() {
       double secs = std::chrono::duration<double>(
                         std::chrono::steady_clock::now() - cycle_start)
                         .count();
-      int64_t f, cus, dep, segb;
+      int64_t f, cus, dep, segb, strp;
       int hier;
       if (pm_.RecordCycle(cycle_bytes_, secs, &f, &cus, &hier, &dep,
-                          &segb)) {
+                          &segb, &strp)) {
         fusion_threshold_ = f;
         cycle_us_ = cus;
         pending_tuned_fusion_ = f;
@@ -1558,6 +1858,14 @@ void Engine::BackgroundLoop() {
         if (segb >= 1) {
           ApplyRingSegment(segb);
           pending_tuned_segment_ = ring_segment_bytes_.load();
+        }
+        if (strp >= 1) {
+          // applied to rank 0's own dispatch captures immediately; the
+          // workers adopt it from the next broadcast BEFORE dispatching
+          // that frame's responses, so every link's two ends flip the
+          // cap at the same collective boundary
+          wire_stripes_active_.store(strp, std::memory_order_relaxed);
+          pending_tuned_stripes_ = strp;
         }
       }
       cycle_bytes_ = 0;
@@ -1585,7 +1893,7 @@ Status Engine::RecvCtrl(Socket& sock, std::string* frame) {
 }
 
 void Engine::AdoptTuned(int64_t fusion, int64_t cycle_us, int64_t hier,
-                        int64_t depth, int64_t seg_bytes) {
+                        int64_t depth, int64_t seg_bytes, int64_t stripes) {
   // workers adopt coordinator-tuned knobs from the wire BEFORE executing
   // the responses of the frame that carried them: the coordinator already
   // runs the new values for those responses, and the hierarchical flag
@@ -1600,6 +1908,12 @@ void Engine::AdoptTuned(int64_t fusion, int64_t cycle_us, int64_t hier,
   if (hier >= 0) hierarchical_allreduce_ = hier != 0;
   if (depth >= 1) ApplyPipelineDepth(depth);
   if (seg_bytes >= 1) ApplyRingSegment(seg_bytes);
+  // like `hier`, the stripe cap is stream-order-critical: it is captured
+  // per work item at dispatch, so adopting it here (before this frame's
+  // responses dispatch) flips both ends of every link at the same
+  // collective boundary
+  if (stripes >= 1)
+    wire_stripes_active_.store(stripes, std::memory_order_relaxed);
 }
 
 void Engine::SplitRequests(std::vector<Request>& reqs, RequestList* full,
@@ -1897,7 +2211,8 @@ void Engine::WorkerTick(RequestList& local, bool* stop) {
         return;
       }
       AdoptTuned(ce.tuned_fusion, ce.tuned_cycle_us, ce.tuned_hierarchical,
-                 ce.tuned_pipeline_depth, ce.tuned_segment_bytes);
+                 ce.tuned_pipeline_depth, ce.tuned_segment_bytes,
+                 ce.tuned_wire_stripes);
       for (const auto& g : ce.groups) {
         Response resp;
         s = DecodeCachedGroup(g, &resp);
@@ -1917,7 +2232,8 @@ void Engine::WorkerTick(RequestList& local, bool* stop) {
         return;
       }
       AdoptTuned(rl.tuned_fusion, rl.tuned_cycle_us, rl.tuned_hierarchical,
-                 rl.tuned_pipeline_depth, rl.tuned_segment_bytes);
+                 rl.tuned_pipeline_depth, rl.tuned_segment_bytes,
+                 rl.tuned_wire_stripes);
       auto snap = SnapshotReqs(rl);
       for (const Response& r : rl.responses) Dispatch(r);
       ApplyCacheMutations(rl, snap);
@@ -2031,7 +2347,8 @@ bool Engine::CoordinatorTick(RequestList& local) {
   bool have_ce = !ce.groups.empty();
   bool have_tuned = pending_tuned_fusion_ >= 0 || pending_tuned_cycle_ >= 0 ||
                     pending_tuned_hier_ >= 0 || pending_tuned_depth_ >= 0 ||
-                    pending_tuned_segment_ >= 0;
+                    pending_tuned_segment_ >= 0 ||
+                    pending_tuned_stripes_ >= 0;
   bool have_rl = !out.responses.empty() || out.shutdown ||
                  (have_tuned && !have_ce);
   if (have_tuned) {
@@ -2049,12 +2366,14 @@ bool Engine::CoordinatorTick(RequestList& local) {
       ce.tuned_hierarchical = pending_tuned_hier_;
       ce.tuned_pipeline_depth = pending_tuned_depth_;
       ce.tuned_segment_bytes = pending_tuned_segment_;
+      ce.tuned_wire_stripes = pending_tuned_stripes_;
     } else {
       out.tuned_fusion = pending_tuned_fusion_;
       out.tuned_cycle_us = pending_tuned_cycle_;
       out.tuned_hierarchical = pending_tuned_hier_;
       out.tuned_pipeline_depth = pending_tuned_depth_;
       out.tuned_segment_bytes = pending_tuned_segment_;
+      out.tuned_wire_stripes = pending_tuned_stripes_;
     }
   }
   bool sent = true;
@@ -2087,6 +2406,7 @@ bool Engine::CoordinatorTick(RequestList& local) {
     pending_tuned_hier_ = -1;
     pending_tuned_depth_ = -1;
     pending_tuned_segment_ = -1;
+    pending_tuned_stripes_ = -1;
   }
   // local execution mirrors the wire order exactly: cached groups first,
   // then full responses, then the full responses' cache mutations
@@ -2467,6 +2787,43 @@ void Engine::Dispatch(const Response& resp) {
   Execute(resp);
 }
 
+// Scatter-gather plan for one fused allreduce.  An entry wires in place
+// (skipping BOTH fusion memcpys) when:
+//  * scatter-gather is on (threshold > 0) AND the segmented ring is on —
+//    the monolithic duplex exchange cannot walk discontiguous regions;
+//  * the entry is at least HOROVOD_TPU_SG_THRESHOLD_BYTES;
+//  * its logical offset and size are 64-byte multiples, so every region
+//    boundary falls on the accumulate kernels' 8-element group grid and
+//    a region-split accumulate equals the packed whole-range accumulate;
+//  * its dtype accumulates elementwise (fp16/bf16 use blocked kernels
+//    whose rounding-tie grouping is pointer-relative — a mid-stream
+//    region boundary would regroup them, breaking SG-on/off bitwise
+//    equivalence, so those always pack).
+// Everything else stages into the fusion buffer exactly as before.
+size_t Engine::PlanWireRegions(const std::vector<TensorEntry>& entries,
+                               std::vector<uint8_t>* packed) {
+  int64_t thr =
+      ring_segment_bytes_.load(std::memory_order_relaxed) > 0
+          ? sg_threshold_
+          : 0;
+  packed->assign(entries.size(), 1);
+  size_t pack_total = 0;
+  int64_t off = 0;
+  for (size_t i = 0; i < entries.size(); i++) {
+    const TensorEntry& e = entries[i];
+    DType d = e.req.dtype;
+    bool split_ok = d != DType::kFloat16 && d != DType::kBFloat16;
+    bool sg = thr > 0 && static_cast<int64_t>(e.nbytes) >= thr &&
+              off % 64 == 0 && e.nbytes % 64 == 0 && split_ok;
+    if (sg)
+      (*packed)[i] = 0;
+    else
+      pack_total += e.nbytes;
+    off += static_cast<int64_t>(e.nbytes);
+  }
+  return pack_total;
+}
+
 // Pack stage (negotiation thread): pull the entries out of the tensor
 // table in stream order, capture the collective algorithm for this point
 // of the stream, pack fused allreduces into a pool buffer, and enqueue.
@@ -2495,26 +2852,38 @@ void Engine::PipelineDispatch(const Response& resp) {
   // rank, so the per-item algorithm stays globally agreed even when the
   // executors lag by different amounts
   item.hierarchical = hierarchical_allreduce_.load();
+  item.wire_stripes = wire_stripes_active_.load(std::memory_order_relaxed);
   for (auto& e : item.entries)
     timeline_.Start(e.req.name, OpName(resp.op));
   if (resp.op == OpType::kAllreduce && item.entries.size() > 1) {
     size_t total = 0;
     for (auto& e : item.entries) total += e.nbytes;
     item.total = total;
-    item.buf = AcquireBuf(total);  // backpressure: blocks at full depth
+    // scatter-gather split: entries above the SG threshold wire straight
+    // from their payloads — their pack AND unpack memcpys disappear (the
+    // counted hvd_sg_bytes_skipped_total series); only the small tail
+    // stages into the pool buffer
+    size_t pack_total = PlanWireRegions(item.entries, &item.packed);
+    item.buf = AcquireBuf(pack_total);  // backpressure: blocks at full depth
     FaultInjector::Get().OnPhase(FaultPhase::kPack);
     auto t0 = std::chrono::steady_clock::now();
     int64_t busy0 = ExecutorBusyNs();
     timeline_.PipelineStart(item.buf->id, "PACK");
-    for (auto& e : item.entries)
-      timeline_.ActivityStart(e.req.name, "MEMCPY_IN_FUSION_BUFFER");
     char* fused = item.buf->data.data();
     size_t off = 0;
-    for (auto& e : item.entries) {
+    for (size_t i = 0; i < item.entries.size(); i++) {
+      TensorEntry& e = item.entries[i];
+      if (!item.packed[i]) continue;
+      timeline_.ActivityStart(e.req.name, "MEMCPY_IN_FUSION_BUFFER");
       std::memcpy(fused + off, e.payload(), e.nbytes);
       off += e.nbytes;
+      timeline_.ActivityEnd(e.req.name);
     }
-    for (auto& e : item.entries) timeline_.ActivityEnd(e.req.name);
+    item.regions = BuildRegions(item.entries, item.packed, fused);
+    pack_bytes_total_.fetch_add(static_cast<int64_t>(pack_total),
+                                std::memory_order_relaxed);
+    sg_bytes_total_.fetch_add(static_cast<int64_t>(total - pack_total),
+                              std::memory_order_relaxed);
     timeline_.PipelineEnd(item.buf->id);
     int64_t dt = std::chrono::duration_cast<std::chrono::nanoseconds>(
                      std::chrono::steady_clock::now() - t0)
@@ -2646,26 +3015,36 @@ void Engine::CompleteItem(WorkItem& item) {
   timeline_.PipelineStart(lane, "UNPACK");
   Status st = item.status;
   if (item.buf) {
-    for (auto& e : item.entries)
-      timeline_.ActivityStart(e.req.name, "MEMCPY_OUT_FUSION_BUFFER");
+    // fused: packed entries copy out of the fusion buffer; scatter-gather
+    // entries were reduced in place on their payloads, so they behave
+    // like the unfused case (copy-out only for a non-aliased user_out)
     char* fused = item.buf->data.data();
     size_t off = 0;
-    for (auto& e : item.entries) {
-      if (st.ok()) {
-        char* dst =
-            e.user_out ? static_cast<char*>(e.user_out) : e.data.data();
-        std::memcpy(dst, fused + off, e.nbytes);
+    for (size_t i = 0; i < item.entries.size(); i++) {
+      TensorEntry& e = item.entries[i];
+      bool was_packed = item.packed.empty() || item.packed[i];
+      if (was_packed) {
+        timeline_.ActivityStart(e.req.name, "MEMCPY_OUT_FUSION_BUFFER");
+        if (st.ok()) {
+          char* dst =
+              e.user_out ? static_cast<char*>(e.user_out) : e.data.data();
+          std::memcpy(dst, fused + off, e.nbytes);
+        }
+        off += e.nbytes;
+        timeline_.ActivityEnd(e.req.name);
+        FinishAllreduceEntry(e, st, /*copy_out=*/false);
+      } else {
+        FinishAllreduceEntry(e, st, /*copy_out=*/true);
       }
-      off += e.nbytes;
+      timeline_.End(e.req.name);
     }
-    for (auto& e : item.entries) timeline_.ActivityEnd(e.req.name);
-  }
-  // fused results were already unpacked straight to their destinations
-  // above; an unfused item was reduced in place on the staged payload, so
-  // a non-aliased user_out still needs the copy-out
-  for (auto& e : item.entries) {
-    FinishAllreduceEntry(e, st, /*copy_out=*/!item.buf);
-    timeline_.End(e.req.name);
+  } else {
+    // unfused: reduced in place on the staged payload, so a non-aliased
+    // user_out still needs the copy-out
+    for (auto& e : item.entries) {
+      FinishAllreduceEntry(e, st, /*copy_out=*/true);
+      timeline_.End(e.req.name);
+    }
   }
   timeline_.PipelineEnd(lane);
   if (item.buf) ReleaseBuf(std::move(item.buf));
@@ -2825,27 +3204,28 @@ void Engine::RunWire(WorkItem& item) {
     }
     return;
   }
+  // stream-order stripe cap: both ends of every link apply the same cap
+  // at the same item boundary, so the striped cursors stay in lockstep
+  SetLinksActiveStripes(item.wire_stripes);
   auto t0 = std::chrono::steady_clock::now();
   switch (resp.op) {
     case OpType::kAllreduce: {
       DType dtype = item.entries[0].req.dtype;
-      char* buf;
-      int64_t nelems;
-      if (item.buf) {
-        buf = item.buf->data.data();
-        nelems = static_cast<int64_t>(item.total / DTypeSize(dtype));
-      } else {
-        buf = item.entries[0].payload();
-        nelems = NumElems(item.entries[0].req.dims);
-      }
+      WireRegions single;
+      if (!item.buf)
+        single.Add(item.entries[0].payload(),
+                   static_cast<int64_t>(item.entries[0].nbytes));
+      const WireRegions& wr = item.buf ? item.regions : single;
+      int64_t nelems =
+          wr.total() / static_cast<int64_t>(DTypeSize(dtype));
       const char* act =
           item.hierarchical ? "HIERARCHICAL_ALLREDUCE" : "RING_ALLREDUCE";
       int lane = item.buf ? item.buf->id : -1;
       timeline_.PipelineStart(lane, "WIRE");
       for (auto& e : item.entries) timeline_.ActivityStart(e.req.name, act);
       item.status = item.hierarchical
-                        ? HierarchicalAllreduce(buf, nelems, dtype)
-                        : RingAllreduce(buf, nelems, dtype);
+                        ? HierarchicalAllreduce(wr, nelems, dtype)
+                        : RingAllreduce(wr, nelems, dtype);
       for (auto& e : item.entries) timeline_.ActivityEnd(e.req.name);
       timeline_.PipelineEnd(lane);
       break;
@@ -2912,6 +3292,8 @@ void Engine::Execute(const Response& resp) {
   if (entries.empty()) return;
   for (const TensorEntry& e : entries)
     cycle_bytes_ += static_cast<int64_t>(e.nbytes);
+  // inline data plane: this thread owns the links; apply the current cap
+  SetLinksActiveStripes(wire_stripes_active_.load(std::memory_order_relaxed));
   for (const std::string& name : resp.names)
     timeline_.Start(name, OpName(resp.op));
   switch (resp.op) {
@@ -2942,10 +3324,10 @@ void Engine::ExecuteAllreduce(const Response& resp,
   auto act_end = [&]() {
     for (auto& e : entries) timeline_.ActivityEnd(e.req.name);
   };
-  auto reduce = [&](char* buf, int64_t nelems) {
+  auto reduce = [&](const WireRegions& wr, int64_t nelems) {
     if (hierarchical_allreduce_)
-      return HierarchicalAllreduce(buf, nelems, dtype);
-    return RingAllreduce(buf, nelems, dtype);
+      return HierarchicalAllreduce(wr, nelems, dtype);
+    return RingAllreduce(wr, nelems, dtype);
   };
   const char* act = hierarchical_allreduce_ ? "HIERARCHICAL_ALLREDUCE"
                                             : "RING_ALLREDUCE";
@@ -2954,32 +3336,46 @@ void Engine::ExecuteAllreduce(const Response& resp,
     // staged result still needs the copy-out to a non-aliased user_out
     TensorEntry& e = entries[0];
     act_start(act);
-    Status st = reduce(e.payload(), NumElems(e.req.dims));
+    WireRegions wr;
+    wr.Add(e.payload(), static_cast<int64_t>(e.nbytes));
+    Status st = reduce(wr, NumElems(e.req.dims));
     act_end();
     FinishAllreduceEntry(e, st, /*copy_out=*/true);
     if (!st.ok()) FailAll(st);
     return;
   }
-  // fusion buffer (persistent across responses): pack, one allreduce, unpack
+  // fusion buffer (persistent across responses): pack the small tail, one
+  // allreduce over the scatter-gather view, unpack the packed tail —
+  // entries above the SG threshold never touch the fusion buffer
   FaultInjector::Get().OnPhase(FaultPhase::kPack);
   size_t total = 0;
   for (auto& e : entries) total += e.nbytes;
-  if (fusion_buf_.size() < total) fusion_buf_.resize(total);
+  std::vector<uint8_t> packed;
+  size_t pack_total = PlanWireRegions(entries, &packed);
+  if (fusion_buf_.size() < pack_total) fusion_buf_.resize(pack_total);
   char* fused = fusion_buf_.data();
   size_t off = 0;
   act_start("MEMCPY_IN_FUSION_BUFFER");
-  for (auto& e : entries) {
-    std::memcpy(fused + off, e.payload(), e.nbytes);
-    off += e.nbytes;
+  for (size_t i = 0; i < entries.size(); i++) {
+    if (!packed[i]) continue;
+    std::memcpy(fused + off, entries[i].payload(), entries[i].nbytes);
+    off += entries[i].nbytes;
   }
   act_end();
+  WireRegions wr = BuildRegions(entries, packed, fused);
+  pack_bytes_total_.fetch_add(static_cast<int64_t>(pack_total),
+                              std::memory_order_relaxed);
+  sg_bytes_total_.fetch_add(static_cast<int64_t>(total - pack_total),
+                            std::memory_order_relaxed);
   act_start(act);
-  Status st = reduce(fused, static_cast<int64_t>(total / DTypeSize(dtype)));
+  Status st = reduce(wr, static_cast<int64_t>(total / DTypeSize(dtype)));
   act_end();
   FaultInjector::Get().OnPhase(FaultPhase::kUnpack);
   act_start("MEMCPY_OUT_FUSION_BUFFER");
   off = 0;
-  for (auto& e : entries) {
+  for (size_t i = 0; i < entries.size(); i++) {
+    TensorEntry& e = entries[i];
+    if (!packed[i]) continue;
     // unpack straight into the caller's buffer when provided
     if (st.ok()) {
       char* dst = e.user_out ? static_cast<char*>(e.user_out) : e.data.data();
@@ -2988,8 +3384,11 @@ void Engine::ExecuteAllreduce(const Response& resp,
     off += e.nbytes;
   }
   act_end();
-  // the unpack above already wrote each result to its destination
-  for (auto& e : entries) FinishAllreduceEntry(e, st, /*copy_out=*/false);
+  // packed results were written to their destinations above; SG entries
+  // were reduced in place on their payloads (copy-out like the unfused
+  // case when a non-aliased user_out exists)
+  for (size_t i = 0; i < entries.size(); i++)
+    FinishAllreduceEntry(entries[i], st, /*copy_out=*/!packed[i]);
   if (!st.ok()) FailAll(st);
 }
 
@@ -3135,7 +3534,7 @@ bool Stalled(std::chrono::steady_clock::time_point last_progress,
 // ``fast_rx`` caps the wait when another (shm) direction still needs
 // polling service.  Callers fall back to Backoff::Wait() when the
 // blocked direction is not a TCP send.
-void SendBlockedWait(Backoff& bo, Socket& tx, size_t want, bool fast_rx) {
+void SendBlockedWait(Backoff& bo, Link& tx, size_t want, bool fast_rx) {
   bo.idle++;
   if (bo.idle < 8) return;  // stay hot: a near-empty bucket refills fast
   double d = tx.PaceDelaySeconds(want);
@@ -3150,8 +3549,10 @@ void SendBlockedWait(Backoff& bo, Socket& tx, size_t want, bool fast_rx) {
     std::this_thread::yield();
     return;
   }
+  // park on the stripe the next logical byte goes to — the only one whose
+  // writability can unblock the in-order send cursor
   struct pollfd p;
-  p.fd = tx.fd();
+  p.fd = tx.send_fd();
   p.events = POLLOUT;
   p.revents = 0;
   ::poll(&p, 1, fast_rx ? 1 : 50);
@@ -3223,11 +3624,12 @@ Status Engine::PeerRecvAll(int r, void* data, size_t n) {
     }
     if (Aborting()) return AbortedStatus();
     if (!rx && bo.idle >= 64) {
-      // recv-blocked TCP parks in poll(POLLIN); bounded so the abort
-      // latch and the no-progress clock are re-checked promptly
+      // recv-blocked TCP parks in poll(POLLIN) on the cursor stripe;
+      // bounded so the abort latch and the no-progress clock are
+      // re-checked promptly
       bo.idle++;
       struct pollfd pf;
-      pf.fd = peers_[r].fd();
+      pf.fd = peers_[r].recv_fd();
       pf.events = POLLIN;
       pf.revents = 0;
       ::poll(&pf, 1, 50);
@@ -3252,18 +3654,6 @@ Status Engine::PeerSendRecv(int r_send, const void* send_buf, size_t send_n,
   ShmRing* rx = r_recv < static_cast<int>(shm_rx_.size())
                     ? shm_rx_[r_recv].get()
                     : nullptr;
-  if (!tx && !rx) {
-    Status st = Socket::SendRecv(peers_[r_send], send_buf, send_n,
-                                 peers_[r_recv], recv_buf, recv_n,
-                                 ring_idle_sink_);
-    if (!st.ok() && st.message.find("no progress") != std::string::npos)
-      return PeerDeadStatus("peer exchange",
-                            "rank " + std::to_string(r_send) +
-                                " (send) / rank " + std::to_string(r_recv) +
-                                " (recv)",
-                            Timeouts().duplex);
-    return st;
-  }
   const char* sp = static_cast<const char*>(send_buf);
   char* rp = static_cast<char*>(recv_buf);
   size_t sleft = send_n, rleft = recv_n;
@@ -3328,10 +3718,33 @@ Status Engine::PeerSendRecv(int r_send, const void* send_buf, size_t send_n,
       flush_idle();
       return AbortedStatus();
     }
-    if (!tx && sleft > 0)
+    if (!tx && !rx && sleft > 0 && rleft > 0 && bo.idle >= 8 &&
+        peers_[r_send].PaceDelaySeconds(sleft) <= 0.0) {
+      // pure TCP with BOTH directions pending and tokens available: park
+      // on both cursor-stripe fds at once (the dual-fd poll the removed
+      // Socket::SendRecv had) so either direction's readiness wakes the
+      // loop immediately; 50 ms bounds the abort/no-progress re-checks
+      bo.idle++;
+      struct pollfd pf[2];
+      pf[0] = {peers_[r_send].send_fd(), POLLOUT, 0};
+      pf[1] = {peers_[r_recv].recv_fd(), POLLIN, 0};
+      ::poll(pf, 2, 50);
+    } else if (!tx && sleft > 0) {
       SendBlockedWait(bo, peers_[r_send], sleft, /*fast_rx=*/rleft > 0);
-    else
+    } else if (!rx && rleft > 0 && bo.idle >= 64) {
+      // recv is the blocker and it is TCP: park in poll(POLLIN) on the
+      // cursor stripe instead of the sleep ladder (short while a full shm
+      // tx ring still needs push retries); 50 ms bounds the abort-latch
+      // and no-progress re-check cadence
+      bo.idle++;
+      struct pollfd pf;
+      pf.fd = peers_[r_recv].recv_fd();
+      pf.events = POLLIN;
+      pf.revents = 0;
+      ::poll(&pf, 1, (tx && sleft > 0) ? 1 : 50);
+    } else {
       bo.Wait();
+    }
     if (Stalled(last_prog, Timeouts().duplex)) {
       flush_idle();
       return PeerDeadStatus("peer exchange",
@@ -3447,21 +3860,28 @@ Status Engine::PeerSendRecvReduce(int r_send, const void* send_buf,
   return Status::OK();
 }
 
-Status Engine::RingAllreduceGroup(char* buf, int64_t nelems, DType dtype,
+Status Engine::RingAllreduceGroup(const WireRegions& wr, int64_t nelems,
+                                  DType dtype,
                                   const std::vector<int>& members) {
   int m = static_cast<int>(members.size());
-  if (m <= 1) return Status::OK();
+  if (m <= 1 || nelems <= 0) return Status::OK();
   // chaos hook: "kill:rank=R:phase=ring" fires here — the survivors'
   // ring loops park on a peer that will never answer
   FaultInjector::Get().OnPhase(FaultPhase::kRing);
   int64_t seg = ring_segment_bytes_.load(std::memory_order_relaxed);
+  // a scatter-gather view REQUIRES the segmented loop (the monolithic
+  // duplex exchange cannot walk discontiguous regions); PlanWireRegions
+  // only splits when segmentation is on, so this fallback covers only a
+  // concurrent retune-to-0 race
+  if (seg <= 0 && !wr.single() && !wr.parts.empty()) seg = 256 << 10;
   if (seg > 0)
-    return RingAllreduceGroupSegmented(buf, nelems, dtype, members, seg);
+    return RingAllreduceGroupSegmented(wr, nelems, dtype, members, seg);
   // HOROVOD_TPU_RING_SEGMENT_BYTES=0: the historical monolithic ring —
   // one whole-chunk duplex exchange per step, barriering on each
   // (bisection knob, and the reference the segmented loop must match
   // bitwise).  Wall/idle time still feeds the ring counters so
   // hvd_ring_wire_idle_fraction compares the two modes.
+  char* buf = wr.base();
   ring_runs_mono_.fetch_add(1, std::memory_order_relaxed);
   int me = static_cast<int>(
       std::find(members.begin(), members.end(), rank_) - members.begin());
@@ -3565,8 +3985,8 @@ struct SegGeom {
 //    are grouping-sensitive on rounding ties, and this pins the grouping
 //    for ANY segment size (which is also what makes live segment
 //    retuning safe).
-Status Engine::RingAllreduceGroupSegmented(char* buf, int64_t nelems,
-                                           DType dtype,
+Status Engine::RingAllreduceGroupSegmented(const WireRegions& wr,
+                                           int64_t nelems, DType dtype,
                                            const std::vector<int>& members,
                                            int64_t seg_bytes) {
   int m = static_cast<int>(members.size());
@@ -3588,8 +4008,20 @@ Status Engine::RingAllreduceGroupSegmented(char* buf, int64_t nelems,
   ShmRing* rx = left < static_cast<int>(shm_rx_.size())
                     ? shm_rx_[left].get()
                     : nullptr;
-  Socket* txs = tx ? nullptr : &peers_[right];
-  Socket* rxs = rx ? nullptr : &peers_[left];
+  Link* txs = tx ? nullptr : &peers_[right];
+  Link* rxs = rx ? nullptr : &peers_[left];
+  // single-region fast path pointer (the overwhelmingly common case);
+  // multi-region (scatter-gather) ranges go through wr.ForRange/Iovecs
+  char* buf = wr.base();
+  const bool sg = !wr.single();
+  // timeline stripe lanes: one lane per stripe, only when the link
+  // EFFECTIVELY runs more than one (the cap defaults to kMaxStripes, so
+  // the raw cap alone would mark lanes on every single-stripe link)
+  static const char* kStripeLane[Link::kMaxStripes] = {
+      "wire/stripe0", "wire/stripe1", "wire/stripe2", "wire/stripe3",
+      "wire/stripe4", "wire/stripe5", "wire/stripe6", "wire/stripe7"};
+  const bool lanes =
+      txs && std::min(txs->active_stripes(), txs->stripes()) > 1;
 
   // reduce-scatter receives stage one segment before its single
   // accumulate (bounded scratch; segment boundaries are element-aligned
@@ -3610,6 +4042,7 @@ Status Engine::RingAllreduceGroupSegmented(char* buf, int64_t nelems,
 
   int64_t segments = 0, payload = 0;   // flushed to the atomics at exit
   int64_t idle_ns = 0, idle_since = 0;
+  int last_lane = -1;  // stripe lane with an open STRIPE_SEND span
   auto last_prog = std::chrono::steady_clock::now();
   int64_t t0 = NowNs();
   Backoff bo;
@@ -3643,11 +4076,34 @@ Status Engine::RingAllreduceGroupSegmented(char* buf, int64_t nelems,
           }
           prog = true;
         } else {
-          size_t k;
+          size_t k = 0;
+          int lane_idx = lanes ? txs->send_stripe() : -1;
           if (tx) {
-            k = tx->TryPush(buf + lo_b, send_avail);
+            if (!sg) {
+              k = tx->TryPush(buf + lo_b, send_avail);
+            } else {
+              // scatter-gather over shm: push the region pieces in
+              // logical order until one comes up short
+              wr.ForRange(
+                  lo_b, lo_b + static_cast<int64_t>(send_avail),
+                  [&](char* p, int64_t n) {
+                    size_t kk = tx->TryPush(p, static_cast<size_t>(n));
+                    k += kk;
+                    return kk == static_cast<size_t>(n);
+                  });
+            }
           } else {
-            int kk = txs->SendSome(buf + lo_b, send_avail);
+            int kk;
+            if (!sg) {
+              kk = txs->SendSome(buf + lo_b, send_avail);
+            } else {
+              // scatter-gather over TCP: one writev per push, straight
+              // from the scattered tensor memory
+              struct iovec iov[16];
+              int cnt = wr.Iovecs(
+                  lo_b, lo_b + static_cast<int64_t>(send_avail), iov, 16);
+              kk = cnt > 0 ? txs->SendvSome(iov, cnt) : 0;
+            }
             if (kk < 0) {
               err = Status::Error("segmented ring send to rank " +
                                   std::to_string(right) + " failed");
@@ -3656,6 +4112,15 @@ Status Engine::RingAllreduceGroupSegmented(char* buf, int64_t nelems,
             k = static_cast<size_t>(kk);
           }
           if (k > 0) {
+            if (lane_idx >= 0 && lane_idx != last_lane) {
+              // stripe lane: one span per stint on a stripe (the
+              // round-robin rotation), not per push — per-push spans
+              // would multiply timeline volume several-fold
+              if (last_lane >= 0)
+                timeline_.RingSegEnd(kStripeLane[last_lane]);
+              timeline_.RingSegStart(kStripeLane[lane_idx], "STRIPE_SEND");
+              last_lane = lane_idx;
+            }
             if (s_off == 0) timeline_.RingSegStart("ring/send", "SEG_SEND");
             s_off += static_cast<int64_t>(k);
             payload += static_cast<int64_t>(k);
@@ -3698,22 +4163,49 @@ Status Engine::RingAllreduceGroupSegmented(char* buf, int64_t nelems,
         prog = true;
       } else {
         bool reduce_phase = rt < m - 1;
-        char* dst = reduce_phase
-                        ? ring_scratch_.data() + r_off
-                        : buf + lo * static_cast<int64_t>(esize) + r_off;
         size_t want = static_cast<size_t>(seg_b - r_off);
-        size_t k;
-        if (rx) {
-          k = rx->TryPop(dst, want);
-        } else {
-          int kk = rxs->RecvSome(dst, want);
-          if (kk < 0) {
-            err = Status::Error("segmented ring recv from rank " +
-                                std::to_string(left) +
-                                " failed or closed");
-            break;
+        int64_t dst_b = lo * static_cast<int64_t>(esize) + r_off;
+        size_t k = 0;
+        if (reduce_phase || !sg) {
+          // reduce-scatter stages into contiguous scratch (then one
+          // region-aware accumulate); packed allgather lands in place
+          char* dst = reduce_phase ? ring_scratch_.data() + r_off
+                                   : buf + dst_b;
+          if (rx) {
+            k = rx->TryPop(dst, want);
+          } else {
+            int kk = rxs->RecvSome(dst, want);
+            if (kk < 0) {
+              err = Status::Error("segmented ring recv from rank " +
+                                  std::to_string(left) +
+                                  " failed or closed");
+              break;
+            }
+            k = static_cast<size_t>(kk);
           }
-          k = static_cast<size_t>(kk);
+        } else {
+          // scatter-gather allgather phase: bytes land straight in the
+          // destination regions (readv over the pieces)
+          if (rx) {
+            wr.ForRange(dst_b, dst_b + static_cast<int64_t>(want),
+                        [&](char* p, int64_t n) {
+                          size_t kk = rx->TryPop(p, static_cast<size_t>(n));
+                          k += kk;
+                          return kk == static_cast<size_t>(n);
+                        });
+          } else {
+            struct iovec iov[16];
+            int cnt = wr.Iovecs(dst_b, dst_b + static_cast<int64_t>(want),
+                                iov, 16);
+            int kk = cnt > 0 ? rxs->RecvvSome(iov, cnt) : 0;
+            if (kk < 0) {
+              err = Status::Error("segmented ring recv from rank " +
+                                  std::to_string(left) +
+                                  " failed or closed");
+              break;
+            }
+            k = static_cast<size_t>(kk);
+          }
         }
         if (k > 0) {
           if (r_off == 0) timeline_.RingSegStart("ring/recv", "SEG_RECV");
@@ -3725,8 +4217,8 @@ Status Engine::RingAllreduceGroupSegmented(char* buf, int64_t nelems,
               // while this runs, the left neighbor keeps filling the
               // transport with segment s+1 — the overlap this loop buys
               timeline_.RingSegStart("ring/accum", "SEG_ACCUM");
-              Accumulate(buf + lo * static_cast<int64_t>(esize),
-                         ring_scratch_.data(), hi - lo, dtype);
+              AccumulateRegions(wr, lo, ring_scratch_.data(), hi - lo,
+                                dtype);
               timeline_.RingSegEnd("ring/accum");
             }
             r_off = 0;
@@ -3768,7 +4260,7 @@ Status Engine::RingAllreduceGroupSegmented(char* buf, int64_t nelems,
       // past the peer timeout.
       bo.idle++;
       struct pollfd p;
-      p.fd = rxs->fd();
+      p.fd = rxs->recv_fd();
       p.events = POLLIN;
       p.revents = 0;
       ::poll(&p, 1, (tx && send_avail > 0) ? 1 : 50);
@@ -3785,6 +4277,7 @@ Status Engine::RingAllreduceGroupSegmented(char* buf, int64_t nelems,
     }
   }
 
+  if (last_lane >= 0) timeline_.RingSegEnd(kStripeLane[last_lane]);
   if (idle_since) idle_ns += NowNs() - idle_since;
   ring_runs_seg_.fetch_add(1, std::memory_order_relaxed);
   ring_segments_.fetch_add(segments, std::memory_order_relaxed);
@@ -3801,17 +4294,16 @@ Status Engine::RingAllreduceGroupSegmented(char* buf, int64_t nelems,
 // flow per host pair on the slow links instead of local_size flows), then
 // broadcast the result within each host.  Wire cost on the cross links
 // drops from 2(n-1)/n per rank to 2(h-1)/h per host.
-Status Engine::HierarchicalAllreduce(char* buf, int64_t nelems, DType dtype) {
-  Status st = RingAllreduceGroup(buf, nelems, dtype, local_group_);
+Status Engine::HierarchicalAllreduce(const WireRegions& wr, int64_t nelems,
+                                     DType dtype) {
+  Status st = RingAllreduceGroup(wr, nelems, dtype, local_group_);
   if (!st.ok()) return st;
   int local_root = local_group_.front();
   if (rank_ == local_root && cross_group_.size() > 1) {
-    st = RingAllreduceGroup(buf, nelems, dtype, cross_group_);
+    st = RingAllreduceGroup(wr, nelems, dtype, cross_group_);
     if (!st.ok()) return st;
   }
-  return TreeBroadcastGroup(buf,
-                            nelems * static_cast<int64_t>(DTypeSize(dtype)),
-                            local_root, local_group_);
+  return TreeBroadcastRegions(wr, local_root, local_group_);
 }
 
 // Variable-sized ring allgather over a subgroup: member block b travels
@@ -3881,8 +4373,8 @@ Status Engine::RingAllgatherGroupSegmented(
   ShmRing* rx = left < static_cast<int>(shm_rx_.size())
                     ? shm_rx_[left].get()
                     : nullptr;
-  Socket* txs = tx ? nullptr : &peers_[right];
-  Socket* rxs = rx ? nullptr : &peers_[left];
+  Link* txs = tx ? nullptr : &peers_[right];
+  Link* rxs = rx ? nullptr : &peers_[left];
 
   // block travelling on step t: I send (me - t), receive (me - t - 1) —
   // which is precisely my step-t+1 send, so recv progress gates sends
@@ -4044,7 +4536,7 @@ Status Engine::RingAllgatherGroupSegmented(
     else if (rxs && rt <= last_step && bo.idle >= 64) {
       bo.idle++;
       struct pollfd p;
-      p.fd = rxs->fd();
+      p.fd = rxs->recv_fd();
       p.events = POLLIN;
       p.revents = 0;
       ::poll(&p, 1, (tx && send_avail > 0) ? 1 : 50);
@@ -4236,6 +4728,150 @@ void Engine::ExecuteBroadcast(const Response& resp, TensorEntry& entry) {
   MarkDone(entry.handle, Status::OK(), entry.req.dims, std::move(entry.data));
 }
 
+// Segment-windowed pairwise alltoall: up to HOROVOD_TPU_ALLTOALL_WINDOW
+// (default 4) step exchanges progress concurrently, each nibbling its
+// block in ring-segment-sized pieces over its own peer link.  Pure byte
+// movement to disjoint offsets — results are bitwise identical to the
+// monolithic exchange for any window/segment/stripe setting by
+// construction (scheduling moves WHEN bytes land, never where).
+Status Engine::AlltoallWindowed(const char* send, int64_t blk,
+                                const std::vector<int64_t>& recv_off,
+                                const std::vector<int64_t>& recv_rows,
+                                int64_t stride, size_t esize, char* out,
+                                int64_t seg_bytes) {
+  struct StepState {
+    int to = 0, from = 0;
+    int64_t sleft = 0, soff = 0;  // send block remaining / cursor
+    int64_t rleft = 0, roff = 0;  // recv block remaining / cursor
+    bool done() const { return sleft == 0 && rleft == 0; }
+  };
+  const int last = size_ - 1;
+  // parsed once per process (hot data-plane path); per-rank divergence
+  // would be benign — the oldest incomplete step is always in-window on
+  // both endpoints, so mismatched depths cannot deadlock, only deepen
+  // one side's concurrency
+  static const int64_t wmax_env =
+      EnvInt64("HOROVOD_TPU_ALLTOALL_WINDOW", 4);
+  int64_t wmax = wmax_env;
+  if (wmax < 1) wmax = 1;
+  if (wmax > last) wmax = last;
+  std::deque<StepState> win;
+  int next_step = 1;
+  auto admit = [&] {
+    while (static_cast<int64_t>(win.size()) < wmax && next_step <= last) {
+      StepState ss;
+      ss.to = (rank_ + next_step) % size_;
+      ss.from = (rank_ - next_step + size_) % size_;
+      ss.sleft = blk;
+      ss.rleft = recv_rows[ss.from] * stride * static_cast<int64_t>(esize);
+      FaultInjector::Get().OnLink(ss.to);
+      if (ss.from != ss.to) FaultInjector::Get().OnLink(ss.from);
+      win.push_back(ss);
+      next_step++;
+    }
+  };
+  admit();
+  alltoall_windowed_.fetch_add(1, std::memory_order_relaxed);
+  auto last_prog = std::chrono::steady_clock::now();
+  Backoff bo;
+  while (!win.empty()) {
+    bool prog = false;
+    for (auto& ss : win) {
+      if (ss.sleft > 0) {
+        ShmRing* tx = ss.to < static_cast<int>(shm_tx_.size())
+                          ? shm_tx_[ss.to].get()
+                          : nullptr;
+        int64_t nib = ss.sleft < seg_bytes ? ss.sleft : seg_bytes;
+        const char* p = send + ss.to * blk + ss.soff;
+        size_t k;
+        if (tx) {
+          k = tx->TryPush(p, static_cast<size_t>(nib));
+        } else {
+          int kk = peers_[ss.to].SendSome(p, static_cast<size_t>(nib));
+          if (kk < 0)
+            return Status::Error("windowed alltoall send to rank " +
+                                 std::to_string(ss.to) + " failed");
+          k = static_cast<size_t>(kk);
+        }
+        if (k > 0) {
+          ss.soff += static_cast<int64_t>(k);
+          ss.sleft -= static_cast<int64_t>(k);
+          prog = true;
+        }
+      }
+      if (ss.rleft > 0) {
+        ShmRing* rx = ss.from < static_cast<int>(shm_rx_.size())
+                          ? shm_rx_[ss.from].get()
+                          : nullptr;
+        int64_t nib = ss.rleft < seg_bytes ? ss.rleft : seg_bytes;
+        char* p = out + recv_off[ss.from] * static_cast<int64_t>(esize) +
+                  ss.roff;
+        size_t k;
+        if (rx) {
+          k = rx->TryPop(p, static_cast<size_t>(nib));
+        } else {
+          int kk = peers_[ss.from].RecvSome(p, static_cast<size_t>(nib));
+          if (kk < 0)
+            return Status::Error("windowed alltoall recv from rank " +
+                                 std::to_string(ss.from) +
+                                 " failed or closed");
+          k = static_cast<size_t>(kk);
+        }
+        if (k > 0) {
+          ss.roff += static_cast<int64_t>(k);
+          ss.rleft -= static_cast<int64_t>(k);
+          prog = true;
+        }
+      }
+    }
+    // retire finished steps (they may finish out of order) and admit the
+    // next ones so the window stays full
+    for (auto it = win.begin(); it != win.end();)
+      it = it->done() ? win.erase(it) : it + 1;
+    admit();
+    if (win.empty()) break;
+    if (prog) {
+      bo.Progress();
+      last_prog = std::chrono::steady_clock::now();
+      continue;
+    }
+    if (Aborting()) return AbortedStatus();
+    // deterministic wait like the other TCP loops: when a TCP send is
+    // among the blockers, sleep the exactly-known pace refill or park in
+    // poll(POLLOUT) on its cursor stripe (capped short — other window
+    // steps still need service); otherwise the generic ladder
+    {
+      Link* blocked_tx = nullptr;
+      int64_t tx_want = 0;
+      for (const auto& ss : win) {
+        if (ss.sleft > 0 &&
+            !(ss.to < static_cast<int>(shm_tx_.size()) &&
+              shm_tx_[ss.to])) {
+          blocked_tx = &peers_[ss.to];
+          tx_want = ss.sleft < seg_bytes ? ss.sleft : seg_bytes;
+          break;
+        }
+      }
+      if (blocked_tx)
+        SendBlockedWait(bo, *blocked_tx, static_cast<size_t>(tx_want),
+                        /*fast_rx=*/true);
+      else
+        bo.Wait();
+    }
+    if (Stalled(last_prog, Timeouts().duplex)) {
+      std::ostringstream who;
+      for (const auto& ss : win) {
+        if (who.tellp() > 0) who << ", ";
+        who << "rank " << ss.to << " (send) / rank " << ss.from
+            << " (recv)";
+      }
+      return PeerDeadStatus("windowed alltoall", who.str(),
+                            Timeouts().duplex);
+    }
+  }
+  return Status::OK();
+}
+
 // Pairwise-exchange alltoall: rank i sends its j-th row-block to rank j.
 // Requires dim0 divisible by size (validated at enqueue in the frontend).
 void Engine::ExecuteAlltoall(const Response& resp, TensorEntry& entry) {
@@ -4258,19 +4894,32 @@ void Engine::ExecuteAlltoall(const Response& resp, TensorEntry& entry) {
   // own block
   std::memcpy(out.data() + recv_off[rank_] * esize,
               entry.data.data() + rank_ * blk, static_cast<size_t>(blk));
-  for (int step = 1; step < size_; step++) {
-    int to = (rank_ + step) % size_;
-    int from = (rank_ - step + size_) % size_;
-    Status st = PeerSendRecv(
-        to, entry.data.data() + to * blk, static_cast<size_t>(blk),
-        from, out.data() + recv_off[from] * esize,
-        static_cast<size_t>(recv_rows[from] * stride) * esize);
-    if (!st.ok()) {
-      Status err = Status::Error("alltoall failed: " + st.message);
-      MarkDone(entry.handle, err, {}, {});
-      DataPlaneFail(err);
-      return;
+  int64_t seg = ring_segment_bytes_.load(std::memory_order_relaxed);
+  Status st;
+  if (seg > 0 && size_ > 1) {
+    // segment-windowed pairwise exchange (the ring's (step, segment)
+    // machinery): several steps stream concurrently over their distinct
+    // peer links instead of barriering on one whole-block duplex at a
+    // time, so one paced or slow partner no longer serializes the rest
+    st = AlltoallWindowed(entry.data.data(), blk, recv_off, recv_rows,
+                          stride, esize, out.data(), seg);
+  } else {
+    // HOROVOD_TPU_RING_SEGMENT_BYTES=0: the historical monolithic
+    // pairwise exchange (bisection knob)
+    for (int step = 1; step < size_ && st.ok(); step++) {
+      int to = (rank_ + step) % size_;
+      int from = (rank_ - step + size_) % size_;
+      st = PeerSendRecv(
+          to, entry.data.data() + to * blk, static_cast<size_t>(blk),
+          from, out.data() + recv_off[from] * esize,
+          static_cast<size_t>(recv_rows[from] * stride) * esize);
     }
+  }
+  if (!st.ok()) {
+    Status err = Status::Error("alltoall failed: " + st.message);
+    MarkDone(entry.handle, err, {}, {});
+    DataPlaneFail(err);
+    return;
   }
   std::vector<int64_t> out_dims = entry.req.dims;
   if (out_dims.empty()) out_dims = {1};
@@ -4450,6 +5099,40 @@ void hvd_ring_stats(int64_t* out) {
     return;
   }
   g_engine->RingStats(out);
+}
+
+// Striped-wire + scatter-gather statistics for this rank, in order:
+// {configured cross-link stripes (x NICs), configured local-link stripes,
+// live active-stripe cap, stripe quantum bytes, SG threshold bytes,
+// SG bytes that skipped the pack memcpys, bytes packed into fusion
+// buffers, windowed alltoall runs, then per-stripe tx payload bytes for
+// stripes 0..7 summed over all links}.  All -1 when the engine is down.
+// The byte series are COUNTED (pure functions of workload + protocol), so
+// they gate CI where wall-clock series cannot: stripes>1 shows up as
+// traffic on stripe indices >= 1, and scatter-gather as pack bytes
+// dropping while sg bytes rise.
+void hvd_wire_stats(int64_t* out) {
+  if (!g_engine) {
+    for (int i = 0; i < 16; i++) out[i] = -1;
+    return;
+  }
+  g_engine->WireStats(out);
+}
+
+// Topology descriptor (hosts x NICs x ranks) as a malloc'd JSON string
+// (free via hvd_free_cstr); NULL when the engine is down.  Surfaces the
+// ring order and per-link stripe counts the wire actually uses.
+const char* hvd_topology_describe() {
+  if (!g_engine) return nullptr;
+  return strdup(g_engine->TopoJson().c_str());
+}
+
+// Chaos hook (tests only): half-close stripe `stripe` of the link to
+// `peer`, so every transfer riding it fails promptly — the dead-stripe
+// chaos row asserts the failure surfaces as a rank-naming abort within
+// the fault-domain bound, not a mystery socket error.
+void hvd_debug_kill_stripe(int peer, int stripe) {
+  if (g_engine) g_engine->KillStripe(peer, stripe);
 }
 
 // Diagnostic: standalone throughput (GB/s of dst bytes) of the in-place

@@ -15,8 +15,6 @@
 #include <cstdlib>
 #include <thread>
 
-#include "fault.h"
-
 namespace hvdtpu {
 
 namespace {
@@ -24,13 +22,6 @@ namespace {
 Status Errno(const std::string& what) {
   return Status::Error(what + ": " + strerror(errno));
 }
-
-// Duplex no-progress bound, shared with the engine's mixed shm/TCP
-// progress loops via fault.cc's single parse chain (explicit
-// HOROVOD_TPU_DATA_PLANE_TIMEOUT_SECS wins, else the fault domain's
-// HOROVOD_TPU_PEER_TIMEOUT_S, default 60; 0 disables), so the pure-TCP
-// and shm-mixed paths stall out identically.
-double DuplexTimeoutSecs() { return DuplexTimeoutSeconds(); }
 
 void SetNoDelay(int fd) {
   int one = 1;
@@ -45,53 +36,21 @@ void SetNoDelay(int fd) {
 
 }  // namespace
 
-Socket& Socket::operator=(Socket&& o) noexcept {
-  if (this != &o) {
-    Close();
-    fd_ = o.fd_;
-    pace_rate_ = o.pace_rate_;
-    pace_tokens_ = o.pace_tokens_;
-    pace_last_ = o.pace_last_;
-    o.fd_ = -1;
-  }
-  return *this;
-}
+// ---------------------------------------------------------------------------
+// PaceBucket
+// ---------------------------------------------------------------------------
 
-void Socket::SetPacing(double bytes_per_sec) {
-  pace_rate_ = bytes_per_sec > 0 ? bytes_per_sec : 0.0;
-  pace_tokens_ = 0.0;
-  pace_last_ = std::chrono::steady_clock::now();
-}
-
-double Socket::PaceDelaySeconds(size_t want) const {
-  if (pace_rate_ <= 0 || want == 0) return 0.0;
+size_t PaceBucket::Allowance(size_t want) {
+  if (rate <= 0) return want;
   auto now = std::chrono::steady_clock::now();
-  double dt = std::chrono::duration<double>(now - pace_last_).count();
-  // mirror PaceAllowance's burst/quantum arithmetic WITHOUT mutating the
-  // bucket: the answer is "how long until PaceAllowance would say yes"
-  double burst = pace_rate_ * 0.020;
-  if (burst < 64 * 1024) burst = 64 * 1024;
-  double tokens = pace_tokens_ + pace_rate_ * dt;
-  if (tokens > burst) tokens = burst;
-  double quantum = 256.0 * 1024;
-  if (quantum > static_cast<double>(want)) quantum = static_cast<double>(want);
-  if (quantum > burst) quantum = burst;
-  if (quantum < 1.0) quantum = 1.0;
-  if (tokens >= quantum) return 0.0;
-  return (quantum - tokens) / pace_rate_;
-}
-
-size_t Socket::PaceAllowance(size_t want) {
-  if (pace_rate_ <= 0) return want;
-  auto now = std::chrono::steady_clock::now();
-  double dt = std::chrono::duration<double>(now - pace_last_).count();
-  pace_last_ = now;
+  double dt = std::chrono::duration<double>(now - last).count();
+  last = now;
   // burst cap ~20 ms of line rate (min 64 KB so tiny rates still move
   // whole control messages): bounds the backlog a sleepy sender can dump
-  double burst = pace_rate_ * 0.020;
+  double burst = rate * 0.020;
   if (burst < 64 * 1024) burst = 64 * 1024;
-  pace_tokens_ += pace_rate_ * dt;
-  if (pace_tokens_ > burst) pace_tokens_ = burst;
+  tokens += rate * dt;
+  if (tokens > burst) tokens = burst;
   // batch paced sends into >= quantum chunks (capped by want and the
   // burst budget): letting sub-quantum trickles through makes the duplex
   // progress loops wake at the backoff's ~50 us granularity and spend
@@ -103,11 +62,42 @@ size_t Socket::PaceAllowance(size_t want) {
   double quantum = 256.0 * 1024;
   if (quantum > static_cast<double>(want)) quantum = static_cast<double>(want);
   if (quantum > burst) quantum = burst;
-  if (pace_tokens_ < quantum || pace_tokens_ < 1.0) return 0;
-  double allowed = pace_tokens_ < static_cast<double>(want)
-                       ? pace_tokens_
-                       : static_cast<double>(want);
+  if (tokens < quantum || tokens < 1.0) return 0;
+  double allowed =
+      tokens < static_cast<double>(want) ? tokens : static_cast<double>(want);
   return static_cast<size_t>(allowed);
+}
+
+double PaceBucket::DelaySeconds(size_t want) const {
+  if (rate <= 0 || want == 0) return 0.0;
+  auto now = std::chrono::steady_clock::now();
+  double dt = std::chrono::duration<double>(now - last).count();
+  // mirror Allowance's burst/quantum arithmetic WITHOUT mutating the
+  // bucket: the answer is "how long until Allowance would say yes"
+  double burst = rate * 0.020;
+  if (burst < 64 * 1024) burst = 64 * 1024;
+  double have = tokens + rate * dt;
+  if (have > burst) have = burst;
+  double quantum = 256.0 * 1024;
+  if (quantum > static_cast<double>(want)) quantum = static_cast<double>(want);
+  if (quantum > burst) quantum = burst;
+  if (quantum < 1.0) quantum = 1.0;
+  if (have >= quantum) return 0.0;
+  return (quantum - have) / rate;
+}
+
+// ---------------------------------------------------------------------------
+// Socket
+// ---------------------------------------------------------------------------
+
+Socket& Socket::operator=(Socket&& o) noexcept {
+  if (this != &o) {
+    Close();
+    fd_ = o.fd_;
+    pace_ = o.pace_;
+    o.fd_ = -1;
+  }
+  return *this;
 }
 
 Socket::~Socket() { Close(); }
@@ -119,15 +109,19 @@ void Socket::Close() {
   }
 }
 
+void Socket::ShutdownBoth() {
+  if (fd_ >= 0) ::shutdown(fd_, SHUT_RDWR);
+}
+
 Status Socket::SendAll(const void* data, size_t n) {
   const char* p = static_cast<const char*>(data);
   while (n > 0) {
-    size_t chunk = PaceAllowance(n);
+    size_t chunk = pace_.Allowance(n);
     if (chunk == 0) {
       // paced out: the refill time is known exactly — sleep it instead
       // of a fixed 1 ms guess (bounded so a pathological rate can't park
       // the control plane for seconds)
-      int64_t us = static_cast<int64_t>(PaceDelaySeconds(n) * 1e6);
+      int64_t us = static_cast<int64_t>(pace_.DelaySeconds(n) * 1e6);
       std::this_thread::sleep_for(std::chrono::microseconds(
           us < 50 ? 50 : us > 100000 ? 100000 : us));
       continue;
@@ -137,7 +131,7 @@ Status Socket::SendAll(const void* data, size_t n) {
       if (errno == EINTR) continue;
       return Errno("send");
     }
-    ConsumePace(static_cast<size_t>(k));
+    pace_.Consume(static_cast<size_t>(k));
     p += k;
     n -= static_cast<size_t>(k);
   }
@@ -159,22 +153,17 @@ Status Socket::RecvAll(void* data, size_t n) {
   return Status::OK();
 }
 
-int Socket::SendSome(const void* data, size_t n) {
-  size_t chunk = PaceAllowance(n);
-  if (chunk == 0) return 0;  // paced out == would-block to callers
+int Socket::RawSendSome(const void* data, size_t n) {
   while (true) {
-    ssize_t k = ::send(fd_, data, chunk, MSG_NOSIGNAL | MSG_DONTWAIT);
-    if (k >= 0) {
-      ConsumePace(static_cast<size_t>(k));
-      return static_cast<int>(k);
-    }
+    ssize_t k = ::send(fd_, data, n, MSG_NOSIGNAL | MSG_DONTWAIT);
+    if (k >= 0) return static_cast<int>(k);
     if (errno == EINTR) continue;
     if (errno == EAGAIN || errno == EWOULDBLOCK) return 0;
     return -1;
   }
 }
 
-int Socket::RecvSome(void* data, size_t n) {
+int Socket::RawRecvSome(void* data, size_t n) {
   while (true) {
     ssize_t k = ::recv(fd_, data, n, MSG_DONTWAIT);
     if (k > 0) return static_cast<int>(k);
@@ -185,113 +174,44 @@ int Socket::RecvSome(void* data, size_t n) {
   }
 }
 
-Status Socket::SendRecv(Socket& send_sock, const void* send_buf, size_t send_n,
-                        Socket& recv_sock, void* recv_buf, size_t recv_n,
-                        int64_t* idle_ns) {
-  auto now_ns = [] {
-    return std::chrono::duration_cast<std::chrono::nanoseconds>(
-               std::chrono::steady_clock::now().time_since_epoch())
-        .count();
-  };
-  const char* sp = static_cast<const char*>(send_buf);
-  char* rp = static_cast<char*>(recv_buf);
-  size_t sleft = send_n, rleft = recv_n;
-  // No progress on either direction for the (configurable) duplex bound
-  // is the failure condition; a paced sender waiting on its token bucket
-  // is NOT stuck, so the deadline resets on progress rather than being
-  // one fixed poll timeout.
-  const double limit_s = DuplexTimeoutSecs();
-  auto last_progress = std::chrono::steady_clock::now();
-  while (sleft > 0 || rleft > 0) {
-    size_t schunk = 0;
-    struct pollfd fds[2];
-    int nf = 0;
-    int si = -1, ri = -1;
-    if (sleft > 0) {
-      schunk = send_sock.PaceAllowance(sleft);
-      if (schunk > 0) {
-        si = nf;
-        fds[nf].fd = send_sock.fd_;
-        fds[nf].events = POLLOUT;
-        nf++;
-      }
-    }
-    if (rleft > 0) {
-      ri = nf;
-      fds[nf].fd = recv_sock.fd_;
-      fds[nf].events = POLLIN;
-      nf++;
-    }
-    if (nf == 0) {
-      // only a paced-out send remains: sleep exactly the bucket-refill
-      // time instead of a fixed 1 ms tick
-      int64_t us =
-          static_cast<int64_t>(send_sock.PaceDelaySeconds(sleft) * 1e6);
-      int64_t w0 = idle_ns ? now_ns() : 0;
-      std::this_thread::sleep_for(std::chrono::microseconds(
-          us < 50 ? 50 : us > 100000 ? 100000 : us));
-      if (idle_ns) *idle_ns += now_ns() - w0;
-    } else {
-      // when the send side is paced out, poll only until the KNOWN
-      // bucket-refill time so it re-checks exactly then instead of a
-      // guessed 5 ms; cap by the configured no-progress bound so a
-      // short bound is enforced promptly, not after a 60 s poll.  The
-      // 1 s ceiling keeps the fault domain's abort latch checked at
-      // least once a second (a wedged peer's exchange must cancel fast
-      // once the job aborts) at a cost of ~1 wakeup/s.
-      int base_ms = 1000;
-      if (limit_s > 0 && limit_s * 1000 < base_ms)
-        base_ms = static_cast<int>(limit_s * 1000) + 1;
-      int timeout_ms = base_ms;
-      if (sleft > 0 && si < 0) {
-        timeout_ms = static_cast<int>(
-                         send_sock.PaceDelaySeconds(sleft) * 1000) + 1;
-        if (timeout_ms > base_ms) timeout_ms = base_ms;
-      }
-      // time inside poll is exactly time with no bytes moving on either
-      // direction — the wire-idle the segmented ring exists to shrink
-      int64_t w0 = idle_ns ? now_ns() : 0;
-      int rc = ::poll(fds, nf, timeout_ms);
-      if (idle_ns) *idle_ns += now_ns() - w0;
-      if (rc < 0) {
-        if (errno == EINTR) continue;
-        return Errno("poll");
-      }
-      if (si >= 0 && (fds[si].revents & (POLLOUT | POLLERR | POLLHUP))) {
-        ssize_t k =
-            ::send(send_sock.fd_, sp, schunk, MSG_NOSIGNAL | MSG_DONTWAIT);
-        if (k < 0 && errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR)
-          return Errno("send");
-        if (k > 0) {
-          send_sock.ConsumePace(static_cast<size_t>(k));
-          sp += k;
-          sleft -= static_cast<size_t>(k);
-          last_progress = std::chrono::steady_clock::now();
-        }
-      }
-      if (ri >= 0 && (fds[ri].revents & (POLLIN | POLLERR | POLLHUP))) {
-        ssize_t k = ::recv(recv_sock.fd_, rp, rleft, MSG_DONTWAIT);
-        if (k < 0 && errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR)
-          return Errno("recv");
-        if (k == 0) return Status::Error("peer closed connection");
-        if (k > 0) {
-          rp += k;
-          rleft -= static_cast<size_t>(k);
-          last_progress = std::chrono::steady_clock::now();
-        }
-      }
-    }
-    if (Aborting())
-      return Status::Error(
-          "job abort in progress — transfer cancelled before completion");
-    if (limit_s > 0 &&
-        std::chrono::duration<double>(std::chrono::steady_clock::now() -
-                                      last_progress)
-                .count() > limit_s)
-      return Status::Error("send_recv made no progress inside the timeout");
+int Socket::RawSendvSome(const struct iovec* iov, int iovcnt) {
+  struct msghdr msg;
+  memset(&msg, 0, sizeof(msg));
+  msg.msg_iov = const_cast<struct iovec*>(iov);
+  msg.msg_iovlen = static_cast<size_t>(iovcnt);
+  while (true) {
+    ssize_t k = ::sendmsg(fd_, &msg, MSG_NOSIGNAL | MSG_DONTWAIT);
+    if (k >= 0) return static_cast<int>(k);
+    if (errno == EINTR) continue;
+    if (errno == EAGAIN || errno == EWOULDBLOCK) return 0;
+    return -1;
   }
-  return Status::OK();
 }
+
+int Socket::RawRecvvSome(const struct iovec* iov, int iovcnt) {
+  struct msghdr msg;
+  memset(&msg, 0, sizeof(msg));
+  msg.msg_iov = const_cast<struct iovec*>(iov);
+  msg.msg_iovlen = static_cast<size_t>(iovcnt);
+  while (true) {
+    ssize_t k = ::recvmsg(fd_, &msg, MSG_DONTWAIT);
+    if (k > 0) return static_cast<int>(k);
+    if (k == 0) return -1;
+    if (errno == EINTR) continue;
+    if (errno == EAGAIN || errno == EWOULDBLOCK) return 0;
+    return -1;
+  }
+}
+
+int Socket::SendSome(const void* data, size_t n) {
+  size_t chunk = pace_.Allowance(n);
+  if (chunk == 0) return 0;  // paced out == would-block to callers
+  int k = RawSendSome(data, chunk);
+  if (k > 0) pace_.Consume(static_cast<size_t>(k));
+  return k;
+}
+
+int Socket::RecvSome(void* data, size_t n) { return RawRecvSome(data, n); }
 
 Status Socket::SendFrame(const std::string& payload) {
   uint64_t len = payload.size();
@@ -362,6 +282,213 @@ Status Socket::Connect(const std::string& host, int port, Socket* out,
   return Status::Error("connect to " + host + ":" + std::to_string(port) +
                        " timed out (" + err + ")");
 }
+
+// ---------------------------------------------------------------------------
+// Link — one logical peer connection over K striped TCP sockets
+// ---------------------------------------------------------------------------
+
+Link::Link(Link&& o) noexcept
+    : n_(o.n_), quantum_(o.quantum_), send_idx_(o.send_idx_),
+      send_off_(o.send_off_), recv_idx_(o.recv_idx_), recv_off_(o.recv_off_),
+      pace_(o.pace_) {
+  active_.store(o.active_.load(std::memory_order_relaxed),
+                std::memory_order_relaxed);
+  for (int i = 0; i < kMaxStripes; i++) {
+    socks_[i] = std::move(o.socks_[i]);
+    tx_bytes_[i].store(o.tx_bytes_[i].load(std::memory_order_relaxed),
+                       std::memory_order_relaxed);
+  }
+  o.n_ = 0;
+}
+
+Link& Link::operator=(Link&& o) noexcept {
+  if (this != &o) {
+    Close();
+    n_ = o.n_;
+    quantum_ = o.quantum_;
+    send_idx_ = o.send_idx_;
+    send_off_ = o.send_off_;
+    recv_idx_ = o.recv_idx_;
+    recv_off_ = o.recv_off_;
+    pace_ = o.pace_;
+    active_.store(o.active_.load(std::memory_order_relaxed),
+                  std::memory_order_relaxed);
+    for (int i = 0; i < kMaxStripes; i++) {
+      socks_[i] = std::move(o.socks_[i]);
+      tx_bytes_[i].store(o.tx_bytes_[i].load(std::memory_order_relaxed),
+                         std::memory_order_relaxed);
+    }
+    o.n_ = 0;
+  }
+  return *this;
+}
+
+void Link::Configure(int64_t quantum_bytes) {
+  if (quantum_bytes < (4 << 10)) quantum_bytes = 4 << 10;
+  if (quantum_bytes > (8 << 20)) quantum_bytes = 8 << 20;
+  quantum_ = quantum_bytes;
+}
+
+void Link::SetStripe(int i, Socket&& s) {
+  if (i < 0 || i >= kMaxStripes) return;
+  socks_[i] = std::move(s);
+  if (i + 1 > n_) n_ = i + 1;
+}
+
+void Link::SetActiveStripes(int k) {
+  if (k < 1) k = 1;
+  if (k > kMaxStripes) k = kMaxStripes;
+  active_.store(k, std::memory_order_relaxed);
+  // cursors deliberately NOT reset: the effective K history (applied at
+  // agreed stream positions) is what keeps both endpoints in lockstep
+  if (send_idx_ >= ActiveK() && send_off_ == 0) send_idx_ = 0;
+  if (recv_idx_ >= ActiveK() && recv_off_ == 0) recv_idx_ = 0;
+}
+
+int Link::ActiveK() const {
+  int k = active_.load(std::memory_order_relaxed);
+  return k < n_ ? k : (n_ > 0 ? n_ : 1);
+}
+
+void Link::Close() {
+  for (int i = 0; i < kMaxStripes; i++) socks_[i].Close();
+  n_ = 0;
+}
+
+void Link::KillStripe(int i) {
+  if (i >= 0 && i < n_) socks_[i].ShutdownBoth();
+}
+
+void Link::AdvanceSend(size_t k) {
+  send_off_ += static_cast<int64_t>(k);
+  tx_bytes_[send_idx_].fetch_add(static_cast<int64_t>(k),
+                                 std::memory_order_relaxed);
+  if (send_off_ >= quantum_) {
+    send_off_ = 0;
+    send_idx_ = (send_idx_ + 1) % ActiveK();
+  }
+}
+
+void Link::AdvanceRecv(size_t k) {
+  recv_off_ += static_cast<int64_t>(k);
+  if (recv_off_ >= quantum_) {
+    recv_off_ = 0;
+    recv_idx_ = (recv_idx_ + 1) % ActiveK();
+  }
+}
+
+int Link::SendSome(const void* data, size_t n) {
+  if (n_ == 0) return -1;
+  size_t quota = static_cast<size_t>(quantum_ - send_off_);
+  size_t want = n < quota ? n : quota;
+  size_t allow = pace_.Allowance(want);
+  if (allow == 0) return 0;  // paced out == would-block
+  int k = socks_[send_idx_].RawSendSome(data, allow);
+  if (k > 0) {
+    pace_.Consume(static_cast<size_t>(k));
+    AdvanceSend(static_cast<size_t>(k));
+  }
+  return k;
+}
+
+int Link::RecvSome(void* data, size_t n) {
+  if (n_ == 0) return -1;
+  size_t quota = static_cast<size_t>(quantum_ - recv_off_);
+  size_t want = n < quota ? n : quota;
+  int k = socks_[recv_idx_].RawRecvSome(data, want);
+  if (k > 0) AdvanceRecv(static_cast<size_t>(k));
+  return k;
+}
+
+namespace {
+// Trim an iovec list to a byte budget (and the fixed 16-entry cap) —
+// the single clamp rule both striped scatter-gather directions share.
+int TrimIovecs(const struct iovec* iov, int iovcnt, size_t budget,
+               struct iovec* out) {
+  int cnt = 0;
+  size_t left = budget;
+  for (int i = 0; i < iovcnt && cnt < 16 && left > 0; i++) {
+    size_t take = iov[i].iov_len < left ? iov[i].iov_len : left;
+    if (take == 0) continue;
+    out[cnt].iov_base = iov[i].iov_base;
+    out[cnt].iov_len = take;
+    left -= take;
+    cnt++;
+  }
+  return cnt;
+}
+}  // namespace
+
+int Link::SendvSome(const struct iovec* iov, int iovcnt) {
+  if (n_ == 0) return -1;
+  size_t total = 0;
+  for (int i = 0; i < iovcnt; i++) total += iov[i].iov_len;
+  size_t quota = static_cast<size_t>(quantum_ - send_off_);
+  size_t want = total < quota ? total : quota;
+  size_t allow = pace_.Allowance(want);
+  if (allow == 0) return 0;
+  struct iovec trimmed[16];
+  int cnt = TrimIovecs(iov, iovcnt, allow, trimmed);
+  if (cnt == 0) return 0;
+  int k = socks_[send_idx_].RawSendvSome(trimmed, cnt);
+  if (k > 0) {
+    pace_.Consume(static_cast<size_t>(k));
+    AdvanceSend(static_cast<size_t>(k));
+  }
+  return k;
+}
+
+int Link::RecvvSome(const struct iovec* iov, int iovcnt) {
+  if (n_ == 0) return -1;
+  size_t quota = static_cast<size_t>(quantum_ - recv_off_);
+  struct iovec trimmed[16];
+  int cnt = TrimIovecs(iov, iovcnt, quota, trimmed);
+  if (cnt == 0) return 0;
+  int k = socks_[recv_idx_].RawRecvvSome(trimmed, cnt);
+  if (k > 0) AdvanceRecv(static_cast<size_t>(k));
+  return k;
+}
+
+Status Link::SendAll(const void* data, size_t n) {
+  const char* p = static_cast<const char*>(data);
+  while (n > 0) {
+    int k = SendSome(p, n);
+    if (k < 0)
+      return Status::Error("striped send failed on stripe " +
+                           std::to_string(send_idx_));
+    if (k == 0) {
+      double d = pace_.DelaySeconds(n);
+      int64_t us = static_cast<int64_t>(d * 1e6);
+      std::this_thread::sleep_for(std::chrono::microseconds(
+          us < 50 ? 50 : us > 100000 ? 100000 : us));
+      continue;
+    }
+    p += k;
+    n -= static_cast<size_t>(k);
+  }
+  return Status::OK();
+}
+
+Status Link::RecvAll(void* data, size_t n) {
+  char* p = static_cast<char*>(data);
+  while (n > 0) {
+    int k = RecvSome(p, n);
+    if (k < 0)
+      return Status::Error("striped recv failed or closed on stripe " +
+                           std::to_string(recv_idx_));
+    if (k == 0) {
+      std::this_thread::sleep_for(std::chrono::microseconds(50));
+      continue;
+    }
+    p += k;
+    n -= static_cast<size_t>(k);
+  }
+  return Status::OK();
+}
+
+// ---------------------------------------------------------------------------
+// Listener
+// ---------------------------------------------------------------------------
 
 Status Listener::Listen(const std::string& host, int port) {
   fd_ = ::socket(AF_INET, SOCK_STREAM, 0);

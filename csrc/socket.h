@@ -2,8 +2,18 @@
 // the peer-to-peer data plane.  Role analog: the transport MPI provided the
 // reference; here it is plain TCP, matching the Spark launcher's TCP service
 // pattern (/root/reference/horovod/spark/util/network.py) re-done natively.
+//
+// The data plane speaks through Link: one LOGICAL peer connection striped
+// over K parallel TCP sockets (wire v6).  Striping is a deterministic
+// round-robin of fixed-size quanta of the logical byte stream, so the
+// receiver reassembles the exact sequence the sender produced for ANY K —
+// the transport can never change collective results, only how many kernel
+// flows (and congestion windows) carry them.
 #pragma once
 
+#include <sys/uio.h>
+
+#include <atomic>
 #include <chrono>
 #include <cstdint>
 #include <string>
@@ -13,44 +23,68 @@
 
 namespace hvdtpu {
 
+// Userspace token-bucket egress pacing (0 disables).  One bucket paces one
+// LOGICAL link: Socket embeds one for the single-stream case, and Link
+// shares one across all of its stripes so K paced streams still honor the
+// configured aggregate rate exactly (the pacing semantics and the
+// deterministic PaceDelaySeconds sleeps are unchanged by striping).
+struct PaceBucket {
+  double rate = 0.0;    // bytes/sec; 0 = unpaced
+  double tokens = 0.0;  // current fill (bytes)
+  std::chrono::steady_clock::time_point last{};
+
+  void Reset(double bytes_per_sec) {
+    rate = bytes_per_sec > 0 ? bytes_per_sec : 0.0;
+    tokens = 0.0;
+    last = std::chrono::steady_clock::now();
+  }
+  // Refill and return how many of `want` bytes may be sent now (0 = caller
+  // should back off); Consume after the real send.
+  size_t Allowance(size_t want);
+  // Seconds until the bucket could cover a send of `want` bytes
+  // (quantum-batched, same arithmetic as Allowance); pure read, so callers
+  // may sleep exactly this long instead of guessing.
+  double DelaySeconds(size_t want) const;
+  void Consume(size_t sent) { tokens -= static_cast<double>(sent); }
+};
+
 class Socket {
  public:
   Socket() = default;
   explicit Socket(int fd) : fd_(fd) {}
   Socket(const Socket&) = delete;
   Socket& operator=(const Socket&) = delete;
-  Socket(Socket&& o) noexcept
-      : fd_(o.fd_), pace_rate_(o.pace_rate_), pace_tokens_(o.pace_tokens_),
-        pace_last_(o.pace_last_) {
-    o.fd_ = -1;
-  }
+  Socket(Socket&& o) noexcept : fd_(o.fd_), pace_(o.pace_) { o.fd_ = -1; }
   Socket& operator=(Socket&& o) noexcept;
   ~Socket();
 
   bool valid() const { return fd_ >= 0; }
   int fd() const { return fd_; }
   void Close();
+  // Half-close both directions WITHOUT releasing the fd: every blocked or
+  // future transfer on this socket fails promptly, but no other thread can
+  // race a kernel fd-number reuse — the chaos hook killing one stripe of a
+  // live link mid-collective uses this instead of Close.
+  void ShutdownBoth();
 
   // Blocking helpers (loop over partial transfers; EINTR-safe).
   Status SendAll(const void* data, size_t n);
   Status RecvAll(void* data, size_t n);
 
-  // Simultaneous send+recv via poll(): required by ring steps where every
-  // rank sends to one neighbor while receiving from the other — pure
-  // blocking send-then-recv deadlocks once payloads exceed kernel buffers.
-  // ``idle_ns``, when non-null, accumulates the time spent parked in
-  // poll()/sleep with neither direction moving — the engine's ring
-  // wire-idle accounting for the monolithic (unsegmented) path.
-  static Status SendRecv(Socket& send_sock, const void* send_buf, size_t send_n,
-                         Socket& recv_sock, void* recv_buf, size_t recv_n,
-                         int64_t* idle_ns = nullptr);
-
-  // Nonblocking partial transfers for the engine's mixed shm/TCP progress
-  // loops: bytes moved, 0 when the kernel would block, -1 on error (for
-  // RecvSome also on orderly peer close — the data plane never expects EOF
-  // mid-transfer).
+  // Nonblocking partial transfers: bytes moved, 0 when the kernel would
+  // block (or the pace bucket is dry), -1 on error (for RecvSome also on
+  // orderly peer close — the data plane never expects EOF mid-transfer).
   int SendSome(const void* data, size_t n);
   int RecvSome(void* data, size_t n);
+
+  // Raw nonblocking transfers used by Link, which owns the pacing: the
+  // scatter-gather forms run one sendmsg/recvmsg over the iovec array so a
+  // fused tensor group wires straight from/to scattered tensor memory with
+  // no pack/unpack staging.
+  int RawSendSome(const void* data, size_t n);
+  int RawRecvSome(void* data, size_t n);
+  int RawSendvSome(const struct iovec* iov, int iovcnt);
+  int RawRecvvSome(const struct iovec* iov, int iovcnt);
 
   // Length-prefixed frames.
   Status SendFrame(const std::string& payload);
@@ -65,33 +99,102 @@ class Socket {
   // other hosts can reach us at (multi-host data-plane advertising).
   std::string LocalAddr() const;
 
-  // Userspace token-bucket egress pacing (0 disables).  The engine
-  // applies it to CROSS-HOST peer sockets when
-  // HOROVOD_TPU_CROSS_HOST_PACE_MBPS is set: on a single test machine it
-  // models the asymmetric intra/inter-host link cost the hierarchical
-  // paths exist for (reference rationale: operations.cc two-level
-  // allreduce), and on real fabrics it doubles as an egress throttle.
-  // Single-threaded per socket, like every other Socket method here.
-  void SetPacing(double bytes_per_sec);
-
-  // Seconds until the token bucket could cover a send of `want` bytes
-  // (quantum-batched, same arithmetic as PaceAllowance); 0 when unpaced
-  // or tokens are already available.  Pure read — the bucket state is
-  // untouched, so callers may sleep exactly this long instead of running
-  // the generic spin/yield/sleep backoff ladder (the refill time is the
-  // one wait the sender can compute instead of guess).
-  double PaceDelaySeconds(size_t want) const;
+  // Single-stream pacing (control-plane use; data-plane links pace at the
+  // Link level).  Single-threaded per socket, like every method here.
+  void SetPacing(double bytes_per_sec) { pace_.Reset(bytes_per_sec); }
+  double PaceDelaySeconds(size_t want) const {
+    return pace_.DelaySeconds(want);
+  }
 
  private:
-  // Refill the bucket and return how many of `want` bytes may be sent
-  // now (0 = caller should back off); ConsumePace after the real send.
-  size_t PaceAllowance(size_t want);
-  void ConsumePace(size_t sent) { pace_tokens_ -= static_cast<double>(sent); }
-
   int fd_ = -1;
-  double pace_rate_ = 0.0;    // bytes/sec; 0 = unpaced
-  double pace_tokens_ = 0.0;  // current bucket fill (bytes)
-  std::chrono::steady_clock::time_point pace_last_{};
+  PaceBucket pace_;
+};
+
+// One logical data-plane peer connection striped over up to kMaxStripes
+// parallel TCP sockets.  The logical byte stream is cut into fixed
+// `quantum` chunks assigned round-robin to the active stripes: chunk c
+// rides stripe c % K, and each side advances its cursor deterministically,
+// so for a given (quantum, active-K history) the reassembled stream is
+// bit-identical to a single socket — striping is invisible to every layer
+// above the transport.  The active count may be capped live (the autotune
+// K dimension); both endpoints apply cap changes at the same collective
+// boundary, so their cursors never diverge.  Single-threaded, like Socket:
+// whichever thread runs the wire owns the link.
+class Link {
+ public:
+  static constexpr int kMaxStripes = 8;
+
+  Link() = default;
+  Link(Link&& o) noexcept;
+  Link& operator=(Link&& o) noexcept;
+
+  // Round-robin grain; rank-0-decided and bootstrap-shipped (both ends of
+  // every link must agree or streams reassemble wrong).
+  void Configure(int64_t quantum_bytes);
+  // Install the socket for stripe index `i` (bootstrap: stripes of one
+  // link may be accepted in any order).
+  void SetStripe(int i, Socket&& s);
+  int stripes() const { return n_; }
+  // Cap the round-robin to the first k stripes (autotuned K).  Cursor
+  // arithmetic depends on the cap HISTORY, so callers only change it at
+  // stream positions both endpoints agree on (collective boundaries).
+  void SetActiveStripes(int k);
+  int active_stripes() const {
+    return active_.load(std::memory_order_relaxed);
+  }
+  bool valid() const { return n_ > 0 && socks_[0].valid(); }
+  void Close();
+  // Chaos hook: half-close one stripe so transfers on it fail promptly
+  // (tests/test_fault.py's dead-stripe row).
+  void KillStripe(int i);
+
+  void SetPacing(double bytes_per_sec) { pace_.Reset(bytes_per_sec); }
+  double PaceDelaySeconds(size_t want) const {
+    return pace_.DelaySeconds(want);
+  }
+
+  // Nonblocking transfers over the logical stream: bytes moved, 0 on
+  // would-block/paced-out, -1 on error.  At most one stripe quantum per
+  // call (callers loop); the scatter-gather form wires the iovec pieces
+  // with one sendmsg/recvmsg.
+  int SendSome(const void* data, size_t n);
+  int RecvSome(void* data, size_t n);
+  int SendvSome(const struct iovec* iov, int iovcnt);
+  int RecvvSome(const struct iovec* iov, int iovcnt);
+
+  // Blocking loops for the tiny bootstrap/shm handshakes.
+  Status SendAll(const void* data, size_t n);
+  Status RecvAll(void* data, size_t n);
+
+  // fds the next logical byte moves on — what progress loops poll.
+  int send_fd() const { return socks_[send_idx_].fd(); }
+  int recv_fd() const { return socks_[recv_idx_].fd(); }
+  int fd() const { return recv_fd(); }
+
+  // Stripe index the next logical send byte goes to (timeline lanes).
+  int send_stripe() const { return send_idx_; }
+  // Cumulative payload bytes sent on stripe i (telemetry; readable from
+  // the diagnostics thread).
+  int64_t stripe_tx_bytes(int i) const {
+    return tx_bytes_[i].load(std::memory_order_relaxed);
+  }
+
+ private:
+  int ActiveK() const;
+  void AdvanceSend(size_t k);
+  void AdvanceRecv(size_t k);
+
+  Socket socks_[kMaxStripes];
+  int n_ = 0;
+  std::atomic<int> active_{kMaxStripes};  // cap; effective K = min(cap, n_)
+  int64_t quantum_ = 64 << 10;
+  int send_idx_ = 0;
+  int64_t send_off_ = 0;  // bytes of the current quantum already sent
+  int recv_idx_ = 0;
+  int64_t recv_off_ = 0;
+  PaceBucket pace_;
+  std::atomic<int64_t> tx_bytes_[kMaxStripes] = {};
 };
 
 class Listener {

@@ -1,0 +1,109 @@
+// Topology descriptor: the generalization of the engine's historical fixed
+// local/cross split (HierarchicalAllreduce's two levels) into one queryable
+// object — hosts x NICs x ranks, derived from the bootstrap host table that
+// every rank receives verbatim, so every method returns the SAME answer on
+// every rank by construction (the property the two-level paths already
+// relied on implicitly).
+//
+// It answers the placement questions the striped wire (wire v6) adds:
+//  * how many TCP stripes should the link to peer j carry? — same-host
+//    links get the local count (loopback rarely benefits from more than
+//    one flow), cross-host links get the cross count multiplied by the
+//    host's NIC count (one stream set per NIC is the classic way to fill
+//    a multi-rail fabric; the pacing simulator models one rail, real
+//    fabrics report theirs via HOROVOD_TPU_NICS);
+//  * in what order should a FLAT ring visit the ranks? — host-contiguous
+//    order, so an n-rank ring crosses hosts exactly h times instead of up
+//    to n times.  Only the allreduce ring may be reordered: allgather/
+//    alltoall concat layouts are rank-indexed, so they keep rank order.
+//
+// All counts are rank-0-decided and shipped in the bootstrap table (like
+// cache capacity and pipeline depth): per-link stripe counts must agree on
+// BOTH endpoints or the striped streams reassemble wrong.
+#pragma once
+
+#include <algorithm>
+#include <map>
+#include <sstream>
+#include <string>
+#include <vector>
+
+namespace hvdtpu {
+
+struct Topology {
+  int rank = 0;
+  int size = 1;
+  int nics = 1;
+  int stripes_cross = 1;
+  int stripes_local = 1;
+  int max_stripes = 8;  // Link::kMaxStripes, injected to avoid the include
+  std::vector<std::string> hashes;               // host hash per rank
+  std::vector<int> local_group;                  // ranks on my host, sorted
+  std::vector<int> cross_group;                  // local roots (min per host)
+  std::vector<std::vector<int>> host_groups;     // all groups, by min rank
+
+  void Build(int rank_in, int size_in, const std::vector<std::string>& h,
+             int nics_in, int sc, int sl, int max_stripes_in) {
+    rank = rank_in;
+    size = size_in;
+    hashes = h;
+    nics = nics_in < 1 ? 1 : nics_in;
+    stripes_cross = sc < 1 ? 1 : sc;
+    stripes_local = sl < 1 ? 1 : sl;
+    max_stripes = max_stripes_in;
+    local_group.clear();
+    cross_group.clear();
+    host_groups.clear();
+    std::map<std::string, std::vector<int>> groups;
+    for (int i = 0; i < size; i++) groups[hashes[i]].push_back(i);
+    local_group = groups[hashes[rank]];
+    for (auto& [hh, g] : groups) cross_group.push_back(g.front());
+    std::sort(cross_group.begin(), cross_group.end());
+    for (int root : cross_group)
+      for (auto& [hh, g] : groups)
+        if (g.front() == root) host_groups.push_back(g);
+  }
+
+  bool multi_host() const { return host_groups.size() > 1; }
+  bool same_host(int a, int b) const { return hashes[a] == hashes[b]; }
+
+  // TCP stripe count for the link to `peer` (identical when evaluated on
+  // either endpoint: same_host is symmetric and the counts are shipped).
+  int LinkStripes(int peer) const {
+    int k = same_host(rank, peer) ? stripes_local : stripes_cross * nics;
+    if (k < 1) k = 1;
+    if (k > max_stripes) k = max_stripes;
+    return k;
+  }
+
+  // Host-contiguous visit order for the flat allreduce ring: the
+  // concatenation of the host groups (groups ordered by min member rank,
+  // members ascending).  Derived from the shared table, so every rank
+  // computes the same ring.
+  std::vector<int> RingOrder() const {
+    std::vector<int> order;
+    order.reserve(static_cast<size_t>(size));
+    for (const auto& g : host_groups)
+      for (int r : g) order.push_back(r);
+    return order;
+  }
+
+  // JSON description for diagnostics/tests (hvd_topology_describe).
+  std::string DescribeJson() const {
+    std::ostringstream os;
+    os << "{\"hosts\":" << host_groups.size() << ",\"nics\":" << nics
+       << ",\"size\":" << size << ",\"rank\":" << rank
+       << ",\"stripes_cross\":" << stripes_cross
+       << ",\"stripes_local\":" << stripes_local << ",\"ring_order\":[";
+    std::vector<int> order = RingOrder();
+    for (size_t i = 0; i < order.size(); i++)
+      os << (i ? "," : "") << order[i];
+    os << "],\"link_stripes\":[";
+    for (int j = 0; j < size; j++)
+      os << (j ? "," : "") << (j == rank ? 0 : LinkStripes(j));
+    os << "]}";
+    return os.str();
+  }
+};
+
+}  // namespace hvdtpu

@@ -179,6 +179,7 @@ std::string Serialize(const ResponseList& l) {
   PutI64(&s, l.tuned_hierarchical);
   PutI64(&s, l.tuned_pipeline_depth);
   PutI64(&s, l.tuned_segment_bytes);
+  PutI64(&s, l.tuned_wire_stripes);
   PutI64(&s, static_cast<int64_t>(l.responses.size()));
   for (const Response& r : l.responses) {
     PutI32(&s, static_cast<int32_t>(r.op));
@@ -201,6 +202,7 @@ Status Parse(const std::string& buf, ResponseList* out) {
   out->tuned_hierarchical = rd.I64();
   out->tuned_pipeline_depth = rd.I64();
   out->tuned_segment_bytes = rd.I64();
+  out->tuned_wire_stripes = rd.I64();
   int64_t n = rd.I64();
   if (n < 0 || n > (1 << 24)) return Status::Error("bad response count");
   out->responses.clear();
@@ -252,6 +254,7 @@ std::string Serialize(const CachedExecFrame& f) {
   PutI64(&s, f.tuned_hierarchical);
   PutI64(&s, f.tuned_pipeline_depth);
   PutI64(&s, f.tuned_segment_bytes);
+  PutI64(&s, f.tuned_wire_stripes);
   PutI64(&s, static_cast<int64_t>(f.groups.size()));
   for (const auto& g : f.groups) {
     PutI64(&s, static_cast<int64_t>(g.size()));
@@ -269,6 +272,7 @@ Status Parse(const std::string& buf, CachedExecFrame* out) {
   out->tuned_hierarchical = rd.I64();
   out->tuned_pipeline_depth = rd.I64();
   out->tuned_segment_bytes = rd.I64();
+  out->tuned_wire_stripes = rd.I64();
   int64_t ng = rd.I64();
   // bound counts by what the buffer could possibly hold BEFORE reserving:
   // a corrupt count must produce the clean parse error, not a multi-hundred

@@ -23,8 +23,10 @@ namespace hvdtpu {
 
 // Bump kWireVersion on ANY layout change (header, field order, new frame).
 constexpr uint32_t kWireMagic = 0x48564457u;  // "HVDW" little-endian
-constexpr uint16_t kWireVersion = 5;          // v5: fault domain
-                                              // (HEARTBEAT/ABORT frames)
+constexpr uint16_t kWireVersion = 6;          // v6: striped wire
+                                              // (tuned_wire_stripes knob;
+                                              // striped data-plane hellos
+                                              // and bootstrap-table fields)
 
 enum class FrameType : uint16_t {
   kInvalid = 0,
@@ -70,6 +72,7 @@ struct ResponseList {
   int64_t tuned_hierarchical = -1;  // 0/1 when the autotuner owns the knob
   int64_t tuned_pipeline_depth = -1;  // >=1 when the autotuner owns the knob
   int64_t tuned_segment_bytes = -1;   // >=1 when the autotuner owns the knob
+  int64_t tuned_wire_stripes = -1;    // >=1 when the autotuner owns the knob
 };
 
 // Steady-state claim: "every cache slot whose bit is set holds an entry
@@ -95,6 +98,7 @@ struct CachedExecFrame {
   int64_t tuned_hierarchical = -1;
   int64_t tuned_pipeline_depth = -1;
   int64_t tuned_segment_bytes = -1;
+  int64_t tuned_wire_stripes = -1;
 };
 
 // Idle-tick liveness probe (fault domain): any control frame refreshes the

@@ -87,6 +87,22 @@ def main(argv=None) -> int:
                          "is on the wire while the previous one "
                          "accumulates; 0 restores the monolithic per-step "
                          "ring (bisection)")
+    ap.add_argument("--wire-stripes", type=int, default=None, metavar="K",
+                    help="TCP stripes per data-plane link (sets "
+                         "HOROVOD_TPU_WIRE_STRIPES for every worker; "
+                         "default 1). Each peer link is striped over K "
+                         "parallel connections with segments round-robined "
+                         "across them — K congestion windows drive a "
+                         "congested or paced link instead of one; results "
+                         "are bitwise identical for any K")
+    ap.add_argument("--sg-threshold", type=int, default=None,
+                    metavar="BYTES",
+                    help="scatter-gather threshold (sets "
+                         "HOROVOD_TPU_SG_THRESHOLD_BYTES for every worker; "
+                         "default 4194304, 0 disables). Fused tensors at "
+                         "least this large wire straight from tensor "
+                         "memory via writev/readv, skipping both fusion-"
+                         "buffer memcpys")
     ap.add_argument("--peer-timeout", type=float, default=None, metavar="S",
                     help="peer-death detection bound in seconds (sets "
                          "HOROVOD_TPU_PEER_TIMEOUT_S for every worker; "
@@ -199,6 +215,10 @@ def main(argv=None) -> int:
         if args.ring_segment_bytes is not None:
             env["HOROVOD_TPU_RING_SEGMENT_BYTES"] = str(
                 args.ring_segment_bytes)
+        if args.wire_stripes is not None:
+            env["HOROVOD_TPU_WIRE_STRIPES"] = str(args.wire_stripes)
+        if args.sg_threshold is not None:
+            env["HOROVOD_TPU_SG_THRESHOLD_BYTES"] = str(args.sg_threshold)
         if args.peer_timeout is not None:
             env["HOROVOD_TPU_PEER_TIMEOUT_S"] = str(args.peer_timeout)
         # each worker leads its own process group so a stuck worker's whole

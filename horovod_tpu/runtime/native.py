@@ -196,6 +196,15 @@ def _bind(lib):
         lib.hvd_frame_parse_error.restype = ctypes.c_void_p  # manual free
     except AttributeError:
         pass
+    try:
+        # striped wire + scatter-gather (wire v6); same prebuilt-.so caveat
+        lib.hvd_wire_stats.argtypes = [ctypes.POINTER(ctypes.c_int64)]
+        lib.hvd_wire_stats.restype = None
+        lib.hvd_topology_describe.restype = ctypes.c_void_p  # manual free
+        lib.hvd_debug_kill_stripe.argtypes = [ctypes.c_int, ctypes.c_int]
+        lib.hvd_debug_kill_stripe.restype = None
+    except AttributeError:
+        pass
     return lib
 
 
@@ -268,6 +277,48 @@ class NativeEngine(Engine):
         d.update(self._pipeline_stats())
         d.update(self._ring_stats())
         d.update(self._fault_stats())
+        d.update(self._wire_stats())
+        return d
+
+    def topology_describe(self) -> dict | None:
+        """The engine's topology descriptor (hosts x NICs x ranks): ring
+        order and per-link stripe counts as the wire actually uses them.
+        None when the loaded .so (or the engine) predates the striped
+        wire."""
+        import json
+
+        fn = getattr(self._lib, "hvd_topology_describe", None)
+        if fn is None:
+            return None
+        p = fn()
+        if not p:
+            return None
+        try:
+            return json.loads(ctypes.cast(p, ctypes.c_char_p).value.decode())
+        finally:
+            self._lib.hvd_free_cstr(p)
+
+    def _wire_stats(self) -> dict:
+        """Striped-wire + scatter-gather counters for THIS rank.  The byte
+        series are counted (pure functions of workload + protocol): with
+        K stripes the per-stripe tx bytes spread across indices 0..K-1,
+        and with scatter-gather on, ``sg_bytes_skipped`` rises while
+        ``pack_bytes`` stops growing for tensors above the threshold.
+        Zeros when the loaded .so predates the striped wire."""
+        fn = getattr(self._lib, "hvd_wire_stats", None)
+        keys = ("wire_stripes_cross", "wire_stripes_local", "wire_stripes",
+                "wire_stripe_quantum_bytes", "sg_threshold_bytes",
+                "sg_bytes_skipped", "pack_bytes", "alltoall_windowed")
+        if fn is None:
+            d = dict.fromkeys(keys, 0)
+            d["wire_stripes"] = 1
+            d["wire_stripe_bytes"] = [0] * 8
+            return d
+        vals = (ctypes.c_int64 * 16)()
+        fn(vals)
+        d = {k: max(int(v), 0) for k, v in zip(keys, vals)}
+        d["wire_stripes"] = max(d["wire_stripes"], 1)
+        d["wire_stripe_bytes"] = [max(int(vals[8 + s]), 0) for s in range(8)]
         return d
 
     def _fault_stats(self) -> dict:
@@ -386,7 +437,10 @@ class NativeEngine(Engine):
                      "cache_evictions": 0, "negotiation_bytes": 0,
                      "ring_segments": 0, "ring_bytes": 0,
                      "peer_timeouts": 0, "aborts": 0, "heartbeats_tx": 0,
-                     "heartbeats_rx": 0}
+                     "heartbeats_rx": 0, "sg_bytes_skipped": 0,
+                     "pack_bytes": 0}
+        # per-stripe tx bytes: one labelled counter per stripe index
+        stripe_seen = [0] * 8
         cumulative = (
             ("stall_events", telemetry.NATIVE_STALL_EVENTS),
             ("cache_hits", telemetry.NATIVE_CACHE_HITS),
@@ -395,6 +449,8 @@ class NativeEngine(Engine):
             ("negotiation_bytes", telemetry.NATIVE_NEGOTIATION_BYTES),
             ("ring_segments", telemetry.NATIVE_RING_SEGMENTS),
             ("ring_bytes", telemetry.NATIVE_RING_BYTES),
+            ("sg_bytes_skipped", telemetry.NATIVE_SG_BYTES_SKIPPED),
+            ("pack_bytes", telemetry.NATIVE_PACK_BYTES),
             ("peer_timeouts", telemetry.NATIVE_PEER_TIMEOUTS),
             ("aborts", telemetry.NATIVE_ABORTS),
             ("heartbeats_tx", telemetry.NATIVE_HEARTBEATS_TX),
@@ -440,6 +496,9 @@ class NativeEngine(Engine):
                 d["ring_wire_idle_fraction"])
             reg.gauge(telemetry.NATIVE_RING_SEGMENT_BYTES).set(
                 d["ring_segment_bytes"])
+            reg.gauge(telemetry.NATIVE_WIRE_STRIPES).set(d["wire_stripes"])
+            reg.gauge(telemetry.NATIVE_SG_THRESHOLD).set(
+                d["sg_threshold_bytes"])
             if d["heartbeat_age_s"] >= 0:  # -1 = engine down: keep the
                 reg.gauge(telemetry.NATIVE_HEARTBEAT_AGE).set(  # last real age
                     d["heartbeat_age_s"])
@@ -449,6 +508,12 @@ class NativeEngine(Engine):
                     if delta > 0:
                         reg.counter(metric).inc(delta)
                         last_seen[key] = d[key]
+                for s, now_b in enumerate(d["wire_stripe_bytes"]):
+                    delta = now_b - stripe_seen[s]
+                    if delta > 0:
+                        reg.counter(telemetry.NATIVE_WIRE_STRIPE_BYTES,
+                                    stripe=str(s)).inc(delta)
+                        stripe_seen[s] = now_b
                 for stage, (ns_key, n_key) in stage_keys.items():
                     ns0, n0 = stage_seen[stage]
                     dns, dn = d[ns_key] - ns0, d[n_key] - n0

@@ -13,7 +13,8 @@ from __future__ import annotations
 
 # csrc/wire.h — frame header
 WIRE_MAGIC = 0x48564457  # "HVDW" little-endian
-WIRE_VERSION = 5         # v5: fault domain (HEARTBEAT/ABORT frames)
+WIRE_VERSION = 6         # v6: striped wire (tuned_wire_stripes knob;
+                         # striped data-plane hellos + bootstrap fields)
 
 # csrc/wire.h — FrameType
 FRAME_INVALID = 0
@@ -44,6 +45,20 @@ def frame_header(version: int = WIRE_VERSION,
     import struct
 
     return struct.pack("<IHH", WIRE_MAGIC, version, frame_type)
+
+# csrc/wire.h — autotuner-sync fields carried by ResponseList AND
+# CachedExecFrame, in serialization order (each an int64, -1 = no change).
+# tools/check_wire_abi.py parses both struct bodies and asserts this list
+# matches EXACTLY — adding a tuned knob without mirroring it here (and
+# bumping WIRE_VERSION) is the drift this guard exists to catch.
+TUNED_KNOBS = (
+    "tuned_fusion",
+    "tuned_cycle_us",
+    "tuned_hierarchical",
+    "tuned_pipeline_depth",
+    "tuned_segment_bytes",
+    "tuned_wire_stripes",
+)
 
 # csrc/common.h — OpType (the request/response op codes on the wire)
 OP_ALLREDUCE = 0

@@ -78,6 +78,16 @@ NATIVE_RING_WIRE_IDLE = "hvd_ring_wire_idle_fraction"
 NATIVE_RING_SEGMENT_BYTES = "hvd_ring_segment_bytes"
 NATIVE_RING_SEGMENTS = "hvd_ring_segments_total"
 NATIVE_RING_BYTES = "hvd_ring_bytes_total"
+# striped wire + scatter-gather (csrc K-stripe links, wire v6): the
+# stripes gauge is the live active-stripe cap; per-stripe tx bytes carry a
+# stripe="0".."7" label (traffic on indices >= 1 IS striping working);
+# sg_bytes_skipped counts fusion-buffer pack memcpys avoided by wiring
+# large tensors in place, pack_bytes the memcpys that still ran
+NATIVE_WIRE_STRIPES = "hvd_wire_stripes"
+NATIVE_WIRE_STRIPE_BYTES = "hvd_wire_stripe_bytes_total"
+NATIVE_SG_BYTES_SKIPPED = "hvd_sg_bytes_skipped_total"
+NATIVE_PACK_BYTES = "hvd_pack_bytes_total"
+NATIVE_SG_THRESHOLD = "hvd_sg_threshold_bytes"
 # fault domain (csrc peer-death detection + coordinated abort, PR 5):
 # heartbeat age is the oldest control-plane silence this rank observes
 # (an age approaching hvd_peer_timeout IS a detection in progress); the
@@ -335,6 +345,8 @@ __all__ = [
     "NATIVE_PIPELINE_DEPTH", "NATIVE_PIPELINE_STAGE_SECONDS",
     "NATIVE_RING_WIRE_IDLE", "NATIVE_RING_SEGMENT_BYTES",
     "NATIVE_RING_SEGMENTS", "NATIVE_RING_BYTES",
+    "NATIVE_WIRE_STRIPES", "NATIVE_WIRE_STRIPE_BYTES",
+    "NATIVE_SG_BYTES_SKIPPED", "NATIVE_PACK_BYTES", "NATIVE_SG_THRESHOLD",
     "NATIVE_HEARTBEAT_AGE", "NATIVE_PEER_TIMEOUTS", "NATIVE_ABORTS",
     "NATIVE_ABORT_LATENCY", "NATIVE_HEARTBEATS_TX", "NATIVE_HEARTBEATS_RX",
 ]

@@ -672,15 +672,28 @@ def scenario_ring_equiv():
             chunks.append(np.ascontiguousarray(hvd.allreduce(
                 arr, average=False,
                 name=f"re.{np.dtype(dtype).name}.{sz}")))
-    # fused batch through the pooled fusion buffer and the segmented loop
+    # fused batch through the pooled fusion buffer and the segmented loop.
+    # The two 65552-element tensors are scatter-gather bait: 262208 bytes
+    # each, a 64-byte multiple at a 64-byte-aligned logical offset, so a
+    # test that sets HOROVOD_TPU_SG_THRESHOLD_BYTES <= 262208 makes them
+    # wire in place while the small tails still pack — and the results
+    # must stay bitwise identical either way.
+    fused_sizes = [65552, 65552, 8192 + 3, 8192 + 3, 8192 + 3, 1001]
     handles = [
         hvd.allreduce_async(
-            (rng.standard_normal(8192 + 3) * (r + i)).astype(np.float32),
+            (rng.standard_normal(sz) * (r + i)).astype(np.float32),
             average=False, name=f"ref{i}")
-        for i in range(6)
+        for i, sz in enumerate(fused_sizes)
     ]
     for h in handles:
         chunks.append(np.ascontiguousarray(hvd.synchronize(h)))
+    # pairwise alltoall through the (maybe) segment-windowed exchange:
+    # disjoint-offset byte movement only, so windowed vs monolithic (and
+    # any stripe count) must be bitwise identical
+    for i, rows in enumerate((1, 3, 173)):
+        arr = (rng.standard_normal((rows * n, 5)) * (r + 2)).astype(
+            np.float32)
+        chunks.append(np.ascontiguousarray(hvd.alltoall(arr, name=f"ra{i}")))
     # standalone allgather through the (maybe) segment-windowed exchange:
     # variable rank-dependent first dims make the member blocks unequal,
     # straddling the segment size (PR 5 satellite: allgather gets the same
@@ -698,9 +711,27 @@ def scenario_ring_equiv():
             assert d["ring_collectives_segmented"] > 0, d
             assert d["ring_segments"] > 0, d
             assert d["ring_collectives_monolithic"] == 0, d
+            assert d["alltoall_windowed"] > 0, d
         else:
             assert d["ring_collectives_segmented"] == 0, d
             assert d["ring_collectives_monolithic"] > 0, d
+            assert d["alltoall_windowed"] == 0, d
+    expect_stripes = os.environ.get("HVD_TEST_EXPECT_STRIPES")
+    if expect_stripes is not None:
+        # the wire actually striped: the active count matches and, when
+        # TCP carried traffic, stripe indices >= 1 moved payload bytes
+        d = _diag()
+        k = int(expect_stripes)
+        assert d["wire_stripes"] == k, d
+        if k > 1 and os.environ.get("HVD_TEST_EXPECT_STRIPE_TRAFFIC") == "1":
+            assert d["wire_stripe_bytes"][k - 1] > 0, d
+    expect_sg = os.environ.get("HVD_TEST_EXPECT_SG")
+    if expect_sg is not None:
+        d = _diag()
+        if expect_sg == "1":
+            assert d["sg_bytes_skipped"] > 0, d
+        else:
+            assert d["sg_bytes_skipped"] == 0, d
     blob = b"".join(c.tobytes() for c in chunks)
     with open(os.path.join(out_dir, f"ring_equiv_r{r}.bin"), "wb") as f:
         f.write(blob)
@@ -716,6 +747,39 @@ def scenario_ring_equiv_hier():
     os.environ["HOROVOD_TPU_HOST_HASH"] = f"simhost{r // 2}"
     os.environ["HOROVOD_TPU_HIERARCHICAL_ALLREDUCE"] = "1"
     scenario_ring_equiv()
+
+
+def scenario_ring_equiv_paced_flat():
+    """scenario_ring_equiv on a simulated every-rank-its-own-host topology
+    with paced cross-host links and the FLAT ring forced: every byte rides
+    paced TCP, the regime the striped wire exists for."""
+    r = int(os.environ["HOROVOD_TPU_RANK"])
+    os.environ["HOROVOD_TPU_HOST_HASH"] = f"simhost{r}"
+    os.environ["HOROVOD_TPU_HIERARCHICAL_ALLREDUCE"] = "0"
+    scenario_ring_equiv()
+
+
+def scenario_topo_describe():
+    """Topology descriptor sanity: every rank sees the same ring order, a
+    zero self-entry in link_stripes, and the configured stripe count on
+    every peer link."""
+    hvd.init()
+    from horovod_tpu.runtime import state as _state
+
+    r, n = hvd.rank(), hvd.size()
+    t = _state.engine().topology_describe()
+    assert t is not None and t["size"] == n and t["rank"] == r, t
+    assert sorted(t["ring_order"]) == list(range(n)), t
+    ks = t["link_stripes"]
+    want = int(os.environ.get("HOROVOD_TPU_WIRE_STRIPES", "1"))
+    assert len(ks) == n and ks[r] == 0, t
+    for j in range(n):
+        if j != r:
+            assert ks[j] == want, (t, want)
+    out = hvd.allreduce(np.ones(8, np.float32), average=False, name="warm")
+    assert np.allclose(out, n)
+    hvd.shutdown()
+    print(f"rank {r}: topo OK", flush=True)
 
 
 def scenario_skewed_shutdown():
@@ -768,6 +832,39 @@ def scenario_fault_loop():
         print(f"rank {r}: FAULT: {e}", flush=True)
         sys.exit(7)
     print(f"rank {r}: fault loop ran dry with no fault", flush=True)
+
+
+def scenario_stripe_chaos():
+    """Striped-wire chaos workload: a steady big-tensor allreduce stream
+    over K TCP stripes; after a short warmup, rank 1 half-closes ONE
+    stripe of its link to rank 0 mid-ring (the hvd_debug_kill_stripe
+    hook).  Every rank must exit non-zero with an error NAMING a rank —
+    a dead stripe flows through the PR 5 fault domain like a dead peer,
+    not as a silent hang or a mystery socket error."""
+    import threading
+    import time
+
+    from horovod_tpu.runtime import state as _state
+
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    if r == 1:
+        def killer():
+            time.sleep(float(os.environ.get("HVD_TEST_KILL_AFTER_S", "0.3")))
+            eng = _state.engine()
+            eng._lib.hvd_debug_kill_stripe(0, 1)  # stripe 1 of the 0-link
+            print("rank 1: stripe 1 of link to rank 0 killed", flush=True)
+
+        threading.Thread(target=killer, daemon=True).start()
+    data = np.full(1 << 20, float(r), np.float32)
+    try:
+        for step in range(5000):
+            out = hvd.allreduce(data, average=False, name="sc")
+            assert out is not None
+    except RuntimeError as e:
+        print(f"rank {r}: FAULT: {e}", flush=True)
+        sys.exit(7)
+    print(f"rank {r}: stripe chaos ran dry with no fault", flush=True)
 
 
 def scenario_fault_idle():

@@ -18,6 +18,12 @@ the checked-in artifact:
   (BENCH_r08): exact functions of (payload, ring size, segment size) —
   drift means the windowing silently changed shape, gated at 1% both
   directions.
+
+* striped-wire ``stripe_kb_per_step`` / ``pack_kb_per_step`` /
+  ``sg_kb_per_step`` (BENCH_r10): exact functions of (payload, ring
+  size, stripe layout, SG threshold) — drift means the stripe
+  round-robin or the scatter-gather split silently changed shape,
+  gated at 1% both directions.
 """
 
 import json
@@ -125,6 +131,76 @@ def test_fault_bench_detection_bounded():
             lat = p["detect_to_all_exited_s"]
             assert lat is not None and lat < bound, (np_key, label, p)
     assert points >= 10, f"only {points} chaos points in BENCH_r09"
+
+
+def test_wire_counted_series_gate():
+    """Fresh striped + scatter-gather fused steps at the BENCH_r10
+    workload shape (-np 2, 4 stripes, 64 KB quantum, SG on) vs the
+    artifact: stripe KB/step, pack KB/step, and SG KB/step are exact
+    functions of (payload, ring size, stripe layout, SG threshold) — a
+    drift beyond 1% in EITHER direction means the striping or the SG
+    split silently changed shape, not noise.  The gate run skips the
+    artifact's pacing: pacing changes WHEN bytes move, never how many."""
+    old = _baseline("BENCH_r10.json")
+    cfg = old.get("config", {})
+    point = _bench_worker_json(
+        2,
+        ["--wire-worker", "--wire-steps", "4",
+         "--wire-mb", str(cfg.get("mb", 32))],
+        {"HOROVOD_TPU_PIPELINE_DEPTH": "1",
+         "HOROVOD_TPU_SHM": "0",
+         "HOROVOD_TPU_WIRE_STRIPES": "4",
+         "HOROVOD_TPU_STRIPE_QUANTUM_BYTES": "65536",
+         "HOROVOD_TPU_SG_THRESHOLD_BYTES":
+             str(cfg.get("sg_threshold_on", 1048576)),
+         # batching pinned LONGER than the bench's 20 ms so scheduler
+         # jitter can't split a step's 8 submissions across cycles (a
+         # solo tensor skips the fusion buffer and would dent the
+         # counted pack series)
+         "HOROVOD_TPU_CYCLE_TIME": "50",
+         "HOROVOD_TPU_BURST_WINDOW_US": "20000"},
+        timeout=300)
+    assert point.get("wire_stripes") == 4, point
+    new = {"np2": {"k4_sg_on": point}}
+    series_base = ["np2.k4_sg_on.stripe_kb_per_step",
+                   "np2.k4_sg_on.pack_kb_per_step",
+                   "np2.k4_sg_on.sg_kb_per_step"]
+    for direction in (":lower", ":higher"):
+        rows, code = bench_compare.compare(
+            old, new, [s + direction for s in series_base],
+            max_regression_pct=1.0)
+        assert code == 0, (direction, rows)
+
+
+def test_wire_artifact_shows_striping_and_sg_working():
+    """The acceptance shape, asserted on the checked-in artifact: K=4
+    spreads payload across all 4 stripe indices where K=1 uses one, and
+    SG-on moves the big tensors out of the counted pack series (pack
+    KB/step drops to the small tail; SG KB/step picks up the rest)."""
+    r10 = _baseline("BENCH_r10.json")
+    for np_key in ("np2", "np4"):
+        p = r10.get(np_key)
+        if not p:
+            continue
+        k4 = p["k4_sg_on"]
+        k1 = p["k1_sg_off"]
+        assert k4["stripes_carrying_traffic"] == 4, k4
+        assert k1["stripes_carrying_traffic"] == 1, k1
+        by_stripe = k4["stripe_kb_per_step_by_stripe"]
+        assert all(b > 0 for b in by_stripe[:4]), by_stripe
+        assert k1["stripe_kb_per_step_by_stripe"][1] == 0, k1
+        # SG: the pack series drops by the big tensors' share...
+        assert k4["pack_kb_per_step"] < p["k4_sg_off"]["pack_kb_per_step"], p
+        assert k4["sg_kb_per_step"] > 0, k4
+        assert p["k4_sg_off"]["sg_kb_per_step"] == 0, p
+        # ...while the wire moves the same bytes either way (counted).
+        # The idle-fraction/wall series are deliberately NOT asserted:
+        # on this shared 2-core host they move run-to-run (the bench
+        # records them with cpu_saturated caveats); the counted stripe
+        # spread above IS the stable K>1 signal.
+        assert abs(k4["stripe_kb_per_step"]
+                   - p["k4_sg_off"]["stripe_kb_per_step"]) <= max(
+            0.01 * k4["stripe_kb_per_step"], 1.0), p
 
 
 def test_ring_counted_series_gate():

@@ -108,6 +108,29 @@ def test_kill_at_pack():
     _assert_died_well(res, dead_rank=1, np_=2)
 
 
+def test_stripe_death_mid_ring():
+    """Wire v6 dead-stripe row: ONE of the 4 TCP stripes of a live link
+    half-closes mid-ring (hvd_debug_kill_stripe).  The transfer riding
+    that stripe must fail promptly and flow through the PR 5 fault
+    domain: every rank exits non-zero with an error NAMING a rank inside
+    the bound — not a hang waiting on the 3 healthy stripes, and not a
+    bare errno with no culprit."""
+    import re
+
+    res = _run_chaos("stripe_chaos", 2, "",
+                     extra_env={"HOROVOD_TPU_SHM": "0",
+                                "HOROVOD_TPU_WIRE_STRIPES": "4"})
+    assert res.returncode != 0, res.stdout + res.stderr
+    assert res.elapsed < EXIT_WALL_S, (
+        f"took {res.elapsed:.0f}s — dead stripe not detected in bound")
+    assert "stripe 1 of link to rank 0 killed" in res.stdout, res.stdout
+    faults = [l for l in res.stdout.splitlines() if ": FAULT:" in l]
+    assert faults, res.stdout + res.stderr
+    for line in faults:
+        assert re.search(r"rank \d", line.split("FAULT:", 1)[1]), line
+    assert "ran dry" not in res.stdout, "stripe kill never bit"
+
+
 def test_coordinator_death():
     """Rank 0 dies mid-ring: workers must self-abort via the lost-
     coordinator path (socket reset or heartbeat age), not hang."""

@@ -653,6 +653,158 @@ def test_autotune_ring_segment_opt_in(tmp_path):
     assert cells <= {65536, 131072, 262144, 524288, 1048576}, cells
 
 
+# ---------------------------------------------------------------------------
+# striped wire + scatter-gather (wire v6)
+# ---------------------------------------------------------------------------
+
+def _wire_equiv_blobs(tmp_path, scenario, np_, base_env, configs):
+    """Like _ring_equiv_blobs, but each config carries its own full env
+    overlay (stripe count, SG threshold, expectation probes).  All configs
+    run the segmented ring at 64 KB so the ONLY variables are the stripe
+    count and the scatter-gather split — which must never change results:
+    striping is a deterministic round-robin of the same byte stream, and
+    SG only moves where fused bytes live, never their logical order."""
+    blobs = {}
+    for label, env_over in configs:
+        out = tmp_path / label
+        out.mkdir()
+        env = dict(base_env)
+        env.update({
+            "HOROVOD_TPU_RING_SEGMENT_BYTES": "65536",
+            "HVD_TEST_OUT_DIR": str(out),
+            "HVD_TEST_EXPECT_SEGMENTED": "1",
+            "HOROVOD_TPU_CYCLE_TIME": "100",
+            "HOROVOD_TPU_BURST_WINDOW_US": "50000",
+        })
+        env.update(env_over)
+        res = _run(scenario, np_, timeout=240, env=env)
+        assert res.returncode == 0, res.stderr + res.stdout
+        for r in range(np_):
+            assert f"rank {r}: ring equiv OK" in res.stdout
+        blobs[label] = _read_rank_files(str(out), "ring_equiv", np_)
+    return blobs
+
+
+_SG_ON = {"HOROVOD_TPU_SG_THRESHOLD_BYTES": "262144",
+          "HVD_TEST_EXPECT_SG": "1"}
+_SG_OFF = {"HOROVOD_TPU_SG_THRESHOLD_BYTES": "0", "HVD_TEST_EXPECT_SG": "0"}
+
+
+def _stripe_cfg(k, sg, traffic=False):
+    env = {"HOROVOD_TPU_WIRE_STRIPES": str(k),
+           "HVD_TEST_EXPECT_STRIPES": str(k)}
+    env.update(_SG_ON if sg else _SG_OFF)
+    if traffic and k > 1:
+        env["HVD_TEST_EXPECT_STRIPE_TRAFFIC"] = "1"
+    return env
+
+
+def test_striped_sg_bitwise_tcp_fp16(tmp_path):
+    """K ∈ {1,2,4} parallel TCP stripes × scatter-gather on/off over plain
+    TCP (fp16 rows included) must all match the single-socket packed
+    baseline bitwise, with the per-stripe byte counters proving stripes
+    >= 1 actually carried payload."""
+    blobs = _wire_equiv_blobs(
+        tmp_path, "ring_equiv", 2,
+        {"HOROVOD_TPU_SHM": "0", "HVD_TEST_RING_FP16": "1"},
+        [("k1", _stripe_cfg(1, sg=False)),
+         ("k2_sg", _stripe_cfg(2, sg=True, traffic=True)),
+         ("k4_sg", _stripe_cfg(4, sg=True, traffic=True)),
+         ("k4", _stripe_cfg(4, sg=False, traffic=True))])
+    _assert_blobs_equal(blobs, "k1", 2)
+
+
+def test_striped_sg_bitwise_shm(tmp_path):
+    """Striping + SG must not disturb the shm fast path (same-host links
+    move bytes through the mapped rings; the striped TCP sockets idle)."""
+    blobs = _wire_equiv_blobs(
+        tmp_path, "ring_equiv", 2, {},
+        [("k1", _stripe_cfg(1, sg=False)),
+         ("k4_sg", _stripe_cfg(4, sg=True))])
+    _assert_blobs_equal(blobs, "k1", 2)
+
+
+def test_striped_sg_bitwise_paced_tcp(tmp_path):
+    """The target regime: every byte rides PACED cross-host TCP (one
+    simulated host per rank, flat ring).  K=4 + SG must match K=1 packed
+    bitwise while the shared per-link token bucket keeps pacing exact."""
+    blobs = _wire_equiv_blobs(
+        tmp_path, "ring_equiv_paced_flat", 2,
+        {"HOROVOD_TPU_CROSS_HOST_PACE_MBPS": "200"},
+        [("k1", _stripe_cfg(1, sg=False)),
+         ("k4_sg", _stripe_cfg(4, sg=True, traffic=True))])
+    _assert_blobs_equal(blobs, "k1", 2)
+
+
+def test_striped_sg_bitwise_hierarchical_paced(tmp_path):
+    """Two-level allreduce on a simulated 2x2-host topology with paced
+    cross links: the striped + scatter-gather wire runs inside the local
+    shm rings AND the paced cross-root ring, and must still match the
+    single-stripe packed baseline bitwise on every rank.  (No per-stripe
+    traffic probe: non-root ranks legitimately move zero TCP bytes.)"""
+    blobs = _wire_equiv_blobs(
+        tmp_path, "ring_equiv_hier", 4,
+        {"HOROVOD_TPU_CROSS_HOST_PACE_MBPS": "200"},
+        [("k1", _stripe_cfg(1, sg=False)),
+         ("k4_sg", _stripe_cfg(4, sg=True))])
+    _assert_blobs_equal(blobs, "k1", 4)
+
+
+def test_autotune_wire_stripes_opt_in(tmp_path):
+    """HOROVOD_TPU_AUTOTUNE_WIRE_STRIPES=1 adds the active stripe count
+    to the search ({1,2,4}, CSV column included) over plain TCP: the mesh
+    pre-opens 4 stripes, caps flip mid-stream through the tuned-frame
+    adoption path (both ends of every link at the same collective
+    boundary), and results stay correct throughout."""
+    log = tmp_path / "autotune.csv"
+    res = _run("autotune", 2, env={
+        "HOROVOD_AUTOTUNE": "1",
+        "HOROVOD_AUTOTUNE_LOG": str(log),
+        "HOROVOD_TPU_AUTOTUNE_WIRE_STRIPES": "1",
+        "HOROVOD_TPU_SHM": "0",
+        "HOROVOD_TPU_AUTOTUNE_CYCLES_PER_SAMPLE": "2",
+        "HOROVOD_TPU_AUTOTUNE_SAMPLES_PER_STEP": "2",
+        "HOROVOD_TPU_AUTOTUNE_WARMUP_SAMPLES": "1",
+        "HOROVOD_TPU_CYCLE_TIME": "1",
+    })
+    assert res.returncode == 0, res.stderr + res.stdout
+    lines = log.read_text().strip().splitlines()
+    assert lines[0] == ("fusion_threshold_bytes,cycle_time_us,"
+                        "hierarchical_allreduce,wire_stripes,"
+                        "score_bytes_per_us")
+    rows = [l.split(",") for l in lines[1:]]
+    assert len(rows) >= 3, lines
+    cells = {int(r[3]) for r in rows}
+    assert cells <= {1, 2, 4}, cells
+
+
+def test_topology_descriptor():
+    """Every rank derives the same descriptor from the bootstrap table:
+    ring order is a permutation of the world, the self link has zero
+    stripes, and peer links carry the configured count."""
+    res = _run("topo_describe", 2,
+               env={"HOROVOD_TPU_WIRE_STRIPES": "2"})
+    assert res.returncode == 0, res.stderr + res.stdout
+    for r in range(2):
+        assert f"rank {r}: topo OK" in res.stdout
+
+
+def test_wire_stats_api_shape():
+    """The wire-stats C API returns 16 well-formed counters (engine down:
+    all -1) and native.py shapes them into the diagnostics dict."""
+    import ctypes
+
+    from horovod_tpu.runtime.native import lib_path
+
+    lib = ctypes.CDLL(lib_path())
+    lib.hvd_wire_stats.argtypes = [ctypes.POINTER(ctypes.c_int64)]
+    lib.hvd_wire_stats.restype = None
+    vals = (ctypes.c_int64 * 16)()
+    lib.hvd_wire_stats(vals)
+    assert all(int(v) == -1 for v in vals), list(vals)
+    assert lib.hvd_topology_describe() in (None, 0)
+
+
 def test_ring_stats_api_shape():
     """The ring-stats C API returns 8 well-formed counters (engine down:
     all -1) and native.py derives a [0,1] idle fraction."""

@@ -34,10 +34,24 @@ def test_checker_detects_version_drift():
     """The guard must actually bite: a simulated version bump in wire.h
     without a Python update is reported."""
     wire_h, common_h = _headers()
-    tampered = wire_h.replace("kWireVersion = 5", "kWireVersion = 6")
+    tampered = wire_h.replace("kWireVersion = 6", "kWireVersion = 7")
     assert tampered != wire_h, "kWireVersion moved; update this test"
     problems = check_wire_abi.check(tampered, common_h)
     assert any("kWireVersion" in p for p in problems), problems
+
+
+def test_checker_detects_new_tuned_knob():
+    """A tuned-knob field added to ResponseList without the wire_abi
+    TUNED_KNOBS mirror (the v6 drift-guard extension) is reported."""
+    wire_h, common_h = _headers()
+    tampered = wire_h.replace(
+        "int64_t tuned_wire_stripes = -1;    // >=1 when the autotuner "
+        "owns the knob",
+        "int64_t tuned_wire_stripes = -1;    // >=1 when the autotuner "
+        "owns the knob\n  int64_t tuned_new_knob = -1;", 1)
+    assert tampered != wire_h, "tuned_wire_stripes moved; update this test"
+    problems = check_wire_abi.check(tampered, common_h)
+    assert any("tuned" in p for p in problems), problems
 
 
 def test_checker_detects_new_frame_type():
@@ -53,11 +67,23 @@ def test_v5_fault_frames_present():
     exist on both sides of the mirror at the pinned ids."""
     from horovod_tpu.runtime import wire_abi
 
-    assert wire_abi.WIRE_VERSION == 5
     assert wire_abi.FRAME_TYPES["kHeartbeat"] == wire_abi.FRAME_HEARTBEAT == 5
     assert wire_abi.FRAME_TYPES["kAbort"] == wire_abi.FRAME_ABORT == 6
     wire_h, _ = _headers()
     assert "kHeartbeat = 5" in wire_h and "kAbort = 6" in wire_h
+
+
+def test_v6_tuned_wire_stripes_present():
+    """The striped wire's v6 collateral: the tuned_wire_stripes knob rides
+    BOTH response-side frames, the Python mirror tracks the knob list, and
+    the version is 6 on both sides."""
+    from horovod_tpu.runtime import wire_abi
+
+    assert wire_abi.WIRE_VERSION == 6
+    assert wire_abi.TUNED_KNOBS[-1] == "tuned_wire_stripes"
+    wire_h, _ = _headers()
+    assert "kWireVersion = 6" in wire_h
+    assert wire_h.count("int64_t tuned_wire_stripes") == 2
 
 
 def test_version_mismatch_message_names_both_versions():
@@ -84,7 +110,7 @@ def test_version_mismatch_message_names_both_versions():
     lib.hvd_free_cstr.argtypes = [ctypes.c_void_p]
     lib.hvd_wire_version.restype = ctypes.c_int
 
-    assert lib.hvd_wire_version() == wire_abi.WIRE_VERSION == 5
+    assert lib.hvd_wire_version() == wire_abi.WIRE_VERSION == 6
 
     def parse_error(buf: bytes) -> str | None:
         p = lib.hvd_frame_parse_error(buf, len(buf))
@@ -95,11 +121,19 @@ def test_version_mismatch_message_names_both_versions():
         finally:
             lib.hvd_free_cstr(p)
 
-    # stale v4 header (old .so still running somewhere): both versions named
+    # v5 <-> v6 (the previous release still running somewhere): the striped
+    # wire's version bump must surface as the descriptive both-versions
+    # message, exactly like every previous bump
+    stale = wire_abi.frame_header(version=5) + b"\x00" * 16
+    msg = parse_error(stale)
+    assert msg is not None
+    assert "v5" in msg and "v6" in msg and "libhvdtpu.so" in msg, msg
+
+    # an even older v4 header: same contract, both versions named
     stale = wire_abi.frame_header(version=4) + b"\x00" * 16
     msg = parse_error(stale)
     assert msg is not None
-    assert "v4" in msg and "v5" in msg and "libhvdtpu.so" in msg, msg
+    assert "v4" in msg and "v6" in msg and "libhvdtpu.so" in msg, msg
 
     # current-version garbage is a parse error, not a version error
     import struct

@@ -41,6 +41,17 @@ def _parse_constant(text: str, name: str) -> int | None:
     return int(m.group(1), 0) if m else None
 
 
+def _parse_tuned_fields(text: str, struct_name: str) -> tuple[str, ...]:
+    """``int64_t tuned_*`` members of a struct, in declaration (and
+    therefore serialization) order — the autotuner-sync knobs both
+    response-side frames carry."""
+    m = re.search(r"struct\s+" + struct_name + r"\s*\{(.*?)\n\};", text,
+                  re.S)
+    if not m:
+        return ()
+    return tuple(re.findall(r"int64_t\s+(tuned_\w+)\s*=", m.group(1)))
+
+
 def check(wire_h: str, common_h: str) -> list[str]:
     """All drift problems between the C++ headers' text and the Python
     mirrors; empty list = in sync."""
@@ -64,6 +75,17 @@ def check(wire_h: str, common_h: str) -> list[str]:
         problems.append(
             f"FrameType: wire.h has {frames}, wire_abi.py has "
             f"{wire_abi.FRAME_TYPES}")
+
+    # tuned-knob sync fields: ResponseList and CachedExecFrame must carry
+    # the SAME knob list, and the Python mirror must track it (a new knob
+    # is a layout change — wire-version bump plus this list)
+    want_knobs = tuple(wire_abi.TUNED_KNOBS)
+    for struct in ("ResponseList", "CachedExecFrame"):
+        got = _parse_tuned_fields(wire_h, struct)
+        if got != want_knobs:
+            problems.append(
+                f"{struct} tuned knobs: wire.h has {got}, wire_abi.py "
+                f"TUNED_KNOBS has {want_knobs}")
 
     ops = _parse_enum(common_h, "OpType")
     if ops != wire_abi.OP_TYPES:
