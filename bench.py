@@ -1901,6 +1901,113 @@ def bench_fault(args):
     return results
 
 
+def _run_elastic_point(n, inject, elems, peer_timeout, restart=False):
+    """One elastic chaos launch via hvdrun --min-np (plus --restart for the
+    rejoin round trip), driving tests/native_worker.py's elastic_loop.
+    Latency is the SURVIVORS' own measurement: first retryable failure to
+    the first completed collective in the re-formed world (the printed
+    SHRINK_LATENCY_S markers); the counted membership series come from the
+    WORLD_CHANGED markers."""
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "HOROVOD_TPU_FAULT_INJECT": inject,
+        "HOROVOD_TPU_PEER_TIMEOUT_S": str(peer_timeout),
+        "HOROVOD_TPU_DATA_TIMEOUT_S": "3",
+        "HVD_TEST_ELEMS": str(elems),
+        "HVD_TEST_EXPECT_FINAL_SIZE": str(n if restart else n - 1),
+    })
+    if restart:
+        env["HVD_TEST_CHANGES"] = "2"
+    worker = os.path.join(REPO, "tests", "native_worker.py")
+    cmd = [sys.executable, "-m", "horovod_tpu.run", "-np", str(n),
+           "--grace-period", "1", "--min-np", "1"]
+    if restart:
+        cmd += ["--restart", "1"]
+    cmd += [sys.executable, worker, "elastic_loop"]
+    t0 = time.perf_counter()
+    proc = subprocess.run(cmd, env=env, cwd=REPO, capture_output=True,
+                          text=True, timeout=300)
+    wall = time.perf_counter() - t0
+    # regex extraction: concurrent ranks can interleave mid-line, so the
+    # markers are matched anywhere in the stream, not line-split
+    import re
+    lats = [float(m) for m in
+            re.findall(r"SHRINK_LATENCY_S=([0-9.]+)", proc.stdout)]
+    changes = joins = 0
+    final = None
+    for m in re.finditer(
+            r"WORLD_CHANGED size=(\d+) changes=(\d+) joins=(\d+)",
+            proc.stdout):
+        if int(m.group(2)) >= changes:
+            changes = int(m.group(2))
+            final = int(m.group(1))
+        joins = max(joins, int(m.group(3)))
+    return {
+        "inject": inject,
+        "exit_code": proc.returncode,
+        "wall_s": round(wall, 2),
+        "world_changes": changes,
+        "rank_joins": joins,
+        "final_size": final,
+        "shrink_latency_max_s": round(max(lats), 3) if lats else None,
+        "shrink_latency_min_s": round(min(lats), 3) if lats else None,
+    }
+
+
+def bench_elastic(args):
+    """Elastic-membership bench (BENCH_r11): detect -> shrunk-world-first-
+    cycle latency per injection point at -np 2 and 4, plus one
+    shrink-then-rejoin round trip per world size.
+
+    The COUNTED series (world_changes / rank_joins / final_size / exit 0
+    per point) are pure functions of the injection and gate CI
+    (tests/test_bench_gate.py); the latency series carry the usual shared-
+    2-core-host caveats but are dominated by the mesh rebuild, not the
+    scheduler: kill points detect via socket reset and the half-closed
+    old-world links RST every parked survivor, so the shrunk world is
+    live in tens of milliseconds.  Only the hang point (alive-but-wedged
+    rank) must wait out the heartbeat age, by design."""
+    peer_timeout = args.elastic_peer_timeout
+    results = {"config": {
+        "peer_timeout_s": peer_timeout,
+        "data_timeout_s": 3.0,
+        "min_np": 1,
+        "nproc": os.cpu_count(),
+        "note": "shrink_latency is measured IN-WORKER (first retryable "
+                "failure -> first completed collective in the new world); "
+                "kill points ride the socket-reset + link-RST cascade, "
+                "the hang point pays the heartbeat detection window "
+                "before the measured span starts",
+    }}
+    for n in (2, 4):
+        if n > args.elastic_max_np:
+            continue
+        victim = n - 1
+        point = {}
+        for label, inject, elems in (
+                ("kill_negotiation", f"kill:rank={victim}:cycle=10", 4096),
+                ("kill_pack", f"kill:rank={victim}:phase=pack:hit=5",
+                 65536),
+                ("kill_ring", f"kill:rank={victim}:phase=ring:hit=5",
+                 200000),
+                ("kill_unpack", f"kill:rank={victim}:phase=unpack:hit=5",
+                 65536),
+                ("hang_heartbeat", f"hang:rank={victim}:cycle=10", 4096),
+        ):
+            point[label] = _run_elastic_point(n, inject, elems,
+                                              peer_timeout)
+        point["kill_ring_rejoin"] = _run_elastic_point(
+            n, f"kill:rank={victim}:phase=ring:hit=5", 100000,
+            peer_timeout, restart=True)
+        lat = [p["shrink_latency_max_s"] for p in point.values()
+               if p.get("shrink_latency_max_s") is not None]
+        if lat:
+            point["shrink_latency_worst_s"] = max(lat)
+        results[f"np{n}"] = point
+    return results
+
+
 def bench_scaling(args):
     """Weak-scaling efficiency of the eager DP path: per-step time at
     np=1 vs np=N on THIS host (loopback TCP).  Only valid where each rank
@@ -2681,6 +2788,13 @@ def build_parser() -> argparse.ArgumentParser:
                          "(big enough that ring-phase kills land mid-wire)")
     ap.add_argument("--fault-peer-timeout", type=float, default=5.0)
     ap.add_argument("--fault-max-np", type=int, default=4)
+    ap.add_argument("--elastic", action="store_true",
+                    help="run ONLY the elastic-membership chaos bench "
+                         "(detect->shrunk-world-first-cycle latency per "
+                         "injection point + a shrink/rejoin round trip); "
+                         "writes BENCH_r11.json")
+    ap.add_argument("--elastic-peer-timeout", type=float, default=5.0)
+    ap.add_argument("--elastic-max-np", type=int, default=4)
     ap.add_argument("--pipeline-worker", action="store_true",
                     help=argparse.SUPPRESS)
     ap.add_argument("--skip-pipeline", action="store_true")
@@ -2763,6 +2877,22 @@ def main() -> None:
         return
     if args.fault_worker:
         fault_worker(args)
+        return
+    if args.elastic:
+        # elastic-membership only: chaos launches — a few minutes, own
+        # artifact
+        out = bench_elastic(args)
+        with open(os.path.join(REPO, "BENCH_r11.json"), "w") as f:
+            json.dump(out, f, indent=1)
+        compact = {}
+        for k, v in out.items():
+            if k.startswith("np"):
+                compact[k] = {
+                    "worst_shrink_s": v.get("shrink_latency_worst_s"),
+                    "rejoin_changes": v.get("kill_ring_rejoin", {}).get(
+                        "world_changes"),
+                }
+        print(json.dumps({"elastic": compact, "full": "BENCH_r11.json"}))
         return
     if args.fault:
         # fault-domain only: chaos launches + one negotiation run — a few

@@ -38,6 +38,8 @@
 
 #include <fcntl.h>
 #include <poll.h>
+#include <sys/socket.h>
+#include <sys/time.h>
 #include <unistd.h>
 
 #include "autotune.h"
@@ -69,6 +71,12 @@ Status AbortedStatus() {
   return Status::Error(
       "job abort in progress — transfer cancelled before completion");
 }
+
+// Retryable-failure tag for elastic membership changes.  This prefix is
+// API: horovod_tpu/runtime/native.py raises WorldShrunkError on it so
+// training loops can re-run the collective after hvd.world_changed() —
+// keep the two sides in sync.
+constexpr const char* kWorldChangeTag = "[world-change]";
 
 // A data-plane no-progress bound expired: count it and name the peer(s),
 // so the surfaced handle error says WHO is presumed dead, not just that
@@ -366,6 +374,11 @@ int64_t NormalizeSegmentBytes(int64_t b) {
   return (b + 63) & ~int64_t{63};
 }
 
+int ClampStripes(int64_t v) {
+  return static_cast<int>(v < 1 ? 1
+                          : v > Link::kMaxStripes ? Link::kMaxStripes : v);
+}
+
 // ---------------------------------------------------------------------------
 // scatter-gather wire view (HOROVOD_TPU_SG_THRESHOLD_BYTES)
 // ---------------------------------------------------------------------------
@@ -522,11 +535,14 @@ class Engine {
 
   // two-level topology derived from the bootstrap host table — the
   // engine-truth local/cross placement (reference: MPI_Comm_split_type
-  // derived ranks, operations.cc:1760-1797)
+  // derived ranks, operations.cc:1760-1797).  Locked: elastic world
+  // changes swap the group vectors on the bg thread while the Python
+  // diagnostics thread may be reading them.
   void Topo(int* local_rank, int* local_size, int* cross_rank,
             int* cross_size) const {
+    std::lock_guard<std::mutex> lk(topo_mu_);
     *local_rank = static_cast<int>(
-        std::find(local_group_.begin(), local_group_.end(), rank_) -
+        std::find(local_group_.begin(), local_group_.end(), topo_rank_) -
         local_group_.begin());
     *local_size = static_cast<int>(local_group_.size());
     *cross_size = static_cast<int>(host_groups_.size());
@@ -624,7 +640,10 @@ class Engine {
   }
 
   // Topology descriptor as JSON (diagnostics/tests).
-  std::string TopoJson() const { return topo_.DescribeJson(); }
+  std::string TopoJson() const {
+    std::lock_guard<std::mutex> lk(topo_mu_);
+    return topo_.DescribeJson();
+  }
 
   // Chaos hook: half-close one stripe of the link to `peer` so transfers
   // on it fail promptly (the dead-stripe chaos row).
@@ -638,6 +657,15 @@ class Engine {
   // age the fault metrics export — under steady traffic it sits near 0,
   // and a value approaching the peer timeout IS the detection in progress.
   int64_t MaxPeerAgeMs() const;
+
+  // Elastic world info, readable from any thread: {world epoch (bumps on
+  // every applied shrink/join), current size, current rank, elastic on}.
+  void WorldStats(int64_t out[4]) const {
+    out[0] = world_epoch_.load(std::memory_order_relaxed);
+    out[1] = world_size_pub_.load(std::memory_order_relaxed);
+    out[2] = world_rank_pub_.load(std::memory_order_relaxed);
+    out[3] = elastic_ ? 1 : 0;
+  }
 
  private:
   void BackgroundLoop();
@@ -664,9 +692,80 @@ class Engine {
     std::lock_guard<std::mutex> lk(mu_);
     return shutdown_sent_;
   }
-  // per-tick liveness duties; true = aborted, stop the loop
-  bool CoordinatorFaultTick(bool shutdown_in_flight);
+  // per-tick liveness duties.  The coordinator's returns 0 = continue,
+  // 1 = aborted (stop the loop), 2 = the world changed under this tick
+  // (its negotiation state is stale — abandon the tick, keep running).
+  int CoordinatorFaultTick(bool shutdown_in_flight);
   bool WorkerFaultTick(bool shutdown_in_flight);
+  // -- elastic membership (wire v7) ---------------------------------------
+  // The bootstrap table text for a (new) world: version tag, every rank-0
+  // decided knob at its CURRENT value, then host/port/hash per rank — the
+  // same format Init ships, reused by world-change frames so survivors and
+  // joiners learn membership through one parser.
+  std::string BuildTable(const std::vector<std::string>& hosts,
+                         const std::vector<int>& ports,
+                         const std::vector<std::string>& hashes,
+                         const std::string& shm_token);
+  // Parse a bootstrap table: applies the knob fields to this engine and
+  // returns the membership vectors.  Fails cleanly on a version-tag skew.
+  Status ParseTable(const std::string& table,
+                    std::vector<std::string>* hosts, std::vector<int>* ports,
+                    std::vector<std::string>* hashes, std::string* shm_token);
+  // Derive topology + (re)build the peer mesh, pacing, hierarchical
+  // defaults, shm rings, and liveness arrays for the CURRENT members
+  // (rank_, size_, hosts_, ports_, hashes_, shm_token_).  Init and every
+  // applied world change funnel through this.
+  Status BuildWorld();
+  // Joiner bootstrap: dial the coordinator's rendezvous listener, announce
+  // JOIN, adopt the world-change frame that admits us, ack, await commit.
+  Status JoinBootstrap(const std::string& host, int port,
+                       const std::string& my_hash);
+  // The retryable failure every handle cancelled by a membership change
+  // reports (Python raises WorldShrunkError on the tag).
+  Status MakeWorldChangeStatus(const std::string& why) const;
+  // In elastic mode a data-plane wire error is USUALLY a death the
+  // coordinator is about to shrink away: tag it retryable so callers can
+  // wait out world_changed() instead of treating it as fatal.  A STREAK
+  // of tagged failures with no world change in between means the peer is
+  // control-plane-alive with a broken data plane (e.g. one dead stripe)
+  // — no shrink is coming, so the tag stops and the raw error surfaces
+  // as fatal instead of luring callers into a retry livelock.
+  Status ElasticizeWire(Status st);
+  // Fail the in-flight cycle with `cause`, clear every piece of old-world
+  // negotiation/cache/claim state, and tear down the data plane.
+  void BeginWorldChange(const Status& cause);
+  // Coordinator: a worker died.  Shrink when elastic allows it (returns 0
+  // — caller abandons the tick), abort classically otherwise (returns 1).
+  int OnWorkerDeath(int dead_rank, const std::string& why);
+  // Coordinator: run the propose/ack/commit protocol and rebuild.  `dead`
+  // holds already-closed old ranks; join admits the pending joiner.
+  // Returns true when the change had to abort instead.
+  bool CoordinateWorldChange(std::vector<int> dead, const std::string& why,
+                             bool join);
+  // Worker: apply a received world-change proposal (ack, await commit,
+  // rebuild); loops internally when superseded.  true = aborted (stop).
+  bool HandleWorldChange(WorldChangeFrame wc);
+  // Shared commit-protocol tail for survivors (HandleWorldChange) and
+  // joiners (JoinBootstrap): drain coordinator control frames until
+  // `wc`'s epoch commits, a newer proposal supersedes it (`wc` is
+  // overwritten), the job aborts, the coordinator is lost, or `bound_s`
+  // expires.  `abort_out.message` carries the cause for kAborted/kLost.
+  // One implementation so the two sides of the protocol cannot drift.
+  enum class WcWait { kCommitted, kSuperseded, kAborted, kLost, kTimeout };
+  WcWait AwaitWorldCommit(WorldChangeFrame* wc, double bound_s,
+                          AbortFrame* abort_out);
+  // Shared tail: counters, epoch bump, fresh heartbeat clock.
+  void FinishWorldChange(bool join, int64_t t0_ns);
+  // Rank 0: admit one pending joiner from the rendezvous listener.
+  // 0 = none, 1 = aborted, 2 = world changed.
+  int MaybeAcceptJoin();
+  std::string NewShmToken() const {
+    return std::to_string(getpid()) + "." +
+           std::to_string(std::chrono::steady_clock::now()
+                              .time_since_epoch()
+                              .count() &
+                          0xffffff);
+  }
   // -- response cache (negotiation control plane) -------------------------
   // byte-counted control-plane send/recv (coordinator star only)
   Status SendCtrl(Socket& sock, const std::string& frame);
@@ -848,6 +947,43 @@ class Engine {
   double peer_timeout_s_ = 60.0;
   double hb_interval_s_ = 5.0;
   double stall_abort_s_ = 0.0;           // 0 = stalls stay warn-only
+  // -- elastic membership (wire v7) ---------------------------------------
+  // elastic_ is rank-0-decided and table-shipped (workers change their
+  // wire-error semantics with it, so all ranks must agree); min_np_ only
+  // matters on rank 0 (the shrink floor).  hosts_/ports_/hashes_ persist
+  // the bootstrap membership so rank 0 can ship a new table on a world
+  // change and every member can rebuild the mesh from it.
+  std::atomic<bool> elastic_{false};
+  int min_np_ = 1;
+  int shm_on_ = 1;                       // table decision, persisted
+  int tune_stripes_on_ = 0;              // table decision, persisted
+  std::vector<std::string> hosts_;       // data-listener addr per rank
+  std::vector<int> ports_;
+  std::vector<std::string> hashes_;
+  std::string shm_token_;
+  bool hier_env_pinned_ = false;         // HIERARCHICAL_ALLREDUCE env set
+  bool hier_default_ = false;            // table-derived default (pm_ init)
+  Listener rendezvous_;                  // rank 0, elastic: joiners dial it
+  bool rendezvous_open_ = false;
+  uint64_t world_proposal_ = 0;          // coordinator: last proposal sent
+  struct PendingJoin {                   // rank 0: one joiner at a time
+    Socket sock;
+    std::string host, hash;
+    int port = 0;
+    bool live = false;
+  };
+  PendingJoin join_;
+  // published world info for cross-thread readers (Python diagnostics):
+  // the bg thread renumbers rank_/size_ mid-run, so readers on other
+  // threads use these mirrors (and hb arrays are allocated once at
+  // hb_cap_ and never shrunk, so MaxPeerAgeMs can never index freed
+  // memory whatever interleaving it observes)
+  std::atomic<int64_t> world_epoch_{0};
+  std::atomic<int> world_rank_pub_{0}, world_size_pub_{1};
+  // consecutive elasticized wire failures with no applied world change:
+  // past a small streak the retryable tag stops (see ElasticizeWire)
+  std::atomic<int> elastic_wire_fails_{0};
+  int hb_cap_ = 0;
   std::unique_ptr<std::atomic<int64_t>[]> hb_seen_;  // steady ns per peer
   // rank 0: 1 while worker i's control socket is open.  The bg thread owns
   // workers_ and checks valid() directly; this atomic shadow exists ONLY
@@ -861,6 +997,11 @@ class Engine {
 
   // two-level topology, grouped by host hash at bootstrap
   std::vector<int> all_ranks_;          // 0..size-1
+  int topo_rank_ = 0;                   // rank_ snapshot paired with the
+                                        // groups below (guarded by topo_mu_:
+                                        // elastic renumbering writes rank_ on
+                                        // the bg thread, so Topo() must pair
+                                        // a consistent rank with the vectors)
   std::vector<int> local_group_;        // ranks sharing my host hash, sorted
   std::vector<int> cross_group_;        // local roots (min rank per host)
   std::vector<std::vector<int>> host_groups_;  // all groups, by min rank
@@ -946,6 +1087,10 @@ class Engine {
   // opt-in autotuner moves; it is CAPTURED per work item in stream order
   // (WorkItem::wire_stripes) so both ends flip at the same collective.
   Topology topo_;
+  // guards topo_ + the group/ring-order vectors against the Python
+  // diagnostics thread while elastic rebuilds swap them (the wire thread
+  // reads them lock-free, but only while rebuilds are quiescent)
+  mutable std::mutex topo_mu_;
   std::vector<int> ring_order_;          // flat-ring visit order
   int stripes_cross_ = 1, stripes_local_ = 1, nics_ = 1;
   int64_t stripe_quantum_ = 64 << 10;
@@ -1130,12 +1275,10 @@ Status Engine::Init(const std::string& host, int port, int rank, int size) {
     my_hash = hostname;
   }
 
-  std::vector<std::string> hashes(size_, my_hash);
-  std::string shm_token;  // job-unique, rank-0 generated, shipped in the table
   // rank 0 decides and the table ships the decision: a per-rank env read
   // would let divergent environments skip the flag handshake on one side
   // and corrupt the peer byte stream
-  int shm_on = EnvFlagIsZero("HOROVOD_TPU_SHM") ? 0 : 1;
+  shm_on_ = EnvFlagIsZero("HOROVOD_TPU_SHM") ? 0 : 1;
   // response-cache capacity: rank-0 decided and table-shipped for the same
   // reason — divergent capacities would desynchronize the replicated slot
   // tables and corrupt the claim protocol.  0 disables the cache.
@@ -1159,14 +1302,10 @@ Status Engine::Init(const std::string& host, int port, int rank, int size) {
   // the table — both ends of every link must agree on the stripe layout
   // (streams would reassemble wrong otherwise) and on the SG threshold
   // (the counted pack-bytes series must mean one thing per job)
-  auto clamp_stripes = [](int64_t v) {
-    return static_cast<int>(v < 1 ? 1 : v > Link::kMaxStripes
-                                            ? Link::kMaxStripes : v);
-  };
-  stripes_cross_ = clamp_stripes(EnvInt64("HOROVOD_TPU_WIRE_STRIPES", 1));
-  stripes_local_ = clamp_stripes(
+  stripes_cross_ = ClampStripes(EnvInt64("HOROVOD_TPU_WIRE_STRIPES", 1));
+  stripes_local_ = ClampStripes(
       EnvInt64("HOROVOD_TPU_WIRE_STRIPES_LOCAL", stripes_cross_));
-  nics_ = clamp_stripes(EnvInt64("HOROVOD_TPU_NICS", 1));
+  nics_ = ClampStripes(EnvInt64("HOROVOD_TPU_NICS", 1));
   stripe_quantum_ = EnvInt64("HOROVOD_TPU_STRIPE_QUANTUM_BYTES", 64 << 10);
   if (stripe_quantum_ < (4 << 10)) stripe_quantum_ = 4 << 10;
   if (stripe_quantum_ > (8 << 20)) stripe_quantum_ = 8 << 20;
@@ -1176,30 +1315,42 @@ Status Engine::Init(const std::string& host, int port, int rank, int size) {
   // the opt-in flag is rank-0-decided and table-shipped like the stripe
   // counts themselves: a flag set on only one side would make connect
   // and accept disagree on the per-link socket count and hang bootstrap
-  int tune_stripes_on =
+  tune_stripes_on_ =
       EnvFlag("HOROVOD_TPU_AUTOTUNE_WIRE_STRIPES") ? 1 : 0;
-  if (size_ > 1) {
+  // elastic membership (wire v7): rank 0 decides, the table ships it —
+  // workers change their wire-error semantics with the flag (retryable
+  // world-change errors instead of fatal ones), so all must agree
+  elastic_ = ElasticEnabled();
+  min_np_ = MinNp();
+  // a relaunched worker re-enters a RUNNING world (HOROVOD_TPU_JOIN=1,
+  // set by the elastic supervisor): its env rank/size describe the dead
+  // slot's original world and are ignored — the coordinator assigns the
+  // new rank through the admitting world-change frame
+  bool join_mode = EnvFlag("HOROVOD_TPU_JOIN") && size != 1;
+  if (size_ > 1 || join_mode) {
     // data-plane listener first, so peers can connect whenever they learn
     // our address
     Status s = data_listener_.Listen("", 0);
     if (!s.ok()) return s;
-
-    std::vector<std::string> hosts(size_);
-    std::vector<int> ports(size_);
-    if (rank_ == 0) {
-      Listener rv;
-      s = rv.Listen("", port);
+    if (join_mode) {
+      s = JoinBootstrap(host, port, my_hash);
       if (!s.ok()) return s;
+    } else if (rank_ == 0) {
+      s = rendezvous_.Listen("", port);
+      if (!s.ok()) return s;
+      rendezvous_open_ = true;
       // advertise the address workers dial for rendezvous (routable from
       // every host by construction); localhost stays localhost
       const char* adv = getenv("HOROVOD_TPU_DATA_ADDR");
-      hosts[0] = adv ? adv : (host.empty() ? "127.0.0.1" : host);
-      ports[0] = data_listener_.port();
+      hosts_.assign(size_, "");
+      ports_.assign(size_, 0);
+      hashes_.assign(size_, my_hash);
+      hosts_[0] = adv ? adv : (host.empty() ? "127.0.0.1" : host);
+      ports_[0] = data_listener_.port();
       workers_.resize(size_);
-      std::vector<int> order(size_, -1);
       for (int i = 1; i < size_; i++) {
         Socket sock;
-        s = rv.Accept(&sock, start_timeout_s_);
+        s = rendezvous_.Accept(&sock, start_timeout_s_);
         if (!s.ok()) return s;
         std::string hello;
         s = sock.RecvFrame(&hello);
@@ -1211,37 +1362,29 @@ Status Engine::Init(const std::string& host, int port, int rank, int size) {
         is >> r >> h >> p >> hash;
         if (r < 1 || r >= size_ || workers_[r].valid())
           return Status::Error("bad hello from worker: " + hello);
-        hosts[r] = h;
-        ports[r] = p;
-        hashes[r] = hash.empty() ? h : hash;
+        hosts_[r] = h;
+        ports_[r] = p;
+        hashes_[r] = hash.empty() ? h : hash;
         workers_[r] = std::move(sock);
       }
       // job-unique token namespacing the shm segments (several engines /
       // jobs may share a host)
-      shm_token = std::to_string(getpid()) + "." +
-                  std::to_string(std::chrono::steady_clock::now()
-                                     .time_since_epoch()
-                                     .count() &
-                                 0xffffff);
-      // version tag first: the table is the FIRST cross-.so exchange, so a
-      // mixed deployment must fail here with the same clean message the
-      // framed wire protocol gives, not with a misparsed host table
-      std::ostringstream table;
-      table << "HVDW" << kWireVersion << " " << shm_token << " " << shm_on
-            << " " << cache_capacity_ << " " << pipeline_depth_.load()
-            << " " << ring_segment_bytes_.load() << " " << stripes_cross_
-            << " " << stripes_local_ << " " << nics_ << " "
-            << stripe_quantum_ << " " << sg_threshold_ << " "
-            << tune_stripes_on << " ";
-      for (int i = 0; i < size_; i++)
-        table << hosts[i] << " " << ports[i] << " " << hashes[i] << " ";
+      shm_token_ = NewShmToken();
+      std::string table = BuildTable(hosts_, ports_, hashes_, shm_token_);
       for (int i = 1; i < size_; i++) {
-        s = workers_[i].SendFrame(table.str());
+        s = workers_[i].SendFrame(table);
         if (!s.ok()) return s;
+      }
+      if (!elastic_) {
+        // non-elastic jobs never admit joiners: release the port
+        rendezvous_.Close();
+        rendezvous_open_ = false;
       }
     } else {
       s = Socket::Connect(host, port, &coord_, start_timeout_s_);
-      if (!s.ok()) return s;
+      if (!s.ok())
+        return Status::Error("rendezvous with the coordinator (rank 0) "
+                             "failed: " + s.message);
       // advertise the local IP on the route to the coordinator — the
       // address peers on other hosts can reach our data listener at
       const char* adv = getenv("HOROVOD_TPU_DATA_ADDR");
@@ -1253,160 +1396,30 @@ Status Engine::Init(const std::string& host, int port, int rank, int size) {
       std::string table;
       s = coord_.RecvFrame(&table);
       if (!s.ok()) return s;
-      std::istringstream is(table);
-      std::string tag;
-      is >> tag;
-      if (tag != "HVDW" + std::to_string(kWireVersion))
+      s = ParseTable(table, &hosts_, &ports_, &hashes_, &shm_token_);
+      if (!s.ok()) return s;
+      // the table's member count is coordinator-decided; BuildWorld
+      // indexes these vectors by the env-derived size_, so a skew (e.g.
+      // one rank launched with the wrong HOROVOD_TPU_SIZE) must fail
+      // here, not as out-of-bounds reads in the topology build
+      if (hosts_.size() != static_cast<size_t>(size_))
         return Status::Error(
-            "wire protocol version mismatch at bootstrap: coordinator sent "
-            "table tag '" + tag + "', this engine expects 'HVDW" +
-            std::to_string(kWireVersion) +
-            "' — all ranks must load the same libhvdtpu.so");
-      int64_t table_depth = 2, table_seg = 256 << 10;
-      int64_t t_sc = 1, t_sl = 1, t_nics = 1, t_quant = 64 << 10,
-              t_sg = 4 << 20;
-      is >> shm_token >> shm_on >> cache_capacity_ >> table_depth
-         >> table_seg >> t_sc >> t_sl >> t_nics >> t_quant >> t_sg
-         >> tune_stripes_on;
-      pipeline_depth_ = table_depth < 1 ? 1 : table_depth > 8 ? 8
-                                                              : table_depth;
-      ring_segment_bytes_ = NormalizeSegmentBytes(table_seg);
-      stripes_cross_ = clamp_stripes(t_sc);
-      stripes_local_ = clamp_stripes(t_sl);
-      nics_ = clamp_stripes(t_nics);
-      stripe_quantum_ = t_quant;
-      sg_threshold_ = t_sg < 0 ? 0 : t_sg;
-      for (int i = 0; i < size_; i++) is >> hosts[i] >> ports[i] >> hashes[i];
+            "bootstrap table describes " + std::to_string(hosts_.size()) +
+            " ranks but this worker was launched into a world of " +
+            std::to_string(size_) + " — HOROVOD_TPU_SIZE skew?");
     }
-
-    // topology descriptor first: the per-link stripe counts it derives
-    // from the shared table decide how many sockets the mesh opens per
-    // peer (both endpoints evaluate the same count by construction)
-    topo_.Build(rank_, size_, hashes, nics_, stripes_cross_, stripes_local_,
-                Link::kMaxStripes);
-    // the opt-in stripe autotuner pre-opens 4 stripes per link so the
-    // search can raise the active cap live without reconnecting
-    // (tune_stripes_on is the table-shipped decision, agreed everywhere)
-    auto opened = [&](int j) {
-      int k = topo_.LinkStripes(j);
-      if (tune_stripes_on && k < 4) k = 4;
-      return k;
-    };
-    // full data-plane mesh: connect to lower ranks, accept from higher
-    // ones — K striped sockets per logical link (wire v6), each announced
-    // with {rank, stripe} so one peer's stripes may accept in any order
-    peers_.resize(size_);
-    for (int j = 0; j < size_; j++)
-      if (j != rank_) peers_[j].Configure(stripe_quantum_);
-    for (int j = 0; j < rank_; j++) {
-      for (int st = 0; st < opened(j); st++) {
-        Socket sock;
-        s = Socket::Connect(hosts[j], ports[j], &sock, start_timeout_s_);
-        if (!s.ok()) return s;
-        int32_t hello[2] = {rank_, st};
-        s = sock.SendAll(hello, sizeof(hello));
-        if (!s.ok()) return s;
-        peers_[j].SetStripe(st, std::move(sock));
-      }
-    }
-    int expect = 0;
-    for (int j = rank_ + 1; j < size_; j++) expect += opened(j);
-    for (int k = 0; k < expect; k++) {
-      Socket sock;
-      s = data_listener_.Accept(&sock, start_timeout_s_);
-      if (!s.ok()) return s;
-      int32_t hello[2] = {-1, -1};
-      s = sock.RecvAll(hello, sizeof(hello));
-      if (!s.ok()) return s;
-      int who = hello[0], stripe = hello[1];
-      if (who <= rank_ || who >= size_ || stripe < 0 ||
-          stripe >= opened(who))
-        return Status::Error("unexpected data-plane peer " +
-                             std::to_string(who) + " stripe " +
-                             std::to_string(stripe));
-      peers_[who].SetStripe(stripe, std::move(sock));
-    }
-    // initial active cap: tuned runs start at the LARGEST configured
-    // per-link count (the cap is global, so seeding below a configured
-    // local count would silently override it before the search even
-    // starts), clamped into the search space {1,2,4} — the GP attributes
-    // the first samples to the seed cell, so measuring outside the space
-    // (e.g. 8 = cross x NICs) would poison that cell's score; untuned
-    // runs leave every link at its opened count
-    wire_stripes_active_ =
-        tune_stripes_on
-            ? std::min<int64_t>(4, clamp_stripes(std::max(
-                  stripes_local_, stripes_cross_ * nics_)))
-            : Link::kMaxStripes;
   } else {
-    // single-process world: no mesh, but the descriptor below still
-    // backs Topo()/hvd_topology_describe
-    topo_.Build(rank_, size_, hashes, nics_, stripes_cross_,
-                stripes_local_, Link::kMaxStripes);
+    // single-process world: no mesh, but BuildWorld still derives the
+    // descriptor backing Topo()/hvd_topology_describe
+    hosts_.assign(1, host.empty() ? "127.0.0.1" : host);
+    ports_.assign(1, 0);
+    hashes_.assign(1, my_hash);
   }
 
-  // two-level topology from the agreed host hashes (identical on every
-  // rank: all derive it from the broadcast table; built above — before
-  // the mesh, which needs the per-link stripe counts).  The descriptor
-  // also picks the FLAT ring's host-contiguous visit order — allgather
-  // and alltoall keep rank order (their concat layouts are rank-indexed).
-  all_ranks_.resize(size_);
-  for (int i = 0; i < size_; i++) all_ranks_[i] = i;
-  local_group_ = topo_.local_group;
-  cross_group_ = topo_.cross_group;
-  host_groups_ = topo_.host_groups;
-  ring_order_ = topo_.RingOrder();
-  bool multi_host = topo_.multi_host();
-  // cross-host egress pacing (userspace token bucket, socket.cc): models
-  // asymmetric intra/inter-host link cost — the condition the
-  // hierarchical two-level paths exist for — on a single test machine,
-  // and throttles real WAN egress.  Applies only to peers on OTHER
-  // hosts; same-host traffic (shm or loopback TCP) stays at full speed.
-  double pace_mbps = 0.0;
-  if (const char* pc = getenv("HOROVOD_TPU_CROSS_HOST_PACE_MBPS"))
-    if (pc[0]) pace_mbps = atof(pc);
-  if (pace_mbps > 0) {
-    int paced = 0;
-    for (int j = 0; j < size_; j++)
-      if (j != rank_ && hashes[j] != hashes[rank_]) {
-        peers_[j].SetPacing(pace_mbps * 1e6);
-        paced++;
-      }
-    LOG_RANK(Debug, rank_) << "cross-host pacing " << pace_mbps << " MB/s on "
-                           << paced << " peer socket(s)";
+  {
+    Status s = BuildWorld();
+    if (!s.ok()) return s;
   }
-  // hierarchical data plane: local ring -> cross ring on local roots ->
-  // local broadcast (the eager analog of the reference's two-level path,
-  // operations.cc:1284-1446); default on exactly when the topology is
-  // multi-host with local groups to exploit, env-forceable either way.
-  // The default must be computed from globally shared data (host_groups_,
-  // identical on every rank) — deriving it from the rank's OWN group size
-  // would make asymmetric topologies disagree on the algorithm and hang.
-  bool any_local = false;
-  for (const auto& g : host_groups_) any_local |= g.size() > 1;
-  bool dflt = multi_host && any_local;
-  const char* ha = getenv("HOROVOD_TPU_HIERARCHICAL_ALLREDUCE");
-  if (!ha || !ha[0]) ha = getenv("HOROVOD_HIERARCHICAL_ALLREDUCE");
-  hierarchical_allreduce_ = (ha && ha[0]) ? (strcmp(ha, "0") != 0) : dflt;
-  const char* hg = getenv("HOROVOD_TPU_HIERARCHICAL_ALLGATHER");
-  if (!hg || !hg[0]) hg = getenv("HOROVOD_HIERARCHICAL_ALLGATHER");
-  hierarchical_allgather_ = (hg && hg[0]) ? (strcmp(hg, "0") != 0) : false;
-  hierarchical_allreduce_ = hierarchical_allreduce_.load() && multi_host;
-  hierarchical_allgather_ &= multi_host;
-  LOG_RANK(Debug, rank_) << "topology: " << host_groups_.size()
-                         << " host group(s),"
-                         << " local group size " << local_group_.size()
-                         << ", hierarchical allreduce "
-                         << (hierarchical_allreduce_ ? "on" : "off")
-                         << ", wire stripes " << stripes_cross_ << "x"
-                         << nics_ << " cross / " << stripes_local_
-                         << " local";
-  // same-host peers get a shared-memory data plane (loopback TCP moves
-  // every byte through the kernel twice; a mapped ring moves it at memcpy
-  // speed) — the eager analog of the reference's intra-node shared-memory
-  // staging (operations.cc:929-1033). Kill-switch: HOROVOD_TPU_SHM=0 on
-  // the launcher/rank 0 (the table ships the decision to every rank).
-  if (size_ > 1 && shm_on) SetupShm(shm_token);
   // the autotuner owns knobs the env did NOT pin (reference
   // parameter_manager fixed=true semantics): an explicit
   // HOROVOD[_TPU]_FUSION_THRESHOLD / CYCLE_TIME / HIERARCHICAL_* stays
@@ -1440,10 +1453,10 @@ Status Engine::Init(const std::string& host, int port, int rank, int size) {
   // stripe-count autotuning is opt-in the same way: the mesh pre-opened
   // enough stripes above; the search only moves the active cap (the
   // table-shipped decision, so it can never diverge from the mesh)
-  bool tune_stripes = size_ > 1 && tune_stripes_on != 0;
+  bool tune_stripes = size_ > 1 && tune_stripes_on_ != 0;
   if (rank_ == 0)
     pm_.Initialize(fusion_threshold_, cycle_us_,
-                   /*tune_hierarchical=*/dflt && !(ha && ha[0]),
+                   /*tune_hierarchical=*/hier_default_ && !hier_env_pinned_,
                    hierarchical_allreduce_,
                    /*tune_fusion=*/!env_set("HOROVOD_TPU_FUSION_THRESHOLD",
                                             "HOROVOD_FUSION_THRESHOLD"),
@@ -1466,18 +1479,8 @@ Status Engine::Init(const std::string& host, int port, int rank, int size) {
   peer_timeout_s_ = PeerTimeoutSeconds();
   hb_interval_s_ = HeartbeatIntervalSeconds();
   stall_abort_s_ = StallAbortSeconds();
-  hb_seen_.reset(new std::atomic<int64_t>[static_cast<size_t>(
-      size_ > 0 ? size_ : 1)]);
-  worker_live_.reset(new std::atomic<uint8_t>[static_cast<size_t>(
-      size_ > 0 ? size_ : 1)]);
-  int64_t boot_ns = NowNs();
-  for (int i = 0; i < (size_ > 0 ? size_ : 1); i++) {
-    hb_seen_[i] = boot_ns;
-    worker_live_[i] = static_cast<uint8_t>(
-        rank_ == 0 && i > 0 && i < static_cast<int>(workers_.size()) &&
-        workers_[i].valid());
-  }
-  hb_last_tx_ns_ = boot_ns;
+  // hb_seen_/worker_live_ were allocated (once, at hb_cap_) and seeded by
+  // BuildWorld above; elastic world changes re-seed without reallocating
   LOG_RANK(Debug, rank_) << "fault domain: peer timeout "
                          << peer_timeout_s_ << "s, heartbeat interval "
                          << hb_interval_s_ << "s, stall abort "
@@ -1493,6 +1496,792 @@ Status Engine::Init(const std::string& host, int port, int rank, int size) {
   if (pipelined_) dp_thread_ = std::thread(&Engine::DataPlaneLoop, this);
   bg_ = std::thread(&Engine::BackgroundLoop, this);
   return Status::OK();
+}
+
+// ---------------------------------------------------------------------------
+// elastic membership (wire v7): table helpers, world build, shrink/join
+// ---------------------------------------------------------------------------
+
+std::string Engine::BuildTable(const std::vector<std::string>& hosts,
+                               const std::vector<int>& ports,
+                               const std::vector<std::string>& hashes,
+                               const std::string& shm_token) {
+  // version tag first: the table is the FIRST cross-.so exchange, so a
+  // mixed deployment must fail here with the same clean message the
+  // framed wire protocol gives, not with a misparsed host table.  Every
+  // knob ships at its CURRENT value, so a world-change table teaches a
+  // joiner whatever the autotuner has already moved.
+  std::ostringstream table;
+  table << "HVDW" << kWireVersion << " " << shm_token << " " << shm_on_
+        << " " << cache_capacity_ << " " << pipeline_depth_.load()
+        << " " << ring_segment_bytes_.load() << " " << stripes_cross_
+        << " " << stripes_local_ << " " << nics_ << " "
+        << stripe_quantum_ << " " << sg_threshold_ << " "
+        << tune_stripes_on_ << " " << (elastic_ ? 1 : 0) << " " << min_np_
+        << " " << hosts.size() << " ";
+  for (size_t i = 0; i < hosts.size(); i++)
+    table << hosts[i] << " " << ports[i] << " " << hashes[i] << " ";
+  return table.str();
+}
+
+Status Engine::ParseTable(const std::string& table,
+                          std::vector<std::string>* hosts,
+                          std::vector<int>* ports,
+                          std::vector<std::string>* hashes,
+                          std::string* shm_token) {
+  std::istringstream is(table);
+  std::string tag;
+  is >> tag;
+  if (tag != "HVDW" + std::to_string(kWireVersion))
+    return Status::Error(
+        "wire protocol version mismatch at bootstrap: coordinator sent "
+        "table tag '" + tag + "', this engine expects 'HVDW" +
+        std::to_string(kWireVersion) +
+        "' — all ranks must load the same libhvdtpu.so");
+  int64_t table_depth = 2, table_seg = 256 << 10;
+  int64_t t_sc = 1, t_sl = 1, t_nics = 1, t_quant = 64 << 10,
+          t_sg = 4 << 20;
+  int t_elastic = 0, t_min_np = 1;
+  int64_t count = 0;
+  is >> *shm_token >> shm_on_ >> cache_capacity_ >> table_depth
+     >> table_seg >> t_sc >> t_sl >> t_nics >> t_quant >> t_sg
+     >> tune_stripes_on_ >> t_elastic >> t_min_np >> count;
+  if (!is || count < 1 || count > (1 << 20))
+    return Status::Error("malformed bootstrap table");
+  ApplyPipelineDepth(table_depth);
+  ring_segment_bytes_ = NormalizeSegmentBytes(table_seg);
+  stripes_cross_ = ClampStripes(t_sc);
+  stripes_local_ = ClampStripes(t_sl);
+  nics_ = ClampStripes(t_nics);
+  stripe_quantum_ = t_quant;
+  sg_threshold_ = t_sg < 0 ? 0 : t_sg;
+  elastic_ = t_elastic != 0;
+  min_np_ = t_min_np < 1 ? 1 : t_min_np;
+  hosts->assign(static_cast<size_t>(count), "");
+  ports->assign(static_cast<size_t>(count), 0);
+  hashes->assign(static_cast<size_t>(count), "");
+  for (int64_t i = 0; i < count; i++)
+    is >> (*hosts)[i] >> (*ports)[i] >> (*hashes)[i];
+  if (!is) return Status::Error("truncated bootstrap table");
+  return Status::OK();
+}
+
+Status Engine::BuildWorld() {
+  // topology descriptor first: the per-link stripe counts it derives from
+  // the shared table decide how many sockets the mesh opens per peer
+  // (both endpoints evaluate the same count by construction).  The
+  // descriptor also picks the FLAT ring's host-contiguous visit order —
+  // allgather/alltoall keep rank order (concat layouts are rank-indexed).
+  {
+    // topo_ and the groups are read by the Python diagnostics thread
+    // (Topo, TopoJson); elastic rebuilds swap them mid-run, so the
+    // writer holds the same lock those readers take for the Build too
+    std::lock_guard<std::mutex> lk(topo_mu_);
+    topo_.Build(rank_, size_, hashes_, nics_, stripes_cross_,
+                stripes_local_, Link::kMaxStripes);
+    all_ranks_.resize(size_);
+    for (int i = 0; i < size_; i++) all_ranks_[i] = i;
+    topo_rank_ = rank_;
+    local_group_ = topo_.local_group;
+    cross_group_ = topo_.cross_group;
+    host_groups_ = topo_.host_groups;
+    ring_order_ = topo_.RingOrder();
+  }
+  bool multi_host = topo_.multi_host();
+  // the data plane is rebuilt from scratch on every elastic world change:
+  // stale half-transferred streams die with the old sockets, so the new
+  // world starts from clean byte streams (the executor is quiescent —
+  // BeginWorldChange drained it — so this thread owns the links)
+  for (auto& l : peers_) l.Close();
+  peers_.clear();
+  shm_tx_.clear();
+  shm_rx_.clear();
+  if (size_ > 1) {
+    peers_.resize(size_);
+    for (int j = 0; j < size_; j++)
+      if (j != rank_) peers_[j].Configure(stripe_quantum_);
+    // the opt-in stripe autotuner pre-opens 4 stripes per link so the
+    // search can raise the active cap live without reconnecting
+    // (tune_stripes_on_ is the table-shipped decision, agreed everywhere)
+    auto opened = [&](int j) {
+      int k = topo_.LinkStripes(j);
+      if (tune_stripes_on_ && k < 4) k = 4;
+      return k;
+    };
+    // full data-plane mesh: connect to lower ranks, accept from higher
+    // ones — K striped sockets per logical link (wire v6), each announced
+    // with {rank, stripe} so one peer's stripes may accept in any order.
+    // Failures NAME the {rank, stripe} that never answered: at bootstrap
+    // and at elastic rebuilds that is the line an operator greps for.
+    for (int j = 0; j < rank_; j++) {
+      for (int st = 0; st < opened(j); st++) {
+        Socket sock;
+        Status s = Socket::Connect(hosts_[j], ports_[j], &sock,
+                                   start_timeout_s_);
+        if (!s.ok())
+          return Status::Error(
+              "data-plane connect to rank " + std::to_string(j) +
+              " stripe " + std::to_string(st) + " (" + hosts_[j] + ":" +
+              std::to_string(ports_[j]) + ") never answered: " + s.message);
+        int32_t hello[2] = {rank_, st};
+        s = sock.SendAll(hello, sizeof(hello));
+        if (!s.ok()) return s;
+        peers_[j].SetStripe(st, std::move(sock));
+      }
+    }
+    int expect = 0;
+    std::map<int, int> awaited;  // higher rank -> stripes still expected
+    for (int j = rank_ + 1; j < size_; j++) {
+      expect += opened(j);
+      awaited[j] = opened(j);
+    }
+    for (int k = 0; k < expect; k++) {
+      Socket sock;
+      Status s = data_listener_.Accept(&sock, start_timeout_s_);
+      if (!s.ok()) {
+        std::ostringstream who;
+        for (auto& [j, n] : awaited)
+          if (n > 0) who << " rank " << j << " (" << n << " stripe(s))";
+        return Status::Error(
+            "data-plane accept: these peers never connected:" + who.str() +
+            " — " + s.message);
+      }
+      int32_t hello[2] = {-1, -1};
+      s = sock.RecvAll(hello, sizeof(hello));
+      if (!s.ok()) return s;
+      int who = hello[0], stripe = hello[1];
+      if (who <= rank_ || who >= size_ || stripe < 0 ||
+          stripe >= opened(who))
+        return Status::Error("unexpected data-plane peer " +
+                             std::to_string(who) + " stripe " +
+                             std::to_string(stripe));
+      awaited[who]--;
+      peers_[who].SetStripe(stripe, std::move(sock));
+    }
+    // initial active cap: tuned runs start at the LARGEST configured
+    // per-link count (the cap is global, so seeding below a configured
+    // local count would silently override it before the search even
+    // starts), clamped into the search space {1,2,4}; untuned runs leave
+    // every link at its opened count
+    wire_stripes_active_ =
+        tune_stripes_on_
+            ? std::min<int64_t>(4, ClampStripes(std::max(
+                  stripes_local_, stripes_cross_ * nics_)))
+            : Link::kMaxStripes;
+    // cross-host egress pacing (userspace token bucket, socket.cc):
+    // applies only to peers on OTHER hosts; same-host traffic (shm or
+    // loopback TCP) stays at full speed
+    double pace_mbps = 0.0;
+    if (const char* pc = getenv("HOROVOD_TPU_CROSS_HOST_PACE_MBPS"))
+      if (pc[0]) pace_mbps = atof(pc);
+    if (pace_mbps > 0) {
+      int paced = 0;
+      for (int j = 0; j < size_; j++)
+        if (j != rank_ && hashes_[j] != hashes_[rank_]) {
+          peers_[j].SetPacing(pace_mbps * 1e6);
+          paced++;
+        }
+      LOG_RANK(Debug, rank_) << "cross-host pacing " << pace_mbps
+                             << " MB/s on " << paced << " peer socket(s)";
+    }
+  }
+  // hierarchical data plane: default on exactly when the topology is
+  // multi-host with local groups to exploit, env-forceable either way.
+  // The default must be computed from globally shared data (host_groups_,
+  // identical on every rank) — deriving it from the rank's OWN group size
+  // would make asymmetric topologies disagree on the algorithm and hang.
+  bool any_local = false;
+  for (const auto& g : host_groups_) any_local |= g.size() > 1;
+  hier_default_ = multi_host && any_local;
+  const char* ha = getenv("HOROVOD_TPU_HIERARCHICAL_ALLREDUCE");
+  if (!ha || !ha[0]) ha = getenv("HOROVOD_HIERARCHICAL_ALLREDUCE");
+  hier_env_pinned_ = ha && ha[0];
+  hierarchical_allreduce_ =
+      hier_env_pinned_ ? (strcmp(ha, "0") != 0) : hier_default_;
+  const char* hg = getenv("HOROVOD_TPU_HIERARCHICAL_ALLGATHER");
+  if (!hg || !hg[0]) hg = getenv("HOROVOD_HIERARCHICAL_ALLGATHER");
+  hierarchical_allgather_ = (hg && hg[0]) ? (strcmp(hg, "0") != 0) : false;
+  hierarchical_allreduce_ = hierarchical_allreduce_.load() && multi_host;
+  hierarchical_allgather_ &= multi_host;
+  LOG_RANK(Debug, rank_) << "topology: " << host_groups_.size()
+                         << " host group(s),"
+                         << " local group size " << local_group_.size()
+                         << ", hierarchical allreduce "
+                         << (hierarchical_allreduce_ ? "on" : "off")
+                         << ", wire stripes " << stripes_cross_ << "x"
+                         << nics_ << " cross / " << stripes_local_
+                         << " local";
+  // same-host peers get a shared-memory data plane; each world gets a
+  // fresh token (the old segments were unlinked at attach time)
+  if (size_ > 1 && shm_on_) SetupShm(shm_token_);
+  // liveness arrays: allocated ONCE at a capacity the world can never
+  // outgrow, then only re-seeded — MaxPeerAgeMs runs on the Python
+  // diagnostics thread and must never index freed memory
+  if (!hb_seen_) {
+    hb_cap_ = size_ > 64 ? size_ : 64;
+    hb_seen_.reset(new std::atomic<int64_t>[static_cast<size_t>(hb_cap_)]);
+    worker_live_.reset(
+        new std::atomic<uint8_t>[static_cast<size_t>(hb_cap_)]);
+  }
+  if (size_ > hb_cap_)
+    return Status::Error("world grew past its liveness capacity (" +
+                         std::to_string(hb_cap_) + ")");
+  int64_t boot_ns = NowNs();
+  for (int i = 0; i < hb_cap_; i++) {
+    hb_seen_[i] = boot_ns;
+    worker_live_[i] = static_cast<uint8_t>(
+        rank_ == 0 && i > 0 && i < static_cast<int>(workers_.size()) &&
+        workers_[i].valid());
+  }
+  hb_last_tx_ns_ = boot_ns;
+  world_rank_pub_.store(rank_, std::memory_order_relaxed);
+  world_size_pub_.store(size_, std::memory_order_relaxed);
+  return Status::OK();
+}
+
+Engine::WcWait Engine::AwaitWorldCommit(WorldChangeFrame* wc, double bound_s,
+                                        AbortFrame* abort_out) {
+  abort_out->dead_rank = -1;
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::duration<double>(bound_s);
+  for (;;) {
+    if (std::chrono::steady_clock::now() > deadline) return WcWait::kTimeout;
+    if (!coord_.Readable(50)) continue;
+    std::string fr;
+    Status rs = RecvCtrl(coord_, &fr);
+    if (!rs.ok()) {
+      abort_out->message = rs.message;
+      return WcWait::kLost;
+    }
+    // joiners run this before Init allocates the liveness arrays
+    if (hb_seen_) NoteSeen(0);
+    FrameType ft = FrameTypeOf(fr);
+    if (ft == FrameType::kHeartbeat) {
+      Faults().heartbeats_rx.fetch_add(1, std::memory_order_relaxed);
+      continue;
+    }
+    if (ft == FrameType::kAbort) {
+      if (!Parse(fr, abort_out).ok()) {
+        abort_out->message = "job aborted during the world change";
+        abort_out->dead_rank = -1;
+      }
+      return WcWait::kAborted;
+    }
+    if (ft == FrameType::kWorldChange) {
+      Status ps = Parse(fr, wc);
+      if (!ps.ok()) {
+        abort_out->message = ps.message;
+        return WcWait::kAborted;
+      }
+      return WcWait::kSuperseded;  // another member died mid-change
+    }
+    if (ft == FrameType::kWorldCommit) {
+      WorldCommitFrame cf;
+      if (Parse(fr, &cf).ok() && cf.epoch == wc->epoch)
+        return WcWait::kCommitted;
+      // commits for an older epoch are stale — ignored
+    }
+  }
+}
+
+Status Engine::JoinBootstrap(const std::string& host, int port,
+                             const std::string& my_hash) {
+  Status s = Socket::Connect(host, port, &coord_, start_timeout_s_);
+  if (!s.ok())
+    return Status::Error(
+        "elastic join: rendezvous with the coordinator failed (is the job "
+        "running with HOROVOD_TPU_ELASTIC=1?): " + s.message);
+  const char* adv = getenv("HOROVOD_TPU_DATA_ADDR");
+  std::ostringstream hello;
+  hello << "JOIN " << (adv ? adv : coord_.LocalAddr()) << " "
+        << data_listener_.port() << " " << my_hash;
+  s = coord_.SendFrame(hello.str());
+  if (!s.ok()) return s;
+  // the world-change frame that admits us doubles as our bootstrap table
+  WorldChangeFrame wc;
+  bool have = false;
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::duration<double>(start_timeout_s_);
+  while (!have) {
+    if (std::chrono::steady_clock::now() > deadline)
+      return Status::Error(
+          "elastic join: the coordinator never admitted this worker (no "
+          "world-change frame within the start timeout)");
+    if (!coord_.Readable(100)) continue;
+    std::string frame;
+    s = coord_.RecvFrame(&frame);
+    if (!s.ok())
+      return Status::Error("elastic join: lost coordinator: " + s.message);
+    FrameType ft = FrameTypeOf(frame);
+    if (ft == FrameType::kHeartbeat) continue;
+    if (ft == FrameType::kAbort) {
+      AbortFrame af;
+      (void)Parse(frame, &af);
+      return Status::Error("elastic join rejected: job aborting — " +
+                           af.message);
+    }
+    if (ft != FrameType::kWorldChange) continue;
+    s = Parse(frame, &wc);
+    if (!s.ok()) return s;
+    have = true;
+  }
+  for (;;) {
+    // my slot is the (single) joiner entry
+    int new_rank = -1;
+    for (size_t i = 0; i < wc.old_ranks.size(); i++)
+      if (wc.old_ranks[i] < 0) new_rank = static_cast<int>(i);
+    if (new_rank < 0)
+      return Status::Error(
+          "elastic join: admitting world-change frame has no joiner slot");
+    std::vector<std::string> nh, nhash;
+    std::vector<int> np;
+    std::string token;
+    s = ParseTable(wc.table, &nh, &np, &nhash, &token);
+    if (!s.ok()) return s;
+    if (nh.size() != wc.old_ranks.size())
+      return Status::Error("elastic join: table/membership size mismatch");
+    rank_ = new_rank;
+    size_ = static_cast<int>(wc.old_ranks.size());
+    hosts_ = std::move(nh);
+    ports_ = std::move(np);
+    hashes_ = std::move(nhash);
+    shm_token_ = std::move(token);
+    WorldAckFrame ack;
+    ack.rank = new_rank;
+    ack.epoch = wc.epoch;
+    s = SendCtrl(coord_, Serialize(ack));
+    if (!s.ok()) return s;
+    // await the commit — or a superseding proposal (a survivor died while
+    // we were joining), which restarts the adoption
+    AbortFrame af;
+    WcWait w = AwaitWorldCommit(&wc, start_timeout_s_, &af);
+    if (w == WcWait::kSuperseded) continue;
+    if (w == WcWait::kTimeout)
+      return Status::Error(
+          "elastic join: no world-commit from the coordinator within "
+          "the start timeout");
+    if (w == WcWait::kLost)
+      return Status::Error("elastic join: lost coordinator: " + af.message);
+    if (w == WcWait::kAborted)
+      return Status::Error("elastic join: job aborted — " + af.message);
+    break;  // committed
+  }
+  LOG_RANK(Warning, rank_) << "elastic join: entering a running world as "
+                           << "rank " << rank_ << " of " << size_;
+  return Status::OK();
+}
+
+Status Engine::MakeWorldChangeStatus(const std::string& why) const {
+  return Status::Error(
+      std::string(kWorldChangeTag) + " " + why +
+      " — in-flight collective cancelled while the world membership "
+      "changes; retry it once hvd.world_changed() reports the new world");
+}
+
+Status Engine::ElasticizeWire(Status st) {
+  if (!elastic_ || st.code != Status::kError) {
+    if (st.ok()) elastic_wire_fails_.store(0, std::memory_order_relaxed);
+    return st;
+  }
+  if (st.message.compare(0, strlen(kWorldChangeTag), kWorldChangeTag) == 0)
+    return st;
+  // streak guard: repeated wire failures with no world change applied in
+  // between mean nobody is dying — a retryable tag would livelock the
+  // caller's wait-for-world_changed() loop, so let the raw error through
+  if (elastic_wire_fails_.fetch_add(1, std::memory_order_relaxed) >= 3)
+    return st;
+  return Status::Error(
+      std::string(kWorldChangeTag) + " " + st.message +
+      " — if the peer is dead the world will shrink; retry after "
+      "hvd.world_changed()");
+}
+
+void Engine::BeginWorldChange(const Status& cause) {
+  SetAborting(true);  // parked transfers (ours + the executor's) cancel
+  // half-close every old-world link (fd-safe vs a mid-transfer executor):
+  // local blocked TCP waits fail on the next syscall, and the RSTs
+  // unwedge the REMOTE ends too — survivors parked in rings with us learn
+  // about the change in one round trip instead of a full data timeout.
+  // (shm-parked peers still need the bounded no-progress wait: a mapped
+  // ring has no reset to send.)
+  for (auto& l : peers_) l.ShutdownAll();
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    aborted_ = true;  // MarkDone substitutes the retryable cause
+    abort_status_ = cause;
+  }
+  FailAll(cause);  // drains the pipeline; the in-flight cycle fails retryable
+  // old-world negotiation / claim / cache state dies with the membership
+  message_table_.clear();
+  ready_.clear();
+  error_ready_.clear();
+  cache_claims_.clear();
+  cached_ready_.clear();
+  pending_invalid_.clear();
+  bits_inflight_.clear();
+  resend_.clear();
+  // re-key the response cache: every member restarts cold, so the
+  // replicated slot tables stay trivially identical in the new world
+  // (old entries carried old-world first_dims vectors anyway)
+  cache_.Init(cache_capacity_);
+  cache_entries_.store(0, std::memory_order_relaxed);
+}
+
+int Engine::OnWorkerDeath(int dead_rank, const std::string& why) {
+  if (elastic_ && !ShutdownInFlight()) {
+    int live = 1;
+    for (int i = 1; i < size_; i++) live += workers_[i].valid() ? 1 : 0;
+    if (live >= min_np_)
+      return CoordinateWorldChange({dead_rank}, why, /*join=*/false) ? 1 : 0;
+    LogWarn("elastic: world would shrink to " + std::to_string(live) +
+            " < HOROVOD_TPU_MIN_NP=" + std::to_string(min_np_) +
+            " — aborting instead");
+  }
+  AbortJob(Status::Error(why + "; aborting job"), dead_rank);
+  return 1;
+}
+
+bool Engine::CoordinateWorldChange(std::vector<int> dead,
+                                   const std::string& why, bool join) {
+  int64_t t0 = NowNs();
+  timeline_.FaultMark(join ? "WORLD_JOIN" : "WORLD_SHRINK");
+  if (!dead.empty()) timeline_.FaultMark("PEER_DEAD");
+  LogWarn(std::string("elastic world change (") +
+          (join ? "join" : "shrink") + "): " + why);
+  BeginWorldChange(MakeWorldChangeStatus(why));
+  bool joiner = join && join_.live;
+  std::vector<int> survivors;
+  int new_size = 0;
+  WorldChangeFrame wc;
+  std::string token;
+  for (;;) {  // propose rounds: every death detected mid-round restarts it
+    survivors.assign(1, 0);
+    for (int i = 1; i < size_; i++)
+      if (workers_[i].valid() &&
+          std::find(dead.begin(), dead.end(), i) == dead.end())
+        survivors.push_back(i);
+    new_size = static_cast<int>(survivors.size()) + (joiner ? 1 : 0);
+    if (new_size < min_np_) {
+      AbortJob(Status::Error(
+                   why + " — world would shrink to " +
+                   std::to_string(new_size) + " < HOROVOD_TPU_MIN_NP=" +
+                   std::to_string(min_np_) + "; aborting job"),
+               dead.empty() ? -1 : dead.front());
+      return true;
+    }
+    std::vector<std::string> nh, nhash;
+    std::vector<int> np;
+    wc = WorldChangeFrame{};
+    wc.epoch = ++world_proposal_;
+    // the live joiner state, not the join argument: a joiner whose socket
+    // breaks mid-round demotes the change to a plain shrink
+    wc.kind = joiner ? 1 : 0;
+    wc.message = why;
+    for (int d : dead) wc.dead_ranks.push_back(d);
+    for (int r : survivors) {
+      nh.push_back(hosts_[r]);
+      np.push_back(ports_[r]);
+      nhash.push_back(hashes_[r]);
+      wc.old_ranks.push_back(r);
+    }
+    if (joiner) {
+      nh.push_back(join_.host);
+      np.push_back(join_.port);
+      nhash.push_back(join_.hash);
+      wc.old_ranks.push_back(-1);
+    }
+    token = NewShmToken();
+    wc.table = BuildTable(nh, np, nhash, token);
+    std::string frame = Serialize(wc);
+    bool redo = false;
+    for (int r : survivors) {
+      if (r == 0) continue;
+      if (!SendCtrl(workers_[r], frame).ok()) {
+        worker_live_[r].store(0, std::memory_order_relaxed);
+        workers_[r].Close();
+        dead.push_back(r);
+        redo = true;
+      }
+    }
+    if (joiner && !join_.sock.SendFrame(frame).ok()) {
+      join_.live = false;
+      joiner = false;
+      redo = true;
+    }
+    if (redo) continue;
+    // collect one ack per member; a socket that breaks (or a member that
+    // never acks inside the bound — e.g. wedged past the data timeout)
+    // is another death, and the round restarts without it.  The bound is
+    // sized by the slowest LEGITIMATE ack: a survivor whose bg thread is
+    // parked behind an shm transfer unwedges at the data timeout — not
+    // by the (much larger) start timeout, which would stretch every
+    // wedged round to minutes.
+    std::set<int> pending;
+    for (int r : survivors)
+      if (r != 0) pending.insert(r);
+    bool jpending = joiner;
+    double ack_bound = DuplexTimeoutSeconds() + 10;
+    if (ack_bound < 30) ack_bound = 30;
+    auto deadline = std::chrono::steady_clock::now() +
+                    std::chrono::duration<double>(ack_bound);
+    while ((!pending.empty() || jpending) && !redo) {
+      if (std::chrono::steady_clock::now() > deadline) break;
+      bool moved = false;
+      for (auto it = pending.begin(); it != pending.end() && !redo;) {
+        int r = *it;
+        bool acked = false;
+        while (workers_[r].valid() && workers_[r].Readable(0)) {
+          std::string fr;
+          if (!RecvCtrl(workers_[r], &fr).ok()) {
+            worker_live_[r].store(0, std::memory_order_relaxed);
+            workers_[r].Close();
+            dead.push_back(r);
+            redo = true;
+            break;
+          }
+          moved = true;
+          NoteSeen(r);
+          FrameType ft = FrameTypeOf(fr);
+          if (ft == FrameType::kWorldAck) {
+            WorldAckFrame af;
+            if (Parse(fr, &af).ok() && af.epoch == wc.epoch) {
+              acked = true;
+              break;
+            }
+          } else if (ft == FrameType::kHeartbeat) {
+            Faults().heartbeats_rx.fetch_add(1, std::memory_order_relaxed);
+          }
+          // anything else is old-world traffic whose handles the sender
+          // already failed retryable — discard it
+        }
+        it = acked ? pending.erase(it) : ++it;
+      }
+      if (jpending && !redo && join_.sock.Readable(0)) {
+        std::string fr;
+        if (!join_.sock.RecvFrame(&fr).ok()) {
+          join_.live = false;
+          joiner = false;
+          redo = true;
+        } else if (FrameTypeOf(fr) == FrameType::kWorldAck) {
+          WorldAckFrame af;
+          if (Parse(fr, &af).ok() && af.epoch == wc.epoch) jpending = false;
+        }
+        moved = true;
+      }
+      if (!moved && !redo)
+        std::this_thread::sleep_for(std::chrono::milliseconds(2));
+    }
+    if (!redo && (!pending.empty() || jpending)) {
+      for (int r : pending) {
+        LogWarn("elastic: rank " + std::to_string(r) +
+                " never acked the world change — presumed dead");
+        worker_live_[r].store(0, std::memory_order_relaxed);
+        workers_[r].Close();
+        dead.push_back(r);
+      }
+      if (jpending) {
+        join_.live = false;
+        joiner = false;
+      }
+      redo = true;
+    }
+    if (redo) continue;
+    // commit: every member acked, the old world is quiesced everywhere
+    WorldCommitFrame cf;
+    cf.epoch = wc.epoch;
+    std::string cframe = Serialize(cf);
+    for (int r : survivors) {
+      if (r == 0) continue;
+      if (!SendCtrl(workers_[r], cframe).ok()) {
+        // a death THIS late cannot be re-proposed (already-committed
+        // members are rebuilding the mesh and no longer read control
+        // frames): the rebuild below times out on the corpse and aborts —
+        // the rare double-death-at-commit window
+        worker_live_[r].store(0, std::memory_order_relaxed);
+        workers_[r].Close();
+      }
+    }
+    if (joiner) (void)join_.sock.SendFrame(cframe);
+    break;
+  }
+  // apply the membership locally (rank 0 keeps rank 0 by construction:
+  // coordinator death always aborts, so the coordinator always survives)
+  std::vector<Socket> nworkers(static_cast<size_t>(new_size));
+  std::vector<std::string> nh, nhash;
+  std::vector<int> np;
+  for (size_t i = 0; i < survivors.size(); i++) {
+    int r = survivors[i];
+    if (r != 0) nworkers[i] = std::move(workers_[r]);
+    nh.push_back(hosts_[r]);
+    np.push_back(ports_[r]);
+    nhash.push_back(hashes_[r]);
+  }
+  if (joiner) {
+    nworkers[static_cast<size_t>(new_size) - 1] = std::move(join_.sock);
+    nh.push_back(join_.host);
+    np.push_back(join_.port);
+    nhash.push_back(join_.hash);
+  }
+  join_.live = false;
+  workers_ = std::move(nworkers);
+  hosts_ = std::move(nh);
+  ports_ = std::move(np);
+  hashes_ = std::move(nhash);
+  shm_token_ = token;
+  size_ = new_size;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    aborted_ = false;
+    abort_status_ = Status::OK();
+  }
+  SetAborting(false);
+  Status s = BuildWorld();
+  if (!s.ok()) {
+    AbortJob(Status::Error("elastic world rebuild failed: " + s.message),
+             -1);
+    return true;
+  }
+  FinishWorldChange(joiner, t0);
+  return false;
+}
+
+bool Engine::HandleWorldChange(WorldChangeFrame wc) {
+  int64_t t0 = NowNs();
+  LogWarn("elastic world change from coordinator: " + wc.message);
+  BeginWorldChange(MakeWorldChangeStatus(wc.message));
+  for (;;) {
+    int new_rank = -1;
+    for (size_t i = 0; i < wc.old_ranks.size(); i++)
+      if (wc.old_ranks[i] == rank_) new_rank = static_cast<int>(i);
+    if (new_rank < 0)
+      return AbortJob(
+          Status::Error("world change evicted this rank (old rank " +
+                        std::to_string(rank_) + ") — aborting"),
+          -1);
+    std::vector<std::string> nh, nhash;
+    std::vector<int> np;
+    std::string token;
+    Status s = ParseTable(wc.table, &nh, &np, &nhash, &token);
+    if (!s.ok()) return AbortJob(s, -1);
+    if (nh.size() != wc.old_ranks.size())
+      return AbortJob(
+          Status::Error("world-change table/membership size mismatch"), -1);
+    WorldAckFrame ack;
+    ack.rank = new_rank;
+    ack.epoch = wc.epoch;
+    if (!SendCtrl(coord_, Serialize(ack)).ok())
+      return AbortJob(Status::Error("lost coordinator (rank 0) during the "
+                                    "world change — aborting"),
+                      0);
+    // must exceed the coordinator's ack bound (it may be waiting out a
+    // wedged member before committing or re-proposing)
+    double bound = DuplexTimeoutSeconds() + 30;
+    if (bound < 50) bound = 50;
+    AbortFrame af;
+    WcWait w = AwaitWorldCommit(&wc, bound, &af);
+    if (w == WcWait::kSuperseded) continue;  // re-apply the newer proposal
+    if (w == WcWait::kTimeout)
+      return AbortJob(
+          Status::Error("no world-commit from the coordinator within " +
+                        std::to_string(static_cast<int>(bound)) +
+                        "s — presumed dead; aborting"),
+          0);
+    if (w == WcWait::kLost)
+      return AbortJob(Status::Error("lost coordinator (rank 0) during "
+                                    "the world change — aborting"),
+                      0);
+    if (w == WcWait::kAborted)
+      return AbortJob(Status::Error(af.message), af.dead_rank);
+    rank_ = new_rank;
+    size_ = static_cast<int>(wc.old_ranks.size());
+    hosts_ = std::move(nh);
+    ports_ = std::move(np);
+    hashes_ = std::move(nhash);
+    shm_token_ = std::move(token);
+    break;
+  }
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    aborted_ = false;
+    abort_status_ = Status::OK();
+  }
+  SetAborting(false);
+  Status s = BuildWorld();
+  if (!s.ok())
+    return AbortJob(
+        Status::Error("elastic world rebuild failed: " + s.message), -1);
+  FinishWorldChange(wc.kind == 1, t0);
+  return false;
+}
+
+void Engine::FinishWorldChange(bool join, int64_t t0_ns) {
+  Faults().world_changes.fetch_add(1, std::memory_order_relaxed);
+  if (join) Faults().rank_joins.fetch_add(1, std::memory_order_relaxed);
+  Faults().shrink_latency_ns.fetch_add(NowNs() - t0_ns,
+                                       std::memory_order_relaxed);
+  world_epoch_.fetch_add(1, std::memory_order_relaxed);
+  elastic_wire_fails_.store(0, std::memory_order_relaxed);
+  {
+    // a shutdown announced DURING the change was discarded with the rest
+    // of the old-world control traffic: re-announce it in the new world
+    std::lock_guard<std::mutex> lk(mu_);
+    if (shutdown_requested_) shutdown_sent_ = false;
+  }
+  LOG_RANK(Warning, rank_)
+      << "world change applied: now rank " << rank_ << " of " << size_
+      << " (epoch " << world_epoch_.load(std::memory_order_relaxed) << ")";
+  Wake();  // callers polling world_changed() should not wait out a cycle
+}
+
+int Engine::MaybeAcceptJoin() {
+  if (!elastic_ || rank_ != 0 || !rendezvous_open_) return 0;
+  Socket sock;
+  if (!rendezvous_.Accept(&sock, 0.0).ok()) return 0;  // poll-only
+  // a real joiner's hello is in flight before this tick polls the accept;
+  // the short bound keeps a hello-less connection (port scanner, LB
+  // health probe) from parking the negotiation thread
+  if (!sock.Readable(100)) {
+    LogWarn("elastic: rendezvous connection sent no hello — dropped");
+    return 0;
+  }
+  // Readable proves only the FIRST byte: bound the whole frame read too,
+  // or a partial-frame staller wedges the negotiation thread (and with
+  // it heartbeats — one stray TCP connection must never kill the job)
+  struct timeval tv = {2, 0};
+  setsockopt(sock.fd(), SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  std::string hello;
+  Status hs = sock.RecvFrame(&hello);
+  tv = {0, 0};  // the socket lives on as the joiner's control link
+  setsockopt(sock.fd(), SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  if (!hs.ok()) {
+    LogWarn("elastic: rendezvous hello never completed — dropped");
+    return 0;
+  }
+  std::istringstream is(hello);
+  std::string tag, h, hash;
+  int p = 0;
+  is >> tag >> h >> p >> hash;
+  if (tag != "JOIN" || h.empty() || p <= 0) {
+    LogWarn("elastic: unrecognized rendezvous hello '" + hello +
+            "' — dropped");
+    return 0;
+  }
+  if (size_ + 1 > hb_cap_) {
+    LogWarn("elastic: join rejected — world at liveness capacity");
+    return 0;
+  }
+  join_.sock = std::move(sock);
+  join_.host = h;
+  join_.port = p;
+  join_.hash = hash.empty() ? h : hash;
+  join_.live = true;
+  return CoordinateWorldChange({},
+                               "rank join: relaunched worker at " + h + ":" +
+                                   std::to_string(p) +
+                                   " re-entering the world",
+                               /*join=*/true)
+             ? 1
+             : 2;
 }
 
 // Wake the background thread immediately (submission/shutdown path).  A
@@ -1774,12 +2563,25 @@ void Engine::BackgroundLoop() {
       PipelineStallCheck();
     }
 
+    // a 1-rank elastic world still admits joiners: no CoordinatorTick
+    // runs to poll the rendezvous listener, so the loop does it here —
+    // BEFORE draining the queue, so ops submitted during the change
+    // negotiate in the new world instead of dying with the old one
+    if (rank_ == 0 && size_ == 1 && elastic_ && rendezvous_open_ &&
+        MaybeAcceptJoin() == 1) {
+      stop = true;
+      continue;
+    }
+
     RequestList local;
     {
       std::lock_guard<std::mutex> lk(mu_);
       while (!queue_.empty()) {
         local.requests.push_back(std::move(queue_.front()));
         queue_.pop_front();
+        // stamped at drain, not enqueue: an elastic world change may have
+        // renumbered this rank after the op was submitted
+        local.requests.back().rank = rank_;
       }
       if (shutdown_requested_ && !shutdown_sent_) {
         local.shutdown = true;
@@ -2202,6 +3004,21 @@ void Engine::WorkerTick(RequestList& local, bool* stop) {
           s.ok() ? af.dead_rank : -1);
       return;
     }
+    if (ft == FrameType::kWorldChange) {
+      // elastic membership change: fail the in-flight cycle retryable,
+      // adopt the proposed membership, ack, await the commit, rebuild
+      WorldChangeFrame wcf;
+      s = Parse(frame, &wcf);
+      if (!s.ok()) {
+        *stop = AbortJob(s, -1);
+        return;
+      }
+      *stop = HandleWorldChange(std::move(wcf));
+      return;  // either way this tick's world is gone
+    }
+    if (ft == FrameType::kWorldCommit || ft == FrameType::kWorldAck) {
+      continue;  // stale stragglers from a completed membership round
+    }
     if (ft == FrameType::kCachedExec) {
       CachedExecFrame ce;
       s = Parse(frame, &ce);
@@ -2279,17 +3096,19 @@ bool Engine::CoordinatorTick(RequestList& local) {
       Status s = RecvCtrl(workers_[i], &frame);
       if (!s.ok()) {
         // with a shutdown already in flight this is just a finished worker
-        // closing its socket; otherwise it is a death, and the job must
+        // closing its socket; otherwise it is a death: elastic worlds
+        // SHRINK around it at this negotiation boundary, classic worlds
         // ABORT (every survivor errors and exits) rather than pretend the
         // dead rank asked for a clean shutdown
         worker_live_[i].store(0, std::memory_order_relaxed);
         workers_[i].Close();
         if (shutdown) break;
-        return AbortJob(
-            Status::Error("rank " + std::to_string(i) +
-                          " connection lost (" + s.message +
-                          ") — worker presumed dead; aborting job"),
-            i);
+        int r = OnWorkerDeath(i, "rank " + std::to_string(i) +
+                                 " connection lost (" + s.message +
+                                 ") — worker presumed dead");
+        // shrunk: this tick's negotiation state died with the old world —
+        // abandon the tick but keep the loop running
+        return r == 1;
       }
       NoteSeen(i);  // any worker frame is a liveness proof
       FrameType ft = FrameTypeOf(frame);
@@ -2339,10 +3158,16 @@ bool Engine::CoordinatorTick(RequestList& local) {
   // ...while misses take the full fuse path; stalls are watched on both
   FuseReady(&out);
   if (stall_check_) StallCheck();
-  // fault domain BEFORE the send phase: an abort must precede any response
-  // broadcast this tick, or workers could start collectives the aborting
-  // coordinator will never join
-  if (CoordinatorFaultTick(shutdown)) return true;
+  // fault domain BEFORE the send phase: an abort (or a membership change)
+  // must precede any response broadcast this tick, or workers could start
+  // collectives the aborting coordinator will never join
+  {
+    int ftick = CoordinatorFaultTick(shutdown);
+    if (ftick == 1) return true;
+    // world changed: the tick's negotiation state is stale — abandon it
+    // (the affected handles already failed with the retryable cause)
+    if (ftick == 2) return false;
+  }
   out.shutdown = shutdown;
   bool have_ce = !ce.groups.empty();
   bool have_tuned = pending_tuned_fusion_ >= 0 || pending_tuned_cycle_ >= 0 ||
@@ -2625,11 +3450,16 @@ void Engine::StallCheck() {
 // ---------------------------------------------------------------------------
 
 int64_t Engine::MaxPeerAgeMs() const {
-  if (size_ <= 1 || !hb_seen_) return 0;
+  // world mirrors, not rank_/size_: elastic rebuilds renumber those on
+  // the bg thread while this runs on the Python diagnostics thread (the
+  // hb arrays themselves are allocated once at hb_cap_, never freed)
+  int n = world_size_pub_.load(std::memory_order_relaxed);
+  if (n > hb_cap_) n = hb_cap_;
+  if (n <= 1 || !hb_seen_) return 0;
   int64_t now = NowNs();
   int64_t mx = 0;
-  if (rank_ == 0) {
-    for (int i = 1; i < size_; i++) {
+  if (world_rank_pub_.load(std::memory_order_relaxed) == 0) {
+    for (int i = 1; i < n; i++) {
       // atomic shadow of workers_[i].valid(): this runs on the Python
       // diagnostics thread and must not race the bg thread's Close()
       if (!worker_live_[i].load(std::memory_order_relaxed)) continue;
@@ -2684,13 +3514,14 @@ bool Engine::AbortJob(const Status& st, int dead_rank) {
   return true;
 }
 
-bool Engine::CoordinatorFaultTick(bool shutdown_in_flight) {
-  if (shutdown_in_flight) return false;
+int Engine::CoordinatorFaultTick(bool shutdown_in_flight) {
+  if (shutdown_in_flight) return 0;
   // watchdog escalation raised by StallCheck / PipelineStallCheck
   if (!stall_abort_msg_.empty()) {
     std::string m;
     m.swap(stall_abort_msg_);
-    return AbortJob(Status::Error(m), -1);
+    AbortJob(Status::Error(m), -1);
+    return 1;
   }
   int64_t now = NowNs();
   if (peer_timeout_s_ > 0) {
@@ -2700,17 +3531,25 @@ bool Engine::CoordinatorFaultTick(bool shutdown_in_flight) {
           (now - hb_seen_[i].load(std::memory_order_relaxed)) / 1e9;
       if (age > peer_timeout_s_) {
         Faults().peer_timeouts.fetch_add(1, std::memory_order_relaxed);
-        return AbortJob(
-            Status::Error(
-                "rank " + std::to_string(i) + " sent no control frames "
-                "for " + std::to_string(static_cast<int>(age)) +
-                "s (HOROVOD_TPU_PEER_TIMEOUT_S=" +
-                std::to_string(static_cast<int>(peer_timeout_s_)) +
-                ") — worker presumed dead; aborting job"),
-            i);
+        // a hung-but-alive rank holds its socket open: close it so an
+        // elastic shrink's survivor sweep cannot count the corpse
+        worker_live_[i].store(0, std::memory_order_relaxed);
+        workers_[i].Close();
+        return OnWorkerDeath(
+            i, "rank " + std::to_string(i) + " sent no control frames "
+               "for " + std::to_string(static_cast<int>(age)) +
+               "s (HOROVOD_TPU_PEER_TIMEOUT_S=" +
+               std::to_string(static_cast<int>(peer_timeout_s_)) +
+               ") — worker presumed dead") == 1
+                   ? 1
+                   : 2;
       }
     }
   }
+  // pending joiners are admitted here — the next negotiation boundary
+  // after the relaunched worker dialed the rendezvous listener
+  int jr = MaybeAcceptJoin();
+  if (jr != 0) return jr;
   // idle links get an explicit heartbeat so workers' coordinator-age and
   // this rank's worker-ages stay fresh without any steady-state traffic
   if (hb_interval_s_ > 0 && (now - hb_last_tx_ns_) / 1e9 > hb_interval_s_) {
@@ -2722,17 +3561,17 @@ bool Engine::CoordinatorFaultTick(bool shutdown_in_flight) {
       if (!SendCtrl(workers_[i], frame).ok()) {
         worker_live_[i].store(0, std::memory_order_relaxed);
         workers_[i].Close();
-        return AbortJob(
-            Status::Error("rank " + std::to_string(i) +
-                          " unreachable on heartbeat — worker presumed "
-                          "dead; aborting job"),
-            i);
+        return OnWorkerDeath(
+            i, "rank " + std::to_string(i) +
+               " unreachable on heartbeat — worker presumed dead") == 1
+                   ? 1
+                   : 2;
       }
       Faults().heartbeats_tx.fetch_add(1, std::memory_order_relaxed);
     }
     hb_last_tx_ns_ = now;
   }
-  return false;
+  return 0;
 }
 
 bool Engine::WorkerFaultTick(bool shutdown_in_flight) {
@@ -3223,9 +4062,9 @@ void Engine::RunWire(WorkItem& item) {
       int lane = item.buf ? item.buf->id : -1;
       timeline_.PipelineStart(lane, "WIRE");
       for (auto& e : item.entries) timeline_.ActivityStart(e.req.name, act);
-      item.status = item.hierarchical
-                        ? HierarchicalAllreduce(wr, nelems, dtype)
-                        : RingAllreduce(wr, nelems, dtype);
+      item.status = ElasticizeWire(
+          item.hierarchical ? HierarchicalAllreduce(wr, nelems, dtype)
+                            : RingAllreduce(wr, nelems, dtype));
       for (auto& e : item.entries) timeline_.ActivityEnd(e.req.name);
       timeline_.PipelineEnd(lane);
       break;
@@ -3338,7 +4177,7 @@ void Engine::ExecuteAllreduce(const Response& resp,
     act_start(act);
     WireRegions wr;
     wr.Add(e.payload(), static_cast<int64_t>(e.nbytes));
-    Status st = reduce(wr, NumElems(e.req.dims));
+    Status st = ElasticizeWire(reduce(wr, NumElems(e.req.dims)));
     act_end();
     FinishAllreduceEntry(e, st, /*copy_out=*/true);
     if (!st.ok()) FailAll(st);
@@ -3368,7 +4207,8 @@ void Engine::ExecuteAllreduce(const Response& resp,
   sg_bytes_total_.fetch_add(static_cast<int64_t>(total - pack_total),
                             std::memory_order_relaxed);
   act_start(act);
-  Status st = reduce(wr, static_cast<int64_t>(total / DTypeSize(dtype)));
+  Status st =
+      ElasticizeWire(reduce(wr, static_cast<int64_t>(total / DTypeSize(dtype))));
   act_end();
   FaultInjector::Get().OnPhase(FaultPhase::kUnpack);
   act_start("MEMCPY_OUT_FUSION_BUFFER");
@@ -4643,7 +5483,7 @@ void Engine::ExecuteAllgather(const Response& resp, TensorEntry& entry) {
 
   if (hierarchical_allgather_) {
     std::vector<char> out;
-    Status st = HierarchicalAllgather(resp, entry, stride, &out);
+    Status st = ElasticizeWire(HierarchicalAllgather(resp, entry, stride, &out));
     if (!st.ok()) {
       MarkDone(entry.handle, st, {}, {});
       DataPlaneFail(st);
@@ -4662,7 +5502,7 @@ void Engine::ExecuteAllgather(const Response& resp, TensorEntry& entry) {
   std::vector<size_t> bytes(size_);
   for (int r = 0; r < size_; r++)
     bytes[r] = static_cast<size_t>(resp.first_dims[r] * stride) * esize;
-  Status st = RingAllgatherGroup(all_ranks_, bytes, out.data());
+  Status st = ElasticizeWire(RingAllgatherGroup(all_ranks_, bytes, out.data()));
   if (!st.ok()) {
     MarkDone(entry.handle, st, {}, {});
     DataPlaneFail(st);
@@ -4709,9 +5549,9 @@ Status Engine::TreeBroadcastGroup(char* buf, int64_t nbytes, int root,
 }
 
 void Engine::ExecuteBroadcast(const Response& resp, TensorEntry& entry) {
-  Status st = TreeBroadcast(entry.payload(),
-                            static_cast<int64_t>(entry.nbytes),
-                            resp.root_rank);
+  Status st = ElasticizeWire(TreeBroadcast(entry.payload(),
+                                           static_cast<int64_t>(entry.nbytes),
+                                           resp.root_rank));
   if (!st.ok()) {
     Status err = Status::Error("broadcast failed: " + st.message);
     MarkDone(entry.handle, err, {}, {});
@@ -4916,7 +5756,7 @@ void Engine::ExecuteAlltoall(const Response& resp, TensorEntry& entry) {
     }
   }
   if (!st.ok()) {
-    Status err = Status::Error("alltoall failed: " + st.message);
+    Status err = ElasticizeWire(Status::Error("alltoall failed: " + st.message));
     MarkDone(entry.handle, err, {}, {});
     DataPlaneFail(err);
     return;
@@ -5239,6 +6079,29 @@ void hvd_fault_stats(int64_t* out) {
   out[7] = 0;
 }
 
+// Elastic world statistics, in order: {world epoch (bumps on every applied
+// shrink/join), current world size, current rank, world changes applied,
+// rank joins applied, cumulative detect -> new-world-live latency ns,
+// elastic enabled, reserved}.  The counters are process-wide (fault.h, like
+// the abort counters); epoch/size/rank are -1 when the engine is down.
+void hvd_world_stats(int64_t* out) {
+  if (g_engine) {
+    int64_t w[4];
+    g_engine->WorldStats(w);
+    out[0] = w[0];
+    out[1] = w[1];
+    out[2] = w[2];
+    out[6] = w[3];
+  } else {
+    out[0] = out[1] = out[2] = -1;
+    out[6] = ElasticEnabled() ? 1 : 0;
+  }
+  out[3] = Faults().world_changes.load(std::memory_order_relaxed);
+  out[4] = Faults().rank_joins.load(std::memory_order_relaxed);
+  out[5] = Faults().shrink_latency_ns.load(std::memory_order_relaxed);
+  out[7] = 0;
+}
+
 // The control-plane wire version this .so speaks (kWireVersion mirror for
 // Python-side diagnostics and the ABI drift guard).
 int hvd_wire_version() { return static_cast<int>(kWireVersion); }
@@ -5280,6 +6143,21 @@ const char* hvd_frame_parse_error(const void* buf, int64_t len) {
     }
     case FrameType::kAbort: {
       AbortFrame f;
+      st = Parse(s, &f);
+      break;
+    }
+    case FrameType::kWorldChange: {
+      WorldChangeFrame f;
+      st = Parse(s, &f);
+      break;
+    }
+    case FrameType::kWorldAck: {
+      WorldAckFrame f;
+      st = Parse(s, &f);
+      break;
+    }
+    case FrameType::kWorldCommit: {
+      WorldCommitFrame f;
       st = Parse(s, &f);
       break;
     }

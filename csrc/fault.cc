@@ -32,16 +32,42 @@ double PeerTimeoutSeconds() {
   return t;
 }
 
+namespace {
+// Shared default for both data-plane directions: HOROVOD_TPU_DATA_TIMEOUT_S
+// when set, else the peer timeout.  The dedicated knob decouples "how long
+// may a wedged transfer park" from "is death detection on": PEER_TIMEOUT_S=0
+// used to unbound every no-progress wait too (the PR 5 trade-off), so
+// "detection off" meant "hang forever on a wedged transfer".
+double DataTimeoutDefault() {
+  double v = EnvDouble("HOROVOD_TPU_DATA_TIMEOUT_S", -1.0);
+  if (v >= 0) return v;
+  return PeerTimeoutSeconds();
+}
+}  // namespace
+
 double DuplexTimeoutSeconds() {
   static double t =
-      EnvDouble("HOROVOD_TPU_DATA_PLANE_TIMEOUT_SECS", PeerTimeoutSeconds());
+      EnvDouble("HOROVOD_TPU_DATA_PLANE_TIMEOUT_SECS", DataTimeoutDefault());
   return t;
 }
 
 double OnewayTimeoutSeconds() {
   static double t = EnvDouble("HOROVOD_TPU_DATA_PLANE_ONEWAY_TIMEOUT_SECS",
-                              PeerTimeoutSeconds());
+                              DataTimeoutDefault());
   return t;
+}
+
+bool ElasticEnabled() {
+  static bool on = EnvFlag("HOROVOD_TPU_ELASTIC");
+  return on;
+}
+
+int MinNp() {
+  static int n = [] {
+    int64_t v = EnvInt64("HOROVOD_TPU_MIN_NP", 1);
+    return static_cast<int>(v < 1 ? 1 : v);
+  }();
+  return n;
 }
 
 double HeartbeatIntervalSeconds() {

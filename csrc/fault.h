@@ -52,12 +52,26 @@ namespace hvdtpu {
 // an integer parse would silently turn 0.5 into detection-off.
 double PeerTimeoutSeconds();
 
-// Data-plane no-progress bounds: the per-direction env overrides
-// (HOROVOD_TPU_DATA_PLANE[_ONEWAY]_TIMEOUT_SECS) when set, else the peer
-// timeout.  Shared by engine.cc's progress loops and socket.cc's duplex
-// helper so the pure-TCP and shm-mixed paths stall out identically.
+// Data-plane no-progress bounds.  Resolution order, most specific first:
+// the per-direction env overrides (HOROVOD_TPU_DATA_PLANE[_ONEWAY]_
+// TIMEOUT_SECS), then HOROVOD_TPU_DATA_TIMEOUT_S (one knob for both
+// directions — exists so HOROVOD_TPU_PEER_TIMEOUT_S=0 can turn DETECTION
+// off without also unbounding every wedged transfer, the PR 5 trade-off),
+// then the peer timeout.  Shared by engine.cc's progress loops so the
+// pure-TCP and shm-mixed paths stall out identically.
 double DuplexTimeoutSeconds();
 double OnewayTimeoutSeconds();
+
+// HOROVOD_TPU_ELASTIC: opt-in elastic membership — a dead rank SHRINKS the
+// world at the next negotiation boundary instead of aborting the job (and
+// relaunched ranks may JOIN it back).  Abort stays the default.  Rank 0
+// reads this and ships the decision in the bootstrap table; workers use
+// the shipped value, not their own env.
+bool ElasticEnabled();
+
+// HOROVOD_TPU_MIN_NP: the smallest world elastic shrink may produce
+// (default 1); a death that would shrink below it aborts classically.
+int MinNp();
 
 // Idle-tick heartbeat period.  Steady-state traffic IS the heartbeat
 // (any control frame refreshes last-seen); explicit frames only flow on
@@ -89,6 +103,10 @@ struct FaultCounters {
   std::atomic<int64_t> abort_latency_ns{0};  // detect -> local handles failed
   std::atomic<int64_t> heartbeats_tx{0};
   std::atomic<int64_t> heartbeats_rx{0};
+  // elastic membership (wire v7)
+  std::atomic<int64_t> world_changes{0};   // shrinks + joins applied
+  std::atomic<int64_t> rank_joins{0};      // join-kind changes applied
+  std::atomic<int64_t> shrink_latency_ns{0};  // detect -> new world live
 };
 
 FaultCounters& Faults();

@@ -253,6 +253,15 @@ Status Socket::Connect(const std::string& host, int port, Socket* out,
   auto deadline =
       std::chrono::steady_clock::now() + std::chrono::duration<double>(timeout_s);
   std::string err = "unknown";
+  // exponential backoff with jitter under the total deadline: a slow-
+  // starting peer used to be hammered on a fixed 50 ms tick, which at
+  // bootstrap (n ranks x K stripes all dialing one listener) and at
+  // elastic mesh rebuilds turns into a synchronized SYN storm.  The
+  // jitter de-phases the retriers; the cap keeps worst-case discovery of
+  // a late listener under a second.
+  int64_t backoff_ms = 25;
+  unsigned seed = static_cast<unsigned>(
+      std::chrono::steady_clock::now().time_since_epoch().count() ^ port);
   while (std::chrono::steady_clock::now() < deadline) {
     struct addrinfo hints;
     memset(&hints, 0, sizeof(hints));
@@ -276,11 +285,24 @@ Status Socket::Connect(const std::string& host, int port, Socket* out,
       if (fd >= 0) ::close(fd);
       freeaddrinfo(res);
     }
-    // rendezvous peer may not be listening yet — retry
-    std::this_thread::sleep_for(std::chrono::milliseconds(50));
+    // rendezvous peer may not be listening yet — retry with backoff;
+    // jitter is ±25% of the current step (rand_r: no global PRNG state)
+    int64_t jitter = backoff_ms / 4;
+    int64_t sleep_ms = backoff_ms;
+    if (jitter > 0)
+      sleep_ms += static_cast<int64_t>(rand_r(&seed) % (2 * jitter + 1)) -
+                  jitter;
+    auto left = std::chrono::duration_cast<std::chrono::milliseconds>(
+        deadline - std::chrono::steady_clock::now());
+    if (sleep_ms > left.count()) sleep_ms = left.count();
+    if (sleep_ms > 0)
+      std::this_thread::sleep_for(std::chrono::milliseconds(sleep_ms));
+    backoff_ms = backoff_ms * 2 > 1000 ? 1000 : backoff_ms * 2;
   }
   return Status::Error("connect to " + host + ":" + std::to_string(port) +
-                       " timed out (" + err + ")");
+                       " gave up after " +
+                       std::to_string(static_cast<int>(timeout_s)) +
+                       "s of backoff retries (last error: " + err + ")");
 }
 
 // ---------------------------------------------------------------------------
@@ -357,6 +379,10 @@ void Link::Close() {
 
 void Link::KillStripe(int i) {
   if (i >= 0 && i < n_) socks_[i].ShutdownBoth();
+}
+
+void Link::ShutdownAll() {
+  for (int i = 0; i < n_; i++) socks_[i].ShutdownBoth();
 }
 
 void Link::AdvanceSend(size_t k) {

@@ -148,6 +148,11 @@ class Link {
   // Chaos hook: half-close one stripe so transfers on it fail promptly
   // (tests/test_fault.py's dead-stripe row).
   void KillStripe(int i);
+  // Half-close EVERY stripe without releasing the fds (safe while another
+  // thread is mid-transfer on the link): local blocked transfers fail on
+  // the next syscall and the peer's end sees an RST — how an elastic
+  // world change unwedges both ends of every old-world link at once.
+  void ShutdownAll();
 
   void SetPacing(double bytes_per_sec) { pace_.Reset(bytes_per_sec); }
   double PaceDelaySeconds(size_t want) const {

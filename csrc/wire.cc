@@ -80,10 +80,12 @@ struct Reader {
     off += static_cast<size_t>(n);
     return v;
   }
-  std::vector<int64_t> Dims() {
+  // cap defaults to tensor-shaped lists; membership lists (world-change
+  // dead_ranks/old_ranks) pass the bootstrap table's member bound instead
+  std::vector<int64_t> Dims(int64_t cap = 1024) {
     int64_t n = I64();
     std::vector<int64_t> v;
-    if (n < 0 || n > 1024) {
+    if (n < 0 || n > cap) {
       fail = true;
       return v;
     }
@@ -126,7 +128,7 @@ FrameType FrameTypeOf(const std::string& buf) {
     return FrameType::kInvalid;
   }
   if (type < static_cast<uint16_t>(FrameType::kRequestList) ||
-      type > static_cast<uint16_t>(FrameType::kAbort))
+      type > static_cast<uint16_t>(FrameType::kWorldCommit))
     return FrameType::kInvalid;
   return static_cast<FrameType>(type);
 }
@@ -329,6 +331,68 @@ Status Parse(const std::string& buf, AbortFrame* out) {
   out->dead_rank = rd.I32();
   out->message = rd.Str();
   if (rd.fail) return Status::Error("truncated abort frame");
+  return Status::OK();
+}
+
+std::string Serialize(const WorldChangeFrame& f) {
+  std::string s;
+  PutHeader(&s, FrameType::kWorldChange);
+  PutU64(&s, f.epoch);
+  PutI32(&s, f.kind);
+  PutStr(&s, f.message);
+  PutDims(&s, f.dead_ranks);
+  PutDims(&s, f.old_ranks);
+  PutStr(&s, f.table);
+  return s;
+}
+
+Status Parse(const std::string& buf, WorldChangeFrame* out) {
+  Reader rd{buf};
+  Status hs = ReadHeader(&rd, FrameType::kWorldChange);
+  if (!hs.ok()) return hs;
+  out->epoch = rd.U64();
+  out->kind = rd.I32();
+  out->message = rd.Str();
+  out->dead_ranks = rd.Dims(1 << 20);  // member-count bound, not dims
+  out->old_ranks = rd.Dims(1 << 20);
+  out->table = rd.Str();
+  if (rd.fail) return Status::Error("truncated world-change frame");
+  if (out->old_ranks.empty())
+    return Status::Error("world-change frame proposes an empty world");
+  return Status::OK();
+}
+
+std::string Serialize(const WorldAckFrame& f) {
+  std::string s;
+  PutHeader(&s, FrameType::kWorldAck);
+  PutI32(&s, f.rank);
+  PutU64(&s, f.epoch);
+  return s;
+}
+
+Status Parse(const std::string& buf, WorldAckFrame* out) {
+  Reader rd{buf};
+  Status hs = ReadHeader(&rd, FrameType::kWorldAck);
+  if (!hs.ok()) return hs;
+  out->rank = rd.I32();
+  out->epoch = rd.U64();
+  if (rd.fail) return Status::Error("truncated world-ack frame");
+  return Status::OK();
+}
+
+std::string Serialize(const WorldCommitFrame& f) {
+  std::string s;
+  PutHeader(&s, FrameType::kWorldCommit);
+  PutU64(&s, f.epoch);
+  return s;
+}
+
+Status Parse(const std::string& buf, WorldCommitFrame* out) {
+  Reader rd{buf};
+  Status hs = ReadHeader(&rd, FrameType::kWorldCommit);
+  if (!hs.ok()) return hs;
+  out->epoch = rd.U64();
+  if (rd.fail) return Status::Error("truncated world-commit frame");
   return Status::OK();
 }
 

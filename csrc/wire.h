@@ -23,10 +23,10 @@ namespace hvdtpu {
 
 // Bump kWireVersion on ANY layout change (header, field order, new frame).
 constexpr uint32_t kWireMagic = 0x48564457u;  // "HVDW" little-endian
-constexpr uint16_t kWireVersion = 6;          // v6: striped wire
-                                              // (tuned_wire_stripes knob;
-                                              // striped data-plane hellos
-                                              // and bootstrap-table fields)
+constexpr uint16_t kWireVersion = 7;          // v7: elastic membership
+                                              // (world-change/ack/commit
+                                              // frames; elastic + min-np
+                                              // bootstrap-table fields)
 
 enum class FrameType : uint16_t {
   kInvalid = 0,
@@ -36,6 +36,9 @@ enum class FrameType : uint16_t {
   kCachedExec = 4,    // coordinator -> worker: execute cached slot groups
   kHeartbeat = 5,     // both ways: idle-tick liveness probe (fault domain)
   kAbort = 6,         // coordinator -> worker: job-wide coordinated abort
+  kWorldChange = 7,   // coordinator -> members: new-membership proposal
+  kWorldAck = 8,      // member -> coordinator: proposal applied locally
+  kWorldCommit = 9,   // coordinator -> members: rebuild the data plane now
 };
 
 struct Request {
@@ -121,6 +124,39 @@ struct AbortFrame {
   std::string message;      // human-readable cause, surfaced in handle errors
 };
 
+// Elastic membership change (coordinator -> every member of the NEW world,
+// wire v7): on peer death (kind = shrink) or a pending rank join (kind =
+// join), rank 0 proposes a re-numbered contiguous world at a negotiation
+// boundary.  Members tear down the in-flight cycle (its handles fail with a
+// retryable world-change error), ACK, and on the commit rebuild the data
+// plane for the new membership — survive the death instead of aborting.
+//   old_ranks[i] = the OLD rank of new rank i (-1 for a fresh joiner), so a
+//   recipient finds its new rank by locating its old one; `table` is the
+//   new world's bootstrap table (same text format Init ships, with a fresh
+//   shm token), so the joiner learns every rank-0-decided knob the original
+//   bootstrap would have taught it.
+struct WorldChangeFrame {
+  uint64_t epoch = 0;               // proposal id, monotonic per coordinator
+  int32_t kind = 0;                 // 0 = shrink, 1 = join
+  std::string message;              // cause, surfaced in retryable errors
+  std::vector<int64_t> dead_ranks;  // old ranks presumed dead (may be empty)
+  std::vector<int64_t> old_ranks;   // old rank per new rank; -1 = joiner
+  std::string table;                // new world's bootstrap table text
+};
+
+// Member -> coordinator: "proposal `epoch` applied locally (in-flight cycle
+// failed, old data plane torn down); ready for the commit".  A dead member
+// never acks — the coordinator re-proposes without it.
+struct WorldAckFrame {
+  int32_t rank = 0;    // the sender's NEW rank under the acked proposal
+  uint64_t epoch = 0;
+};
+
+// Coordinator -> members: every member acked `epoch` — rebuild the mesh.
+struct WorldCommitFrame {
+  uint64_t epoch = 0;
+};
+
 // Frame dispatch: the type a buffer claims to carry (kInvalid when the
 // buffer is too short or the magic/version doesn't match).
 FrameType FrameTypeOf(const std::string& buf);
@@ -132,11 +168,17 @@ std::string Serialize(const CacheBitsFrame& f);
 std::string Serialize(const CachedExecFrame& f);
 std::string Serialize(const HeartbeatFrame& f);
 std::string Serialize(const AbortFrame& f);
+std::string Serialize(const WorldChangeFrame& f);
+std::string Serialize(const WorldAckFrame& f);
+std::string Serialize(const WorldCommitFrame& f);
 Status Parse(const std::string& buf, RequestList* out);
 Status Parse(const std::string& buf, ResponseList* out);
 Status Parse(const std::string& buf, CacheBitsFrame* out);
 Status Parse(const std::string& buf, CachedExecFrame* out);
 Status Parse(const std::string& buf, HeartbeatFrame* out);
 Status Parse(const std::string& buf, AbortFrame* out);
+Status Parse(const std::string& buf, WorldChangeFrame* out);
+Status Parse(const std::string& buf, WorldAckFrame* out);
+Status Parse(const std::string& buf, WorldCommitFrame* out);
 
 }  // namespace hvdtpu

@@ -24,6 +24,7 @@ import numpy as np
 
 from horovod_tpu.compression import Compression
 from horovod_tpu.runtime import state as _state
+from horovod_tpu.runtime.fault import WorldShrunkError
 from horovod_tpu.runtime.state import (
     init,
     is_initialized,
@@ -35,6 +36,8 @@ from horovod_tpu.runtime.state import (
     cross_rank,
     cross_size,
     mpi_threads_supported,
+    world_changed,
+    world_epoch,
 )
 
 __version__ = "0.5.0"
@@ -197,6 +200,7 @@ __all__ = [
     "init", "shutdown", "is_initialized",
     "rank", "size", "local_rank", "local_size", "cross_rank", "cross_size",
     "mpi_threads_supported",
+    "world_changed", "world_epoch", "WorldShrunkError",
     "allreduce", "allgather", "broadcast", "alltoall", "barrier",
     "allreduce_async", "allgather_async", "broadcast_async",
     "poll", "synchronize",

@@ -34,6 +34,98 @@ from horovod_tpu.utils import net
 
 
 
+def _exit_code(rc: int) -> int:
+    """Popen returncode -> propagatable exit code (signal deaths map to
+    the shell convention 128+sig)."""
+    return rc if rc >= 0 else 128 - rc
+
+
+def _elastic_supervise(procs, args, first_rank, local_n, spawn,
+                       kill_all) -> int:
+    """Elastic supervision: a dead worker no longer ends the job — the
+    engine shrinks the world around it (and, with ``--restart N`` budget
+    left, the dead slot is relaunched as a JOINER that re-enters at a
+    negotiation boundary).  Only the coordinator's exit decides the job's
+    outcome: rank 0 exits 0 when training finished, non-zero when the
+    world could not survive (below --min-np, coordinator fault, ...)."""
+    restarts_left = max(args.restart or 0, 0)
+    max_np = args.max_np if args.max_np is not None else args.num_proc
+    has_rank0 = first_rank == 0
+    final_rc: dict[int, int] = {}
+    live = set(range(local_n))
+    job_rc = None
+    try:
+        while live:
+            for i in sorted(live):
+                rc = procs[i].poll()
+                if rc is None:
+                    continue
+                live.discard(i)
+                grank = first_rank + i
+                final_rc[i] = rc
+                if has_rank0 and i == 0:
+                    # the coordinator's exit — clean or not — IS the job's
+                    # outcome; stragglers (e.g. a wedged rank the world
+                    # shrank away from) get the settle window then the
+                    # TERM/KILL escalation below
+                    print(f"[horovod_tpu.run] rank 0 (coordinator) "
+                          f"{_fault.describe_exit(rc)}; job over",
+                          file=sys.stderr)
+                    job_rc = _exit_code(rc)
+                    live.clear()
+                    break
+                if rc == 0:
+                    continue
+                print(f"[horovod_tpu.run] rank {grank} "
+                      f"{_fault.describe_exit(rc)}; elastic mode — "
+                      "survivors continue", file=sys.stderr)
+                if restarts_left > 0 and len(live) + 1 <= max_np:
+                    restarts_left -= 1
+                    print(f"[horovod_tpu.run] relaunching rank {grank} as "
+                          f"a joiner ({restarts_left} restart(s) left)",
+                          file=sys.stderr)
+                    procs[i] = spawn(i, join=True)
+                    live.add(i)
+            if live:
+                time.sleep(0.05)
+    finally:
+        # settle: give clean finishers the grace window, then reap
+        settle = time.monotonic() + max(args.grace_period, 0.1)
+        while (time.monotonic() < settle
+               and any(p.poll() is None for p in procs)):
+            time.sleep(0.05)
+        kill_all()
+    if job_rc is None:
+        if has_rank0:
+            # worker deaths were survived BY DESIGN: the coordinator's
+            # clean exit is the job finishing
+            job_rc = _exit_code(final_rc.get(0, 1))
+        elif any(rc == 0 for rc in final_rc.values()):
+            # non-coordinator host: rank 0 (on another host) owns the
+            # job's outcome, and a local death the world shrank away
+            # from is not a job failure.  Any local worker finishing
+            # CLEANLY proves the coordinated shutdown reached this
+            # host — the job completed; report success
+            job_rc = 0
+        else:
+            # no local rank finished cleanly (job-wide abort, or every
+            # local rank was killed): surface the first failure
+            bad = [rc for rc in final_rc.values() if rc != 0]
+            job_rc = _exit_code(bad[0]) if bad else 0
+    if job_rc != 0:
+        print("[horovod_tpu.run] post-mortem:", file=sys.stderr)
+        for i in range(local_n):
+            line = _fault.post_mortem_line(
+                first_rank + i,
+                procs[i].poll() if i < len(procs) else None,
+                metrics_dir=args.metrics_dir
+                or os.environ.get("HOROVOD_TPU_METRICS_DIR"),
+                timeline_path=args.timeline
+                or os.environ.get("HOROVOD_TIMELINE"))
+            print(f"[horovod_tpu.run]   {line}", file=sys.stderr)
+    return job_rc
+
+
 def _parse_hosts(spec: str) -> list[tuple[str, int]]:
     out = []
     for part in spec.split(","):
@@ -109,6 +201,35 @@ def main(argv=None) -> int:
                          "default 60, 0 disables). A rank silent past this "
                          "bound triggers a job-wide coordinated abort "
                          "instead of the classic everybody-hangs")
+    ap.add_argument("--data-timeout", type=float, default=None, metavar="S",
+                    help="data-plane no-progress bound in seconds (sets "
+                         "HOROVOD_TPU_DATA_TIMEOUT_S; defaults to the peer "
+                         "timeout). Bounds wedged transfers independently "
+                         "of death DETECTION, so --peer-timeout 0 no "
+                         "longer means 'hang forever on a wedged transfer'")
+    ap.add_argument("--min-np", type=int, default=None, metavar="N",
+                    help="opt into ELASTIC membership with this world-size "
+                         "floor (sets HOROVOD_TPU_ELASTIC=1 and "
+                         "HOROVOD_TPU_MIN_NP): a dead rank SHRINKS the "
+                         "world at the next negotiation boundary instead "
+                         "of aborting the job, as long as at least N ranks "
+                         "survive; below N the classic coordinated abort "
+                         "runs. In-flight collectives fail with a "
+                         "retryable WorldShrunkError the training loop "
+                         "answers with hvd.world_changed()")
+    ap.add_argument("--max-np", type=int, default=None, metavar="N",
+                    help="elastic ceiling: relaunched ranks only re-join "
+                         "while the world is below N (default: the "
+                         "launch's -np). Approximate on multi-host "
+                         "launches: each launcher counts only its OWN "
+                         "live workers against the ceiling")
+    ap.add_argument("--restart", type=int, default=0, metavar="N",
+                    help="elastic mode: relaunch up to N dead workers as "
+                         "JOINERS (HOROVOD_TPU_JOIN=1) — the world shrinks "
+                         "around the death, then grows back when the "
+                         "relaunched worker re-enters at a negotiation "
+                         "boundary. Rank 0 (the coordinator) is never "
+                         "relaunched: its death still ends the job")
     ap.add_argument("--grace-period", type=float,
                     default=float(os.environ.get("HOROVOD_TPU_GRACE_S", 10)),
                     metavar="S",
@@ -190,7 +311,10 @@ def main(argv=None) -> int:
     signal.signal(signal.SIGINT, lambda *a: (_kill_all(), sys.exit(130)))
     signal.signal(signal.SIGTERM, lambda *a: (_kill_all(), sys.exit(143)))
 
-    for local_rank in range(local_n):
+    elastic = args.min_np is not None or _fault.elastic_enabled()
+    min_np_val = args.min_np if args.min_np is not None else _fault.min_np()
+
+    def _spawn(local_rank: int, join: bool = False) -> subprocess.Popen:
         rank = first_rank + local_rank
         env = dict(os.environ)
         env.update({
@@ -221,9 +345,32 @@ def main(argv=None) -> int:
             env["HOROVOD_TPU_SG_THRESHOLD_BYTES"] = str(args.sg_threshold)
         if args.peer_timeout is not None:
             env["HOROVOD_TPU_PEER_TIMEOUT_S"] = str(args.peer_timeout)
+        if args.data_timeout is not None:
+            env["HOROVOD_TPU_DATA_TIMEOUT_S"] = str(args.data_timeout)
+        if elastic:
+            env["HOROVOD_TPU_ELASTIC"] = "1"
+            env["HOROVOD_TPU_MIN_NP"] = str(max(min_np_val, 1))
+        if join:
+            # a relaunched worker re-enters the RUNNING world through the
+            # coordinator's rendezvous listener; its env rank describes
+            # the dead slot, the engine negotiates the real one
+            env["HOROVOD_TPU_JOIN"] = "1"
+            # the chaos spec targeted the ORIGINAL incarnation: a joiner
+            # that re-arms the same kill would just die again and burn
+            # the restart budget on a loop
+            env.pop("HOROVOD_TPU_FAULT_INJECT", None)
+        else:
+            env.pop("HOROVOD_TPU_JOIN", None)
         # each worker leads its own process group so a stuck worker's whole
         # subtree can be killed
-        procs.append(subprocess.Popen(cmd, env=env, start_new_session=True))
+        return subprocess.Popen(cmd, env=env, start_new_session=True)
+
+    for local_rank in range(local_n):
+        procs.append(_spawn(local_rank))
+
+    if elastic:
+        return _elastic_supervise(procs, args, first_rank, local_n, _spawn,
+                                  _kill_all)
 
     exit_code = 0
     failed = False

@@ -37,6 +37,24 @@ PEER_TIMEOUT_ENV = "HOROVOD_TPU_PEER_TIMEOUT_S"
 HEARTBEAT_ENV = "HOROVOD_TPU_HEARTBEAT_S"
 STALL_ABORT_ENV = "HOROVOD_TPU_STALL_ABORT_S"
 INJECT_ENV = "HOROVOD_TPU_FAULT_INJECT"
+DATA_TIMEOUT_ENV = "HOROVOD_TPU_DATA_TIMEOUT_S"
+ELASTIC_ENV = "HOROVOD_TPU_ELASTIC"
+MIN_NP_ENV = "HOROVOD_TPU_MIN_NP"
+JOIN_ENV = "HOROVOD_TPU_JOIN"
+
+# Mirror of csrc/engine.cc kWorldChangeTag: the retryable-failure prefix
+# every handle cancelled by an elastic membership change carries.  native.py
+# raises WorldShrunkError when a collective fails with it.
+WORLD_CHANGE_TAG = "[world-change]"
+
+
+class WorldShrunkError(RuntimeError):
+    """A collective was cancelled because the world membership is changing
+    (a rank died and the survivors are re-forming, or a rank is joining).
+
+    Retryable: wait for ``hvd.world_changed()`` to report the new world,
+    re-scale optimizer state to the new ``hvd.size()``, re-broadcast
+    whatever must stay replicated, and re-run the collective."""
 
 
 def peer_timeout_s() -> float:
@@ -67,6 +85,34 @@ def stall_abort_s() -> float:
     except ValueError:
         v = 0.0
     return max(v, 0.0)
+
+
+def data_timeout_s() -> float:
+    """Mirror of csrc/fault.cc DataTimeoutDefault: the data-plane
+    no-progress bound (``HOROVOD_TPU_DATA_TIMEOUT_S``) — defaults to the
+    peer timeout, and exists so detection-off (peer timeout 0) no longer
+    means "hang forever on a wedged transfer"."""
+    env = os.environ.get(DATA_TIMEOUT_ENV, "")
+    if env:
+        try:
+            return max(float(env), 0.0)
+        except ValueError:
+            pass
+    return peer_timeout_s()
+
+
+def elastic_enabled(environ=os.environ) -> bool:
+    """Mirror of csrc/fault.cc ElasticEnabled (HOROVOD_TPU_ELASTIC)."""
+    v = environ.get(ELASTIC_ENV, "")
+    return bool(v) and v.lower() not in ("0", "false", "no", "off")
+
+
+def min_np(environ=os.environ) -> int:
+    """Mirror of csrc/fault.cc MinNp (HOROVOD_TPU_MIN_NP, default 1)."""
+    try:
+        return max(int(environ.get(MIN_NP_ENV, "") or 1), 1)
+    except ValueError:
+        return 1
 
 
 # ---------------------------------------------------------------------------

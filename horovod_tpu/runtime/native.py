@@ -205,6 +205,12 @@ def _bind(lib):
         lib.hvd_debug_kill_stripe.restype = None
     except AttributeError:
         pass
+    try:
+        # elastic membership (wire v7); same prebuilt-.so caveat
+        lib.hvd_world_stats.argtypes = [ctypes.POINTER(ctypes.c_int64)]
+        lib.hvd_world_stats.restype = None
+    except AttributeError:
+        pass
     return lib
 
 
@@ -278,7 +284,31 @@ class NativeEngine(Engine):
         d.update(self._ring_stats())
         d.update(self._fault_stats())
         d.update(self._wire_stats())
+        d.update(self.world_stats())
         return d
+
+    def world_stats(self) -> dict:
+        """Elastic world info: ``world_epoch`` bumps on every applied
+        shrink/join (``hvd.world_changed()`` polls it), ``world_size`` /
+        ``world_rank`` are the engine's CURRENT values (they diverge from
+        the launch env after a shrink), and the counters are process-wide.
+        Engine-down/predates-elastic: epoch 0, size/rank from nothing."""
+        fn = getattr(self._lib, "hvd_world_stats", None)
+        if fn is None:
+            return {"world_epoch": 0, "world_size": self._topology.size,
+                    "world_rank": self._topology.rank, "world_changes": 0,
+                    "rank_joins": 0, "shrink_latency_ns": 0, "elastic": 0}
+        vals = (ctypes.c_int64 * 8)()
+        fn(vals)
+        return {
+            "world_epoch": max(int(vals[0]), 0),
+            "world_size": int(vals[1]),
+            "world_rank": int(vals[2]),
+            "world_changes": max(int(vals[3]), 0),
+            "rank_joins": max(int(vals[4]), 0),
+            "shrink_latency_ns": max(int(vals[5]), 0),
+            "elastic": max(int(vals[6]), 0),
+        }
 
     def topology_describe(self) -> dict | None:
         """The engine's topology descriptor (hosts x NICs x ranks): ring
@@ -438,7 +468,7 @@ class NativeEngine(Engine):
                      "ring_segments": 0, "ring_bytes": 0,
                      "peer_timeouts": 0, "aborts": 0, "heartbeats_tx": 0,
                      "heartbeats_rx": 0, "sg_bytes_skipped": 0,
-                     "pack_bytes": 0}
+                     "pack_bytes": 0, "world_changes": 0, "rank_joins": 0}
         # per-stripe tx bytes: one labelled counter per stripe index
         stripe_seen = [0] * 8
         cumulative = (
@@ -455,19 +485,27 @@ class NativeEngine(Engine):
             ("aborts", telemetry.NATIVE_ABORTS),
             ("heartbeats_tx", telemetry.NATIVE_HEARTBEATS_TX),
             ("heartbeats_rx", telemetry.NATIVE_HEARTBEATS_RX),
+            ("world_changes", telemetry.NATIVE_WORLD_CHANGES),
+            ("rank_joins", telemetry.NATIVE_RANK_JOINS),
         )
         # the FAULT counters are process-wide by design (fault.h: they
         # survive engine re-init like the registry does) — seed their
         # last-seen from the CURRENT values so a second init() in this
         # process doesn't re-mirror the first engine's whole history
         fault_now = self._fault_stats()
+        world_now = self.world_stats()
         for k in ("peer_timeouts", "aborts", "heartbeats_tx",
                   "heartbeats_rx"):
             last_seen[k] = fault_now[k]
+        for k in ("world_changes", "rank_joins"):
+            last_seen[k] = world_now[k]
         # abort latency: each collection observes the window's mean
         # detect->handles-failed latency (cumulative ns / cumulative count
         # deltas), same scheme as the pipeline stage histograms
         abort_seen = [fault_now["abort_latency_ns"], fault_now["aborts"]]
+        # shrink latency: same windowed-mean scheme over world changes
+        shrink_seen = [world_now["shrink_latency_ns"],
+                       world_now["world_changes"]]
         # per-stage cumulative (ns, item count) at last collection: each
         # collection observes the mean per-item stage latency of the
         # window into the stage histogram
@@ -502,6 +540,8 @@ class NativeEngine(Engine):
             if d["heartbeat_age_s"] >= 0:  # -1 = engine down: keep the
                 reg.gauge(telemetry.NATIVE_HEARTBEAT_AGE).set(  # last real age
                     d["heartbeat_age_s"])
+            if d["world_size"] > 0:  # -1 = engine down: keep the last size
+                reg.gauge(telemetry.NATIVE_WORLD_SIZE).set(d["world_size"])
             with mirror_lock:
                 for key, metric in cumulative:
                     delta = d[key] - last_seen[key]
@@ -530,6 +570,13 @@ class NativeEngine(Engine):
                         dns / dn / 1e9)
                     abort_seen[0] = d["abort_latency_ns"]
                     abort_seen[1] = d["aborts"]
+                dns = d["shrink_latency_ns"] - shrink_seen[0]
+                dn = d["world_changes"] - shrink_seen[1]
+                if dn > 0 and dns >= 0:
+                    reg.histogram(telemetry.NATIVE_SHRINK_LATENCY).observe(
+                        dns / dn / 1e9)
+                    shrink_seen[0] = d["shrink_latency_ns"]
+                    shrink_seen[1] = d["world_changes"]
 
         self._diagnostics_collector = collect
         reg.register_collector(collect)
@@ -630,6 +677,13 @@ class NativeEngine(Engine):
                     msg = ctypes.cast(p, ctypes.c_char_p).value.decode()
                 finally:
                     self._lib.hvd_free_cstr(p)
+                from horovod_tpu.runtime.fault import (WORLD_CHANGE_TAG,
+                                                       WorldShrunkError)
+
+                if WORLD_CHANGE_TAG in msg:
+                    # elastic membership change cancelled this collective:
+                    # retryable — wait for world_changed(), then re-run
+                    raise WorldShrunkError(f"collective failed: {msg}")
                 raise RuntimeError(f"collective failed: {msg}")
             with self._lock:
                 direct = self._out_by_handle.get(handle)

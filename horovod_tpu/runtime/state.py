@@ -10,6 +10,7 @@ all — meshes and axis names are explicit arguments.
 from __future__ import annotations
 
 import atexit
+import os
 import threading
 
 from horovod_tpu.utils.topo import Topology, detect_topology
@@ -21,6 +22,8 @@ class _State:
         self.initialized = False
         self.topology: Topology | None = None
         self.engine = None
+        # last elastic world epoch observed by world_changed()
+        self.world_epoch_seen = 0
 
 
 _state = _State()
@@ -31,6 +34,26 @@ class NotInitializedError(RuntimeError):
         super().__init__(
             "horovod_tpu has not been initialized; call horovod_tpu.init() first"
         )
+
+
+def _world_topology(eng, base: Topology) -> Topology:
+    """The live world's Topology, rebuilt from the engine's published
+    world rank/size and local placement — and repointed into the engine
+    so its own checks (broadcast root range, alltoall divisibility) see
+    the same world.  Shared by ``init()``'s joiner branch and
+    ``world_changed()`` so the two views can never drift."""
+    w = eng.world_stats()
+    lr, ls, cr, cs = eng.local_topology()
+    topo = Topology(
+        rank=int(w["world_rank"]), size=int(w["world_size"]),
+        local_rank=lr, local_size=ls,
+        cross_rank=cr, cross_size=cs,
+        num_local_devices=base.num_local_devices,
+        platform=base.platform,
+    )
+    if hasattr(eng, "_topology"):
+        eng._topology = topo
+    return topo
 
 
 def init(comm=None) -> None:
@@ -91,9 +114,18 @@ def init(comm=None) -> None:
                 num_local_devices=topology.num_local_devices,
                 platform=topology.platform,
             )
+        if (os.environ.get("HOROVOD_TPU_JOIN") and engine is not None
+                and hasattr(engine, "world_stats")):
+            # elastic joiner: the launch env describes the DEAD slot's
+            # original world — the engine negotiated the real rank/size
+            # with the coordinator during its join bootstrap
+            topology = _world_topology(engine, topology)
         _state.topology = topology
         _state.engine = engine
         _state.initialized = True
+        _state.world_epoch_seen = (
+            engine.world_stats()["world_epoch"]
+            if engine is not None and hasattr(engine, "world_stats") else 0)
     # after the lock: the dump thread may itself call rank-reading APIs.
     # Processes outside an active sub-communicator (rank -1, no engine)
     # start no dumper — a rank0-named dump from them would clobber the
@@ -169,6 +201,39 @@ def cross_rank() -> int:
 
 def cross_size() -> int:
     return _topology().cross_size
+
+
+def world_epoch() -> int:
+    """The elastic world epoch: 0 at init, +1 for every applied membership
+    change (shrink or join).  Pollable from any thread."""
+    _topology()  # raises NotInitializedError when appropriate
+    eng = _state.engine
+    if eng is None or not hasattr(eng, "world_stats"):
+        return 0
+    return int(eng.world_stats()["world_epoch"])
+
+
+def world_changed() -> bool:
+    """True when the world membership changed since the last call (or
+    since init) — and, when it did, refreshes ``rank()``/``size()`` and
+    the local placement from the engine's new world.
+
+    The elastic recovery loop: catch :class:`WorldShrunkError` from a
+    collective, poll ``world_changed()`` until it reports the new world,
+    re-scale optimizer state to the new ``size()``, re-broadcast whatever
+    must stay replicated, and re-run the collective."""
+    with _state.lock:
+        if not _state.initialized:
+            raise NotInitializedError()
+        eng = _state.engine
+        if eng is None or not hasattr(eng, "world_stats"):
+            return False
+        w = eng.world_stats()
+        if int(w["world_epoch"]) == _state.world_epoch_seen:
+            return False
+        _state.topology = _world_topology(eng, _state.topology)
+        _state.world_epoch_seen = int(w["world_epoch"])
+        return True
 
 
 def mpi_threads_supported() -> bool:

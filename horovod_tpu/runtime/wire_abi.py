@@ -13,8 +13,8 @@ from __future__ import annotations
 
 # csrc/wire.h — frame header
 WIRE_MAGIC = 0x48564457  # "HVDW" little-endian
-WIRE_VERSION = 6         # v6: striped wire (tuned_wire_stripes knob;
-                         # striped data-plane hellos + bootstrap fields)
+WIRE_VERSION = 7         # v7: elastic membership (world-change/ack/commit
+                         # frames; elastic + min-np bootstrap-table fields)
 
 # csrc/wire.h — FrameType
 FRAME_INVALID = 0
@@ -24,6 +24,9 @@ FRAME_CACHE_BITS = 3
 FRAME_CACHED_EXEC = 4
 FRAME_HEARTBEAT = 5
 FRAME_ABORT = 6
+FRAME_WORLD_CHANGE = 7
+FRAME_WORLD_ACK = 8
+FRAME_WORLD_COMMIT = 9
 
 FRAME_TYPES = {
     "kInvalid": FRAME_INVALID,
@@ -33,7 +36,14 @@ FRAME_TYPES = {
     "kCachedExec": FRAME_CACHED_EXEC,
     "kHeartbeat": FRAME_HEARTBEAT,
     "kAbort": FRAME_ABORT,
+    "kWorldChange": FRAME_WORLD_CHANGE,
+    "kWorldAck": FRAME_WORLD_ACK,
+    "kWorldCommit": FRAME_WORLD_COMMIT,
 }
+
+# csrc/wire.h — WorldChangeFrame.kind (elastic membership, wire v7)
+WORLD_CHANGE_SHRINK = 0
+WORLD_CHANGE_JOIN = 1
 
 
 def frame_header(version: int = WIRE_VERSION,

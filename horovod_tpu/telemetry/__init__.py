@@ -100,6 +100,14 @@ NATIVE_ABORT_LATENCY = "hvd_abort_latency_seconds"
 NATIVE_HEARTBEATS_TX = "hvd_heartbeats_tx_total"
 NATIVE_HEARTBEATS_RX = "hvd_heartbeats_rx_total"
 
+# elastic membership (wire v7): the live world size (shrinks when a dead
+# rank is survived, grows when a relaunched rank joins), the applied
+# membership changes, and the detect -> new-world-live latency histogram
+NATIVE_WORLD_SIZE = "hvd_world_size"
+NATIVE_WORLD_CHANGES = "hvd_world_changes_total"
+NATIVE_RANK_JOINS = "hvd_rank_joins_total"
+NATIVE_SHRINK_LATENCY = "hvd_shrink_latency_seconds"
+
 _TRUTHY = ("1", "true", "yes", "on")
 
 _registry = MetricsRegistry()
@@ -349,4 +357,6 @@ __all__ = [
     "NATIVE_SG_BYTES_SKIPPED", "NATIVE_PACK_BYTES", "NATIVE_SG_THRESHOLD",
     "NATIVE_HEARTBEAT_AGE", "NATIVE_PEER_TIMEOUTS", "NATIVE_ABORTS",
     "NATIVE_ABORT_LATENCY", "NATIVE_HEARTBEATS_TX", "NATIVE_HEARTBEATS_RX",
+    "NATIVE_WORLD_SIZE", "NATIVE_WORLD_CHANGES", "NATIVE_RANK_JOINS",
+    "NATIVE_SHRINK_LATENCY",
 ]

@@ -886,6 +886,183 @@ def scenario_fault_idle():
     print(f"rank {r}: fault idle ran dry with no fault", flush=True)
 
 
+def scenario_elastic_loop():
+    """Elastic chaos workload: a steady allreduce-of-ones stream under
+    HOROVOD_TPU_ELASTIC=1 and an injected kill (or a supervisor-driven
+    join).  Survivors must NOT exit: the cancelled collective raises the
+    retryable WorldShrunkError, the worker waits out hvd.world_changed(),
+    and the loop resumes in the re-formed world — where the sum-of-ones
+    result IS the live world size, so correctness self-asserts.
+
+    Engine rank 0 (stable across changes — coordinator death aborts)
+    decides termination once it has observed HVD_TEST_CHANGES world
+    changes (or reached HVD_TEST_EXPECT_FINAL_SIZE — staggered deaths
+    may fold into fewer changes) and HVD_TEST_STEPS_AFTER further clean
+    steps; everyone else
+    (joiners included) leaves when the coordinated shutdown fails their
+    next collective.  Prints per-event markers the chaos tests parse:
+    RETRYABLE / WORLD_CHANGED size=N / SHRINK_LATENCY_S=x."""
+    import time as _time
+
+    hvd.init()
+    launch_rank = int(os.environ.get("HOROVOD_TPU_RANK", "0"))
+    elems = int(os.environ.get("HVD_TEST_ELEMS", "4096"))
+    steps_after = int(os.environ.get("HVD_TEST_STEPS_AFTER", "10"))
+    want_changes = int(os.environ.get("HVD_TEST_CHANGES", "1"))
+    expect_final = os.environ.get("HVD_TEST_EXPECT_FINAL_SIZE")
+    data = np.ones(elems, np.float32)
+    from horovod_tpu.runtime import state as _st
+
+    changes_seen = 0
+    post_steps = 0
+    t_err = None
+    done = 0.0
+    ws = hvd.size()
+    for step in range(100000):
+        size_before = hvd.size()
+        # a 4-tensor async burst per step (like fault_loop): fused groups
+        # exercise the pack/unpack phases the injector hooks
+        hs = [hvd.allreduce_async(data, average=False, name=f"el{i}")
+              for i in range(4)]
+        try:
+            outs = [hvd.synchronize(h) for h in hs]
+            # rank 0 decides termination; the broadcast makes every rank
+            # (late joiners included) leave the loop on the SAME step, so
+            # nobody is still submitting when the coordinator exits
+            stop = hvd.broadcast(np.array([done], np.float32),
+                                 root_rank=0, name="el_stop")
+        except hvd.WorldShrunkError as e:
+            if t_err is None:
+                t_err = _time.monotonic()
+                print(f"rank {launch_rank}: RETRYABLE: {e}", flush=True)
+            for h in hs:  # drain the burst's remaining failed handles
+                try:
+                    hvd.synchronize(h)
+                except (RuntimeError, ValueError):
+                    pass
+            deadline = _time.monotonic() + 60
+            while not hvd.world_changed():
+                if _time.monotonic() > deadline:
+                    raise SystemExit(
+                        f"rank {launch_rank}: world never re-formed")
+                _time.sleep(0.02)
+            continue
+        except RuntimeError as e:
+            if "shut down" in str(e):
+                break  # coordinated clean shutdown reached this rank
+            raise
+        if stop[0] > 0:
+            ws = hvd.size()
+            break
+        changed = hvd.world_changed()
+        ws = hvd.size()
+        # the sum of ones IS the world size; around a change the result
+        # may belong to either the old or the new world
+        for out in outs:
+            assert out[0] in (float(size_before), float(ws)), (
+                launch_rank, out[0], size_before, ws)
+        d = _st.engine().world_stats()
+        if changed or d["world_changes"] > changes_seen:
+            changes_seen = d["world_changes"]
+            print(f"rank {launch_rank}: WORLD_CHANGED size={ws} "
+                  f"changes={d['world_changes']} joins={d['rank_joins']}",
+                  flush=True)
+            if t_err is not None:
+                print(f"rank {launch_rank}: SHRINK_LATENCY_S="
+                      f"{_time.monotonic() - t_err:.3f}", flush=True)
+                t_err = None
+            post_steps = 0
+        # the change count is a target, not a promise: a death landing
+        # DURING a shrink folds into the re-proposed round, so two kills
+        # may surface as ONE world change — reaching the expected final
+        # size (after at least one change) settles the world just as well
+        settled = (changes_seen >= want_changes
+                   or (expect_final is not None and changes_seen >= 1
+                       and ws == int(expect_final)))
+        if settled:
+            post_steps += 1
+            # the final size is a termination GATE, not an assertion: with
+            # staggered multi-death injections the world may still be
+            # mid-journey when the change count first hits the target
+            if (hvd.rank() == 0 and post_steps >= steps_after
+                    and (not expect_final or ws == int(expect_final))):
+                done = 1.0  # broadcast on the NEXT step stops everyone
+    else:
+        print(f"rank {launch_rank}: elastic loop ran dry with no change",
+              flush=True)
+        sys.exit(5)
+    hvd.shutdown()
+    print(f"rank {launch_rank}: elastic loop OK world={ws} "
+          f"changes={changes_seen}", flush=True)
+
+
+def scenario_elastic_dump():
+    """Bitwise checker for the shrunk world: after the world reaches
+    HVD_TEST_EXPECT_SIZE members, run a deterministic allreduce battery
+    (same rng stream everywhere, per-rank scale from HVD_TEST_VALUES
+    keyed by LAUNCH rank) and dump the raw result bytes by NEW rank.
+    The test runs this once under an injected kill (survivors shrink to
+    the target size first) and once as a FRESH job launched directly at
+    that size with the survivors' values — the dumps must match byte for
+    byte: a shrunk world must compute exactly what a fresh world of that
+    shape computes."""
+    import time as _time
+
+    hvd.init()
+    launch_rank = int(os.environ.get("HOROVOD_TPU_RANK", "0"))
+    values = os.environ.get("HVD_TEST_VALUES", "")
+    my_value = (float(values.split(",")[launch_rank])
+                if values else float(launch_rank))
+    out_dir = os.environ["HVD_TEST_OUT_DIR"]
+    expect_size = int(os.environ["HVD_TEST_EXPECT_SIZE"])
+    rng = np.random.default_rng(99)  # same stream on every rank
+    sizes = (1001, 32768, 65537)
+    bases = [rng.standard_normal(sz) for sz in sizes]
+    if os.environ.get("HVD_TEST_ELASTIC_KILL") == "1":
+        # chaos leg: generate ring traffic until the injected kill lands
+        # and the world shrinks to the target size
+        data = np.ones(1 << 16, np.float32)
+        deadline = _time.monotonic() + 90
+        while hvd.size() != expect_size:
+            if _time.monotonic() > deadline:
+                raise SystemExit(
+                    f"rank {launch_rank}: world never shrank to "
+                    f"{expect_size} (still {hvd.size()})")
+            try:
+                hvd.allreduce(data, average=False, name="warm")
+                hvd.world_changed()
+            except hvd.WorldShrunkError:
+                while (not hvd.world_changed()
+                       and _time.monotonic() < deadline):
+                    _time.sleep(0.02)
+    assert hvd.size() == expect_size, (hvd.size(), expect_size)
+    chunks = []
+    for i, base in enumerate(bases):
+        for dtype in (np.float32, np.float64):
+            arr = (base * (my_value + 1)).astype(dtype)
+            for _ in range(50):  # a straggler change may still interrupt
+                try:
+                    out = hvd.allreduce(
+                        arr, average=False,
+                        name=f"eb{i}.{np.dtype(dtype).name}")
+                    break
+                except hvd.WorldShrunkError:
+                    while not hvd.world_changed():
+                        _time.sleep(0.02)
+            else:
+                raise SystemExit(
+                    f"rank {launch_rank}: eb{i} never completed")
+            chunks.append(np.ascontiguousarray(out))
+    blob = b"".join(c.tobytes() for c in chunks)
+    new_rank = hvd.rank()
+    path = os.path.join(out_dir, f"elastic_dump_r{new_rank}.bin")
+    with open(path, "wb") as f:
+        f.write(blob)
+    hvd.shutdown()
+    print(f"rank {launch_rank}: elastic dump OK newrank={new_rank} "
+          f"({len(blob)} bytes)", flush=True)
+
+
 def scenario_fault_sigterm_stuck():
     """Supervision test: rank 0 fails fast; the others trap SIGTERM and
     refuse to die, so only the launcher's grace-then-SIGKILL escalation

@@ -133,6 +133,37 @@ def test_fault_bench_detection_bounded():
     assert points >= 10, f"only {points} chaos points in BENCH_r09"
 
 
+def test_elastic_artifact_shows_survival():
+    """BENCH_r11's counted series: every elastic injection point must show
+    the world actually SURVIVING the death — job exit 0, the expected
+    shrunk (or re-grown) final size, the exact number of membership
+    changes, and a rank join on the rejoin rows.  These are pure functions
+    of the injection (scheduling/pacing independent), so they gate; the
+    latency series are recorded with the usual 2-core-host caveats and are
+    NOT gated (tests/test_fault.py's TCP row bounds latency live)."""
+    r11 = _baseline("BENCH_r11.json")
+    points = 0
+    for np_key, np_ in (("np2", 2), ("np4", 4)):
+        p = r11.get(np_key)
+        if not p:
+            continue
+        for label, row in p.items():
+            if not isinstance(row, dict) or "exit_code" not in row:
+                continue
+            points += 1
+            assert row["exit_code"] == 0, (np_key, label, row)
+            if label == "kill_ring_rejoin":
+                assert row["world_changes"] == 2, (np_key, label, row)
+                assert row["rank_joins"] == 1, (np_key, label, row)
+                assert row["final_size"] == np_, (np_key, label, row)
+            else:
+                assert row["world_changes"] == 1, (np_key, label, row)
+                assert row["rank_joins"] == 0, (np_key, label, row)
+                assert row["final_size"] == np_ - 1, (np_key, label, row)
+            assert row["shrink_latency_max_s"] is not None, (np_key, label)
+    assert points >= 10, f"only {points} elastic points in BENCH_r11"
+
+
 def test_wire_counted_series_gate():
     """Fresh striped + scatter-gather fused steps at the BENCH_r10
     workload shape (-np 2, 4 stripes, 64 KB quantum, SG on) vs the

@@ -208,6 +208,285 @@ def test_delay_injection_slows_but_completes():
 
 
 # ---------------------------------------------------------------------------
+# elastic membership (wire v7): survive the death — shrink, don't abort
+# ---------------------------------------------------------------------------
+
+def _run_elastic(scenario: str, np_: int, inject: str, extra_env=None,
+                 hvdrun_args=(), grace: float = 3.0,
+                 timeout: float = EXIT_WALL_S + 60):
+    """One elastic chaos launch: detection pinned tight, the data-plane
+    no-progress bound pinned TIGHTER (the split-knob satellite — shm-parked
+    survivors have no RST to unwedge them), elastic on via --min-np."""
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "HOROVOD_TPU_FAULT_INJECT": inject,
+        "HOROVOD_TPU_PEER_TIMEOUT_S": str(PEER_TIMEOUT_S),
+        "HOROVOD_TPU_DATA_TIMEOUT_S": "3",
+    })
+    env.update(extra_env or {})
+    t0 = time.monotonic()
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "horovod_tpu.run", "-np", str(np_),
+         "--grace-period", str(grace), *hvdrun_args,
+         sys.executable, WORKER, scenario],
+        cwd=REPO, env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True)
+    try:
+        stdout, stderr = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        # SIGTERM first: hvdrun's handler reaps every worker TREE (each
+        # worker runs in its own session, so killing only the supervisor
+        # leaks spinning ranks that poison the rest of the suite)
+        proc.terminate()
+        try:
+            proc.wait(timeout=grace + 10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
+        raise
+    res = subprocess.CompletedProcess(proc.args, proc.returncode,
+                                      stdout, stderr)
+    res.elapsed = time.monotonic() - t0
+    return res
+
+
+def _shrink_latencies(stdout: str) -> list[float]:
+    return [float(line.rsplit("=", 1)[1])
+            for line in stdout.splitlines() if "SHRINK_LATENCY_S=" in line]
+
+
+def _assert_shrank(res, dead_rank: int, np_: int, final_size: int,
+                   changes: int = 1):
+    """The elastic acceptance shape: the JOB DID NOT EXIT on the death —
+    survivors reported the retryable error, re-formed a world of
+    final_size, completed further collectives there (the sum-of-ones
+    self-check inside the worker), and hvdrun exited 0."""
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert res.elapsed < EXIT_WALL_S + 30, f"took {res.elapsed:.0f}s"
+    survivors = [r for r in range(np_) if r != dead_rank]
+    for r in survivors:
+        assert f"rank {r}: elastic loop OK" in res.stdout, (
+            r, res.stdout + res.stderr)
+    assert f"WORLD_CHANGED size={final_size} changes={changes}" in \
+        res.stdout, res.stdout
+    assert "RETRYABLE:" in res.stdout, res.stdout
+    assert "elastic loop ran dry" not in res.stdout
+    # abort never ran: no survivor exited on the death
+    assert "aborting job" not in res.stdout, res.stdout
+
+
+def test_elastic_shrink_at_negotiation():
+    res = _run_elastic("elastic_loop", 3, "kill:rank=1:cycle=15",
+                       extra_env={"HVD_TEST_EXPECT_FINAL_SIZE": "2"},
+                       hvdrun_args=("--min-np", "1"))
+    _assert_shrank(res, dead_rank=1, np_=3, final_size=2)
+
+
+def test_elastic_shrink_mid_ring_shm():
+    """Kill inside the segmented ring over the shm data plane: survivors
+    are parked on rings the dead peer will never service; the world-change
+    latch + the (new, split) data timeout must cancel them, and the world
+    re-forms instead of aborting."""
+    res = _run_elastic("elastic_loop", 3, "kill:rank=1:phase=ring:hit=8",
+                       extra_env={"HVD_TEST_ELEMS": "200000",
+                                  "HVD_TEST_EXPECT_FINAL_SIZE": "2"},
+                       hvdrun_args=("--min-np", "1"))
+    _assert_shrank(res, dead_rank=1, np_=3, final_size=2)
+
+
+def test_elastic_shrink_mid_ring_tcp_latency_bound():
+    """Same death over plain TCP: the half-closed old-world links RST the
+    survivors' parked transfers, so detect -> first-shrunk-world-cycle
+    must land well inside HOROVOD_TPU_PEER_TIMEOUT_S + 2 s (the
+    acceptance bound; in practice it is tens of milliseconds)."""
+    res = _run_elastic("elastic_loop", 3, "kill:rank=1:phase=ring:hit=8",
+                       extra_env={"HVD_TEST_ELEMS": "200000",
+                                  "HOROVOD_TPU_SHM": "0",
+                                  "HVD_TEST_EXPECT_FINAL_SIZE": "2"},
+                       hvdrun_args=("--min-np", "1"))
+    _assert_shrank(res, dead_rank=1, np_=3, final_size=2)
+    lats = _shrink_latencies(res.stdout)
+    assert lats, res.stdout
+    assert max(lats) < PEER_TIMEOUT_S + 2, (lats, res.stdout)
+
+
+def test_elastic_shrink_at_pack():
+    res = _run_elastic("elastic_loop", 2, "kill:rank=1:phase=pack:hit=6",
+                       extra_env={"HVD_TEST_ELEMS": "65536",
+                                  "HVD_TEST_EXPECT_FINAL_SIZE": "1"},
+                       hvdrun_args=("--min-np", "1"))
+    _assert_shrank(res, dead_rank=1, np_=2, final_size=1)
+
+
+def test_elastic_shrink_np4(tmp_path):
+    """The acceptance row: an injected SIGKILL of one rank in a 4-rank job
+    no longer exits the job — survivors re-form a 3-rank world, the next
+    allreduce completes there (sum-of-ones == 3), hvd_world_changes_total
+    increments in the exported metrics, hvd_world_size reads 3, and
+    hvdrun exits 0."""
+    import json
+
+    md = tmp_path / "metrics"
+    res = _run_elastic("elastic_loop", 4, "kill:rank=1:phase=ring:hit=8",
+                       extra_env={"HVD_TEST_ELEMS": "100000",
+                                  "HVD_TEST_EXPECT_FINAL_SIZE": "3"},
+                       hvdrun_args=("--min-np", "1",
+                                    "--metrics-dir", str(md)))
+    _assert_shrank(res, dead_rank=1, np_=4, final_size=3)
+    lats = _shrink_latencies(res.stdout)
+    assert lats and max(lats) < PEER_TIMEOUT_S + 2, (lats, res.stdout)
+    # the elastic metrics made it out through the registry (final dump at
+    # shutdown): the world gauge shows the SHRUNK size, the change counter
+    # incremented exactly once
+    with open(md / "metrics.rank0.json") as f:
+        metrics = {m["name"]: m.get("value")
+                   for m in json.load(f)["metrics"]
+                   if not m.get("labels") and "value" in m}
+    assert metrics.get("hvd_world_size") == 3, metrics
+    assert metrics.get("hvd_world_changes_total") == 1, metrics
+
+
+@pytest.mark.slow  # the ring/pack rows already cover the shrink machinery
+def test_elastic_shrink_at_unpack():
+    res = _run_elastic("elastic_loop", 2, "kill:rank=1:phase=unpack:hit=6",
+                       extra_env={"HVD_TEST_ELEMS": "65536",
+                                  "HVD_TEST_EXPECT_FINAL_SIZE": "1"},
+                       hvdrun_args=("--min-np", "1"))
+    _assert_shrank(res, dead_rank=1, np_=2, final_size=1)
+
+
+def test_elastic_shrunk_world_bitwise_vs_fresh():
+    """A shrunk world must compute EXACTLY what a fresh world of that
+    shape computes: np4 loses rank 1 mid-ring and the survivors (launch
+    ranks 0,2,3 -> new ranks 0,1,2) run a deterministic allreduce battery;
+    a fresh np3 job whose ranks carry the survivors' values runs the same
+    battery.  The per-new-rank result dumps must match byte for byte —
+    the re-derived ring order, chunk geometry, and accumulate chains are
+    indistinguishable from a from-scratch bootstrap at that size."""
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        elastic_dir = os.path.join(td, "elastic")
+        fresh_dir = os.path.join(td, "fresh")
+        os.makedirs(elastic_dir)
+        os.makedirs(fresh_dir)
+        res = _run_elastic(
+            "elastic_dump", 4, "kill:rank=1:phase=ring:hit=6",
+            extra_env={"HVD_TEST_OUT_DIR": elastic_dir,
+                       "HVD_TEST_ELASTIC_KILL": "1",
+                       "HVD_TEST_EXPECT_SIZE": "3",
+                       "HVD_TEST_VALUES": "0,9,2,3"},  # 9 = the victim
+            hvdrun_args=("--min-np", "1"))
+        assert res.returncode == 0, res.stdout + res.stderr
+        # fresh job at the survivors' shape: rank i holds survivor i's value
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.update({"HVD_TEST_OUT_DIR": fresh_dir,
+                    "HVD_TEST_EXPECT_SIZE": "3",
+                    "HVD_TEST_VALUES": "0,2,3"})
+        fresh = subprocess.run(
+            [sys.executable, "-m", "horovod_tpu.run", "-np", "3",
+             sys.executable, WORKER, "elastic_dump"],
+            cwd=REPO, env=env, capture_output=True, text=True, timeout=180)
+        assert fresh.returncode == 0, fresh.stdout + fresh.stderr
+        for r in range(3):
+            with open(os.path.join(elastic_dir,
+                                   f"elastic_dump_r{r}.bin"), "rb") as f:
+                shrunk = f.read()
+            with open(os.path.join(fresh_dir,
+                                   f"elastic_dump_r{r}.bin"), "rb") as f:
+                scratch = f.read()
+            assert shrunk, r
+            assert shrunk == scratch, (
+                f"new rank {r}: shrunk-world results differ from a fresh "
+                f"np3 run")
+
+
+@pytest.mark.slow  # two staggered deaths at -np 4 on a 2-core box
+def test_elastic_multi_death():
+    """Two ranks die: the world must keep shrinking (4 -> 2, via one
+    combined or two sequential changes) and still complete."""
+    res = _run_elastic(
+        "elastic_loop", 4,
+        "kill:rank=1:phase=ring:hit=6;kill:rank=2:phase=ring:hit=20",
+        extra_env={"HVD_TEST_ELEMS": "100000",
+                   "HVD_TEST_EXPECT_FINAL_SIZE": "2"},
+        hvdrun_args=("--min-np", "1"))
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "rank 0: elastic loop OK world=2" in res.stdout, res.stdout
+    assert "size=2" in res.stdout, res.stdout
+
+
+@pytest.mark.slow  # staggered double-kill; multi_death covers the fast lane
+def test_elastic_death_during_shrink():
+    """The second death lands immediately after (or during) the first
+    shrink.  Either outcome is acceptable — a second shrink down to the
+    1-rank world that then completes, or a clean rank-naming abort — but
+    never a hang and never a silent exit 0 at the wrong size."""
+    res = _run_elastic(
+        "elastic_loop", 3,
+        "kill:rank=1:phase=ring:hit=6;kill:rank=2:phase=ring:hit=7",
+        extra_env={"HVD_TEST_ELEMS": "100000",
+                   "HVD_TEST_EXPECT_FINAL_SIZE": "1",
+                   "HVD_TEST_CHANGES": "2"},
+        hvdrun_args=("--min-np", "1"))
+    assert res.elapsed < EXIT_WALL_S + 30, f"took {res.elapsed:.0f}s"
+    if res.returncode == 0:
+        assert "rank 0: elastic loop OK world=1" in res.stdout, res.stdout
+    else:
+        # aborted: the cause must name a rank, classic fault-domain style
+        import re
+        assert re.search(r"rank \d", res.stdout + res.stderr), (
+            res.stdout + res.stderr)
+
+
+def test_elastic_coordinator_death_still_aborts():
+    """Elastic mode does NOT make rank 0 expendable: the coordinator owns
+    membership, so its death is still a job-ending abort with workers
+    naming rank 0."""
+    res = _run_elastic("elastic_loop", 3, "kill:rank=0:phase=ring:hit=8",
+                       extra_env={"HVD_TEST_ELEMS": "200000"},
+                       hvdrun_args=("--min-np", "1"))
+    assert res.returncode != 0, res.stdout + res.stderr
+    assert res.elapsed < EXIT_WALL_S + 30
+    assert "rank 0" in res.stdout + res.stderr
+    assert "elastic loop OK" not in res.stdout, res.stdout
+
+
+def test_elastic_below_min_np_aborts():
+    """A death that would shrink below --min-np keeps the classic PR 5
+    contract: coordinated abort, non-zero exit, dead rank named."""
+    res = _run_elastic("elastic_loop", 2, "kill:rank=1:phase=ring:hit=8",
+                       extra_env={"HVD_TEST_ELEMS": "200000"},
+                       hvdrun_args=("--min-np", "2"))
+    assert res.returncode != 0, res.stdout + res.stderr
+    assert res.elapsed < EXIT_WALL_S + 30
+    assert "HOROVOD_TPU_MIN_NP" in res.stdout + res.stderr, (
+        res.stdout + res.stderr)
+    assert "rank 1" in res.stdout + res.stderr
+
+
+def test_elastic_join_after_restart():
+    """Scale back UP: rank 1 is killed, the world shrinks 3 -> 2, hvdrun's
+    --restart budget relaunches the slot as a JOINER, and the world grows
+    back to 3 (changes=2, joins=1) before completing cleanly — including
+    the relaunched process, which bootstraps mid-job through the
+    coordinator's rendezvous listener."""
+    res = _run_elastic("elastic_loop", 3, "kill:rank=1:phase=ring:hit=8",
+                       extra_env={"HVD_TEST_ELEMS": "100000",
+                                  "HVD_TEST_CHANGES": "2",
+                                  "HVD_TEST_EXPECT_FINAL_SIZE": "3"},
+                       hvdrun_args=("--min-np", "1", "--restart", "1"),
+                       timeout=EXIT_WALL_S + 120)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "relaunching rank 1 as a joiner" in res.stderr, res.stderr
+    assert "WORLD_CHANGED size=2 changes=1 joins=0" in res.stdout, res.stdout
+    assert "WORLD_CHANGED size=3 changes=2 joins=1" in res.stdout, res.stdout
+    # the joiner itself finished the loop cleanly in the re-grown world
+    assert res.stdout.count("elastic loop OK") == 3, res.stdout
+
+
+# ---------------------------------------------------------------------------
 # hvdrun supervision: exit-code propagation, grace kill, post-mortem
 # ---------------------------------------------------------------------------
 

@@ -34,7 +34,7 @@ def test_checker_detects_version_drift():
     """The guard must actually bite: a simulated version bump in wire.h
     without a Python update is reported."""
     wire_h, common_h = _headers()
-    tampered = wire_h.replace("kWireVersion = 6", "kWireVersion = 7")
+    tampered = wire_h.replace("kWireVersion = 7", "kWireVersion = 8")
     assert tampered != wire_h, "kWireVersion moved; update this test"
     problems = check_wire_abi.check(tampered, common_h)
     assert any("kWireVersion" in p for p in problems), problems
@@ -56,8 +56,9 @@ def test_checker_detects_new_tuned_knob():
 
 def test_checker_detects_new_frame_type():
     wire_h, common_h = _headers()
-    tampered = wire_h.replace("kAbort = 6,",
-                              "kAbort = 6,\n  kNewFrame = 7,")
+    tampered = wire_h.replace("kWorldCommit = 9,",
+                              "kWorldCommit = 9,\n  kNewFrame = 10,")
+    assert tampered != wire_h, "kWorldCommit moved; update this test"
     problems = check_wire_abi.check(tampered, common_h)
     assert any("FrameType" in p for p in problems), problems
 
@@ -75,22 +76,35 @@ def test_v5_fault_frames_present():
 
 def test_v6_tuned_wire_stripes_present():
     """The striped wire's v6 collateral: the tuned_wire_stripes knob rides
-    BOTH response-side frames, the Python mirror tracks the knob list, and
-    the version is 6 on both sides."""
+    BOTH response-side frames and the Python mirror tracks the knob list."""
     from horovod_tpu.runtime import wire_abi
 
-    assert wire_abi.WIRE_VERSION == 6
     assert wire_abi.TUNED_KNOBS[-1] == "tuned_wire_stripes"
     wire_h, _ = _headers()
-    assert "kWireVersion = 6" in wire_h
     assert wire_h.count("int64_t tuned_wire_stripes") == 2
 
 
+def test_v7_world_frames_present():
+    """The elastic membership's wire v7 collateral: world-change/ack/commit
+    frame types exist on both sides of the mirror at the pinned ids, and
+    the version is 7 on both sides."""
+    from horovod_tpu.runtime import wire_abi
+
+    assert wire_abi.WIRE_VERSION == 7
+    assert wire_abi.FRAME_TYPES["kWorldChange"] == 7
+    assert wire_abi.FRAME_TYPES["kWorldAck"] == 8
+    assert wire_abi.FRAME_TYPES["kWorldCommit"] == 9
+    wire_h, _ = _headers()
+    assert "kWireVersion = 7" in wire_h
+    for needle in ("kWorldChange = 7", "kWorldAck = 8", "kWorldCommit = 9"):
+        assert needle in wire_h, needle
+
+
 def test_version_mismatch_message_names_both_versions():
-    """A v4 frame hitting a v5 engine must produce the descriptive
-    both-versions error — the operator-facing contract for a mixed .so
-    deployment — via the native parse probe.  Skips (not fails) when the
-    .so predates the probe."""
+    """A stale-version frame hitting a v7 engine must produce the
+    descriptive both-versions error — the operator-facing contract for a
+    mixed .so deployment — via the native parse probe.  Skips (not fails)
+    when the .so predates the probe."""
     import ctypes
 
     import pytest
@@ -110,7 +124,7 @@ def test_version_mismatch_message_names_both_versions():
     lib.hvd_free_cstr.argtypes = [ctypes.c_void_p]
     lib.hvd_wire_version.restype = ctypes.c_int
 
-    assert lib.hvd_wire_version() == wire_abi.WIRE_VERSION == 6
+    assert lib.hvd_wire_version() == wire_abi.WIRE_VERSION == 7
 
     def parse_error(buf: bytes) -> str | None:
         p = lib.hvd_frame_parse_error(buf, len(buf))
@@ -121,19 +135,19 @@ def test_version_mismatch_message_names_both_versions():
         finally:
             lib.hvd_free_cstr(p)
 
-    # v5 <-> v6 (the previous release still running somewhere): the striped
-    # wire's version bump must surface as the descriptive both-versions
-    # message, exactly like every previous bump
+    # v6 <-> v7 (the previous release still running somewhere): the elastic
+    # membership's version bump must surface as the descriptive
+    # both-versions message, exactly like every previous bump
+    stale = wire_abi.frame_header(version=6) + b"\x00" * 16
+    msg = parse_error(stale)
+    assert msg is not None
+    assert "v6" in msg and "v7" in msg and "libhvdtpu.so" in msg, msg
+
+    # an even older v5 header: same contract, both versions named
     stale = wire_abi.frame_header(version=5) + b"\x00" * 16
     msg = parse_error(stale)
     assert msg is not None
-    assert "v5" in msg and "v6" in msg and "libhvdtpu.so" in msg, msg
-
-    # an even older v4 header: same contract, both versions named
-    stale = wire_abi.frame_header(version=4) + b"\x00" * 16
-    msg = parse_error(stale)
-    assert msg is not None
-    assert "v4" in msg and "v6" in msg and "libhvdtpu.so" in msg, msg
+    assert "v5" in msg and "v7" in msg and "libhvdtpu.so" in msg, msg
 
     # current-version garbage is a parse error, not a version error
     import struct
@@ -142,7 +156,7 @@ def test_version_mismatch_message_names_both_versions():
     msg = parse_error(bad)
     assert msg is not None and "version" not in msg, msg
 
-    # a well-formed v5 heartbeat frame parses clean
+    # a well-formed current-version heartbeat frame parses clean
     hb = wire_abi.frame_header(
         frame_type=wire_abi.FRAME_HEARTBEAT) + struct.pack("<i", 3)
     assert parse_error(hb) is None
