@@ -4,9 +4,10 @@
 
 namespace hvdtpu {
 
-void ResponseCache::Init(int64_t capacity) {
+void ResponseCache::Init(int64_t capacity, int set_id) {
   // clamp: the bitvector wire format bounds claims to 8M slots; anything
   // near that is a config error, not a workload
+  set_id_ = set_id;
   capacity_ = std::min<int64_t>(std::max<int64_t>(capacity, 0), 1 << 20);
   slots_.assign(static_cast<size_t>(capacity_), CacheEntry{});
   slot_epoch_.assign(static_cast<size_t>(capacity_), 0);

@@ -52,8 +52,12 @@ struct CacheEntry {
 
 class ResponseCache {
  public:
-  // capacity <= 0 disables the cache entirely.
-  void Init(int64_t capacity);
+  // capacity <= 0 disables the cache entirely.  `set_id` names the
+  // process set this replica serves (wire v8: every set owns its OWN
+  // replicated cache, so disjoint sets' steady states never contend for
+  // slots); it only flavors diagnostics, never the replication protocol.
+  void Init(int64_t capacity, int set_id = 0);
+  int set_id() const { return set_id_; }
   bool enabled() const { return capacity_ > 0; }
   int64_t capacity() const { return capacity_; }
   uint64_t epoch() const { return epoch_; }
@@ -99,6 +103,7 @@ class ResponseCache {
   void BumpSlot(int s) { slot_epoch_[s] = ++epoch_; }
 
   int64_t capacity_ = 0;
+  int set_id_ = 0;
   std::vector<CacheEntry> slots_;
   std::vector<uint64_t> slot_epoch_;
   std::unordered_map<std::string, int> by_name_;

@@ -66,6 +66,11 @@ enum class OpType : int32_t {
   kAlltoall = 3,
   kError = 4,     // response-only: cross-rank validation failed
   kShutdown = 5,  // response-only: coordinated shutdown
+  // process-set registration (wire v8): negotiated like a collective —
+  // every WORLD rank submits the same member list, rank 0 assigns the set
+  // id and broadcasts it in response-stream order, so all ranks register
+  // sets at the same stream position (mesh builds synchronize on that)
+  kProcessSet = 6,
 };
 
 struct Status {

@@ -72,6 +72,17 @@ Status AbortedStatus() {
       "job abort in progress — transfer cancelled before completion");
 }
 
+// A same-host peer's world change wrote the poison word into a shared
+// ring: cancel this transfer NOW (the shm analog of the TCP RST cascade)
+// instead of waiting out HOROVOD_TPU_DATA_TIMEOUT_S.  In elastic mode
+// ElasticizeWire tags the error retryable like any other wire failure.
+Status ShmPoisonStatus(int peer) {
+  Faults().shm_poisons_seen.fetch_add(1, std::memory_order_relaxed);
+  return Status::Error(
+      "shm ring shared with rank " + std::to_string(peer) +
+      " was poisoned by a peer's world change — transfer cancelled");
+}
+
 // Retryable-failure tag for elastic membership changes.  This prefix is
 // API: horovod_tpu/runtime/native.py raises WorldShrunkError on it so
 // training loops can re-run the collective after hvd.world_changed() —
@@ -499,13 +510,145 @@ struct HandleState {
   std::vector<char> result;
 };
 
+// ---------------------------------------------------------------------------
+// process sets (wire v8): per-set negotiation state + keyed communicators
+// ---------------------------------------------------------------------------
+
+// coordinator-side per-name readiness (one negotiation round entry)
+struct Negotiation {
+  std::vector<Request> received;      // one per rank, first arrival first
+  std::set<int32_t> ranks;
+  std::chrono::steady_clock::time_point first_arrival;
+  bool stall_warned = false;
+};
+
+// coordinator-side per-slot claim negotiation (the bitvector AND state)
+struct CacheClaim {
+  std::set<int32_t> ranks;
+  std::chrono::steady_clock::time_point first_claim;
+  bool stall_warned = false;
+};
+
+// One process set's negotiation round, response cache, and claim protocol.
+// The global set (id 0) owns one instance (Engine::neg0_); every registered
+// set owns its own, so steady states, claims, displacements, and stalls on
+// one set never touch another's — the control-plane half of "disjoint sets
+// never head-of-line block each other".  All fields are background-thread
+// only except the lookup counters.
+struct NegState {
+  int set_id = 0;
+  std::vector<int> members;   // global engine ranks, ascending
+  std::vector<int> index_of;  // global rank -> member index, -1 outside
+  std::map<std::string, Negotiation> message_table;  // ordered: stable fuse
+  std::deque<std::string> ready;        // fully-subscribed names, FIFO
+  std::deque<Response> error_ready;     // validation failures to broadcast
+  ResponseCache cache;                  // this set's replicated slot table
+  // this rank's claims sent (slot per name) awaiting cached execution
+  std::unordered_map<std::string, int> bits_inflight;
+  std::vector<Request> resend;          // displaced claims re-entering
+  std::map<int, CacheClaim> cache_claims;   // coordinator only
+  std::set<int> pending_invalid;            // coordinator only
+  std::deque<int> cached_ready;             // fully-claimed slots, FIFO
+  // this rank's steady-state lookups on this set (diagnostics thread)
+  std::atomic<int64_t> hits{0}, misses{0};
+
+  int expected() const { return static_cast<int>(members.size()); }
+  int IndexOf(int g) const {
+    return (g >= 0 && g < static_cast<int>(index_of.size())) ? index_of[g]
+                                                             : -1;
+  }
+  void SetMembers(std::vector<int> m, int world_size) {
+    members = std::move(m);
+    index_of.assign(static_cast<size_t>(world_size), -1);
+    for (size_t i = 0; i < members.size(); i++)
+      if (members[i] >= 0 && members[i] < world_size)
+        index_of[static_cast<size_t>(members[i])] = static_cast<int>(i);
+  }
+  // cold restart (init / world change): negotiation and cache state die
+  // with the membership so the replicated tables stay trivially identical
+  void Reset(int64_t cache_capacity) {
+    message_table.clear();
+    ready.clear();
+    error_ready.clear();
+    cache_claims.clear();
+    cached_ready.clear();
+    pending_invalid.clear();
+    bits_inflight.clear();
+    resend.clear();
+    cache.Init(cache_capacity, set_id);
+  }
+};
+
+// The transport + topology a collective runs over: the world mesh for the
+// global set, a set's own dedicated sub-mesh otherwise.  Every data-plane
+// function resolves its links/rings/scratch through the executing thread's
+// Comm (thread_local below), so the same ring/tree/alltoall code serves
+// any communicator — and concurrent executors never share transport state
+// (each set owns its sockets and shm rings outright, which is what makes
+// even OVERLAPPING sets safe to run concurrently on a tagless wire).
+struct Comm {
+  int set_id = 0;
+  std::vector<int> members;   // global ranks, ascending
+  int rank = 0;               // my index within members
+  int size = 1;
+  std::vector<int> index_of;  // global rank -> member index, -1 outside
+  std::vector<Link>* links = nullptr;  // indexed by GLOBAL rank
+  std::vector<std::unique_ptr<ShmRing>>* shm_tx = nullptr;
+  std::vector<std::unique_ptr<ShmRing>>* shm_rx = nullptr;
+  std::vector<char>* ring_scratch = nullptr;
+  std::vector<char>* fusion_buf = nullptr;
+  std::vector<int> ring_order;  // host-contiguous visit order (global ranks)
+  std::vector<int> local_group, cross_group;
+  std::vector<std::vector<int>> host_groups;
+  bool hierarchical = false;             // fixed at build for sets
+  bool hierarchical_allgather = false;
+  int64_t* ring_idle_sink = nullptr;     // per-comm idle attribution
+  int IndexOf(int g) const {
+    return (g >= 0 && g < static_cast<int>(index_of.size())) ? index_of[g]
+                                                             : -1;
+  }
+};
+
+// A registered process set: negotiation state, keyed communicator, and a
+// dedicated executor thread.  One FIFO per set is what makes collectives
+// on disjoint sets proceed CONCURRENTLY — each set's wire runs on its own
+// thread over its own sockets and shm rings, so neither the control plane
+// nor the data plane serializes one set behind another.
+struct ProcessSet {
+  int id = 0;
+  // membership flags + published shape, atomic: Enqueue (Python thread)
+  // and the diagnostics thread read them while the background thread
+  // registers/rebuilds/evicts
+  std::atomic<bool> member{false};
+  std::atomic<bool> evicted{false};  // every member died (elastic)
+  std::atomic<int> pub_size{0};
+  std::atomic<int> pub_rank{-1};
+  NegState neg;
+  Comm comm;
+  // dedicated transport, global-rank-indexed like the engine's own mesh
+  std::vector<Link> links;
+  std::vector<std::unique_ptr<ShmRing>> shm_tx, shm_rx;
+  std::vector<char> fusion_buf, ring_scratch;
+  // executor (members only)
+  std::thread exec;
+  std::mutex mu;
+  std::condition_variable cv;
+  std::deque<Response> work;  // guarded by mu
+  bool stop = false;          // guarded by mu
+  bool busy = false;          // guarded by mu
+  // counters, readable from the diagnostics thread
+  std::atomic<int64_t> collectives{0};
+  std::atomic<int64_t> payload_bytes{0};
+  std::atomic<int64_t> wire_ns{0};
+};
+
 class Engine {
  public:
   // pipe fds close at destruction, not Shutdown: a late Enqueue's Wake()
   // may race Shutdown, and writing to a drained-but-open pipe is harmless
   // while writing to a closed (possibly reused) fd is not
   ~Engine() {
-    // defensive: Shutdown() normally joins the executor; a destruction
+    // defensive: Shutdown() normally joins the executors; a destruction
     // path that skipped it must still join or std::thread terminates
     if (dp_thread_.joinable()) {
       {
@@ -515,6 +658,7 @@ class Engine {
       dp_cv_.notify_all();
       dp_thread_.join();
     }
+    StopSetExecutors();
     for (int fd : wake_pipe_)
       if (fd >= 0) close(fd);
   }
@@ -523,7 +667,14 @@ class Engine {
 
   int Enqueue(OpType op, const std::string& name, DType dtype,
               const std::vector<int64_t>& dims, const void* data,
-              int root_rank, void* user_out);
+              int root_rank, void* user_out, int process_set = 0);
+  // Collective registration of a new process set: every WORLD rank calls
+  // this with the same sorted member list; the returned handle completes
+  // with the coordinator-assigned set id as a 4-byte result.
+  int EnqueueProcessSet(const std::vector<int64_t>& members);
+  // Per-set stats rows {id, size, my set rank, collectives, payload bytes,
+  // wire ns, cache hits, cache misses}; returns rows written (set 0 first).
+  int ProcessSetStats(int64_t* out, int max_sets) const;
   int PollHandle(int handle);  // 0 pending, 1 ok, -1 error
   int WaitHandle(int handle, double timeout_s);
   HandleState* GetDone(int handle);  // valid until ReleaseHandle
@@ -673,9 +824,44 @@ class Engine {
   void Wake();
   bool CoordinatorTick(RequestList& local);  // returns true on shutdown
   void WorkerTick(RequestList& local, bool* stop);
-  void HandleArrivedRequests(const RequestList& list, ResponseList* out);
-  void FuseReady(ResponseList* out);
+  void HandleArrivedRequests(NegState& ns, const RequestList& list,
+                             ResponseList* out);
+  void FuseReady(NegState& ns, ResponseList* out);
   void StallCheck();
+  // -- process sets (wire v8) ---------------------------------------------
+  // The executing thread's communicator (world by default; set executors
+  // install their set's).  Every data-plane function resolves transport
+  // state through this.
+  Comm& C();
+  ProcessSet* FindSet(int id);            // bg thread (no lock)
+  NegState* NegOf(int set_id);            // bg thread; nullptr = unknown
+  // Apply a kProcessSet response at its broadcast-stream position: every
+  // rank registers the set here, members build the sub-mesh + executor.
+  void ApplyProcessSet(const Response& resp);
+  // Register/rebuild one set from its (current-world) member list.
+  Status BuildSetComm(ProcessSet& ps);
+  // Accept one data-listener connection carrying a {set, rank, stripe}
+  // hello for `set_id`; hellos for OTHER sets are parked, not errors.
+  Status AcceptSetConn(int set_id, int* rank_out, int* stripe_out,
+                       Socket* out);
+  void SetExecLoop(ProcessSet* ps);       // set executor thread body
+  void ExecuteSet(ProcessSet& ps, const Response& resp);
+  void DispatchSet(ProcessSet& ps, const Response& resp);  // bg thread
+  // World change support: drain set executors + clear their queues
+  // (BeginWorldChange), reconcile psets_ with the table registry
+  // (BuildWorld tail), stop every executor (shutdown/destruction).
+  void QuiesceSets();
+  Status ApplySetTable();
+  void EvictSet(ProcessSet& ps);
+  void StopSetExecutors();
+  bool AnyResend() const;
+  // shared-memory ring setup for an arbitrary same-host peer group over
+  // an arbitrary link mesh (world init and per-set builds both use it)
+  void SetupShmGroup(const std::string& token,
+                     const std::vector<int>& local_peers,
+                     std::vector<Link>& links,
+                     std::vector<std::unique_ptr<ShmRing>>& stx,
+                     std::vector<std::unique_ptr<ShmRing>>& srx);
   // -- fault domain (PR 5) -------------------------------------------------
   // record a control frame from `rank` (heartbeat piggybacking: every
   // frame refreshes liveness, explicit heartbeats only fill idle gaps)
@@ -702,10 +888,10 @@ class Engine {
   // decided knob at its CURRENT value, then host/port/hash per rank — the
   // same format Init ships, reused by world-change frames so survivors and
   // joiners learn membership through one parser.
-  std::string BuildTable(const std::vector<std::string>& hosts,
-                         const std::vector<int>& ports,
-                         const std::vector<std::string>& hashes,
-                         const std::string& shm_token);
+  std::string BuildTable(
+      const std::vector<std::string>& hosts, const std::vector<int>& ports,
+      const std::vector<std::string>& hashes, const std::string& shm_token,
+      const std::vector<std::pair<int, std::vector<int>>>& sets);
   // Parse a bootstrap table: applies the knob fields to this engine and
   // returns the membership vectors.  Fails cleanly on a version-tag skew.
   Status ParseTable(const std::string& table,
@@ -771,30 +957,35 @@ class Engine {
   Status SendCtrl(Socket& sock, const std::string& frame);
   Status RecvCtrl(Socket& sock, std::string* frame);
   // split drained requests into cache claims (slot ids) vs full-path ones
-  void SplitRequests(std::vector<Request>& reqs, RequestList* full,
-                     std::vector<int>* claims);
+  void SplitRequests(NegState& ns, std::vector<Request>& reqs,
+                     RequestList* full, std::vector<int>* claims);
   // coordinator: account one rank's claim on a slot (the bitvector AND)
-  void RegisterClaim(int rank, int slot, uint64_t epoch, ResponseList* out);
+  void RegisterClaim(NegState& ns, int rank, int slot, uint64_t epoch,
+                     ResponseList* out);
   // coordinator: feed a claim back into full negotiation as a synthesized
   // Request (a full request arrived for the same cached name)
-  void SynthesizeClaimRequest(int rank, int slot, ResponseList* out);
+  void SynthesizeClaimRequest(NegState& ns, int rank, int slot,
+                              ResponseList* out);
   // coordinator: a full request for a cached name invalidates the entry's
   // steady-state path until the renegotiation resolves
-  void CheckCacheInvalidation(const Request& r, ResponseList* out);
+  void CheckCacheInvalidation(NegState& ns, const Request& r,
+                              ResponseList* out);
   // coordinator: drain fully-claimed slots into fused cached-exec groups
-  void BuildCachedExec(CachedExecFrame* ce);
+  void BuildCachedExec(NegState& ns, CachedExecFrame* ce);
   // all ranks: cached-exec group -> executable Response (touches LRU)
-  Status DecodeCachedGroup(const std::vector<uint32_t>& group, Response* resp);
+  Status DecodeCachedGroup(NegState& ns, const std::vector<uint32_t>& group,
+                           Response* resp);
   // all ranks: this rank's Request per response name, captured BEFORE
   // execution erases the tensor-table entries (cache insertion input)
   std::unordered_map<std::string, Request> SnapshotReqs(
-      const ResponseList& rl);
+      NegState& ns, const ResponseList& rl);
   // all ranks: replicate insert/replace/evict/remove from a broadcast
   // response list; resolves displaced claims (resend / claim clearing)
-  void ApplyCacheMutations(const ResponseList& rl,
+  void ApplyCacheMutations(NegState& ns, const ResponseList& rl,
                            const std::unordered_map<std::string, Request>& snap);
   // claims whose cache entry got displaced re-enter as full requests
-  void HandleDisplaced(const std::vector<std::string>& displaced);
+  void HandleDisplaced(NegState& ns,
+                       const std::vector<std::string>& displaced);
   // workers: adopt coordinator-tuned knobs from any response-side frame
   void AdoptTuned(int64_t fusion, int64_t cycle_us, int64_t hier,
                   int64_t depth, int64_t seg_bytes, int64_t stripes);
@@ -875,7 +1066,7 @@ class Engine {
   // ring then crosses hosts exactly h times.  Allgather/alltoall keep
   // rank order (their concat layouts are rank-indexed).
   Status RingAllreduce(const WireRegions& wr, int64_t nelems, DType dtype) {
-    return RingAllreduceGroup(wr, nelems, dtype, ring_order_);
+    return RingAllreduceGroup(wr, nelems, dtype, C().ring_order);
   }
   Status RingAllreduceGroup(const WireRegions& wr, int64_t nelems,
                             DType dtype, const std::vector<int>& members);
@@ -895,7 +1086,7 @@ class Engine {
   Status HierarchicalAllgather(const Response& resp, TensorEntry& entry,
                                int64_t stride, std::vector<char>* out);
   Status TreeBroadcast(char* buf, int64_t nbytes, int root) {
-    return TreeBroadcastGroup(buf, nbytes, root, all_ranks_);
+    return TreeBroadcastGroup(buf, nbytes, root, C().members);
   }
   Status TreeBroadcastGroup(char* buf, int64_t nbytes, int root,
                             const std::vector<int>& members);
@@ -916,8 +1107,9 @@ class Engine {
                           const std::vector<int64_t>& recv_rows,
                           int64_t stride, size_t esize, char* out,
                           int64_t seg_bytes);
-  // same-host shared-memory data plane (shm.h); falls back to the TCP
-  // peer sockets pair-by-pair when segments can't be set up
+  // same-host shared-memory data plane for the WORLD mesh (shm.h); falls
+  // back to the TCP peer sockets pair-by-pair when segments can't be set
+  // up.  Per-set rings go through SetupShmGroup directly.
   void SetupShm(const std::string& token);
   Status PeerSendAll(int r, const void* data, size_t n);
   Status PeerRecvAll(int r, void* data, size_t n);
@@ -1049,6 +1241,7 @@ class Engine {
   bool dp_busy_flag_ = false;        // executor mid-item (pipe_mu_)
   Status dp_fail_;                   // first wire failure (pipe_mu_)
   bool failing_ = false;             // FailAll reentrancy guard (bg thread)
+  bool abort_pending_stop_ = false;  // bg thread: stop after an inline abort
   // overlap/stage accounting, readable from the diagnostics thread
   std::atomic<bool> dp_busy_{false};
   std::atomic<int64_t> pipe_items_{0}, pipe_packs_{0};
@@ -1099,11 +1292,12 @@ class Engine {
   std::atomic<int64_t> pack_bytes_total_{0};  // bytes memcpy'd into fusion
   std::atomic<int64_t> sg_bytes_total_{0};    // pack memcpys avoided
   std::atomic<int64_t> alltoall_windowed_{0};
-  // monolithic-ring idle accounting: set by the wire thread around the
-  // monolithic body so the shared Peer* progress loops attribute their
-  // no-progress waits to the ring (null outside it) — this is what makes
-  // hvd_ring_wire_idle_fraction comparable across the two ring modes
-  int64_t* ring_idle_sink_ = nullptr;
+  // The world communicator: the Comm every thread uses unless a set
+  // executor installed its own (monolithic-ring idle attribution rides
+  // Comm::ring_idle_sink, per executing communicator).  Rebuilt by
+  // BuildWorld; its pointer fields reference the engine-owned vectors
+  // below, which never move.
+  Comm world_comm_;
 
   // byte-buffer pool for entry/result staging (guarded by mu_): fresh
   // 64 MB allocations fault pages at a fraction of warm-copy bandwidth,
@@ -1172,40 +1366,29 @@ class Engine {
   std::atomic<bool> running_{false};
   std::thread bg_;
 
-  // coordinator-only negotiation state
-  struct Negotiation {
-    std::vector<Request> received;      // one per rank, first arrival first
-    std::set<int32_t> ranks;
-    std::chrono::steady_clock::time_point first_arrival;
-    bool stall_warned = false;
-  };
-  std::map<std::string, Negotiation> message_table_;  // ordered for stable fuse
-  std::deque<std::string> ready_;       // fully-subscribed names, FIFO
-  std::deque<Response> error_ready_;    // validation failures to broadcast
-
-  // response cache (background thread only, except the atomic counters).
-  // cache_ is the coordinator-replicated slot table (cache.h documents the
-  // replication contract); the bookkeeping below implements the claim
-  // protocol around it.
-  ResponseCache cache_;
+  // negotiation + response cache + claim state for the GLOBAL set (0);
+  // every registered process set owns its own NegState (psets_ below).
+  // All background-thread only, like the fields it replaced.
+  NegState neg0_;
   int64_t cache_capacity_ = 1024;       // rank 0 decides; table ships it
-  // this rank's claims sent (slot per name) awaiting cached execution or
-  // displacement; rank 0 tracks its own local claims here too
-  std::unordered_map<std::string, int> bits_inflight_;
-  // displaced claims re-entering the full path next cycle
-  std::vector<Request> resend_;
-  // coordinator: per-slot claim negotiation (the bitvector AND state)
-  struct CacheClaim {
-    std::set<int32_t> ranks;
-    std::chrono::steady_clock::time_point first_claim;
-    bool stall_warned = false;
-  };
-  std::map<int, CacheClaim> cache_claims_;
-  // slots whose entry is being renegotiated via the full path (a full
-  // request arrived for a cached name): claims convert to synthesized
-  // requests until the renegotiation's response mutates the slot
-  std::set<int> pending_invalid_;
-  std::deque<int> cached_ready_;        // fully-claimed slots, FIFO
+  // -- process sets (wire v8) ---------------------------------------------
+  // Registered sets by id.  The map structure and the member/evicted flags
+  // are guarded by psets_mu_ (Enqueue and the diagnostics thread read them
+  // off the background thread); everything inside a ProcessSet is owned by
+  // the background thread + that set's executor.
+  std::map<int, std::unique_ptr<ProcessSet>> psets_;
+  mutable std::mutex psets_mu_;
+  int next_pset_id_ = 1;                // rank 0 assigns, broadcast-ordered
+  // set-mesh accept parking: a data-listener hello for another set (or a
+  // not-yet-reached build) is parked here instead of failing the accept —
+  // ranks build meshes in the same stream order but at their own pace
+  std::map<int, std::deque<std::tuple<int, int, Socket>>> pending_set_conns_;
+  // set registry parsed from the latest bootstrap/world-change table
+  // (new-rank space); BuildWorld reconciles psets_ against it
+  std::vector<std::pair<int, std::vector<int>>> table_psets_;
+  // global-set execution counters (set executors keep their own)
+  std::atomic<int64_t> set0_collectives_{0};
+  std::atomic<int64_t> set0_payload_bytes_{0};
   // counters readable from the diagnostics thread
   std::atomic<int64_t> cache_hits_{0};
   std::atomic<int64_t> cache_misses_{0};
@@ -1233,6 +1416,16 @@ class Engine {
 // failures raised inside the shared Execute* helpers to the deferred
 // DataPlaneFail path instead of a cross-thread FailAll.
 thread_local bool t_on_executor = false;
+
+// The communicator the current thread's collectives run over: null means
+// the world mesh (background thread, the global data-plane executor, and
+// any Python-thread caller); process-set executors install their set's
+// Comm at thread start.  A thread_local rather than a parameter so the
+// entire ring/tree/alltoall call chain stays signature-identical to the
+// single-communicator engine it grew from.
+thread_local Comm* t_comm = nullptr;
+
+Comm& Engine::C() { return t_comm != nullptr ? *t_comm : world_comm_; }
 
 // ---------------------------------------------------------------------------
 // bootstrap
@@ -1370,7 +1563,8 @@ Status Engine::Init(const std::string& host, int port, int rank, int size) {
       // job-unique token namespacing the shm segments (several engines /
       // jobs may share a host)
       shm_token_ = NewShmToken();
-      std::string table = BuildTable(hosts_, ports_, hashes_, shm_token_);
+      // no process sets exist at bootstrap — they register post-init
+      std::string table = BuildTable(hosts_, ports_, hashes_, shm_token_, {});
       for (int i = 1; i < size_; i++) {
         s = workers_[i].SendFrame(table);
         if (!s.ok()) return s;
@@ -1468,9 +1662,10 @@ Status Engine::Init(const std::string& host, int port, int rank, int size) {
                    /*tune_stripes=*/tune_stripes,
                    wire_stripes_active_.load());
 
-  cache_.Init(cache_capacity_);
-  LOG_RANK(Debug, rank_) << "response cache: capacity " << cache_.capacity()
-                         << (cache_.enabled() ? "" : " (disabled)");
+  neg0_.Reset(cache_capacity_);
+  LOG_RANK(Debug, rank_) << "response cache: capacity "
+                         << neg0_.cache.capacity()
+                         << (neg0_.cache.enabled() ? "" : " (disabled)");
 
   // fault domain: liveness config, chaos-test injection, and a fresh abort
   // latch (a previous engine in this process may have aborted)
@@ -1502,10 +1697,10 @@ Status Engine::Init(const std::string& host, int port, int rank, int size) {
 // elastic membership (wire v7): table helpers, world build, shrink/join
 // ---------------------------------------------------------------------------
 
-std::string Engine::BuildTable(const std::vector<std::string>& hosts,
-                               const std::vector<int>& ports,
-                               const std::vector<std::string>& hashes,
-                               const std::string& shm_token) {
+std::string Engine::BuildTable(
+    const std::vector<std::string>& hosts, const std::vector<int>& ports,
+    const std::vector<std::string>& hashes, const std::string& shm_token,
+    const std::vector<std::pair<int, std::vector<int>>>& sets) {
   // version tag first: the table is the FIRST cross-.so exchange, so a
   // mixed deployment must fail here with the same clean message the
   // framed wire protocol gives, not with a misparsed host table.  Every
@@ -1521,6 +1716,14 @@ std::string Engine::BuildTable(const std::vector<std::string>& hosts,
         << " " << hosts.size() << " ";
   for (size_t i = 0; i < hosts.size(); i++)
     table << hosts[i] << " " << ports[i] << " " << hashes[i] << " ";
+  // process-set registry (wire v8): membership changes renumber every set
+  // through this same table, so survivors AND joiners learn the full
+  // registry (already in the NEW world's rank space) from one parser
+  table << sets.size() << " ";
+  for (const auto& [id, mem] : sets) {
+    table << id << " " << mem.size() << " ";
+    for (int m : mem) table << m << " ";
+  }
   return table.str();
 }
 
@@ -1563,6 +1766,24 @@ Status Engine::ParseTable(const std::string& table,
   for (int64_t i = 0; i < count; i++)
     is >> (*hosts)[i] >> (*ports)[i] >> (*hashes)[i];
   if (!is) return Status::Error("truncated bootstrap table");
+  // process-set registry (wire v8): BuildWorld reconciles psets_ against
+  // this after the mesh rebuild (ids keep their values; member lists are
+  // already in the new world's rank space)
+  table_psets_.clear();
+  int64_t nsets = 0;
+  is >> nsets;
+  if (!is || nsets < 0 || nsets > (1 << 16))
+    return Status::Error("malformed bootstrap table (process-set registry)");
+  for (int64_t s = 0; s < nsets; s++) {
+    int64_t id = 0, nm = 0;
+    is >> id >> nm;
+    if (!is || id < 1 || nm < 1 || nm > count)
+      return Status::Error("malformed process-set entry in bootstrap table");
+    std::vector<int> mem(static_cast<size_t>(nm), 0);
+    for (int64_t i = 0; i < nm; i++) is >> mem[i];
+    if (!is) return Status::Error("truncated process-set registry");
+    table_psets_.emplace_back(static_cast<int>(id), std::move(mem));
+  }
   return Status::OK();
 }
 
@@ -1623,39 +1844,40 @@ Status Engine::BuildWorld() {
               "data-plane connect to rank " + std::to_string(j) +
               " stripe " + std::to_string(st) + " (" + hosts_[j] + ":" +
               std::to_string(ports_[j]) + ") never answered: " + s.message);
-        int32_t hello[2] = {rank_, st};
+        // hellos are {set, rank, stripe} since wire v8: every data-plane
+        // connection names the communicator it belongs to (set 0 = the
+        // world mesh), so accept loops can park another mesh's strays
+        // instead of failing when build paces differ across ranks
+        int32_t hello[3] = {0, rank_, st};
         s = sock.SendAll(hello, sizeof(hello));
         if (!s.ok()) return s;
         peers_[j].SetStripe(st, std::move(sock));
       }
     }
-    int expect = 0;
     std::map<int, int> awaited;  // higher rank -> stripes still expected
-    for (int j = rank_ + 1; j < size_; j++) {
-      expect += opened(j);
-      awaited[j] = opened(j);
-    }
-    for (int k = 0; k < expect; k++) {
+    for (int j = rank_ + 1; j < size_; j++) awaited[j] = opened(j);
+    while (!awaited.empty()) {
       Socket sock;
-      Status s = data_listener_.Accept(&sock, start_timeout_s_);
+      int who = -1, stripe = -1;
+      Status s = AcceptSetConn(0, &who, &stripe, &sock);
       if (!s.ok()) {
-        std::ostringstream who;
+        std::ostringstream missing;
         for (auto& [j, n] : awaited)
-          if (n > 0) who << " rank " << j << " (" << n << " stripe(s))";
+          if (n > 0) missing << " rank " << j << " (" << n << " stripe(s))";
         return Status::Error(
-            "data-plane accept: these peers never connected:" + who.str() +
-            " — " + s.message);
+            "data-plane accept: these peers never connected:" +
+            missing.str() + " — " + s.message);
       }
-      int32_t hello[2] = {-1, -1};
-      s = sock.RecvAll(hello, sizeof(hello));
-      if (!s.ok()) return s;
-      int who = hello[0], stripe = hello[1];
       if (who <= rank_ || who >= size_ || stripe < 0 ||
           stripe >= opened(who))
         return Status::Error("unexpected data-plane peer " +
                              std::to_string(who) + " stripe " +
                              std::to_string(stripe));
-      awaited[who]--;
+      auto it = awaited.find(who);
+      if (it == awaited.end() || it->second <= 0)
+        return Status::Error("duplicate data-plane hello from rank " +
+                             std::to_string(who));
+      if (--it->second == 0) awaited.erase(it);
       peers_[who].SetStripe(stripe, std::move(sock));
     }
     // initial active cap: tuned runs start at the LARGEST configured
@@ -1736,7 +1958,32 @@ Status Engine::BuildWorld() {
   hb_last_tx_ns_ = boot_ns;
   world_rank_pub_.store(rank_, std::memory_order_relaxed);
   world_size_pub_.store(size_, std::memory_order_relaxed);
-  return Status::OK();
+  // the world communicator: what every thread's C() resolves to unless a
+  // set executor installed its own.  Pointer fields reference the engine
+  // vectors (stable addresses); the rest is copied per rebuild.
+  world_comm_.set_id = 0;
+  world_comm_.members = all_ranks_;
+  world_comm_.index_of = all_ranks_;  // identity in the world space
+  world_comm_.rank = rank_;
+  world_comm_.size = size_;
+  world_comm_.links = &peers_;
+  world_comm_.shm_tx = &shm_tx_;
+  world_comm_.shm_rx = &shm_rx_;
+  world_comm_.ring_scratch = &ring_scratch_;
+  world_comm_.fusion_buf = &fusion_buf_;
+  world_comm_.ring_order = ring_order_;
+  world_comm_.local_group = local_group_;
+  world_comm_.cross_group = cross_group_;
+  world_comm_.host_groups = host_groups_;
+  world_comm_.hierarchical = hierarchical_allreduce_.load();
+  world_comm_.hierarchical_allgather = hierarchical_allgather_;
+  world_comm_.ring_idle_sink = nullptr;
+  // global-set negotiation membership (identity in the world space)
+  neg0_.set_id = 0;
+  neg0_.SetMembers(all_ranks_, size_);
+  // reconcile the process-set registry with the table (bootstrap: empty;
+  // elastic world changes: the renumbered membership rank 0 shipped)
+  return ApplySetTable();
 }
 
 Engine::WcWait Engine::AwaitWorldCommit(WorldChangeFrame* wc, double bound_s,
@@ -1897,34 +2144,48 @@ Status Engine::ElasticizeWire(Status st) {
 }
 
 void Engine::BeginWorldChange(const Status& cause) {
-  SetAborting(true);  // parked transfers (ours + the executor's) cancel
+  SetAborting(true);  // parked transfers (ours + the executors') cancel
   // half-close every old-world link (fd-safe vs a mid-transfer executor):
   // local blocked TCP waits fail on the next syscall, and the RSTs
   // unwedge the REMOTE ends too — survivors parked in rings with us learn
   // about the change in one round trip instead of a full data timeout.
-  // (shm-parked peers still need the bounded no-progress wait: a mapped
-  // ring has no reset to send.)
   for (auto& l : peers_) l.ShutdownAll();
+  // shm has no RST — write the POISON word instead: a co-resident peer
+  // parked on one of our rings observes it on its next idle poll and
+  // cancels instantly instead of waiting out HOROVOD_TPU_DATA_TIMEOUT_S.
+  auto poison_rings = [](std::vector<std::unique_ptr<ShmRing>>& rings) {
+    for (auto& r : rings)
+      if (r && r->valid()) {
+        r->Poison();
+        Faults().shm_poisons_written.fetch_add(1, std::memory_order_relaxed);
+      }
+  };
+  poison_rings(shm_tx_);
+  poison_rings(shm_rx_);
+  // process sets ride the same world change: their links half-close and
+  // their rings poison exactly like the world mesh's
+  for (auto& [id, ps] : psets_) {
+    for (auto& l : ps->links) l.ShutdownAll();
+    poison_rings(ps->shm_tx);
+    poison_rings(ps->shm_rx);
+  }
   {
     std::lock_guard<std::mutex> lk(mu_);
     aborted_ = true;  // MarkDone substitutes the retryable cause
     abort_status_ = cause;
   }
   FailAll(cause);  // drains the pipeline; the in-flight cycle fails retryable
-  // old-world negotiation / claim / cache state dies with the membership
-  message_table_.clear();
-  ready_.clear();
-  error_ready_.clear();
-  cache_claims_.clear();
-  cached_ready_.clear();
-  pending_invalid_.clear();
-  bits_inflight_.clear();
-  resend_.clear();
-  // re-key the response cache: every member restarts cold, so the
-  // replicated slot tables stay trivially identical in the new world
-  // (old entries carried old-world first_dims vectors anyway)
-  cache_.Init(cache_capacity_);
+  // set executors drain their (already-failing) work and go idle before
+  // the old transport is torn down under them
+  QuiesceSets();
+  // old-world negotiation / claim / cache state dies with the membership;
+  // every cache re-keys cold so the replicated slot tables stay trivially
+  // identical in the new world (per set, like before per world)
+  neg0_.Reset(cache_capacity_);
+  for (auto& [id, ps] : psets_) ps->neg.Reset(cache_capacity_);
   cache_entries_.store(0, std::memory_order_relaxed);
+  // parked cross-set strays belong to the old world's meshes
+  pending_set_conns_.clear();
 }
 
 int Engine::OnWorkerDeath(int dead_rank, const std::string& why) {
@@ -1991,7 +2252,24 @@ bool Engine::CoordinateWorldChange(std::vector<int> dead,
       wc.old_ranks.push_back(-1);
     }
     token = NewShmToken();
-    wc.table = BuildTable(nh, np, nhash, token);
+    // renumber every process set through the same table: survivors keep
+    // their (renumbered) membership, corpses drop out, sets whose last
+    // member died drop entirely.  A JOINER is never auto-added to a set.
+    std::map<int, int> new_of;
+    for (size_t i = 0; i < survivors.size(); i++)
+      new_of[survivors[i]] = static_cast<int>(i);
+    std::vector<std::pair<int, std::vector<int>>> tsets;
+    for (auto& [id, ps] : psets_) {
+      if (ps->evicted) continue;
+      std::vector<int> nm;
+      for (int g : ps->neg.members) {
+        auto it = new_of.find(g);
+        if (it != new_of.end()) nm.push_back(it->second);
+      }
+      if (!nm.empty()) tsets.emplace_back(id, std::move(nm));
+    }
+    table_psets_ = tsets;  // rank 0's own BuildWorld reconciles from this
+    wc.table = BuildTable(nh, np, nhash, token, tsets);
     std::string frame = Serialize(wc);
     bool redo = false;
     for (int r : survivors) {
@@ -2247,12 +2525,10 @@ int Engine::MaybeAcceptJoin() {
   // Readable proves only the FIRST byte: bound the whole frame read too,
   // or a partial-frame staller wedges the negotiation thread (and with
   // it heartbeats — one stray TCP connection must never kill the job)
-  struct timeval tv = {2, 0};
-  setsockopt(sock.fd(), SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  sock.SetRecvTimeout(2.0);
   std::string hello;
   Status hs = sock.RecvFrame(&hello);
-  tv = {0, 0};  // the socket lives on as the joiner's control link
-  setsockopt(sock.fd(), SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  sock.SetRecvTimeout(0);  // the socket lives on as the joiner's link
   if (!hs.ok()) {
     LogWarn("elastic: rendezvous hello never completed — dropped");
     return 0;
@@ -2282,6 +2558,521 @@ int Engine::MaybeAcceptJoin() {
                                /*join=*/true)
              ? 1
              : 2;
+}
+
+// ---------------------------------------------------------------------------
+// process sets (wire v8): registry, keyed communicators, set executors
+// ---------------------------------------------------------------------------
+
+ProcessSet* Engine::FindSet(int id) {
+  auto it = psets_.find(id);
+  return it == psets_.end() ? nullptr : it->second.get();
+}
+
+NegState* Engine::NegOf(int set_id) {
+  if (set_id == 0) return &neg0_;
+  ProcessSet* ps = FindSet(set_id);
+  return (ps == nullptr || ps->evicted.load(std::memory_order_relaxed))
+             ? nullptr
+             : &ps->neg;
+}
+
+bool Engine::AnyResend() const {
+  if (!neg0_.resend.empty()) return true;
+  for (const auto& [id, ps] : psets_)
+    if (!ps->neg.resend.empty()) return true;
+  return false;
+}
+
+int Engine::EnqueueProcessSet(const std::vector<int64_t>& members) {
+  // local validation first: a bad list fails HERE with a clear error on
+  // the submitting rank (the coordinator still cross-validates agreement)
+  std::string why;
+  int world = world_size_pub_.load(std::memory_order_relaxed);
+  if (members.empty()) {
+    why = "process set needs at least one member";
+  } else if (members.size() > 1024) {
+    why = "process sets are bounded to 1024 members (request wire bound)";
+  } else {
+    for (size_t i = 0; i < members.size() && why.empty(); i++) {
+      if (members[i] < 0 || members[i] >= world)
+        why = "member rank " + std::to_string(members[i]) +
+              " outside the world [0, " + std::to_string(world) + ")";
+      else if (i > 0 && members[i] <= members[i - 1])
+        why = "member list must be strictly ascending";
+    }
+  }
+  std::ostringstream nm;
+  nm << "__pset__";
+  for (size_t i = 0; i < members.size(); i++)
+    nm << (i ? "," : "") << members[i];
+  std::string name = nm.str();
+  std::lock_guard<std::mutex> lk(mu_);
+  int handle = next_handle_++;
+  handles_[handle] = HandleState{};
+  if (!running_) {
+    handles_[handle].done = true;
+    handles_[handle].status = aborted_ ? abort_status_ : Status::Shutdown();
+    return handle;
+  }
+  if (why.empty() && tensor_table_.count(name))
+    why = "this process-set registration is already in flight";
+  if (!why.empty()) {
+    handles_[handle].done = true;
+    handles_[handle].status = Status::Error(why);
+    cv_.notify_all();
+    return handle;
+  }
+  TensorEntry e;
+  e.req.rank = rank_;
+  e.req.op = OpType::kProcessSet;
+  e.req.dtype = DType::kInt32;
+  e.req.name = name;
+  e.req.dims = members;  // the member list IS the negotiated payload
+  e.nbytes = 0;
+  e.handle = handle;
+  queue_.push_back(e.req);
+  tensor_table_.emplace(name, std::move(e));
+  Wake();
+  return handle;
+}
+
+void Engine::ApplyProcessSet(const Response& resp) {
+  if (resp.first_dims.size() < 2) {
+    LogWarn("malformed process-set response — dropped");
+    return;
+  }
+  int id = static_cast<int>(resp.first_dims[0]);
+  std::vector<int> members;
+  for (size_t i = 1; i < resp.first_dims.size(); i++)
+    members.push_back(static_cast<int>(resp.first_dims[i]));
+  if (id >= next_pset_id_) next_pset_id_ = id + 1;
+  auto fresh = std::make_unique<ProcessSet>();
+  fresh->id = id;
+  fresh->neg.set_id = id;
+  fresh->neg.SetMembers(members, size_);
+  fresh->neg.Reset(cache_capacity_);
+  Status s = BuildSetComm(*fresh);
+  ProcessSet* ps = fresh.get();
+  {
+    std::lock_guard<std::mutex> plk(psets_mu_);
+    psets_[id] = std::move(fresh);
+  }
+  if (s.ok() && ps->member.load(std::memory_order_relaxed))
+    ps->exec = std::thread(&Engine::SetExecLoop, this, ps);
+  // complete the registration handle with the assigned id as the result
+  int handle = -1;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = tensor_table_.find(resp.names.empty() ? std::string()
+                                                    : resp.names[0]);
+    if (it != tensor_table_.end()) {
+      handle = it->second.handle;
+      tensor_table_.erase(it);
+    }
+  }
+  if (!s.ok()) {
+    // a half-built sub-mesh strands the members that DID build: this is
+    // bootstrap-grade, so fail the handle and abort the job cleanly
+    if (handle >= 0) MarkDone(handle, s, {}, {});
+    AbortJob(Status::Error("process set " + std::to_string(id) +
+                           " mesh build failed: " + s.message),
+             -1);
+    abort_pending_stop_ = true;
+    return;
+  }
+  if (handle >= 0) {
+    std::vector<char> result(sizeof(int32_t));
+    int32_t id32 = id;
+    std::memcpy(result.data(), &id32, sizeof(id32));
+    MarkDone(handle, Status::OK(), {1}, std::move(result));
+  }
+  LOG_RANK(Debug, rank_) << "process set " << id << " registered ("
+                         << members.size() << " member(s), "
+                         << (ps->member.load() ? "member" : "not a member")
+                         << ")";
+}
+
+Status Engine::BuildSetComm(ProcessSet& ps) {
+  NegState& ns = ps.neg;
+  int m = ns.expected();
+  int my = ns.IndexOf(rank_);
+  ps.member.store(my >= 0, std::memory_order_relaxed);
+  ps.pub_size.store(m, std::memory_order_relaxed);
+  ps.pub_rank.store(my, std::memory_order_relaxed);
+  ps.comm.set_id = ps.id;
+  ps.comm.members = ns.members;
+  ps.comm.index_of = ns.index_of;
+  ps.comm.rank = my < 0 ? 0 : my;
+  ps.comm.size = m;
+  ps.comm.links = &ps.links;
+  ps.comm.shm_tx = &ps.shm_tx;
+  ps.comm.shm_rx = &ps.shm_rx;
+  ps.comm.ring_scratch = &ps.ring_scratch;
+  ps.comm.fusion_buf = &ps.fusion_buf;
+  ps.comm.ring_idle_sink = nullptr;
+  ps.comm.ring_order.clear();
+  ps.comm.local_group.clear();
+  ps.comm.cross_group.clear();
+  ps.comm.host_groups.clear();
+  // old transport (elastic rebuild) dies first
+  for (auto& l : ps.links) l.Close();
+  ps.links.clear();
+  ps.shm_tx.clear();
+  ps.shm_rx.clear();
+  if (!ps.member.load(std::memory_order_relaxed)) return Status::OK();
+  // Set topology, built in SET-INDEX space over the members' host hashes
+  // and mapped back to global ranks — identical to what a STANDALONE
+  // world of exactly these processes would derive, which is what makes a
+  // sub-world collective bitwise-equal to running that subset alone.
+  std::vector<std::string> mh;
+  mh.reserve(ns.members.size());
+  for (int g : ns.members) mh.push_back(hashes_[g]);
+  Topology topo;
+  topo.set_id = ps.id;
+  topo.Build(my, m, mh, nics_, stripes_cross_, stripes_local_,
+             Link::kMaxStripes);
+  ps.comm.ring_order = Topology::MapToGlobal(topo.RingOrder(), ns.members);
+  ps.comm.local_group =
+      Topology::MapToGlobal(topo.local_group, ns.members);
+  ps.comm.cross_group =
+      Topology::MapToGlobal(topo.cross_group, ns.members);
+  for (const auto& g : topo.host_groups)
+    ps.comm.host_groups.push_back(Topology::MapToGlobal(g, ns.members));
+  // hierarchical defaults: BuildWorld's exact derivation on the SET's
+  // topology (same env pins apply) — again the standalone-world parity
+  bool multi_host = topo.multi_host();
+  bool any_local = false;
+  for (const auto& g : ps.comm.host_groups) any_local |= g.size() > 1;
+  bool hier_default = multi_host && any_local;
+  const char* ha = getenv("HOROVOD_TPU_HIERARCHICAL_ALLREDUCE");
+  if (!ha || !ha[0]) ha = getenv("HOROVOD_HIERARCHICAL_ALLREDUCE");
+  ps.comm.hierarchical =
+      ((ha && ha[0]) ? (strcmp(ha, "0") != 0) : hier_default) && multi_host;
+  const char* hg = getenv("HOROVOD_TPU_HIERARCHICAL_ALLGATHER");
+  if (!hg || !hg[0]) hg = getenv("HOROVOD_HIERARCHICAL_ALLGATHER");
+  ps.comm.hierarchical_allgather =
+      ((hg && hg[0]) ? (strcmp(hg, "0") != 0) : false) && multi_host;
+  if (m <= 1) return Status::OK();  // single-member set: no transport
+  // Dedicated sub-mesh: every set owns its OWN striped sockets (and shm
+  // rings below), so concurrent collectives on different sets — disjoint
+  // OR overlapping — never interleave byte streams on a shared link.
+  ps.links.resize(static_cast<size_t>(size_));
+  for (int g : ns.members)
+    if (g != rank_) ps.links[g].Configure(stripe_quantum_);
+  auto opened = [&](int gj) { return topo.LinkStripes(ns.IndexOf(gj)); };
+  for (int g : ns.members) {
+    if (g >= rank_) continue;
+    for (int st = 0; st < opened(g); st++) {
+      Socket sock;
+      Status s =
+          Socket::Connect(hosts_[g], ports_[g], &sock, start_timeout_s_);
+      if (!s.ok())
+        return Status::Error(
+            "process-set " + std::to_string(ps.id) + " connect to rank " +
+            std::to_string(g) + " stripe " + std::to_string(st) + " (" +
+            hosts_[g] + ":" + std::to_string(ports_[g]) +
+            ") never answered: " + s.message);
+      int32_t hello[3] = {ps.id, rank_, st};
+      s = sock.SendAll(hello, sizeof(hello));
+      if (!s.ok()) return s;
+      ps.links[g].SetStripe(st, std::move(sock));
+    }
+  }
+  std::map<int, int> awaited;
+  for (int g : ns.members)
+    if (g > rank_) awaited[g] = opened(g);
+  while (!awaited.empty()) {
+    Socket sock;
+    int who = -1, stripe = -1;
+    Status s = AcceptSetConn(ps.id, &who, &stripe, &sock);
+    if (!s.ok()) {
+      std::ostringstream missing;
+      for (auto& [j, cnt] : awaited)
+        if (cnt > 0) missing << " rank " << j << " (" << cnt
+                             << " stripe(s))";
+      return Status::Error("process-set " + std::to_string(ps.id) +
+                           " accept: these members never connected:" +
+                           missing.str() + " — " + s.message);
+    }
+    auto it = awaited.find(who);
+    if (it == awaited.end() || it->second <= 0 || stripe < 0 ||
+        stripe >= opened(who))
+      return Status::Error("unexpected process-set " +
+                           std::to_string(ps.id) + " peer " +
+                           std::to_string(who) + " stripe " +
+                           std::to_string(stripe));
+    if (--it->second == 0) awaited.erase(it);
+    ps.links[who].SetStripe(stripe, std::move(sock));
+  }
+  // cross-host member links honor the same pacing env the world mesh does
+  double pace_mbps = 0.0;
+  if (const char* pc = getenv("HOROVOD_TPU_CROSS_HOST_PACE_MBPS"))
+    if (pc[0]) pace_mbps = atof(pc);
+  if (pace_mbps > 0)
+    for (int g : ns.members)
+      if (g != rank_ && hashes_[g] != hashes_[rank_])
+        ps.links[g].SetPacing(pace_mbps * 1e6);
+  // same-host members get their own shm rings, namespaced per set so two
+  // sets' rings (and the world's) never collide
+  if (shm_on_) {
+    std::vector<int> local_peers;
+    for (int g : ps.comm.local_group)
+      if (g != rank_) local_peers.push_back(g);
+    if (!local_peers.empty())
+      SetupShmGroup(shm_token_ + "s" + std::to_string(ps.id), local_peers,
+                    ps.links, ps.shm_tx, ps.shm_rx);
+  }
+  return Status::OK();
+}
+
+Status Engine::AcceptSetConn(int set_id, int* rank_out, int* stripe_out,
+                             Socket* out) {
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::duration<double>(start_timeout_s_);
+  for (;;) {
+    auto pit = pending_set_conns_.find(set_id);
+    if (pit != pending_set_conns_.end() && !pit->second.empty()) {
+      auto& [r, st, sock] = pit->second.front();
+      *rank_out = r;
+      *stripe_out = st;
+      *out = std::move(sock);
+      pit->second.pop_front();
+      return Status::OK();
+    }
+    if (std::chrono::steady_clock::now() > deadline)
+      return Status::Error("timed out awaiting mesh connections (set " +
+                           std::to_string(set_id) + ")");
+    Socket sock;
+    if (!data_listener_.Accept(&sock, 1.0).ok()) continue;  // poll again
+    int32_t hello[3] = {-1, -1, -1};
+    sock.SetRecvTimeout(5.0);
+    Status s = sock.RecvAll(hello, sizeof(hello));
+    sock.SetRecvTimeout(0);
+    if (!s.ok()) {
+      LogWarn("data-plane connection sent no hello — dropped");
+      continue;
+    }
+    if (hello[0] == set_id) {
+      *rank_out = hello[1];
+      *stripe_out = hello[2];
+      *out = std::move(sock);
+      return Status::OK();
+    }
+    // a connection for ANOTHER communicator's build: ranks build meshes
+    // in the same broadcast order but at their own pace, so park it for
+    // the build that will consume it instead of failing this one.
+    // Garbage hellos (a scanner's bytes misread as a set id) drop
+    // loudly instead of leaking fds: fields must be in range, the set id
+    // must be PLAUSIBLE (ids are coordinator-sequential, and a peer can
+    // only be ahead of us by registrations already in the broadcast
+    // stream), and total parking is bounded well above the legitimate
+    // worst case (members x stripes of concurrent builds) so a valid
+    // member hello is never the thing dropped by pace skew.
+    size_t parked = 0;
+    for (const auto& [sid, q] : pending_set_conns_) parked += q.size();
+    if (hello[0] < 0 || hello[0] >= next_pset_id_ + 1024 || hello[1] < 0 ||
+        hello[1] >= size_ || hello[2] < 0 ||
+        hello[2] >= Link::kMaxStripes || parked >= 8192) {
+      LogWarn("data-plane hello {" + std::to_string(hello[0]) + "," +
+              std::to_string(hello[1]) + "," + std::to_string(hello[2]) +
+              "} not parkable — dropped");
+      continue;
+    }
+    pending_set_conns_[hello[0]].emplace_back(hello[1], hello[2],
+                                              std::move(sock));
+  }
+}
+
+void Engine::DispatchSet(ProcessSet& ps, const Response& resp) {
+  if (resp.op == OpType::kError) {
+    Execute(resp);  // completes the handles inline; touches no transport
+    return;
+  }
+  {
+    std::lock_guard<std::mutex> lk(ps.mu);
+    ps.work.push_back(resp);
+  }
+  ps.cv.notify_one();
+}
+
+void Engine::SetExecLoop(ProcessSet* ps) {
+  // this thread's collectives run over the set's own communicator, and
+  // its wire failures defer to the background thread (no cross-thread
+  // FailAll) exactly like the global data-plane executor's
+  t_comm = &ps->comm;
+  t_on_executor = true;
+  for (;;) {
+    Response resp;
+    {
+      std::unique_lock<std::mutex> lk(ps->mu);
+      ps->cv.wait(lk, [&] { return !ps->work.empty() || ps->stop; });
+      if (ps->work.empty()) return;  // stop with a drained queue
+      resp = std::move(ps->work.front());
+      ps->work.pop_front();
+      ps->busy = true;
+    }
+    ExecuteSet(*ps, resp);
+    {
+      std::lock_guard<std::mutex> lk(ps->mu);
+      ps->busy = false;
+    }
+    ps->cv.notify_all();
+    Wake();  // completions must not wait out the negotiation cycle timer
+  }
+}
+
+void Engine::ExecuteSet(ProcessSet& ps, const Response& resp) {
+  std::vector<TensorEntry> entries;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    for (const std::string& name : resp.names) {
+      auto it = tensor_table_.find(name);
+      if (it == tensor_table_.end()) continue;  // failed by a world change
+      entries.push_back(std::move(it->second));
+      tensor_table_.erase(it);
+    }
+  }
+  if (entries.empty()) return;
+  ps.collectives.fetch_add(1, std::memory_order_relaxed);
+  for (const TensorEntry& e : entries)
+    ps.payload_bytes.fetch_add(static_cast<int64_t>(e.nbytes),
+                               std::memory_order_relaxed);
+  int64_t t0 = NowNs();
+  for (const std::string& name : resp.names)
+    timeline_.Start(name, OpName(resp.op));
+  switch (resp.op) {
+    case OpType::kAllreduce:
+      ExecuteAllreduce(resp, entries);
+      break;
+    case OpType::kAllgather:
+      ExecuteAllgather(resp, entries[0]);
+      break;
+    case OpType::kBroadcast:
+      ExecuteBroadcast(resp, entries[0]);
+      break;
+    case OpType::kAlltoall:
+      ExecuteAlltoall(resp, entries[0]);
+      break;
+    default:
+      break;
+  }
+  for (const std::string& name : resp.names) timeline_.End(name);
+  ps.wire_ns.fetch_add(NowNs() - t0, std::memory_order_relaxed);
+}
+
+void Engine::QuiesceSets() {
+  // BeginWorldChange already latched the abort and half-closed/poisoned
+  // every set's transport, so a busy executor cancels within one backoff
+  // step; queued responses' entries were failed by FailAll
+  for (auto& [id, ps] : psets_) {
+    std::unique_lock<std::mutex> lk(ps->mu);
+    ps->work.clear();
+    ps->cv.wait(lk, [&] { return !ps->busy; });
+  }
+}
+
+void Engine::EvictSet(ProcessSet& ps) {
+  if (ps.exec.joinable()) {
+    {
+      std::lock_guard<std::mutex> lk(ps.mu);
+      ps.stop = true;
+      ps.work.clear();
+    }
+    ps.cv.notify_all();
+    ps.exec.join();
+  }
+  for (auto& l : ps.links) l.Close();
+  ps.links.clear();
+  ps.shm_tx.clear();
+  ps.shm_rx.clear();
+  ps.member.store(false, std::memory_order_relaxed);
+  ps.evicted.store(true, std::memory_order_relaxed);
+  ps.pub_size.store(0, std::memory_order_relaxed);
+  ps.neg.Reset(0);
+  LOG_RANK(Warning, rank_) << "process set " << ps.id
+                           << " evicted: its last member left the world";
+}
+
+void Engine::StopSetExecutors() {
+  for (auto& [id, ps] : psets_) {
+    if (!ps->exec.joinable()) continue;
+    {
+      std::lock_guard<std::mutex> lk(ps->mu);
+      ps->stop = true;
+    }
+    ps->cv.notify_all();
+    ps->exec.join();
+  }
+}
+
+Status Engine::ApplySetTable() {
+  // reconcile the registry with the table's (new-rank-space) member
+  // lists: evict sets whose members all died, rebuild surviving sets'
+  // communicators, create sets this rank has never seen (joiners)
+  std::map<int, std::vector<int>> want;
+  for (auto& [id, mem] : table_psets_) want[id] = mem;
+  for (auto& [id, ps] : psets_)
+    if (!ps->evicted.load(std::memory_order_relaxed) && !want.count(id))
+      EvictSet(*ps);
+  for (auto& [id, mem] : want) {
+    ProcessSet* ps = FindSet(id);
+    if (ps == nullptr) {
+      auto fresh = std::make_unique<ProcessSet>();
+      fresh->id = id;
+      fresh->neg.set_id = id;
+      ps = fresh.get();
+      {
+        std::lock_guard<std::mutex> plk(psets_mu_);
+        psets_[id] = std::move(fresh);
+      }
+      if (id >= next_pset_id_) next_pset_id_ = id + 1;
+    }
+    if (ps->evicted.load(std::memory_order_relaxed)) continue;
+    bool had_exec = ps->exec.joinable();
+    ps->neg.SetMembers(mem, size_);
+    ps->neg.Reset(cache_capacity_);
+    Status s = BuildSetComm(*ps);
+    if (!s.ok()) return s;
+    if (ps->member.load(std::memory_order_relaxed) && !had_exec)
+      ps->exec = std::thread(&Engine::SetExecLoop, this, ps);
+  }
+  return Status::OK();
+}
+
+int Engine::ProcessSetStats(int64_t* out, int max_sets) const {
+  int n = 0;
+  auto put = [&](int64_t id, int64_t sz, int64_t rk, int64_t coll,
+                 int64_t bytes, int64_t wns, int64_t hits,
+                 int64_t misses) {
+    if (n >= max_sets) return;
+    int64_t* p = out + 8 * n++;
+    p[0] = id;
+    p[1] = sz;
+    p[2] = rk;
+    p[3] = coll;
+    p[4] = bytes;
+    p[5] = wns;
+    p[6] = hits;
+    p[7] = misses;
+  };
+  put(0, world_size_pub_.load(std::memory_order_relaxed),
+      world_rank_pub_.load(std::memory_order_relaxed),
+      set0_collectives_.load(std::memory_order_relaxed),
+      set0_payload_bytes_.load(std::memory_order_relaxed), 0,
+      neg0_.hits.load(std::memory_order_relaxed),
+      neg0_.misses.load(std::memory_order_relaxed));
+  std::lock_guard<std::mutex> lk(psets_mu_);
+  for (const auto& [id, ps] : psets_) {
+    put(id, ps->pub_size.load(std::memory_order_relaxed),
+        ps->pub_rank.load(std::memory_order_relaxed),
+        ps->collectives.load(std::memory_order_relaxed),
+        ps->payload_bytes.load(std::memory_order_relaxed),
+        ps->wire_ns.load(std::memory_order_relaxed),
+        ps->neg.hits.load(std::memory_order_relaxed),
+        ps->neg.misses.load(std::memory_order_relaxed));
+  }
+  return n;
 }
 
 // Wake the background thread immediately (submission/shutdown path).  A
@@ -2371,6 +3162,9 @@ void Engine::Shutdown() {
           << " overlap_ms=" << pipe_overlap_ns_.load() / 1000000;
     }
   }
+  // set executors drain their remaining queues (peers are doing the same
+  // before anyone's sockets close) and stop
+  StopSetExecutors();
   timeline_.Shutdown();
 }
 
@@ -2380,11 +3174,40 @@ void Engine::Shutdown() {
 
 int Engine::Enqueue(OpType op, const std::string& name, DType dtype,
                     const std::vector<int64_t>& dims, const void* data,
-                    int root_rank, void* user_out) {
+                    int root_rank, void* user_out, int process_set) {
   size_t nbytes = static_cast<size_t>(NumElems(dims)) * DTypeSize(dtype);
   // user_out only makes sense for same-shape ops
   if (op != OpType::kAllreduce && op != OpType::kBroadcast)
     user_out = nullptr;
+  // process-set routing: membership is validated HERE, on the submitting
+  // rank, so a non-member op fails locally with a clear error instead of
+  // wedging a negotiation it could never complete
+  if (process_set != 0) {
+    std::string why;
+    {
+      std::lock_guard<std::mutex> plk(psets_mu_);
+      auto it = psets_.find(process_set);
+      if (it == psets_.end())
+        why = "unknown process set " + std::to_string(process_set) +
+              " (add_process_set must complete on every rank first)";
+      else if (it->second->evicted)
+        why = "process set " + std::to_string(process_set) +
+              " no longer exists (an elastic membership change removed "
+              "its last member)";
+      else if (!it->second->member)
+        why = "this rank is not a member of process set " +
+              std::to_string(process_set);
+    }
+    if (!why.empty()) {
+      std::lock_guard<std::mutex> lk(mu_);
+      int handle = next_handle_++;
+      handles_[handle] = HandleState{};
+      handles_[handle].done = true;
+      handles_[handle].status = Status::Error(why);
+      cv_.notify_all();
+      return handle;
+    }
+  }
   // in-place (out aliases input): no staging at all — the collective runs
   // on the caller's buffer; otherwise stage the input outside the lock
   // (pooled: warm pages after the first few ops instead of a fresh 64 MB
@@ -2423,6 +3246,7 @@ int Engine::Enqueue(OpType op, const std::string& name, DType dtype,
   e.req.name = name;
   e.req.root_rank = root_rank;
   e.req.dims = dims;
+  e.req.set = process_set;
   e.data = std::move(staged);
   e.nbytes = nbytes;
   e.handle = handle;
@@ -2521,9 +3345,13 @@ void Engine::FailAll(const Status& st) {
     dp_fail_ = Status::OK();
   }
   // claim bookkeeping references the tensors being failed (bg thread owns
-  // all of it; FailAll only runs on the bg thread)
-  bits_inflight_.clear();
-  resend_.clear();
+  // all of it; FailAll only runs on the bg thread) — every set's
+  neg0_.bits_inflight.clear();
+  neg0_.resend.clear();
+  for (auto& [id, ps] : psets_) {
+    ps->neg.bits_inflight.clear();
+    ps->neg.resend.clear();
+  }
   std::lock_guard<std::mutex> lk(mu_);
   for (auto& [name, entry] : tensor_table_) {
     auto it = handles_.find(entry.handle);
@@ -2554,13 +3382,18 @@ void Engine::BackgroundLoop() {
       // unpack/complete whatever the executor finished since last tick
       // (cycle N-1's items) before negotiating and packing cycle N+1
       DrainCompletions();
+      PipelineStallCheck();
+    }
+    {
+      // deferred executor failures drain UNCONDITIONALLY: process-set
+      // executors route their wire errors through DataPlaneFail too, and
+      // they exist even when the global data plane runs inline (depth 1)
       Status df;
       {
         std::lock_guard<std::mutex> lk(pipe_mu_);
         df = dp_fail_;
       }
       if (!df.ok()) FailAll(df);
-      PipelineStallCheck();
     }
 
     // a 1-rank elastic world still admits joiners: no CoordinatorTick
@@ -2598,11 +3431,25 @@ void Engine::BackgroundLoop() {
         timeline_.NegotiateStart(r.name, OpName(r.op));
         timeline_.NegotiateRankReady(r.name, 0);
         timeline_.NegotiateEnd(r.name);
-        if (cache_.enabled()) {
-          if (cache_.Lookup(r) >= 0)
+        if (r.op == OpType::kProcessSet) {
+          // degenerate world: the set registers immediately (members can
+          // only be {0}); id assignment is still coordinator-ordered
+          Response resp;
+          resp.op = r.op;
+          resp.names = {r.name};
+          resp.first_dims.push_back(next_pset_id_++);
+          for (int64_t d : r.dims) resp.first_dims.push_back(d);
+          to_execute.responses.push_back(std::move(resp));
+          continue;
+        }
+        if (neg0_.cache.enabled()) {
+          if (neg0_.cache.Lookup(r) >= 0) {
             cache_hits_.fetch_add(1, std::memory_order_relaxed);
-          else
+            neg0_.hits.fetch_add(1, std::memory_order_relaxed);
+          } else {
             cache_misses_.fetch_add(1, std::memory_order_relaxed);
+            neg0_.misses.fetch_add(1, std::memory_order_relaxed);
+          }
         }
         Response resp;
         resp.op = r.op;
@@ -2612,9 +3459,9 @@ void Engine::BackgroundLoop() {
         to_execute.responses.push_back(std::move(resp));
       }
       to_execute.shutdown = local.shutdown;
-      auto snap = SnapshotReqs(to_execute);
+      auto snap = SnapshotReqs(neg0_, to_execute);
       for (const Response& resp : to_execute.responses) Execute(resp);
-      ApplyCacheMutations(to_execute, snap);
+      ApplyCacheMutations(neg0_, to_execute, snap);
       if (to_execute.shutdown) {
         FailAll(Status::Shutdown());
         stop = true;
@@ -2627,10 +3474,13 @@ void Engine::BackgroundLoop() {
     } else {
       WorkerTick(local, &stop);
     }
+    // an abort raised inline (e.g. a failed process-set mesh build) stops
+    // the loop at the tick boundary
+    if (abort_pending_stop_) stop = true;
 
     // a pending displaced-claim resend skips the wait: the full request
     // should re-enter negotiation on the very next tick, not a cycle later
-    if (!stop && resend_.empty()) {
+    if (!stop && !AnyResend()) {
       auto elapsed = std::chrono::steady_clock::now() - cycle_start;
       auto budget = std::chrono::microseconds(cycle_us_);
       if (elapsed < budget)
@@ -2718,27 +3568,29 @@ void Engine::AdoptTuned(int64_t fusion, int64_t cycle_us, int64_t hier,
     wire_stripes_active_.store(stripes, std::memory_order_relaxed);
 }
 
-void Engine::SplitRequests(std::vector<Request>& reqs, RequestList* full,
-                           std::vector<int>* claims) {
+void Engine::SplitRequests(NegState& ns, std::vector<Request>& reqs,
+                           RequestList* full, std::vector<int>* claims) {
   for (Request& r : reqs) {
-    if (cache_.enabled()) {
-      int s = cache_.Lookup(r);
+    if (ns.cache.enabled() && r.op != OpType::kProcessSet) {
+      int s = ns.cache.Lookup(r);
       if (s >= 0) {
         cache_hits_.fetch_add(1, std::memory_order_relaxed);
+        ns.hits.fetch_add(1, std::memory_order_relaxed);
         claims->push_back(s);
-        bits_inflight_[r.name] = s;
+        ns.bits_inflight[r.name] = s;
         continue;
       }
       cache_misses_.fetch_add(1, std::memory_order_relaxed);
+      ns.misses.fetch_add(1, std::memory_order_relaxed);
     }
     full->requests.push_back(std::move(r));
   }
 }
 
 std::unordered_map<std::string, Request> Engine::SnapshotReqs(
-    const ResponseList& rl) {
+    NegState& ns, const ResponseList& rl) {
   std::unordered_map<std::string, Request> snap;
-  if (!cache_.enabled()) return snap;
+  if (!ns.cache.enabled()) return snap;
   std::lock_guard<std::mutex> lk(mu_);
   for (const Response& r : rl.responses) {
     if (r.op == OpType::kError) continue;
@@ -2751,9 +3603,9 @@ std::unordered_map<std::string, Request> Engine::SnapshotReqs(
 }
 
 void Engine::ApplyCacheMutations(
-    const ResponseList& rl,
+    NegState& ns, const ResponseList& rl,
     const std::unordered_map<std::string, Request>& snap) {
-  if (!cache_.enabled()) return;
+  if (!ns.cache.enabled()) return;
   std::vector<std::string> displaced;
   std::vector<int> mutated;
   static const std::vector<int64_t> kNoDims;
@@ -2762,8 +3614,8 @@ void Engine::ApplyCacheMutations(
       // a validation failure for a cached name removes the entry (the
       // renegotiated signature proved stale) — replicated on every rank
       for (const std::string& nm : r.names) {
-        bits_inflight_.erase(nm);
-        cache_.Remove(nm, &mutated);
+        ns.bits_inflight.erase(nm);
+        ns.cache.Remove(nm, &mutated);
       }
       continue;
     }
@@ -2776,41 +3628,45 @@ void Engine::ApplyCacheMutations(
       // a rank with no live tensor-table entry (caller released early)
       // still inserts so slot assignments stay replicated; the entry is
       // marked locally-unhittable
-      cache_.Upsert(nm, r.op, local ? it->second.dtype : DType::kFloat32,
-                    r.root_rank, local ? it->second.dims : kNoDims, local,
-                    r.first_dims, &displaced, &mutated);
+      ns.cache.Upsert(nm, r.op, local ? it->second.dtype : DType::kFloat32,
+                      r.root_rank, local ? it->second.dims : kNoDims, local,
+                      r.first_dims, &displaced, &mutated);
     }
   }
-  cache_entries_.store(cache_.entries(), std::memory_order_relaxed);
-  cache_evictions_.store(cache_.evictions(), std::memory_order_relaxed);
+  if (ns.set_id == 0) {
+    cache_entries_.store(ns.cache.entries(), std::memory_order_relaxed);
+    cache_evictions_.store(ns.cache.evictions(), std::memory_order_relaxed);
+  }
   if (rank_ == 0) {
     // partial claims on a mutated slot are void: remote claimers observe
     // the same mutation in their broadcast stream and re-send full
     // requests (HandleDisplaced on their side); rank 0's own re-sends are
     // driven by the displaced-name pass below
     for (int s : mutated) {
-      cache_claims_.erase(s);
-      pending_invalid_.erase(s);
+      ns.cache_claims.erase(s);
+      ns.pending_invalid.erase(s);
     }
   }
-  HandleDisplaced(displaced);
+  HandleDisplaced(ns, displaced);
 }
 
-void Engine::HandleDisplaced(const std::vector<std::string>& displaced) {
+void Engine::HandleDisplaced(NegState& ns,
+                             const std::vector<std::string>& displaced) {
   for (const std::string& nm : displaced) {
-    auto it = bits_inflight_.find(nm);
-    if (it == bits_inflight_.end()) continue;  // no claim of ours pending
-    bits_inflight_.erase(it);
+    auto it = ns.bits_inflight.find(nm);
+    if (it == ns.bits_inflight.end()) continue;  // no claim of ours pending
+    ns.bits_inflight.erase(it);
     std::lock_guard<std::mutex> lk(mu_);
     auto tt = tensor_table_.find(nm);
     // still pending here (not covered by a response in this same batch):
     // the claim died with the cache entry — fall back to the full path
-    if (tt != tensor_table_.end()) resend_.push_back(tt->second.req);
+    if (tt != tensor_table_.end()) ns.resend.push_back(tt->second.req);
   }
 }
 
-void Engine::SynthesizeClaimRequest(int rank, int slot, ResponseList* out) {
-  const CacheEntry* e = cache_.At(slot);
+void Engine::SynthesizeClaimRequest(NegState& ns, int rank, int slot,
+                                    ResponseList* out) {
+  const CacheEntry* e = ns.cache.At(slot);
   if (!e) return;
   Request q;
   q.rank = rank;
@@ -2818,54 +3674,59 @@ void Engine::SynthesizeClaimRequest(int rank, int slot, ResponseList* out) {
   q.dtype = e->dtype;
   q.root_rank = e->root_rank;
   q.name = e->name;
+  q.set = ns.set_id;
   // dims[1:] are cross-rank-equal by the entry's own negotiation; dim0 is
-  // per-rank for allgather/alltoall and recorded in first_dims
+  // per-rank for allgather/alltoall and recorded in first_dims (indexed by
+  // SET rank)
   q.dims = e->my_dims;
+  int ri = ns.IndexOf(rank);
   if ((e->op == OpType::kAllgather || e->op == OpType::kAlltoall) &&
-      !q.dims.empty() && rank < static_cast<int>(e->first_dims.size()))
-    q.dims[0] = e->first_dims[rank];
-  if (rank == rank_) bits_inflight_.erase(e->name);
+      !q.dims.empty() && ri >= 0 &&
+      ri < static_cast<int>(e->first_dims.size()))
+    q.dims[0] = e->first_dims[ri];
+  if (rank == rank_) ns.bits_inflight.erase(e->name);
   RequestList rl;
   rl.requests.push_back(std::move(q));
-  HandleArrivedRequests(rl, out);
+  HandleArrivedRequests(ns, rl, out);
 }
 
-void Engine::CheckCacheInvalidation(const Request& r, ResponseList* out) {
-  if (!cache_.enabled()) return;
-  int s = cache_.SlotOf(r.name);
-  if (s < 0 || pending_invalid_.count(s)) return;
+void Engine::CheckCacheInvalidation(NegState& ns, const Request& r,
+                                    ResponseList* out) {
+  if (!ns.cache.enabled()) return;
+  int s = ns.cache.SlotOf(r.name);
+  if (s < 0 || ns.pending_invalid.count(s)) return;
   // a full request for a cached name means some rank's signature changed
   // (or its claim was displaced): route the WHOLE name through the full
   // path — existing and future claims convert to synthesized requests so
   // readiness accounting stays unified and mismatches error instead of
   // deadlocking half-in-cache/half-in-table
-  pending_invalid_.insert(s);
-  auto it = cache_claims_.find(s);
-  if (it != cache_claims_.end()) {
+  ns.pending_invalid.insert(s);
+  auto it = ns.cache_claims.find(s);
+  if (it != ns.cache_claims.end()) {
     std::set<int32_t> ranks = std::move(it->second.ranks);
-    cache_claims_.erase(it);
-    for (int32_t rk : ranks) SynthesizeClaimRequest(rk, s, out);
+    ns.cache_claims.erase(it);
+    for (int32_t rk : ranks) SynthesizeClaimRequest(ns, rk, s, out);
   }
 }
 
-void Engine::RegisterClaim(int rank, int slot, uint64_t epoch,
+void Engine::RegisterClaim(NegState& ns, int rank, int slot, uint64_t epoch,
                            ResponseList* out) {
-  const CacheEntry* e = cache_.At(slot);
+  const CacheEntry* e = ns.cache.At(slot);
   // stale claim: the slot mutated after the claimer's knowledge — drop it;
   // the claimer observes the same mutation and re-sends the full request
-  if (!e || cache_.slot_epoch(slot) > epoch) return;
-  if (pending_invalid_.count(slot)) {
-    SynthesizeClaimRequest(rank, slot, out);
+  if (!e || ns.cache.slot_epoch(slot) > epoch) return;
+  if (ns.pending_invalid.count(slot)) {
+    SynthesizeClaimRequest(ns, rank, slot, out);
     return;
   }
-  CacheClaim& c = cache_claims_[slot];
+  CacheClaim& c = ns.cache_claims[slot];
   if (c.ranks.count(rank)) {
     Response err;
     err.op = OpType::kError;
     err.names = {e->name};
     err.error_message = "rank " + std::to_string(rank) +
                         " submitted op '" + e->name + "' twice";
-    error_ready_.push_back(std::move(err));
+    ns.error_ready.push_back(std::move(err));
     return;
   }
   if (c.ranks.empty()) {
@@ -2874,18 +3735,18 @@ void Engine::RegisterClaim(int rank, int slot, uint64_t epoch,
   }
   c.ranks.insert(rank);
   timeline_.NegotiateRankReady(e->name, rank);
-  if (static_cast<int>(c.ranks.size()) == size_) {
+  if (static_cast<int>(c.ranks.size()) == ns.expected()) {
     timeline_.NegotiateEnd(e->name);
-    cached_ready_.push_back(slot);
-    cache_claims_.erase(slot);
+    ns.cached_ready.push_back(slot);
+    ns.cache_claims.erase(slot);
   }
 }
 
-void Engine::BuildCachedExec(CachedExecFrame* ce) {
-  while (!cached_ready_.empty()) {
-    int lead = cached_ready_.front();
-    cached_ready_.pop_front();
-    const CacheEntry* e = cache_.At(lead);
+void Engine::BuildCachedExec(NegState& ns, CachedExecFrame* ce) {
+  while (!ns.cached_ready.empty()) {
+    int lead = ns.cached_ready.front();
+    ns.cached_ready.pop_front();
+    const CacheEntry* e = ns.cache.At(lead);
     if (!e) continue;  // mutated since completion (defensive)
     std::vector<uint32_t> group{static_cast<uint32_t>(lead)};
     if (e->op == OpType::kAllreduce) {
@@ -2894,11 +3755,11 @@ void Engine::BuildCachedExec(CachedExecFrame* ce) {
       // enabling the cache never UN-fuses the steady-state data plane
       int64_t bytes = NumElems(e->my_dims) *
                       static_cast<int64_t>(DTypeSize(e->dtype));
-      for (auto it = cached_ready_.begin();
-           it != cached_ready_.end() && bytes < fusion_threshold_;) {
-        const CacheEntry* n = cache_.At(*it);
+      for (auto it = ns.cached_ready.begin();
+           it != ns.cached_ready.end() && bytes < fusion_threshold_;) {
+        const CacheEntry* n = ns.cache.At(*it);
         if (!n) {
-          it = cached_ready_.erase(it);
+          it = ns.cached_ready.erase(it);
           continue;
         }
         if (n->op != OpType::kAllreduce || n->dtype != e->dtype) {
@@ -2913,68 +3774,104 @@ void Engine::BuildCachedExec(CachedExecFrame* ce) {
         }
         bytes += nb;
         group.push_back(static_cast<uint32_t>(*it));
-        it = cached_ready_.erase(it);
+        it = ns.cached_ready.erase(it);
       }
     }
     ce->groups.push_back(std::move(group));
   }
 }
 
-Status Engine::DecodeCachedGroup(const std::vector<uint32_t>& group,
+Status Engine::DecodeCachedGroup(NegState& ns,
+                                 const std::vector<uint32_t>& group,
                                  Response* resp) {
   if (group.empty()) return Status::Error("empty cached-exec group");
   for (uint32_t id : group) {
-    const CacheEntry* e = cache_.At(static_cast<int>(id));
+    const CacheEntry* e = ns.cache.At(static_cast<int>(id));
     if (!e)
       return Status::Error(
           "cached-exec referenced an empty cache slot — response cache "
-          "replica divergence");
+          "replica divergence (set " + std::to_string(ns.set_id) + ")");
     if (resp->names.empty()) {
       resp->op = e->op;
       resp->root_rank = e->root_rank;
       resp->first_dims = e->first_dims;
     }
     resp->names.push_back(e->name);
-    cache_.Touch(static_cast<int>(id));
-    bits_inflight_.erase(e->name);
+    ns.cache.Touch(static_cast<int>(id));
+    ns.bits_inflight.erase(e->name);
   }
   return Status::OK();
 }
 
 void Engine::WorkerTick(RequestList& local, bool* stop) {
-  // displaced claims re-enter as full requests ahead of this cycle's batch
-  if (!resend_.empty()) {
-    local.requests.insert(local.requests.begin(),
-                          std::make_move_iterator(resend_.begin()),
-                          std::make_move_iterator(resend_.end()));
-    resend_.clear();
-  }
-  RequestList full;
-  full.shutdown = local.shutdown;
-  std::vector<int> claims;
-  SplitRequests(local.requests, &full, &claims);
-  if (!claims.empty()) {
-    CacheBitsFrame cb;
-    cb.rank = rank_;
-    cb.epoch = cache_.epoch();
-    cb.bits.assign(static_cast<size_t>(cache_.high_water() + 7) / 8, 0);
-    for (int s : claims) cb.bits[s >> 3] |= static_cast<uint8_t>(1u << (s & 7));
-    Status s = SendCtrl(coord_, Serialize(cb));
-    if (!s.ok()) {
-      *stop = AbortJob(
-          Status::Error("lost coordinator (rank 0): " + s.message), 0);
-      return;
+  // split this tick's submissions by process set; displaced-claim resends
+  // re-enter ahead of their OWN set's batch.  One claims frame + one full
+  // frame per set that has traffic — with only the global set this is
+  // byte-for-byte the single-frame v7 tick.
+  std::map<int, std::vector<Request>> by_set;
+  by_set[0];  // the global set always processes (shutdown rides its frame)
+  for (Request& r : local.requests) by_set[r.set].push_back(std::move(r));
+  auto prepend_resend = [&](NegState& ns) {
+    if (ns.resend.empty()) return;
+    auto& v = by_set[ns.set_id];
+    v.insert(v.begin(), std::make_move_iterator(ns.resend.begin()),
+             std::make_move_iterator(ns.resend.end()));
+    ns.resend.clear();
+  };
+  prepend_resend(neg0_);
+  for (auto& [id, ps] : psets_) prepend_resend(ps->neg);
+  for (auto& [sid, reqs] : by_set) {
+    NegState* ns = NegOf(sid);
+    if (ns == nullptr) {
+      // the set died between enqueue and drain (elastic eviction): its
+      // ops fail locally with a descriptive error instead of wiring
+      std::lock_guard<std::mutex> lk(mu_);
+      for (Request& r : reqs) {
+        auto it = tensor_table_.find(r.name);
+        if (it == tensor_table_.end()) continue;
+        int handle = it->second.handle;
+        tensor_table_.erase(it);
+        auto hit = handles_.find(handle);
+        if (hit != handles_.end() && !hit->second.done) {
+          hit->second.done = true;
+          hit->second.status = Status::Error(
+              "process set " + std::to_string(sid) +
+              " no longer exists (membership change evicted it)");
+        }
+      }
+      cv_.notify_all();
+      continue;
     }
-    hb_last_tx_ns_ = NowNs();
-  }
-  if (!full.requests.empty() || full.shutdown) {
-    Status s = SendCtrl(coord_, Serialize(full));
-    if (!s.ok()) {
-      *stop = AbortJob(
-          Status::Error("lost coordinator (rank 0): " + s.message), 0);
-      return;
+    RequestList full;
+    full.process_set = sid;
+    full.shutdown = sid == 0 && local.shutdown;
+    std::vector<int> claims;
+    SplitRequests(*ns, reqs, &full, &claims);
+    if (!claims.empty()) {
+      CacheBitsFrame cb;
+      cb.rank = rank_;
+      cb.epoch = ns->cache.epoch();
+      cb.process_set = sid;
+      cb.bits.assign(static_cast<size_t>(ns->cache.high_water() + 7) / 8, 0);
+      for (int s : claims)
+        cb.bits[s >> 3] |= static_cast<uint8_t>(1u << (s & 7));
+      Status s = SendCtrl(coord_, Serialize(cb));
+      if (!s.ok()) {
+        *stop = AbortJob(
+            Status::Error("lost coordinator (rank 0): " + s.message), 0);
+        return;
+      }
+      hb_last_tx_ns_ = NowNs();
     }
-    hb_last_tx_ns_ = NowNs();
+    if (!full.requests.empty() || full.shutdown) {
+      Status s = SendCtrl(coord_, Serialize(full));
+      if (!s.ok()) {
+        *stop = AbortJob(
+            Status::Error("lost coordinator (rank 0): " + s.message), 0);
+        return;
+      }
+      hb_last_tx_ns_ = NowNs();
+    }
   }
   // frames execute strictly in arrival order — cached-exec groups decode
   // against the cache state BEFORE any later frame's mutations apply,
@@ -3027,18 +3924,29 @@ void Engine::WorkerTick(RequestList& local, bool* stop) {
         *stop = true;
         return;
       }
+      NegState* ns = NegOf(ce.process_set);
+      if (ns == nullptr) {
+        LogWarn("cached-exec frame for unknown process set " +
+                std::to_string(ce.process_set) + " — dropped");
+        continue;
+      }
       AdoptTuned(ce.tuned_fusion, ce.tuned_cycle_us, ce.tuned_hierarchical,
                  ce.tuned_pipeline_depth, ce.tuned_segment_bytes,
                  ce.tuned_wire_stripes);
+      ProcessSet* ps = ce.process_set != 0 ? FindSet(ce.process_set)
+                                           : nullptr;
       for (const auto& g : ce.groups) {
         Response resp;
-        s = DecodeCachedGroup(g, &resp);
+        s = DecodeCachedGroup(*ns, g, &resp);
         if (!s.ok()) {
           FailAll(s);
           *stop = true;
           return;
         }
-        Dispatch(resp);
+        if (ps != nullptr)
+          DispatchSet(*ps, resp);  // the set's own executor runs it
+        else
+          Dispatch(resp);
       }
     } else if (ft == FrameType::kResponseList) {
       ResponseList rl;
@@ -3048,12 +3956,25 @@ void Engine::WorkerTick(RequestList& local, bool* stop) {
         *stop = true;
         return;
       }
+      NegState* ns = NegOf(rl.process_set);
+      if (ns == nullptr) {
+        LogWarn("response frame for unknown process set " +
+                std::to_string(rl.process_set) + " — dropped");
+        continue;
+      }
       AdoptTuned(rl.tuned_fusion, rl.tuned_cycle_us, rl.tuned_hierarchical,
                  rl.tuned_pipeline_depth, rl.tuned_segment_bytes,
                  rl.tuned_wire_stripes);
-      auto snap = SnapshotReqs(rl);
-      for (const Response& r : rl.responses) Dispatch(r);
-      ApplyCacheMutations(rl, snap);
+      auto snap = SnapshotReqs(*ns, rl);
+      ProcessSet* ps = rl.process_set != 0 ? FindSet(rl.process_set)
+                                           : nullptr;
+      for (const Response& r : rl.responses) {
+        if (ps != nullptr)
+          DispatchSet(*ps, r);
+        else
+          Dispatch(r);
+      }
+      ApplyCacheMutations(*ns, rl, snap);
       got_shutdown = got_shutdown || rl.shutdown;
     } else {
       // surface the descriptive version-mismatch error, not just "invalid"
@@ -3073,21 +3994,44 @@ void Engine::WorkerTick(RequestList& local, bool* stop) {
 }
 
 bool Engine::CoordinatorTick(RequestList& local) {
-  // displaced own-claims re-enter as full requests ahead of this batch
-  if (!resend_.empty()) {
-    local.requests.insert(local.requests.begin(),
-                          std::make_move_iterator(resend_.begin()),
-                          std::make_move_iterator(resend_.end()));
-    resend_.clear();
+  ResponseList out;  // the GLOBAL set's response list (tuned knobs +
+                     // shutdown ride it, exactly as before)
+  // per-set response lists for this tick's non-global traffic; created
+  // lazily so a global-only tick allocates nothing extra
+  std::map<int, ResponseList> souts;
+  auto out_for = [&](int sid) -> ResponseList* {
+    if (sid == 0) return &out;
+    ResponseList& so = souts[sid];
+    so.process_set = sid;
+    return &so;
+  };
+  // own requests, split by set: displaced own-claims re-enter ahead of
+  // their set's batch, cache claims register directly, misses negotiate
+  std::map<int, std::vector<Request>> by_set;
+  by_set[0];
+  for (Request& r : local.requests) by_set[r.set].push_back(std::move(r));
+  auto prepend_resend = [&](NegState& ns) {
+    if (ns.resend.empty()) return;
+    auto& v = by_set[ns.set_id];
+    v.insert(v.begin(), std::make_move_iterator(ns.resend.begin()),
+             std::make_move_iterator(ns.resend.end()));
+    ns.resend.clear();
+  };
+  prepend_resend(neg0_);
+  for (auto& [id, ps] : psets_) prepend_resend(ps->neg);
+  for (auto& [sid, reqs] : by_set) {
+    NegState* ns = NegOf(sid);
+    if (ns == nullptr) continue;  // evicted set; Enqueue already errors
+    RequestList own_full;
+    std::vector<int> own_claims;
+    SplitRequests(*ns, reqs, &own_full, &own_claims);
+    ResponseList* op = out_for(sid);
+    for (int s : own_claims)
+      RegisterClaim(*ns, 0, s, ns->cache.epoch(), op);
+    for (const Request& r : own_full.requests)
+      CheckCacheInvalidation(*ns, r, op);
+    HandleArrivedRequests(*ns, own_full, op);
   }
-  ResponseList out;
-  // own requests: cache claims register directly; misses negotiate fully
-  RequestList own_full;
-  std::vector<int> own_claims;
-  SplitRequests(local.requests, &own_full, &own_claims);
-  for (int s : own_claims) RegisterClaim(0, s, cache_.epoch(), &out);
-  for (const Request& r : own_full.requests) CheckCacheInvalidation(r, &out);
-  HandleArrivedRequests(own_full, &out);
   bool shutdown = local.shutdown;
   // worker frames
   for (int i = 1; i < size_; i++) {
@@ -3124,8 +4068,16 @@ bool Engine::CoordinatorTick(RequestList& local) {
           shutdown = true;
           break;
         }
-        for (const Request& r : rl.requests) CheckCacheInvalidation(r, &out);
-        HandleArrivedRequests(rl, &out);
+        NegState* ns = NegOf(rl.process_set);
+        if (ns == nullptr) {
+          LogWarn("request frame for unknown process set " +
+                  std::to_string(rl.process_set) + " — dropped");
+          continue;
+        }
+        ResponseList* op = out_for(rl.process_set);
+        for (const Request& r : rl.requests)
+          CheckCacheInvalidation(*ns, r, op);
+        HandleArrivedRequests(*ns, rl, op);
         shutdown = shutdown || rl.shutdown;
       } else if (ft == FrameType::kCacheBits) {
         CacheBitsFrame cb;
@@ -3135,12 +4087,19 @@ bool Engine::CoordinatorTick(RequestList& local) {
           shutdown = true;
           break;
         }
+        NegState* ns = NegOf(cb.process_set);
+        if (ns == nullptr) {
+          LogWarn("cache-bits frame for unknown process set " +
+                  std::to_string(cb.process_set) + " — dropped");
+          continue;
+        }
+        ResponseList* op = out_for(cb.process_set);
         for (size_t b = 0; b < cb.bits.size(); b++) {
           uint8_t byte = cb.bits[b];
           for (int k = 0; byte != 0; k++, byte >>= 1)
             if (byte & 1u)
-              RegisterClaim(cb.rank, static_cast<int>(b * 8) + k, cb.epoch,
-                            &out);
+              RegisterClaim(*ns, cb.rank, static_cast<int>(b * 8) + k,
+                            cb.epoch, op);
         }
       } else {
         RequestList probe;
@@ -3154,9 +4113,23 @@ bool Engine::CoordinatorTick(RequestList& local) {
   }
   // globally-hit cache entries execute via compact slot groups...
   CachedExecFrame ce;
-  BuildCachedExec(&ce);
+  BuildCachedExec(neg0_, &ce);
   // ...while misses take the full fuse path; stalls are watched on both
-  FuseReady(&out);
+  FuseReady(neg0_, &out);
+  // per-set ready work drains the same way into per-set frames — each
+  // set's negotiation completes (and emits) independently of every other
+  // set's progress, the control-plane half of no-head-of-line-blocking
+  std::map<int, CachedExecFrame> sces;
+  for (auto& [sid, ps] : psets_) {
+    if (ps->evicted) continue;
+    if (!ps->neg.cached_ready.empty()) {
+      CachedExecFrame& f = sces[sid];
+      f.process_set = sid;
+      BuildCachedExec(ps->neg, &f);
+    }
+    if (!ps->neg.ready.empty() || !ps->neg.error_ready.empty())
+      FuseReady(ps->neg, out_for(sid));
+  }
   if (stall_check_) StallCheck();
   // fault domain BEFORE the send phase: an abort (or a membership change)
   // must precede any response broadcast this tick, or workers could start
@@ -3233,35 +4206,97 @@ bool Engine::CoordinatorTick(RequestList& local) {
     pending_tuned_segment_ = -1;
     pending_tuned_stripes_ = -1;
   }
+  // per-set emission: each set's frames go ONLY to that set's member
+  // workers, then apply locally — dispatch hands work to the set's own
+  // executor (instant), and a non-member coordinator still replicates the
+  // cache mutations (its replica must track the members' for the claim
+  // protocol to stay sound).  This runs BEFORE the global set's local
+  // execution so rank 0's own (possibly inline) wire work never delays
+  // another set's broadcast.
+  {
+    std::set<int> emit_ids;
+    for (auto& [sid, f] : sces) emit_ids.insert(sid);
+    for (auto& [sid, so] : souts)
+      if (!so.responses.empty()) emit_ids.insert(sid);
+    for (int sid : emit_ids) {
+      ProcessSet* ps = FindSet(sid);
+      if (ps == nullptr || ps->evicted) continue;
+      auto send_members = [&](const std::string& frame) {
+        for (int g : ps->neg.members) {
+          if (g == 0 || g >= static_cast<int>(workers_.size()) ||
+              !workers_[g].valid())
+            continue;
+          if (!SendCtrl(workers_[g], frame).ok())
+            LogWarn("send to process-set member failed");
+        }
+      };
+      auto cit = sces.find(sid);
+      bool s_have_ce = cit != sces.end() && !cit->second.groups.empty();
+      auto rit = souts.find(sid);
+      bool s_have_rl = rit != souts.end() && !rit->second.responses.empty();
+      if (s_have_ce) send_members(Serialize(cit->second));
+      if (s_have_rl) send_members(Serialize(rit->second));
+      if (s_have_ce || s_have_rl) hb_last_tx_ns_ = NowNs();
+      // local apply mirrors the wire order: cached groups, then full
+      // responses, then the full responses' cache mutations
+      if (s_have_ce) {
+        for (const auto& g : cit->second.groups) {
+          Response resp;
+          Status st = DecodeCachedGroup(ps->neg, g, &resp);
+          if (!st.ok()) {
+            FailAll(st);
+            return true;
+          }
+          if (ps->member) DispatchSet(*ps, resp);
+        }
+      }
+      if (s_have_rl) {
+        auto ssnap = SnapshotReqs(ps->neg, rit->second);
+        if (ps->member)
+          for (const Response& r : rit->second.responses)
+            DispatchSet(*ps, r);
+        ApplyCacheMutations(ps->neg, rit->second, ssnap);
+      }
+    }
+  }
   // local execution mirrors the wire order exactly: cached groups first,
   // then full responses, then the full responses' cache mutations
   if (have_ce) timeline_.CachedNegotiation();
   for (const auto& g : ce.groups) {
     Response resp;
-    Status st = DecodeCachedGroup(g, &resp);
+    Status st = DecodeCachedGroup(neg0_, g, &resp);
     if (!st.ok()) {
       FailAll(st);
       return true;
     }
     Dispatch(resp);
   }
-  auto snap = SnapshotReqs(out);
+  auto snap = SnapshotReqs(neg0_, out);
   for (const Response& r : out.responses) Dispatch(r);
-  ApplyCacheMutations(out, snap);
+  ApplyCacheMutations(neg0_, out, snap);
   return shutdown;
 }
 
-void Engine::HandleArrivedRequests(const RequestList& list,
+void Engine::HandleArrivedRequests(NegState& ns, const RequestList& list,
                                    ResponseList* out) {
   for (const Request& r : list.requests) {
-    Negotiation& neg = message_table_[r.name];
+    if (ns.set_id != 0 && ns.IndexOf(r.rank) < 0) {
+      // a non-member submission can only reach here through a bug or a
+      // membership race; the submitter's own engine rejects these at
+      // enqueue, so dropping (with a warning) cannot strand a handle
+      LogWarn("op '" + r.name + "' submitted to process set " +
+              std::to_string(ns.set_id) + " by non-member rank " +
+              std::to_string(r.rank) + " — dropped");
+      continue;
+    }
+    Negotiation& neg = ns.message_table[r.name];
     if (neg.ranks.count(r.rank)) {
       Response err;
       err.op = OpType::kError;
       err.names = {r.name};
       err.error_message = "rank " + std::to_string(r.rank) +
                           " submitted op '" + r.name + "' twice";
-      error_ready_.push_back(std::move(err));
+      ns.error_ready.push_back(std::move(err));
       continue;
     }
     if (neg.received.empty()) {
@@ -3271,7 +4306,7 @@ void Engine::HandleArrivedRequests(const RequestList& list,
     neg.ranks.insert(r.rank);
     neg.received.push_back(r);
     timeline_.NegotiateRankReady(r.name, r.rank);
-    if (static_cast<int>(neg.ranks.size()) == size_) {
+    if (static_cast<int>(neg.ranks.size()) == ns.expected()) {
       // validate cross-rank consistency -> clean error instead of hang
       const Request& first = neg.received.front();
       std::string err;
@@ -3300,6 +4335,13 @@ void Engine::HandleArrivedRequests(const RequestList& list,
         } else if (q.op == OpType::kBroadcast && q.dims != first.dims) {
           err = "broadcast shape mismatch: " + DimsStr(first.dims) + " vs " +
                 DimsStr(q.dims);
+        } else if (q.op == OpType::kProcessSet && q.dims != first.dims) {
+          err = "process-set member list mismatch: rank " +
+                std::to_string(first.rank) + " registered " +
+                DimsStr(first.dims) + ", rank " + std::to_string(q.rank) +
+                " registered " + DimsStr(q.dims) +
+                " — add_process_set is collective and must receive the "
+                "same ranks everywhere";
         }
         if (!err.empty()) break;
       }
@@ -3309,41 +4351,51 @@ void Engine::HandleArrivedRequests(const RequestList& list,
         resp.op = OpType::kError;
         resp.names = {first.name};
         resp.error_message = "op '" + first.name + "': " + err;
-        error_ready_.push_back(std::move(resp));
-        message_table_.erase(r.name);
+        ns.error_ready.push_back(std::move(resp));
+        ns.message_table.erase(r.name);
       } else {
-        ready_.push_back(r.name);
+        ns.ready.push_back(r.name);
       }
     }
   }
 }
 
-void Engine::FuseReady(ResponseList* out) {
-  while (!error_ready_.empty()) {
-    out->responses.push_back(std::move(error_ready_.front()));
-    error_ready_.pop_front();
+void Engine::FuseReady(NegState& ns, ResponseList* out) {
+  while (!ns.error_ready.empty()) {
+    out->responses.push_back(std::move(ns.error_ready.front()));
+    ns.error_ready.pop_front();
   }
-  while (!ready_.empty()) {
-    std::string name = std::move(ready_.front());
-    ready_.pop_front();
-    auto it = message_table_.find(name);
-    if (it == message_table_.end()) continue;
+  while (!ns.ready.empty()) {
+    std::string name = std::move(ns.ready.front());
+    ns.ready.pop_front();
+    auto it = ns.message_table.find(name);
+    if (it == ns.message_table.end()) continue;
     const Request& first = it->second.received.front();
     Response resp;
     resp.op = first.op;
     resp.names = {name};
     resp.root_rank = first.root_rank;
     if (first.op == OpType::kAllgather || first.op == OpType::kAlltoall) {
-      // collect every rank's first-dim in rank order
-      std::vector<int64_t> fd(size_, 0);
+      // collect every member's first-dim in SET-rank order
+      std::vector<int64_t> fd(ns.expected(), 0);
       for (const Request& q : it->second.received)
-        fd[q.rank] = q.dims.empty() ? 1 : q.dims[0];
+        fd[ns.IndexOf(q.rank)] = q.dims.empty() ? 1 : q.dims[0];
       resp.first_dims = std::move(fd);
+    }
+    if (first.op == OpType::kProcessSet) {
+      // registration ready on every world rank: assign the id here — in
+      // broadcast-stream order, so every rank registers the same id at
+      // the same position — and ship {id, members...} on first_dims
+      resp.first_dims.push_back(next_pset_id_++);
+      for (int64_t d : first.dims) resp.first_dims.push_back(d);
+      ns.message_table.erase(it);
+      out->responses.push_back(std::move(resp));
+      continue;
     }
     int64_t bytes =
         NumElems(first.dims) * static_cast<int64_t>(DTypeSize(first.dtype));
     DType dtype = first.dtype;
-    message_table_.erase(it);
+    ns.message_table.erase(it);
     // fuse ready same-dtype allreduces up to the threshold, looking ahead
     // PAST non-matching entries (other ops, other dtypes, too-big) instead
     // of stopping at the first mismatch — the reference's skip-list
@@ -3351,11 +4403,11 @@ void Engine::FuseReady(ResponseList* out) {
     // gradient streams fusing into one buffer per dtype.  Skipped entries
     // stay in ready_ (in order) and head later responses this same tick.
     if (resp.op == OpType::kAllreduce) {
-      for (auto itr = ready_.begin();
-           itr != ready_.end() && bytes < fusion_threshold_;) {
-        auto nx = message_table_.find(*itr);
-        if (nx == message_table_.end()) {
-          itr = ready_.erase(itr);
+      for (auto itr = ns.ready.begin();
+           itr != ns.ready.end() && bytes < fusion_threshold_;) {
+        auto nx = ns.message_table.find(*itr);
+        if (nx == ns.message_table.end()) {
+          itr = ns.ready.erase(itr);
           continue;
         }
         const Request& nr = nx->second.received.front();
@@ -3371,8 +4423,8 @@ void Engine::FuseReady(ResponseList* out) {
         }
         bytes += nbytes;
         resp.names.push_back(*itr);
-        message_table_.erase(nx);
-        itr = ready_.erase(itr);
+        ns.message_table.erase(nx);
+        itr = ns.ready.erase(itr);
       }
     }
     out->responses.push_back(std::move(resp));
@@ -3381,11 +4433,12 @@ void Engine::FuseReady(ResponseList* out) {
 
 void Engine::StallCheck() {
   auto now = std::chrono::steady_clock::now();
+  const NegState* cur = nullptr;  // set by the per-state loop below
   auto missing = [&](const std::set<int32_t>& ranks) {
     std::ostringstream os;
     os << "[";
     bool first = true;
-    for (int r = 0; r < size_; r++) {
+    for (int r : cur->members) {
       if (!ranks.count(r)) {
         os << (first ? "" : ",") << r;
         first = false;
@@ -3414,35 +4467,45 @@ void Engine::StallCheck() {
         std::to_string(static_cast<int>(stall_abort_s_)) +
         ") — aborting job";
   };
-  for (auto& [name, neg] : message_table_) {
-    if (neg.received.empty()) continue;
-    double age =
-        std::chrono::duration<double>(now - neg.first_arrival).count();
-    if (!neg.stall_warned && age > stall_warn_s_) {
-      warn("op '" + name + "' has waited " +
-               std::to_string(static_cast<int>(age)) + "s",
-           neg.ranks);
-      neg.stall_warned = true;
+  // one watchdog pass per negotiation state: the global set's plus every
+  // registered set's (a stalled set op names its set)
+  auto check_state = [&](NegState& ns) {
+    cur = &ns;
+    std::string tag =
+        ns.set_id == 0 ? "" : " [set " + std::to_string(ns.set_id) + "]";
+    for (auto& [name, neg] : ns.message_table) {
+      if (neg.received.empty()) continue;
+      double age =
+          std::chrono::duration<double>(now - neg.first_arrival).count();
+      if (!neg.stall_warned && age > stall_warn_s_) {
+        warn("op '" + name + "'" + tag + " has waited " +
+                 std::to_string(static_cast<int>(age)) + "s",
+             neg.ranks);
+        neg.stall_warned = true;
+      }
+      escalate("op '" + name + "'" + tag, age, neg.ranks);
     }
-    escalate("op '" + name + "'", age, neg.ranks);
-  }
-  // partially-claimed cache slots stall the same way a partially-arrived
-  // full negotiation does — same watchdog, same counter
-  for (auto& [slot, claim] : cache_claims_) {
-    if (claim.ranks.empty()) continue;
-    double age =
-        std::chrono::duration<double>(now - claim.first_claim).count();
-    const CacheEntry* e = cache_.At(slot);
-    std::string nm = "cached op '" +
-                     (e ? e->name : std::to_string(slot)) + "'";
-    if (!claim.stall_warned && age > stall_warn_s_) {
-      warn(nm + " has waited " + std::to_string(static_cast<int>(age)) +
-               "s",
-           claim.ranks);
-      claim.stall_warned = true;
+    // partially-claimed cache slots stall the same way a partially-arrived
+    // full negotiation does — same watchdog, same counter
+    for (auto& [slot, claim] : ns.cache_claims) {
+      if (claim.ranks.empty()) continue;
+      double age =
+          std::chrono::duration<double>(now - claim.first_claim).count();
+      const CacheEntry* e = ns.cache.At(slot);
+      std::string nm = "cached op '" +
+                       (e ? e->name : std::to_string(slot)) + "'" + tag;
+      if (!claim.stall_warned && age > stall_warn_s_) {
+        warn(nm + " has waited " + std::to_string(static_cast<int>(age)) +
+                 "s",
+             claim.ranks);
+        claim.stall_warned = true;
+      }
+      escalate(nm, age, claim.ranks);
     }
-    escalate(nm, age, claim.ranks);
-  }
+  };
+  check_state(neg0_);
+  for (auto& [id, ps] : psets_)
+    if (!ps->evicted) check_state(ps->neg);
 }
 
 // ---------------------------------------------------------------------------
@@ -3619,6 +4682,16 @@ bool Engine::WorkerFaultTick(bool shutdown_in_flight) {
 // queue behind data-plane work); everything else goes through the executor
 // queue when pipelined.
 void Engine::Dispatch(const Response& resp) {
+  // process-set registration always applies inline at its broadcast
+  // position (never the executor queue): the mesh build must synchronize
+  // across ranks at the same response-stream point
+  if (resp.op == OpType::kProcessSet) {
+    ApplyProcessSet(resp);
+    return;
+  }
+  if (resp.op != OpType::kError) {
+    set0_collectives_.fetch_add(1, std::memory_order_relaxed);
+  }
   if (pipelined_ && resp.op != OpType::kError) {
     PipelineDispatch(resp);
     return;
@@ -3684,8 +4757,11 @@ void Engine::PipelineDispatch(const Response& resp) {
     }
   }
   if (item.entries.empty()) return;
-  for (const TensorEntry& e : item.entries)
+  for (const TensorEntry& e : item.entries) {
     cycle_bytes_ += static_cast<int64_t>(e.nbytes);
+    set0_payload_bytes_.fetch_add(static_cast<int64_t>(e.nbytes),
+                                  std::memory_order_relaxed);
+  }
   // captured HERE, in response-stream order, not read by the executor at
   // run time: knob adoption happens at the same stream position on every
   // rank, so the per-item algorithm stays globally agreed even when the
@@ -4103,6 +5179,10 @@ void Engine::RunWire(WorkItem& item) {
 // ---------------------------------------------------------------------------
 
 void Engine::Execute(const Response& resp) {
+  if (resp.op == OpType::kProcessSet) {  // size-1 worlds reach here
+    ApplyProcessSet(resp);
+    return;
+  }
   if (resp.op == OpType::kError) {
     for (const std::string& name : resp.names) {
       std::unique_lock<std::mutex> lk(mu_);
@@ -4129,8 +5209,11 @@ void Engine::Execute(const Response& resp) {
     }
   }
   if (entries.empty()) return;
-  for (const TensorEntry& e : entries)
+  for (const TensorEntry& e : entries) {
     cycle_bytes_ += static_cast<int64_t>(e.nbytes);
+    set0_payload_bytes_.fetch_add(static_cast<int64_t>(e.nbytes),
+                                  std::memory_order_relaxed);
+  }
   // inline data plane: this thread owns the links; apply the current cap
   SetLinksActiveStripes(wire_stripes_active_.load(std::memory_order_relaxed));
   for (const std::string& name : resp.names)
@@ -4163,13 +5246,15 @@ void Engine::ExecuteAllreduce(const Response& resp,
   auto act_end = [&]() {
     for (auto& e : entries) timeline_.ActivityEnd(e.req.name);
   };
+  // the global set follows the (autotunable) live algorithm flag; a
+  // process set's choice was fixed at its build from ITS topology
+  bool hier = C().set_id == 0 ? hierarchical_allreduce_.load()
+                              : C().hierarchical;
   auto reduce = [&](const WireRegions& wr, int64_t nelems) {
-    if (hierarchical_allreduce_)
-      return HierarchicalAllreduce(wr, nelems, dtype);
+    if (hier) return HierarchicalAllreduce(wr, nelems, dtype);
     return RingAllreduce(wr, nelems, dtype);
   };
-  const char* act = hierarchical_allreduce_ ? "HIERARCHICAL_ALLREDUCE"
-                                            : "RING_ALLREDUCE";
+  const char* act = hier ? "HIERARCHICAL_ALLREDUCE" : "RING_ALLREDUCE";
   if (entries.size() == 1) {
     // no fusion copy needed: reduce in place on the payload buffer; the
     // staged result still needs the copy-out to a non-aliased user_out
@@ -4180,7 +5265,7 @@ void Engine::ExecuteAllreduce(const Response& resp,
     Status st = ElasticizeWire(reduce(wr, NumElems(e.req.dims)));
     act_end();
     FinishAllreduceEntry(e, st, /*copy_out=*/true);
-    if (!st.ok()) FailAll(st);
+    if (!st.ok()) DataPlaneFail(st);
     return;
   }
   // fusion buffer (persistent across responses): pack the small tail, one
@@ -4191,8 +5276,9 @@ void Engine::ExecuteAllreduce(const Response& resp,
   for (auto& e : entries) total += e.nbytes;
   std::vector<uint8_t> packed;
   size_t pack_total = PlanWireRegions(entries, &packed);
-  if (fusion_buf_.size() < pack_total) fusion_buf_.resize(pack_total);
-  char* fused = fusion_buf_.data();
+  std::vector<char>& fusion = *C().fusion_buf;
+  if (fusion.size() < pack_total) fusion.resize(pack_total);
+  char* fused = fusion.data();
   size_t off = 0;
   act_start("MEMCPY_IN_FUSION_BUFFER");
   for (size_t i = 0; i < entries.size(); i++) {
@@ -4229,7 +5315,7 @@ void Engine::ExecuteAllreduce(const Response& resp,
   // case when a non-aliased user_out exists)
   for (size_t i = 0; i < entries.size(); i++)
     FinishAllreduceEntry(entries[i], st, /*copy_out=*/!packed[i]);
-  if (!st.ok()) FailAll(st);
+  if (!st.ok()) DataPlaneFail(st);
 }
 
 // Ring allreduce over an arbitrary rank subgroup: reduce-scatter then
@@ -4241,8 +5327,23 @@ void Engine::ExecuteAllreduce(const Response& resp,
 // ---------------------------------------------------------------------------
 
 void Engine::SetupShm(const std::string& token) {
-  shm_tx_.resize(size_);
-  shm_rx_.resize(size_);
+  std::vector<int> local_peers;
+  for (int j : local_group_)
+    if (j != rank_) local_peers.push_back(j);
+  if (local_peers.empty()) return;
+  SetupShmGroup(token, local_peers, peers_, shm_tx_, shm_rx_);
+}
+
+// Ring setup over an arbitrary same-host peer group and link mesh: the
+// world mesh and every process set's sub-mesh share this (each with its
+// own token namespace, links, and ring vectors).
+void Engine::SetupShmGroup(const std::string& token,
+                           const std::vector<int>& local_peers,
+                           std::vector<Link>& links,
+                           std::vector<std::unique_ptr<ShmRing>>& stx,
+                           std::vector<std::unique_ptr<ShmRing>>& srx) {
+  stx.resize(static_cast<size_t>(size_));
+  srx.resize(static_cast<size_t>(size_));
   int64_t rb = EnvInt64("HOROVOD_TPU_SHM_RING_BYTES", 8 << 20);
   // clamp: 0 would stall every transfer, a negative value would overflow
   // the segment-length arithmetic into out-of-bounds ring writes
@@ -4253,9 +5354,6 @@ void Engine::SetupShm(const std::string& token) {
     return "/hvdtpu_" + token + "_" + std::to_string(src) + "_" +
            std::to_string(dst);
   };
-  std::vector<int> local_peers;
-  for (int j : local_group_)
-    if (j != rank_) local_peers.push_back(j);
   if (local_peers.empty()) return;
 
   // Four flag passes over all peers (tiny sends never block, so the
@@ -4271,17 +5369,17 @@ void Engine::SetupShm(const std::string& token) {
     Status s = tx->Create(ring_name(rank_, j), ring_bytes);
     created[j] = s.ok() ? 1 : 0;
     if (s.ok()) {
-      shm_tx_[j] = std::move(tx);
+      stx[j] = std::move(tx);
     } else {
       LOG_RANK(Warning, rank_)
           << "shm ring to rank " << j << " unavailable (" << s.message
           << "); pair falls back to TCP";
     }
-    if (!peers_[j].SendAll(&created[j], 1).ok()) created[j] = 0;
+    if (!links[j].SendAll(&created[j], 1).ok()) created[j] = 0;
   }
   for (int j : local_peers) {
     uint8_t f = 0;
-    if (!peers_[j].RecvAll(&f, 1).ok()) f = 0;
+    if (!links[j].RecvAll(&f, 1).ok()) f = 0;
     peer_created[j] = f;
   }
   for (int j : local_peers) {
@@ -4289,23 +5387,23 @@ void Engine::SetupShm(const std::string& token) {
     if (peer_created[j]) {
       auto rx = std::make_unique<ShmRing>();
       if (rx->Attach(ring_name(j, rank_)).ok()) {
-        shm_rx_[j] = std::move(rx);
+        srx[j] = std::move(rx);
         f = 1;
       }
     }
     attached[j] = f;
-    if (!peers_[j].SendAll(&f, 1).ok()) attached[j] = 0;
+    if (!links[j].SendAll(&f, 1).ok()) attached[j] = 0;
   }
   int active = 0;
   for (int j : local_peers) {
     uint8_t f = 0;  // peer's attached-flag for my ring
-    if (!peers_[j].RecvAll(&f, 1).ok()) f = 0;
-    if (!f) shm_tx_[j].reset();  // peer can't read it: direction is TCP
-    if (!attached[j]) shm_rx_[j].reset();
+    if (!links[j].RecvAll(&f, 1).ok()) f = 0;
+    if (!f) stx[j].reset();  // peer can't read it: direction is TCP
+    if (!attached[j]) srx[j].reset();
     // both sides hold the mapping now (or the ring was dropped): drop the
     // filesystem name so a SIGKILL'd job cannot leak /dev/shm segments
-    if (shm_tx_[j]) shm_tx_[j]->Unlink();
-    active += shm_tx_[j] != nullptr;
+    if (stx[j]) stx[j]->Unlink();
+    active += stx[j] != nullptr;
   }
   LOG_RANK(Debug, rank_) << "shm data plane: " << active << "/"
                          << local_peers.size() << " same-host tx rings ("
@@ -4401,8 +5499,11 @@ void SendBlockedWait(Backoff& bo, Link& tx, size_t want, bool fast_rx) {
 
 Status Engine::PeerSendAll(int r, const void* data, size_t n) {
   FaultInjector::Get().OnLink(r);
-  ShmRing* tx = r < static_cast<int>(shm_tx_.size()) ? shm_tx_[r].get()
-                                                     : nullptr;
+  Comm& c = C();
+  ShmRing* tx = r < static_cast<int>(c.shm_tx->size())
+                    ? (*c.shm_tx)[r].get()
+                    : nullptr;
+  Link& link = (*c.links)[r];
   const char* p = static_cast<const char*>(data);
   auto last_prog = std::chrono::steady_clock::now();
   Backoff bo;
@@ -4411,7 +5512,7 @@ Status Engine::PeerSendAll(int r, const void* data, size_t n) {
     if (tx) {
       k = tx->TryPush(p, n);
     } else {
-      int kk = peers_[r].SendSome(p, n);
+      int kk = link.SendSome(p, n);
       if (kk < 0)
         return Status::Error("send to rank " + std::to_string(r) +
                              " failed");
@@ -4425,10 +5526,11 @@ Status Engine::PeerSendAll(int r, const void* data, size_t n) {
       continue;
     }
     if (Aborting()) return AbortedStatus();
+    if (tx && tx->Poisoned()) return ShmPoisonStatus(r);
     if (tx)
       bo.Wait();
     else
-      SendBlockedWait(bo, peers_[r], n, /*fast_rx=*/false);
+      SendBlockedWait(bo, link, n, /*fast_rx=*/false);
     if (Stalled(last_prog, Timeouts().oneway))
       return PeerDeadStatus("peer send",
                             "rank " + std::to_string(r),
@@ -4439,8 +5541,11 @@ Status Engine::PeerSendAll(int r, const void* data, size_t n) {
 
 Status Engine::PeerRecvAll(int r, void* data, size_t n) {
   FaultInjector::Get().OnLink(r);
-  ShmRing* rx = r < static_cast<int>(shm_rx_.size()) ? shm_rx_[r].get()
-                                                     : nullptr;
+  Comm& c = C();
+  ShmRing* rx = r < static_cast<int>(c.shm_rx->size())
+                    ? (*c.shm_rx)[r].get()
+                    : nullptr;
+  Link& link = (*c.links)[r];
   char* p = static_cast<char*>(data);
   auto last_prog = std::chrono::steady_clock::now();
   Backoff bo;
@@ -4449,7 +5554,7 @@ Status Engine::PeerRecvAll(int r, void* data, size_t n) {
     if (rx) {
       k = rx->TryPop(p, n);
     } else {
-      int kk = peers_[r].RecvSome(p, n);
+      int kk = link.RecvSome(p, n);
       if (kk < 0)
         return Status::Error("recv from rank " + std::to_string(r) +
                              " failed or closed");
@@ -4463,13 +5568,14 @@ Status Engine::PeerRecvAll(int r, void* data, size_t n) {
       continue;
     }
     if (Aborting()) return AbortedStatus();
+    if (rx && rx->Poisoned()) return ShmPoisonStatus(r);
     if (!rx && bo.idle >= 64) {
       // recv-blocked TCP parks in poll(POLLIN) on the cursor stripe;
       // bounded so the abort latch and the no-progress clock are
       // re-checked promptly
       bo.idle++;
       struct pollfd pf;
-      pf.fd = peers_[r].recv_fd();
+      pf.fd = link.recv_fd();
       pf.events = POLLIN;
       pf.revents = 0;
       ::poll(&pf, 1, 50);
@@ -4488,12 +5594,16 @@ Status Engine::PeerSendRecv(int r_send, const void* send_buf, size_t send_n,
                             int r_recv, void* recv_buf, size_t recv_n) {
   FaultInjector::Get().OnLink(r_send);
   if (r_recv != r_send) FaultInjector::Get().OnLink(r_recv);
-  ShmRing* tx = r_send < static_cast<int>(shm_tx_.size())
-                    ? shm_tx_[r_send].get()
+  Comm& c = C();
+  ShmRing* tx = r_send < static_cast<int>(c.shm_tx->size())
+                    ? (*c.shm_tx)[r_send].get()
                     : nullptr;
-  ShmRing* rx = r_recv < static_cast<int>(shm_rx_.size())
-                    ? shm_rx_[r_recv].get()
+  ShmRing* rx = r_recv < static_cast<int>(c.shm_rx->size())
+                    ? (*c.shm_rx)[r_recv].get()
                     : nullptr;
+  Link& stx_link = (*c.links)[r_send];
+  Link& srx_link = (*c.links)[r_recv];
+  int64_t* idle_sink = c.ring_idle_sink;
   const char* sp = static_cast<const char*>(send_buf);
   char* rp = static_cast<char*>(recv_buf);
   size_t sleft = send_n, rleft = recv_n;
@@ -4503,7 +5613,7 @@ Status Engine::PeerSendRecv(int r_send, const void* send_buf, size_t send_n,
   // exactly when the ring idle fraction matters most
   auto flush_idle = [&] {
     if (idle_since) {
-      *ring_idle_sink_ += NowNs() - idle_since;
+      *idle_sink += NowNs() - idle_since;
       idle_since = 0;
     }
   };
@@ -4517,7 +5627,7 @@ Status Engine::PeerSendRecv(int r_send, const void* send_buf, size_t send_n,
         sleft -= k;
         prog |= k > 0;
       } else {
-        int k = peers_[r_send].SendSome(sp, sleft);
+        int k = stx_link.SendSome(sp, sleft);
         if (k < 0) {
           flush_idle();
           return Status::Error("send to rank " +
@@ -4535,7 +5645,7 @@ Status Engine::PeerSendRecv(int r_send, const void* send_buf, size_t send_n,
         rleft -= k;
         prog |= k > 0;
       } else {
-        int k = peers_[r_recv].RecvSome(rp, rleft);
+        int k = srx_link.RecvSome(rp, rleft);
         if (k < 0) {
           flush_idle();
           return Status::Error("recv from rank " +
@@ -4553,24 +5663,28 @@ Status Engine::PeerSendRecv(int r_send, const void* send_buf, size_t send_n,
       last_prog = std::chrono::steady_clock::now();
       continue;
     }
-    if (ring_idle_sink_ && !idle_since) idle_since = NowNs();
+    if (idle_sink && !idle_since) idle_since = NowNs();
     if (Aborting()) {
       flush_idle();
       return AbortedStatus();
     }
+    if ((tx && tx->Poisoned()) || (rx && rx->Poisoned())) {
+      flush_idle();
+      return ShmPoisonStatus(tx && tx->Poisoned() ? r_send : r_recv);
+    }
     if (!tx && !rx && sleft > 0 && rleft > 0 && bo.idle >= 8 &&
-        peers_[r_send].PaceDelaySeconds(sleft) <= 0.0) {
+        stx_link.PaceDelaySeconds(sleft) <= 0.0) {
       // pure TCP with BOTH directions pending and tokens available: park
       // on both cursor-stripe fds at once (the dual-fd poll the removed
       // Socket::SendRecv had) so either direction's readiness wakes the
       // loop immediately; 50 ms bounds the abort/no-progress re-checks
       bo.idle++;
       struct pollfd pf[2];
-      pf[0] = {peers_[r_send].send_fd(), POLLOUT, 0};
-      pf[1] = {peers_[r_recv].recv_fd(), POLLIN, 0};
+      pf[0] = {stx_link.send_fd(), POLLOUT, 0};
+      pf[1] = {srx_link.recv_fd(), POLLIN, 0};
       ::poll(pf, 2, 50);
     } else if (!tx && sleft > 0) {
-      SendBlockedWait(bo, peers_[r_send], sleft, /*fast_rx=*/rleft > 0);
+      SendBlockedWait(bo, stx_link, sleft, /*fast_rx=*/rleft > 0);
     } else if (!rx && rleft > 0 && bo.idle >= 64) {
       // recv is the blocker and it is TCP: park in poll(POLLIN) on the
       // cursor stripe instead of the sleep ladder (short while a full shm
@@ -4578,7 +5692,7 @@ Status Engine::PeerSendRecv(int r_send, const void* send_buf, size_t send_n,
       // and no-progress re-check cadence
       bo.idle++;
       struct pollfd pf;
-      pf.fd = peers_[r_recv].recv_fd();
+      pf.fd = srx_link.recv_fd();
       pf.events = POLLIN;
       pf.revents = 0;
       ::poll(&pf, 1, (tx && sleft > 0) ? 1 : 50);
@@ -4605,26 +5719,30 @@ Status Engine::PeerSendRecvReduce(int r_send, const void* send_buf,
                                   size_t send_n, int r_recv, char* dst,
                                   int64_t nelems, DType dtype) {
   size_t esize = DTypeSize(dtype);
-  ShmRing* rx = r_recv < static_cast<int>(shm_rx_.size())
-                    ? shm_rx_[r_recv].get()
+  Comm& c = C();
+  std::vector<char>& scratch_vec = *c.ring_scratch;
+  ShmRing* rx = r_recv < static_cast<int>(c.shm_rx->size())
+                    ? (*c.shm_rx)[r_recv].get()
                     : nullptr;
   if (!rx) {
     size_t rn = static_cast<size_t>(nelems) * esize;
-    if (ring_scratch_.size() < rn) ring_scratch_.resize(rn);
+    if (scratch_vec.size() < rn) scratch_vec.resize(rn);
     Status st = PeerSendRecv(r_send, send_buf, send_n, r_recv,
-                             ring_scratch_.data(), rn);
+                             scratch_vec.data(), rn);
     if (!st.ok()) return st;
-    Accumulate(dst, ring_scratch_.data(), nelems, dtype);
+    Accumulate(dst, scratch_vec.data(), nelems, dtype);
     return Status::OK();
   }
   FaultInjector::Get().OnLink(r_send);
   if (r_recv != r_send) FaultInjector::Get().OnLink(r_recv);
-  ShmRing* tx = r_send < static_cast<int>(shm_tx_.size())
-                    ? shm_tx_[r_send].get()
+  ShmRing* tx = r_send < static_cast<int>(c.shm_tx->size())
+                    ? (*c.shm_tx)[r_send].get()
                     : nullptr;
+  Link& stx_link = (*c.links)[r_send];
+  int64_t* idle_sink = c.ring_idle_sink;
   constexpr size_t kBite = 1 << 20;
-  if (ring_scratch_.size() < kBite + 8) ring_scratch_.resize(kBite + 8);
-  char* scratch = ring_scratch_.data();
+  if (scratch_vec.size() < kBite + 8) scratch_vec.resize(kBite + 8);
+  char* scratch = scratch_vec.data();
   const char* sp = static_cast<const char*>(send_buf);
   size_t sleft = send_n;
   size_t rleft = static_cast<size_t>(nelems) * esize;
@@ -4634,7 +5752,7 @@ Status Engine::PeerSendRecvReduce(int r_send, const void* send_buf,
   int64_t idle_since = 0;
   auto flush_idle = [&] {
     if (idle_since) {
-      *ring_idle_sink_ += NowNs() - idle_since;
+      *idle_sink += NowNs() - idle_since;
       idle_since = 0;
     }
   };
@@ -4648,7 +5766,7 @@ Status Engine::PeerSendRecvReduce(int r_send, const void* send_buf,
         sleft -= k;
         prog |= k > 0;
       } else {
-        int k = peers_[r_send].SendSome(sp, sleft);
+        int k = stx_link.SendSome(sp, sleft);
         if (k < 0) {
           flush_idle();
           return Status::Error("send to rank " +
@@ -4679,13 +5797,17 @@ Status Engine::PeerSendRecvReduce(int r_send, const void* send_buf,
       last_prog = std::chrono::steady_clock::now();
       continue;
     }
-    if (ring_idle_sink_ && !idle_since) idle_since = NowNs();
+    if (idle_sink && !idle_since) idle_since = NowNs();
     if (Aborting()) {
       flush_idle();
       return AbortedStatus();
     }
+    if ((tx && tx->Poisoned()) || rx->Poisoned()) {
+      flush_idle();
+      return ShmPoisonStatus(tx && tx->Poisoned() ? r_send : r_recv);
+    }
     if (!tx && sleft > 0)
-      SendBlockedWait(bo, peers_[r_send], sleft, /*fast_rx=*/rleft > 0);
+      SendBlockedWait(bo, stx_link, sleft, /*fast_rx=*/rleft > 0);
     else
       bo.Wait();
     if (Stalled(last_prog, Timeouts().duplex)) {
@@ -4732,7 +5854,7 @@ Status Engine::RingAllreduceGroup(const WireRegions& wr, int64_t nelems,
   auto chunk_lo = [&](int c) { return nelems * c / m; };
 
   int64_t idle = 0, t0 = NowNs();
-  ring_idle_sink_ = &idle;
+  C().ring_idle_sink = &idle;
   Status result;
   for (int step = 0; step < m - 1 && result.ok(); step++) {
     int send_c = (me - step + 2 * m) % m;
@@ -4756,7 +5878,7 @@ Status Engine::RingAllreduceGroup(const WireRegions& wr, int64_t nelems,
     if (!st.ok())
       result = Status::Error("ring allreduce failed: " + st.message);
   }
-  ring_idle_sink_ = nullptr;
+  C().ring_idle_sink = nullptr;
   ring_wire_ns_.fetch_add(NowNs() - t0, std::memory_order_relaxed);
   ring_idle_ns_.fetch_add(idle, std::memory_order_relaxed);
   return result;
@@ -4842,14 +5964,16 @@ Status Engine::RingAllreduceGroupSegmented(const WireRegions& wr,
             std::max<int64_t>(1, seg_bytes / static_cast<int64_t>(esize))};
   const int last_step = 2 * m - 3;
 
-  ShmRing* tx = right < static_cast<int>(shm_tx_.size())
-                    ? shm_tx_[right].get()
+  Comm& c = C();
+  ShmRing* tx = right < static_cast<int>(c.shm_tx->size())
+                    ? (*c.shm_tx)[right].get()
                     : nullptr;
-  ShmRing* rx = left < static_cast<int>(shm_rx_.size())
-                    ? shm_rx_[left].get()
+  ShmRing* rx = left < static_cast<int>(c.shm_rx->size())
+                    ? (*c.shm_rx)[left].get()
                     : nullptr;
-  Link* txs = tx ? nullptr : &peers_[right];
-  Link* rxs = rx ? nullptr : &peers_[left];
+  Link* txs = tx ? nullptr : &(*c.links)[right];
+  Link* rxs = rx ? nullptr : &(*c.links)[left];
+  std::vector<char>& scratch_vec = *c.ring_scratch;
   // single-region fast path pointer (the overwhelmingly common case);
   // multi-region (scatter-gather) ranges go through wr.ForRange/Iovecs
   char* buf = wr.base();
@@ -4869,7 +5993,7 @@ Status Engine::RingAllreduceGroupSegmented(const WireRegions& wr,
   int64_t max_chunk = (nelems + m - 1) / m;
   size_t seg_cap = static_cast<size_t>(
                        std::min<int64_t>(g.seg_elems, max_chunk)) * esize;
-  if (ring_scratch_.size() < seg_cap) ring_scratch_.resize(seg_cap);
+  if (scratch_vec.size() < seg_cap) scratch_vec.resize(seg_cap);
 
   // cursors: both sides walk units in the same global order, so the
   // dependency test is one (step, segment) comparison
@@ -5009,7 +6133,7 @@ Status Engine::RingAllreduceGroupSegmented(const WireRegions& wr,
         if (reduce_phase || !sg) {
           // reduce-scatter stages into contiguous scratch (then one
           // region-aware accumulate); packed allgather lands in place
-          char* dst = reduce_phase ? ring_scratch_.data() + r_off
+          char* dst = reduce_phase ? scratch_vec.data() + r_off
                                    : buf + dst_b;
           if (rx) {
             k = rx->TryPop(dst, want);
@@ -5057,7 +6181,7 @@ Status Engine::RingAllreduceGroupSegmented(const WireRegions& wr,
               // while this runs, the left neighbor keeps filling the
               // transport with segment s+1 — the overlap this loop buys
               timeline_.RingSegStart("ring/accum", "SEG_ACCUM");
-              AccumulateRegions(wr, lo, ring_scratch_.data(), hi - lo,
+              AccumulateRegions(wr, lo, scratch_vec.data(), hi - lo,
                                 dtype);
               timeline_.RingSegEnd("ring/accum");
             }
@@ -5084,6 +6208,10 @@ Status Engine::RingAllreduceGroupSegmented(const WireRegions& wr,
     if (!idle_since) idle_since = NowNs();
     if (Aborting()) {
       err = AbortedStatus();
+      break;
+    }
+    if ((tx && tx->Poisoned()) || (rx && rx->Poisoned())) {
+      err = ShmPoisonStatus(tx && tx->Poisoned() ? right : left);
       break;
     }
     if (txs && send_avail > 0)
@@ -5136,14 +6264,15 @@ Status Engine::RingAllreduceGroupSegmented(const WireRegions& wr,
 // drops from 2(n-1)/n per rank to 2(h-1)/h per host.
 Status Engine::HierarchicalAllreduce(const WireRegions& wr, int64_t nelems,
                                      DType dtype) {
-  Status st = RingAllreduceGroup(wr, nelems, dtype, local_group_);
+  Comm& c = C();
+  Status st = RingAllreduceGroup(wr, nelems, dtype, c.local_group);
   if (!st.ok()) return st;
-  int local_root = local_group_.front();
-  if (rank_ == local_root && cross_group_.size() > 1) {
-    st = RingAllreduceGroup(wr, nelems, dtype, cross_group_);
+  int local_root = c.local_group.front();
+  if (rank_ == local_root && c.cross_group.size() > 1) {
+    st = RingAllreduceGroup(wr, nelems, dtype, c.cross_group);
     if (!st.ok()) return st;
   }
-  return TreeBroadcastRegions(wr, local_root, local_group_);
+  return TreeBroadcastRegions(wr, local_root, c.local_group);
 }
 
 // Variable-sized ring allgather over a subgroup: member block b travels
@@ -5207,14 +6336,15 @@ Status Engine::RingAllgatherGroupSegmented(
   FaultInjector::Get().OnLink(right);
   if (left != right) FaultInjector::Get().OnLink(left);
 
-  ShmRing* tx = right < static_cast<int>(shm_tx_.size())
-                    ? shm_tx_[right].get()
+  Comm& c = C();
+  ShmRing* tx = right < static_cast<int>(c.shm_tx->size())
+                    ? (*c.shm_tx)[right].get()
                     : nullptr;
-  ShmRing* rx = left < static_cast<int>(shm_rx_.size())
-                    ? shm_rx_[left].get()
+  ShmRing* rx = left < static_cast<int>(c.shm_rx->size())
+                    ? (*c.shm_rx)[left].get()
                     : nullptr;
-  Link* txs = tx ? nullptr : &peers_[right];
-  Link* rxs = rx ? nullptr : &peers_[left];
+  Link* txs = tx ? nullptr : &(*c.links)[right];
+  Link* rxs = rx ? nullptr : &(*c.links)[left];
 
   // block travelling on step t: I send (me - t), receive (me - t - 1) —
   // which is precisely my step-t+1 send, so recv progress gates sends
@@ -5371,6 +6501,10 @@ Status Engine::RingAllgatherGroupSegmented(
       err = AbortedStatus();
       break;
     }
+    if ((tx && tx->Poisoned()) || (rx && rx->Poisoned())) {
+      err = ShmPoisonStatus(tx && tx->Poisoned() ? right : left);
+      break;
+    }
     if (txs && send_avail > 0)
       SendBlockedWait(bo, *txs, send_avail, /*fast_rx=*/rt <= last_step);
     else if (rxs && rt <= last_step && bo.idle >= 64) {
@@ -5412,76 +6546,84 @@ Status Engine::RingAllgatherGroupSegmented(
 Status Engine::HierarchicalAllgather(const Response& resp, TensorEntry& entry,
                                      int64_t stride,
                                      std::vector<char>* out) {
+  Comm& c = C();
   size_t esize = DTypeSize(entry.req.dtype);
+  // first_dims is SET-rank-indexed; groups carry global ranks
   auto rank_bytes = [&](int r) {
-    return static_cast<size_t>(resp.first_dims[r] * stride) * esize;
+    return static_cast<size_t>(resp.first_dims[c.IndexOf(r)] * stride) *
+           esize;
   };
   // stage 1: local ring allgather -> local concat (member order)
-  int m = static_cast<int>(local_group_.size());
+  int m = static_cast<int>(c.local_group.size());
   std::vector<size_t> lbytes(m);
   size_t loff = 0, lme = 0;
   for (int i = 0; i < m; i++) {
-    lbytes[i] = rank_bytes(local_group_[i]);
-    if (local_group_[i] == rank_) lme = loff;
+    lbytes[i] = rank_bytes(c.local_group[i]);
+    if (c.local_group[i] == rank_) lme = loff;
     loff += lbytes[i];
   }
   // group blocks (concat of member rows) laid out in host-group order
-  std::vector<size_t> gbytes(host_groups_.size());
-  std::vector<size_t> goff(host_groups_.size() + 1, 0);
+  std::vector<size_t> gbytes(c.host_groups.size());
+  std::vector<size_t> goff(c.host_groups.size() + 1, 0);
   size_t my_goff = 0;
-  for (size_t g = 0; g < host_groups_.size(); g++) {
+  for (size_t g = 0; g < c.host_groups.size(); g++) {
     size_t b = 0;
-    for (int r : host_groups_[g]) b += rank_bytes(r);
+    for (int r : c.host_groups[g]) b += rank_bytes(r);
     gbytes[g] = b;
     goff[g + 1] = goff[g] + b;
-    if (host_groups_[g].front() == local_group_.front()) my_goff = goff[g];
+    if (c.host_groups[g].front() == c.local_group.front()) my_goff = goff[g];
   }
   std::vector<char> gathered(goff.back());
   std::memcpy(gathered.data() + my_goff + lme, entry.data.data(),
               entry.data.size());
   Status st = RingAllgatherGroup(
-      local_group_, lbytes, gathered.data() + my_goff);
+      c.local_group, lbytes, gathered.data() + my_goff);
   if (!st.ok()) return st;
   // stage 2: local roots exchange host blocks
-  if (rank_ == local_group_.front() && cross_group_.size() > 1) {
-    st = RingAllgatherGroup(cross_group_, gbytes, gathered.data());
+  if (rank_ == c.local_group.front() && c.cross_group.size() > 1) {
+    st = RingAllgatherGroup(c.cross_group, gbytes, gathered.data());
     if (!st.ok()) return st;
   }
   // stage 3: root broadcasts the full concat within the host
   st = TreeBroadcastGroup(gathered.data(),
                           static_cast<int64_t>(gathered.size()),
-                          local_group_.front(), local_group_);
+                          c.local_group.front(), c.local_group);
   if (!st.ok()) return st;
-  // reorder host-grouped concat into global rank order
-  std::vector<size_t> global_off(size_ + 1, 0);
-  for (int r = 0; r < size_; r++)
-    global_off[r + 1] = global_off[r] + rank_bytes(r);
-  out->assign(global_off[size_], 0);
+  // reorder host-grouped concat into member (set-rank) order
+  std::vector<size_t> global_off(c.size + 1, 0);
+  for (int i = 0; i < c.size; i++)
+    global_off[i + 1] = global_off[i] + rank_bytes(c.members[i]);
+  out->assign(global_off[c.size], 0);
   size_t src = 0;
-  for (const auto& g : host_groups_)
+  for (const auto& g : c.host_groups)
     for (int r : g) {
-      std::memcpy(out->data() + global_off[r], gathered.data() + src,
-                  rank_bytes(r));
+      std::memcpy(out->data() + global_off[c.IndexOf(r)],
+                  gathered.data() + src, rank_bytes(r));
       src += rank_bytes(r);
     }
   return Status::OK();
 }
 
 void Engine::ExecuteAllgather(const Response& resp, TensorEntry& entry) {
+  Comm& c = C();
   DType dtype = entry.req.dtype;
   size_t esize = DTypeSize(dtype);
   // row stride = product of dims[1:]
   int64_t stride = 1;
   for (size_t i = 1; i < entry.req.dims.size(); i++)
     stride *= entry.req.dims[i];
-  std::vector<int64_t> offsets(size_ + 1, 0);
-  for (int r = 0; r < size_; r++)
+  // first_dims and the concat layout are SET-rank-indexed (identity for
+  // the global set)
+  std::vector<int64_t> offsets(c.size + 1, 0);
+  for (int r = 0; r < c.size; r++)
     offsets[r + 1] = offsets[r] + resp.first_dims[r] * stride;
   std::vector<int64_t> out_dims = entry.req.dims;
   if (out_dims.empty()) out_dims = {1};
-  out_dims[0] = offsets[size_] / (stride ? stride : 1);
+  out_dims[0] = offsets[c.size] / (stride ? stride : 1);
 
-  if (hierarchical_allgather_) {
+  bool hier_ag =
+      c.set_id == 0 ? hierarchical_allgather_ : c.hierarchical_allgather;
+  if (hier_ag) {
     std::vector<char> out;
     Status st = ElasticizeWire(HierarchicalAllgather(resp, entry, stride, &out));
     if (!st.ok()) {
@@ -5493,16 +6635,17 @@ void Engine::ExecuteAllgather(const Response& resp, TensorEntry& entry) {
     return;
   }
 
-  std::vector<char> out = PoolGet(static_cast<size_t>(offsets[size_]) * esize);
-  std::memcpy(out.data() + offsets[rank_] * esize, entry.data.data(),
+  std::vector<char> out =
+      PoolGet(static_cast<size_t>(offsets[c.size]) * esize);
+  std::memcpy(out.data() + offsets[c.rank] * esize, entry.data.data(),
               entry.data.size());
   PoolPut(std::move(entry.data));
-  // flat variable-sized ring: block b travels the ring; after n-1 steps
-  // every rank holds all blocks at the right offsets
-  std::vector<size_t> bytes(size_);
-  for (int r = 0; r < size_; r++)
+  // flat variable-sized ring: block b travels the ring; after m-1 steps
+  // every member holds all blocks at the right offsets
+  std::vector<size_t> bytes(c.size);
+  for (int r = 0; r < c.size; r++)
     bytes[r] = static_cast<size_t>(resp.first_dims[r] * stride) * esize;
-  Status st = ElasticizeWire(RingAllgatherGroup(all_ranks_, bytes, out.data()));
+  Status st = ElasticizeWire(RingAllgatherGroup(c.members, bytes, out.data()));
   if (!st.ok()) {
     MarkDone(entry.handle, st, {}, {});
     DataPlaneFail(st);
@@ -5549,9 +6692,20 @@ Status Engine::TreeBroadcastGroup(char* buf, int64_t nbytes, int root,
 }
 
 void Engine::ExecuteBroadcast(const Response& resp, TensorEntry& entry) {
+  Comm& c = C();
+  // root_rank is a SET rank (identity for the global set): translate to
+  // the member's global rank for the tree walk
+  if (resp.root_rank < 0 || resp.root_rank >= c.size) {
+    Status err = Status::Error(
+        "broadcast root_rank " + std::to_string(resp.root_rank) +
+        " out of range for communicator of size " + std::to_string(c.size));
+    MarkDone(entry.handle, err, {}, {});
+    DataPlaneFail(err);
+    return;
+  }
   Status st = ElasticizeWire(TreeBroadcast(entry.payload(),
                                            static_cast<int64_t>(entry.nbytes),
-                                           resp.root_rank));
+                                           c.members[resp.root_rank]));
   if (!st.ok()) {
     Status err = Status::Error("broadcast failed: " + st.message);
     MarkDone(entry.handle, err, {}, {});
@@ -5579,13 +6733,15 @@ Status Engine::AlltoallWindowed(const char* send, int64_t blk,
                                 const std::vector<int64_t>& recv_rows,
                                 int64_t stride, size_t esize, char* out,
                                 int64_t seg_bytes) {
+  Comm& c = C();
   struct StepState {
-    int to = 0, from = 0;
+    int to = 0, from = 0;  // global peer ranks (transport targets)
+    int ti = 0, fi = 0;    // their SET indices (buffer layout)
     int64_t sleft = 0, soff = 0;  // send block remaining / cursor
     int64_t rleft = 0, roff = 0;  // recv block remaining / cursor
     bool done() const { return sleft == 0 && rleft == 0; }
   };
-  const int last = size_ - 1;
+  const int last = c.size - 1;
   // parsed once per process (hot data-plane path); per-rank divergence
   // would be benign — the oldest incomplete step is always in-window on
   // both endpoints, so mismatched depths cannot deadlock, only deepen
@@ -5600,10 +6756,12 @@ Status Engine::AlltoallWindowed(const char* send, int64_t blk,
   auto admit = [&] {
     while (static_cast<int64_t>(win.size()) < wmax && next_step <= last) {
       StepState ss;
-      ss.to = (rank_ + next_step) % size_;
-      ss.from = (rank_ - next_step + size_) % size_;
+      ss.ti = (c.rank + next_step) % c.size;
+      ss.fi = (c.rank - next_step + c.size) % c.size;
+      ss.to = c.members[ss.ti];
+      ss.from = c.members[ss.fi];
       ss.sleft = blk;
-      ss.rleft = recv_rows[ss.from] * stride * static_cast<int64_t>(esize);
+      ss.rleft = recv_rows[ss.fi] * stride * static_cast<int64_t>(esize);
       FaultInjector::Get().OnLink(ss.to);
       if (ss.from != ss.to) FaultInjector::Get().OnLink(ss.from);
       win.push_back(ss);
@@ -5618,16 +6776,16 @@ Status Engine::AlltoallWindowed(const char* send, int64_t blk,
     bool prog = false;
     for (auto& ss : win) {
       if (ss.sleft > 0) {
-        ShmRing* tx = ss.to < static_cast<int>(shm_tx_.size())
-                          ? shm_tx_[ss.to].get()
+        ShmRing* tx = ss.to < static_cast<int>(c.shm_tx->size())
+                          ? (*c.shm_tx)[ss.to].get()
                           : nullptr;
         int64_t nib = ss.sleft < seg_bytes ? ss.sleft : seg_bytes;
-        const char* p = send + ss.to * blk + ss.soff;
+        const char* p = send + ss.ti * blk + ss.soff;
         size_t k;
         if (tx) {
           k = tx->TryPush(p, static_cast<size_t>(nib));
         } else {
-          int kk = peers_[ss.to].SendSome(p, static_cast<size_t>(nib));
+          int kk = (*c.links)[ss.to].SendSome(p, static_cast<size_t>(nib));
           if (kk < 0)
             return Status::Error("windowed alltoall send to rank " +
                                  std::to_string(ss.to) + " failed");
@@ -5640,17 +6798,17 @@ Status Engine::AlltoallWindowed(const char* send, int64_t blk,
         }
       }
       if (ss.rleft > 0) {
-        ShmRing* rx = ss.from < static_cast<int>(shm_rx_.size())
-                          ? shm_rx_[ss.from].get()
+        ShmRing* rx = ss.from < static_cast<int>(c.shm_rx->size())
+                          ? (*c.shm_rx)[ss.from].get()
                           : nullptr;
         int64_t nib = ss.rleft < seg_bytes ? ss.rleft : seg_bytes;
-        char* p = out + recv_off[ss.from] * static_cast<int64_t>(esize) +
+        char* p = out + recv_off[ss.fi] * static_cast<int64_t>(esize) +
                   ss.roff;
         size_t k;
         if (rx) {
           k = rx->TryPop(p, static_cast<size_t>(nib));
         } else {
-          int kk = peers_[ss.from].RecvSome(p, static_cast<size_t>(nib));
+          int kk = (*c.links)[ss.from].RecvSome(p, static_cast<size_t>(nib));
           if (kk < 0)
             return Status::Error("windowed alltoall recv from rank " +
                                  std::to_string(ss.from) +
@@ -5676,6 +6834,16 @@ Status Engine::AlltoallWindowed(const char* send, int64_t blk,
       continue;
     }
     if (Aborting()) return AbortedStatus();
+    for (const auto& ss : win) {
+      ShmRing* tx = ss.to < static_cast<int>(c.shm_tx->size())
+                        ? (*c.shm_tx)[ss.to].get()
+                        : nullptr;
+      ShmRing* rx = ss.from < static_cast<int>(c.shm_rx->size())
+                        ? (*c.shm_rx)[ss.from].get()
+                        : nullptr;
+      if ((tx && tx->Poisoned()) || (rx && rx->Poisoned()))
+        return ShmPoisonStatus(tx && tx->Poisoned() ? ss.to : ss.from);
+    }
     // deterministic wait like the other TCP loops: when a TCP send is
     // among the blockers, sleep the exactly-known pace refill or park in
     // poll(POLLOUT) on its cursor stripe (capped short — other window
@@ -5685,9 +6853,9 @@ Status Engine::AlltoallWindowed(const char* send, int64_t blk,
       int64_t tx_want = 0;
       for (const auto& ss : win) {
         if (ss.sleft > 0 &&
-            !(ss.to < static_cast<int>(shm_tx_.size()) &&
-              shm_tx_[ss.to])) {
-          blocked_tx = &peers_[ss.to];
+            !(ss.to < static_cast<int>(c.shm_tx->size()) &&
+              (*c.shm_tx)[ss.to])) {
+          blocked_tx = &(*c.links)[ss.to];
           tx_want = ss.sleft < seg_bytes ? ss.sleft : seg_bytes;
           break;
         }
@@ -5715,28 +6883,30 @@ Status Engine::AlltoallWindowed(const char* send, int64_t blk,
 // Pairwise-exchange alltoall: rank i sends its j-th row-block to rank j.
 // Requires dim0 divisible by size (validated at enqueue in the frontend).
 void Engine::ExecuteAlltoall(const Response& resp, TensorEntry& entry) {
+  Comm& c = C();
   DType dtype = entry.req.dtype;
   size_t esize = DTypeSize(dtype);
   int64_t stride = 1;
   for (size_t i = 1; i < entry.req.dims.size(); i++)
     stride *= entry.req.dims[i];
-  // rows I contribute to each destination
-  int64_t my_rows = (entry.req.dims.empty() ? 1 : entry.req.dims[0]) / size_;
+  // rows I contribute to each destination (layout is SET-rank-indexed)
+  int64_t my_rows =
+      (entry.req.dims.empty() ? 1 : entry.req.dims[0]) / c.size;
   // rows I receive from each source = their dim0 / size
-  std::vector<int64_t> recv_rows(size_);
-  std::vector<int64_t> recv_off(size_ + 1, 0);
-  for (int r = 0; r < size_; r++) {
-    recv_rows[r] = resp.first_dims[r] / size_;
+  std::vector<int64_t> recv_rows(c.size);
+  std::vector<int64_t> recv_off(c.size + 1, 0);
+  for (int r = 0; r < c.size; r++) {
+    recv_rows[r] = resp.first_dims[r] / c.size;
     recv_off[r + 1] = recv_off[r] + recv_rows[r] * stride;
   }
-  std::vector<char> out(static_cast<size_t>(recv_off[size_]) * esize);
+  std::vector<char> out(static_cast<size_t>(recv_off[c.size]) * esize);
   int64_t blk = my_rows * stride * static_cast<int64_t>(esize);
   // own block
-  std::memcpy(out.data() + recv_off[rank_] * esize,
-              entry.data.data() + rank_ * blk, static_cast<size_t>(blk));
+  std::memcpy(out.data() + recv_off[c.rank] * esize,
+              entry.data.data() + c.rank * blk, static_cast<size_t>(blk));
   int64_t seg = ring_segment_bytes_.load(std::memory_order_relaxed);
   Status st;
-  if (seg > 0 && size_ > 1) {
+  if (seg > 0 && c.size > 1) {
     // segment-windowed pairwise exchange (the ring's (step, segment)
     // machinery): several steps stream concurrently over their distinct
     // peer links instead of barriering on one whole-block duplex at a
@@ -5746,13 +6916,14 @@ void Engine::ExecuteAlltoall(const Response& resp, TensorEntry& entry) {
   } else {
     // HOROVOD_TPU_RING_SEGMENT_BYTES=0: the historical monolithic
     // pairwise exchange (bisection knob)
-    for (int step = 1; step < size_ && st.ok(); step++) {
-      int to = (rank_ + step) % size_;
-      int from = (rank_ - step + size_) % size_;
+    for (int step = 1; step < c.size && st.ok(); step++) {
+      int ti = (c.rank + step) % c.size;
+      int fi = (c.rank - step + c.size) % c.size;
       st = PeerSendRecv(
-          to, entry.data.data() + to * blk, static_cast<size_t>(blk),
-          from, out.data() + recv_off[from] * esize,
-          static_cast<size_t>(recv_rows[from] * stride) * esize);
+          c.members[ti], entry.data.data() + ti * blk,
+          static_cast<size_t>(blk), c.members[fi],
+          out.data() + recv_off[fi] * esize,
+          static_cast<size_t>(recv_rows[fi] * stride) * esize);
     }
   }
   if (!st.ok()) {
@@ -5763,7 +6934,7 @@ void Engine::ExecuteAlltoall(const Response& resp, TensorEntry& entry) {
   }
   std::vector<int64_t> out_dims = entry.req.dims;
   if (out_dims.empty()) out_dims = {1};
-  out_dims[0] = recv_off[size_] / (stride ? stride : 1);
+  out_dims[0] = recv_off[c.size] / (stride ? stride : 1);
   MarkDone(entry.handle, Status::OK(), std::move(out_dims), std::move(out));
 }
 
@@ -5825,6 +6996,45 @@ int hvd_enqueue_out(int op, const char* name, int dtype, int ndim,
   return g_engine->Enqueue(static_cast<OpType>(op), name,
                            static_cast<DType>(dtype), d, data, root_rank,
                            out);
+}
+
+// Process-set enqueues (wire v8): like hvd_enqueue/_out with the target
+// communicator's id (0 = the global set, matching the plain entry points).
+int hvd_enqueue_set(int op, const char* name, int dtype, int ndim,
+                    const int64_t* dims, const void* data, int root_rank,
+                    int process_set) {
+  if (!g_engine) return -1;
+  std::vector<int64_t> d(dims, dims + ndim);
+  return g_engine->Enqueue(static_cast<OpType>(op), name,
+                           static_cast<DType>(dtype), d, data, root_rank,
+                           nullptr, process_set);
+}
+
+int hvd_enqueue_out_set(int op, const char* name, int dtype, int ndim,
+                        const int64_t* dims, const void* data, int root_rank,
+                        void* out, int process_set) {
+  if (!g_engine) return -1;
+  std::vector<int64_t> d(dims, dims + ndim);
+  return g_engine->Enqueue(static_cast<OpType>(op), name,
+                           static_cast<DType>(dtype), d, data, root_rank,
+                           out, process_set);
+}
+
+// Collective registration of a process set: every world rank calls this
+// with the same ascending member list; the returned handle completes with
+// the coordinator-assigned set id as a 4-byte int32 result.
+int hvd_add_process_set(const int64_t* ranks, int n) {
+  if (!g_engine || n < 0) return -1;
+  return g_engine->EnqueueProcessSet(std::vector<int64_t>(ranks, ranks + n));
+}
+
+// Per-set statistics: rows of 8 int64s {id, size, my set rank (-1 when not
+// a member), collectives run, payload bytes, wire ns, cache hits, cache
+// misses}, global set first.  Returns rows written (0 when the engine is
+// down), bounded by max_sets.
+int hvd_process_set_stats(int64_t* out, int max_sets) {
+  if (!g_engine) return 0;
+  return g_engine->ProcessSetStats(out, max_sets);
 }
 
 int hvd_poll(int handle) { return g_engine ? g_engine->PollHandle(handle) : -2; }
@@ -6076,7 +7286,9 @@ void hvd_fault_stats(int64_t* out) {
   out[4] = Faults().abort_latency_ns.load(std::memory_order_relaxed);
   out[5] = Faults().heartbeats_tx.load(std::memory_order_relaxed);
   out[6] = Faults().heartbeats_rx.load(std::memory_order_relaxed);
-  out[7] = 0;
+  // shm poison word (wire v8): waits that unwedged instantly on a peer's
+  // world change instead of riding out the data timeout
+  out[7] = Faults().shm_poisons_seen.load(std::memory_order_relaxed);
 }
 
 // Elastic world statistics, in order: {world epoch (bumps on every applied

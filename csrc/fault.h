@@ -107,6 +107,11 @@ struct FaultCounters {
   std::atomic<int64_t> world_changes{0};   // shrinks + joins applied
   std::atomic<int64_t> rank_joins{0};      // join-kind changes applied
   std::atomic<int64_t> shrink_latency_ns{0};  // detect -> new world live
+  // shm poison word (wire v8 satellite): rings poisoned by a local world
+  // change + peer poisons observed (each observation is a data-plane wait
+  // that unwedged instantly instead of riding out the data timeout)
+  std::atomic<int64_t> shm_poisons_written{0};
+  std::atomic<int64_t> shm_poisons_seen{0};
 };
 
 FaultCounters& Faults();

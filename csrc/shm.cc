@@ -48,6 +48,7 @@ Status ShmRing::Create(const std::string& name, size_t capacity) {
   hdr_ = new (p) ShmRingHdr();
   hdr_->head.store(0, std::memory_order_relaxed);
   hdr_->tail.store(0, std::memory_order_relaxed);
+  hdr_->poison.store(0, std::memory_order_relaxed);
   hdr_->capacity = capacity;
   data_ = static_cast<char*>(p) + sizeof(ShmRingHdr);
   map_len_ = len;

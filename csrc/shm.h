@@ -24,6 +24,14 @@ struct ShmRingHdr {
   char pad0[64 - sizeof(std::atomic<uint64_t>)];
   std::atomic<uint64_t> tail;  // consumer-advanced, monotonic byte count
   char pad1[64 - sizeof(std::atomic<uint64_t>)];
+  // Poison word (elastic follow-on): a world change writes the sentinel
+  // so a CO-RESIDENT peer parked on this ring unwedges on its next idle
+  // poll instead of waiting out HOROVOD_TPU_DATA_TIMEOUT_S — the shm
+  // analog of the RST cascade TCP links get from ShutdownAll.  Either
+  // side may write it (it is not part of the SPSC head/tail protocol);
+  // a fresh Create clears it.
+  std::atomic<uint64_t> poison;
+  char pad2[64 - sizeof(std::atomic<uint64_t>)];
   uint64_t capacity;
 };
 
@@ -49,6 +57,16 @@ class ShmRing {
   // Copy up to n bytes in/out; returns bytes moved (0 = ring full/empty).
   size_t TryPush(const void* buf, size_t n);
   size_t TryPop(void* buf, size_t n);
+
+  // Write / read the poison sentinel (see ShmRingHdr::poison).  Checked
+  // only on the engine's idle paths, so the hot push/pop loops stay at
+  // their original cost.
+  void Poison() {
+    if (hdr_) hdr_->poison.store(1, std::memory_order_release);
+  }
+  bool Poisoned() const {
+    return hdr_ && hdr_->poison.load(std::memory_order_acquire) != 0;
+  }
 
   bool valid() const { return hdr_ != nullptr; }
 

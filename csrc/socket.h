@@ -92,6 +92,12 @@ class Socket {
   // True if a frame header is ready to read without blocking.
   bool Readable(int timeout_ms = 0) const;
 
+  // Kernel receive timeout (SO_RCVTIMEO); 0 restores blocking reads.
+  // Used to bound handshake reads (rendezvous hellos, process-set mesh
+  // hellos) so one stray or stalled connection can never park the
+  // negotiation thread indefinitely.
+  void SetRecvTimeout(double seconds);
+
   static Status Connect(const std::string& host, int port, Socket* out,
                         double timeout_s = 30.0);
 

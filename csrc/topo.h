@@ -31,6 +31,13 @@
 namespace hvdtpu {
 
 struct Topology {
+  // Process-set topologies (wire v8) build in SET-INDEX space: pass the
+  // member-compacted hash list and the caller's set index to Build, then
+  // map the group/ring vectors back to global ranks with MapToGlobal.
+  // Building in index space is what makes a sub-world's ring order equal
+  // the ring order a STANDALONE world of those hosts would compute — the
+  // property the sub-world-vs-standalone bitwise battery asserts.
+  int set_id = 0;
   int rank = 0;
   int size = 1;
   int nics = 1;
@@ -88,10 +95,21 @@ struct Topology {
     return order;
   }
 
+  // Translate set-index-space entries (what Build produced from a
+  // member-compacted hash list) back into global ranks.
+  static std::vector<int> MapToGlobal(const std::vector<int>& idxs,
+                                      const std::vector<int>& members) {
+    std::vector<int> out;
+    out.reserve(idxs.size());
+    for (int i : idxs) out.push_back(members[static_cast<size_t>(i)]);
+    return out;
+  }
+
   // JSON description for diagnostics/tests (hvd_topology_describe).
   std::string DescribeJson() const {
     std::ostringstream os;
-    os << "{\"hosts\":" << host_groups.size() << ",\"nics\":" << nics
+    os << "{\"set\":" << set_id
+       << ",\"hosts\":" << host_groups.size() << ",\"nics\":" << nics
        << ",\"size\":" << size << ",\"rank\":" << rank
        << ",\"stripes_cross\":" << stripes_cross
        << ",\"stripes_local\":" << stripes_local << ",\"ring_order\":[";

@@ -115,6 +115,21 @@ Status ReadHeader(Reader* rd, FrameType expect) {
   return Status::OK();
 }
 
+// Set tag (wire v8): a trailing int32 process-set id on every
+// negotiation-side frame, written ONLY for non-global sets so the global
+// set's frames stay byte-for-byte what v7 produced (the steady-state
+// ctrl-bytes gate pins this).  Parsing reads the tag exactly when the
+// serializer left trailing bytes — the frame bodies are otherwise
+// fixed-layout, so "bytes remain" is unambiguous.
+void PutSetTag(std::string* s, int32_t set) {
+  if (set != 0) PutI32(s, set);
+}
+
+int32_t ReadSetTag(Reader* rd) {
+  if (rd->fail || rd->off >= rd->buf.size()) return 0;
+  return rd->I32();
+}
+
 }  // namespace
 
 FrameType FrameTypeOf(const std::string& buf) {
@@ -146,6 +161,7 @@ std::string Serialize(const RequestList& l) {
     PutStr(&s, r.name);
     PutDims(&s, r.dims);
   }
+  PutSetTag(&s, l.process_set);
   return s;
 }
 
@@ -169,6 +185,8 @@ Status Parse(const std::string& buf, RequestList* out) {
     if (rd.fail) return Status::Error("truncated request list");
     out->requests.push_back(std::move(r));
   }
+  out->process_set = ReadSetTag(&rd);
+  for (Request& r : out->requests) r.set = out->process_set;
   return Status::OK();
 }
 
@@ -191,6 +209,7 @@ std::string Serialize(const ResponseList& l) {
     for (const std::string& nm : r.names) PutStr(&s, nm);
     PutDims(&s, r.first_dims);
   }
+  PutSetTag(&s, l.process_set);
   return s;
 }
 
@@ -217,10 +236,13 @@ Status Parse(const std::string& buf, ResponseList* out) {
     int64_t nn = rd.I64();
     if (nn < 0 || nn > (1 << 24)) return Status::Error("bad name count");
     for (int64_t j = 0; j < nn; j++) r.names.push_back(rd.Str());
-    r.first_dims = rd.Dims();
+    // first_dims is rank-shaped, not tensor-shaped (one entry per member;
+    // process-set responses carry {id, members...}): member-count bound
+    r.first_dims = rd.Dims(1 << 20);
     if (rd.fail) return Status::Error("truncated response list");
     out->responses.push_back(std::move(r));
   }
+  out->process_set = ReadSetTag(&rd);
   return Status::OK();
 }
 
@@ -231,6 +253,7 @@ std::string Serialize(const CacheBitsFrame& f) {
   PutU64(&s, f.epoch);
   PutI64(&s, static_cast<int64_t>(f.bits.size()));
   s.append(reinterpret_cast<const char*>(f.bits.data()), f.bits.size());
+  PutSetTag(&s, f.process_set);
   return s;
 }
 
@@ -245,6 +268,8 @@ Status Parse(const std::string& buf, CacheBitsFrame* out) {
   if (rd.fail || n < 0 || n > (1 << 20) || !rd.Need(static_cast<size_t>(n)))
     return Status::Error("truncated cache-bits frame");
   out->bits.assign(buf.data() + rd.off, buf.data() + rd.off + n);
+  rd.off += static_cast<size_t>(n);
+  out->process_set = ReadSetTag(&rd);
   return Status::OK();
 }
 
@@ -262,6 +287,7 @@ std::string Serialize(const CachedExecFrame& f) {
     PutI64(&s, static_cast<int64_t>(g.size()));
     for (uint32_t id : g) PutU32(&s, id);
   }
+  PutSetTag(&s, f.process_set);
   return s;
 }
 
@@ -295,6 +321,7 @@ Status Parse(const std::string& buf, CachedExecFrame* out) {
     if (rd.fail) return Status::Error("truncated cached-exec frame");
     out->groups.push_back(std::move(g));
   }
+  out->process_set = ReadSetTag(&rd);
   return Status::OK();
 }
 

@@ -23,10 +23,10 @@ namespace hvdtpu {
 
 // Bump kWireVersion on ANY layout change (header, field order, new frame).
 constexpr uint32_t kWireMagic = 0x48564457u;  // "HVDW" little-endian
-constexpr uint16_t kWireVersion = 7;          // v7: elastic membership
-                                              // (world-change/ack/commit
-                                              // frames; elastic + min-np
-                                              // bootstrap-table fields)
+constexpr uint16_t kWireVersion = 8;          // v8: process sets (set-tagged
+                                              // request/response/cache
+                                              // frames; kProcessSet op;
+                                              // set registry in the table)
 
 enum class FrameType : uint16_t {
   kInvalid = 0,
@@ -46,13 +46,25 @@ struct Request {
   OpType op = OpType::kAllreduce;
   DType dtype = DType::kFloat32;
   std::string name;
-  int32_t root_rank = -1;                 // broadcast only
+  int32_t root_rank = -1;                 // broadcast only (SET rank)
   std::vector<int64_t> dims;              // tensor shape
+  // Process set this op runs on (engine-local routing field, NOT
+  // serialized per request: the enclosing frame's set tag carries it —
+  // one frame holds one set's requests, so global-set-only frames stay
+  // byte-for-byte what wire v7 produced).
+  int32_t set = 0;
 };
 
+// Every negotiation-side frame below is SET-TAGGED (wire v8): a trailing
+// int32 process-set id, written ONLY when the set is not the global set 0
+// and parsed only when trailing bytes exist.  Global-set-only jobs thus
+// serialize byte-for-byte identical frames (sizes and payloads; only the
+// header's version field moved) — the property the steady-state
+// ctrl-bytes CI gate holds pinned.
 struct RequestList {
   std::vector<Request> requests;
   bool shutdown = false;
+  int32_t process_set = 0;  // set tag (trailing; omitted when 0)
 };
 
 struct Response {
@@ -76,6 +88,7 @@ struct ResponseList {
   int64_t tuned_pipeline_depth = -1;  // >=1 when the autotuner owns the knob
   int64_t tuned_segment_bytes = -1;   // >=1 when the autotuner owns the knob
   int64_t tuned_wire_stripes = -1;    // >=1 when the autotuner owns the knob
+  int32_t process_set = 0;            // set tag (trailing; omitted when 0)
 };
 
 // Steady-state claim: "every cache slot whose bit is set holds an entry
@@ -88,6 +101,7 @@ struct CacheBitsFrame {
   int32_t rank = 0;
   uint64_t epoch = 0;
   std::vector<uint8_t> bits;  // bit s => claim on cache slot s
+  int32_t process_set = 0;    // set tag (trailing; omitted when 0)
 };
 
 // "Execute cached ids": each group is a list of cache slot ids executing
@@ -102,6 +116,7 @@ struct CachedExecFrame {
   int64_t tuned_pipeline_depth = -1;
   int64_t tuned_segment_bytes = -1;
   int64_t tuned_wire_stripes = -1;
+  int32_t process_set = 0;  // set tag (trailing; omitted when 0)
 };
 
 // Idle-tick liveness probe (fault domain): any control frame refreshes the

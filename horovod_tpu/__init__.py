@@ -38,6 +38,11 @@ from horovod_tpu.runtime.state import (
     mpi_threads_supported,
     world_changed,
     world_epoch,
+    ProcessSet,
+    add_process_set,
+    global_process_set,
+    process_set_stats,
+    elastic,
 )
 
 __version__ = "0.5.0"
@@ -72,12 +77,46 @@ def _auto_name(prefix: str, name: str | None, handle_hint: str = "") -> str:
 _auto_name.counter = itertools.count(1)
 
 
+def _pset(process_set) -> tuple[int, int]:
+    """(set id, communicator size) for a collective's process_set kwarg.
+
+    Accepts a :class:`ProcessSet` or a raw set id; set names are
+    namespaced per set (``ps<id>.``) so the same tensor name may be in
+    flight on two sets at once — which is precisely what concurrent
+    sub-world collectives do."""
+    if process_set is None:
+        return 0, size()
+    sid = getattr(process_set, "process_set_id", process_set)
+    sid = int(sid)
+    if sid == 0:
+        return 0, size()
+    # The size always resolves through the ENGINE (cached per world
+    # epoch; world_changed() drops the cache in state.py) — never through
+    # the ProcessSet object's registration-time member list, which an
+    # elastic shrink silently leaves stale.  Averages must divide by the
+    # LIVE set size.
+    eng = _state.engine()
+    cache = getattr(eng, "_pset_size_cache", None)
+    if cache is None:
+        cache = eng._pset_size_cache = {}
+    if sid not in cache:
+        for row in eng.process_set_stats():
+            cache[row["id"]] = row["size"]
+    return sid, cache.get(sid, size())
+
+
+def _pset_name(prefix: str, name: str | None, sid: int) -> str:
+    base = _auto_name(prefix, name)
+    return base if sid == 0 else f"ps{sid}.{base}"
+
+
 # --------------------------------------------------------------------------
 # Synchronous eager collectives (numpy in, numpy out)
 # --------------------------------------------------------------------------
 
 def allreduce(tensor, average: bool = True, name: str | None = None,
-              compression=Compression.none, out=None) -> np.ndarray:
+              compression=Compression.none, out=None,
+              process_set=None) -> np.ndarray:
     """Sum (or average) across all processes.
 
     ``out``: optional result buffer (input's shape/dtype, C-contiguous)
@@ -85,7 +124,12 @@ def allreduce(tensor, average: bool = True, name: str | None = None,
     on warm pages; pass the input itself for an in-place reduce.  Only
     honored on the uncompressed path (compression changes the wire
     shape).
+
+    ``process_set``: a :class:`ProcessSet` (or id) restricting the
+    collective to that set's members, running concurrently with other
+    sets' traffic; ``average`` divides by the SET size.
     """
+    sid, nprocs = _pset(process_set)
     arr = _as_numpy(tensor)
     comp, ctx = compression.compress(arr)
     if compression is Compression.int8:
@@ -95,8 +139,8 @@ def allreduce(tensor, average: bool = True, name: str | None = None,
         # agreement round in the engine (not implemented).
         comp, ctx = compression.decompress(comp, ctx), None
     direct = out if compression is Compression.none else None
-    res = _state.engine().allreduce(comp, _auto_name("allreduce", name),
-                                    out=direct)
+    res = _state.engine().allreduce(comp, _pset_name("allreduce", name, sid),
+                                    out=direct, process_set=sid)
     res = compression.decompress(res, ctx)
     if average:
         if direct is not None:
@@ -106,38 +150,53 @@ def allreduce(tensor, average: bool = True, name: str | None = None,
             # rides the wire as [1]
             target = direct.reshape(1) if direct.ndim == 0 and \
                 np.ndim(res) == 1 else direct
-            np.divide(res, size(), out=target, casting="unsafe")
+            np.divide(res, nprocs, out=target, casting="unsafe")
         else:
-            res = res / size()
+            res = res / nprocs
     if direct is not None:
         # the caller's buffer (original shape, 0-d included) is the result
         return direct
     return res
 
 
-def allgather(tensor, name: str | None = None) -> np.ndarray:
+def allgather(tensor, name: str | None = None,
+              process_set=None) -> np.ndarray:
     """Concatenate values from all processes along dim 0.  First dims may
     differ across ranks; other dims must match (reference
-    `/root/reference/horovod/common/operations.cc:387-452`)."""
-    return _state.engine().allgather(_as_numpy(tensor), _auto_name("allgather", name))
+    `/root/reference/horovod/common/operations.cc:387-452`).  With
+    ``process_set``, concatenates the SET members' values in set-rank
+    order."""
+    sid, _ = _pset(process_set)
+    return _state.engine().allgather(
+        _as_numpy(tensor), _pset_name("allgather", name, sid),
+        process_set=sid)
 
 
 def broadcast(tensor, root_rank: int, name: str | None = None,
-              out=None) -> np.ndarray:
+              out=None, process_set=None) -> np.ndarray:
     """Every process receives root_rank's value.  ``out`` as in
-    :func:`allreduce` (pass the input itself for in-place)."""
+    :func:`allreduce` (pass the input itself for in-place).  With
+    ``process_set``, ``root_rank`` is the root's SET rank and only
+    members participate."""
+    sid, _ = _pset(process_set)
     res = _state.engine().broadcast(
-        _as_numpy(tensor), root_rank, _auto_name("broadcast", name), out=out
+        _as_numpy(tensor), root_rank, _pset_name("broadcast", name, sid),
+        out=out, process_set=sid
     )
     # the caller's buffer (original shape — 0-d rides the wire as [1]) is
     # the result when provided
     return out if out is not None else res
 
 
-def alltoall(tensor, name: str | None = None) -> np.ndarray:
+def alltoall(tensor, name: str | None = None,
+             process_set=None) -> np.ndarray:
     """Scatter dim-0 slices to each rank and gather their slices (new
-    capability; absent from the reference)."""
-    return _state.engine().alltoall(_as_numpy(tensor), _auto_name("alltoall", name))
+    capability; absent from the reference).  With ``process_set``, slices
+    scatter among the SET members (dim 0 divisible by the set size)."""
+    sid, _ = _pset(process_set)
+    return _state.engine().alltoall(
+        _as_numpy(tensor), _pset_name("alltoall", name, sid),
+        process_set=sid)
 
 
 def barrier() -> None:
@@ -149,25 +208,34 @@ def barrier() -> None:
 # --------------------------------------------------------------------------
 
 def allreduce_async(tensor, average: bool = True, name: str | None = None,
-                    out=None) -> int:
+                    out=None, process_set=None) -> int:
+    sid, nprocs = _pset(process_set)
     arr = _as_numpy(tensor)
     engine = _state.engine()
-    handle = engine.allreduce_async(arr, _auto_name("allreduce", name),
-                                    out=out)
+    handle = engine.allreduce_async(arr, _pset_name("allreduce", name, sid),
+                                    out=out, process_set=sid)
     if average:
-        # tracked on the engine so handle-id reuse after shutdown()/init()
-        # can never inherit a stale average flag
-        engine.average_handles.add(handle)
+        # tracked on the engine (with the communicator size to divide by)
+        # so handle-id reuse after shutdown()/init() can never inherit a
+        # stale average flag
+        engine.average_handles[handle] = nprocs
     return handle
 
 
-def allgather_async(tensor, name: str | None = None) -> int:
-    return _state.engine().allgather_async(_as_numpy(tensor), _auto_name("allgather", name))
+def allgather_async(tensor, name: str | None = None,
+                    process_set=None) -> int:
+    sid, _ = _pset(process_set)
+    return _state.engine().allgather_async(
+        _as_numpy(tensor), _pset_name("allgather", name, sid),
+        process_set=sid)
 
 
-def broadcast_async(tensor, root_rank: int, name: str | None = None) -> int:
+def broadcast_async(tensor, root_rank: int, name: str | None = None,
+                    process_set=None) -> int:
+    sid, _ = _pset(process_set)
     return _state.engine().broadcast_async(
-        _as_numpy(tensor), root_rank, _auto_name("broadcast", name)
+        _as_numpy(tensor), root_rank, _pset_name("broadcast", name, sid),
+        process_set=sid
     )
 
 
@@ -183,16 +251,16 @@ def synchronize(handle: int):
     engine = _state.engine()
     out = engine.synchronize(handle)
     if handle in engine.average_handles:
-        engine.average_handles.discard(handle)
+        nprocs = engine.average_handles.pop(handle)
         floaty = isinstance(out, np.ndarray) and (
             np.issubdtype(out.dtype, np.floating)
             or out.dtype.name == "bfloat16")
         if floaty:
             # in place: keeps caller-provided `out` buffers authoritative
             # (bf16 divides through float32 and casts back)
-            np.divide(out, size(), out=out, casting="unsafe")
+            np.divide(out, nprocs, out=out, casting="unsafe")
         else:
-            out = out / size()  # ints promote, as before
+            out = out / nprocs  # ints promote, as before
     return out
 
 
@@ -200,7 +268,9 @@ __all__ = [
     "init", "shutdown", "is_initialized",
     "rank", "size", "local_rank", "local_size", "cross_rank", "cross_size",
     "mpi_threads_supported",
-    "world_changed", "world_epoch", "WorldShrunkError",
+    "world_changed", "world_epoch", "WorldShrunkError", "elastic",
+    "ProcessSet", "add_process_set", "global_process_set",
+    "process_set_stats",
     "allreduce", "allgather", "broadcast", "alltoall", "barrier",
     "allreduce_async", "allgather_async", "broadcast_async",
     "poll", "synchronize",

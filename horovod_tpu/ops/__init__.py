@@ -1,3 +1,16 @@
+"""Compiled-path (traced/SPMD) collectives over named mesh axes.
+
+Process-set note (wire v8): the EAGER engine's keyed sub-communicators
+(``hvd.add_process_set`` + ``process_set=`` on the eager collectives) have
+a zero-cost compiled-path equivalent — a named mesh axis IS a process set.
+An expert group or pipeline stage that would be ``ProcessSet([0, 2])``
+eagerly is simply a sub-axis of the device mesh here, and every function
+below already scopes to whatever ``axis_name`` it is given; XLA runs
+collectives over disjoint axes concurrently by construction.  Use the
+eager process sets for host-tensor / dynamic-shape traffic, mesh axes
+inside ``jit``.
+"""
+
 from horovod_tpu.ops.collective_ops import (
     allreduce,
     grouped_allreduce,

@@ -89,9 +89,11 @@ class Engine:
 
     def __init__(self) -> None:
         self.handles = HandleManager()
-        # handles whose results the frontend must divide by world size;
-        # engine-scoped so ids can't leak across shutdown()/init() cycles
-        self.average_handles: set[int] = set()
+        # handle -> divisor for results the frontend must average (the
+        # communicator size the op ran over: world size for the global
+        # set, the SET size for process-set ops); engine-scoped so ids
+        # can't leak across shutdown()/init() cycles
+        self.average_handles: dict[int, int] = {}
         # span/counter recording for every engine (this base class included):
         # wraps the instance's *_async submits and synchronize when metrics
         # or a timeline are configured; installs nothing when disabled, so
@@ -104,36 +106,53 @@ class Engine:
     # (routed through self.synchronize, not handles.wait directly, so the
     # telemetry wrapper sees completions from the sync variants too)
     def allreduce(self, array: np.ndarray, name: str, op: str = _SUM,
-                  out: np.ndarray | None = None) -> np.ndarray:
-        return self.synchronize(self.allreduce_async(array, name, op,
-                                                     out=out))
+                  out: np.ndarray | None = None,
+                  process_set: int = 0) -> np.ndarray:
+        return self.synchronize(self.allreduce_async(
+            array, name, op, out=out, process_set=process_set))
 
-    def allgather(self, array: np.ndarray, name: str) -> np.ndarray:
-        return self.synchronize(self.allgather_async(array, name))
+    def allgather(self, array: np.ndarray, name: str,
+                  process_set: int = 0) -> np.ndarray:
+        return self.synchronize(
+            self.allgather_async(array, name, process_set=process_set))
 
     def broadcast(self, array: np.ndarray, root_rank: int, name: str,
-                  out: np.ndarray | None = None) -> np.ndarray:
-        return self.synchronize(
-            self.broadcast_async(array, root_rank, name, out=out))
+                  out: np.ndarray | None = None,
+                  process_set: int = 0) -> np.ndarray:
+        return self.synchronize(self.broadcast_async(
+            array, root_rank, name, out=out, process_set=process_set))
 
-    def alltoall(self, array: np.ndarray, name: str) -> np.ndarray:
-        return self.synchronize(self.alltoall_async(array, name))
+    def alltoall(self, array: np.ndarray, name: str,
+                 process_set: int = 0) -> np.ndarray:
+        return self.synchronize(
+            self.alltoall_async(array, name, process_set=process_set))
 
     # -- async API (must be implemented) -----------------------------------
     # `out` (allreduce/broadcast): caller-owned result buffer of the
     # input's shape/dtype — written by the engine, enabling in-place ops
-    # and buffer reuse across steps (no fresh pages per op)
-    def allreduce_async(self, array, name, op=_SUM, out=None) -> int:
+    # and buffer reuse across steps (no fresh pages per op).
+    # `process_set` (wire v8): the keyed sub-communicator the op runs on
+    # (0 = the global set; ids come from add_process_set).
+    def allreduce_async(self, array, name, op=_SUM, out=None,
+                        process_set: int = 0) -> int:
         raise NotImplementedError
 
-    def allgather_async(self, array, name) -> int:
+    def allgather_async(self, array, name, process_set: int = 0) -> int:
         raise NotImplementedError
 
-    def broadcast_async(self, array, root_rank, name, out=None) -> int:
+    def broadcast_async(self, array, root_rank, name, out=None,
+                        process_set: int = 0) -> int:
         raise NotImplementedError
 
-    def alltoall_async(self, array, name) -> int:
+    def alltoall_async(self, array, name, process_set: int = 0) -> int:
         raise NotImplementedError
+
+    # -- process sets ------------------------------------------------------
+    def add_process_set(self, ranks) -> int:
+        raise NotImplementedError
+
+    def process_set_stats(self) -> list:
+        return []
 
     def poll(self, handle: int) -> bool:
         return self.handles.poll(handle)
@@ -153,6 +172,13 @@ class SingleProcessEngine(Engine):
 
     name = "single"
 
+    def __init__(self) -> None:
+        super().__init__()
+        # process sets in a 1-rank world: only {0} is registrable; every
+        # set's collectives are the same identity copies
+        self._psets: dict[int, list[int]] = {}
+        self._next_pset = 1
+
     def _complete(self, result) -> int:
         handle = self.handles.allocate()
         self.handles.mark_done(handle, result)
@@ -164,20 +190,50 @@ class SingleProcessEngine(Engine):
             return out
         return np.array(array, copy=True)
 
-    def allreduce_async(self, array, name, op=_SUM, out=None) -> int:
+    def _check_pset(self, process_set: int) -> None:
+        if process_set != 0 and process_set not in self._psets:
+            raise RuntimeError(f"unknown process set {process_set}")
+
+    def add_process_set(self, ranks) -> int:
+        members = [int(r) for r in ranks]
+        if members != [0]:
+            raise RuntimeError(
+                f"process set members {members} outside the size-1 world")
+        sid = self._next_pset
+        self._next_pset += 1
+        self._psets[sid] = members
+        return sid
+
+    def process_set_stats(self) -> list:
+        rows = [{"id": 0, "size": 1, "rank": 0, "collectives": 0,
+                 "payload_bytes": 0, "wire_ns": 0, "cache_hits": 0,
+                 "cache_misses": 0}]
+        for sid in sorted(self._psets):
+            rows.append({"id": sid, "size": 1, "rank": 0, "collectives": 0,
+                         "payload_bytes": 0, "wire_ns": 0, "cache_hits": 0,
+                         "cache_misses": 0})
+        return rows
+
+    def allreduce_async(self, array, name, op=_SUM, out=None,
+                        process_set: int = 0) -> int:
+        self._check_pset(process_set)
         return self._complete(self._copy(array, out))
 
-    def allgather_async(self, array, name) -> int:
+    def allgather_async(self, array, name, process_set: int = 0) -> int:
+        self._check_pset(process_set)
         return self._complete(np.array(array, copy=True))
 
-    def broadcast_async(self, array, root_rank, name, out=None) -> int:
+    def broadcast_async(self, array, root_rank, name, out=None,
+                        process_set: int = 0) -> int:
+        self._check_pset(process_set)
         if root_rank != 0:
             raise ValueError(
                 f"broadcast root_rank {root_rank} out of range for size-1 world"
             )
         return self._complete(self._copy(array, out))
 
-    def alltoall_async(self, array, name) -> int:
+    def alltoall_async(self, array, name, process_set: int = 0) -> int:
+        self._check_pset(process_set)
         return self._complete(np.array(array, copy=True))
 
 

@@ -211,6 +211,28 @@ def _bind(lib):
         lib.hvd_world_stats.restype = None
     except AttributeError:
         pass
+    try:
+        # process sets (wire v8); same prebuilt-.so caveat
+        lib.hvd_enqueue_set.argtypes = [
+            ctypes.c_int, ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_void_p, ctypes.c_int,
+            ctypes.c_int,
+        ]
+        lib.hvd_enqueue_set.restype = ctypes.c_int
+        lib.hvd_enqueue_out_set.argtypes = [
+            ctypes.c_int, ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_void_p, ctypes.c_int,
+            ctypes.c_void_p, ctypes.c_int,
+        ]
+        lib.hvd_enqueue_out_set.restype = ctypes.c_int
+        lib.hvd_add_process_set.argtypes = [
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int]
+        lib.hvd_add_process_set.restype = ctypes.c_int
+        lib.hvd_process_set_stats.argtypes = [
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int]
+        lib.hvd_process_set_stats.restype = ctypes.c_int
+    except AttributeError:
+        pass
     return lib
 
 
@@ -285,6 +307,9 @@ class NativeEngine(Engine):
         d.update(self._fault_stats())
         d.update(self._wire_stats())
         d.update(self.world_stats())
+        psets = self.process_set_stats()
+        d["process_sets"] = psets
+        d["process_set_count"] = len(psets)
         return d
 
     def world_stats(self) -> dict:
@@ -351,6 +376,55 @@ class NativeEngine(Engine):
         d["wire_stripe_bytes"] = [max(int(vals[8 + s]), 0) for s in range(8)]
         return d
 
+    # -- process sets (wire v8) --------------------------------------------
+    _MAX_PSET_STATS = 64
+
+    def add_process_set(self, ranks) -> int:
+        """Collectively register a process set over the given global
+        ranks (ascending).  Every rank of the job must call this with the
+        same list; returns the coordinator-assigned set id.  Membership is
+        not required to call — non-members just learn the id."""
+        fn = getattr(self._lib, "hvd_add_process_set", None)
+        if fn is None:
+            raise RuntimeError(
+                "loaded libhvdtpu.so predates process sets (wire v8)")
+        members = [int(r) for r in ranks]
+        arr = (ctypes.c_int64 * max(len(members), 1))(*(members or [0]))
+        handle = fn(arr, len(members))
+        if handle < 0:
+            raise RuntimeError("add_process_set failed: engine not running")
+        rc = self._lib.hvd_wait(handle, -1.0)
+        try:
+            if rc < 0:
+                p = self._lib.hvd_error_str(handle)
+                try:
+                    msg = ctypes.cast(p, ctypes.c_char_p).value.decode()
+                finally:
+                    self._lib.hvd_free_cstr(p)
+                raise RuntimeError(f"add_process_set failed: {msg}")
+            out = ctypes.c_int32(0)
+            self._lib.hvd_result_copy(
+                handle, ctypes.cast(ctypes.byref(out), ctypes.c_void_p))
+            return int(out.value)
+        finally:
+            self._lib.hvd_release(handle)
+
+    def process_set_stats(self) -> list[dict]:
+        """Per-set statistics rows (global set 0 first): id, size, this
+        rank's SET rank (-1 when outside), collectives run, payload bytes,
+        wire ns, and this rank's cache hits/misses on that set."""
+        fn = getattr(self._lib, "hvd_process_set_stats", None)
+        if fn is None:
+            return []
+        vals = (ctypes.c_int64 * (8 * self._MAX_PSET_STATS))()
+        n = fn(vals, self._MAX_PSET_STATS)
+        keys = ("id", "size", "rank", "collectives", "payload_bytes",
+                "wire_ns", "cache_hits", "cache_misses")
+        return [
+            {k: int(vals[8 * i + j]) for j, k in enumerate(keys)}
+            for i in range(max(n, 0))
+        ]
+
     def _fault_stats(self) -> dict:
         """Fault-domain counters.  ``heartbeat_age_s`` is the oldest
         control-plane silence this rank observes (rank 0: worst worker;
@@ -361,7 +435,7 @@ class NativeEngine(Engine):
         fn = getattr(self._lib, "hvd_fault_stats", None)
         keys = ("heartbeat_age_ms", "peer_timeout_ms", "peer_timeouts",
                 "aborts", "abort_latency_ns", "heartbeats_tx",
-                "heartbeats_rx")
+                "heartbeats_rx", "shm_poisons")
         if fn is None:
             d = dict.fromkeys(keys, 0)
             age_ms = 0
@@ -471,6 +545,9 @@ class NativeEngine(Engine):
                      "pack_bytes": 0, "world_changes": 0, "rank_joins": 0}
         # per-stripe tx bytes: one labelled counter per stripe index
         stripe_seen = [0] * 8
+        # per-process-set counters: one labelled series per set id
+        pset_seen: dict = {}
+        shm_poison_seen = [0]
         cumulative = (
             ("stall_events", telemetry.NATIVE_STALL_EVENTS),
             ("cache_hits", telemetry.NATIVE_CACHE_HITS),
@@ -554,6 +631,29 @@ class NativeEngine(Engine):
                         reg.counter(telemetry.NATIVE_WIRE_STRIPE_BYTES,
                                     stripe=str(s)).inc(delta)
                         stripe_seen[s] = now_b
+                # process sets: registered-set gauge + per-set labelled
+                # counters so concurrent sets' traffic stays separable
+                reg.gauge(telemetry.NATIVE_PROCESS_SETS).set(
+                    max(d.get("process_set_count", 1) - 1, 0))
+                for row in d.get("process_sets", []):
+                    sid = str(row["id"])
+                    seen = pset_seen.setdefault(
+                        sid, {"collectives": 0, "payload_bytes": 0,
+                              "cache_hits": 0})
+                    for key, metric in (
+                            ("collectives",
+                             telemetry.NATIVE_PSET_COLLECTIVES),
+                            ("payload_bytes", telemetry.NATIVE_PSET_BYTES),
+                            ("cache_hits",
+                             telemetry.NATIVE_PSET_CACHE_HITS)):
+                        delta = row[key] - seen[key]
+                        if delta > 0:
+                            reg.counter(metric, set=sid).inc(delta)
+                            seen[key] = row[key]
+                delta = d.get("shm_poisons", 0) - shm_poison_seen[0]
+                if delta > 0:
+                    reg.counter(telemetry.NATIVE_SHM_POISONS).inc(delta)
+                    shm_poison_seen[0] = d.get("shm_poisons", 0)
                 for stage, (ns_key, n_key) in stage_keys.items():
                     ns0, n0 = stage_seen[stage]
                     dns, dn = d[ns_key] - ns0, d[n_key] - n0
@@ -591,7 +691,7 @@ class NativeEngine(Engine):
 
     # -- async ops ---------------------------------------------------------
     def _enqueue(self, op: int, array, name: str, root_rank: int = -1,
-                 out: np.ndarray | None = None) -> int:
+                 out: np.ndarray | None = None, process_set: int = 0) -> int:
         arr, dtype = _np_view(np.asarray(array))
         if out is not None:
             if out.ndim == 0 and arr.shape == (1,):
@@ -605,23 +705,40 @@ class NativeEngine(Engine):
                     "out must be C-contiguous with the input's shape/dtype"
                     f" (got {out.dtype}{out.shape} for {arr.dtype}{arr.shape})")
         dims = (ctypes.c_int64 * max(arr.ndim, 1))(*(arr.shape or (1,)))
+        if process_set != 0 and not hasattr(self._lib, "hvd_enqueue_set"):
+            raise RuntimeError(
+                "loaded libhvdtpu.so predates process sets (wire v8)")
         if op in (_OP_ALLREDUCE, _OP_BROADCAST):
             # same-shape ops: the engine writes the result straight into
             # this buffer on its background thread (one copy out, no
             # result-vector stage); `out` lets callers go fully in-place
             if out is None:
                 out = np.empty_like(arr)
-            handle = self._lib.hvd_enqueue_out(
-                op, name.encode(), dtype, arr.ndim, dims,
-                arr.ctypes.data_as(ctypes.c_void_p), root_rank,
-                out.ctypes.data_as(ctypes.c_void_p),
-            )
+            if process_set != 0:
+                handle = self._lib.hvd_enqueue_out_set(
+                    op, name.encode(), dtype, arr.ndim, dims,
+                    arr.ctypes.data_as(ctypes.c_void_p), root_rank,
+                    out.ctypes.data_as(ctypes.c_void_p), process_set,
+                )
+            else:
+                handle = self._lib.hvd_enqueue_out(
+                    op, name.encode(), dtype, arr.ndim, dims,
+                    arr.ctypes.data_as(ctypes.c_void_p), root_rank,
+                    out.ctypes.data_as(ctypes.c_void_p),
+                )
         else:
             out = None
-            handle = self._lib.hvd_enqueue(
-                op, name.encode(), dtype, arr.ndim, dims,
-                arr.ctypes.data_as(ctypes.c_void_p), root_rank,
-            )
+            if process_set != 0:
+                handle = self._lib.hvd_enqueue_set(
+                    op, name.encode(), dtype, arr.ndim, dims,
+                    arr.ctypes.data_as(ctypes.c_void_p), root_rank,
+                    process_set,
+                )
+            else:
+                handle = self._lib.hvd_enqueue(
+                    op, name.encode(), dtype, arr.ndim, dims,
+                    arr.ctypes.data_as(ctypes.c_void_p), root_rank,
+                )
         if handle < 0:
             raise RuntimeError("enqueue failed: engine not running")
         with self._lock:
@@ -630,32 +747,55 @@ class NativeEngine(Engine):
                 self._out_by_handle[handle] = out
         return handle
 
-    def allreduce_async(self, array, name, op=_SUM, out=None) -> int:
+    def _pset_size(self, process_set: int) -> int:
+        """The communicator size an op runs over (frontend validation).
+        Cached per world epoch — the same ``_pset_size_cache`` attribute
+        the hvd frontend uses, dropped by ``world_changed()`` — so hot
+        per-op validation never pays a native stats scan."""
+        if process_set == 0:
+            return self._topology.size
+        cache = getattr(self, "_pset_size_cache", None)
+        if cache is None:
+            cache = self._pset_size_cache = {}
+        if process_set not in cache:
+            for row in self.process_set_stats():
+                cache[row["id"]] = row["size"]
+        return cache.get(process_set, self._topology.size)
+
+    def allreduce_async(self, array, name, op=_SUM, out=None,
+                        process_set: int = 0) -> int:
         if op != _SUM:
             raise ValueError("native engine reduces with op='sum'; apply "
                              "min/max via the compiled path")
-        return self._enqueue(_OP_ALLREDUCE, array, name, out=out)
+        return self._enqueue(_OP_ALLREDUCE, array, name, out=out,
+                             process_set=process_set)
 
-    def allgather_async(self, array, name) -> int:
-        return self._enqueue(_OP_ALLGATHER, array, name)
+    def allgather_async(self, array, name, process_set: int = 0) -> int:
+        return self._enqueue(_OP_ALLGATHER, array, name,
+                             process_set=process_set)
 
-    def broadcast_async(self, array, root_rank, name, out=None) -> int:
-        if not 0 <= root_rank < self._topology.size:
+    def broadcast_async(self, array, root_rank, name, out=None,
+                        process_set: int = 0) -> int:
+        limit = self._pset_size(process_set)
+        if not 0 <= root_rank < limit:
             raise ValueError(
-                f"broadcast root_rank {root_rank} out of range for world "
-                f"size {self._topology.size}"
+                f"broadcast root_rank {root_rank} out of range for "
+                f"communicator size {limit}"
             )
-        return self._enqueue(_OP_BROADCAST, array, name, root_rank, out=out)
+        return self._enqueue(_OP_BROADCAST, array, name, root_rank, out=out,
+                             process_set=process_set)
 
-    def alltoall_async(self, array, name) -> int:
+    def alltoall_async(self, array, name, process_set: int = 0) -> int:
         arr = np.asarray(array)
         dim0 = arr.shape[0] if arr.ndim else 1
-        if dim0 % self._topology.size != 0:
+        limit = self._pset_size(process_set)
+        if limit and dim0 % limit != 0:
             raise ValueError(
-                f"alltoall first dim {dim0} must be divisible by world size "
-                f"{self._topology.size}"
+                f"alltoall first dim {dim0} must be divisible by "
+                f"communicator size {limit}"
             )
-        return self._enqueue(_OP_ALLTOALL, array, name)
+        return self._enqueue(_OP_ALLTOALL, array, name,
+                             process_set=process_set)
 
     # -- completion --------------------------------------------------------
     def poll(self, handle: int) -> bool:
@@ -711,18 +851,21 @@ class NativeEngine(Engine):
                 self._out_by_handle.pop(handle, None)
 
     # -- sync wrappers (route through native wait, not HandleManager) ------
-    def allreduce(self, array, name, op=_SUM, out=None):
-        return self.synchronize(self.allreduce_async(array, name, op, out=out))
+    def allreduce(self, array, name, op=_SUM, out=None, process_set=0):
+        return self.synchronize(self.allreduce_async(
+            array, name, op, out=out, process_set=process_set))
 
-    def allgather(self, array, name):
-        return self.synchronize(self.allgather_async(array, name))
-
-    def broadcast(self, array, root_rank, name, out=None):
+    def allgather(self, array, name, process_set=0):
         return self.synchronize(
-            self.broadcast_async(array, root_rank, name, out=out))
+            self.allgather_async(array, name, process_set=process_set))
 
-    def alltoall(self, array, name):
-        return self.synchronize(self.alltoall_async(array, name))
+    def broadcast(self, array, root_rank, name, out=None, process_set=0):
+        return self.synchronize(self.broadcast_async(
+            array, root_rank, name, out=out, process_set=process_set))
+
+    def alltoall(self, array, name, process_set=0):
+        return self.synchronize(
+            self.alltoall_async(array, name, process_set=process_set))
 
     def shutdown(self) -> None:
         collector = getattr(self, "_diagnostics_collector", None)

@@ -233,6 +233,10 @@ def world_changed() -> bool:
             return False
         _state.topology = _world_topology(eng, _state.topology)
         _state.world_epoch_seen = int(w["world_epoch"])
+        # set shapes may have renumbered/evicted: drop the frontend's
+        # id -> size cache so averages divide by the NEW set sizes
+        if hasattr(eng, "_pset_size_cache"):
+            eng._pset_size_cache = {}
         return True
 
 
@@ -242,3 +246,153 @@ def mpi_threads_supported() -> bool:
     ``operations.cc:2461-2468``)."""
     _topology()
     return True
+
+
+# ---------------------------------------------------------------------------
+# process sets (wire v8): keyed sub-communicators
+# ---------------------------------------------------------------------------
+
+class ProcessSet:
+    """A keyed sub-communicator: collectives passed ``process_set=ps`` run
+    over exactly ``ranks``, concurrently with (and bitwise-independent of)
+    every other set's traffic.  Create with :func:`add_process_set`; the
+    module-level :data:`global_process_set` (id 0) is the implicit
+    communicator every plain op runs on.
+
+    ``ranks`` (and therefore :meth:`included`/:meth:`rank`/:meth:`size`)
+    reflect the REGISTRATION-time membership.  After an elastic world
+    change the engine renumbers sets; the collective frontends always
+    resolve the live size/membership from the engine (so averages divide
+    correctly), and :func:`process_set_stats` gives the live view —
+    re-resolve from it after ``world_changed()`` reports a new world."""
+
+    def __init__(self, process_set_id: int, ranks: list[int]) -> None:
+        self.process_set_id = int(process_set_id)
+        self.ranks = [int(r) for r in ranks]
+
+    def size(self) -> int:
+        return len(self.ranks)
+
+    def included(self) -> bool:
+        """Whether the CALLING process is a member."""
+        return rank() in self.ranks
+
+    def rank(self) -> int:
+        """This process's rank WITHIN the set (-1 when outside)."""
+        try:
+            return self.ranks.index(rank())
+        except ValueError:
+            return -1
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"ProcessSet(id={self.process_set_id}, ranks={self.ranks})"
+
+
+# the global set: id 0, every rank.  ``ranks`` is resolved lazily because
+# the world size is unknown before init (and changes under elasticity).
+class _GlobalProcessSet(ProcessSet):
+    def __init__(self) -> None:
+        super().__init__(0, [])
+
+    @property  # type: ignore[override]
+    def ranks(self):  # noqa: D102 - see ProcessSet
+        if _state.initialized and _state.topology is not None:
+            return list(range(_state.topology.size))
+        return []
+
+    @ranks.setter
+    def ranks(self, value):  # the base __init__ assigns; ignore it
+        pass
+
+
+global_process_set = _GlobalProcessSet()
+
+
+def add_process_set(ranks) -> ProcessSet:
+    """Collectively register a process set over ``ranks`` (global ranks,
+    ascending).  EVERY rank of the job must call this with the same list
+    (members and non-members alike), in the same order relative to other
+    ``add_process_set`` calls; the engine assigns the id and builds the
+    set's own communicator (sockets + shm rings) on the members.
+
+    Returns a :class:`ProcessSet` usable as ``hvd.allreduce(...,
+    process_set=ps)`` on member ranks."""
+    members = sorted(int(r) for r in ranks)
+    eng = engine()
+    sid = eng.add_process_set(members)
+    return ProcessSet(sid, members)
+
+
+def process_set_stats() -> list:
+    """Per-set engine statistics (global set first): id, size, this
+    rank's set rank, collectives run, payload bytes, cache hits/misses."""
+    return engine().process_set_stats()
+
+
+# ---------------------------------------------------------------------------
+# hvd.elastic.run — the packaged WorldShrunkError retry loop
+# ---------------------------------------------------------------------------
+
+class _Elastic:
+    """Namespace object exported as ``hvd.elastic``."""
+
+    @staticmethod
+    def run(func=None, *, sync=None, timeout: float = 60.0,
+            max_restarts: int | None = None):
+        """Decorator packaging the elastic recovery loop (the recipe
+        docs/troubleshooting.md used to spell out by hand)::
+
+            def sync_state():                # ONE fixed-name sync point
+                global params
+                params = hvd.broadcast(params, 0, name="sync_state")
+
+            @hvd.elastic.run(sync=sync_state)
+            def train_step(batch):
+                return hvd.allreduce(grads(batch), name="grads")
+
+        The wrapper calls ``sync()`` once up front (program start IS a
+        sync point — that is what lets a relaunched joiner fall in step
+        with mid-stream survivors), then runs ``func``.  When a
+        collective raises :class:`WorldShrunkError` (a membership change
+        cancelled it), the wrapper waits out :func:`world_changed` —
+        which refreshes ``rank()``/``size()`` — re-runs ``sync()``, and
+        retries ``func`` from the top.
+
+        ``timeout`` bounds each wait for the new world (a wire error with
+        no world change behind it re-raises as fatal — see the streak
+        guard in the engine).  ``max_restarts`` bounds retries (None =
+        unbounded).  Usable bare (``@hvd.elastic.run``) or with
+        arguments."""
+        def decorate(fn):
+            import functools
+            import time
+
+            from horovod_tpu.runtime.fault import WorldShrunkError
+
+            @functools.wraps(fn)
+            def wrapper(*args, **kwargs):
+                restarts = 0
+                if sync is not None:
+                    sync()
+                while True:
+                    try:
+                        return fn(*args, **kwargs)
+                    except WorldShrunkError:
+                        if (max_restarts is not None
+                                and restarts >= max_restarts):
+                            raise
+                        restarts += 1
+                        deadline = time.monotonic() + timeout
+                        while not world_changed():
+                            if time.monotonic() > deadline:
+                                raise
+                            time.sleep(0.02)
+                        if sync is not None:
+                            sync()
+
+            return wrapper
+
+        return decorate if func is None else decorate(func)
+
+
+elastic = _Elastic()

@@ -13,8 +13,9 @@ from __future__ import annotations
 
 # csrc/wire.h — frame header
 WIRE_MAGIC = 0x48564457  # "HVDW" little-endian
-WIRE_VERSION = 7         # v7: elastic membership (world-change/ack/commit
-                         # frames; elastic + min-np bootstrap-table fields)
+WIRE_VERSION = 8         # v8: process sets (set-tagged request/response/
+                         # cache frames; kProcessSet op; set registry in
+                         # the bootstrap/world-change table)
 
 # csrc/wire.h — FrameType
 FRAME_INVALID = 0
@@ -44,6 +45,23 @@ FRAME_TYPES = {
 # csrc/wire.h — WorldChangeFrame.kind (elastic membership, wire v7)
 WORLD_CHANGE_SHRINK = 0
 WORLD_CHANGE_JOIN = 1
+
+# csrc/wire.h — set-tagged frames (wire v8): every struct listed here
+# carries a TRAILING `int32_t process_set` field, serialized only when the
+# set is not the global set 0 (global-set-only jobs stay byte-identical to
+# v7 frames) and parsed exactly when trailing bytes remain.
+# tools/check_wire_abi.py parses the struct bodies and asserts this list
+# matches — adding a set-tagged frame without mirroring it here is drift.
+SET_TAGGED_FRAMES = (
+    "RequestList",
+    "ResponseList",
+    "CacheBitsFrame",
+    "CachedExecFrame",
+)
+
+# The global process set's id (the implicit communicator every pre-v8 op
+# ran on; hvd.add_process_set assigns ids starting at 1).
+GLOBAL_PROCESS_SET = 0
 
 
 def frame_header(version: int = WIRE_VERSION,
@@ -77,6 +95,7 @@ OP_BROADCAST = 2
 OP_ALLTOALL = 3
 OP_ERROR = 4
 OP_SHUTDOWN = 5
+OP_PROCESS_SET = 6  # wire v8: collective process-set registration
 
 OP_TYPES = {
     "kAllreduce": OP_ALLREDUCE,
@@ -85,6 +104,7 @@ OP_TYPES = {
     "kAlltoall": OP_ALLTOALL,
     "kError": OP_ERROR,
     "kShutdown": OP_SHUTDOWN,
+    "kProcessSet": OP_PROCESS_SET,
 }
 
 # csrc/common.h — DType codes (also mirrored by runtime/native.py _DTYPES,

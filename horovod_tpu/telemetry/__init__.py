@@ -108,6 +108,18 @@ NATIVE_WORLD_CHANGES = "hvd_world_changes_total"
 NATIVE_RANK_JOINS = "hvd_rank_joins_total"
 NATIVE_SHRINK_LATENCY = "hvd_shrink_latency_seconds"
 
+# process sets (wire v8): registered-set count, plus per-set counters
+# labeled with set="<id>" (the global set is set 0) — collectives run,
+# payload bytes moved, and this rank's steady-state cache lookups, so two
+# concurrent sets' traffic is separable on one dashboard
+NATIVE_PROCESS_SETS = "hvd_process_sets"
+NATIVE_PSET_COLLECTIVES = "hvd_pset_collectives_total"
+NATIVE_PSET_BYTES = "hvd_pset_payload_bytes_total"
+NATIVE_PSET_CACHE_HITS = "hvd_pset_cache_hits_total"
+# shm poison word (wire v8 satellite): data-plane waits that unwedged
+# instantly on a peer's world change instead of riding out the timeout
+NATIVE_SHM_POISONS = "hvd_shm_poisons_total"
+
 _TRUTHY = ("1", "true", "yes", "on")
 
 _registry = MetricsRegistry()
@@ -359,4 +371,6 @@ __all__ = [
     "NATIVE_ABORT_LATENCY", "NATIVE_HEARTBEATS_TX", "NATIVE_HEARTBEATS_RX",
     "NATIVE_WORLD_SIZE", "NATIVE_WORLD_CHANGES", "NATIVE_RANK_JOINS",
     "NATIVE_SHRINK_LATENCY",
+    "NATIVE_PROCESS_SETS", "NATIVE_PSET_COLLECTIVES", "NATIVE_PSET_BYTES",
+    "NATIVE_PSET_CACHE_HITS", "NATIVE_SHM_POISONS",
 ]

@@ -1063,6 +1063,363 @@ def scenario_elastic_dump():
           f"({len(blob)} bytes)", flush=True)
 
 
+def scenario_process_sets():
+    """Functional battery for keyed sub-world collectives (wire v8):
+    disjoint sets {0,1} / {2,3} run allreduce, allgather, broadcast, and
+    alltoall over their OWN communicators (results are functions of the
+    SET ranks, asserted per member), an OVERLAPPING set {1,..,n-1} works
+    against both, global collectives keep working throughout, average
+    divides by the SET size, and non-member submissions fail with a clear
+    error instead of wedging negotiation."""
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    assert n >= 4, "scenario needs -np 4"
+    a = hvd.add_process_set([0, 1])
+    b = hvd.add_process_set([2, 3])
+    c = hvd.add_process_set(list(range(1, n)))
+    assert (a.process_set_id, b.process_set_id) == (1, 2), (a, b)
+    my_sets = [ps for ps in (a, b, c) if ps.included()]
+
+    # interleaved traffic on my sets + the global set, several rounds
+    for step in range(4):
+        handles = []
+        for ps in my_sets:
+            sr, m = ps.rank(), ps.size()
+            handles.append((ps, hvd.allreduce_async(
+                np.full(64, float(sr + 1), np.float32), average=False,
+                name=f"ar{step}", process_set=ps)))
+        gh = hvd.allreduce_async(np.full(32, float(r), np.float32),
+                                 average=False, name=f"g{step}")
+        for ps, h in handles:
+            m = ps.size()
+            got = hvd.synchronize(h)
+            assert np.allclose(got, m * (m + 1) / 2), (r, ps, got[0])
+        got = hvd.synchronize(gh)
+        assert np.allclose(got, n * (n - 1) / 2), (r, got[0])
+
+    for ps in my_sets:
+        sr, m = ps.rank(), ps.size()
+        # average divides by the SET size
+        got = hvd.allreduce(np.full(8, float(m), np.float32), average=True,
+                            process_set=ps, name="avg")
+        assert np.allclose(got, float(m)), (r, ps, got[0])
+        # allgather concatenates in SET-rank order with variable dims
+        gat = hvd.allgather(np.full((sr + 1, 2), float(sr), np.int32),
+                            process_set=ps, name="ag")
+        expect = np.concatenate(
+            [np.full((k + 1, 2), k, np.int32) for k in range(m)])
+        assert np.array_equal(gat, expect), (r, ps, gat)
+        # broadcast root is a SET rank
+        got = hvd.broadcast(np.arange(3, dtype=np.float32) * (sr + 1),
+                            root_rank=m - 1, process_set=ps, name="bc")
+        assert np.allclose(got, np.arange(3, dtype=np.float32) * m), (r, ps)
+        # alltoall scatters among SET members
+        rows = 2 * m
+        inp = (np.arange(rows * 2, dtype=np.float32).reshape(rows, 2)
+               + 100 * sr)
+        got = hvd.alltoall(inp, process_set=ps, name="a2a")
+        expect = np.concatenate([
+            (np.arange(rows * 2, dtype=np.float32).reshape(rows, 2)
+             + 100 * k)[2 * sr:2 * sr + 2]
+            for k in range(m)
+        ])
+        assert np.array_equal(got, expect), (r, ps)
+
+    # non-member submission fails locally with a descriptive error
+    outside = next(ps for ps in (a, b) if not ps.included()) \
+        if not (a.included() and b.included()) else None
+    if outside is not None:
+        try:
+            hvd.allreduce(np.ones(4, np.float32), process_set=outside,
+                          name="nm")
+            raise SystemExit(f"rank {r}: expected non-member error")
+        except RuntimeError as e:
+            assert "not a member" in str(e), str(e)
+
+    # per-set counters separable in the stats rows
+    stats = {row["id"]: row for row in hvd.process_set_stats()}
+    assert 0 in stats and stats[0]["size"] == n, stats
+    for ps in my_sets:
+        row = stats[ps.process_set_id]
+        assert row["size"] == ps.size(), (r, row)
+        assert row["rank"] == ps.rank(), (r, row)
+        assert row["collectives"] >= 8, (r, row)
+        assert row["payload_bytes"] > 0, (r, row)
+    # global barrier before shutdown: per-set workloads are asymmetric,
+    # and an early shutdown (the coordinator's especially) would fail the
+    # other sets' in-flight negotiations
+    hvd.allreduce(np.ones(2, np.float32), average=False, name="fin")
+    hvd.shutdown()
+    print(f"rank {r}: process sets OK", flush=True)
+
+
+def scenario_pset_no_hol():
+    """No head-of-line blocking, asserted DETERMINISTICALLY: rank 3
+    submits its half of set B's collective only once a flag file says
+    set A's whole stream completed — so B's negotiation was provably
+    open the entire time A ran (by construction, not timing).  If one
+    set's pending negotiation or wire gated the other's — the
+    single-communicator engine's failure mode this PR removes — A's
+    loop could never finish while B is held open, and the run would
+    hang at the file gate."""
+    import time
+
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    assert n >= 4
+    a = hvd.add_process_set([0, 1])
+    b = hvd.add_process_set([2, 3])
+    flag = os.environ["HVD_TEST_HOLD_FILE"]
+    rounds = int(os.environ.get("HVD_TEST_ROUNDS", "25"))
+    bh = None
+    if r == 2:
+        bh = hvd.allreduce_async(np.ones(1 << 16, np.float32),
+                                 average=False, name="bheld",
+                                 process_set=b)
+    if r == 3:
+        deadline = time.monotonic() + 120
+        while not os.path.exists(flag):
+            if time.monotonic() > deadline:
+                raise SystemExit("rank 3: set A never finished — "
+                                 "head-of-line blocking?")
+            time.sleep(0.01)
+        bh = hvd.allreduce_async(np.ones(1 << 16, np.float32),
+                                 average=False, name="bheld",
+                                 process_set=b)
+    if r in (0, 1):
+        for i in range(rounds):
+            got = hvd.allreduce(np.full(1 << 14, 1.0, np.float32),
+                                average=False, name=f"a{i}",
+                                process_set=a)
+            assert np.allclose(got, 2.0)
+        stats = {row["id"]: row for row in hvd.process_set_stats()}
+        assert stats[a.process_set_id]["collectives"] == rounds, stats
+        print(f"rank {r}: A_DONE rounds={rounds}", flush=True)
+        if r == 0:
+            with open(flag, "w") as f:
+                f.write("a done")
+    if bh is not None:
+        got = hvd.synchronize(bh)
+        assert np.allclose(got, 2.0)
+        # B's one collective completed only after release (B member view)
+        stats = {row["id"]: row for row in hvd.process_set_stats()}
+        assert stats[b.process_set_id]["collectives"] == 1, stats
+    # everyone joins one final global op so nobody exits early
+    hvd.allreduce(np.ones(4, np.float32), average=False, name="fin")
+    hvd.shutdown()
+    print(f"rank {r}: pset no-hol OK", flush=True)
+
+
+def scenario_pset_dump():
+    """Bitwise checker for sub-world collectives: run a deterministic
+    battery over ONE communicator and dump the raw result bytes by
+    COMMUNICATOR rank.  With HVD_TEST_PSET_MEMBERS set (csv of global
+    ranks) the battery runs on that process set inside a bigger world —
+    with it unset, on the global set of a STANDALONE world launched at
+    the subset's size.  The test asserts the two dumps match byte for
+    byte: a sub-world collective must compute exactly what that subset
+    computes as its own world.  Non-members meanwhile run a steady
+    stream of GLOBAL collectives, so the battery also proves concurrent
+    foreign traffic never perturbs the set's arithmetic."""
+    import ml_dtypes
+
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    out_dir = os.environ["HVD_TEST_OUT_DIR"]
+    members_env = os.environ.get("HVD_TEST_PSET_MEMBERS", "")
+    if members_env:
+        members = [int(x) for x in members_env.split(",")]
+        others = [x for x in range(n) if x not in members]
+        ps = hvd.add_process_set(members)
+        # the complement gets its OWN set: the bystanders' noise rides a
+        # concurrent communicator (a global collective would need the
+        # battery members and could never complete)
+        psn = hvd.add_process_set(others) if others else None
+        comm_rank, comm_size = ps.rank(), ps.size()
+        kw = {"process_set": ps}
+    else:
+        comm_rank, comm_size = r, n
+        kw = {}
+    if members_env and comm_rank < 0:
+        # non-member: stream CONCURRENT traffic over the complement set
+        # while the battery runs, then wait out the members at the final
+        # global sync (ANY rank's early shutdown would fail their ops)
+        for i in range(40):
+            out = hvd.allreduce(np.full(4096, float(r), np.float32),
+                                average=False, name=f"noise{i}",
+                                process_set=psn)
+            assert out is not None
+        hvd.allreduce(np.ones(2, np.float32), average=False, name="pdfin")
+        hvd.shutdown()
+        print(f"rank {r}: pset dump bystander OK", flush=True)
+        return
+    rng = np.random.default_rng(7)  # same stream on every member
+    dtypes = [np.float32, ml_dtypes.bfloat16, np.float64, np.int32,
+              np.float16]
+    sizes = (1, 7, 1001, 32768, 65537)
+    chunks = []
+    for dtype in dtypes:
+        for sz in sizes:
+            base = rng.standard_normal(sz) * 3
+            arr = (base * (comm_rank + 1)).astype(dtype)
+            chunks.append(np.ascontiguousarray(hvd.allreduce(
+                arr, average=False,
+                name=f"pd.{np.dtype(dtype).name}.{sz}", **kw)))
+    # fused batch
+    handles = [
+        hvd.allreduce_async(
+            (rng.standard_normal(sz) * (comm_rank + i)).astype(np.float32),
+            average=False, name=f"pdf{i}", **kw)
+        for i, sz in enumerate((8192 + 3, 8192 + 3, 1001, 513))
+    ]
+    for h in handles:
+        chunks.append(np.ascontiguousarray(hvd.synchronize(h)))
+    # variable-first-dim allgather, broadcast, alltoall
+    for i, rows in enumerate((1, 29)):
+        arr = (rng.standard_normal((rows * (comm_rank + 1), 3))
+               * (comm_rank + 1)).astype(np.float64)
+        chunks.append(np.ascontiguousarray(
+            hvd.allgather(arr, name=f"pdg{i}", **kw)))
+    chunks.append(np.ascontiguousarray(hvd.broadcast(
+        (rng.standard_normal(171) * (comm_rank + 2)).astype(np.float32),
+        root_rank=comm_size - 1, name="pdb", **kw)))
+    rows = 3 * comm_size
+    chunks.append(np.ascontiguousarray(hvd.alltoall(
+        (rng.standard_normal((rows, 2)) + comm_rank).astype(np.float32),
+        name="pda", **kw)))
+    blob = b"".join(cnk.tobytes() for cnk in chunks)
+    with open(os.path.join(out_dir, f"pset_dump_r{comm_rank}.bin"),
+              "wb") as f:
+        f.write(blob)
+    if members_env:
+        # join the bystanders' final global sync before anyone shuts down
+        hvd.allreduce(np.ones(2, np.float32), average=False, name="pdfin")
+    hvd.shutdown()
+    print(f"rank {r}: pset dump OK commrank={comm_rank} "
+          f"({len(blob)} bytes)", flush=True)
+
+
+def scenario_pset_fault_loop():
+    """Chaos workload with two disjoint process sets under an injected
+    death (non-elastic): steady per-set + global allreduce streams until
+    the fault domain aborts — the ABORT must stay JOB-WIDE by default,
+    i.e. members of the set WITHOUT the corpse exit non-zero too."""
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    assert n >= 4
+    a = hvd.add_process_set([0, 1])
+    b = hvd.add_process_set([2, 3])
+    mine = [ps for ps in (a, b) if ps.included()]
+    elems = int(os.environ.get("HVD_TEST_ELEMS", "65536"))
+    try:
+        for step in range(5000):
+            for ps in mine:
+                hvd.allreduce(np.ones(elems, np.float32), average=False,
+                              name="pf", process_set=ps)
+            hvd.allreduce(np.ones(256, np.float32), average=False,
+                          name="pfg")
+    except RuntimeError as e:
+        print(f"rank {r}: FAULT: {e}", flush=True)
+        sys.exit(7)
+    print(f"rank {r}: fault loop ran dry with no fault", flush=True)
+
+
+def scenario_pset_dump_paced_flat():
+    """scenario_pset_dump on a simulated every-rank-its-own-host topology
+    with the flat ring forced: every byte (the set's sub-mesh included)
+    rides paced cross-host TCP."""
+    r = int(os.environ["HOROVOD_TPU_RANK"])
+    os.environ["HOROVOD_TPU_HOST_HASH"] = f"simhost{r}"
+    os.environ["HOROVOD_TPU_HIERARCHICAL_ALLREDUCE"] = "0"
+    scenario_pset_dump()
+
+
+def scenario_pset_elastic():
+    """Elastic + process sets: two disjoint sets under an injected kill of
+    a member of set B.  The world shrinks; set A (no corpse) re-forms with
+    its membership intact and keeps computing, set B re-forms without the
+    dead rank (or evicts, if it lost its last member) — the renumbering
+    flows through the world-change table.  Prints the markers the chaos
+    test parses."""
+    import time as _time
+
+    hvd.init()
+    launch_rank = int(os.environ.get("HOROVOD_TPU_RANK", "0"))
+    n = hvd.size()
+    assert n >= 4
+    a = hvd.add_process_set([0, 1])
+    b = hvd.add_process_set([2, 3])
+    mine = [ps for ps in (a, b) if ps.included()]
+    from horovod_tpu.runtime import state as _st
+
+    deadline = _time.monotonic() + 90
+    changed = False
+    steps_after = 0
+    while _time.monotonic() < deadline:
+        try:
+            for ps in mine:
+                got = hvd.allreduce(np.ones(1 << 14, np.float32),
+                                    average=False, name="pe",
+                                    process_set=ps)
+                assert got is not None
+            hvd.allreduce(np.ones(256, np.float32), average=False,
+                          name="peg")
+        except hvd.WorldShrunkError as e:
+            print(f"rank {launch_rank}: RETRYABLE: {e}", flush=True)
+            while not hvd.world_changed():
+                if _time.monotonic() > deadline:
+                    raise SystemExit(
+                        f"rank {launch_rank}: world never re-formed")
+                _time.sleep(0.02)
+            changed = True
+            # the registry renumbered through the table: re-resolve my
+            # sets from the engine (dead sets drop, survivors renumber)
+            stats = {row["id"]: row for row in hvd.process_set_stats()}
+            mine = []
+            for ps in (a, b):
+                row = stats.get(ps.process_set_id)
+                if row and row["size"] > 0 and row["rank"] >= 0:
+                    mine.append(hvd.ProcessSet(
+                        ps.process_set_id, list(range(row["size"]))))
+            print(f"rank {launch_rank}: WORLD_CHANGED size={hvd.size()} "
+                  f"sets={sorted(stats)} "
+                  f"setsizes={[stats[i]['size'] for i in sorted(stats)]}",
+                  flush=True)
+            continue
+        except RuntimeError as e:
+            if "shut down" in str(e):
+                break
+            raise
+        if changed:
+            steps_after += 1
+            if steps_after >= 10:
+                break
+    if not changed:
+        print(f"rank {launch_rank}: pset elastic ran dry", flush=True)
+        raise SystemExit(5)
+    # the renumbered registry matches the injection's expectation, and
+    # any surviving multi-member set of mine still computes
+    expect_sizes = os.environ.get("HVD_TEST_EXPECT_SETSIZES")
+    if expect_sizes:
+        want = [int(x) for x in expect_sizes.split(",")]
+        stats = {row["id"]: row for row in hvd.process_set_stats()}
+        got_sizes = [stats[i]["size"] for i in sorted(stats)]
+        assert got_sizes == want, (launch_rank, got_sizes, want)
+    for ps in mine:
+        if ps.size() >= 2:
+            got = hvd.allreduce(np.ones(8, np.float32), average=False,
+                                name="pea", process_set=ps)
+            assert np.allclose(got, float(ps.size())), (launch_rank, got[0])
+    # global barrier before shutdown: survivors' final per-set work is
+    # asymmetric, and an early shutdown would fail it mid-negotiation
+    try:
+        hvd.allreduce(np.ones(2, np.float32), average=False, name="pefin")
+    except (RuntimeError, hvd.WorldShrunkError):
+        pass  # a straggler change at the barrier is not what's under test
+    hvd.shutdown()
+    print(f"rank {launch_rank}: pset elastic OK", flush=True)
+
+
 def scenario_fault_sigterm_stuck():
     """Supervision test: rank 0 fails fast; the others trap SIGTERM and
     refuse to die, so only the launcher's grace-then-SIGKILL escalation

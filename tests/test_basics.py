@@ -165,3 +165,100 @@ def test_version_matches_package_metadata():
         m = re.search(r'^version = "([^"]+)"$', f.read(), re.M)
     assert m, "pyproject.toml version line not found"
     assert horovod_tpu.__version__ == m.group(1)
+
+
+# ---------------------------------------------------------------------------
+# process sets (wire v8) — single-process semantics + API objects
+# ---------------------------------------------------------------------------
+
+def test_process_set_single(hvd_single):
+    """A 1-rank world registers {0} and every collective over it is the
+    identity, with average dividing by the SET size (1)."""
+    ps = hvd.add_process_set([0])
+    assert ps.process_set_id >= 1
+    assert ps.included() and ps.rank() == 0 and ps.size() == 1
+    out = hvd.allreduce(np.array([3.0], np.float32), average=True,
+                        process_set=ps)
+    assert np.allclose(out, 3.0)
+    got = hvd.broadcast(np.arange(4, dtype=np.float32), root_rank=0,
+                        process_set=ps)
+    assert np.allclose(got, np.arange(4))
+    rows = hvd.process_set_stats()
+    assert rows[0]["id"] == 0 and rows[0]["size"] == 1
+    assert any(row["id"] == ps.process_set_id for row in rows)
+
+
+def test_process_set_single_rejects_foreign_ranks(hvd_single):
+    with pytest.raises(RuntimeError):
+        hvd.add_process_set([0, 1])
+
+
+def test_global_process_set_object(hvd_single):
+    gps = hvd.global_process_set
+    assert gps.process_set_id == 0
+    assert gps.included() and gps.rank() == 0
+    assert gps.ranks == [0]
+    # passing it explicitly is the same as passing nothing
+    out = hvd.allreduce(np.ones(3, np.float32), average=False,
+                        process_set=gps)
+    assert np.allclose(out, 1.0)
+
+
+def test_unknown_process_set_errors(hvd_single):
+    with pytest.raises(RuntimeError):
+        hvd.allreduce(np.ones(2, np.float32), process_set=77)
+
+
+def test_elastic_run_decorator_retries(hvd_single):
+    """hvd.elastic.run packages the catch/wait/resync loop: the wrapped
+    step retries after WorldShrunkError once world_changed() reports the
+    new world, calling the sync callback at start and after each
+    change."""
+    import horovod_tpu.runtime.state as state_mod
+
+    calls = {"sync": 0, "step": 0}
+    boom = {"armed": True}
+
+    def sync():
+        calls["sync"] += 1
+
+    @hvd.elastic.run(sync=sync, timeout=5.0)
+    def step():
+        calls["step"] += 1
+        if boom["armed"]:
+            boom["armed"] = False
+            raise hvd.WorldShrunkError("simulated membership change")
+        return "ok"
+
+    orig = state_mod.world_changed
+    state_mod.world_changed = lambda: True
+    try:
+        assert step() == "ok"
+    finally:
+        state_mod.world_changed = orig
+    assert calls["step"] == 2      # failed once, retried once
+    assert calls["sync"] == 2      # at start + after the change
+
+
+def test_elastic_run_decorator_bare(hvd_single):
+    @hvd.elastic.run
+    def step(x):
+        return x + 1
+
+    assert step(41) == 42
+
+
+def test_elastic_run_max_restarts(hvd_single):
+    import horovod_tpu.runtime.state as state_mod
+
+    @hvd.elastic.run(max_restarts=1, timeout=5.0)
+    def step():
+        raise hvd.WorldShrunkError("always")
+
+    orig = state_mod.world_changed
+    state_mod.world_changed = lambda: True
+    try:
+        with pytest.raises(hvd.WorldShrunkError):
+            step()
+    finally:
+        state_mod.world_changed = orig

@@ -234,6 +234,57 @@ def test_wire_artifact_shows_striping_and_sg_working():
             0.01 * k4["stripe_kb_per_step"], 1.0), p
 
 
+def test_pset_counted_series_gate():
+    """Fresh per-set counted series at the BENCH_r12 workload shape vs
+    the artifact: each member's per-set collective count and payload KB
+    are EXACT functions of (steps, payload, membership) — any drift
+    means set routing or the per-set counters changed shape.  The gate
+    run skips the artifact's pacing (counted series are
+    pacing-independent) and uses a short loop."""
+    old = _baseline("BENCH_r12.json")
+    cfg = old.get("config", {})
+    steps, mb = 4, int(cfg.get("mb", 16))
+    point = _bench_worker_json(
+        4,
+        ["--pset-worker", "--pset-steps", str(steps),
+         "--pset-mb", str(mb)],
+        {"HVD_PSET_MODE": "sets", "HOROVOD_TPU_CYCLE_TIME": "1"},
+        timeout=300)
+    assert point.get("mode") == "sets", point
+    # counted: every member ran exactly `steps` collectives on ITS set,
+    # each moving exactly steps*mb KB of payload
+    assert point["set_collectives_per_member"] == [steps] * 4, point
+    assert point["set_kb_per_member"] == [float(steps * mb * 1024)] * 4, \
+        point
+    assert point["member_set_ids"] == [1, 1, 2, 2], point
+    # the artifact's own counted series carry the full-size run
+    art = old["np4"]["concurrent_sets"]
+    full = int(cfg.get("steps", 8))
+    assert art["set_collectives_per_member"] == [full] * 4, art
+    assert art["set_kb_per_member"] == [float(full * mb * 1024)] * 4, art
+
+
+def test_pset_artifact_shows_concurrency_and_no_hol():
+    """The acceptance shape, asserted on the checked-in artifact: the
+    no-head-of-line probe COUNTED set A running its whole stream to
+    completion while set B's negotiation was provably open (B's last
+    member submits only after a file-gate on A finishing, so
+    a_collectives == rounds is by-construction "while B pending"; the B
+    member then saw exactly its one released collective), and the
+    concurrent-vs-serialized comparison was recorded (the wall speedup
+    itself is a paced-fabric measurement and is not gated)."""
+    r12 = _baseline("BENCH_r12.json")
+    p = r12.get("np4")
+    assert p, r12
+    hol = p["hol_probe"]
+    assert hol["no_head_of_line_blocking"] is True, hol
+    assert hol["a_collectives_while_b_pending"] == hol["rounds"], hol
+    assert hol["b_collectives_after_release"] == 1, hol
+    assert p["serialized_global"]["collectives"] == 2 * \
+        p["concurrent_sets"]["steps"], p
+    assert p.get("speedup_concurrent_vs_global") is not None, p
+
+
 def test_ring_counted_series_gate():
     """Fresh segmented ring at the BENCH_r08 workload (-np 2, shm,
     256 KB segments) vs the artifact: segments/ring and KB/ring are

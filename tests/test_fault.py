@@ -164,8 +164,13 @@ def test_kill_at_unpack():
 def test_hang_detected_by_heartbeat_timeout():
     """A wedged-but-alive rank sends no frames: only the heartbeat age can
     catch it (its sockets never close).  Survivors must exit non-zero with
-    the peer-timeout message naming the rank."""
-    res = _run_chaos("fault_loop", 3, "hang:rank=1:cycle=15")
+    the peer-timeout message naming the rank.  The data-plane no-progress
+    bound is pinned ABOVE the heartbeat bound so the two detectors (same
+    default bound, started within ms of each other) don't race for which
+    message surfaces — this row is specifically about the heartbeat path;
+    the data-plane bound has its own rows."""
+    res = _run_chaos("fault_loop", 3, "hang:rank=1:cycle=15",
+                     extra_env={"HOROVOD_TPU_DATA_TIMEOUT_S": "60"})
     _assert_died_well(res, dead_rank=1, np_=3)
     assert "sent no control frames" in res.stdout, res.stdout
 
@@ -489,6 +494,60 @@ def test_elastic_join_after_restart():
 # ---------------------------------------------------------------------------
 # hvdrun supervision: exit-code propagation, grace kill, post-mortem
 # ---------------------------------------------------------------------------
+
+# ---------------------------------------------------------------------------
+# process sets x fault domain (wire v8)
+# ---------------------------------------------------------------------------
+
+def test_pset_abort_stays_job_wide():
+    """Default (non-elastic) semantics with process sets: a death in set
+    {2,3} aborts the WHOLE job — members of the disjoint set {0,1} exit
+    non-zero with the rank-naming cause too, exactly like any other
+    death.  Scoping a failure to one set is an ELASTIC behavior, never
+    the default."""
+    res = _run_chaos("pset_fault_loop", 4, "kill:rank=3:phase=ring:hit=6",
+                     extra_env={"HVD_TEST_ELEMS": "500000"})
+    _assert_died_well(res, dead_rank=3, np_=4)
+    # specifically: at least one member of the DISJOINT set surfaced it
+    assert ("rank 0: FAULT:" in res.stdout
+            or "rank 1: FAULT:" in res.stdout), res.stdout
+
+
+def test_pset_elastic_disjoint_set_survives():
+    """Elastic mode: a death in set {2,3} shrinks the world; the disjoint
+    set {0,1} re-forms with its membership INTACT (renumbered through the
+    world-change table) and keeps computing, the corpse's set re-forms
+    around the survivor, and the job exits 0."""
+    res = _run_elastic("pset_elastic", 4, "kill:rank=3:phase=ring:hit=6",
+                       hvdrun_args=("--min-np", "1"),
+                       extra_env={"HVD_TEST_ELEMS": "500000",
+                                  "HVD_TEST_EXPECT_SETSIZES": "3,2,1"})
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "RETRYABLE:" in res.stdout, res.stdout
+    # registry after the shrink: world of 3, set 1 (A) still 2 members,
+    # set 2 (B) down to 1
+    assert "setsizes=[3, 2, 1]" in res.stdout, res.stdout
+    for r in (0, 1, 2):
+        assert f"rank {r}: pset elastic OK" in res.stdout, (
+            r, res.stdout + res.stderr)
+    assert "aborting job" not in res.stdout, res.stdout
+
+
+def test_pset_elastic_shrink_renumbers_all_sets():
+    """Elastic kill of rank 1 (a member of set {0,1}): ranks 2,3 renumber
+    to 1,2 and BOTH sets renumber consistently through the same table —
+    set A keeps its survivor (now alone), set B keeps both members at
+    their new ranks and still computes."""
+    res = _run_elastic("pset_elastic", 4, "kill:rank=1:phase=ring:hit=6",
+                       hvdrun_args=("--min-np", "1"),
+                       extra_env={"HVD_TEST_ELEMS": "500000",
+                                  "HVD_TEST_EXPECT_SETSIZES": "3,1,2"})
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "setsizes=[3, 1, 2]" in res.stdout, res.stdout
+    for r in (0, 2, 3):
+        assert f"rank {r}: pset elastic OK" in res.stdout, (
+            r, res.stdout + res.stderr)
+
 
 def test_hvdrun_propagates_first_failing_code():
     env = dict(os.environ, JAX_PLATFORMS="cpu")

@@ -847,6 +847,139 @@ def test_pipeline_race_free_under_tsan():
         assert f"rank {r}: pipeline inflight OK" in res.stdout
 
 
+# ---------------------------------------------------------------------------
+# process sets (wire v8): keyed sub-world communicators
+# ---------------------------------------------------------------------------
+
+def test_process_sets_functional():
+    """Disjoint + overlapping sets run every collective over their own
+    communicators (results keyed by SET rank), the global set keeps
+    working, averages divide by the set size, non-members fail cleanly,
+    and the per-set stats rows are separable."""
+    res = _run("process_sets", 4, timeout=180)
+    assert res.returncode == 0, res.stderr + res.stdout
+    for r in range(4):
+        assert f"rank {r}: process sets OK" in res.stdout
+
+
+def test_process_sets_no_head_of_line_blocking(tmp_path):
+    """The acceptance property, deterministically: set B's negotiation is
+    held open (its last member's submission is file-gated on set A
+    FINISHING) while set A completes a pile of collectives — per-set
+    counters prove A's traffic ran to completion while B stayed pending,
+    by construction rather than timing.  The single-communicator engine
+    could not do this: every op shared one negotiation round and one
+    executor FIFO."""
+    res = _run("pset_no_hol", 4, timeout=180,
+               env={"HVD_TEST_HOLD_FILE": str(tmp_path / "a_done.flag")})
+    assert res.returncode == 0, res.stderr + res.stdout
+    for r in (0, 1):
+        assert f"rank {r}: A_DONE" in res.stdout, res.stdout
+    for r in range(4):
+        assert f"rank {r}: pset no-hol OK" in res.stdout
+
+
+def _pset_dump_blobs(tmp_path, label, np_, env):
+    out = tmp_path / label
+    out.mkdir()
+    full_env = {"HVD_TEST_OUT_DIR": str(out),
+                # pin batching so both runs fuse identical groups (fusion
+                # grouping moves ring chunk boundaries — the same pinning
+                # every other bitwise battery uses)
+                "HOROVOD_TPU_CYCLE_TIME": "100",
+                "HOROVOD_TPU_BURST_WINDOW_US": "50000"}
+    full_env.update(env)
+    res = _run("pset_dump", np_, timeout=240, env=full_env)
+    assert res.returncode == 0, res.stderr + res.stdout
+    return res
+
+
+@pytest.mark.parametrize("members,standalone_np", [
+    ("0,1", 2),
+    pytest.param("1,3", 2, marks=pytest.mark.slow),
+    pytest.param("0,1,2", 3, marks=pytest.mark.slow),
+])
+def test_pset_bitwise_vs_standalone_world(tmp_path, members, standalone_np):
+    """A sub-world collective must be BITWISE identical to running that
+    subset as a standalone world: same members (by communicator rank),
+    same rng inputs, same dumps — while non-members flood the global set
+    with concurrent traffic.  Covers non-contiguous member lists (the
+    set-rank remapping) via the slow rows."""
+    sub = _pset_dump_blobs(tmp_path, "sub", 4,
+                           {"HVD_TEST_PSET_MEMBERS": members})
+    alone = _pset_dump_blobs(tmp_path, "alone", standalone_np, {})
+    del sub, alone
+    m = standalone_np
+    for cr in range(m):
+        with open(tmp_path / "sub" / f"pset_dump_r{cr}.bin", "rb") as f:
+            sub_b = f.read()
+        with open(tmp_path / "alone" / f"pset_dump_r{cr}.bin", "rb") as f:
+            alone_b = f.read()
+        assert sub_b == alone_b, (
+            f"comm rank {cr}: sub-world results differ from the "
+            f"standalone {m}-rank world")
+
+
+def test_pset_bitwise_vs_standalone_tcp(tmp_path):
+    """The same sub-world-vs-standalone identity with shm off: every
+    byte of both runs rides (the set's own) TCP links."""
+    env = {"HOROVOD_TPU_SHM": "0"}
+    _pset_dump_blobs(tmp_path, "sub", 4,
+                     dict(env, HVD_TEST_PSET_MEMBERS="0,1"))
+    _pset_dump_blobs(tmp_path, "alone", 2, env)
+    for cr in range(2):
+        sub_b = (tmp_path / "sub" / f"pset_dump_r{cr}.bin").read_bytes()
+        alone_b = (tmp_path / "alone" / f"pset_dump_r{cr}.bin").read_bytes()
+        assert sub_b == alone_b, f"comm rank {cr} diverged over TCP"
+
+
+@pytest.mark.slow  # 4-proc paced run
+def test_pset_bitwise_vs_standalone_paced(tmp_path):
+    """Sub-world-vs-standalone identity on a simulated one-rank-per-host
+    topology (every byte rides paced cross-host TCP, flat ring): the
+    set's dedicated sub-mesh inherits pacing and stays bitwise-exact
+    under it.  Uses the pset_dump_paced_flat worker wrapper, which gives
+    each rank its own host hash before init."""
+    env = {"HOROVOD_TPU_CROSS_HOST_PACE_MBPS": "200"}
+    res = _run("pset_dump_paced_flat", 4, timeout=300, env=dict(
+        env, HVD_TEST_PSET_MEMBERS="0,1",
+        HVD_TEST_OUT_DIR=str((tmp_path / "sub").mkdir() or tmp_path / "sub"),
+        HOROVOD_TPU_CYCLE_TIME="100",
+        HOROVOD_TPU_BURST_WINDOW_US="50000"))
+    assert res.returncode == 0, res.stderr + res.stdout
+    res = _run("pset_dump_paced_flat", 2, timeout=300, env=dict(
+        env,
+        HVD_TEST_OUT_DIR=str((tmp_path / "alone").mkdir()
+                             or tmp_path / "alone"),
+        HOROVOD_TPU_CYCLE_TIME="100",
+        HOROVOD_TPU_BURST_WINDOW_US="50000"))
+    assert res.returncode == 0, res.stderr + res.stdout
+    for cr in range(2):
+        sub_b = (tmp_path / "sub" / f"pset_dump_r{cr}.bin").read_bytes()
+        alone_b = (tmp_path / "alone" / f"pset_dump_r{cr}.bin").read_bytes()
+        assert sub_b == alone_b, f"comm rank {cr} diverged under pacing"
+
+
+def test_process_set_stats_api_shape():
+    """The process-set stats C API returns 0 rows when the engine is
+    down, and add_process_set raises instead of wedging."""
+    import ctypes
+
+    from horovod_tpu.runtime.native import lib_path
+
+    lib = ctypes.CDLL(lib_path())
+    lib.hvd_process_set_stats.argtypes = [ctypes.POINTER(ctypes.c_int64),
+                                          ctypes.c_int]
+    lib.hvd_process_set_stats.restype = ctypes.c_int
+    vals = (ctypes.c_int64 * 64)()
+    assert lib.hvd_process_set_stats(vals, 8) == 0
+    lib.hvd_add_process_set.argtypes = [ctypes.POINTER(ctypes.c_int64),
+                                        ctypes.c_int]
+    lib.hvd_add_process_set.restype = ctypes.c_int
+    ranks = (ctypes.c_int64 * 2)(0, 1)
+    assert lib.hvd_add_process_set(ranks, 2) == -1  # engine down
+
+
 def test_accum_blocked_kernels_match_scalar_bitwise():
     """The blocked fp16/bf16 accumulate fallbacks must reproduce the
     scalar helpers bit for bit across ALL 65536 input patterns (normals,

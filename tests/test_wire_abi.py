@@ -34,7 +34,7 @@ def test_checker_detects_version_drift():
     """The guard must actually bite: a simulated version bump in wire.h
     without a Python update is reported."""
     wire_h, common_h = _headers()
-    tampered = wire_h.replace("kWireVersion = 7", "kWireVersion = 8")
+    tampered = wire_h.replace("kWireVersion = 8", "kWireVersion = 9")
     assert tampered != wire_h, "kWireVersion moved; update this test"
     problems = check_wire_abi.check(tampered, common_h)
     assert any("kWireVersion" in p for p in problems), problems
@@ -86,22 +86,49 @@ def test_v6_tuned_wire_stripes_present():
 
 def test_v7_world_frames_present():
     """The elastic membership's wire v7 collateral: world-change/ack/commit
-    frame types exist on both sides of the mirror at the pinned ids, and
-    the version is 7 on both sides."""
+    frame types exist on both sides of the mirror at the pinned ids."""
     from horovod_tpu.runtime import wire_abi
 
-    assert wire_abi.WIRE_VERSION == 7
     assert wire_abi.FRAME_TYPES["kWorldChange"] == 7
     assert wire_abi.FRAME_TYPES["kWorldAck"] == 8
     assert wire_abi.FRAME_TYPES["kWorldCommit"] == 9
     wire_h, _ = _headers()
-    assert "kWireVersion = 7" in wire_h
     for needle in ("kWorldChange = 7", "kWorldAck = 8", "kWorldCommit = 9"):
         assert needle in wire_h, needle
 
 
+def test_v8_process_set_collateral_present():
+    """The process-set subsystem's wire v8 collateral: the version is 8 on
+    both sides, the kProcessSet op exists at its pinned id, and the four
+    negotiation-side frames carry the trailing set tag in both mirrors."""
+    from horovod_tpu.runtime import wire_abi
+
+    assert wire_abi.WIRE_VERSION == 8
+    assert wire_abi.OP_TYPES["kProcessSet"] == wire_abi.OP_PROCESS_SET == 6
+    assert wire_abi.GLOBAL_PROCESS_SET == 0
+    assert wire_abi.SET_TAGGED_FRAMES == (
+        "RequestList", "ResponseList", "CacheBitsFrame", "CachedExecFrame")
+    wire_h, common_h = _headers()
+    assert "kWireVersion = 8" in wire_h
+    assert "kProcessSet = 6" in common_h
+    assert wire_h.count("int32_t process_set = 0;") == 4
+
+
+def test_checker_detects_set_tag_drift():
+    """A set tag added to a frame without the SET_TAGGED_FRAMES mirror (the
+    v8 drift-guard extension) is reported."""
+    wire_h, common_h = _headers()
+    tampered = wire_h.replace(
+        "struct HeartbeatFrame {\n  int32_t rank = 0;",
+        "struct HeartbeatFrame {\n  int32_t rank = 0;\n"
+        "  int32_t process_set = 0;", 1)
+    assert tampered != wire_h, "HeartbeatFrame moved; update this test"
+    problems = check_wire_abi.check(tampered, common_h)
+    assert any("set-tagged" in p for p in problems), problems
+
+
 def test_version_mismatch_message_names_both_versions():
-    """A stale-version frame hitting a v7 engine must produce the
+    """A stale-version frame hitting a v8 engine must produce the
     descriptive both-versions error — the operator-facing contract for a
     mixed .so deployment — via the native parse probe.  Skips (not fails)
     when the .so predates the probe."""
@@ -124,7 +151,7 @@ def test_version_mismatch_message_names_both_versions():
     lib.hvd_free_cstr.argtypes = [ctypes.c_void_p]
     lib.hvd_wire_version.restype = ctypes.c_int
 
-    assert lib.hvd_wire_version() == wire_abi.WIRE_VERSION == 7
+    assert lib.hvd_wire_version() == wire_abi.WIRE_VERSION == 8
 
     def parse_error(buf: bytes) -> str | None:
         p = lib.hvd_frame_parse_error(buf, len(buf))
@@ -135,19 +162,19 @@ def test_version_mismatch_message_names_both_versions():
         finally:
             lib.hvd_free_cstr(p)
 
-    # v6 <-> v7 (the previous release still running somewhere): the elastic
-    # membership's version bump must surface as the descriptive
+    # v7 <-> v8 (the previous release still running somewhere): the
+    # process-set version bump must surface as the descriptive
     # both-versions message, exactly like every previous bump
+    stale = wire_abi.frame_header(version=7) + b"\x00" * 16
+    msg = parse_error(stale)
+    assert msg is not None
+    assert "v7" in msg and "v8" in msg and "libhvdtpu.so" in msg, msg
+
+    # an even older v6 header: same contract, both versions named
     stale = wire_abi.frame_header(version=6) + b"\x00" * 16
     msg = parse_error(stale)
     assert msg is not None
-    assert "v6" in msg and "v7" in msg and "libhvdtpu.so" in msg, msg
-
-    # an even older v5 header: same contract, both versions named
-    stale = wire_abi.frame_header(version=5) + b"\x00" * 16
-    msg = parse_error(stale)
-    assert msg is not None
-    assert "v5" in msg and "v7" in msg and "libhvdtpu.so" in msg, msg
+    assert "v6" in msg and "v8" in msg and "libhvdtpu.so" in msg, msg
 
     # current-version garbage is a parse error, not a version error
     import struct

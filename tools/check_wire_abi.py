@@ -52,6 +52,17 @@ def _parse_tuned_fields(text: str, struct_name: str) -> tuple[str, ...]:
     return tuple(re.findall(r"int64_t\s+(tuned_\w+)\s*=", m.group(1)))
 
 
+def _parse_set_tagged(text: str) -> tuple[str, ...]:
+    """Frame structs carrying the trailing ``int32_t process_set`` set tag
+    (wire v8), in declaration order — the Python mirror's
+    ``SET_TAGGED_FRAMES`` must track them exactly."""
+    out = []
+    for m in re.finditer(r"struct\s+(\w+)\s*\{(.*?)\n\};", text, re.S):
+        if re.search(r"int32_t\s+process_set\s*=", m.group(2)):
+            out.append(m.group(1))
+    return tuple(out)
+
+
 def check(wire_h: str, common_h: str) -> list[str]:
     """All drift problems between the C++ headers' text and the Python
     mirrors; empty list = in sync."""
@@ -86,6 +97,19 @@ def check(wire_h: str, common_h: str) -> list[str]:
             problems.append(
                 f"{struct} tuned knobs: wire.h has {got}, wire_abi.py "
                 f"TUNED_KNOBS has {want_knobs}")
+
+    # set-tagged frames (wire v8): the trailing process_set tag must ride
+    # exactly the frames the Python mirror lists — tagging a new frame (or
+    # untagging one) is a layout change the mirror has to track
+    tagged = _parse_set_tagged(wire_h)
+    want_tagged = tuple(wire_abi.SET_TAGGED_FRAMES)
+    # Request carries a NON-serialized routing field; exclude struct
+    # Request itself from the wire comparison only if present
+    tagged_frames = tuple(t for t in tagged if t != "Request")
+    if tagged_frames != want_tagged:
+        problems.append(
+            f"set-tagged frames: wire.h has {tagged_frames}, wire_abi.py "
+            f"SET_TAGGED_FRAMES has {want_tagged}")
 
     ops = _parse_enum(common_h, "OpType")
     if ops != wire_abi.OP_TYPES:
