@@ -51,6 +51,7 @@
 #include "socket.h"
 #include "timeline.h"
 #include "topo.h"
+#include "trace.h"
 #include "wire.h"
 
 namespace hvdtpu {
@@ -551,6 +552,11 @@ struct NegState {
   std::deque<int> cached_ready;             // fully-claimed slots, FIFO
   // this rank's steady-state lookups on this set (diagnostics thread)
   std::atomic<int64_t> hits{0}, misses{0};
+  // flight-recorder round counter: +1 per payload response dispatched on
+  // this set.  Responses broadcast in stream order, so every rank counts
+  // identically — (set, epoch, round) is the cross-rank collective
+  // identity the trace merger correlates on, with NO wire change.
+  uint32_t trace_rounds = 0;
 
   int expected() const { return static_cast<int>(members.size()); }
   int IndexOf(int g) const {
@@ -576,6 +582,7 @@ struct NegState {
     bits_inflight.clear();
     resend.clear();
     cache.Init(cache_capacity, set_id);
+    trace_rounds = 0;  // rounds restart with the membership (epoch bumps)
   }
 };
 
@@ -633,7 +640,10 @@ struct ProcessSet {
   std::thread exec;
   std::mutex mu;
   std::condition_variable cv;
-  std::deque<Response> work;  // guarded by mu
+  // (flight-recorder round, response): the round is assigned on the bg
+  // thread at the set's stream position and rides along so the executor's
+  // events carry the same identity every rank assigned this response
+  std::deque<std::pair<uint32_t, Response>> work;  // guarded by mu
   bool stop = false;          // guarded by mu
   bool busy = false;          // guarded by mu
   // counters, readable from the diagnostics thread
@@ -845,7 +855,7 @@ class Engine {
   Status AcceptSetConn(int set_id, int* rank_out, int* stripe_out,
                        Socket* out);
   void SetExecLoop(ProcessSet* ps);       // set executor thread body
-  void ExecuteSet(ProcessSet& ps, const Response& resp);
+  void ExecuteSet(ProcessSet& ps, const Response& resp, uint32_t round);
   void DispatchSet(ProcessSet& ps, const Response& resp);  // bg thread
   // World change support: drain set executors + clear their queues
   // (BeginWorldChange), reconcile psets_ with the table registry
@@ -1008,6 +1018,10 @@ class Engine {
     // both ends of every link must apply the same cap at the same
     // collective boundary or the striped streams reassemble wrong
     int64_t wire_stripes = Link::kMaxStripes;
+    // flight-recorder identity, captured at dispatch in stream order so
+    // the executor's wire events carry the same (set, epoch, round) every
+    // rank assigned this response
+    TraceCtx trace;
     Status status;                 // wire result (set by the executor)
   };
   void Dispatch(const Response& resp);          // inline or pipelined
@@ -1434,6 +1448,10 @@ Comm& Engine::C() { return t_comm != nullptr ? *t_comm : world_comm_; }
 Status Engine::Init(const std::string& host, int port, int rank, int size) {
   rank_ = rank;
   size_ = size;
+  // flight recorder first: bootstrap itself should be on the record (a
+  // rank SIGKILLed mid-rendezvous leaves a black box too).  File-backed
+  // when HOROVOD_TPU_TRACE_DIR is set; HOROVOD_TPU_TRACE=0 disables.
+  TraceInit(rank_, size_);
   fusion_threshold_ = EnvInt64("HOROVOD_TPU_FUSION_THRESHOLD",
                                EnvInt64("HOROVOD_FUSION_THRESHOLD", 64 << 20));
   cycle_us_ = 1000 * EnvInt64("HOROVOD_TPU_CYCLE_TIME",
@@ -1569,6 +1587,21 @@ Status Engine::Init(const std::string& host, int port, int rank, int size) {
         s = workers_[i].SendFrame(table);
         if (!s.ok()) return s;
       }
+      // one-shot clock-offset probe, piggybacked on the rendezvous star:
+      // each worker pings three times and we answer with our monotonic
+      // clock, so merged flight-recorder timestamps align across hosts.
+      // Raw frames (not SendCtrl/RecvCtrl): the probe must not perturb
+      // the counted control-plane byte series.
+      for (int i = 1; i < size_; i++) {
+        for (int k = 0; k < 3; k++) {
+          std::string probe;
+          s = workers_[i].RecvFrame(&probe);
+          if (!s.ok()) return s;
+          s = workers_[i].SendFrame(
+              std::to_string(trace_detail::TraceNowNs()));
+          if (!s.ok()) return s;
+        }
+      }
       if (!elastic_) {
         // non-elastic jobs never admit joiners: release the port
         rendezvous_.Close();
@@ -1601,6 +1634,25 @@ Status Engine::Init(const std::string& host, int port, int rank, int size) {
             "bootstrap table describes " + std::to_string(hosts_.size()) +
             " ranks but this worker was launched into a world of " +
             std::to_string(size_) + " — HOROVOD_TPU_SIZE skew?");
+      // clock-offset probe (see the coordinator side above): three
+      // round trips, keep the minimum-RTT sample — offset = coordinator
+      // clock minus the midpoint of our send/recv stamps
+      int64_t best_rtt = -1, offset = 0;
+      for (int k = 0; k < 3; k++) {
+        int64_t t0p = trace_detail::TraceNowNs();
+        s = coord_.SendFrame("clk");
+        if (!s.ok()) return s;
+        std::string reply;
+        s = coord_.RecvFrame(&reply);
+        if (!s.ok()) return s;
+        int64_t t1p = trace_detail::TraceNowNs();
+        int64_t tc = strtoll(reply.c_str(), nullptr, 10);
+        if (best_rtt < 0 || t1p - t0p < best_rtt) {
+          best_rtt = t1p - t0p;
+          offset = tc - (t0p + t1p) / 2;
+        }
+      }
+      TraceSetClockOffset(offset);
     }
   } else {
     // single-process world: no mesh, but BuildWorld still derives the
@@ -2498,6 +2550,14 @@ void Engine::FinishWorldChange(bool join, int64_t t0_ns) {
   Faults().shrink_latency_ns.fetch_add(NowNs() - t0_ns,
                                        std::memory_order_relaxed);
   world_epoch_.fetch_add(1, std::memory_order_relaxed);
+  // black box: membership changes are exactly when an operator will want
+  // the pre-change engine activity — snapshot the recorder and re-stamp
+  // its world view (this rank may have been renumbered)
+  TraceSetWorld(rank_, size_,
+                static_cast<uint64_t>(
+                    world_epoch_.load(std::memory_order_relaxed)));
+  TraceAutoDump(TracePhase::kWorldChange,
+                world_epoch_.load(std::memory_order_relaxed));
   elastic_wire_fails_.store(0, std::memory_order_relaxed);
   {
     // a shutdown announced DURING the change was discarded with the rest
@@ -2889,9 +2949,17 @@ void Engine::DispatchSet(ProcessSet& ps, const Response& resp) {
     Execute(resp);  // completes the handles inline; touches no transport
     return;
   }
+  // round assigned at the set's stream position — identical on every rank
+  uint32_t round = ++ps.neg.trace_rounds;
+  t_trace_ctx = {ps.id,
+                 static_cast<uint16_t>(
+                     world_epoch_.load(std::memory_order_relaxed)),
+                 round, static_cast<uint8_t>(resp.op)};
+  TraceEmitEnd(TracePhase::kNegotiate,
+               static_cast<int64_t>(resp.names.size()));
   {
     std::lock_guard<std::mutex> lk(ps.mu);
-    ps.work.push_back(resp);
+    ps.work.emplace_back(round, resp);
   }
   ps.cv.notify_one();
 }
@@ -2902,17 +2970,22 @@ void Engine::SetExecLoop(ProcessSet* ps) {
   // FailAll) exactly like the global data-plane executor's
   t_comm = &ps->comm;
   t_on_executor = true;
+  {
+    char nm[16];
+    snprintf(nm, sizeof(nm), "set%d", ps->id);
+    TraceNameThread(nm);
+  }
   for (;;) {
-    Response resp;
+    std::pair<uint32_t, Response> item;
     {
       std::unique_lock<std::mutex> lk(ps->mu);
       ps->cv.wait(lk, [&] { return !ps->work.empty() || ps->stop; });
       if (ps->work.empty()) return;  // stop with a drained queue
-      resp = std::move(ps->work.front());
+      item = std::move(ps->work.front());
       ps->work.pop_front();
       ps->busy = true;
     }
-    ExecuteSet(*ps, resp);
+    ExecuteSet(*ps, item.second, item.first);
     {
       std::lock_guard<std::mutex> lk(ps->mu);
       ps->busy = false;
@@ -2922,7 +2995,12 @@ void Engine::SetExecLoop(ProcessSet* ps) {
   }
 }
 
-void Engine::ExecuteSet(ProcessSet& ps, const Response& resp) {
+void Engine::ExecuteSet(ProcessSet& ps, const Response& resp,
+                        uint32_t round) {
+  t_trace_ctx = {ps.id,
+                 static_cast<uint16_t>(
+                     world_epoch_.load(std::memory_order_relaxed)),
+                 round, static_cast<uint8_t>(resp.op)};
   std::vector<TensorEntry> entries;
   {
     std::lock_guard<std::mutex> lk(mu_);
@@ -3166,6 +3244,7 @@ void Engine::Shutdown() {
   // before anyone's sockets close) and stop
   StopSetExecutors();
   timeline_.Shutdown();
+  TraceDump(nullptr);  // flush the flight recorder's final state
 }
 
 // ---------------------------------------------------------------------------
@@ -3208,6 +3287,14 @@ int Engine::Enqueue(OpType op, const std::string& name, DType dtype,
       return handle;
     }
   }
+  // flight recorder: submission marker on the caller's thread.  The
+  // negotiated round is unknown here (round 0); the merge tool keys this
+  // event by time and set only.
+  t_trace_ctx = {process_set,
+                 static_cast<uint16_t>(
+                     world_epoch_.load(std::memory_order_relaxed)),
+                 0, static_cast<uint8_t>(op)};
+  TraceEmit(TracePhase::kEnqueue, static_cast<int64_t>(nbytes));
   // in-place (out aliases input): no staging at all — the collective runs
   // on the caller's buffer; otherwise stage the input outside the lock
   // (pooled: warm pages after the first few ops instead of a fresh 64 MB
@@ -3303,6 +3390,10 @@ std::string Engine::TakeError(int handle) {
 
 void Engine::MarkDone(int handle, Status st, std::vector<int64_t> dims,
                       std::vector<char> result) {
+  // one completion event per handle (identity from the completing
+  // thread's context; arg = status code) — the deterministic per-tensor
+  // tail of every collective's event stream
+  TraceEmit(TracePhase::kComplete, static_cast<int64_t>(st.code));
   std::lock_guard<std::mutex> lk(mu_);
   auto it = handles_.find(handle);
   if (it == handles_.end()) return;  // caller released without waiting
@@ -3370,6 +3461,7 @@ void Engine::FailAll(const Status& st) {
 // ---------------------------------------------------------------------------
 
 void Engine::BackgroundLoop() {
+  TraceNameThread("bg");
   bool stop = false;
   while (!stop) {
     auto cycle_start = std::chrono::steady_clock::now();
@@ -3428,6 +3520,10 @@ void Engine::BackgroundLoop() {
       // replicating insertions keeps the diagnostics meaningful at -np 1.
       ResponseList to_execute;
       for (Request& r : local.requests) {
+        t_trace_ctx = {0, static_cast<uint16_t>(
+                              world_epoch_.load(std::memory_order_relaxed)),
+                       ++neg0_.trace_rounds, static_cast<uint8_t>(r.op)};
+        TraceEmitEnd(TracePhase::kNegotiate, 1);
         timeline_.NegotiateStart(r.name, OpName(r.op));
         timeline_.NegotiateRankReady(r.name, 0);
         timeline_.NegotiateEnd(r.name);
@@ -3842,6 +3938,17 @@ void Engine::WorkerTick(RequestList& local, bool* stop) {
       cv_.notify_all();
       continue;
     }
+    // flight recorder: negotiation wait opens when this rank's requests
+    // leave for the coordinator; the matching end marker carries the
+    // resolved round at dispatch (the merge tool pairs first-unpaired)
+    if (!reqs.empty()) {
+      t_trace_ctx = {sid,
+                     static_cast<uint16_t>(
+                         world_epoch_.load(std::memory_order_relaxed)),
+                     0, 0};
+      TraceEmit(TracePhase::kNegotiate,
+                static_cast<int64_t>(reqs.size()));
+    }
     RequestList full;
     full.process_set = sid;
     full.shutdown = sid == 0 && local.shutdown;
@@ -4024,6 +4131,16 @@ bool Engine::CoordinatorTick(RequestList& local) {
     if (ns == nullptr) continue;  // evicted set; Enqueue already errors
     RequestList own_full;
     std::vector<int> own_claims;
+    // flight recorder: coordinator's own negotiation wait opens here,
+    // mirroring the workers' send-side marker
+    if (!reqs.empty()) {
+      t_trace_ctx = {sid,
+                     static_cast<uint16_t>(
+                         world_epoch_.load(std::memory_order_relaxed)),
+                     0, 0};
+      TraceEmit(TracePhase::kNegotiate,
+                static_cast<int64_t>(reqs.size()));
+    }
     SplitRequests(*ns, reqs, &own_full, &own_claims);
     ResponseList* op = out_for(sid);
     for (int s : own_claims)
@@ -4572,6 +4689,9 @@ bool Engine::AbortJob(const Status& st, int dead_rank) {
     abort_status_ = st;
   }
   FailAll(st);
+  // black box: make the flight recorder durable with the abort cause as
+  // its last event — hvdrun's post-mortem reads this, not stderr
+  TraceAutoDump(TracePhase::kAbort, dead_rank);
   Faults().abort_latency_ns.fetch_add(NowNs() - t0,
                                       std::memory_order_relaxed);
   return true;
@@ -4691,6 +4811,15 @@ void Engine::Dispatch(const Response& resp) {
   }
   if (resp.op != OpType::kError) {
     set0_collectives_.fetch_add(1, std::memory_order_relaxed);
+    // flight recorder: the negotiated round's identity is this stream
+    // position — every rank dispatches the same responses in the same
+    // order, so (set 0, epoch, round) correlates across ranks for free
+    t_trace_ctx = {0,
+                   static_cast<uint16_t>(
+                       world_epoch_.load(std::memory_order_relaxed)),
+                   ++neg0_.trace_rounds, static_cast<uint8_t>(resp.op)};
+    TraceEmitEnd(TracePhase::kNegotiate,
+                 static_cast<int64_t>(resp.names.size()));
   }
   if (pipelined_ && resp.op != OpType::kError) {
     PipelineDispatch(resp);
@@ -4768,6 +4897,7 @@ void Engine::PipelineDispatch(const Response& resp) {
   // executors lag by different amounts
   item.hierarchical = hierarchical_allreduce_.load();
   item.wire_stripes = wire_stripes_active_.load(std::memory_order_relaxed);
+  item.trace = t_trace_ctx;  // identity assigned by Dispatch, stream-ordered
   for (auto& e : item.entries)
     timeline_.Start(e.req.name, OpName(resp.op));
   if (resp.op == OpType::kAllreduce && item.entries.size() > 1) {
@@ -4780,6 +4910,9 @@ void Engine::PipelineDispatch(const Response& resp) {
     // stages into the pool buffer
     size_t pack_total = PlanWireRegions(item.entries, &item.packed);
     item.buf = AcquireBuf(pack_total);  // backpressure: blocks at full depth
+    // span opens BEFORE the injector hook so an injected slow:phase=pack
+    // lands inside the recorded pack span (what attribution must find)
+    TraceEmit(TracePhase::kPack, static_cast<int64_t>(pack_total));
     FaultInjector::Get().OnPhase(FaultPhase::kPack);
     auto t0 = std::chrono::steady_clock::now();
     int64_t busy0 = ExecutorBusyNs();
@@ -4800,6 +4933,7 @@ void Engine::PipelineDispatch(const Response& resp) {
     sg_bytes_total_.fetch_add(static_cast<int64_t>(total - pack_total),
                               std::memory_order_relaxed);
     timeline_.PipelineEnd(item.buf->id);
+    TraceEmitEnd(TracePhase::kPack, static_cast<int64_t>(pack_total));
     int64_t dt = std::chrono::duration_cast<std::chrono::nanoseconds>(
                      std::chrono::steady_clock::now() - t0)
                      .count();
@@ -4923,6 +5057,8 @@ void Engine::FinishAllreduceEntry(TensorEntry& e, const Status& st,
 // executor handed back — while the executor is already mid-wire on the
 // NEXT item, which is the second half of the overlap.
 void Engine::CompleteItem(WorkItem& item) {
+  t_trace_ctx = item.trace;
+  TraceEmit(TracePhase::kUnpack, static_cast<int64_t>(item.total));
   FaultInjector::Get().OnPhase(FaultPhase::kUnpack);
   auto t0 = std::chrono::steady_clock::now();
   int64_t busy0 = ExecutorBusyNs();
@@ -4962,6 +5098,7 @@ void Engine::CompleteItem(WorkItem& item) {
     }
   }
   timeline_.PipelineEnd(lane);
+  TraceEmitEnd(TracePhase::kUnpack, static_cast<int64_t>(item.total));
   if (item.buf) ReleaseBuf(std::move(item.buf));
   int64_t dt = std::chrono::duration_cast<std::chrono::nanoseconds>(
                    std::chrono::steady_clock::now() - t0)
@@ -5060,6 +5197,7 @@ void Engine::PipelineStallCheck() {
 // negotiation thread never touches the data plane again after Init.
 void Engine::DataPlaneLoop() {
   t_on_executor = true;
+  TraceNameThread("wire");
   bool first = true;
   for (;;) {
     WorkItem item;
@@ -5122,6 +5260,7 @@ void Engine::RunWire(WorkItem& item) {
   // stream-order stripe cap: both ends of every link apply the same cap
   // at the same item boundary, so the striped cursors stay in lockstep
   SetLinksActiveStripes(item.wire_stripes);
+  t_trace_ctx = item.trace;
   auto t0 = std::chrono::steady_clock::now();
   switch (resp.op) {
     case OpType::kAllreduce: {
@@ -5270,7 +5409,10 @@ void Engine::ExecuteAllreduce(const Response& resp,
   }
   // fusion buffer (persistent across responses): pack the small tail, one
   // allreduce over the scatter-gather view, unpack the packed tail —
-  // entries above the SG threshold never touch the fusion buffer
+  // entries above the SG threshold never touch the fusion buffer.  The
+  // pack span opens BEFORE the injector hook so an injected
+  // slow:phase=pack lands inside it (what attribution must find).
+  TraceEmit(TracePhase::kPack, 0);
   FaultInjector::Get().OnPhase(FaultPhase::kPack);
   size_t total = 0;
   for (auto& e : entries) total += e.nbytes;
@@ -5287,6 +5429,7 @@ void Engine::ExecuteAllreduce(const Response& resp,
     off += entries[i].nbytes;
   }
   act_end();
+  TraceEmitEnd(TracePhase::kPack, static_cast<int64_t>(pack_total));
   WireRegions wr = BuildRegions(entries, packed, fused);
   pack_bytes_total_.fetch_add(static_cast<int64_t>(pack_total),
                               std::memory_order_relaxed);
@@ -5296,6 +5439,7 @@ void Engine::ExecuteAllreduce(const Response& resp,
   Status st =
       ElasticizeWire(reduce(wr, static_cast<int64_t>(total / DTypeSize(dtype))));
   act_end();
+  TraceEmit(TracePhase::kUnpack, static_cast<int64_t>(pack_total));
   FaultInjector::Get().OnPhase(FaultPhase::kUnpack);
   act_start("MEMCPY_OUT_FUSION_BUFFER");
   off = 0;
@@ -5310,6 +5454,7 @@ void Engine::ExecuteAllreduce(const Response& resp,
     off += e.nbytes;
   }
   act_end();
+  TraceEmitEnd(TracePhase::kUnpack, static_cast<int64_t>(pack_total));
   // packed results were written to their destinations above; SG entries
   // were reduced in place on their payloads (copy-out like the unfused
   // case when a non-aliased user_out exists)
@@ -5861,9 +6006,12 @@ Status Engine::RingAllreduceGroup(const WireRegions& wr, int64_t nelems,
     int recv_c = (me - step - 1 + 2 * m) % m;
     int64_t s_lo = chunk_lo(send_c), s_hi = chunk_lo(send_c + 1);
     int64_t r_lo = chunk_lo(recv_c), r_hi = chunk_lo(recv_c + 1);
+    TraceEmit(TracePhase::kWireSend, (s_hi - s_lo) * esize, right, 0, step);
     Status st = PeerSendRecvReduce(
         right, buf + s_lo * esize, (s_hi - s_lo) * esize,
         left, buf + r_lo * esize, r_hi - r_lo, dtype);
+    TraceEmitEnd(TracePhase::kWireSend, (s_hi - s_lo) * esize, right, 0,
+                 step);
     if (!st.ok())
       result = Status::Error("ring allreduce failed: " + st.message);
   }
@@ -5872,9 +6020,13 @@ Status Engine::RingAllreduceGroup(const WireRegions& wr, int64_t nelems,
     int recv_c = (me - step + 2 * m) % m;
     int64_t s_lo = chunk_lo(send_c), s_hi = chunk_lo(send_c + 1);
     int64_t r_lo = chunk_lo(recv_c), r_hi = chunk_lo(recv_c + 1);
+    TraceEmit(TracePhase::kWireSend, (s_hi - s_lo) * esize, right, 0,
+              m - 1 + step);
     Status st = PeerSendRecv(
         right, buf + s_lo * esize, (s_hi - s_lo) * esize,
         left, buf + r_lo * esize, (r_hi - r_lo) * esize);
+    TraceEmitEnd(TracePhase::kWireSend, (s_hi - s_lo) * esize, right, 0,
+                 m - 1 + step);
     if (!st.ok())
       result = Status::Error("ring allreduce failed: " + st.message);
   }
@@ -6085,7 +6237,12 @@ Status Engine::RingAllreduceGroupSegmented(const WireRegions& wr,
               timeline_.RingSegStart(kStripeLane[lane_idx], "STRIPE_SEND");
               last_lane = lane_idx;
             }
-            if (s_off == 0) timeline_.RingSegStart("ring/send", "SEG_SEND");
+            int ev_stripe = txs ? txs->send_stripe() : 0;
+            if (s_off == 0) {
+              timeline_.RingSegStart("ring/send", "SEG_SEND");
+              TraceEmit(TracePhase::kWireSend, 0, right, ev_stripe,
+                        static_cast<int>(ssg));
+            }
             s_off += static_cast<int64_t>(k);
             payload += static_cast<int64_t>(k);
             send_avail -= k;
@@ -6097,6 +6254,8 @@ Status Engine::RingAllreduceGroupSegmented(const WireRegions& wr,
               if (s_off < seg_b) break;
               s_off -= seg_b;
               timeline_.RingSegEnd("ring/send");
+              TraceEmitEnd(TracePhase::kWireSend, seg_b, right, ev_stripe,
+                           static_cast<int>(ssg));
               segments++;
               ssg++;
               if (ssg >= nsegs) {
@@ -6105,8 +6264,11 @@ Status Engine::RingAllreduceGroupSegmented(const WireRegions& wr,
                 s_off = 0;  // provably 0 here (pushes stop at the chunk end)
                 break;
               }
-              if (s_off > 0)
+              if (s_off > 0) {
                 timeline_.RingSegStart("ring/send", "SEG_SEND");
+                TraceEmit(TracePhase::kWireSend, 0, right, ev_stripe,
+                          static_cast<int>(ssg));
+              }
             }
           }
         }
@@ -6172,18 +6334,28 @@ Status Engine::RingAllreduceGroupSegmented(const WireRegions& wr,
           }
         }
         if (k > 0) {
-          if (r_off == 0) timeline_.RingSegStart("ring/recv", "SEG_RECV");
+          if (r_off == 0) {
+            timeline_.RingSegStart("ring/recv", "SEG_RECV");
+            TraceEmit(TracePhase::kWireRecv, 0, left, 0,
+                      static_cast<int>(rsg));
+          }
           r_off += static_cast<int64_t>(k);
           prog = true;
           if (r_off == seg_b) {
             timeline_.RingSegEnd("ring/recv");
+            TraceEmitEnd(TracePhase::kWireRecv, seg_b, left, 0,
+                         static_cast<int>(rsg));
             if (reduce_phase) {
               // while this runs, the left neighbor keeps filling the
               // transport with segment s+1 — the overlap this loop buys
               timeline_.RingSegStart("ring/accum", "SEG_ACCUM");
+              TraceEmit(TracePhase::kAccumulate, hi - lo, left, 0,
+                        static_cast<int>(rsg));
               AccumulateRegions(wr, lo, scratch_vec.data(), hi - lo,
                                 dtype);
               timeline_.RingSegEnd("ring/accum");
+              TraceEmitEnd(TracePhase::kAccumulate, hi - lo, left, 0,
+                           static_cast<int>(rsg));
             }
             r_off = 0;
             rsg++;
@@ -7384,5 +7556,22 @@ const char* hvd_frame_parse_error(const void* buf, int64_t len) {
   }
   return st.ok() ? nullptr : strdup(st.message.c_str());
 }
+
+// -- flight recorder (trace.h) ----------------------------------------------
+
+// Dump the flight recorder.  With a path: copy the live rings there (any
+// mode).  NULL: flush in place — an msync for a file-backed recorder, a
+// successful no-op for an anonymous one (there is nothing durable to
+// flush; pass a path to persist it).  Works with or without a live
+// engine — the recorder outlives engine re-inits.
+int hvd_trace_dump(const char* path) { return TraceDump(path); }
+
+// {enabled, rings, events written, events dropped, ring capacity, clock
+//  offset ns, auto dumps, file backed}
+void hvd_trace_stats(int64_t* out) { TraceStats(out); }
+
+// Live recorder path ("" when anonymous); malloc'd, free via
+// hvd_free_cstr.
+const char* hvd_trace_path() { return strdup(TracePath()); }
 
 }  // extern "C"

@@ -220,18 +220,26 @@ void FaultInjector::Configure(int rank) {
                    << f.err << ") — IGNORED";
       continue;
     }
-    if (type == "kill" || type == "hang") {
+    if (type == "kill" || type == "hang" || type == "slow") {
       if (f.rank < 0) {
         LOG(Warning) << "fault injection: spec '" << one
                      << "' lacks rank= — IGNORED";
         continue;
       }
+      if (type == "slow" && f.ms <= 0) {
+        LOG(Warning) << "fault injection: spec '" << one
+                     << "' wants ms=N — IGNORED";
+        continue;
+      }
       if (f.rank != rank_) continue;  // armed on the named rank only
       if (nspecs_ >= kMaxSpecs) continue;
       Spec& s = specs_[nspecs_++];
-      s.kill = type == "kill";
+      s.kind = type == "kill" ? Spec::Kind::kKill
+               : type == "hang" ? Spec::Kind::kHang
+                                : Spec::Kind::kSlow;
       s.phase = f.phase;
       s.hit = f.hit;
+      s.ms = f.ms;
       armed_ = true;
     } else if (type == "delay") {
       if (f.link_a < 0 || f.link_b < 0 || f.ms <= 0) {
@@ -258,8 +266,15 @@ void FaultInjector::OnPhaseSlow(FaultPhase p) {
     Spec& s = specs_[i];
     if (s.fired || s.phase != p) continue;
     if (++s.seen < s.hit) continue;
+    if (s.kind == Spec::Kind::kSlow) {
+      // the deterministic straggler: EVERY entry of this phase from the
+      // hit-th on sleeps — re-fires, unlike the one-shot kill/hang
+      s.seen = s.hit;  // avoid counter overflow on very long runs
+      std::this_thread::sleep_for(std::chrono::milliseconds(s.ms));
+      continue;
+    }
     s.fired = true;
-    if (s.kill) {
+    if (s.kind == Spec::Kind::kKill) {
       // async-signal-safe last words: SIGKILL flushes nothing
       char buf[128];
       int n = snprintf(buf, sizeof(buf),

@@ -30,6 +30,10 @@
 //    kill:rank=1:phase=ring         SIGKILL rank 1 entering its 1st ring
 //    kill:rank=1:phase=pack:hit=3   ... at the 3rd pack instead
 //    hang:rank=1:phase=unpack       wedge (sleep forever) instead of dying
+//    slow:rank=1:phase=pack:ms=30   sleep 30 ms at EVERY pack entry (from
+//                                   the hit-th on) — the deterministic
+//                                   per-phase straggler the flight-recorder
+//                                   attribution bench injects and must find
 //    delay:link=0-1:ms=500          500 ms pause entering each 0<->1 transfer
 // Phases: negotiation (default), pack, ring, unpack.  ``cycle`` and ``hit``
 // are synonyms: the Nth entry of that phase on that rank (1-based).
@@ -148,11 +152,13 @@ class FaultInjector {
   void OnLinkSlow(int peer);
 
   struct Spec {
-    bool kill = false;     // else hang
+    enum class Kind { kKill, kHang, kSlow };
+    Kind kind = Kind::kKill;
     FaultPhase phase = FaultPhase::kNegotiation;
     int64_t hit = 1;       // fire at the Nth phase entry (1-based)
+    int64_t ms = 0;        // kSlow: sleep per entry from the hit-th on
     int64_t seen = 0;
-    bool fired = false;
+    bool fired = false;    // kill/hang are one-shot; slow re-fires
   };
   // at most a handful of specs; fixed storage keeps the hook allocation-free
   static constexpr int kMaxSpecs = 8;

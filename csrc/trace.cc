@@ -1,0 +1,337 @@
+#include "trace.h"
+
+#include <fcntl.h>
+#include <signal.h>
+#include <string.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <sys/syscall.h>
+#include <time.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cstdio>
+#include <cstdlib>
+
+#include "common.h"
+
+namespace hvdtpu {
+
+thread_local HVDTPU_TLS_IE TraceCtx t_trace_ctx;
+
+namespace trace_detail {
+
+// ---------------------------------------------------------------------------
+// file layout
+// ---------------------------------------------------------------------------
+// [FileHeader (4096 B)] [RingHeader x kMaxRings (64 B each)] [ring data]
+// Ring i's events start at data_off + i * ring_events * 32.  Everything is
+// written in place through the mapping, so a file-backed recorder is
+// always a valid dump — the reader tolerates one torn in-flight event.
+
+constexpr uint64_t kMagic = 0x3130435254445648ull;  // "HVDTRC01" LE
+constexpr int kMaxRings = 16;
+constexpr int64_t kDefaultRingEvents = 8192;  // x16 rings ~ 128k events
+
+struct FileHeader {        // one page
+  uint64_t magic;
+  uint32_t version;        // layout version, independent of the wire ABI
+  int32_t rank;
+  int32_t size;
+  int32_t pid;
+  uint32_t ring_events;    // capacity per ring (power of two)
+  uint32_t nrings_max;
+  std::atomic<uint32_t> nrings;       // claimed so far
+  std::atomic<int64_t> dropped;       // events lost to a full ring table
+  std::atomic<int64_t> clock_offset_ns;
+  std::atomic<int64_t> auto_dumps;
+  int64_t start_mono_ns;   // monotonic clock at init
+  int64_t start_unix_ns;   // wall clock at init (merge tool anchor)
+  std::atomic<uint64_t> world_epoch;
+  char reserved[4096 - 88];  // fields above end at offset 88
+};
+static_assert(sizeof(FileHeader) == 4096, "header must be one page");
+
+struct Ring {              // 64 bytes, one per emitting thread
+  std::atomic<uint64_t> head;  // events ever written; slot = head % cap
+  uint64_t tid;
+  char name[24];
+  TraceEvent* events;          // not in the file image (process-local);
+                               // the reader derives the base from layout
+  char pad[64 - 48];
+};
+static_assert(sizeof(Ring) == 64, "ring header must stay 64 bytes");
+
+std::atomic<bool> g_on{false};
+thread_local HVDTPU_TLS_IE Ring* t_ring = nullptr;
+
+namespace {
+
+FileHeader* g_hdr = nullptr;       // start of the mapping
+Ring* g_rings = nullptr;           // kMaxRings ring headers
+TraceEvent* g_data = nullptr;      // ring 0's first event
+size_t g_map_bytes = 0;
+int g_fd = -1;                     // -1 = anonymous mapping
+uint32_t g_ring_events = 0;
+// precomputed at init so the signal handler never formats a path
+char g_live_path[512] = "";        // file-backed mapping path ("" = anon)
+char g_fallback_path[512] = "";    // anonymous auto-dump destination
+
+int64_t MonoNs() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return static_cast<int64_t>(ts.tv_sec) * 1000000000ll + ts.tv_nsec;
+}
+
+int64_t UnixNs() {
+  struct timespec ts;
+  clock_gettime(CLOCK_REALTIME, &ts);
+  return static_cast<int64_t>(ts.tv_sec) * 1000000000ll + ts.tv_nsec;
+}
+
+uint32_t Pow2AtLeast(int64_t v) {
+  uint32_t p = 1024;
+  while (static_cast<int64_t>(p) < v && p < (1u << 24)) p <<= 1;
+  return p;
+}
+
+// write() the whole recorder image to a path — async-signal-safe (open/
+// write/close only), used for anonymous rings and explicit dump copies.
+int WriteImage(const char* path) {
+  if (g_hdr == nullptr || path == nullptr || !path[0]) return -1;
+  int fd = ::open(path, O_WRONLY | O_CREAT | O_TRUNC, 0644);
+  if (fd < 0) return -1;
+  const char* p = reinterpret_cast<const char*>(g_hdr);
+  size_t left = g_map_bytes;
+  while (left > 0) {
+    ssize_t w = ::write(fd, p, left);
+    if (w <= 0) {
+      ::close(fd);
+      return -1;
+    }
+    p += w;
+    left -= static_cast<size_t>(w);
+  }
+  ::close(fd);
+  return 0;
+}
+
+// fatal-signal handler: stamp the signal, make the recorder durable,
+// restore default disposition, re-raise.  Installed only over SIG_DFL so
+// Python/runtime-owned handlers are never displaced.  The event is only
+// written when THIS thread already owns a ring: a first TLS access /
+// ring claim from a never-traced thread could allocate (lazy DTV for a
+// dlopen'd .so) inside a signal handler — the dump itself (msync /
+// open+write) is the part that must always run.
+void FatalHandler(int signo) {
+  if (t_ring != nullptr) TraceEmit(TracePhase::kSignal, signo);
+  TraceAutoDump(TracePhase::kSignal, signo);
+  signal(signo, SIG_DFL);
+  raise(signo);
+}
+
+void InstallSignalHandlers(bool file_backed) {
+  // SIGTERM is ROUTINE (the launcher's teardown path): only hook it when
+  // the recorder is file-backed, where the dump is an msync of the live
+  // file — an anonymous recorder dumping on SIGTERM would litter the cwd
+  // with a fallback file on every clean shutdown.  The crash signals
+  // always dump: they are the post-mortem the fallback file exists for.
+  static const int kCrash[] = {SIGSEGV, SIGBUS, SIGILL, SIGFPE, SIGABRT};
+  for (int s : kCrash) {
+    struct sigaction cur;
+    if (sigaction(s, nullptr, &cur) != 0) continue;
+    if (cur.sa_handler != SIG_DFL || (cur.sa_flags & SA_SIGINFO)) continue;
+    struct sigaction sa;
+    memset(&sa, 0, sizeof(sa));
+    sa.sa_handler = FatalHandler;
+    sigemptyset(&sa.sa_mask);
+    sa.sa_flags = SA_RESETHAND;  // one shot: re-entry gets the default
+    sigaction(s, &sa, nullptr);
+  }
+  if (!file_backed) return;
+  struct sigaction cur;
+  if (sigaction(SIGTERM, nullptr, &cur) == 0 &&
+      cur.sa_handler == SIG_DFL && !(cur.sa_flags & SA_SIGINFO)) {
+    struct sigaction sa;
+    memset(&sa, 0, sizeof(sa));
+    sa.sa_handler = FatalHandler;
+    sigemptyset(&sa.sa_mask);
+    sa.sa_flags = SA_RESETHAND;
+    sigaction(SIGTERM, &sa, nullptr);
+  }
+}
+
+}  // namespace
+
+Ring* ClaimRing() {
+  if (g_hdr == nullptr) return nullptr;
+  uint32_t i = g_hdr->nrings.fetch_add(1, std::memory_order_relaxed);
+  if (i >= g_hdr->nrings_max) {
+    g_hdr->nrings.store(g_hdr->nrings_max, std::memory_order_relaxed);
+    g_hdr->dropped.fetch_add(1, std::memory_order_relaxed);
+    return nullptr;
+  }
+  Ring* r = &g_rings[i];
+  r->tid = static_cast<uint64_t>(::syscall(SYS_gettid));
+  r->events = g_data + static_cast<size_t>(i) * g_ring_events;
+  t_ring = r;
+  return r;
+}
+
+void Write(Ring* r, const TraceEvent& ev) {
+  uint64_t h = r->head.load(std::memory_order_relaxed);
+  r->events[h & (g_ring_events - 1)] = ev;
+  r->head.store(h + 1, std::memory_order_release);
+}
+
+int64_t TraceNowNs() { return MonoNs(); }
+
+}  // namespace trace_detail
+
+using namespace trace_detail;
+
+bool TraceEnabled() {
+  static bool on = !EnvFlagIsZero("HOROVOD_TPU_TRACE");
+  return on;
+}
+
+void TraceInit(int rank, int size) {
+  if (!TraceEnabled()) return;
+  // global launcher rank when one exists: an elastic joiner's engine rank
+  // is negotiated, but its file should replace its SLOT's (the metrics
+  // dumper keys files the same way)
+  int64_t env_rank = EnvInt64("HOROVOD_TPU_RANK", rank);
+  if (env_rank >= 0) rank = static_cast<int>(env_rank);
+  if (g_hdr != nullptr) {
+    // re-init in the same process (sub-worlds, tests): keep the mapping,
+    // re-stamp the world view
+    g_hdr->rank = rank;
+    g_hdr->size = size;
+    TraceEmit(TracePhase::kInit, size);
+    return;
+  }
+  g_ring_events = Pow2AtLeast(
+      EnvInt64("HOROVOD_TPU_TRACE_RING_EVENTS", kDefaultRingEvents));
+  g_map_bytes = sizeof(FileHeader) + sizeof(Ring) * kMaxRings +
+                sizeof(TraceEvent) * static_cast<size_t>(g_ring_events) *
+                    kMaxRings;
+  const char* dir = getenv("HOROVOD_TPU_TRACE_DIR");
+  void* map = MAP_FAILED;
+  if (dir && dir[0]) {
+    snprintf(g_live_path, sizeof(g_live_path), "%s/trace.rank%d.bin", dir,
+             rank);
+    int fd = ::open(g_live_path, O_RDWR | O_CREAT | O_TRUNC, 0644);
+    if (fd >= 0 && ::ftruncate(fd, static_cast<off_t>(g_map_bytes)) == 0) {
+      map = ::mmap(nullptr, g_map_bytes, PROT_READ | PROT_WRITE,
+                   MAP_SHARED, fd, 0);
+    }
+    if (map == MAP_FAILED) {
+      if (fd >= 0) ::close(fd);
+      g_live_path[0] = '\0';
+    } else {
+      g_fd = fd;
+    }
+  }
+  if (map == MAP_FAILED) {
+    // anonymous recorder: still dumpable on demand / on fatal signal
+    map = ::mmap(nullptr, g_map_bytes, PROT_READ | PROT_WRITE,
+                 MAP_PRIVATE | MAP_ANONYMOUS, -1, 0);
+    if (map == MAP_FAILED) return;  // recorder unavailable; hooks no-op
+    snprintf(g_fallback_path, sizeof(g_fallback_path),
+             "hvdtpu-trace.rank%d.bin", rank);
+  }
+  memset(map, 0, sizeof(FileHeader) + sizeof(Ring) * kMaxRings);
+  g_hdr = static_cast<FileHeader*>(map);
+  g_rings = reinterpret_cast<Ring*>(static_cast<char*>(map) +
+                                    sizeof(FileHeader));
+  g_data = reinterpret_cast<TraceEvent*>(
+      static_cast<char*>(map) + sizeof(FileHeader) +
+      sizeof(Ring) * kMaxRings);
+  g_hdr->magic = kMagic;
+  g_hdr->version = 1;
+  g_hdr->rank = rank;
+  g_hdr->size = size;
+  g_hdr->pid = static_cast<int32_t>(getpid());
+  g_hdr->ring_events = g_ring_events;
+  g_hdr->nrings_max = kMaxRings;
+  g_hdr->start_mono_ns = MonoNs();
+  g_hdr->start_unix_ns = UnixNs();
+  g_on.store(true, std::memory_order_release);
+  InstallSignalHandlers(g_fd >= 0);
+  TraceEmit(TracePhase::kInit, size);
+}
+
+void TraceSetClockOffset(int64_t offset_ns) {
+  if (g_hdr == nullptr) return;
+  g_hdr->clock_offset_ns.store(offset_ns, std::memory_order_relaxed);
+  TraceEmit(TracePhase::kClockProbe, offset_ns);
+}
+
+void TraceSetWorld(int rank, int size, uint64_t epoch) {
+  if (g_hdr == nullptr) return;
+  g_hdr->rank = rank;
+  g_hdr->size = size;
+  g_hdr->world_epoch.store(epoch, std::memory_order_relaxed);
+}
+
+void TraceNameThread(const char* name) {
+  if (!g_on.load(std::memory_order_relaxed)) return;
+  Ring* r = t_ring != nullptr ? t_ring : ClaimRing();
+  if (r == nullptr || name == nullptr) return;
+  strncpy(r->name, name, sizeof(r->name) - 1);
+  r->name[sizeof(r->name) - 1] = '\0';
+}
+
+void TraceAutoDump(TracePhase why, int64_t arg) {
+  if (g_hdr == nullptr) return;
+  if (why != TracePhase::kSignal)  // the handler already stamped kSignal
+    TraceEmit(why, arg);
+  g_hdr->auto_dumps.fetch_add(1, std::memory_order_relaxed);
+  if (g_fd >= 0) {
+    // file-backed: events are already in the page cache; MS_ASYNC just
+    // schedules writeback and is async-signal-safe
+    ::msync(g_hdr, g_map_bytes, MS_ASYNC);
+  } else if (why == TracePhase::kSignal) {
+    // anonymous recorder: only a CRASH earns an unsolicited file (the
+    // fallback dump is its only evidence); aborts and world changes are
+    // routine enough that writing into the cwd would be litter — the
+    // events stay in memory for hvd_trace_dump on demand
+    WriteImage(g_fallback_path);
+  }
+}
+
+int TraceDump(const char* path) {
+  if (g_hdr == nullptr) return -1;
+  if (path != nullptr && path[0]) return WriteImage(path);
+  if (g_fd >= 0) return ::msync(g_hdr, g_map_bytes, MS_ASYNC);
+  return 0;  // anonymous, no explicit path: nothing durable to flush
+}
+
+void TraceStats(int64_t out[8]) {
+  if (g_hdr == nullptr) {
+    for (int i = 0; i < 8; i++) out[i] = 0;
+    out[0] = TraceEnabled() ? 1 : 0;
+    return;
+  }
+  int64_t written = 0;
+  uint32_t n = g_hdr->nrings.load(std::memory_order_relaxed);
+  if (n > g_hdr->nrings_max) n = g_hdr->nrings_max;
+  for (uint32_t i = 0; i < n; i++)
+    written +=
+        static_cast<int64_t>(g_rings[i].head.load(std::memory_order_relaxed));
+  out[0] = 1;
+  out[1] = static_cast<int64_t>(n);
+  out[2] = written;
+  out[3] = g_hdr->dropped.load(std::memory_order_relaxed);
+  out[4] = static_cast<int64_t>(g_hdr->ring_events);
+  out[5] = g_hdr->clock_offset_ns.load(std::memory_order_relaxed);
+  out[6] = g_hdr->auto_dumps.load(std::memory_order_relaxed);
+  out[7] = g_fd >= 0 ? 1 : 0;
+}
+
+const char* TracePath() {
+  return g_fd >= 0 ? g_live_path
+                   : (g_hdr != nullptr ? g_fallback_path : "");
+}
+
+}  // namespace hvdtpu

@@ -1,0 +1,192 @@
+// Flight recorder: an always-on, lock-free, per-thread ring buffer of
+// fixed-size binary events — the black box the reference system never had
+// (its observability stops at a rank-0 Chrome trace; a SIGKILLed rank
+// leaves nothing but a truncated JSON tail).
+//
+// Design:
+//  * Every emitting thread owns one ring (claimed on first emit; no locks
+//    anywhere on the hot path).  An event is a 32-byte store plus a
+//    relaxed head increment — tens of ns, cheap enough to leave on in
+//    production.  `HOROVOD_TPU_TRACE=0` is the kill switch: disabled mode
+//    costs one predicted branch per call site.
+//  * When `HOROVOD_TPU_TRACE_DIR` is set the rings live in a FILE-BACKED
+//    mmap (`<dir>/trace.rank<r>.bin`): every event is durable the moment
+//    it is written, so a SIGKILLed rank's file holds its last ~100k
+//    events with no signal handler involved — that is the whole black
+//    box.  Without a dir the rings are anonymous memory and can still be
+//    dumped on demand (`hvd_trace_dump`) or by the fatal-signal handler.
+//  * Correlation needs NO wire change: every negotiated collective
+//    already has a deterministic (process set, world epoch, round) identity
+//    on every rank — responses broadcast in stream order, and each rank
+//    counts them identically — so the merge tool aligns ranks by that key
+//    alone.  A one-shot clock-offset probe piggybacked on the bootstrap
+//    rendezvous (engine.cc) aligns the monotonic timestamps across hosts.
+//  * Auto-dump (an msync for file-backed rings, a raw write() otherwise)
+//    fires on coordinated abort, on every applied world change, and from
+//    the fatal-signal handler — the three moments a post-mortem cares
+//    about.  All dump paths are async-signal-safe.
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+
+namespace hvdtpu {
+
+// Engine phases an event can mark.  kEnd (bit 7) turns a begin marker into
+// the matching end marker; instantaneous events carry no kEnd pair.
+enum class TracePhase : uint8_t {
+  kEnqueue = 0,      // op submitted (Python thread); arg = payload bytes
+  kNegotiate = 1,    // begin: requests left for the coordinator;
+                     // end: the negotiated response round dispatched
+  kPack = 2,         // fusion-buffer staging memcpys; arg = packed bytes
+  kWireSend = 3,     // one ring segment pushed; slot = segment, peer set
+  kWireRecv = 4,     // one ring segment landed; slot = segment, peer set
+  kAccumulate = 5,   // segment reduce; arg = elements
+  kUnpack = 6,       // fusion-buffer unpack memcpys; arg = bytes
+  kComplete = 7,     // handle marked done; arg = status code
+  kAbort = 8,        // coordinated abort; arg = dead rank (or -1)
+  kWorldChange = 9,  // elastic membership change beginning; arg = epoch
+  kSignal = 10,      // fatal signal; arg = signo
+  kInit = 11,        // engine init; arg = world size
+  kClockProbe = 12,  // bootstrap clock probe result; arg = offset ns
+};
+
+constexpr uint8_t kTraceEnd = 0x80;  // phase | kTraceEnd = end marker
+
+// One fixed-size binary event (32 bytes, no padding).  `aux` packs the
+// wire stripe (low 4 bits) and the OpType (high 4 bits); `slot` is the
+// tensor index within a fused round for completion events and the segment
+// index (mod 64k) for wire events.
+struct TraceEvent {
+  int64_t t_ns;    // monotonic (CLOCK_MONOTONIC) — offset-corrected by the
+                   // merge tool using the header's clock_offset_ns
+  int64_t arg;     // phase-specific payload (bytes, elements, rank, ...)
+  uint32_t round;  // per-set response-stream position (0 = not yet known)
+  int32_t set;     // process set id
+  uint16_t epoch;  // world epoch (mod 64k)
+  uint16_t slot;   // fused-entry index / segment index
+  int16_t peer;    // peer global rank (-1 = none)
+  uint8_t phase;   // TracePhase | (kTraceEnd for end markers)
+  uint8_t aux;     // stripe (low 4 bits) | OpType (high 4 bits)
+};
+static_assert(sizeof(TraceEvent) == 32, "trace event must stay 32 bytes");
+
+// initial-exec TLS: accesses compile to a fixed offset, never the lazy
+// __tls_get_addr path that may ALLOCATE a dlopen'd module's TLS block on
+// a thread's first touch — the fatal-signal handler reads these, so they
+// must be allocation-free.  The static-TLS surplus glibc reserves for
+// dlopen'd objects comfortably covers the few bytes used here.
+#if defined(__GNUC__)
+#define HVDTPU_TLS_IE __attribute__((tls_model("initial-exec")))
+#else
+#define HVDTPU_TLS_IE
+#endif
+
+// The per-collective identity the executing thread carries so deep wire
+// code can emit fully-keyed events without threading ids through every
+// signature (mirrors the engine's t_comm pattern).
+struct TraceCtx {
+  int32_t set = 0;
+  uint16_t epoch = 0;
+  uint32_t round = 0;
+  uint8_t op = 0;
+};
+extern thread_local HVDTPU_TLS_IE TraceCtx t_trace_ctx;
+
+// Cached enablement: default ON, `HOROVOD_TPU_TRACE=0` kills it.  Safe to
+// call before TraceInit (reads the env once).
+bool TraceEnabled();
+
+// Map the ring file (or anonymous memory), stamp the header, install the
+// fatal-signal dump handlers (once per process, only for signals whose
+// disposition is SIG_DFL so Python-owned handlers are never displaced).
+// `rank` keys the file name; re-init (elastic joiners, tests) re-stamps
+// the same mapping.  No-op when tracing is disabled.
+void TraceInit(int rank, int size);
+
+// Record the bootstrap clock-offset probe result: `offset_ns` added to
+// this rank's monotonic timestamps aligns them with rank 0's clock.
+void TraceSetClockOffset(int64_t offset_ns);
+
+// Re-stamp the header's world view after an elastic change (rank may have
+// been renumbered; epoch bumped).
+void TraceSetWorld(int rank, int size, uint64_t epoch);
+
+// Name the calling thread's ring ("bg", "wire", "set3", ...) for the
+// merge tool's lanes.  First call claims the ring.
+void TraceNameThread(const char* name);
+
+namespace trace_detail {
+struct Ring;
+Ring* ClaimRing();
+extern std::atomic<bool> g_on;
+extern thread_local HVDTPU_TLS_IE Ring* t_ring;
+void Write(Ring* r, const TraceEvent& ev);
+int64_t TraceNowNs();
+}  // namespace trace_detail
+
+// Emit one event (lock-free; ~tens of ns when enabled, one branch when
+// not).  Identity fields come from t_trace_ctx.
+inline void TraceEmit(TracePhase phase, int64_t arg = 0, int peer = -1,
+                      int stripe = 0, int slot = 0) {
+  using namespace trace_detail;
+  if (!g_on.load(std::memory_order_relaxed)) return;
+  Ring* r = t_ring != nullptr ? t_ring : ClaimRing();
+  if (r == nullptr) return;  // ring table full: drop, counted in the header
+  TraceEvent ev;
+  ev.t_ns = TraceNowNs();
+  ev.arg = arg;
+  ev.round = t_trace_ctx.round;
+  ev.set = t_trace_ctx.set;
+  ev.epoch = t_trace_ctx.epoch;
+  ev.slot = static_cast<uint16_t>(slot);
+  ev.peer = static_cast<int16_t>(peer);
+  ev.phase = static_cast<uint8_t>(phase);
+  ev.aux = static_cast<uint8_t>((stripe & 0x0f) |
+                                ((t_trace_ctx.op & 0x0f) << 4));
+  Write(r, ev);
+}
+
+inline void TraceEmitEnd(TracePhase phase, int64_t arg = 0, int peer = -1,
+                         int stripe = 0, int slot = 0) {
+  using namespace trace_detail;
+  if (!g_on.load(std::memory_order_relaxed)) return;
+  Ring* r = t_ring != nullptr ? t_ring : ClaimRing();
+  if (r == nullptr) return;
+  TraceEvent ev;
+  ev.t_ns = TraceNowNs();
+  ev.arg = arg;
+  ev.round = t_trace_ctx.round;
+  ev.set = t_trace_ctx.set;
+  ev.epoch = t_trace_ctx.epoch;
+  ev.slot = static_cast<uint16_t>(slot);
+  ev.peer = static_cast<int16_t>(peer);
+  ev.phase = static_cast<uint8_t>(phase) | kTraceEnd;
+  ev.aux = static_cast<uint8_t>((stripe & 0x0f) |
+                                ((t_trace_ctx.op & 0x0f) << 4));
+  Write(r, ev);
+}
+
+// Durable-ify the recorder now (async-signal-safe): msync for file-backed
+// rings, a raw write() of the whole buffer to the precomputed fallback
+// path otherwise.  `reason` is recorded as an event first.  Called on
+// abort, world change, and from the fatal-signal handler.
+void TraceAutoDump(TracePhase why, int64_t arg);
+
+// Copy the live recorder to `path`.  NULL flushes in place: an msync for
+// a file-backed recorder, a successful no-op for an anonymous one (there
+// is nothing durable to flush — pass a path to persist it).  Returns 0 on
+// success, -1 when tracing is off/unmapped or the write failed.  The C API
+// `hvd_trace_dump` forwards here.
+int TraceDump(const char* path);
+
+// Counted recorder statistics for diagnostics/tests:
+// {enabled, rings claimed, events written, events dropped, ring capacity
+//  (events), clock offset ns, auto dumps, file backed}.
+void TraceStats(int64_t out[8]);
+
+// Live trace file path ("" when anonymous/unmapped) — Python reads it to
+// locate the black box next to the metrics dumps.
+const char* TracePath();
+
+}  // namespace hvdtpu

@@ -121,7 +121,9 @@ def _elastic_supervise(procs, args, first_rank, local_n, spawn,
                 metrics_dir=args.metrics_dir
                 or os.environ.get("HOROVOD_TPU_METRICS_DIR"),
                 timeline_path=args.timeline
-                or os.environ.get("HOROVOD_TIMELINE"))
+                or os.environ.get("HOROVOD_TIMELINE"),
+                trace_dir=args.trace_dir
+                or os.environ.get("HOROVOD_TPU_TRACE_DIR"))
             print(f"[horovod_tpu.run]   {line}", file=sys.stderr)
     return job_rc
 
@@ -154,6 +156,21 @@ def main(argv=None) -> int:
                          "per-rank dumps into DIR (sets "
                          "HOROVOD_TPU_METRICS_DIR; summarize with "
                          "`python -m horovod_tpu.telemetry summarize DIR`)")
+    ap.add_argument("--metrics-port", type=int, default=None, metavar="P",
+                    help="serve live Prometheus /metrics endpoints: rank r "
+                         "scrapes at P+1+r (sets HOROVOD_TPU_METRICS_PORT "
+                         "per worker) and this launcher serves a job-level "
+                         "aggregation at P with every sample re-labelled "
+                         "rank=\"r\" — one scrape target that follows the "
+                         "job through elastic membership changes")
+    ap.add_argument("--trace-dir", default=None, metavar="DIR",
+                    help="flight-recorder black boxes: each rank keeps its "
+                         "always-on event ring in DIR/trace.rank<r>.bin, "
+                         "durable at every event (sets "
+                         "HOROVOD_TPU_TRACE_DIR), so post-mortems read the "
+                         "last engine phases even of a SIGKILLed rank; "
+                         "merge with `python -m horovod_tpu.telemetry "
+                         "trace DIR` for cross-rank straggler attribution")
     ap.add_argument("--cache-capacity", type=int, default=None,
                     metavar="N",
                     help="negotiation response-cache capacity in entries "
@@ -249,6 +266,8 @@ def main(argv=None) -> int:
 
     if args.metrics_dir:
         os.makedirs(args.metrics_dir, exist_ok=True)
+    if args.trace_dir:
+        os.makedirs(args.trace_dir, exist_ok=True)
 
     if not args.command:
         ap.error("no command given")
@@ -332,6 +351,14 @@ def main(argv=None) -> int:
             env["HOROVOD_TIMELINE"] = args.timeline
         if args.metrics_dir:
             env["HOROVOD_TPU_METRICS_DIR"] = args.metrics_dir
+        if args.trace_dir:
+            env["HOROVOD_TPU_TRACE_DIR"] = args.trace_dir
+        if args.metrics_port is not None:
+            # rank r's own scrape endpoint; the launcher aggregates at the
+            # base port (rank is the GLOBAL rank so multi-host launches
+            # never collide on one host's port space)
+            env["HOROVOD_TPU_METRICS_PORT"] = str(
+                args.metrics_port + 1 + rank)
         if args.cache_capacity is not None:
             env["HOROVOD_TPU_CACHE_CAPACITY"] = str(args.cache_capacity)
         if args.pipeline_depth is not None:
@@ -368,9 +395,30 @@ def main(argv=None) -> int:
     for local_rank in range(local_n):
         procs.append(_spawn(local_rank))
 
-    if elastic:
-        return _elastic_supervise(procs, args, first_rank, local_n, _spawn,
-                                  _kill_all)
+    # job-level /metrics aggregation: one scrape target at the base port,
+    # every sample re-labelled with its rank
+    aggregator = None
+    if args.metrics_port is not None:
+        from horovod_tpu.telemetry.httpd import (MetricsServer,
+                                                 scrape_and_aggregate)
+
+        ports = {first_rank + i: args.metrics_port + 1 + first_rank + i
+                 for i in range(local_n)}
+        try:
+            aggregator = MetricsServer(
+                args.metrics_port,
+                aggregate=lambda: scrape_and_aggregate(ports))
+        except OSError as e:
+            print(f"[horovod_tpu.run] /metrics aggregator disabled: {e}",
+                  file=sys.stderr)
+
+    try:
+        if elastic:
+            return _elastic_supervise(procs, args, first_rank, local_n,
+                                      _spawn, _kill_all)
+    finally:
+        if elastic and aggregator is not None:
+            aggregator.stop()
 
     exit_code = 0
     failed = False
@@ -408,10 +456,13 @@ def main(argv=None) -> int:
                 time.sleep(0.05)
     finally:
         _kill_all()
+        if aggregator is not None:
+            aggregator.stop()
         if failed:
             # one line per local rank: exit cause + whatever telemetry the
             # job left behind (heartbeat age from the metrics dumps, last
-            # span from the timeline files) — 'n/a' when those were off
+            # span from the timeline files, last flight-recorder phase
+            # from the black box) — 'n/a' when those were off
             print("[horovod_tpu.run] post-mortem:", file=sys.stderr)
             for i in range(local_n):
                 line = _fault.post_mortem_line(
@@ -420,7 +471,9 @@ def main(argv=None) -> int:
                     metrics_dir=args.metrics_dir
                     or os.environ.get("HOROVOD_TPU_METRICS_DIR"),
                     timeline_path=args.timeline
-                    or os.environ.get("HOROVOD_TIMELINE"))
+                    or os.environ.get("HOROVOD_TIMELINE"),
+                    trace_dir=args.trace_dir
+                    or os.environ.get("HOROVOD_TPU_TRACE_DIR"))
                 print(f"[horovod_tpu.run]   {line}", file=sys.stderr)
     return exit_code
 

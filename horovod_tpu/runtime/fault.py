@@ -17,6 +17,9 @@ Spec grammar (';'-separated specs, ':'-separated ``key=value`` fields)::
     kill:rank=2:cycle=5            SIGKILL rank 2 at its 5th negotiation tick
     kill:rank=1:phase=ring         SIGKILL rank 1 entering its 1st ring
     hang:rank=1:phase=unpack       wedge (sleep forever) instead of dying
+    slow:rank=1:phase=pack:ms=30   sleep 30 ms at EVERY pack entry — the
+                                   deterministic per-phase straggler the
+                                   flight-recorder attribution bench must find
     delay:link=0-1:ms=500          500 ms pause entering each 0<->1 transfer
 
 Phases: ``negotiation`` (default), ``pack``, ``ring``, ``unpack``.
@@ -123,12 +126,12 @@ def min_np(environ=os.environ) -> int:
 class FaultSpec:
     """One parsed ``HOROVOD_TPU_FAULT_INJECT`` spec."""
 
-    kind: str                 # "kill" | "hang" | "delay"
-    rank: int | None = None   # kill/hang target
+    kind: str                 # "kill" | "hang" | "slow" | "delay"
+    rank: int | None = None   # kill/hang/slow target
     phase: str = "negotiation"
     hit: int = 1
     link: tuple[int, int] | None = None  # delay only
-    ms: int = 0                          # delay only
+    ms: int = 0                          # slow/delay only
 
 
 def parse_inject_spec(text: str) -> list[FaultSpec]:
@@ -138,9 +141,9 @@ def parse_inject_spec(text: str) -> list[FaultSpec]:
     out: list[FaultSpec] = []
     for one in filter(None, (s.strip() for s in text.split(";"))):
         kind, _, body = one.partition(":")
-        if kind not in ("kill", "hang", "delay"):
+        if kind not in ("kill", "hang", "slow", "delay"):
             raise ValueError(f"unknown fault type {kind!r} in {one!r} "
-                             "(want kill/hang/delay)")
+                             "(want kill/hang/slow/delay)")
         spec = FaultSpec(kind=kind)
         for field in filter(None, body.split(":")):
             key, eq, val = field.partition("=")
@@ -166,8 +169,10 @@ def parse_inject_spec(text: str) -> list[FaultSpec]:
                 spec.link = (int(m.group(1)), int(m.group(2)))
             else:
                 raise ValueError(f"unknown field {key!r} in {one!r}")
-        if kind in ("kill", "hang") and spec.rank is None:
+        if kind in ("kill", "hang", "slow") and spec.rank is None:
             raise ValueError(f"{one!r} lacks rank=")
+        if kind == "slow" and spec.ms <= 0:
+            raise ValueError(f"{one!r} wants ms=N")
         if kind == "delay" and (spec.link is None or spec.ms <= 0):
             raise ValueError(f"{one!r} wants link=A-B and ms=N")
         out.append(spec)
@@ -258,15 +263,38 @@ def last_timeline_span(timeline_path: str | None,
     return None
 
 
+def last_trace_phase(trace_dir: str | None, rank: int) -> str | None:
+    """The last flight-recorder phase a rank was IN before it stopped
+    writing — read straight from the rank's black-box file, which is
+    valid at every instant (file-backed mmap), so a SIGKILLed rank
+    answers too.  None when the job ran without a trace dir or the file
+    is unreadable."""
+    if not trace_dir:
+        return None
+    path = os.path.join(trace_dir, f"trace.rank{rank}.bin")
+    try:
+        from horovod_tpu.telemetry import trace as ftrace
+
+        got = ftrace.last_phase(path)
+    except (OSError, ValueError):
+        return None
+    return got[0] if got else None
+
+
 def post_mortem_line(rank: int, returncode: int | None,
                      metrics_dir: str | None = None,
-                     timeline_path: str | None = None) -> str:
+                     timeline_path: str | None = None,
+                     trace_dir: str | None = None) -> str:
     """One supervision report line for a rank: exit cause, last exported
-    heartbeat age, last timeline span — 'n/a' where the job ran without
-    that telemetry."""
+    heartbeat age, last timeline span, and the flight recorder's last
+    engine phase — 'n/a' where the job ran without that telemetry.  The
+    flight-recorder column is the one that survives SIGKILL: the black
+    box is a file-backed ring, durable at every event."""
     age = heartbeat_age_from_metrics(metrics_dir, rank)
     span = last_timeline_span(timeline_path, rank)
+    phase = last_trace_phase(trace_dir, rank)
     return (f"rank {rank}: {describe_exit(returncode)}, "
             f"heartbeat_age={age if age is not None else 'n/a'}"
             f"{'s' if age is not None else ''}, "
-            f"last_span={span or 'n/a'}")
+            f"last_span={span or 'n/a'}, "
+            f"last_phase={phase or 'n/a'}")

@@ -212,6 +212,15 @@ def _bind(lib):
     except AttributeError:
         pass
     try:
+        # flight recorder (trace.h); same prebuilt-.so caveat
+        lib.hvd_trace_dump.argtypes = [ctypes.c_char_p]
+        lib.hvd_trace_dump.restype = ctypes.c_int
+        lib.hvd_trace_stats.argtypes = [ctypes.POINTER(ctypes.c_int64)]
+        lib.hvd_trace_stats.restype = None
+        lib.hvd_trace_path.restype = ctypes.c_void_p  # manual free
+    except AttributeError:
+        pass
+    try:
         # process sets (wire v8); same prebuilt-.so caveat
         lib.hvd_enqueue_set.argtypes = [
             ctypes.c_int, ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
@@ -307,6 +316,7 @@ class NativeEngine(Engine):
         d.update(self._fault_stats())
         d.update(self._wire_stats())
         d.update(self.world_stats())
+        d.update(self.trace_stats())
         psets = self.process_set_stats()
         d["process_sets"] = psets
         d["process_set_count"] = len(psets)
@@ -424,6 +434,49 @@ class NativeEngine(Engine):
             {k: int(vals[8 * i + j]) for j, k in enumerate(keys)}
             for i in range(max(n, 0))
         ]
+
+    # -- flight recorder ----------------------------------------------------
+    def trace_stats(self) -> dict:
+        """Flight-recorder statistics: whether it is armed, how many
+        thread rings are live, the counted events-written/dropped totals,
+        the per-ring capacity, the bootstrap clock offset against rank 0,
+        auto-dump count, and whether the rings are file-backed (the
+        black-box mode).  Zeros when the loaded .so predates the
+        recorder."""
+        fn = getattr(self._lib, "hvd_trace_stats", None)
+        keys = ("trace_enabled", "trace_rings", "trace_events",
+                "trace_events_dropped", "trace_ring_capacity",
+                "trace_clock_offset_ns", "trace_auto_dumps",
+                "trace_file_backed")
+        if fn is None:
+            return dict.fromkeys(keys, 0)
+        vals = (ctypes.c_int64 * 8)()
+        fn(vals)
+        return {k: int(v) for k, v in zip(keys, vals)}
+
+    def trace_dump(self, path: str | None = None) -> bool:
+        """Copy the flight recorder to ``path``; ``path=None`` flushes a
+        file-backed recorder in place and is a successful no-op for an
+        anonymous one (nothing durable to flush — pass a path to persist
+        it).  Safe at any time; returns False when the recorder is off."""
+        fn = getattr(self._lib, "hvd_trace_dump", None)
+        if fn is None:
+            return False
+        return fn(path.encode() if path else None) == 0
+
+    def trace_path(self) -> str | None:
+        """The live recorder file ('' -> None when anonymous/off)."""
+        fn = getattr(self._lib, "hvd_trace_path", None)
+        if fn is None:
+            return None
+        p = fn()
+        if not p:
+            return None
+        try:
+            s = ctypes.cast(p, ctypes.c_char_p).value.decode()
+        finally:
+            self._lib.hvd_free_cstr(p)
+        return s or None
 
     def _fault_stats(self) -> dict:
         """Fault-domain counters.  ``heartbeat_age_s`` is the oldest

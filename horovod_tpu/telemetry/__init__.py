@@ -127,6 +127,7 @@ _lock = threading.Lock()
 _metrics_resolved = False
 _metrics_on = False
 _dumper: MetricsDumper | None = None
+_http_server = None  # httpd.MetricsServer when HOROVOD_TPU_METRICS_PORT set
 
 
 def registry() -> MetricsRegistry:
@@ -143,7 +144,8 @@ def metrics_enabled() -> bool:
             if not _metrics_resolved:
                 env = os.environ.get("HOROVOD_TPU_METRICS", "").lower()
                 _metrics_on = env in _TRUTHY or bool(
-                    os.environ.get("HOROVOD_TPU_METRICS_DIR"))
+                    os.environ.get("HOROVOD_TPU_METRICS_DIR")) or bool(
+                    os.environ.get("HOROVOD_TPU_METRICS_PORT"))
                 _metrics_resolved = True
     return _metrics_on
 
@@ -159,11 +161,14 @@ def set_metrics_enabled(value: bool) -> None:
 def reset() -> None:
     """Drop all telemetry state and re-read the environment on next use.
     Test plumbing — production code never needs this."""
-    global _metrics_resolved, _dumper
+    global _metrics_resolved, _dumper, _http_server
     with _lock:
         if _dumper is not None:
             _dumper.stop(final_dump=False)
             _dumper = None
+        if _http_server is not None:
+            _http_server.stop()
+            _http_server = None
         _registry.clear()
         _metrics_resolved = False
     timeline.close()
@@ -174,10 +179,10 @@ def reset() -> None:
 # ---------------------------------------------------------------------------
 
 def on_init(rank: int) -> None:
-    """Start the periodic per-rank dump thread when a metrics dir is set."""
-    global _dumper
-    directory = os.environ.get("HOROVOD_TPU_METRICS_DIR")
-    if not directory or not metrics_enabled():
+    """Start the periodic per-rank dump thread when a metrics dir is set,
+    and the live ``/metrics`` scrape endpoint when a port is."""
+    global _dumper, _http_server
+    if not metrics_enabled():
         return
     # key dump files by the GLOBAL launcher rank when one exists: a
     # sub-communicator init() re-bases `rank` per sub-world, and two
@@ -188,21 +193,50 @@ def on_init(rank: int) -> None:
     global_rank = _env_int(_RANK_ENV)
     if global_rank is None:
         global_rank = rank
+    directory = os.environ.get("HOROVOD_TPU_METRICS_DIR")
+    if directory:
+        with _lock:
+            if _dumper is None:
+                interval = float(
+                    os.environ.get("HOROVOD_TPU_METRICS_INTERVAL", "30"))
+                _dumper = MetricsDumper(_registry, directory, global_rank,
+                                        interval)
+    port_env = os.environ.get("HOROVOD_TPU_METRICS_PORT")
+    if port_env:
+        with _lock:
+            if _http_server is None:
+                try:
+                    from horovod_tpu.telemetry.httpd import MetricsServer
+
+                    _http_server = MetricsServer(
+                        int(port_env), registry=_registry, rank=global_rank)
+                except (OSError, ValueError) as exc:
+                    # a busy port must not kill training; scraping is lost,
+                    # the job is not
+                    import sys
+
+                    print(f"[horovod_tpu.telemetry] /metrics endpoint "
+                          f"disabled: {exc}", file=sys.stderr)
+
+
+def metrics_port() -> int | None:
+    """The live scrape endpoint's resolved port (port 0 requests pick an
+    ephemeral one), or None when no endpoint is up."""
     with _lock:
-        if _dumper is None:
-            interval = float(
-                os.environ.get("HOROVOD_TPU_METRICS_INTERVAL", "30"))
-            _dumper = MetricsDumper(_registry, directory, global_rank,
-                                    interval)
+        return _http_server.port if _http_server is not None else None
 
 
 def on_shutdown() -> None:
-    """Final dump + stop the dumper; finalize the Python timeline file."""
-    global _dumper
+    """Final dump + stop the dumper and the scrape endpoint; finalize the
+    Python timeline file."""
+    global _dumper, _http_server
     with _lock:
         if _dumper is not None:
             _dumper.stop(final_dump=True)
             _dumper = None
+        if _http_server is not None:
+            _http_server.stop()
+            _http_server = None
     timeline.close()
 
 
@@ -347,7 +381,7 @@ def record_fusion_bucket(used_bytes: int, capacity_bytes: int) -> None:
 
 __all__ = [
     "registry", "metrics_enabled", "set_metrics_enabled", "reset",
-    "on_init", "on_shutdown",
+    "on_init", "on_shutdown", "metrics_port",
     "instrument_engine", "wait_timer",
     "record_compiled_collective", "record_fusion_bucket",
     "timeline",

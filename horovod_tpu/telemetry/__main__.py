@@ -11,8 +11,16 @@ Subcommands:
 * ``merge-timelines -o merged.json <trace...>`` — join per-rank Chrome
   traces (native rank-0 file + Python ``.pyrank<r>`` files) into a single
   Perfetto-loadable trace with one pid per rank.
+* ``trace <trace-dir>`` — align the flight-recorder dumps
+  (``trace.rank*.bin``) across ranks, reconstruct per-collective
+  cross-rank spans, compute the critical path, and print the straggler
+  attribution table (per rank x phase: fraction of step critical path).
+  ``-o merged.json`` additionally writes a clock-aligned merged Chrome
+  trace; ``--json`` emits the attribution + counted event series as JSON
+  (what ``bench.py --trace`` and CI gate on).
 
-Pure Python over JSON files: runs anywhere, no native ``.so``, no JAX.
+Pure Python over JSON/binary files: runs anywhere, no native ``.so``,
+no JAX.
 """
 
 from __future__ import annotations
@@ -40,8 +48,20 @@ def main(argv: list[str] | None = None) -> int:
     ap_mt.add_argument("traces", nargs="+")
     ap_mt.add_argument("-o", "--output", required=True)
 
+    ap_tr = sub.add_parser(
+        "trace", help="merge flight-recorder dumps: cross-rank spans, "
+                      "critical path, straggler attribution")
+    ap_tr.add_argument("trace_dir")
+    ap_tr.add_argument("-o", "--output", default=None,
+                       help="also write a clock-aligned merged Chrome trace")
+    ap_tr.add_argument("--json", action="store_true",
+                       help="emit attribution + counted series as JSON")
+
     args = ap.parse_args(argv)
     from horovod_tpu.telemetry import merge
+
+    if args.cmd == "trace":
+        return _trace_cmd(args)
 
     if args.cmd == "summarize":
         try:
@@ -58,6 +78,42 @@ def main(argv: list[str] | None = None) -> int:
     n = merge.merge_timelines(args.traces, args.output)
     print(f"wrote {n} events from {len(args.traces)} trace(s) "
           f"to {args.output}")
+    return 0
+
+
+def _trace_cmd(args) -> int:
+    import json as _json
+
+    from horovod_tpu.telemetry import trace as ftrace
+
+    try:
+        docs = ftrace.load_dir(args.trace_dir)
+    except FileNotFoundError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    merged = ftrace.merge(docs)
+    if args.output:
+        n = ftrace.chrome_trace(docs, args.output)
+        print(f"wrote {n} events from {len(docs)} rank(s) to {args.output}",
+              file=sys.stderr)
+    if args.json:
+        att = ftrace.attribution(merged)
+        doc = {
+            "ranks": merged["ranks"],
+            "epoch_by_rank": merged["epoch_by_rank"],
+            "clock_offsets_ns": {d["rank"]: d["clock_offset_ns"]
+                                 for d in docs},
+            "attribution": att,
+            "counted": ftrace.counted_series(merged),
+            "last_phase_by_rank": {
+                d["rank"]: (ftrace.last_phase(d) or ("n/a", {}))[0]
+                for d in docs},
+        }
+        print(_json.dumps(doc, indent=1))
+    else:
+        print(f"flight recorder: {len(docs)} rank(s), "
+              f"{len(merged['collectives'])} correlated collective(s)")
+        print(ftrace.attribution_table(merged))
     return 0
 
 

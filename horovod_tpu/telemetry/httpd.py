@@ -1,0 +1,181 @@
+"""Live ``/metrics`` HTTP endpoint: per-rank Prometheus scrape targets plus
+the launcher's job-level aggregator.
+
+Per rank, :class:`MetricsServer` serves the process-global registry (which
+already speaks the Prometheus text exposition format) on
+``HOROVOD_TPU_METRICS_PORT`` — collectors run per scrape, so the native
+engine's diagnostics are polled exactly when Prometheus asks.  ``hvdrun
+--metrics-port P`` gives rank r port ``P + 1 + r`` and itself serves an
+aggregated job view on ``P`` by scraping every live rank and re-labelling
+each sample with ``rank="r"`` (the sidecar-exporter shape, done in-process
+so a single scrape target follows the job through elastic membership
+changes).
+
+Endpoints:
+
+* ``GET /metrics`` — Prometheus text (aggregated on the launcher).
+* ``GET /metrics.json`` — the registry's JSON dump document.
+* anything else — 404.
+
+Stdlib only (``http.server`` + ``urllib``); daemon threads, so a wedged
+scraper can never hold a training process open.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "hvdtpu-metrics/1"
+
+    def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler contract
+        try:
+            if self.path.split("?", 1)[0] == "/metrics":
+                body = self.server.render_text().encode()
+                ctype = "text/plain; version=0.0.4; charset=utf-8"
+            elif self.path.split("?", 1)[0] == "/metrics.json":
+                body = self.server.render_json().encode()
+                ctype = "application/json"
+            else:
+                self.send_error(404, "try /metrics")
+                return
+        except Exception as exc:  # a dead engine must not kill the scrape
+            self.send_error(500, str(exc)[:200])
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *args):  # scrapes are not stderr news
+        pass
+
+
+class MetricsServer:
+    """Serve one registry (a rank) or an aggregation callback (hvdrun)."""
+
+    def __init__(self, port: int, registry=None, rank: int | None = None,
+                 aggregate=None) -> None:
+        self._registry = registry
+        self._rank = rank
+        self._aggregate = aggregate
+        self._httpd = ThreadingHTTPServer(("", port), _Handler)
+        self._httpd.daemon_threads = True
+        self._httpd.render_text = self._text
+        self._httpd.render_json = self._json
+        self.port = self._httpd.server_address[1]  # resolved when port=0
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="hvdtpu-metrics-http",
+            daemon=True)
+        self._thread.start()
+
+    def _text(self) -> str:
+        if self._aggregate is not None:
+            return self._aggregate()
+        return self._registry.to_prometheus()
+
+    def _json(self) -> str:
+        if self._aggregate is not None:
+            return json.dumps({"aggregated": True,
+                               "prometheus": self._aggregate()})
+        return self._registry.to_json(rank=self._rank)
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+
+# ---------------------------------------------------------------------------
+# aggregation (launcher side)
+# ---------------------------------------------------------------------------
+
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[A-Za-z_:][A-Za-z0-9_:]*)"
+    r"(?:\{(?P<labels>[^}]*)\})?\s+(?P<value>\S+)$")
+
+
+def relabel(text: str, rank: int) -> str:
+    """Add ``rank="r"`` to every sample of a Prometheus text page (TYPE
+    comments pass through; other comments are dropped)."""
+    out = []
+    for line in text.splitlines():
+        line = line.rstrip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            if line.startswith("# TYPE"):
+                out.append(line)
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            continue
+        labels = m.group("labels")
+        merged = f'rank="{rank}"' + (f",{labels}" if labels else "")
+        out.append(f"{m.group('name')}{{{merged}}} {m.group('value')}")
+    return "\n".join(out)
+
+
+def scrape_and_aggregate(ports_by_rank: dict[int, int],
+                         timeout_s: float = 2.0) -> str:
+    """Fetch every rank's ``/metrics`` (concurrently — a straggler hunt
+    usually starts exactly when some rank is sick, and serial timeouts
+    would stack) and join them into one page with a ``rank`` label per
+    sample.  Ranks that don't answer (dead, not up yet) are reported
+    through ``hvdrun_rank_up`` instead of failing the scrape."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    def fetch(item):
+        rank, port = item
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics",
+                    timeout=timeout_s) as r:
+                return rank, relabel(r.read().decode(), rank)
+        except Exception:
+            return rank, None
+    items = sorted(ports_by_rank.items())
+    with ThreadPoolExecutor(max_workers=min(len(items), 16) or 1) as ex:
+        fetched = list(ex.map(fetch, items))
+    pages = [page for _, page in fetched if page is not None]
+    up = {rank: int(page is not None) for rank, page in fetched}
+    # family grouping: exposition format wants all samples of one metric
+    # contiguous — re-group the concatenated pages by SAMPLE name.  A
+    # histogram's samples (name_bucket/_sum/_count) must sit under the
+    # base name's TYPE line, so map suffixed sample names back to the
+    # family the TYPE comment declared.
+    families: dict[str, list[str]] = {}
+    types: dict[str, str] = {}
+    for page in pages:
+        for line in page.splitlines():
+            if line.startswith("# TYPE "):
+                _, _, name, kind = line.split(None, 3)
+                types.setdefault(name, kind)
+                continue
+            name = line.split("{", 1)[0].split(" ", 1)[0]
+            families.setdefault(name, []).append(line)
+
+    def base_family(name: str) -> str:
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and name[: -len(suffix)] in types:
+                return name[: -len(suffix)]
+        return name
+    lines = ["# TYPE hvdrun_rank_up gauge"]
+    lines += [f'hvdrun_rank_up{{rank="{r}"}} {v}'
+              for r, v in sorted(up.items())]
+    typed: set[str] = set()
+    for name in sorted(families, key=lambda n: (base_family(n), n)):
+        base = base_family(name)
+        if base in types and base not in typed:
+            lines.append(f"# TYPE {base} {types[base]}")
+            typed.add(base)
+        lines += families[name]
+    lines.append(f"# scraped {time.strftime('%Y-%m-%dT%H:%M:%S')}")
+    return "\n".join(lines) + "\n"

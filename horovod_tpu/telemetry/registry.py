@@ -297,14 +297,32 @@ class MetricsRegistry:
 
     # -- per-rank dump files -------------------------------------------------
     def dump(self, directory: str, rank: int) -> str:
-        """Write ``metrics.rank<r>.json`` atomically (tmp + rename) so the
-        merge CLI never reads a half-written file."""
+        """Write ``metrics.rank<r>.json`` atomically: tmp + fsync + rename.
+
+        The contract post-mortems and the merge CLI rely on: the published
+        name NEVER holds a torn document.  The tmp name is pid-unique so a
+        relaunched incarnation of a killed rank (elastic joiners reuse the
+        slot) can't collide with the corpse's abandoned tmp, fsync orders
+        the data before the rename publishes it, and a dump interrupted by
+        SIGKILL leaves only a stray ``.tmp`` — the previous complete dump
+        stays readable under the real name."""
         os.makedirs(directory, exist_ok=True)
         path = os.path.join(directory, f"metrics.rank{rank}.json")
-        tmp = path + ".tmp"
-        with open(tmp, "w") as f:
-            f.write(self.to_json(rank=rank))
-        os.replace(tmp, path)
+        tmp = f"{path}.{os.getpid()}.tmp"
+        try:
+            with open(tmp, "w") as f:
+                f.write(self.to_json(rank=rank))
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        finally:
+            # a failed replace (disk full mid-write, ...) must not leave
+            # tmp litter for the merge CLI's glob to trip on
+            if os.path.exists(tmp):
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
         return path
 
     def clear(self) -> None:

@@ -1,0 +1,474 @@
+"""Flight-recorder reader + cross-rank trace correlation.
+
+The native engine's flight recorder (``csrc/trace.{h,cc}``) leaves one
+binary file per rank (``trace.rank<r>.bin``): a 4 KB header, 16 per-thread
+ring headers, and 16 rings of fixed 32-byte events.  File-backed rings are
+valid dumps at EVERY instant — a SIGKILLed rank's file holds its last
+~100k events with no flush anywhere — so this module is both the
+post-mortem reader (``last_phase``) and the straggler-attribution engine
+(``merge``/``attribution``).
+
+Cross-rank correlation costs no wire bytes: every negotiated collective
+already has a deterministic (process set, world epoch, round) identity on
+every rank — responses broadcast in stream order and each rank counts them
+identically — so events merge on that key alone.  Timestamps align via the
+clock offset each worker measured against rank 0 during bootstrap
+rendezvous (``clock_offset_ns`` in the header).
+
+Pure Python over ``struct``: no numpy, no native ``.so``, runs anywhere
+(the launcher's post-mortem path must work on a box that can't build the
+engine).
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import struct
+
+MAGIC = b"HVDTRC01"
+
+_HEADER_FMT = "<8sIiiiIII4xqqqqqQ"
+_HEADER_LEN = struct.calcsize(_HEADER_FMT)  # 88; header block is 4096
+_HEADER_BLOCK = 4096
+_RING_FMT = "<QQ24s8x16x"
+_RING_LEN = 64
+_EVENT_FMT = "<qqIiHHhBB"
+_EVENT_LEN = 32
+
+END_FLAG = 0x80
+
+PHASES = {
+    0: "enqueue", 1: "negotiate", 2: "pack", 3: "wire-send",
+    4: "wire-recv", 5: "accumulate", 6: "unpack", 7: "complete",
+    8: "abort", 9: "world-change", 10: "signal", 11: "init",
+    12: "clock-probe",
+}
+PHASE_IDS = {v: k for k, v in PHASES.items()}
+
+# phases whose per-collective event counts are pure functions of the
+# workload (tensor sizes, ring size, segment size) — the counted series
+# bench.py --trace gates on.  negotiate/enqueue counts depend on tick
+# scheduling and stay out.
+COUNTED_PHASES = ("wire-send", "wire-recv", "accumulate", "complete")
+
+# attribution buckets, in report order
+SPAN_PHASES = ("negotiate", "pack", "wire-send", "wire-recv",
+               "accumulate", "unpack")
+
+
+class Event:
+    __slots__ = ("t_ns", "arg", "round", "set", "epoch", "slot", "peer",
+                 "phase_id", "stripe", "op", "end")
+
+    def __init__(self, t_ns, arg, round_, set_, epoch, slot, peer, phase,
+                 aux):
+        self.t_ns = t_ns
+        self.arg = arg
+        self.round = round_
+        self.set = set_
+        self.epoch = epoch
+        self.slot = slot
+        self.peer = peer
+        self.phase_id = phase & 0x7F
+        self.end = bool(phase & END_FLAG)
+        self.stripe = aux & 0x0F
+        self.op = (aux >> 4) & 0x0F
+
+    @property
+    def phase(self) -> str:
+        return PHASES.get(self.phase_id, f"?{self.phase_id}")
+
+    def to_dict(self) -> dict:
+        return {"t_ns": self.t_ns, "arg": self.arg, "round": self.round,
+                "set": self.set, "epoch": self.epoch, "slot": self.slot,
+                "peer": self.peer, "phase": self.phase, "end": self.end,
+                "stripe": self.stripe, "op": self.op}
+
+
+def read_trace(path: str) -> dict:
+    """Parse one per-rank recorder file into
+    ``{rank, size, pid, clock_offset_ns, start_unix_ns, dropped, rings}``
+    where each ring is ``{name, tid, head, events}`` (events in
+    chronological ring order).  Tolerates a torn in-flight event (a killed
+    writer) by validating each record; raises ``ValueError`` on a file
+    that is not a recorder dump at all."""
+    with open(path, "rb") as f:
+        blob = f.read()
+    if len(blob) < _HEADER_BLOCK or blob[:8] != MAGIC:
+        raise ValueError(f"{path!r} is not a flight-recorder dump")
+    (_, version, rank, size, pid, ring_events, nrings_max, nrings,
+     dropped, clock_offset, auto_dumps, start_mono, start_unix,
+     world_epoch) = struct.unpack_from(_HEADER_FMT, blob, 0)
+    nrings = min(nrings, nrings_max)
+    rings = []
+    data_off = _HEADER_BLOCK + _RING_LEN * nrings_max
+    for i in range(nrings):
+        head, tid, name = struct.unpack_from(
+            _RING_FMT, blob, _HEADER_BLOCK + i * _RING_LEN)
+        base = data_off + i * ring_events * _EVENT_LEN
+        count = min(head, ring_events)
+        start = head % ring_events if head > ring_events else 0
+        events = []
+        for k in range(count):
+            off = base + ((start + k) % ring_events) * _EVENT_LEN
+            if off + _EVENT_LEN > len(blob):
+                break
+            rec = struct.unpack_from(_EVENT_FMT, blob, off)
+            ev = Event(*rec)
+            # torn-record guard: a killed writer can leave one half-written
+            # event; drop anything that fails basic sanity
+            if ev.t_ns <= 0 or ev.phase_id not in PHASES:
+                continue
+            events.append(ev)
+        rings.append({
+            "name": name.split(b"\0", 1)[0].decode("ascii", "replace"),
+            "tid": tid, "head": head, "events": events,
+        })
+    return {
+        "path": path, "version": version, "rank": rank, "size": size,
+        "pid": pid, "ring_events": ring_events, "dropped": dropped,
+        "clock_offset_ns": clock_offset, "auto_dumps": auto_dumps,
+        "start_mono_ns": start_mono, "start_unix_ns": start_unix,
+        "world_epoch": world_epoch, "rings": rings,
+    }
+
+
+def load_dir(trace_dir: str) -> list[dict]:
+    """Every ``trace.rank*.bin`` in a directory, sorted by rank."""
+    paths = glob.glob(os.path.join(trace_dir, "trace.rank*.bin"))
+    if not paths:
+        raise FileNotFoundError(
+            f"no trace.rank*.bin files in {trace_dir!r} — was the job run "
+            "with --trace-dir / HOROVOD_TPU_TRACE_DIR?")
+    docs = []
+    for p in paths:
+        try:
+            docs.append(read_trace(p))
+        except ValueError:
+            continue
+    for d in docs:
+        if d["rank"] < 0:
+            m = re.search(r"rank(\d+)", os.path.basename(d["path"]))
+            d["rank"] = int(m.group(1)) if m else 0
+    docs.sort(key=lambda d: d["rank"])
+    return docs
+
+
+def last_phase(doc_or_path):
+    """The last engine phase a rank was IN when it stopped writing — the
+    black-box answer hvdrun's post-mortem prints for a SIGKILLed rank.
+    Returns ``(phase_name, detail_dict)`` or ``None`` on an empty trace.
+
+    Preference order: a terminal marker (signal/abort/world-change) wins;
+    otherwise the latest span BEGIN without its end (the phase in
+    progress); otherwise the latest event of any kind."""
+    doc = read_trace(doc_or_path) if isinstance(doc_or_path, str) \
+        else doc_or_path
+    span_ids = {PHASE_IDS[p] for p in SPAN_PHASES}
+    latest = None          # newest event overall
+    open_begin = None      # newest begin whose end never arrived
+    marker = None          # newest terminal marker
+    for ring in doc["rings"]:
+        opens: dict = {}
+        neg_open: dict = {}  # negotiate begins carry round 0: FIFO per set
+        for ev in ring["events"]:
+            if latest is None or ev.t_ns > latest.t_ns:
+                latest = ev
+            if ev.phase in ("signal", "abort", "world-change"):
+                if marker is None or ev.t_ns > marker.t_ns:
+                    marker = ev
+                continue
+            if ev.phase_id not in span_ids:
+                continue
+            if ev.phase == "negotiate":
+                # the end carries the resolved round, the begin round 0 —
+                # pair FIFO per set, same rule as _rank_spans
+                q = neg_open.setdefault(ev.set, [])
+                if ev.end:
+                    if q:
+                        q.pop(0)
+                else:
+                    q.append(ev)
+                continue
+            key = (ev.set, ev.round, ev.phase_id, ev.slot)
+            if ev.end:
+                opens.pop(key, None)
+            else:
+                opens[key] = ev
+        for ev in opens.values():
+            if open_begin is None or ev.t_ns > open_begin.t_ns:
+                open_begin = ev
+        for q in neg_open.values():
+            for ev in q:
+                if open_begin is None or ev.t_ns > open_begin.t_ns:
+                    open_begin = ev
+    pick = marker or open_begin or latest
+    if pick is None:
+        return None
+    return pick.phase, pick.to_dict()
+
+
+# ---------------------------------------------------------------------------
+# cross-rank correlation
+# ---------------------------------------------------------------------------
+
+def _rank_spans(doc: dict, epoch: int | None):
+    """Pair begin/end markers into spans for one rank.  Returns
+    ``(spans, completes, chosen_epoch)`` where spans are dicts with
+    offset-corrected t0/t1.  ``epoch=None`` picks the rank's LATEST world
+    epoch — the only one guaranteed consistent across ranks after elastic
+    membership changes (a joiner's epoch counter restarts)."""
+    off = doc["clock_offset_ns"]
+    span_ids = {PHASE_IDS[p] for p in SPAN_PHASES}
+    if epoch is None:
+        epoch = 0
+        for ring in doc["rings"]:
+            for ev in ring["events"]:
+                if ev.phase_id in span_ids or ev.phase == "complete":
+                    epoch = max(epoch, ev.epoch)
+    spans, completes = [], []
+    for ring in doc["rings"]:
+        open_by_key: dict = {}
+        neg_open: dict = {}  # set -> [begin events], FIFO
+        for ev in ring["events"]:
+            if ev.epoch != epoch:
+                continue
+            if ev.phase == "complete":
+                completes.append({"t": ev.t_ns + off, "set": ev.set,
+                                  "round": ev.round, "status": ev.arg})
+                continue
+            if ev.phase_id not in span_ids:
+                continue
+            if ev.phase == "negotiate":
+                # begins carry round 0 (unknown yet); the end resolves it.
+                # FIFO pairing: oldest open submit matches the next round.
+                if not ev.end:
+                    neg_open.setdefault(ev.set, []).append(ev)
+                    continue
+                q = neg_open.get(ev.set) or []
+                t0 = q.pop(0).t_ns if q else ev.t_ns
+                spans.append({"phase": "negotiate", "set": ev.set,
+                              "round": ev.round, "slot": 0, "peer": -1,
+                              "stripe": 0, "bytes": ev.arg,
+                              "t0": t0 + off, "t1": ev.t_ns + off})
+                continue
+            key = (ev.set, ev.round, ev.phase_id, ev.slot)
+            if not ev.end:
+                open_by_key[key] = ev
+                continue
+            b = open_by_key.pop(key, None)
+            t0 = b.t_ns if b is not None else ev.t_ns
+            spans.append({"phase": ev.phase, "set": ev.set,
+                          "round": ev.round, "slot": ev.slot,
+                          "peer": ev.peer, "stripe": ev.stripe,
+                          "bytes": ev.arg, "t0": t0 + off,
+                          "t1": ev.t_ns + off})
+    return spans, completes, epoch
+
+
+def merge(docs: list[dict], epoch: int | None = None) -> dict:
+    """Correlate per-rank traces into per-collective cross-rank rows.
+
+    Returns ``{collectives, ranks, epoch_by_rank}`` where ``collectives``
+    maps ``(set, round)`` to::
+
+        {"ranks": {rank: {"phases": {phase: ns}, "events": {phase: n},
+                          "start": ns, "end": ns}},
+         "start": min, "end": max, "critical_rank": r}
+
+    Only each rank's latest world epoch is merged — the one key space
+    guaranteed identical on every live rank (rounds restart with the
+    membership on every rank, joiners included)."""
+    collectives: dict = {}
+    epoch_by_rank = {}
+    for doc in docs:
+        rank = doc["rank"]
+        spans, completes, e = _rank_spans(doc, epoch)
+        epoch_by_rank[rank] = e
+        for s in spans:
+            if s["round"] == 0:
+                continue  # identity never resolved (pre-negotiation tail)
+            c = collectives.setdefault(
+                (s["set"], s["round"]),
+                {"ranks": {}, "start": None, "end": None})
+            r = c["ranks"].setdefault(
+                rank, {"phases": {}, "events": {}, "start": None,
+                       "end": None, "bytes": 0})
+            dur = max(s["t1"] - s["t0"], 0)
+            r["phases"][s["phase"]] = r["phases"].get(s["phase"], 0) + dur
+            r["events"][s["phase"]] = r["events"].get(s["phase"], 0) + 1
+            if s["phase"] in ("wire-send", "wire-recv"):
+                r["bytes"] += max(s["bytes"], 0)
+            for k, t in (("start", s["t0"]), ("end", s["t1"])):
+                if r[k] is None or (t < r[k] if k == "start" else t > r[k]):
+                    r[k] = t
+        for comp in completes:
+            if comp["round"] == 0:
+                continue
+            c = collectives.setdefault(
+                (comp["set"], comp["round"]),
+                {"ranks": {}, "start": None, "end": None})
+            r = c["ranks"].setdefault(
+                rank, {"phases": {}, "events": {}, "start": None,
+                       "end": None, "bytes": 0})
+            r["events"]["complete"] = r["events"].get("complete", 0) + 1
+            if r["end"] is None or comp["t"] > r["end"]:
+                r["end"] = comp["t"]
+            if r["start"] is None:
+                r["start"] = comp["t"]
+    for c in collectives.values():
+        for r in c["ranks"].values():
+            for k in ("start", "end"):
+                if (c[k] is None or
+                        (r[k] is not None and
+                         (r[k] < c[k] if k == "start" else r[k] > c[k]))):
+                    c[k] = r[k]
+        ends = {rk: r["end"] for rk, r in c["ranks"].items()
+                if r["end"] is not None}
+        c["critical_rank"] = max(ends, key=ends.get) if ends else None
+    return {"collectives": collectives,
+            "ranks": sorted(d["rank"] for d in docs),
+            "epoch_by_rank": epoch_by_rank}
+
+
+def attribution(merged: dict) -> dict:
+    """Straggler attribution: how much of the job's critical path each
+    (rank, phase) owns.
+
+    Per collective and phase, a rank's blame is its EXCESS over the
+    fastest rank's duration of that phase: the fastest rank's time is the
+    floor everyone pays (the algorithm's cost), and whatever one rank
+    spends beyond it is time every other rank provably sat waiting on a
+    synchronous collective — critical-path time by construction.  Summed
+    over collectives and divided by the summed collective wall time, the
+    table answers *which rank and which phase made the steps slow*, and
+    it does so deterministically (a uniformly-slow phase blames nobody;
+    ranks whose completion order merely jitters blame nobody — only a
+    genuine per-rank skew produces a cell)."""
+    total = 0
+    cells: dict = {}
+    for c in merged["collectives"].values():
+        if c["start"] is None or c["end"] is None:
+            continue
+        wall = max(c["end"] - c["start"], 0)
+        if wall == 0:
+            continue
+        total += wall
+        for phase in SPAN_PHASES:
+            durs = {rk: r["phases"][phase]
+                    for rk, r in c["ranks"].items()
+                    if r["phases"].get(phase)}
+            if len(durs) < 2:
+                continue  # nothing to compare a skew against
+            floor = min(durs.values())
+            for rk, d in durs.items():
+                ex = d - floor
+                if ex > 0:
+                    cells[(rk, phase)] = cells.get((rk, phase), 0) + ex
+    per_rank: dict = {}
+    for (rk, _), ns in cells.items():
+        per_rank[rk] = per_rank.get(rk, 0) + ns
+    rows = [
+        {"rank": rk, "phase": ph, "ns": ns,
+         "fraction": round(ns / total, 4) if total else 0.0}
+        for (rk, ph), ns in sorted(cells.items(),
+                                   key=lambda kv: -kv[1])
+    ]
+    top = rows[0] if rows else None
+    return {"total_critical_ns": total, "rows": rows, "top": top,
+            "critical_ns_by_rank": per_rank}
+
+
+def attribution_table(merged: dict) -> str:
+    """Human-readable rank x phase table of critical-path fractions."""
+    att = attribution(merged)
+    ranks = merged["ranks"]
+    phases = list(SPAN_PHASES)
+    cells = {(r["rank"], r["phase"]): r["fraction"] for r in att["rows"]}
+    widths = [6] + [max(len(p), 6) for p in phases]
+    out = ["straggler attribution (fraction of step critical path):"]
+    out.append("  ".join(["rank".ljust(widths[0])] +
+                         [p.ljust(w) for p, w in zip(phases, widths[1:])]))
+    for rk in ranks:
+        row = [str(rk).ljust(widths[0])]
+        for p, w in zip(phases, widths[1:]):
+            v = cells.get((rk, p), 0.0)
+            row.append((f"{v:.1%}" if v else "-").ljust(w))
+        out.append("  ".join(row).rstrip())
+    if att["top"]:
+        t = att["top"]
+        out.append(f"straggler: rank {t['rank']} {t['phase']} "
+                   f"({t['fraction']:.1%} of critical path, "
+                   f"{t['ns'] / 1e6:.1f} ms)")
+    return "\n".join(out)
+
+
+def counted_series(merged: dict) -> dict:
+    """The scheduling-independent event counts CI gates on: per collective
+    and rank, how many events each counted phase produced.  Also folds the
+    whole run into ``events_per_collective`` (identical rounds collapse —
+    the steady state IS identical rounds)."""
+    per_collective = {}
+    for (set_, round_), c in sorted(merged["collectives"].items()):
+        row = {}
+        for rk, r in sorted(c["ranks"].items()):
+            row[rk] = {p: r["events"].get(p, 0) for p in COUNTED_PHASES}
+        per_collective[f"{set_}:{round_}"] = row
+    return {"per_collective": per_collective,
+            "collectives": len(per_collective)}
+
+
+# ---------------------------------------------------------------------------
+# merged Chrome trace
+# ---------------------------------------------------------------------------
+
+def chrome_trace(docs: list[dict], out_path: str,
+                 epoch: int | None = None) -> int:
+    """Write a merged, clock-aligned Chrome trace: one pid per rank, one
+    tid per recorder ring, phase spans as complete ("X") events with the
+    (set, round) identity in args.  Returns events written."""
+    events: list[dict] = []
+    t_base = None
+    per_rank = []
+    for doc in docs:
+        spans, completes, _ = _rank_spans(doc, epoch)
+        per_rank.append((doc, spans, completes))
+        for s in spans:
+            t_base = s["t0"] if t_base is None else min(t_base, s["t0"])
+    t_base = t_base or 0
+    for doc, spans, completes in per_rank:
+        pid = doc["rank"]
+        events.append({"name": "process_name", "ph": "M", "pid": pid,
+                       "tid": 0, "args": {"name": f"rank {pid}"}})
+        # spans lost their ring identity in _rank_spans; lay them out by
+        # phase lane instead — stable and readable in Perfetto
+        lane = {p: i for i, p in enumerate(SPAN_PHASES)}
+        for p, i in list(lane.items()) + [("complete", len(lane))]:
+            events.append({"name": "thread_name", "ph": "M", "pid": pid,
+                           "tid": i, "args": {"name": p}})
+        for s in spans:
+            events.append({
+                "name": s["phase"], "ph": "X", "pid": pid,
+                "tid": lane.get(s["phase"], len(lane)),
+                "ts": (s["t0"] - t_base) / 1e3,
+                "dur": max(s["t1"] - s["t0"], 0) / 1e3,
+                "args": {"set": s["set"], "round": s["round"],
+                         "slot": s["slot"], "peer": s["peer"],
+                         "stripe": s["stripe"], "bytes": s["bytes"]},
+            })
+        for comp in completes:
+            events.append({
+                "name": "complete", "ph": "i", "pid": pid,
+                "tid": len(lane), "ts": (comp["t"] - t_base) / 1e3,
+                "s": "t",
+                "args": {"set": comp["set"], "round": comp["round"]},
+            })
+    with open(out_path, "w") as f:
+        f.write("[\n")
+        f.write(",\n".join(json.dumps(e, separators=(",", ":"))
+                           for e in events))
+        f.write("\n]\n")
+    return len(events)

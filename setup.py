@@ -24,10 +24,10 @@ from setuptools.command.build_py import build_py as _build_py
 
 HERE = os.path.abspath(os.path.dirname(__file__))
 CSRC = os.path.join(HERE, "csrc")
-SOURCES = ["socket.cc", "wire.cc", "shm.cc", "timeline.cc", "autotune.cc",
-           "engine.cc"]
-HEADERS = ["common.h", "socket.h", "wire.h", "shm.h", "timeline.h",
-           "autotune.h", "logging.h"]
+SOURCES = ["socket.cc", "wire.cc", "cache.cc", "shm.cc", "timeline.cc",
+           "autotune.cc", "fault.cc", "trace.cc", "engine.cc"]
+HEADERS = ["common.h", "socket.h", "wire.h", "cache.h", "shm.h",
+           "timeline.h", "autotune.h", "fault.h", "trace.h", "logging.h"]
 
 
 def _compiler() -> str:

@@ -285,6 +285,82 @@ def test_pset_artifact_shows_concurrency_and_no_hol():
     assert p.get("speedup_concurrent_vs_global") is not None, p
 
 
+def test_trace_attribution_artifact():
+    """BENCH_r13's counted flight-recorder series: the injected per-phase
+    delay (slow:rank=V:phase=pack via the PR 5 injector) must be
+    attributed to EXACTLY that (rank, phase) with the majority of the
+    critical path, and the merged per-collective event counts must be the
+    exact function of the workload geometry — events/collective for an
+    m-rank segmented ring over T fp32 tensors of K Ki elements is
+    sends = (2m-2) * ceil(T*K*4096/(m*seg)), recvs the same,
+    accumulates half, completes = T.  A chaos row proves the black box:
+    hvdrun's post-mortem printed the SIGKILLed victim's last recorded
+    phase, read from its file-backed ring."""
+    r13 = _baseline("BENCH_r13.json")
+    cfg = r13["config"]
+    seg = 256 << 10  # engine default ring segment bytes
+    points = 0
+    for np_key, m in (("np2", 2), ("np4", 4)):
+        p = r13.get(np_key)
+        if not p:
+            continue
+        points += 1
+        victim = p["victim"]
+        top = p["attribution_top"]
+        # attribution target rank and phase: exact
+        assert p["attributed_to_victim_pack"] is True, (np_key, p)
+        assert top["rank"] == victim and top["phase"] == "pack", top
+        # majority of the critical path on the injected (rank, phase)
+        assert top["fraction"] > 0.5, (np_key, top)
+        # events per collective: exact
+        assert p["counted_uniform"] is True, (np_key, p)
+        assert p["allreduce_collectives"] == cfg["steps"], (np_key, p)
+        total_b = cfg["tensors"] * cfg["kelems"] * 1024 * 4
+        chunk_b = total_b // m
+        segs = (chunk_b + seg - 1) // seg
+        want = {"wire-send": (2 * m - 2) * segs,
+                "wire-recv": (2 * m - 2) * segs,
+                "accumulate": (m - 1) * segs,
+                "complete": cfg["tensors"]}
+        for rank_key, row in p["events_per_collective"].items():
+            assert row == want, (np_key, rank_key, row, want)
+        assert p["trace_dropped"] == 0, p
+        assert p["file_backed_ranks"] == m, p
+    assert points == 2, r13
+    chaos = r13["chaos_sigkill_pack"]
+    assert chaos["exit_code"] != 0, chaos
+    # the victim died INSIDE the injector's pack hook, which fires inside
+    # the recorded pack span — the black box must say so
+    assert chaos["victim_last_phase"] == "pack", chaos
+    assert "last_phase=pack" in (chaos["post_mortem_line"] or ""), chaos
+
+
+def test_trace_overhead_gate():
+    """Recorder-on vs HOROVOD_TPU_TRACE=0 at <=1% on the counted
+    ctrl-bytes-per-round series (BENCH_r13's overhead rows, both recorded
+    under the same r06 pinned-batching protocol): the flight recorder
+    adds NO wire bytes — correlation rides the deterministic
+    (set, epoch, round) identity, so the two measurements must agree to
+    the byte up to round-splitting jitter."""
+    r13 = _baseline("BENCH_r13.json")
+    ovh = r13["trace_overhead"]
+    on = ovh["recorder_on"]["ctrl_bytes_per_round_worker"]
+    off = ovh["recorder_off"]["ctrl_bytes_per_round_worker"]
+    assert on and off, ovh
+    assert abs(on / off - 1.0) <= 0.01, ovh
+
+
+def test_wire_abi_v8_untouched():
+    """The flight recorder must not have moved the wire: correlation is
+    wire-free by design, so tools/check_wire_abi.py still reports a clean
+    v8 sync (a version bump or frame-layout drift fails here)."""
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "check_wire_abi.py")],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "version 8" in out.stdout, out.stdout
+
+
 def test_ring_counted_series_gate():
     """Fresh segmented ring at the BENCH_r08 workload (-np 2, shm,
     256 KB segments) vs the artifact: segments/ring and KB/ring are

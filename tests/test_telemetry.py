@@ -35,7 +35,8 @@ from horovod_tpu.telemetry.timeline import PyTimeline  # noqa: E402
 
 _TELEMETRY_ENV = ("HOROVOD_TIMELINE", "HOROVOD_TPU_TIMELINE",
                   "HOROVOD_TPU_METRICS", "HOROVOD_TPU_METRICS_DIR",
-                  "HOROVOD_TPU_METRICS_INTERVAL")
+                  "HOROVOD_TPU_METRICS_INTERVAL",
+                  "HOROVOD_TPU_METRICS_PORT")
 
 
 @pytest.fixture()
@@ -565,6 +566,439 @@ def test_handle_wait_timeout_expires():
 
 
 # ---------------------------------------------------------------------------
+# flight recorder: binary reader, correlation, attribution, black box
+# ---------------------------------------------------------------------------
+
+from horovod_tpu.telemetry import trace as FT  # noqa: E402
+
+
+def _ev(t_ns, phase, *, end=False, arg=0, round_=0, set_=0, epoch=0,
+        slot=0, peer=-1, stripe=0, op=0):
+    """One packed event tuple in csrc/trace.h's 32-byte layout."""
+    pid = FT.PHASE_IDS[phase] | (FT.END_FLAG if end else 0)
+    return (t_ns, arg, round_, set_, epoch, slot, peer, pid,
+            (stripe & 0x0F) | ((op & 0x0F) << 4))
+
+
+def _write_trace(path, rank, rings, size=2, clock_offset=0,
+                 ring_events=64, tail_garbage=False):
+    """Synthesize a recorder file byte-identical to csrc/trace.cc's
+    layout (the reader is the contract both sides meet)."""
+    import struct
+
+    nrings_max = 16
+    blob = bytearray(struct.pack(
+        FT._HEADER_FMT, FT.MAGIC, 1, rank, size, 123,
+        ring_events, nrings_max, len(rings), 0, clock_offset, 0,
+        10, 1700000000 * 10**9, 0).ljust(FT._HEADER_BLOCK, b"\0"))
+    for i in range(nrings_max):
+        if i < len(rings):
+            name, events = rings[i]
+            blob += struct.pack(FT._RING_FMT, len(events), 1000 + i,
+                                name.encode())
+        else:
+            blob += struct.pack(FT._RING_FMT, 0, 0, b"")
+    for i in range(nrings_max):
+        ring = bytearray(ring_events * FT._EVENT_LEN)
+        if i < len(rings):
+            for k, ev in enumerate(rings[i][1]):
+                struct.pack_into(FT._EVENT_FMT, ring, k * FT._EVENT_LEN,
+                                 *ev)
+            if tail_garbage and i == 0:
+                # a torn in-flight record, as a SIGKILLed writer leaves:
+                # bump head past a half-written slot
+                struct.pack_into(
+                    FT._EVENT_FMT, ring, len(rings[i][1]) * FT._EVENT_LEN,
+                    -1, 0, 0, 0, 0, 0, 0, 99, 0)
+                blob[FT._HEADER_BLOCK + i * FT._RING_LEN:
+                     FT._HEADER_BLOCK + i * FT._RING_LEN + 8] = \
+                    struct.pack("<Q", len(rings[i][1]) + 1)
+        blob += ring
+    with open(path, "wb") as f:
+        f.write(bytes(blob))
+    return path
+
+
+def _synthetic_trace_pair(tmp_path, slow_rank=1, slow_phase="pack",
+                          slow_ns=10_000_000, rounds=4):
+    """Two ranks, `rounds` fused collectives each: identical wire spans,
+    one rank's `slow_phase` stretched by slow_ns — the straggler the
+    attribution must name.  Rank 1's raw clock lags 1 ms; its header
+    carries the compensating offset (the bootstrap probe's job)."""
+    # collectives are synchronous: both ranks' round k opens at the same
+    # aligned instant (the fast rank just waits), paced by the slow rank
+    round_len = 1_000_000 + slow_ns
+    paths = []
+    for rank in (0, 1):
+        skew = -1_000_000 if rank == 1 else 0  # raw clock behind by 1 ms
+        off = 1_000_000 if rank == 1 else 0    # probe-measured offset
+        events = []
+        for rnd in range(1, rounds + 1):
+            t = 1_000_000 + (rnd - 1) * round_len + skew
+            base = dict(round_=rnd, set_=0, epoch=0)
+            events.append(_ev(t, "negotiate", arg=2, **base))
+            events.append(_ev(t + 1000, "negotiate", end=True, arg=2,
+                              **base))
+            p = 200_000 + (slow_ns if rank == slow_rank
+                           and slow_phase == "pack" else 0)
+            events.append(_ev(t + 2000, "pack", **base))
+            events.append(_ev(t + 2000 + p, "pack", end=True, arg=4096,
+                              **base))
+            w0 = t + 2000 + p
+            for seg in range(2):
+                events.append(_ev(w0 + seg * 100_000, "wire-send",
+                                  slot=seg, peer=1 - rank, **base))
+                events.append(_ev(w0 + seg * 100_000 + 90_000, "wire-send",
+                                  end=True, arg=2048, slot=seg,
+                                  peer=1 - rank, **base))
+            events.append(_ev(w0 + 250_000, "accumulate", slot=0,
+                              peer=1 - rank, **base))
+            events.append(_ev(w0 + 260_000, "accumulate", end=True,
+                              arg=512, slot=0, peer=1 - rank, **base))
+            events.append(_ev(w0 + 300_000, "unpack", **base))
+            events.append(_ev(w0 + 310_000, "unpack", end=True, arg=4096,
+                              **base))
+            for k in range(2):  # two tensors fused -> two completions
+                events.append(_ev(w0 + 320_000 + k, "complete", **base))
+        paths.append(_write_trace(
+            str(tmp_path / f"trace.rank{rank}.bin"), rank,
+            [("bg", events)], clock_offset=off))
+    return paths
+
+
+def test_trace_reader_roundtrip_and_torn_event(tmp_path):
+    events = [_ev(10, "init", arg=2),
+              _ev(20, "pack", round_=1),
+              _ev(30, "pack", end=True, round_=1)]
+    path = _write_trace(str(tmp_path / "trace.rank0.bin"), 0,
+                        [("bg", events), ("wire", [_ev(40, "complete")])],
+                        clock_offset=7, tail_garbage=True)
+    doc = FT.read_trace(path)
+    assert doc["rank"] == 0 and doc["clock_offset_ns"] == 7
+    assert [r["name"] for r in doc["rings"]] == ["bg", "wire"]
+    # the torn tail record (phase 99, negative timestamp) was dropped
+    assert len(doc["rings"][0]["events"]) == 3
+    got = doc["rings"][0]["events"][1]
+    assert (got.phase, got.round, got.end) == ("pack", 1, False)
+    with pytest.raises(ValueError):
+        FT.read_trace(__file__)  # not a recorder dump
+
+
+def test_trace_last_phase_open_span_and_markers(tmp_path):
+    # an open pack begin (no end): the phase the rank died IN
+    path = _write_trace(str(tmp_path / "trace.rank0.bin"), 0, [("bg", [
+        _ev(10, "negotiate", round_=1),
+        _ev(20, "negotiate", end=True, round_=1),
+        _ev(30, "pack", round_=1),
+    ])])
+    phase, detail = FT.last_phase(path)
+    assert phase == "pack" and detail["round"] == 1
+    # a terminal marker wins over open spans
+    path = _write_trace(str(tmp_path / "trace.rank1.bin"), 1, [("bg", [
+        _ev(30, "pack", round_=1),
+        _ev(50, "abort", arg=1),
+    ])])
+    assert FT.last_phase(path)[0] == "abort"
+
+
+def test_trace_merge_attribution_blames_injected_skew(tmp_path):
+    """The tentpole contract in miniature: rank 1's pack runs 10 ms long
+    per collective; the merged, clock-aligned attribution must hand the
+    majority of the critical path to exactly (rank 1, pack)."""
+    _synthetic_trace_pair(tmp_path)
+    docs = FT.load_dir(str(tmp_path))
+    assert [d["rank"] for d in docs] == [0, 1]
+    merged = FT.merge(docs)
+    assert len(merged["collectives"]) == 4
+    # counted series: exact and identical on both ranks for every round
+    counted = FT.counted_series(merged)
+    for row in counted["per_collective"].values():
+        assert row[0] == row[1] == {"wire-send": 2, "wire-recv": 0,
+                                    "accumulate": 1, "complete": 2}
+    att = FT.attribution(merged)
+    assert att["top"]["rank"] == 1 and att["top"]["phase"] == "pack"
+    assert att["top"]["fraction"] > 0.5, att
+    table = FT.attribution_table(merged)
+    assert "straggler: rank 1 pack" in table
+
+
+def test_trace_clock_offset_aligns_ranks(tmp_path):
+    """Rank 1's raw clock lags by 1 ms but its header carries the probe's
+    offset: aligned span starts must agree across ranks to well under the
+    skew (the whole point of piggybacking the probe on bootstrap)."""
+    _synthetic_trace_pair(tmp_path, slow_ns=0)
+    docs = FT.load_dir(str(tmp_path))
+    merged = FT.merge(docs)
+    for c in merged["collectives"].values():
+        starts = [r["start"] for r in c["ranks"].values()]
+        assert abs(starts[0] - starts[1]) < 100_000  # < 0.1 ms after align
+
+
+def test_trace_chrome_merge_valid_and_cli(tmp_path):
+    _synthetic_trace_pair(tmp_path)
+    docs = FT.load_dir(str(tmp_path))
+    out = tmp_path / "merged.json"
+    n = FT.chrome_trace(docs, str(out))
+    events = json.loads(out.read_text())
+    assert n == len(events) and {e["pid"] for e in events} == {0, 1}
+    assert any(e.get("name") == "pack" and e.get("ph") == "X"
+               for e in events)
+    # the CLI front door: table mode + JSON mode
+    res = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.telemetry", "trace",
+         str(tmp_path), "--json"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert res.returncode == 0, res.stderr
+    doc = json.loads(res.stdout)
+    assert doc["attribution"]["top"]["rank"] == 1
+    assert doc["counted"]["collectives"] == 4
+    res = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.telemetry", "trace",
+         str(tmp_path)],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert res.returncode == 0, res.stderr
+    assert "straggler attribution" in res.stdout
+
+
+def test_trace_post_mortem_reads_black_box(tmp_path):
+    """fault.post_mortem_line picks the victim's last recorded phase out
+    of the (possibly torn) black-box file — the SIGKILL story without a
+    SIGKILL."""
+    from horovod_tpu.runtime import fault as fault_mod
+
+    _write_trace(str(tmp_path / "trace.rank1.bin"), 1, [("bg", [
+        _ev(10, "negotiate", round_=3),
+        _ev(20, "negotiate", end=True, round_=3),
+        _ev(30, "wire-send", round_=3, slot=2, peer=0),
+    ])], tail_garbage=True)
+    line = fault_mod.post_mortem_line(1, -9, trace_dir=str(tmp_path))
+    assert "killed by SIGKILL" in line and "last_phase=wire-send" in line
+    # no trace dir / missing file: n/a, never a crash
+    assert "last_phase=n/a" in fault_mod.post_mortem_line(0, -9)
+    assert "last_phase=n/a" in fault_mod.post_mortem_line(
+        0, -9, trace_dir=str(tmp_path))
+
+
+# ---------------------------------------------------------------------------
+# live /metrics endpoint + hvdrun aggregation
+# ---------------------------------------------------------------------------
+
+def test_metrics_http_endpoint_serves_registry():
+    import urllib.error
+    import urllib.request
+
+    from horovod_tpu.telemetry.httpd import MetricsServer
+
+    reg = MetricsRegistry()
+    reg.counter("hvd_test_total", op="x").inc(3)
+    srv = MetricsServer(0, registry=reg, rank=2)  # port 0: ephemeral
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/metrics", timeout=5) as r:
+            text = r.read().decode()
+            assert r.headers["Content-Type"].startswith("text/plain")
+        assert 'hvd_test_total{op="x"} 3' in text
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/metrics.json", timeout=5) as r:
+            doc = json.loads(r.read().decode())
+        assert doc["rank"] == 2
+        assert any(m["name"] == "hvd_test_total" for m in doc["metrics"])
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/nope", timeout=5)
+    finally:
+        srv.stop()
+
+
+def test_metrics_http_scrape_runs_collectors():
+    """A scrape must observe freshly-collected values: collectors run per
+    export, so the native diagnostics are polled when Prometheus asks."""
+    import urllib.request
+
+    from horovod_tpu.telemetry.httpd import MetricsServer
+
+    reg = MetricsRegistry()
+    calls = []
+    reg.register_collector(
+        lambda: (calls.append(1), reg.gauge("polled").set(len(calls))))
+    srv = MetricsServer(0, registry=reg)
+    try:
+        for want in (1, 2):
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/metrics", timeout=5) as r:
+                assert f"polled {want}" in r.read().decode()
+    finally:
+        srv.stop()
+
+
+def test_prometheus_relabel_and_aggregate():
+    from horovod_tpu.telemetry import httpd
+    from horovod_tpu.telemetry.httpd import MetricsServer
+
+    page = ('# TYPE a_total counter\na_total{op="x"} 2\n'
+            '# HELP junk\nb_gauge 7\n')
+    rl = httpd.relabel(page, 3)
+    assert 'a_total{rank="3",op="x"} 2' in rl
+    assert 'b_gauge{rank="3"} 7' in rl
+    assert "# HELP" not in rl
+
+    reg = MetricsRegistry()
+    reg.counter("hvd_agg_total").inc(5)
+    srv = MetricsServer(0, registry=reg, rank=0)
+    try:
+        # rank 1's port is dead: the aggregate must still answer, with
+        # hvdrun_rank_up flagging who responded
+        text = httpd.scrape_and_aggregate({0: srv.port, 1: 1},
+                                          timeout_s=0.5)
+    finally:
+        srv.stop()
+    assert 'hvdrun_rank_up{rank="0"} 1' in text
+    assert 'hvdrun_rank_up{rank="1"} 0' in text
+    assert 'hvd_agg_total{rank="0"} 5' in text
+
+
+def test_metrics_port_env_starts_endpoint(clean_telemetry, monkeypatch):
+    """HOROVOD_TPU_METRICS_PORT alone enables metrics and stands up the
+    per-rank scrape endpoint; shutdown tears it down."""
+    import urllib.request
+
+    import horovod_tpu as hvd
+
+    monkeypatch.setenv("HOROVOD_TPU_METRICS_PORT", "0")
+    hvd.init()
+    assert T.metrics_enabled()
+    port = T.metrics_port()
+    assert port
+    hvd.allreduce(np.ones(4, np.float32), name="g")
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5) as r:
+        assert T.EAGER_OPS_TOTAL in r.read().decode()
+    hvd.shutdown()
+    assert T.metrics_port() is None
+
+
+# ---------------------------------------------------------------------------
+# atomic metric dumps (post-mortems must never read a torn file)
+# ---------------------------------------------------------------------------
+
+def test_registry_dump_atomic_and_litter_free(tmp_path, monkeypatch):
+    reg = MetricsRegistry()
+    reg.counter("c_total").inc(1)
+    path = reg.dump(str(tmp_path), 3)
+    assert json.load(open(path))["rank"] == 3
+    # no tmp litter for the merge CLI's glob / post-mortem scan to trip on
+    assert [p.name for p in tmp_path.iterdir()] == ["metrics.rank3.json"]
+    # a dump that dies before publish leaves the PREVIOUS dump intact
+    real_replace = os.replace
+
+    def boom(src, dst):
+        raise OSError("disk full")
+    monkeypatch.setattr(os, "replace", boom)
+    with pytest.raises(OSError):
+        reg.counter("c_total").inc(1)
+        reg.dump(str(tmp_path), 3)
+    monkeypatch.setattr(os, "replace", real_replace)
+    doc = json.load(open(path))  # old document, whole and parseable
+    assert doc["metrics"][0]["value"] == 1
+    assert [p.name for p in tmp_path.iterdir()] == ["metrics.rank3.json"]
+
+
+# ---------------------------------------------------------------------------
+# per-set metric labels across an elastic shrink (collector mirror)
+# ---------------------------------------------------------------------------
+
+def _fake_native_diag(psets, epoch, size):
+    d = {k: 0 for k in (
+        "hierarchical", "autotune_converged", "stall_events", "cache_hits",
+        "cache_misses", "cache_evictions", "cache_entries",
+        "negotiation_bytes_tx", "negotiation_bytes_rx", "pipeline_depth",
+        "pipeline_queue_depth", "pipeline_items", "pipeline_packs",
+        "pipeline_pack_ns", "pipeline_wire_ns", "pipeline_unpack_ns",
+        "pipeline_overlap_ns", "pipeline_overlap_fraction",
+        "ring_segment_bytes", "ring_collectives_segmented",
+        "ring_collectives_monolithic", "ring_segments", "ring_bytes",
+        "ring_wire_ns", "ring_wire_idle_ns", "ring_wire_idle_fraction",
+        "wire_stripes_cross", "wire_stripes_local",
+        "wire_stripe_quantum_bytes", "sg_threshold_bytes",
+        "sg_bytes_skipped", "pack_bytes", "alltoall_windowed",
+        "peer_timeouts", "aborts", "abort_latency_ns", "heartbeats_tx",
+        "heartbeats_rx", "shm_poisons", "world_changes", "rank_joins",
+        "shrink_latency_ns", "elastic")}
+    d.update({
+        "wire_stripes": 1, "wire_stripe_bytes": [0] * 8,
+        "heartbeat_age_s": 0.0, "peer_timeout_s": 60.0,
+        "world_epoch": epoch, "world_size": size, "world_rank": 0,
+        "process_sets": psets, "process_set_count": len(psets),
+    })
+    return d
+
+
+def test_pset_metric_labels_across_elastic_shrink(clean_telemetry):
+    """Satellite: per-set labelled series across an elastic shrink — an
+    evicted set's ``hvd_pset_*`` counters STOP cleanly (no decrements, no
+    phantom increments), surviving sets keep counting under renumbered
+    set ranks.  Driven at the collector-mirror level with a scripted
+    engine so the tier-1 suite needs no multi-process elastic run (the
+    live shrink machinery is tests/test_fault.py's job)."""
+    from horovod_tpu.runtime.native import NativeEngine
+
+    T.set_metrics_enabled(True)
+    state = {}
+
+    class Scripted(NativeEngine):
+        def __init__(self):  # no native init — scripted diagnostics
+            self._topology = None
+
+        def diagnostics(self):
+            return _fake_native_diag(**state)
+
+        def world_stats(self):
+            return {"world_epoch": state["epoch"],
+                    "world_size": state["size"], "world_rank": 0,
+                    "world_changes": 0, "rank_joins": 0,
+                    "shrink_latency_ns": 0, "elastic": 1}
+
+        def _fault_stats(self):
+            return {"heartbeat_age_s": 0.0, "peer_timeout_s": 60.0,
+                    "peer_timeouts": 0, "aborts": 0, "abort_latency_ns": 0,
+                    "heartbeats_tx": 0, "heartbeats_rx": 0}
+
+    def pset(sid, size, rank, coll, nbytes, hits=0):
+        return {"id": sid, "size": size, "rank": rank, "collectives": coll,
+                "payload_bytes": nbytes, "wire_ns": 0, "cache_hits": hits,
+                "cache_misses": 0}
+
+    eng = Scripted()
+    # epoch 0: world of 4, sets 1 (this rank is set-rank 1) and 2
+    state.update(epoch=0, size=4, psets=[
+        pset(0, 4, 0, 10, 1000), pset(1, 2, 1, 5, 500),
+        pset(2, 2, -1, 3, 300)])
+    eng._register_diagnostics_collector()
+    reg = T.registry()
+    reg.snapshot()  # collect #1
+    c1 = reg.counter(T.NATIVE_PSET_COLLECTIVES, set="1").value
+    c2 = reg.counter(T.NATIVE_PSET_COLLECTIVES, set="2").value
+    assert (c1, c2) == (5, 3)
+
+    # elastic shrink: set 2's members died (row GONE), set 1 survives with
+    # this rank renumbered to set-rank 0 and keeps counting
+    state.update(epoch=1, size=3, psets=[
+        pset(0, 3, 0, 14, 1400), pset(1, 2, 0, 9, 900, hits=2)])
+    reg.snapshot()  # collect #2
+    assert reg.counter(T.NATIVE_PSET_COLLECTIVES, set="1").value == 9
+    assert reg.counter(T.NATIVE_PSET_BYTES, set="1").value == 900
+    assert reg.counter(T.NATIVE_PSET_CACHE_HITS, set="1").value == 2
+    # the evicted set's series stopped cleanly: same value, no new samples
+    assert reg.counter(T.NATIVE_PSET_COLLECTIVES, set="2").value == 3
+    assert reg.counter(T.NATIVE_PSET_BYTES, set="2").value == 300
+    # another quiet collect: still frozen (no phantom deltas)
+    reg.snapshot()
+    assert reg.counter(T.NATIVE_PSET_COLLECTIVES, set="2").value == 3
+    # and the world-size gauge tracked the shrink
+    assert reg.gauge(T.NATIVE_WORLD_SIZE).value == 3
+
+
+# ---------------------------------------------------------------------------
 # launcher flag threading
 # ---------------------------------------------------------------------------
 
@@ -593,24 +1027,38 @@ def test_run_np1_timeline_end_to_end(tmp_path):
 
 
 def test_run_py_threads_telemetry_env(tmp_path):
-    """`hvdrun --timeline --metrics-dir` must wire the env into workers."""
+    """`hvdrun --timeline --metrics-dir --trace-dir --metrics-port` must
+    wire the env into workers (the port offset by 1 + rank; the launcher
+    itself owns the base port for the aggregate view)."""
     script = tmp_path / "w.py"
     script.write_text(
         "import os\n"
         "print('TL=' + os.environ.get('HOROVOD_TIMELINE', ''))\n"
-        "print('MD=' + os.environ.get('HOROVOD_TPU_METRICS_DIR', ''))\n")
+        "print('MD=' + os.environ.get('HOROVOD_TPU_METRICS_DIR', ''))\n"
+        "print('TD=' + os.environ.get('HOROVOD_TPU_TRACE_DIR', ''))\n"
+        "print('MP=' + os.environ.get('HOROVOD_TPU_METRICS_PORT', ''))\n")
     mdir = tmp_path / "metrics"
+    tdir = tmp_path / "traces"
+    from horovod_tpu.utils import net
+
+    base_port = net.free_port()
     env = dict(os.environ)
-    env.pop("HOROVOD_TIMELINE", None)
-    env.pop("HOROVOD_TPU_METRICS_DIR", None)
+    for var in ("HOROVOD_TIMELINE", "HOROVOD_TPU_METRICS_DIR",
+                "HOROVOD_TPU_TRACE_DIR", "HOROVOD_TPU_METRICS_PORT"):
+        env.pop(var, None)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     res = subprocess.run(
         [sys.executable, "-m", "horovod_tpu.run", "-np", "1",
          "--timeline", str(tmp_path / "t.json"),
          "--metrics-dir", str(mdir),
+         "--trace-dir", str(tdir),
+         "--metrics-port", str(base_port),
          sys.executable, str(script)],
         cwd=REPO, env=env, capture_output=True, text=True, timeout=180)
     assert res.returncode == 0, res.stderr + res.stdout
     assert f"TL={tmp_path / 't.json'}" in res.stdout
     assert f"MD={mdir}" in res.stdout
-    assert mdir.is_dir()  # launcher pre-creates the dump directory
+    assert f"TD={tdir}" in res.stdout
+    assert f"MP={base_port + 1}" in res.stdout  # rank 0 -> base + 1
+    assert mdir.is_dir()  # launcher pre-creates the dump directories
+    assert tdir.is_dir()
