@@ -2199,6 +2199,201 @@ def bench_trace(args):
     return results
 
 
+def health_worker(args):
+    """Subprocess under the launcher: a fixed SINGLE-tensor allreduce
+    stream — one collective per negotiation round, so the injector's
+    accumulate hook (one count per allreduce) makes ``flip ... hit=K``
+    corrupt exactly round K — plus a JSON report of the health/audit
+    counters and steps/sec.  ``HVD_BENCH_SIM_HOSTS=1`` gives each rank
+    its own host hash so cross-host pacing applies (the deterministic
+    clock the overhead ratio is measured against)."""
+    import numpy as np
+
+    import horovod_tpu as hvd
+    from horovod_tpu.runtime import state as _state
+
+    if os.environ.get("HVD_BENCH_SIM_HOSTS") == "1":
+        os.environ["HOROVOD_TPU_HOST_HASH"] = (
+            "simhost" + os.environ.get("HOROVOD_TPU_RANK", "0"))
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    elems = max(args.health_mb * (1 << 20) // 4, 1024)
+    data = np.full(elems, float(r + 1), np.float32)
+    for _ in range(2):
+        hvd.allreduce(data, average=False, name="warm")
+    t0 = time.perf_counter()
+    for _ in range(args.health_steps):
+        hvd.allreduce(data, average=False, name="grad/h")
+    dt = time.perf_counter() - t0
+    # flush rounds: every pending audit digest rides a frame, and every
+    # comparison through the measured steps resolves before we report
+    for i in range(2):
+        hvd.allreduce(np.ones(8, np.float32), average=False, name=f"hf{i}")
+    d = _state.engine().health_stats()
+    mine = [d["audits_sent"], d["audit_checks"], d["audit_mismatches"],
+            d["audit_last_bad_rank"], d["audit_last_bad_round"],
+            d["health_collectives"], d["nan_total"]]
+    per_rank = hvd.allgather(np.array([mine], np.int64), name="hstats")
+    if r == 0:
+        rows = per_rank.tolist()
+        print(json.dumps({
+            "np": n, "steps": args.health_steps, "mb": args.health_mb,
+            "steps_per_sec": round(args.health_steps / dt, 3),
+            "wall_s": round(dt, 4),
+            "health_enabled": int(d["health_enabled"]),
+            "audit_sample": int(d["audit_sample"]),
+            "audits_sent_per_rank": [int(row[0]) for row in rows],
+            "audit_checks": int(rows[0][1]),
+            "audit_mismatches": int(rows[0][2]),
+            "bad_rank": int(rows[0][3]),
+            "bad_round": int(rows[0][4]),
+            "health_collectives": int(rows[0][5]),
+            "nan_total": int(sum(row[6] for row in rows)),
+        }), flush=True)
+    hvd.shutdown()
+
+
+def bench_health(args):
+    """Numerical-health bench (BENCH_r14): silent-data-corruption
+    attribution must be COUNTED-exact, sampling semantics must be a pure
+    function of (round, N), and the in-band stats must cost <=1% end to
+    end.
+
+    * flip rows: ``flip:rank=V:phase=accumulate:hit=K`` with audit
+      sampling on.  One tensor per round makes the corrupted round
+      exactly K; the coordinator must report mismatches == 1,
+      bad_round == K, and (with a 3v1 majority at np4) bad_rank == V —
+      deterministic, no timing anywhere (tests/test_bench_gate.py gates
+      the whole row).
+    * sample-window series: the same flip at round 6 under
+      HOROVOD_TPU_AUDIT_SAMPLE in {1, 2, 4}: detected exactly when
+      6 % N == 0 — the counted basis of the sample-rate bisect recipe.
+    * overhead rows: (a) the r06 negotiation workload with health on
+      (default) vs HOROVOD_TPU_HEALTH=0 — the audit is off, so the
+      counted ctrl bytes/round must be IDENTICAL (ratio 1.0000: health
+      adds zero wire bytes by construction); (b) a paced cross-host
+      allreduce stream (pacing IS the clock, so the wall ratio is
+      meaningful even on this 2-core box) health on vs off, gated <=1%.
+    """
+    results = {"config": {
+        "steps": args.health_steps, "mb": args.health_mb,
+        "flip_hit": 5, "pace_mbps": 200, "nproc": os.cpu_count(),
+        "note": "flip attribution and the sample-window series are "
+                "counted (checksum majorities over deterministic "
+                "rounds); the paced wall ratio rides the pacing clock",
+    }}
+    for n in (2, 4):
+        if n > args.health_max_np:
+            continue
+        victim = min(2, n - 1)
+        env = dict(os.environ)
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "HOROVOD_TPU_AUDIT_SAMPLE": "1",
+            "HOROVOD_TPU_FAULT_INJECT":
+                f"flip:rank={victim}:phase=accumulate:hit=5:bit=4242",
+        })
+        cmd = [sys.executable, "-m", "horovod_tpu.run", "-np", str(n),
+               sys.executable, os.path.abspath(__file__),
+               "--health-worker", "--health-steps",
+               str(args.health_steps), "--health-mb", "1"]
+        point = _run_json_subprocess(cmd, env, timeout=600)
+        point["victim"] = victim
+        point["flip_hit"] = 5
+        point["detected"] = point.get("audit_mismatches") == 1
+        point["detection_round_exact"] = point.get("bad_round") == 5
+        # np2 has no majority (1v1 ties break by digest): detection is
+        # exact there, attribution needs n > 2
+        point["attributed_exact"] = (
+            point["detected"] and point["detection_round_exact"] and
+            (n <= 2 or point.get("bad_rank") == victim))
+        results[f"np{n}"] = point
+
+    # counted sample-window series: flip at round 6, N in {1, 2, 4}
+    window = {}
+    for sample in (1, 2, 4):
+        env = dict(os.environ)
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "HOROVOD_TPU_AUDIT_SAMPLE": str(sample),
+            "HOROVOD_TPU_FAULT_INJECT":
+                "flip:rank=1:phase=accumulate:hit=6",
+        })
+        cmd = [sys.executable, "-m", "horovod_tpu.run", "-np", "2",
+               sys.executable, os.path.abspath(__file__),
+               "--health-worker", "--health-steps", "10",
+               "--health-mb", "1"]
+        point = _run_json_subprocess(cmd, env, timeout=600)
+        window[f"sample{sample}"] = {
+            "expected_detected": 6 % sample == 0,
+            "detected": point.get("audit_mismatches", 0) >= 1,
+            "bad_round": point.get("bad_round"),
+        }
+    results["sample_window"] = window
+
+    overhead = {}
+    # (a) counted ctrl bytes/round, health on (default) vs killed: the
+    # audit is off, so the wire is plain v8 either way — byte-identical
+    for label, health_env in (("health_on", None), ("health_off", "0")):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["HOROVOD_TPU_CYCLE_TIME"] = "50"
+        env["HOROVOD_TPU_BURST_WINDOW_US"] = "20000"
+        env.pop("HOROVOD_TPU_CACHE_CAPACITY", None)
+        env.pop("HOROVOD_TPU_AUDIT_SAMPLE", None)
+        if health_env is None:
+            env.pop("HOROVOD_TPU_HEALTH", None)
+        else:
+            env["HOROVOD_TPU_HEALTH"] = health_env
+        cmd = [sys.executable, "-m", "horovod_tpu.run", "-np", "4",
+               sys.executable, os.path.abspath(__file__),
+               "--negotiation-worker", "--neg-steps", "60",
+               "--neg-tensors", "32", "--neg-elems", "16"]
+        hb = _run_json_subprocess(cmd, env, timeout=600)
+        overhead[label] = {
+            "ctrl_bytes_per_round_worker":
+                hb.get("ctrl_bytes_per_round_worker"),
+            "rounds_per_sec": hb.get("rounds_per_sec"),
+        }
+    on = overhead.get("health_on", {}).get("ctrl_bytes_per_round_worker")
+    off = overhead.get("health_off", {}).get("ctrl_bytes_per_round_worker")
+    if on and off:
+        overhead["ctrl_on_vs_off"] = round(on / off, 4)
+
+    # (b) end-to-end wall on a PACED fabric (every byte rides a
+    # 200 Mbps-paced TCP link, so pacing — not scheduling noise — sets
+    # the step time; median of 3 legs each way)
+    def paced_leg(health_off: bool) -> float:
+        env = dict(os.environ)
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "HVD_BENCH_SIM_HOSTS": "1",
+            "HOROVOD_TPU_CROSS_HOST_PACE_MBPS": "200",
+            "HOROVOD_TPU_HIERARCHICAL_ALLREDUCE": "0",
+        })
+        env.pop("HOROVOD_TPU_AUDIT_SAMPLE", None)
+        if health_off:
+            env["HOROVOD_TPU_HEALTH"] = "0"
+        else:
+            env.pop("HOROVOD_TPU_HEALTH", None)
+        cmd = [sys.executable, "-m", "horovod_tpu.run", "-np", "2",
+               sys.executable, os.path.abspath(__file__),
+               "--health-worker", "--health-steps",
+               str(args.health_steps), "--health-mb",
+               str(args.health_mb)]
+        p = _run_json_subprocess(cmd, env, timeout=900)
+        return p.get("wall_s") or 0.0
+    walls_on = sorted(paced_leg(False) for _ in range(3))
+    walls_off = sorted(paced_leg(True) for _ in range(3))
+    overhead["paced_wall_on_s"] = walls_on[1]
+    overhead["paced_wall_off_s"] = walls_off[1]
+    if walls_on[1] and walls_off[1]:
+        overhead["paced_wall_on_vs_off"] = round(
+            walls_on[1] / walls_off[1], 4)
+    results["health_overhead"] = overhead
+    return results
+
+
 def pset_worker(args):
     """Subprocess under the launcher: the process-set concurrency probe
     (BENCH_r12).  Three modes, selected by HVD_PSET_MODE:
@@ -3261,6 +3456,21 @@ def build_parser() -> argparse.ArgumentParser:
                     help="elements per tensor in Ki (256 = 1 MB fp32)")
     ap.add_argument("--trace-slow-ms", type=int, default=80)
     ap.add_argument("--trace-max-np", type=int, default=4)
+    ap.add_argument("--health", action="store_true",
+                    help="numerical-health bench (BENCH_r14.json): inject "
+                         "a deterministic flip:phase=accumulate bit-flip "
+                         "and prove the sampled cross-rank checksum audit "
+                         "detects and attributes it (counted), sweep the "
+                         "sample window, and measure the in-band stats "
+                         "overhead on counted ctrl bytes and a paced "
+                         "wall clock")
+    ap.add_argument("--health-worker", action="store_true",
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--health-steps", type=int, default=12)
+    ap.add_argument("--health-mb", type=int, default=8,
+                    help="per-step allreduce payload for the paced "
+                         "overhead rows")
+    ap.add_argument("--health-max-np", type=int, default=4)
     ap.add_argument("--scal-iters", type=int, default=50)
     ap.add_argument("--mlp-hidden", type=int, default=512)
     ap.add_argument("--cpu", action="store_true",
@@ -3321,8 +3531,31 @@ def main() -> None:
     if args.trace_worker:
         trace_worker(args)
         return
+    if args.health_worker:
+        health_worker(args)
+        return
     if args.pset_worker:
         pset_worker(args)
+        return
+    if args.health:
+        # numerical-health only: a few launcher runs — minutes, own
+        # artifact
+        out = bench_health(args)
+        with open(os.path.join(REPO, "BENCH_r14.json"), "w") as f:
+            json.dump(out, f, indent=1)
+        compact = {}
+        for k, v in out.items():
+            if k.startswith("np"):
+                compact[k] = {
+                    "detected": v.get("detected"),
+                    "attributed_exact": v.get("attributed_exact"),
+                    "bad_rank": v.get("bad_rank"),
+                    "bad_round": v.get("bad_round")}
+        compact["ctrl_on_vs_off"] = out.get(
+            "health_overhead", {}).get("ctrl_on_vs_off")
+        compact["paced_wall_on_vs_off"] = out.get(
+            "health_overhead", {}).get("paced_wall_on_vs_off")
+        print(json.dumps({"health": compact, "full": "BENCH_r14.json"}))
         return
     if args.trace:
         # flight-recorder only: a few launcher runs — minutes, own artifact

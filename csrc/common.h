@@ -9,6 +9,7 @@
 #include <strings.h>  // strcasecmp — not guaranteed via <cstring>
 
 #include <cstdint>
+#include <cstdio>
 #include <cstdlib>
 #include <cstring>
 #include <string>
@@ -150,6 +151,31 @@ inline uint16_t FloatToBF16(float x) {
   // round-to-nearest-even
   uint32_t rounded = f + 0x7fffu + ((f >> 16) & 1u);
   return static_cast<uint16_t>(rounded >> 16);
+}
+
+// Minimal JSON string escaping for hand-built JSON documents (timeline
+// event/lane names, the health describe document) — one definition so
+// the escapers can never drift.
+inline std::string JsonEscape(const std::string& s) {
+  std::string out;
+  out.reserve(s.size() + 2);
+  for (char c : s) {
+    switch (c) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\n': out += "\\n"; break;
+      case '\t': out += "\\t"; break;
+      default:
+        if (static_cast<unsigned char>(c) < 0x20) {
+          char buf[8];
+          snprintf(buf, sizeof(buf), "\\u%04x", c);
+          out += buf;
+        } else {
+          out += c;
+        }
+    }
+  }
+  return out;
 }
 
 // Env-var knob parsing shared by the engine and the autotuner.

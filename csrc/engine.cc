@@ -46,6 +46,7 @@
 #include "cache.h"
 #include "common.h"
 #include "fault.h"
+#include "health.h"
 #include "logging.h"
 #include "shm.h"
 #include "socket.h"
@@ -368,6 +369,114 @@ void Accumulate(void* dst, const void* src, int64_t n, DType d) {
       break;
     }
   }
+  // in-band numerical health: fold the freshly-reduced range into the
+  // executing thread's accumulator (read-only pass; armed only between
+  // HealthItemBegin/End, so test hooks and disabled mode pay one branch)
+  HealthAccumObserve(dst, n, d);
+}
+
+// Scalar reproduction of the F16C convert-add-convert lane, bit-exact
+// with _mm256_cvtps_ph(_MM_FROUND_TO_NEAREST_INT): round-to-nearest-EVEN
+// with correct subnormal generation and hardware NaN quieting (top 10
+// payload bits kept, quiet bit forced) — unlike FloatToHalf, which rounds
+// half-UP and collapses NaN payloads.  The phased scatter-gather
+// accumulate below uses it to run "SIMD semantics" on the partial groups
+// a region boundary cuts off.
+inline uint16_t FloatToHalfRNE(float x) {
+  uint32_t f;
+  std::memcpy(&f, &x, 4);
+  uint32_t sign = (f >> 16) & 0x8000u;
+  uint32_t em = f & 0x7fffffffu;
+  if (em >= 0x7f800000u) {  // inf / nan
+    if (em == 0x7f800000u) return static_cast<uint16_t>(sign | 0x7c00u);
+    return static_cast<uint16_t>(sign | 0x7c00u | 0x200u |
+                                 ((em >> 13) & 0x3ffu));
+  }
+  // >= 65520 rounds up past the largest finite fp16 (65504) to inf
+  if (em >= 0x477ff000u) return static_cast<uint16_t>(sign | 0x7c00u);
+  uint16_t h;
+  if (em >= 0x38800000u) {  // normal fp16 range
+    uint32_t v = em - 0x38000000u;  // rebias 127 -> 15
+    uint32_t r = v >> 13;
+    uint32_t rem = v & 0x1fffu;
+    r += (rem > 0x1000u) || (rem == 0x1000u && (r & 1u));
+    h = static_cast<uint16_t>(r);  // mantissa carry rolls into the exp
+  } else {  // subnormal fp16 (or zero)
+    uint32_t exp = em >> 23;
+    uint64_t mant = (em & 0x7fffffu) | (exp ? 0x800000u : 0u);
+    if (!exp) exp = 1;
+    int shift = 126 - static_cast<int>(exp);  // m16 = mant >> shift, RNE
+    if (shift > 63 || mant == 0) {
+      h = 0;
+    } else {
+      uint64_t r = mant >> shift;
+      uint64_t rem = mant & ((uint64_t{1} << shift) - 1);
+      uint64_t half = uint64_t{1} << (shift - 1);
+      r += (rem > half) || (rem == half && (r & 1u));
+      h = static_cast<uint16_t>(r);  // may carry into the smallest normal
+    }
+  }
+  return static_cast<uint16_t>(sign | h);
+}
+
+#ifdef HVDTPU_X86_SIMD
+// Region-split fp16 accumulate reproducing the PACKED call bit-for-bit.
+// The packed reference — AccumHalfSimd over [0, total) anchored at the
+// segment base — runs F16C RNE lanes on the 8-wide groups
+// [0, 8*(total/8)) and the round-half-up scalar helper on the tail.  A
+// scatter-gather region split hands this function the piece
+// [pos, pos+n) of that grid; lane membership is decided by the GRID
+// index, never the piece pointer — the group-phase offset that lets
+// fp16 join scatter-gather (ROADMAP carried-over: the rounding-tie
+// grouping used to be pointer-relative, so those dtypes always packed).
+// Verified exhaustively against the F16C lanes over every near-tie
+// operand pair; the one carve-out is NaN(+)NaN with two DIFFERENT
+// payloads, where "whose payload survives" is an operand-order choice
+// the compiler may legally flip — the same carve-out the bf16 blocked-
+// kernel battery documents.
+void AccumHalfSimdPhased(uint16_t* dst, const uint16_t* src, int64_t n,
+                         int64_t pos, int64_t total) {
+  const int64_t simd_end = total & ~int64_t{7};
+  auto scalar_one = [&](int64_t k) {
+    float s = HalfToFloat(dst[k]) + HalfToFloat(src[k]);
+    dst[k] = pos + k < simd_end ? FloatToHalfRNE(s) : FloatToHalf(s);
+  };
+  int64_t i = 0;
+  // leading partial group (cut off by the region boundary): SIMD lanes
+  // in the packed call, reproduced with the RNE scalar
+  int64_t lead = std::min(n, (8 - (pos & 7)) & 7);
+  for (; i < lead; i++) scalar_one(i);
+  // whole aligned groups inside the SIMD range: the vector kernel on an
+  // exact multiple of 8 runs no scalar tail, so bits match by identity
+  int64_t mid_end = std::min((pos + n) & ~int64_t{7}, simd_end) - pos;
+  if (mid_end > i) {
+    AccumHalfSimd(dst + i, src + i, mid_end - i);
+    i = mid_end;
+  }
+  // trailing partial group / packed-call tail
+  for (; i < n; i++) scalar_one(i);
+}
+#endif  // x86
+
+// Accumulate one region piece sitting at grid element position
+// [pos, pos+n) of a packed call spanning [0, total): bitwise identical to
+// the packed whole-range accumulate for every dtype.  Only the fp16 F16C
+// kernel is grouping-sensitive (its SIMD lanes round RNE, its scalar
+// tail rounds half-up — they differ on exact ties); every other kernel is
+// elementwise position-independent and takes the plain dispatch.
+void AccumulatePiece(void* dst, const void* src, int64_t n, DType d,
+                     int64_t pos, int64_t total) {
+#ifdef HVDTPU_X86_SIMD
+  if (d == DType::kFloat16 && AccumSimdEnabled() && CpuHasF16C()) {
+    AccumHalfSimdPhased(static_cast<uint16_t*>(dst),
+                        static_cast<const uint16_t*>(src), n, pos, total);
+    HealthAccumObserve(dst, n, d);
+    return;
+  }
+#endif
+  (void)pos;
+  (void)total;
+  Accumulate(dst, src, n, d);
 }
 
 // Ring-segment size sanitizer shared by the env parse, the bootstrap
@@ -463,9 +572,12 @@ struct WireRegions {
 
 // Elementwise-accumulate src (contiguous) into the logical element range
 // [lo_el, lo_el+nelems) of the regions.  Region boundaries are 64-byte
-// aligned in the logical space (the SG eligibility rule), so splitting the
-// accumulate at them keeps the blocked/SIMD kernels' 8-element groups
-// exactly where the packed whole-range accumulate would put them.
+// aligned in the logical space (the SG eligibility rule), so pieces are
+// always whole elements; grouping-sensitive kernels additionally receive
+// each piece's position within THIS call's grid (the packed reference
+// anchors its 8-lane groups at lo_el, which is chunk-relative — a
+// 64-byte-aligned buffer offset can still fall mid-group), so region
+// splits reproduce the packed whole-range accumulate bit for bit.
 void AccumulateRegions(const WireRegions& wr, int64_t lo_el, const char* src,
                        int64_t nelems, DType d) {
   size_t esize = DTypeSize(d);
@@ -477,9 +589,12 @@ void AccumulateRegions(const WireRegions& wr, int64_t lo_el, const char* src,
   int64_t lo_b = lo_el * static_cast<int64_t>(esize);
   int64_t hi_b = (lo_el + nelems) * static_cast<int64_t>(esize);
   const char* s = src;
+  int64_t pos = 0;  // element position within this call's group grid
   wr.ForRange(lo_b, hi_b, [&](char* p, int64_t n) {
-    Accumulate(p, s, n / static_cast<int64_t>(esize), d);
+    int64_t ne = n / static_cast<int64_t>(esize);
+    AccumulatePiece(p, s, ne, d, pos, nelems);
     s += n;
+    pos += ne;
     return true;
   });
 }
@@ -872,6 +987,20 @@ class Engine {
                      std::vector<Link>& links,
                      std::vector<std::unique_ptr<ShmRing>>& stx,
                      std::vector<std::unique_ptr<ShmRing>>& srx);
+  // -- numerical health + SDC audit ---------------------------------------
+  // Post-wire boundary of one allreduce collective: runs the accumulate-
+  // phase injector hook (arming/applying the deterministic flip), folds
+  // the thread's in-band health accumulator, and — when this round is
+  // audit-sampled — checksums the output regions and queues the digest
+  // for the next control frame.  Runs on whichever thread ran the wire.
+  void HealthAuditCollective(const WireRegions& wr, DType dtype,
+                             const std::vector<TensorEntry>& entries,
+                             const Status& st);
+  // Coordinator: fold audit records (a worker frame's, or rank 0's own
+  // pending digests) into the audit table; resolved mismatches append
+  // verdicts to pending_verdicts_[set] and apply locally.
+  void FeedAuditRecords(int set, const std::vector<AuditRecord>& recs);
+
   // -- fault domain (PR 5) -------------------------------------------------
   // record a control frame from `rank` (heartbeat piggybacking: every
   // frame refreshes liveness, explicit heartbeats only fill idle gaps)
@@ -1197,6 +1326,9 @@ class Engine {
   // not race a concurrent Close() on the non-atomic fd.
   std::unique_ptr<std::atomic<uint8_t>[]> worker_live_;
   int64_t hb_last_tx_ns_ = 0;            // bg thread only (idle-send pacing)
+  // coordinator: audit-mismatch verdicts awaiting a response-side frame
+  // to ride (bg thread only; keyed by process set)
+  std::map<int, std::vector<HealthVerdict>> pending_verdicts_;
   std::string stall_abort_msg_;          // watchdog escalation, bg thread
   bool aborted_ = false;                 // guarded by mu_
   Status abort_status_;                  // guarded by mu_ (sticky cause)
@@ -1452,6 +1584,11 @@ Status Engine::Init(const std::string& host, int port, int rank, int size) {
   // rank SIGKILLed mid-rendezvous leaves a black box too).  File-backed
   // when HOROVOD_TPU_TRACE_DIR is set; HOROVOD_TPU_TRACE=0 disables.
   TraceInit(rank_, size_);
+  // health: cumulative counters are process-wide (like the fault
+  // counters), but the in-flight audit state dies with the engine — a
+  // re-init restarts epochs/rounds at 0, and a stale digest keyed the
+  // same way could fabricate a mismatch against the new engine's data
+  HealthResetTransient();
   fusion_threshold_ = EnvInt64("HOROVOD_TPU_FUSION_THRESHOLD",
                                EnvInt64("HOROVOD_FUSION_THRESHOLD", 64 << 20));
   cycle_us_ = 1000 * EnvInt64("HOROVOD_TPU_CYCLE_TIME",
@@ -2196,6 +2333,9 @@ Status Engine::ElasticizeWire(Status st) {
 }
 
 void Engine::BeginWorldChange(const Status& cause) {
+  // audit verdicts name ranks by OLD-world numbers and rounds restart
+  // with the membership: drop anything still waiting for a frame
+  pending_verdicts_.clear();
   SetAborting(true);  // parked transfers (ours + the executors') cancel
   // half-close every old-world link (fd-safe vs a mid-transfer executor):
   // local blocked TCP waits fail on the next syscall, and the RSTs
@@ -3962,6 +4102,9 @@ void Engine::WorkerTick(RequestList& local, bool* stop) {
       cb.bits.assign(static_cast<size_t>(ns->cache.high_water() + 7) / 8, 0);
       for (int s : claims)
         cb.bits[s >> 3] |= static_cast<uint8_t>(1u << (s & 7));
+      // sampled audit digests piggyback on the tick's first frame for
+      // this set (zero extra round trips; zero bytes when audit is off)
+      if (AuditSampleN() > 0) cb.audits = HealthTakeAudits(sid, rank_);
       Status s = SendCtrl(coord_, Serialize(cb));
       if (!s.ok()) {
         *stop = AbortJob(
@@ -3971,6 +4114,7 @@ void Engine::WorkerTick(RequestList& local, bool* stop) {
       hb_last_tx_ns_ = NowNs();
     }
     if (!full.requests.empty() || full.shutdown) {
+      if (AuditSampleN() > 0) full.audits = HealthTakeAudits(sid, rank_);
       Status s = SendCtrl(coord_, Serialize(full));
       if (!s.ok()) {
         *stop = AbortJob(
@@ -4040,6 +4184,8 @@ void Engine::WorkerTick(RequestList& local, bool* stop) {
       AdoptTuned(ce.tuned_fusion, ce.tuned_cycle_us, ce.tuned_hierarchical,
                  ce.tuned_pipeline_depth, ce.tuned_segment_bytes,
                  ce.tuned_wire_stripes);
+      for (const HealthVerdict& v : ce.verdicts)
+        HealthApplyVerdict(v, rank_, ce.process_set);
       ProcessSet* ps = ce.process_set != 0 ? FindSet(ce.process_set)
                                            : nullptr;
       for (const auto& g : ce.groups) {
@@ -4072,6 +4218,8 @@ void Engine::WorkerTick(RequestList& local, bool* stop) {
       AdoptTuned(rl.tuned_fusion, rl.tuned_cycle_us, rl.tuned_hierarchical,
                  rl.tuned_pipeline_depth, rl.tuned_segment_bytes,
                  rl.tuned_wire_stripes);
+      for (const HealthVerdict& v : rl.verdicts)
+        HealthApplyVerdict(v, rank_, rl.process_set);
       auto snap = SnapshotReqs(*ns, rl);
       ProcessSet* ps = rl.process_set != 0 ? FindSet(rl.process_set)
                                            : nullptr;
@@ -4191,6 +4339,7 @@ bool Engine::CoordinatorTick(RequestList& local) {
                   std::to_string(rl.process_set) + " — dropped");
           continue;
         }
+        FeedAuditRecords(rl.process_set, rl.audits);
         ResponseList* op = out_for(rl.process_set);
         for (const Request& r : rl.requests)
           CheckCacheInvalidation(*ns, r, op);
@@ -4210,6 +4359,7 @@ bool Engine::CoordinatorTick(RequestList& local) {
                   std::to_string(cb.process_set) + " — dropped");
           continue;
         }
+        FeedAuditRecords(cb.process_set, cb.audits);
         ResponseList* op = out_for(cb.process_set);
         for (size_t b = 0; b < cb.bits.size(); b++) {
           uint8_t byte = cb.bits[b];
@@ -4227,6 +4377,14 @@ bool Engine::CoordinatorTick(RequestList& local) {
         break;
       }
     }
+  }
+  // the coordinator's own sampled audit digests skip the wire: feed them
+  // straight into the comparison table at the same tick boundary the
+  // workers' frame-borne records arrive at
+  if (AuditSampleN() > 0) {
+    FeedAuditRecords(0, HealthTakeAudits(0, 0));
+    for (auto& [sid, ps] : psets_)
+      if (!ps->evicted) FeedAuditRecords(sid, HealthTakeAudits(sid, 0));
   }
   // globally-hit cache entries execute via compact slot groups...
   CachedExecFrame ce;
@@ -4291,6 +4449,21 @@ bool Engine::CoordinatorTick(RequestList& local) {
       out.tuned_wire_stripes = pending_tuned_stripes_;
     }
   }
+  // audit-mismatch verdicts ride the tick's first response-side frame for
+  // the global set (cached-exec precedes the response list on the wire);
+  // with no frame this tick they stay pending for the next one
+  {
+    auto pv = pending_verdicts_.find(0);
+    if (pv != pending_verdicts_.end() && !pv->second.empty()) {
+      if (have_ce) {
+        ce.verdicts = std::move(pv->second);
+        pending_verdicts_.erase(pv);
+      } else if (have_rl) {
+        out.verdicts = std::move(pv->second);
+        pending_verdicts_.erase(pv);
+      }
+    }
+  }
   bool sent = true;
   if (have_ce) {
     std::string frame = Serialize(ce);
@@ -4351,6 +4524,17 @@ bool Engine::CoordinatorTick(RequestList& local) {
       bool s_have_ce = cit != sces.end() && !cit->second.groups.empty();
       auto rit = souts.find(sid);
       bool s_have_rl = rit != souts.end() && !rit->second.responses.empty();
+      // per-set audit verdicts ride the set's first frame this tick
+      auto pv = pending_verdicts_.find(sid);
+      if (pv != pending_verdicts_.end() && !pv->second.empty()) {
+        if (s_have_ce) {
+          cit->second.verdicts = std::move(pv->second);
+          pending_verdicts_.erase(pv);
+        } else if (s_have_rl) {
+          rit->second.verdicts = std::move(pv->second);
+          pending_verdicts_.erase(pv);
+        }
+      }
       if (s_have_ce) send_members(Serialize(cit->second));
       if (s_have_rl) send_members(Serialize(rit->second));
       if (s_have_ce || s_have_rl) hb_last_tx_ns_ = NowNs();
@@ -4797,6 +4981,68 @@ bool Engine::WorkerFaultTick(bool shutdown_in_flight) {
 // pipelined data plane
 // ---------------------------------------------------------------------------
 
+// ---------------------------------------------------------------------------
+// numerical health + SDC audit
+// ---------------------------------------------------------------------------
+
+// Post-wire boundary of one allreduce: the single place the accumulate-
+// phase injector hook, the in-band health fold, and the sampled output
+// checksum meet.  Identity comes from t_trace_ctx, which every caller set
+// before running the wire (Dispatch / ExecuteSet / RunWire).  The flip is
+// applied BEFORE the checksum and BEFORE unpack/copy-out, so the injected
+// corruption both reaches the caller's buffers (a real SDC would) and is
+// caught by the audit — while the peers' copies, already reduced from the
+// same wire bytes, stay clean: the bad-DIMM/stale-read model whose
+// corruption does NOT propagate.
+void Engine::HealthAuditCollective(const WireRegions& wr, DType dtype,
+                                   const std::vector<TensorEntry>& entries,
+                                   const Status& st) {
+  (void)dtype;
+  FaultInjector::Get().OnPhase(FaultPhase::kAccumulate);
+  int64_t bit = 0;
+  if (st.ok() && wr.total() > 0 && FaultInjector::Get().TakeFlip(&bit)) {
+    int64_t b = bit % (wr.total() * 8);
+    wr.ForRange(b / 8, b / 8 + 1, [&](char* p, int64_t) {
+      *p = static_cast<char>(*p ^ (1u << (b & 7)));
+      return true;
+    });
+    LOG_RANK(Warning, rank_)
+        << "fault injection: FLIPPED output bit " << b << " of (set "
+        << t_trace_ctx.set << ", round " << t_trace_ctx.round << ")";
+  }
+  if (HealthEnabled()) {
+    std::string label = entries.empty() ? "" : entries[0].req.name;
+    if (entries.size() > 1)
+      label += " (+" + std::to_string(entries.size() - 1) + " fused)";
+    HealthItemEnd(t_trace_ctx.set, t_trace_ctx.round, label);
+  }
+  if (st.ok() && AuditSampled(t_trace_ctx.round)) {
+    uint64_t h = HealthChecksumBegin();
+    // parts walk in logical order, and the region split is a pure
+    // function of rank-0-shipped knobs + the (identical) response — so
+    // every member folds the same byte stream into the same digest
+    for (const auto& part : wr.parts)
+      h = HealthChecksumFold(h, part.p, static_cast<size_t>(part.n));
+    HealthQueueAudit(t_trace_ctx.set, t_trace_ctx.epoch, t_trace_ctx.round,
+                     h);
+  }
+}
+
+void Engine::FeedAuditRecords(int set,
+                              const std::vector<AuditRecord>& recs) {
+  if (recs.empty()) return;
+  NegState* ns = NegOf(set);
+  if (ns == nullptr) return;
+  auto& out = pending_verdicts_[set];
+  size_t before = out.size();
+  for (const AuditRecord& rec : recs)
+    HealthFeedAudit(set, rec, ns->expected(), &out);
+  // the coordinator is a member too: apply freshly-resolved verdicts
+  // locally (workers apply them when the broadcast frame arrives)
+  for (size_t i = before; i < out.size(); i++)
+    HealthApplyVerdict(out[i], rank_, set);
+}
+
 // Response execution entry point for the negotiation thread: errors always
 // complete inline (they never touch the wire, and their handles should not
 // queue behind data-plane work); everything else goes through the executor
@@ -4834,12 +5080,12 @@ void Engine::Dispatch(const Response& resp) {
 //    the monolithic duplex exchange cannot walk discontiguous regions;
 //  * the entry is at least HOROVOD_TPU_SG_THRESHOLD_BYTES;
 //  * its logical offset and size are 64-byte multiples, so every region
-//    boundary falls on the accumulate kernels' 8-element group grid and
-//    a region-split accumulate equals the packed whole-range accumulate;
-//  * its dtype accumulates elementwise (fp16/bf16 use blocked kernels
-//    whose rounding-tie grouping is pointer-relative — a mid-stream
-//    region boundary would regroup them, breaking SG-on/off bitwise
-//    equivalence, so those always pack).
+//    boundary cuts between whole elements for every dtype, and
+//    AccumulatePiece's group-phase offset keeps the grouping-sensitive
+//    fp16 kernel's 8-lane grid anchored where the packed whole-range
+//    accumulate would anchor it (fp16/bf16 historically always packed
+//    because that grouping was pointer-relative; the phase offset is
+//    what retired the restriction).
 // Everything else stages into the fusion buffer exactly as before.
 size_t Engine::PlanWireRegions(const std::vector<TensorEntry>& entries,
                                std::vector<uint8_t>* packed) {
@@ -4852,10 +5098,8 @@ size_t Engine::PlanWireRegions(const std::vector<TensorEntry>& entries,
   int64_t off = 0;
   for (size_t i = 0; i < entries.size(); i++) {
     const TensorEntry& e = entries[i];
-    DType d = e.req.dtype;
-    bool split_ok = d != DType::kFloat16 && d != DType::kBFloat16;
     bool sg = thr > 0 && static_cast<int64_t>(e.nbytes) >= thr &&
-              off % 64 == 0 && e.nbytes % 64 == 0 && split_ok;
+              off % 64 == 0 && e.nbytes % 64 == 0;
     if (sg)
       (*packed)[i] = 0;
     else
@@ -4898,6 +5142,12 @@ void Engine::PipelineDispatch(const Response& resp) {
   item.hierarchical = hierarchical_allreduce_.load();
   item.wire_stripes = wire_stripes_active_.load(std::memory_order_relaxed);
   item.trace = t_trace_ctx;  // identity assigned by Dispatch, stream-ordered
+  // in-band per-(set, name) input-gradient stats, before the pack memcpys
+  // consume the entries (the pack path walks these bytes anyway)
+  if (HealthEnabled() && resp.op == OpType::kAllreduce)
+    for (TensorEntry& e : item.entries)
+      HealthObserveEntry(item.trace.set, e.req.name, item.trace.round,
+                         e.payload(), NumElems(e.req.dims), e.req.dtype);
   for (auto& e : item.entries)
     timeline_.Start(e.req.name, OpName(resp.op));
   if (resp.op == OpType::kAllreduce && item.entries.size() > 1) {
@@ -5277,9 +5527,11 @@ void Engine::RunWire(WorkItem& item) {
       int lane = item.buf ? item.buf->id : -1;
       timeline_.PipelineStart(lane, "WIRE");
       for (auto& e : item.entries) timeline_.ActivityStart(e.req.name, act);
+      if (HealthEnabled()) HealthItemBegin();
       item.status = ElasticizeWire(
           item.hierarchical ? HierarchicalAllreduce(wr, nelems, dtype)
                             : RingAllreduce(wr, nelems, dtype));
+      HealthAuditCollective(wr, dtype, item.entries, item.status);
       for (auto& e : item.entries) timeline_.ActivityEnd(e.req.name);
       timeline_.PipelineEnd(lane);
       break;
@@ -5393,6 +5645,13 @@ void Engine::ExecuteAllreduce(const Response& resp,
     if (hier) return HierarchicalAllreduce(wr, nelems, dtype);
     return RingAllreduce(wr, nelems, dtype);
   };
+  // in-band per-(set, name) input-gradient stats: the entries are still
+  // the caller's raw inputs at this point (pipelined items observe in
+  // PipelineDispatch instead — the two paths never both run)
+  if (HealthEnabled())
+    for (TensorEntry& e : entries)
+      HealthObserveEntry(t_trace_ctx.set, e.req.name, t_trace_ctx.round,
+                         e.payload(), NumElems(e.req.dims), e.req.dtype);
   const char* act = hier ? "HIERARCHICAL_ALLREDUCE" : "RING_ALLREDUCE";
   if (entries.size() == 1) {
     // no fusion copy needed: reduce in place on the payload buffer; the
@@ -5401,7 +5660,9 @@ void Engine::ExecuteAllreduce(const Response& resp,
     act_start(act);
     WireRegions wr;
     wr.Add(e.payload(), static_cast<int64_t>(e.nbytes));
+    if (HealthEnabled()) HealthItemBegin();
     Status st = ElasticizeWire(reduce(wr, NumElems(e.req.dims)));
+    HealthAuditCollective(wr, dtype, entries, st);
     act_end();
     FinishAllreduceEntry(e, st, /*copy_out=*/true);
     if (!st.ok()) DataPlaneFail(st);
@@ -5436,8 +5697,10 @@ void Engine::ExecuteAllreduce(const Response& resp,
   sg_bytes_total_.fetch_add(static_cast<int64_t>(total - pack_total),
                             std::memory_order_relaxed);
   act_start(act);
+  if (HealthEnabled()) HealthItemBegin();
   Status st =
       ElasticizeWire(reduce(wr, static_cast<int64_t>(total / DTypeSize(dtype))));
+  HealthAuditCollective(wr, dtype, entries, st);
   act_end();
   TraceEmit(TracePhase::kUnpack, static_cast<int64_t>(pack_total));
   FaultInjector::Get().OnPhase(FaultPhase::kUnpack);
@@ -7564,6 +7827,29 @@ const char* hvd_frame_parse_error(const void* buf, int64_t len) {
 // successful no-op for an anonymous one (there is nothing durable to
 // flush; pass a path to persist it).  Works with or without a live
 // engine — the recorder outlives engine re-inits.
+// Numerical-health summary (process-wide, like hvd_fault_stats: valid
+// with or without a live engine — counters survive re-init).  Layout:
+// {enabled, fatal_mode, audit_sample, nan_total, inf_total,
+//  subnormal_total, collectives_observed, audits_sent, audit_checks,
+//  audit_mismatches, last_bad_rank, last_bad_round, events_total,
+//  fatal_latched, grad_names_tracked, first_nan_round}.
+void hvd_health_stats(int64_t* out) { HealthStats(out); }
+
+// Full health document as JSON (config, totals, per-(set, name) gradient
+// table with EWMA, anomaly-event log).  Caller frees via hvd_free_cstr.
+const char* hvd_health_describe() {
+  return strdup(HealthDescribeJson().c_str());
+}
+
+// Fast fatal-latch probe for the Python synchronize path (fatal mode):
+// 1 once any anomaly latched NumericalHealthError material.
+int hvd_health_fatal() { return HealthFatalLatched(); }
+
+// The latched anomaly message ("" when none).  Caller frees.
+const char* hvd_health_error() {
+  return strdup(HealthLastError().c_str());
+}
+
 int hvd_trace_dump(const char* path) { return TraceDump(path); }
 
 // {enabled, rings, events written, events dropped, ring capacity, clock

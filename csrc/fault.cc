@@ -127,6 +127,7 @@ const char* PhaseName(FaultPhase p) {
     case FaultPhase::kPack: return "pack";
     case FaultPhase::kRing: return "ring";
     case FaultPhase::kUnpack: return "unpack";
+    case FaultPhase::kAccumulate: return "accumulate";
   }
   return "?";
 }
@@ -137,6 +138,7 @@ struct SpecFields {
   FaultPhase phase = FaultPhase::kNegotiation;
   int64_t hit = 1;
   int64_t ms = 0;
+  int64_t bit = 0;
   int link_a = -1, link_b = -1;
   bool ok = true;
   std::string err;
@@ -164,6 +166,7 @@ SpecFields ParseFields(const std::string& body) {
       else if (v == "pack") f.phase = FaultPhase::kPack;
       else if (v == "ring") f.phase = FaultPhase::kRing;
       else if (v == "unpack") f.phase = FaultPhase::kUnpack;
+      else if (v == "accumulate") f.phase = FaultPhase::kAccumulate;
       else {
         f.ok = false;
         f.err = "unknown phase '" + v + "'";
@@ -174,6 +177,9 @@ SpecFields ParseFields(const std::string& body) {
       if (f.hit < 1) f.hit = 1;
     } else if (k == "ms") {
       f.ms = strtoll(v.c_str(), nullptr, 10);
+    } else if (k == "bit") {
+      f.bit = strtoll(v.c_str(), nullptr, 10);
+      if (f.bit < 0) f.bit = 0;
     } else if (k == "link") {
       // "A-B"
       size_t dash = v.find('-');
@@ -220,7 +226,8 @@ void FaultInjector::Configure(int rank) {
                    << f.err << ") — IGNORED";
       continue;
     }
-    if (type == "kill" || type == "hang" || type == "slow") {
+    if (type == "kill" || type == "hang" || type == "slow" ||
+        type == "flip") {
       if (f.rank < 0) {
         LOG(Warning) << "fault injection: spec '" << one
                      << "' lacks rank= — IGNORED";
@@ -236,10 +243,12 @@ void FaultInjector::Configure(int rank) {
       Spec& s = specs_[nspecs_++];
       s.kind = type == "kill" ? Spec::Kind::kKill
                : type == "hang" ? Spec::Kind::kHang
+               : type == "flip" ? Spec::Kind::kFlip
                                 : Spec::Kind::kSlow;
       s.phase = f.phase;
       s.hit = f.hit;
       s.ms = f.ms;
+      s.bit = f.bit;
       armed_ = true;
     } else if (type == "delay") {
       if (f.link_a < 0 || f.link_b < 0 || f.ms <= 0) {
@@ -274,6 +283,16 @@ void FaultInjector::OnPhaseSlow(FaultPhase p) {
       continue;
     }
     s.fired = true;
+    if (s.kind == Spec::Kind::kFlip) {
+      // arm the one-shot corruption; the engine applies it at the next
+      // collective's output boundary (deterministic payload bit-flip)
+      flip_pending_ = true;
+      flip_bit_ = s.bit;
+      LOG_RANK(Warning, rank_) << "fault injection: FLIP armed at "
+                               << PhaseName(p) << " #" << s.hit << " (bit "
+                               << s.bit << ")";
+      continue;
+    }
     if (s.kind == Spec::Kind::kKill) {
       // async-signal-safe last words: SIGKILL flushes nothing
       char buf[128];

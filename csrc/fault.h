@@ -35,8 +35,20 @@
 //                                   per-phase straggler the flight-recorder
 //                                   attribution bench injects and must find
 //    delay:link=0-1:ms=500          500 ms pause entering each 0<->1 transfer
-// Phases: negotiation (default), pack, ring, unpack.  ``cycle`` and ``hit``
-// are synonyms: the Nth entry of that phase on that rank (1-based).
+//    flip:rank=2:phase=accumulate:bit=7
+//                                   deterministic silent-data-corruption:
+//                                   at the phase's hit-th entry, ARM a
+//                                   one-shot payload bit-flip; the engine
+//                                   applies it to that rank's LOCAL copy of
+//                                   the collective's reduced output (after
+//                                   the wire, before delivery/audit) — the
+//                                   bad-DIMM/stale-read model whose
+//                                   corruption does NOT propagate, which is
+//                                   exactly what the cross-rank checksum
+//                                   audit must catch and attribute
+// Phases: negotiation (default), pack, ring, accumulate, unpack.  ``cycle``
+// and ``hit`` are synonyms: the Nth entry of that phase on that rank
+// (1-based).  The accumulate phase counts once per allreduce collective.
 #pragma once
 
 #include <atomic>
@@ -125,7 +137,7 @@ FaultCounters& Faults();
 // ---------------------------------------------------------------------------
 
 enum class FaultPhase : int { kNegotiation = 0, kPack = 1, kRing = 2,
-                              kUnpack = 3 };
+                              kUnpack = 3, kAccumulate = 4 };
 
 class FaultInjector {
  public:
@@ -145,6 +157,16 @@ class FaultInjector {
     if (delay_armed_) OnLinkSlow(peer);
   }
 
+  // A `flip` spec whose phase hook fired leaves a one-shot pending
+  // bit-flip; the engine consumes it at the next collective's output
+  // boundary (engine.cc HealthAuditCollective) and XORs the named bit.
+  bool TakeFlip(int64_t* bit) {
+    if (!flip_pending_) return false;
+    flip_pending_ = false;
+    *bit = flip_bit_;
+    return true;
+  }
+
   static FaultInjector& Get();
 
  private:
@@ -152,19 +174,22 @@ class FaultInjector {
   void OnLinkSlow(int peer);
 
   struct Spec {
-    enum class Kind { kKill, kHang, kSlow };
+    enum class Kind { kKill, kHang, kSlow, kFlip };
     Kind kind = Kind::kKill;
     FaultPhase phase = FaultPhase::kNegotiation;
     int64_t hit = 1;       // fire at the Nth phase entry (1-based)
     int64_t ms = 0;        // kSlow: sleep per entry from the hit-th on
+    int64_t bit = 0;       // kFlip: payload bit index (mod payload bits)
     int64_t seen = 0;
-    bool fired = false;    // kill/hang are one-shot; slow re-fires
+    bool fired = false;    // kill/hang/flip are one-shot; slow re-fires
   };
   // at most a handful of specs; fixed storage keeps the hook allocation-free
   static constexpr int kMaxSpecs = 8;
   Spec specs_[kMaxSpecs];
   int nspecs_ = 0;
   bool armed_ = false;
+  bool flip_pending_ = false;
+  int64_t flip_bit_ = 0;
   bool delay_armed_ = false;
   int delay_peer_a_ = -1, delay_peer_b_ = -1;
   int64_t delay_ms_ = 0;

@@ -1,0 +1,714 @@
+#include "health.h"
+
+#include <algorithm>
+#include <atomic>
+#include <cmath>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <sstream>
+
+#include "logging.h"
+#include "trace.h"
+
+namespace hvdtpu {
+
+// ---------------------------------------------------------------------------
+// configuration
+// ---------------------------------------------------------------------------
+
+bool HealthEnabled() {
+  static bool on = !EnvFlagIsZero("HOROVOD_TPU_HEALTH");
+  return on;
+}
+
+int64_t AuditSampleN() {
+  static int64_t n = [] {
+    int64_t v = EnvInt64("HOROVOD_TPU_AUDIT_SAMPLE", 0);
+    return v < 0 ? 0 : v;
+  }();
+  return n;
+}
+
+bool HealthFatal() {
+  static bool on = EnvFlag("HOROVOD_TPU_HEALTH_FATAL");
+  return on;
+}
+
+double HealthSpikeFactor() {
+  static double f = [] {
+    const char* v = getenv("HOROVOD_TPU_HEALTH_SPIKE_FACTOR");
+    if (!v || !v[0]) return 0.0;
+    double d = atof(v);
+    return d < 0 ? 0.0 : d;
+  }();
+  return f;
+}
+
+// ---------------------------------------------------------------------------
+// process-wide state
+// ---------------------------------------------------------------------------
+
+thread_local HVDTPU_HEALTH_TLS HealthAccum t_health_accum;
+thread_local HVDTPU_HEALTH_TLS bool t_health_item_open = false;
+
+namespace {
+
+// atomic double max via bit CAS (absmax gauges)
+void AtomicMaxDouble(std::atomic<uint64_t>* a, double v) {
+  uint64_t nv;
+  std::memcpy(&nv, &v, 8);
+  uint64_t cur = a->load(std::memory_order_relaxed);
+  for (;;) {
+    double cd;
+    std::memcpy(&cd, &cur, 8);
+    if (!(v > cd)) return;
+    if (a->compare_exchange_weak(cur, nv, std::memory_order_relaxed)) return;
+  }
+}
+
+double LoadDouble(const std::atomic<uint64_t>& a) {
+  uint64_t b = a.load(std::memory_order_relaxed);
+  double d;
+  std::memcpy(&d, &b, 8);
+  return d;
+}
+
+// JSON has no inf/nan literals: an overflowed norm/absmax must serialize
+// as 0, not as text json.loads rejects
+double Fin(double v) { return std::isfinite(v) ? v : 0.0; }
+
+struct NameStat {
+  int64_t count = 0;          // observations (collectives this name rode)
+  int64_t elems = 0;
+  int64_t nan = 0;
+  int64_t inf = 0;
+  int64_t subnormal = 0;
+  double absmax = 0.0;        // latest observation
+  double norm = 0.0;          // latest L2 norm
+  double ewma = 0.0;          // EWMA of the L2 norm (alpha = 0.25)
+  uint32_t last_round = 0;
+  int64_t first_nan_round = -1;
+  int64_t spikes = 0;
+};
+
+struct HealthEvent {
+  HealthEventKind kind;
+  int set;
+  uint32_t round;
+  int rank;
+  std::string name;
+  double value;
+};
+
+struct AuditKey {
+  int set;
+  uint32_t epoch;
+  uint32_t round;
+  bool operator<(const AuditKey& o) const {
+    if (set != o.set) return set < o.set;
+    if (epoch != o.epoch) return epoch < o.epoch;
+    return round < o.round;
+  }
+};
+
+struct AuditCell {
+  std::map<uint64_t, std::vector<int>> by_sum;  // checksum -> ranks
+  int count = 0;
+  int64_t seq = 0;  // insertion order, for bounded eviction
+};
+
+struct HealthState {
+  std::mutex mu;
+  // per-(set, name) gradient table, bounded
+  std::map<std::pair<int, std::string>, NameStat> names;
+  // anomaly-event log, bounded FIFO
+  std::deque<HealthEvent> events;
+  // executor -> negotiation-thread audit handoff, per set
+  std::map<int, std::deque<AuditRecord>> pending;
+  // coordinator audit table
+  std::map<AuditKey, AuditCell> table;
+  int64_t table_seq = 0;
+  // fatal latch
+  bool fatal = false;
+  std::string fatal_msg;
+
+  // counters (atomics: scraped from the diagnostics thread)
+  std::atomic<int64_t> nan_total{0};
+  std::atomic<int64_t> inf_total{0};
+  std::atomic<int64_t> subnormal_total{0};
+  std::atomic<int64_t> collectives{0};      // reduce-stage folds
+  std::atomic<int64_t> audits_sent{0};      // digests this rank queued
+  std::atomic<int64_t> audit_checks{0};     // coordinator: rounds compared
+  std::atomic<int64_t> audit_mismatches{0};
+  std::atomic<int64_t> last_bad_rank{-1};
+  std::atomic<int64_t> last_bad_round{-1};
+  std::atomic<int64_t> event_count{0};
+  std::atomic<int64_t> first_nan_round{-1};
+  std::atomic<uint64_t> absmax_bits{0};
+  std::atomic<uint64_t> reduce_sumsq_bits{0};  // not atomic-add; see fold
+};
+
+HealthState& S() {
+  static HealthState s;
+  return s;
+}
+
+constexpr size_t kMaxNames = 512;
+constexpr size_t kMaxEvents = 64;
+constexpr size_t kMaxAuditCells = 4096;
+
+const char* KindName(HealthEventKind k) {
+  switch (k) {
+    case HealthEventKind::kNan: return "nan";
+    case HealthEventKind::kReduceNan: return "reduce-nan";
+    case HealthEventKind::kNormSpike: return "norm-spike";
+    case HealthEventKind::kAuditMismatch: return "audit-mismatch";
+    case HealthEventKind::kSdcVictim: return "sdc-victim";
+  }
+  return "?";
+}
+
+void LatchFatalLocked(HealthState& s, const std::string& msg) {
+  if (!s.fatal) {
+    s.fatal = true;
+    s.fatal_msg = msg;
+  }
+}
+
+// ---------------------------------------------------------------------------
+// streaming observers (vectorizable classification passes)
+// ---------------------------------------------------------------------------
+
+// One pass over fp32 data: counts + absmax + sumsq.  Classification uses
+// the bit patterns (exp all-ones => inf/nan; exp zero + mantissa => sub-
+// normal) so the loop is branch-light and auto-vectorizes at O3.
+__attribute__((optimize("O3", "tree-vectorize")))
+void ObserveF32(const float* p, int64_t n, HealthAccum* a) {
+  int64_t nan = 0, inf = 0, sub = 0;
+  float mx = 0.0f;
+  double sq = 0.0;
+  for (int64_t i = 0; i < n; i++) {
+    uint32_t b;
+    std::memcpy(&b, p + i, 4);
+    uint32_t em = b & 0x7fffffffu;
+    uint32_t ex = em >> 23;
+    bool is_special = ex == 0xffu;
+    nan += is_special & ((em & 0x7fffffu) != 0);
+    inf += is_special & ((em & 0x7fffffu) == 0);
+    sub += (ex == 0) & ((em & 0x7fffffu) != 0);
+    float av = is_special ? 0.0f : std::fabs(p[i]);
+    if (av > mx) mx = av;
+    sq += is_special ? 0.0 : static_cast<double>(av) * av;
+  }
+  a->elems += n;
+  a->nan += nan;
+  a->inf += inf;
+  a->subnormal += sub;
+  if (mx > a->absmax) a->absmax = mx;
+  a->sumsq += sq;
+}
+
+__attribute__((optimize("O3", "tree-vectorize")))
+void ObserveF64(const double* p, int64_t n, HealthAccum* a) {
+  int64_t nan = 0, inf = 0, sub = 0;
+  double mx = 0.0, sq = 0.0;
+  for (int64_t i = 0; i < n; i++) {
+    uint64_t b;
+    std::memcpy(&b, p + i, 8);
+    uint64_t em = b & 0x7fffffffffffffffull;
+    uint64_t ex = em >> 52;
+    bool is_special = ex == 0x7ffull;
+    nan += is_special & ((em & 0xfffffffffffffull) != 0);
+    inf += is_special & ((em & 0xfffffffffffffull) == 0);
+    sub += (ex == 0) & ((em & 0xfffffffffffffull) != 0);
+    double av = is_special ? 0.0 : std::fabs(p[i]);
+    if (av > mx) mx = av;
+    sq += av * av;
+  }
+  a->elems += n;
+  a->nan += nan;
+  a->inf += inf;
+  a->subnormal += sub;
+  if (mx > a->absmax) a->absmax = mx;
+  a->sumsq += sq;
+}
+
+// 16-bit floats: classify on the raw bits, widen magnitude via the shared
+// scalar converters for absmax/sumsq.
+template <float (*ToF)(uint16_t), uint16_t kExpMask, uint16_t kMantMask>
+__attribute__((optimize("O3")))
+void Observe16(const uint16_t* p, int64_t n, HealthAccum* a) {
+  int64_t nan = 0, inf = 0, sub = 0;
+  double mx = 0.0, sq = 0.0;
+  for (int64_t i = 0; i < n; i++) {
+    uint16_t b = p[i];
+    uint16_t ex = b & kExpMask;
+    uint16_t mant = b & kMantMask;
+    bool is_special = ex == kExpMask;
+    nan += is_special & (mant != 0);
+    inf += is_special & (mant == 0);
+    sub += (ex == 0) & (mant != 0);
+    double av = is_special ? 0.0 : std::fabs(static_cast<double>(ToF(b)));
+    if (av > mx) mx = av;
+    sq += av * av;
+  }
+  a->elems += n;
+  a->nan += nan;
+  a->inf += inf;
+  a->subnormal += sub;
+  if (mx > a->absmax) a->absmax = mx;
+  a->sumsq += sq;
+}
+
+template <typename T>
+__attribute__((optimize("O3", "tree-vectorize")))
+void ObserveInt(const T* p, int64_t n, HealthAccum* a) {
+  double mx = 0.0, sq = 0.0;
+  for (int64_t i = 0; i < n; i++) {
+    double av = std::fabs(static_cast<double>(p[i]));
+    if (av > mx) mx = av;
+    sq += av * av;
+  }
+  a->elems += n;
+  if (mx > a->absmax) a->absmax = mx;
+  a->sumsq += sq;
+}
+
+}  // namespace
+
+void HealthObserveBuffer(const void* p, int64_t n, DType d, HealthAccum* a) {
+  if (n <= 0) return;
+  switch (d) {
+    case DType::kFloat32:
+      ObserveF32(static_cast<const float*>(p), n, a);
+      break;
+    case DType::kFloat64:
+      ObserveF64(static_cast<const double*>(p), n, a);
+      break;
+    case DType::kFloat16:
+      Observe16<HalfToFloat, 0x7c00u, 0x3ffu>(
+          static_cast<const uint16_t*>(p), n, a);
+      break;
+    case DType::kBFloat16:
+      Observe16<BF16ToFloat, 0x7f80u, 0x7fu>(
+          static_cast<const uint16_t*>(p), n, a);
+      break;
+    case DType::kUInt8:
+      ObserveInt(static_cast<const uint8_t*>(p), n, a);
+      break;
+    case DType::kInt8:
+      ObserveInt(static_cast<const int8_t*>(p), n, a);
+      break;
+    case DType::kInt32:
+      ObserveInt(static_cast<const int32_t*>(p), n, a);
+      break;
+    case DType::kInt64:
+      ObserveInt(static_cast<const int64_t*>(p), n, a);
+      break;
+  }
+}
+
+void HealthItemBegin() {
+  t_health_accum.Reset();
+  t_health_item_open = true;
+}
+
+void HealthItemEnd(int set, uint32_t round, const std::string& label) {
+  if (!t_health_item_open) return;
+  t_health_item_open = false;
+  HealthAccum a = t_health_accum;
+  HealthState& s = S();
+  s.collectives.fetch_add(1, std::memory_order_relaxed);
+  if (a.elems == 0) return;
+  s.nan_total.fetch_add(a.nan, std::memory_order_relaxed);
+  s.inf_total.fetch_add(a.inf, std::memory_order_relaxed);
+  s.subnormal_total.fetch_add(a.subnormal, std::memory_order_relaxed);
+  AtomicMaxDouble(&s.absmax_bits, a.absmax);
+  // first-NaN policy on the REDUCE stage: a NaN arriving from any peer's
+  // contribution shows up here even when this rank's own inputs are clean
+  if (a.nan > 0) {
+    int64_t expect = -1;
+    if (s.first_nan_round.compare_exchange_strong(
+            expect, static_cast<int64_t>(round),
+            std::memory_order_relaxed)) {
+      HealthRecordEvent(HealthEventKind::kReduceNan, set, round, -1, label,
+                        static_cast<double>(a.nan));
+      LOG(Warning) << "numerical health: first NaN observed in the "
+                   << "accumulate stage of collective '" << label
+                   << "' (set " << set << ", round " << round << ", "
+                   << a.nan << " NaN element(s))";
+    }
+  }
+}
+
+void HealthObserveEntry(int set, const std::string& name, uint32_t round,
+                        const void* p, int64_t n, DType d) {
+  HealthAccum a;
+  HealthObserveBuffer(p, n, d, &a);
+  HealthState& s = S();
+  s.nan_total.fetch_add(a.nan, std::memory_order_relaxed);
+  s.inf_total.fetch_add(a.inf, std::memory_order_relaxed);
+  s.subnormal_total.fetch_add(a.subnormal, std::memory_order_relaxed);
+  AtomicMaxDouble(&s.absmax_bits, a.absmax);
+  double norm = std::sqrt(a.sumsq);
+  bool first_nan = false;
+  bool spike = false;
+  double ewma_at_spike = 0.0;
+  {
+    std::lock_guard<std::mutex> lk(s.mu);
+    auto key = std::make_pair(set, name);
+    auto it = s.names.find(key);
+    if (it == s.names.end()) {
+      if (s.names.size() >= kMaxNames)
+        it = s.names.emplace(std::make_pair(set, std::string("(other)")),
+                             NameStat{}).first;
+      else
+        it = s.names.emplace(key, NameStat{}).first;
+    }
+    NameStat& st = it->second;
+    st.count++;
+    st.elems += a.elems;
+    st.nan += a.nan;
+    st.inf += a.inf;
+    st.subnormal += a.subnormal;
+    st.absmax = a.absmax;
+    st.norm = norm;
+    st.last_round = round;
+    if (a.nan > 0 && st.first_nan_round < 0) {
+      st.first_nan_round = static_cast<int64_t>(round);
+      first_nan = true;
+    }
+    double f = HealthSpikeFactor();
+    // warmup: the EWMA needs a few clean observations before a spike
+    // verdict means anything
+    if (f > 0 && st.count > 4 && st.ewma > 0 && norm > f * st.ewma &&
+        a.nan == 0) {
+      spike = true;
+      ewma_at_spike = st.ewma;
+      st.spikes++;
+    }
+    st.ewma = st.ewma == 0 ? norm : 0.75 * st.ewma + 0.25 * norm;
+  }
+  if (first_nan) {
+    // the global first-nan gauge may already be set by the reduce-stage
+    // observer — per-name rounds live in the table regardless
+    int64_t expect = -1;
+    s.first_nan_round.compare_exchange_strong(
+        expect, static_cast<int64_t>(round), std::memory_order_relaxed);
+    HealthRecordEvent(HealthEventKind::kNan, set, round, -1, name,
+                      static_cast<double>(a.nan));
+    LOG(Warning) << "numerical health: first NaN in gradient '" << name
+                 << "' (set " << set << ", round " << round << ")";
+  }
+  if (spike) {
+    HealthRecordEvent(HealthEventKind::kNormSpike, set, round, -1, name,
+                      norm);
+    LOG(Warning) << "numerical health: gradient '" << name
+                 << "' L2 norm spiked to " << norm << " ("
+                 << HealthSpikeFactor() << "x threshold over EWMA "
+                 << ewma_at_spike << "; set " << set << ", round " << round
+                 << ")";
+  }
+}
+
+// ---------------------------------------------------------------------------
+// checksum + audit
+// ---------------------------------------------------------------------------
+
+namespace {
+inline uint64_t Mix64(uint64_t x) {
+  x ^= x >> 33;
+  x *= 0xff51afd7ed558ccdULL;
+  x ^= x >> 33;
+  x *= 0xc4ceb9fe1a85ec53ULL;
+  x ^= x >> 33;
+  return x;
+}
+}  // namespace
+
+uint64_t HealthChecksumBegin() { return 0x9e3779b97f4a7c15ULL; }
+
+uint64_t HealthChecksumFold(uint64_t h, const void* p, size_t n) {
+  const char* c = static_cast<const char*>(p);
+  size_t i = 0;
+  for (; i + 8 <= n; i += 8) {
+    uint64_t w;
+    std::memcpy(&w, c + i, 8);
+    h = Mix64(h + w);
+  }
+  if (i < n) {
+    uint64_t w = 0;
+    std::memcpy(&w, c + i, n - i);
+    h = Mix64(h + w + (static_cast<uint64_t>(n - i) << 56));
+  }
+  return h;
+}
+
+void HealthQueueAudit(int set, uint32_t epoch, uint32_t round,
+                      uint64_t sum) {
+  HealthState& s = S();
+  s.audits_sent.fetch_add(1, std::memory_order_relaxed);
+  std::lock_guard<std::mutex> lk(s.mu);
+  AuditRecord rec;
+  rec.rank = -1;  // stamped by the drain
+  rec.epoch = epoch;
+  rec.round = round;
+  rec.sum = sum;
+  auto& q = s.pending[set];
+  q.push_back(rec);
+  // a job that stops negotiating never drains; bound the backlog
+  while (q.size() > 1024) q.pop_front();
+}
+
+std::vector<AuditRecord> HealthTakeAudits(int set, int my_rank) {
+  HealthState& s = S();
+  std::lock_guard<std::mutex> lk(s.mu);
+  auto it = s.pending.find(set);
+  if (it == s.pending.end() || it->second.empty()) return {};
+  std::vector<AuditRecord> out(it->second.begin(), it->second.end());
+  it->second.clear();
+  for (AuditRecord& r : out) r.rank = my_rank;
+  return out;
+}
+
+void HealthResetTransient() {
+  HealthState& s = S();
+  std::lock_guard<std::mutex> lk(s.mu);
+  s.pending.clear();
+  s.table.clear();
+}
+
+void HealthFeedAudit(int set, const AuditRecord& rec, int expected,
+                     std::vector<HealthVerdict>* out) {
+  if (expected <= 0) return;
+  HealthState& s = S();
+  std::vector<std::pair<uint64_t, std::vector<int>>> groups;
+  {
+    std::lock_guard<std::mutex> lk(s.mu);
+    AuditKey key{set, rec.epoch, rec.round};
+    AuditCell& cell = s.table[key];
+    if (cell.count == 0) cell.seq = ++s.table_seq;
+    cell.by_sum[rec.sum].push_back(rec.rank);
+    cell.count++;
+    if (cell.count < expected) {
+      // bounded table: entries orphaned by elastic membership changes
+      // (their epoch died before all members reported) evict oldest-first
+      if (s.table.size() > kMaxAuditCells) {
+        auto oldest = s.table.begin();
+        for (auto it = s.table.begin(); it != s.table.end(); ++it)
+          if (it->second.seq < oldest->second.seq) oldest = it;
+        s.table.erase(oldest);
+      }
+      return;
+    }
+    groups.assign(cell.by_sum.begin(), cell.by_sum.end());
+    s.table.erase(AuditKey{set, rec.epoch, rec.round});
+  }
+  s.audit_checks.fetch_add(1, std::memory_order_relaxed);
+  if (groups.size() <= 1) return;  // all digests agree: the healthy case
+  s.audit_mismatches.fetch_add(1, std::memory_order_relaxed);
+  size_t best = 0;
+  for (size_t i = 1; i < groups.size(); i++)
+    if (groups[i].second.size() > groups[best].second.size()) best = i;
+  // attribution needs a STRICT majority behind one digest: a 2-rank
+  // world (or any even split) only proves THAT corruption happened, not
+  // WHERE — naming a rank off a tie would kill an innocent host half the
+  // time in fatal mode.  Detection is still recorded (counter, round,
+  // event, log); no verdicts are emitted.
+  if (2 * groups[best].second.size() <= static_cast<size_t>(expected)) {
+    s.last_bad_round.store(static_cast<int64_t>(rec.round),
+                           std::memory_order_relaxed);
+    HealthRecordEvent(HealthEventKind::kAuditMismatch, set, rec.round, -1,
+                      "", 0.0);
+    LOG(Error) << "health audit: silent data corruption DETECTED at (set "
+               << set << ", epoch " << rec.epoch << ", round " << rec.round
+               << ") but no checksum holds a strict majority ("
+               << groups.size() << " digest groups over " << expected
+               << " member(s)) — cannot attribute; rerun at >=3 members "
+               << "or bisect per docs/troubleshooting.md";
+    return;
+  }
+  uint64_t want = groups[best].first;
+  for (size_t i = 0; i < groups.size(); i++) {
+    if (i == best) continue;
+    for (int bad : groups[i].second) {
+      HealthVerdict v;
+      v.bad_rank = bad;
+      v.epoch = rec.epoch;
+      v.round = rec.round;
+      v.want = want;
+      v.got = groups[i].first;
+      if (out) out->push_back(v);
+      s.last_bad_rank.store(bad, std::memory_order_relaxed);
+      s.last_bad_round.store(static_cast<int64_t>(rec.round),
+                             std::memory_order_relaxed);
+      HealthRecordEvent(HealthEventKind::kAuditMismatch, set, rec.round,
+                        bad, "", 0.0);
+      LOG(Error) << "health audit: silent data corruption — rank " << bad
+                 << "'s output for (set " << set << ", epoch " << rec.epoch
+                 << ", round " << rec.round << ") diverged from "
+                 << groups[best].second.size() << " agreeing peer(s) "
+                 << "(checksum " << std::hex << groups[i].first << " vs "
+                 << want << std::dec << ")";
+    }
+  }
+}
+
+void HealthApplyVerdict(const HealthVerdict& v, int my_rank, int set) {
+  HealthState& s = S();
+  s.last_bad_rank.store(v.bad_rank, std::memory_order_relaxed);
+  s.last_bad_round.store(static_cast<int64_t>(v.round),
+                         std::memory_order_relaxed);
+  if (v.bad_rank != my_rank) return;
+  std::ostringstream os;
+  os << "silent data corruption detected: this rank's allreduce output "
+     << "for (set " << set << ", epoch " << v.epoch << ", round "
+     << v.round << ") diverged from the majority checksum (got "
+     << std::hex << v.got << ", want " << v.want << std::dec
+     << ") — suspect local memory/CPU corruption on this host";
+  // latch BEFORE recording the event: the verdict's detailed message
+  // (checksums, suspect-host hint) must win over the generic event latch
+  if (HealthFatal()) {
+    std::lock_guard<std::mutex> lk(s.mu);
+    LatchFatalLocked(s, os.str());
+  }
+  HealthRecordEvent(HealthEventKind::kSdcVictim, set, v.round, my_rank,
+                    "", 0.0);
+  LOG_RANK(Error, my_rank) << "health audit: " << os.str();
+}
+
+// ---------------------------------------------------------------------------
+// events + export
+// ---------------------------------------------------------------------------
+
+void HealthRecordEvent(HealthEventKind kind, int set, uint32_t round,
+                       int rank, const std::string& name, double value) {
+  HealthState& s = S();
+  s.event_count.fetch_add(1, std::memory_order_relaxed);
+  // flight recorder: a HEALTH mark at the (set, round) identity so the
+  // cross-rank merge can place the anomaly on the collective timeline
+  TraceCtx saved = t_trace_ctx;
+  t_trace_ctx.set = set;
+  t_trace_ctx.round = round;
+  TraceEmit(TracePhase::kHealth, static_cast<int64_t>(kind), rank);
+  t_trace_ctx = saved;
+  {
+    std::lock_guard<std::mutex> lk(s.mu);
+    s.events.push_back({kind, set, round, rank, name, value});
+    while (s.events.size() > kMaxEvents) s.events.pop_front();
+    if (HealthFatal() && kind != HealthEventKind::kAuditMismatch) {
+      // mismatch verdicts latch on the NAMED rank only (ApplyVerdict);
+      // every other anomaly latches where it was observed
+      std::ostringstream os;
+      switch (kind) {
+        case HealthEventKind::kNan:
+          os << "first NaN in gradient '" << name << "' (" << value
+             << " NaN element(s))";
+          break;
+        case HealthEventKind::kReduceNan:
+          os << "first NaN in the accumulate stage of collective '"
+             << name << "'";
+          break;
+        case HealthEventKind::kNormSpike:
+          os << "gradient '" << name << "' L2 norm spiked to " << value
+             << " (vs its EWMA; threshold "
+             << HealthSpikeFactor() << "x)";
+          break;
+        default:
+          os << "numerical health anomaly (" << KindName(kind) << ")";
+      }
+      os << ", set " << set << ", round " << round;
+      LatchFatalLocked(s, os.str());
+    }
+  }
+}
+
+void HealthStats(int64_t out[16]) {
+  HealthState& s = S();
+  out[0] = HealthEnabled() ? 1 : 0;
+  out[1] = HealthFatal() ? 1 : 0;
+  out[2] = AuditSampleN();
+  out[3] = s.nan_total.load(std::memory_order_relaxed);
+  out[4] = s.inf_total.load(std::memory_order_relaxed);
+  out[5] = s.subnormal_total.load(std::memory_order_relaxed);
+  out[6] = s.collectives.load(std::memory_order_relaxed);
+  out[7] = s.audits_sent.load(std::memory_order_relaxed);
+  out[8] = s.audit_checks.load(std::memory_order_relaxed);
+  out[9] = s.audit_mismatches.load(std::memory_order_relaxed);
+  out[10] = s.last_bad_rank.load(std::memory_order_relaxed);
+  out[11] = s.last_bad_round.load(std::memory_order_relaxed);
+  out[12] = s.event_count.load(std::memory_order_relaxed);
+  int64_t names;
+  {
+    std::lock_guard<std::mutex> lk(s.mu);
+    out[13] = s.fatal ? 1 : 0;
+    names = static_cast<int64_t>(s.names.size());
+  }
+  out[14] = names;
+  out[15] = s.first_nan_round.load(std::memory_order_relaxed);
+}
+
+std::string HealthDescribeJson() {
+  HealthState& s = S();
+  int64_t st[16];
+  HealthStats(st);
+  std::ostringstream os;
+  os << "{\"enabled\":" << st[0] << ",\"fatal_mode\":" << st[1]
+     << ",\"audit_sample\":" << st[2]
+     << ",\"spike_factor\":" << HealthSpikeFactor()
+     << ",\"nan_total\":" << st[3] << ",\"inf_total\":" << st[4]
+     << ",\"subnormal_total\":" << st[5]
+     << ",\"collectives_observed\":" << st[6]
+     << ",\"audits_sent\":" << st[7] << ",\"audit_checks\":" << st[8]
+     << ",\"audit_mismatches\":" << st[9]
+     << ",\"last_bad_rank\":" << st[10]
+     << ",\"last_bad_round\":" << st[11] << ",\"events_total\":" << st[12]
+     << ",\"fatal_latched\":" << st[13]
+     << ",\"first_nan_round\":" << st[15]
+     << ",\"absmax\":" << Fin(LoadDouble(s.absmax_bits));
+  std::lock_guard<std::mutex> lk(s.mu);
+  os << ",\"fatal_message\":\"" << JsonEscape(s.fatal_msg)
+     << "\",\"names\":[";
+  bool first = true;
+  for (const auto& [key, n] : s.names) {
+    if (!first) os << ",";
+    first = false;
+    os << "{\"set\":" << key.first << ",\"name\":\""
+       << JsonEscape(key.second) << "\",\"count\":" << n.count << ",\"elems\":" << n.elems
+       << ",\"nan\":" << n.nan << ",\"inf\":" << n.inf
+       << ",\"subnormal\":" << n.subnormal
+       << ",\"absmax\":" << Fin(n.absmax)
+       << ",\"norm\":" << Fin(n.norm) << ",\"ewma\":" << Fin(n.ewma)
+       << ",\"last_round\":" << n.last_round
+       << ",\"first_nan_round\":" << n.first_nan_round
+       << ",\"spikes\":" << n.spikes << "}";
+  }
+  os << "],\"events\":[";
+  first = true;
+  for (const HealthEvent& e : s.events) {
+    if (!first) os << ",";
+    first = false;
+    os << "{\"kind\":\"" << KindName(e.kind) << "\",\"set\":" << e.set
+       << ",\"round\":" << e.round << ",\"rank\":" << e.rank
+       << ",\"name\":\"" << JsonEscape(e.name)
+       << "\",\"value\":" << Fin(e.value) << "}";
+  }
+  os << "]}";
+  return os.str();
+}
+
+int HealthFatalLatched() {
+  HealthState& s = S();
+  std::lock_guard<std::mutex> lk(s.mu);
+  return s.fatal ? 1 : 0;
+}
+
+std::string HealthLastError() {
+  HealthState& s = S();
+  std::lock_guard<std::mutex> lk(s.mu);
+  return s.fatal_msg;
+}
+
+}  // namespace hvdtpu

@@ -1,0 +1,188 @@
+// Numerical-health + silent-data-corruption subsystem.
+//
+// The flight recorder (trace.h) gave the framework complete TIMING
+// observability; this module watches the VALUES: a NaN burst, an exploding
+// gradient norm, or a silently flipped bit (bad DIMM, kernel bug, shm
+// stomp) propagates through every allreduce and poisons all ranks with no
+// signal until the loss graph dies hours later.  Three parts:
+//
+//  * **In-band tensor health stats** — the pack path walks every input
+//    byte and the accumulate kernels walk every reduced byte already, so
+//    folding NaN/Inf/subnormal counts, absmax, and L2-norm-squared into a
+//    per-thread accumulator is one extra streaming read pass.  Observers
+//    are READ-ONLY: results are bitwise identical with health on or off
+//    (asserted by the ring-equivalence batteries).  Per-(set, tensor-name)
+//    input stats feed the hvd_grad_* metrics; per-collective reduce-stage
+//    stats feed the first-NaN policy.  `HOROVOD_TPU_HEALTH=0` is the kill
+//    switch (default on; the bench gates the overhead at <=1% end-to-end).
+//
+//  * **Cross-rank divergence audit** — the reduced output of every
+//    allreduce is bitwise-identical across members BY CONSTRUCTION, so an
+//    opt-in sampled audit (`HOROVOD_TPU_AUDIT_SAMPLE=N`, default 0 = off)
+//    checksums every Nth collective's output and piggybacks the 64-bit
+//    digest on the next round's control frames, keyed by the deterministic
+//    (set, epoch, round) identity the flight recorder established.  The
+//    coordinator compares and, on mismatch, names the minority rank(s) —
+//    deterministic SDC attribution with ZERO extra round trips, and zero
+//    wire bytes while the audit is off (the ctrl-bytes CI gate pins this).
+//
+//  * **Anomaly engine** — a policy layer (first-NaN, norm-spike vs EWMA,
+//    checksum mismatch) that stamps a HEALTH event into the flight
+//    recorder ring, keeps a drainable event log for Python, and on opt-in
+//    fatal mode (`HOROVOD_TPU_HEALTH_FATAL=1`) latches an error the Python
+//    binding raises as NumericalHealthError — composing with
+//    hvd.elastic.run so a corrupting rank can be shrunk away.
+//
+// All state is PROCESS-WIDE (like fault.h's counters): an engine re-init
+// (sub-worlds, elastic rebuilds, tests) must never zero history mid-scrape.
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+#include "common.h"
+#include "wire.h"
+
+namespace hvdtpu {
+
+// ---------------------------------------------------------------------------
+// configuration (env, parsed once per process; hvdrun --health-* sets these)
+// ---------------------------------------------------------------------------
+
+// HOROVOD_TPU_HEALTH: in-band stats on/off (default ON; =0 kills every
+// observer so the disabled path costs one predicted branch per call site).
+bool HealthEnabled();
+
+// HOROVOD_TPU_AUDIT_SAMPLE: checksum every Nth allreduce per set (0 = off,
+// the default — audit-off jobs serialize byte-identical control frames).
+int64_t AuditSampleN();
+
+// HOROVOD_TPU_HEALTH_FATAL: anomalies latch a fatal error the Python
+// binding raises as NumericalHealthError (default off: record-only).
+bool HealthFatal();
+
+// HOROVOD_TPU_HEALTH_SPIKE_FACTOR: a per-tensor L2 norm more than F times
+// its EWMA (after a short warmup) is a norm-spike anomaly (0 = off).
+double HealthSpikeFactor();
+
+// ---------------------------------------------------------------------------
+// in-band observers
+// ---------------------------------------------------------------------------
+
+// Per-thread streaming accumulator the accumulate kernels fold into.
+struct HealthAccum {
+  int64_t elems = 0;
+  int64_t nan = 0;
+  int64_t inf = 0;
+  int64_t subnormal = 0;
+  double absmax = 0.0;
+  double sumsq = 0.0;
+  void Reset() { *this = HealthAccum{}; }
+};
+
+// Fold one buffer's stats into `a` (read-only pass; dispatched on dtype;
+// integers count no nan/inf/subnormal but still fold absmax/sumsq).
+void HealthObserveBuffer(const void* p, int64_t n, DType d, HealthAccum* a);
+
+// The executing thread's reduce-stage accumulator: Accumulate() folds the
+// freshly-reduced output range here; the engine brackets each collective
+// with ItemBegin/ItemEnd to attribute the fold to (set, round).
+#if defined(__GNUC__)
+#define HVDTPU_HEALTH_TLS __attribute__((tls_model("initial-exec")))
+#else
+#define HVDTPU_HEALTH_TLS
+#endif
+extern thread_local HVDTPU_HEALTH_TLS HealthAccum t_health_accum;
+extern thread_local HVDTPU_HEALTH_TLS bool t_health_item_open;
+
+inline void HealthAccumObserve(const void* p, int64_t n, DType d) {
+  if (t_health_item_open) HealthObserveBuffer(p, n, d, &t_health_accum);
+}
+
+void HealthItemBegin();
+// Fold the thread accumulator into the process totals and run the
+// first-NaN policy for this collective.  `label` names the collective in
+// events ("grad/w0" or "grad/w0 (+7 fused)").
+void HealthItemEnd(int set, uint32_t round, const std::string& label);
+
+// Pack-path per-entry observer: exact per-(set, name) input-gradient
+// stats (nan/inf/subnormal counts, absmax, L2 norm) plus the first-NaN
+// and EWMA norm-spike policies.  Cardinality is capped; overflow folds
+// into an "(other)" row.
+void HealthObserveEntry(int set, const std::string& name, uint32_t round,
+                        const void* p, int64_t n, DType d);
+
+// ---------------------------------------------------------------------------
+// cross-rank divergence audit
+// ---------------------------------------------------------------------------
+
+// True when collective `round` on `set` should be checksummed.  The
+// modulo runs in int64: a sample interval above UINT32_MAX must mean
+// "practically never", not a truncated-to-zero divide.
+inline bool AuditSampled(uint32_t round) {
+  int64_t n = AuditSampleN();
+  return n > 0 && static_cast<int64_t>(round) % n == 0;
+}
+
+// 64-bit streaming checksum (splitmix-style mixer over 8-byte words).
+uint64_t HealthChecksumBegin();
+uint64_t HealthChecksumFold(uint64_t h, const void* p, size_t n);
+
+// Executor side: stash this rank's digest for (set, epoch, round); the
+// negotiation thread drains it onto the next control frame for that set.
+void HealthQueueAudit(int set, uint32_t epoch, uint32_t round, uint64_t sum);
+std::vector<AuditRecord> HealthTakeAudits(int set, int my_rank);
+
+// Coordinator side: fold one member's digest into the audit table; when
+// all `expected` members reported, compare.  On mismatch the minority
+// rank(s) are named: one HealthVerdict per minority rank is appended to
+// `out`, counters/events fire, and the attribution is logged.
+void HealthFeedAudit(int set, const AuditRecord& rec, int expected,
+                     std::vector<HealthVerdict>* out);
+
+// Every member applies the broadcast verdicts (`set` is the carrying
+// frame's process set — rounds are per-set stream positions, so the
+// event identity needs it); the NAMED rank latches the fatal error
+// (fatal mode) so a corrupting rank can take itself out of an elastic
+// world.
+void HealthApplyVerdict(const HealthVerdict& v, int my_rank, int set);
+
+// Engine (re-)init: drop in-flight audit state (pending digests + the
+// coordinator table) — a fresh engine restarts epochs/rounds at 0, and a
+// previous engine's stale digest under the same key would fabricate a
+// mismatch.  Cumulative counters and the gradient table survive, like
+// the fault counters.
+void HealthResetTransient();
+
+// ---------------------------------------------------------------------------
+// export (hvd_health_stats / hvd_health_describe)
+// ---------------------------------------------------------------------------
+
+// Counted summary: {enabled, fatal_mode, audit_sample, nan_total,
+//  inf_total, subnormal_total, collectives_observed, audits_sent,
+//  audit_checks, audit_mismatches, last_bad_rank, last_bad_round,
+//  health_events, fatal_latched, grad_names_tracked, first_nan_round}.
+void HealthStats(int64_t out[16]);
+
+// Full JSON document: config, totals, per-(set, name) gradient table
+// (with EWMA), and the bounded anomaly-event log.
+std::string HealthDescribeJson();
+
+// Fatal latch for the Python binding (checked per synchronize when fatal
+// mode is on): 1 + a human message once any anomaly latched.
+int HealthFatalLatched();
+std::string HealthLastError();
+
+// Anomaly kinds (event log + TracePhase::kHealth arg low bits).
+enum class HealthEventKind : int {
+  kNan = 0,         // first NaN in a tensor's input gradient
+  kReduceNan = 1,   // first NaN observed by the accumulate kernels
+  kNormSpike = 2,   // per-tensor L2 norm spiked vs its EWMA
+  kAuditMismatch = 3,  // coordinator named minority rank(s)
+  kSdcVictim = 4,   // a verdict named THIS rank
+};
+void HealthRecordEvent(HealthEventKind kind, int set, uint32_t round,
+                       int rank, const std::string& name, double value);
+
+}  // namespace hvdtpu

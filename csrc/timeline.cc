@@ -3,6 +3,8 @@
 #include <chrono>
 #include <cstdio>
 
+#include "common.h"  // JsonEscape
+
 namespace hvdtpu {
 namespace {
 
@@ -12,28 +14,8 @@ int64_t NowUs() {
       .count();
 }
 
-// Minimal JSON string escaping for event/lane names.
-std::string JsonEscape(const std::string& s) {
-  std::string out;
-  out.reserve(s.size() + 2);
-  for (char c : s) {
-    switch (c) {
-      case '"': out += "\\\""; break;
-      case '\\': out += "\\\\"; break;
-      case '\n': out += "\\n"; break;
-      case '\t': out += "\\t"; break;
-      default:
-        if (static_cast<unsigned char>(c) < 0x20) {
-          char buf[8];
-          snprintf(buf, sizeof(buf), "\\u%04x", c);
-          out += buf;
-        } else {
-          out += c;
-        }
-    }
-  }
-  return out;
-}
+// JSON name escaping lives in common.h (shared with the health
+// describe document).
 
 }  // namespace
 

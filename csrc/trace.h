@@ -49,6 +49,8 @@ enum class TracePhase : uint8_t {
   kSignal = 10,      // fatal signal; arg = signo
   kInit = 11,        // engine init; arg = world size
   kClockProbe = 12,  // bootstrap clock probe result; arg = offset ns
+  kHealth = 13,      // numerical-health anomaly; arg = event kind,
+                     // peer = implicated rank (-1 = local observation)
 };
 
 constexpr uint8_t kTraceEnd = 0x80;  // phase | kTraceEnd = end marker

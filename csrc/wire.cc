@@ -125,9 +125,92 @@ void PutSetTag(std::string* s, int32_t set) {
   if (set != 0) PutI32(s, set);
 }
 
-int32_t ReadSetTag(Reader* rd) {
+// Health-audit trailing extension: audit digests (worker->coordinator
+// frames) and mismatch verdicts (coordinator->worker frames) ride AFTER
+// the set tag, and ONLY when non-empty — so the set tag must be written
+// explicitly (even for the global set 0) whenever a trailing block
+// follows, or the parser could not tell a set tag from a record count.
+// Empty blocks serialize nothing: audit-off jobs produce byte-for-byte
+// plain-v8 frames (the ctrl-bytes CI gate pins this).
+void PutSetTagAndAudits(std::string* s, int32_t set,
+                        const std::vector<AuditRecord>& audits) {
+  if (audits.empty()) {
+    PutSetTag(s, set);
+    return;
+  }
+  PutI32(s, set);
+  PutU32(s, static_cast<uint32_t>(audits.size()));
+  for (const AuditRecord& a : audits) {
+    PutI32(s, a.rank);
+    PutU32(s, a.epoch);
+    PutU32(s, a.round);
+    PutU64(s, a.sum);
+  }
+}
+
+int32_t ReadSetTagAndAudits(Reader* rd, std::vector<AuditRecord>* audits) {
+  audits->clear();
   if (rd->fail || rd->off >= rd->buf.size()) return 0;
-  return rd->I32();
+  int32_t set = rd->I32();
+  if (rd->fail || rd->off >= rd->buf.size()) return set;
+  uint32_t n = rd->U32();
+  // each record is 20 bytes; a count the remaining bytes cannot hold is
+  // a torn frame, flagged like every other truncation
+  if (static_cast<uint64_t>(n) * 20 > rd->buf.size() - rd->off) {
+    rd->fail = true;
+    return set;
+  }
+  audits->reserve(n);
+  for (uint32_t i = 0; i < n && !rd->fail; i++) {
+    AuditRecord a;
+    a.rank = rd->I32();
+    a.epoch = rd->U32();
+    a.round = rd->U32();
+    a.sum = rd->U64();
+    audits->push_back(a);
+  }
+  return set;
+}
+
+void PutSetTagAndVerdicts(std::string* s, int32_t set,
+                          const std::vector<HealthVerdict>& verdicts) {
+  if (verdicts.empty()) {
+    PutSetTag(s, set);
+    return;
+  }
+  PutI32(s, set);
+  PutU32(s, static_cast<uint32_t>(verdicts.size()));
+  for (const HealthVerdict& v : verdicts) {
+    PutI32(s, v.bad_rank);
+    PutU32(s, v.epoch);
+    PutU32(s, v.round);
+    PutU64(s, v.want);
+    PutU64(s, v.got);
+  }
+}
+
+int32_t ReadSetTagAndVerdicts(Reader* rd,
+                              std::vector<HealthVerdict>* verdicts) {
+  verdicts->clear();
+  if (rd->fail || rd->off >= rd->buf.size()) return 0;
+  int32_t set = rd->I32();
+  if (rd->fail || rd->off >= rd->buf.size()) return set;
+  uint32_t n = rd->U32();
+  if (static_cast<uint64_t>(n) * 28 > rd->buf.size() - rd->off) {
+    rd->fail = true;
+    return set;
+  }
+  verdicts->reserve(n);
+  for (uint32_t i = 0; i < n && !rd->fail; i++) {
+    HealthVerdict v;
+    v.bad_rank = rd->I32();
+    v.epoch = rd->U32();
+    v.round = rd->U32();
+    v.want = rd->U64();
+    v.got = rd->U64();
+    verdicts->push_back(v);
+  }
+  return set;
 }
 
 }  // namespace
@@ -161,7 +244,7 @@ std::string Serialize(const RequestList& l) {
     PutStr(&s, r.name);
     PutDims(&s, r.dims);
   }
-  PutSetTag(&s, l.process_set);
+  PutSetTagAndAudits(&s, l.process_set, l.audits);
   return s;
 }
 
@@ -185,7 +268,8 @@ Status Parse(const std::string& buf, RequestList* out) {
     if (rd.fail) return Status::Error("truncated request list");
     out->requests.push_back(std::move(r));
   }
-  out->process_set = ReadSetTag(&rd);
+  out->process_set = ReadSetTagAndAudits(&rd, &out->audits);
+  if (rd.fail) return Status::Error("truncated request-list audit block");
   for (Request& r : out->requests) r.set = out->process_set;
   return Status::OK();
 }
@@ -209,7 +293,7 @@ std::string Serialize(const ResponseList& l) {
     for (const std::string& nm : r.names) PutStr(&s, nm);
     PutDims(&s, r.first_dims);
   }
-  PutSetTag(&s, l.process_set);
+  PutSetTagAndVerdicts(&s, l.process_set, l.verdicts);
   return s;
 }
 
@@ -242,7 +326,8 @@ Status Parse(const std::string& buf, ResponseList* out) {
     if (rd.fail) return Status::Error("truncated response list");
     out->responses.push_back(std::move(r));
   }
-  out->process_set = ReadSetTag(&rd);
+  out->process_set = ReadSetTagAndVerdicts(&rd, &out->verdicts);
+  if (rd.fail) return Status::Error("truncated response-list verdicts");
   return Status::OK();
 }
 
@@ -253,7 +338,7 @@ std::string Serialize(const CacheBitsFrame& f) {
   PutU64(&s, f.epoch);
   PutI64(&s, static_cast<int64_t>(f.bits.size()));
   s.append(reinterpret_cast<const char*>(f.bits.data()), f.bits.size());
-  PutSetTag(&s, f.process_set);
+  PutSetTagAndAudits(&s, f.process_set, f.audits);
   return s;
 }
 
@@ -269,7 +354,8 @@ Status Parse(const std::string& buf, CacheBitsFrame* out) {
     return Status::Error("truncated cache-bits frame");
   out->bits.assign(buf.data() + rd.off, buf.data() + rd.off + n);
   rd.off += static_cast<size_t>(n);
-  out->process_set = ReadSetTag(&rd);
+  out->process_set = ReadSetTagAndAudits(&rd, &out->audits);
+  if (rd.fail) return Status::Error("truncated cache-bits audit block");
   return Status::OK();
 }
 
@@ -287,7 +373,7 @@ std::string Serialize(const CachedExecFrame& f) {
     PutI64(&s, static_cast<int64_t>(g.size()));
     for (uint32_t id : g) PutU32(&s, id);
   }
-  PutSetTag(&s, f.process_set);
+  PutSetTagAndVerdicts(&s, f.process_set, f.verdicts);
   return s;
 }
 
@@ -321,7 +407,8 @@ Status Parse(const std::string& buf, CachedExecFrame* out) {
     if (rd.fail) return Status::Error("truncated cached-exec frame");
     out->groups.push_back(std::move(g));
   }
-  out->process_set = ReadSetTag(&rd);
+  out->process_set = ReadSetTagAndVerdicts(&rd, &out->verdicts);
+  if (rd.fail) return Status::Error("truncated cached-exec verdicts");
   return Status::OK();
 }
 

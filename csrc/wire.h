@@ -41,6 +41,32 @@ enum class FrameType : uint16_t {
   kWorldCommit = 9,   // coordinator -> members: rebuild the data plane now
 };
 
+// Numerical-health audit record (wire v8 trailing extension): one rank's
+// 64-bit checksum of a sampled allreduce's output, keyed by the
+// deterministic (set, epoch, round) identity.  Rides AFTER the set tag on
+// worker->coordinator frames, and ONLY when the sender has sampled digests
+// pending — audit-off jobs (HOROVOD_TPU_AUDIT_SAMPLE unset, the default)
+// serialize byte-for-byte what plain v8 produced, which is what keeps the
+// steady-state ctrl-bytes CI gate pinned at ratio 1.0000.
+struct AuditRecord {
+  int32_t rank = 0;     // reporting GLOBAL rank
+  uint32_t epoch = 0;   // world epoch of the audited collective
+  uint32_t round = 0;   // per-set response-stream position
+  uint64_t sum = 0;     // 64-bit output checksum
+};
+
+// Coordinator -> members (same trailing rule, response-side frames): an
+// audit comparison failed and `bad_rank` held the minority digest — the
+// named rank latches NumericalHealthError in fatal mode so an elastic
+// world can shrink the corrupter away.
+struct HealthVerdict {
+  int32_t bad_rank = -1;  // GLOBAL rank whose output diverged
+  uint32_t epoch = 0;
+  uint32_t round = 0;
+  uint64_t want = 0;      // majority checksum
+  uint64_t got = 0;       // the minority's checksum
+};
+
 struct Request {
   int32_t rank = 0;
   OpType op = OpType::kAllreduce;
@@ -65,6 +91,9 @@ struct RequestList {
   std::vector<Request> requests;
   bool shutdown = false;
   int32_t process_set = 0;  // set tag (trailing; omitted when 0)
+  // sampled health-audit digests (trailing, after the set tag; omitted
+  // when empty — the empty case reproduces plain-v8 bytes exactly)
+  std::vector<AuditRecord> audits;
 };
 
 struct Response {
@@ -89,6 +118,9 @@ struct ResponseList {
   int64_t tuned_segment_bytes = -1;   // >=1 when the autotuner owns the knob
   int64_t tuned_wire_stripes = -1;    // >=1 when the autotuner owns the knob
   int32_t process_set = 0;            // set tag (trailing; omitted when 0)
+  // audit-mismatch attributions (trailing, after the set tag; omitted
+  // when empty — mismatch-free and audit-off jobs stay plain v8)
+  std::vector<HealthVerdict> verdicts;
 };
 
 // Steady-state claim: "every cache slot whose bit is set holds an entry
@@ -102,6 +134,9 @@ struct CacheBitsFrame {
   uint64_t epoch = 0;
   std::vector<uint8_t> bits;  // bit s => claim on cache slot s
   int32_t process_set = 0;    // set tag (trailing; omitted when 0)
+  // sampled health-audit digests (trailing; omitted when empty) — the
+  // steady state negotiates via these frames, so audits must ride them too
+  std::vector<AuditRecord> audits;
 };
 
 // "Execute cached ids": each group is a list of cache slot ids executing
@@ -117,6 +152,8 @@ struct CachedExecFrame {
   int64_t tuned_segment_bytes = -1;
   int64_t tuned_wire_stripes = -1;
   int32_t process_set = 0;  // set tag (trailing; omitted when 0)
+  // audit-mismatch attributions (trailing; omitted when empty)
+  std::vector<HealthVerdict> verdicts;
 };
 
 // Idle-tick liveness probe (fault domain): any control frame refreshes the

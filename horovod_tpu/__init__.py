@@ -25,6 +25,7 @@ import numpy as np
 from horovod_tpu.compression import Compression
 from horovod_tpu.runtime import state as _state
 from horovod_tpu.runtime.fault import WorldShrunkError
+from horovod_tpu.telemetry.health import NumericalHealthError
 from horovod_tpu.runtime.state import (
     init,
     is_initialized,
@@ -268,7 +269,8 @@ __all__ = [
     "init", "shutdown", "is_initialized",
     "rank", "size", "local_rank", "local_size", "cross_rank", "cross_size",
     "mpi_threads_supported",
-    "world_changed", "world_epoch", "WorldShrunkError", "elastic",
+    "world_changed", "world_epoch", "WorldShrunkError",
+    "NumericalHealthError", "elastic",
     "ProcessSet", "add_process_set", "global_process_set",
     "process_set_stats",
     "allreduce", "allgather", "broadcast", "alltoall", "barrier",

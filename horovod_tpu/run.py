@@ -247,6 +247,31 @@ def main(argv=None) -> int:
                          "relaunched worker re-enters at a negotiation "
                          "boundary. Rank 0 (the coordinator) is never "
                          "relaunched: its death still ends the job")
+    ap.add_argument("--health-sample", type=int, default=None, metavar="N",
+                    help="cross-rank silent-data-corruption audit: checksum "
+                         "every Nth allreduce output and compare digests "
+                         "across ranks on the coordinator (sets "
+                         "HOROVOD_TPU_AUDIT_SAMPLE; 0 = off, the default — "
+                         "audit-off jobs move zero extra wire bytes). A "
+                         "mismatch names the minority rank(s) in stderr, "
+                         "the hvd_audit_* metrics, and the post-mortem")
+    ap.add_argument("--health-fatal", action="store_true",
+                    help="fatal numerical-health mode (sets "
+                         "HOROVOD_TPU_HEALTH_FATAL=1): a first NaN, a norm "
+                         "spike past --health-spike-factor, or an SDC "
+                         "verdict naming a rank raises "
+                         "NumericalHealthError on that rank — composing "
+                         "with --min-np so an elastic world shrinks the "
+                         "corrupting host away")
+    ap.add_argument("--health-spike-factor", type=float, default=None,
+                    metavar="F",
+                    help="per-tensor L2-norm spike threshold vs its EWMA "
+                         "(sets HOROVOD_TPU_HEALTH_SPIKE_FACTOR; 0 = off, "
+                         "the default; 10 is a reasonable starting point)")
+    ap.add_argument("--no-health", action="store_true",
+                    help="disable the in-band numerical-health stats "
+                         "(sets HOROVOD_TPU_HEALTH=0); on by default at "
+                         "<=1%% end-to-end overhead")
     ap.add_argument("--grace-period", type=float,
                     default=float(os.environ.get("HOROVOD_TPU_GRACE_S", 10)),
                     metavar="S",
@@ -370,6 +395,15 @@ def main(argv=None) -> int:
             env["HOROVOD_TPU_WIRE_STRIPES"] = str(args.wire_stripes)
         if args.sg_threshold is not None:
             env["HOROVOD_TPU_SG_THRESHOLD_BYTES"] = str(args.sg_threshold)
+        if args.health_sample is not None:
+            env["HOROVOD_TPU_AUDIT_SAMPLE"] = str(args.health_sample)
+        if args.health_fatal:
+            env["HOROVOD_TPU_HEALTH_FATAL"] = "1"
+        if args.health_spike_factor is not None:
+            env["HOROVOD_TPU_HEALTH_SPIKE_FACTOR"] = str(
+                args.health_spike_factor)
+        if args.no_health:
+            env["HOROVOD_TPU_HEALTH"] = "0"
         if args.peer_timeout is not None:
             env["HOROVOD_TPU_PEER_TIMEOUT_S"] = str(args.peer_timeout)
         if args.data_timeout is not None:

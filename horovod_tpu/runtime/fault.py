@@ -21,9 +21,17 @@ Spec grammar (';'-separated specs, ':'-separated ``key=value`` fields)::
                                    deterministic per-phase straggler the
                                    flight-recorder attribution bench must find
     delay:link=0-1:ms=500          500 ms pause entering each 0<->1 transfer
+    flip:rank=2:phase=accumulate:bit=7
+                                   deterministic silent-data-corruption: flip
+                                   one bit of that rank's LOCAL copy of the
+                                   collective's reduced output (post-wire, so
+                                   the corruption does NOT propagate) — what
+                                   the cross-rank checksum audit must catch
+                                   and attribute
 
-Phases: ``negotiation`` (default), ``pack``, ``ring``, ``unpack``.
-``cycle`` and ``hit`` are synonyms: the Nth entry of that phase (1-based).
+Phases: ``negotiation`` (default), ``pack``, ``ring``, ``accumulate``,
+``unpack``.  ``cycle`` and ``hit`` are synonyms: the Nth entry of that
+phase (1-based; accumulate counts once per allreduce collective).
 """
 
 from __future__ import annotations
@@ -34,7 +42,7 @@ import os
 import re
 import signal
 
-PHASES = ("negotiation", "pack", "ring", "unpack")
+PHASES = ("negotiation", "pack", "ring", "accumulate", "unpack")
 
 PEER_TIMEOUT_ENV = "HOROVOD_TPU_PEER_TIMEOUT_S"
 HEARTBEAT_ENV = "HOROVOD_TPU_HEARTBEAT_S"
@@ -126,12 +134,13 @@ def min_np(environ=os.environ) -> int:
 class FaultSpec:
     """One parsed ``HOROVOD_TPU_FAULT_INJECT`` spec."""
 
-    kind: str                 # "kill" | "hang" | "slow" | "delay"
-    rank: int | None = None   # kill/hang/slow target
+    kind: str                 # "kill" | "hang" | "slow" | "delay" | "flip"
+    rank: int | None = None   # kill/hang/slow/flip target
     phase: str = "negotiation"
     hit: int = 1
     link: tuple[int, int] | None = None  # delay only
     ms: int = 0                          # slow/delay only
+    bit: int = 0                         # flip only: payload bit index
 
 
 def parse_inject_spec(text: str) -> list[FaultSpec]:
@@ -141,9 +150,9 @@ def parse_inject_spec(text: str) -> list[FaultSpec]:
     out: list[FaultSpec] = []
     for one in filter(None, (s.strip() for s in text.split(";"))):
         kind, _, body = one.partition(":")
-        if kind not in ("kill", "hang", "slow", "delay"):
+        if kind not in ("kill", "hang", "slow", "delay", "flip"):
             raise ValueError(f"unknown fault type {kind!r} in {one!r} "
-                             "(want kill/hang/slow/delay)")
+                             "(want kill/hang/slow/delay/flip)")
         spec = FaultSpec(kind=kind)
         for field in filter(None, body.split(":")):
             key, eq, val = field.partition("=")
@@ -161,6 +170,8 @@ def parse_inject_spec(text: str) -> list[FaultSpec]:
                 spec.hit = max(int(val), 1)
             elif key == "ms":
                 spec.ms = int(val)
+            elif key == "bit":
+                spec.bit = max(int(val), 0)
             elif key == "link":
                 m = re.fullmatch(r"(\d+)-(\d+)", val)
                 if not m:
@@ -169,7 +180,7 @@ def parse_inject_spec(text: str) -> list[FaultSpec]:
                 spec.link = (int(m.group(1)), int(m.group(2)))
             else:
                 raise ValueError(f"unknown field {key!r} in {one!r}")
-        if kind in ("kill", "hang", "slow") and spec.rank is None:
+        if kind in ("kill", "hang", "slow", "flip") and spec.rank is None:
             raise ValueError(f"{one!r} lacks rank=")
         if kind == "slow" and spec.ms <= 0:
             raise ValueError(f"{one!r} wants ms=N")
@@ -286,15 +297,21 @@ def post_mortem_line(rank: int, returncode: int | None,
                      timeline_path: str | None = None,
                      trace_dir: str | None = None) -> str:
     """One supervision report line for a rank: exit cause, last exported
-    heartbeat age, last timeline span, and the flight recorder's last
-    engine phase — 'n/a' where the job ran without that telemetry.  The
-    flight-recorder column is the one that survives SIGKILL: the black
-    box is a file-backed ring, durable at every event."""
+    heartbeat age, last timeline span, the flight recorder's last engine
+    phase, and the numerical-health verdict ("first NaN at collective
+    'grad/w0', round 1841" / "SDC audit mismatch (rank 2 named)") — 'n/a'
+    where the job ran without that telemetry.  The flight-recorder column
+    is the one that survives SIGKILL: the black box is a file-backed
+    ring, durable at every event."""
+    from horovod_tpu.telemetry.health import post_mortem_summary
+
     age = heartbeat_age_from_metrics(metrics_dir, rank)
     span = last_timeline_span(timeline_path, rank)
     phase = last_trace_phase(trace_dir, rank)
+    health = post_mortem_summary(metrics_dir, rank)
     return (f"rank {rank}: {describe_exit(returncode)}, "
             f"heartbeat_age={age if age is not None else 'n/a'}"
             f"{'s' if age is not None else ''}, "
             f"last_span={span or 'n/a'}, "
-            f"last_phase={phase or 'n/a'}")
+            f"last_phase={phase or 'n/a'}, "
+            f"health={health or 'n/a'}")

@@ -221,6 +221,15 @@ def _bind(lib):
     except AttributeError:
         pass
     try:
+        # numerical health + SDC audit; same prebuilt-.so caveat
+        lib.hvd_health_stats.argtypes = [ctypes.POINTER(ctypes.c_int64)]
+        lib.hvd_health_stats.restype = None
+        lib.hvd_health_describe.restype = ctypes.c_void_p  # manual free
+        lib.hvd_health_fatal.restype = ctypes.c_int
+        lib.hvd_health_error.restype = ctypes.c_void_p  # manual free
+    except AttributeError:
+        pass
+    try:
         # process sets (wire v8); same prebuilt-.so caveat
         lib.hvd_enqueue_set.argtypes = [
             ctypes.c_int, ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
@@ -297,6 +306,12 @@ class NativeEngine(Engine):
                 f"{topology.size}, rendezvous {host}:{port})"
             )
         self._lib = lib
+        # fatal health mode: every synchronize probes the native latch (one
+        # cheap C call) and raises NumericalHealthError once an anomaly
+        # latched; off (the default) costs nothing per op
+        env = os.environ.get("HOROVOD_TPU_HEALTH_FATAL", "").lower()
+        self._health_fatal = (env not in ("", "0", "false", "no", "off")
+                              and hasattr(lib, "hvd_health_fatal"))
         self._register_diagnostics_collector()
 
     def diagnostics(self) -> dict:
@@ -317,6 +332,7 @@ class NativeEngine(Engine):
         d.update(self._wire_stats())
         d.update(self.world_stats())
         d.update(self.trace_stats())
+        d.update(self.health_stats())
         psets = self.process_set_stats()
         d["process_sets"] = psets
         d["process_set_count"] = len(psets)
@@ -434,6 +450,79 @@ class NativeEngine(Engine):
             {k: int(vals[8 * i + j]) for j, k in enumerate(keys)}
             for i in range(max(n, 0))
         ]
+
+    # -- numerical health + SDC audit ---------------------------------------
+    _HEALTH_KEYS = (
+        "health_enabled", "health_fatal_mode", "audit_sample", "nan_total",
+        "inf_total", "subnormal_total", "health_collectives",
+        "audits_sent", "audit_checks", "audit_mismatches",
+        "audit_last_bad_rank", "audit_last_bad_round", "health_events",
+        "health_fatal_latched", "health_names", "first_nan_round")
+
+    def health_stats(self) -> dict:
+        """Numerical-health summary: in-band NaN/Inf/subnormal totals, the
+        collectives the accumulate observers folded, the sampled-audit
+        digest/check/mismatch counters, and the last SDC attribution
+        (``audit_last_bad_rank``/``_round``, -1 = none).  The counters are
+        PROCESS-wide (they survive engine re-init, like the fault
+        counters).  Zeros when the loaded .so predates health."""
+        fn = getattr(self._lib, "hvd_health_stats", None)
+        if fn is None:
+            d = dict.fromkeys(self._HEALTH_KEYS, 0)
+            d["audit_last_bad_rank"] = -1
+            d["audit_last_bad_round"] = -1
+            d["first_nan_round"] = -1
+            return d
+        vals = (ctypes.c_int64 * 16)()
+        fn(vals)
+        return {k: int(v) for k, v in zip(self._HEALTH_KEYS, vals)}
+
+    def health_describe(self) -> dict | None:
+        """The full health document: config, totals, the per-(set, name)
+        gradient table (counts, absmax, L2 norm, EWMA, first-NaN round),
+        and the bounded anomaly-event log.  None when the loaded .so
+        predates health."""
+        import json
+
+        fn = getattr(self._lib, "hvd_health_describe", None)
+        if fn is None:
+            return None
+        p = fn()
+        if not p:
+            return None
+        try:
+            return json.loads(ctypes.cast(p, ctypes.c_char_p).value.decode())
+        finally:
+            self._lib.hvd_free_cstr(p)
+
+    def _maybe_raise_health(self) -> None:
+        if not self._health_fatal or not self._lib.hvd_health_fatal():
+            return
+        p = self._lib.hvd_health_error()
+        try:
+            msg = ctypes.cast(p, ctypes.c_char_p).value.decode()
+        finally:
+            self._lib.hvd_free_cstr(p)
+        from horovod_tpu import telemetry
+        from horovod_tpu.telemetry.health import NumericalHealthError
+
+        # leave the final health picture behind for the post-mortem: the
+        # raising rank usually exits without reaching shutdown()
+        collector = getattr(self, "_diagnostics_collector", None)
+        if collector is not None:
+            try:
+                collector()
+            except Exception:
+                pass
+        telemetry.flush_dumps()
+        # the atexit shutdown must NOT run the coordinated handshake: a
+        # clean shutdown ends the WHOLE job, while this rank leaving
+        # abruptly is an ordinary rank death the fault domain already
+        # handles — elastic worlds shrink around the suspect host and
+        # keep training (the composition NumericalHealthError exists for)
+        self._health_poisoned = True
+        raise NumericalHealthError(
+            msg or "numerical health anomaly latched (fatal mode)")
 
     # -- flight recorder ----------------------------------------------------
     def trace_stats(self) -> dict:
@@ -581,7 +670,31 @@ class NativeEngine(Engine):
 
         if not telemetry.metrics_enabled():
             return
+        from horovod_tpu.telemetry import health as _health
+
         reg = telemetry.registry()
+        # hvd_build_info: a constant-1 gauge whose labels carry the package
+        # and wire versions plus the configured data-plane knobs, so an
+        # aggregated fleet dashboard spots mixed-version (or mixed-knob)
+        # jobs at a glance.  Registered once per engine with the knobs as
+        # configured at init — a second init with different knobs adds a
+        # second series, which IS the mixed-config signal.
+        try:
+            import horovod_tpu as _pkg
+
+            _ver = str(getattr(_pkg, "__version__", "?"))
+        except Exception:
+            _ver = "?"
+        _wire_fn = getattr(getattr(self, "_lib", None), "hvd_wire_version",
+                           None)
+        d0 = self.diagnostics()
+        reg.gauge(_health.BUILD_INFO, version=_ver,
+                  wire_version=str(int(_wire_fn()) if _wire_fn else 0),
+                  pipeline_depth=str(d0.get("pipeline_depth", 0)),
+                  ring_segment_bytes=str(d0.get("ring_segment_bytes", 0)),
+                  wire_stripes=str(d0.get("wire_stripes", 0)),
+                  sg_threshold_bytes=str(
+                      d0.get("sg_threshold_bytes", 0))).set(1)
         # serializes the read-then-inc: the dump thread and a direct
         # collector() call (shutdown, user snapshot) may race, and both
         # seeing the same stale value would double-count a stall
@@ -643,6 +756,37 @@ class NativeEngine(Engine):
         stage_keys = {"pack": ("pipeline_pack_ns", "pipeline_packs"),
                       "wire": ("pipeline_wire_ns", "pipeline_items"),
                       "unpack": ("pipeline_unpack_ns", "pipeline_items")}
+        # numerical-health mirror state (delta tracking per (set, name)
+        # row; health counters are process-wide like the fault counters,
+        # so a second engine seeds from the current values the same way)
+        health_seen: dict = {}
+        try:
+            health_now = self.health_stats()
+        except AttributeError:  # scripted test engines carry no _lib
+            health_now = {}
+        if health_now:
+            health_seen["totals"] = {
+                "health_collectives": health_now["health_collectives"],
+                "audits_sent": health_now["audits_sent"],
+                "audit_checks": health_now["audit_checks"],
+                "audit_mismatches": health_now["audit_mismatches"]}
+            # the per-(set, name) rows and the event log are process-wide
+            # too: seed them from the CURRENT document so a second engine
+            # init never re-mirrors the first engine's whole history
+            try:
+                desc_now = self.health_describe()
+            except AttributeError:
+                desc_now = None
+            if desc_now:
+                health_seen["names"] = {
+                    (str(row["set"]), row["name"]): {
+                        "nan": row["nan"], "inf": row["inf"],
+                        "subnormal": row["subnormal"]}
+                    for row in desc_now.get("names", [])}
+                health_seen["events"] = {
+                    (ev["kind"], ev["set"], ev["round"], ev["rank"],
+                     ev["name"])
+                    for ev in desc_now.get("events", [])}
 
         def collect(self=self, reg=reg):
             d = self.diagnostics()
@@ -730,6 +874,13 @@ class NativeEngine(Engine):
                         dns / dn / 1e9)
                     shrink_seen[0] = d["shrink_latency_ns"]
                     shrink_seen[1] = d["world_changes"]
+                if "health_collectives" in d:
+                    desc = None
+                    try:
+                        desc = self.health_describe()
+                    except Exception:
+                        desc = None
+                    _health.mirror_health(reg, d, desc or {}, health_seen)
 
         self._diagnostics_collector = collect
         reg.register_collector(collect)
@@ -880,6 +1031,10 @@ class NativeEngine(Engine):
                 raise RuntimeError(f"collective failed: {msg}")
             with self._lock:
                 direct = self._out_by_handle.get(handle)
+            # opt-in fatal health mode: a latched anomaly (first NaN, norm
+            # spike, or an SDC verdict naming this rank) surfaces HERE, on
+            # the training thread, as NumericalHealthError
+            self._maybe_raise_health()
             if direct is not None:
                 # engine already wrote the result into this buffer on its
                 # background thread
@@ -930,4 +1085,10 @@ class NativeEngine(Engine):
             collector()
             telemetry.registry().unregister_collector(collector)
             self._diagnostics_collector = None
+        if getattr(self, "_health_poisoned", False):
+            # fatal health latched on THIS rank: skip the coordinated
+            # shutdown handshake (it would end the whole job cleanly) and
+            # let the process's abrupt exit read as a rank death — the
+            # peers' fault domain aborts or elastically shrinks, by policy
+            return
         self._lib.hvd_native_shutdown()

@@ -59,6 +59,27 @@ SET_TAGGED_FRAMES = (
     "CachedExecFrame",
 )
 
+# csrc/wire.h — health-audit trailing extension (PR 10): frames carrying
+# a trailing `std::vector<AuditRecord> audits` (worker -> coordinator
+# checksum digests) or `std::vector<HealthVerdict> verdicts` (coordinator
+# -> worker SDC attributions).  Both blocks serialize ONLY when non-empty
+# and ALWAYS after the set tag, so audit-off jobs (the default) produce
+# byte-for-byte plain-v8 frames — tools/check_wire_abi.py parses the
+# struct bodies and asserts the lists AND the trailing declaration order.
+AUDIT_TAGGED_FRAMES = (
+    "RequestList",
+    "CacheBitsFrame",
+)
+VERDICT_TAGGED_FRAMES = (
+    "ResponseList",
+    "CachedExecFrame",
+)
+
+# serialized record layouts (little-endian, field order)
+AUDIT_RECORD_BYTES = 20    # i32 rank, u32 epoch, u32 round, u64 sum
+HEALTH_VERDICT_BYTES = 28  # i32 bad_rank, u32 epoch, u32 round,
+                           # u64 want, u64 got
+
 # The global process set's id (the implicit communicator every pre-v8 op
 # ran on; hvd.add_process_set assigns ids starting at 1).
 GLOBAL_PROCESS_SET = 0

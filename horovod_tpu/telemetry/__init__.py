@@ -32,6 +32,23 @@ import threading
 import time
 
 from horovod_tpu.telemetry import timeline
+from horovod_tpu.telemetry.health import (  # noqa: F401  (re-exports)
+    AUDIT_CHECKS,
+    AUDIT_LAST_BAD_RANK,
+    AUDIT_MISMATCHES,
+    AUDIT_SENT,
+    BUILD_INFO,
+    HEALTH_COLLECTIVES,
+    HEALTH_EVENTS,
+    HEALTH_FATAL,
+    HEALTH_FIRST_NAN,
+    HEALTH_GRAD_ABSMAX,
+    HEALTH_GRAD_NORM,
+    HEALTH_INF,
+    HEALTH_NAN,
+    HEALTH_SUBNORMAL,
+    NumericalHealthError,
+)
 from horovod_tpu.telemetry.registry import (  # noqa: F401  (re-exports)
     Counter,
     Gauge,
@@ -217,6 +234,20 @@ def on_init(rank: int) -> None:
 
                     print(f"[horovod_tpu.telemetry] /metrics endpoint "
                           f"disabled: {exc}", file=sys.stderr)
+
+
+def flush_dumps() -> None:
+    """Write one metrics dump NOW if the periodic dumper is running — the
+    fatal-health raise path calls this so a rank that exits on
+    NumericalHealthError leaves its final health picture for the
+    post-mortem even though it never reaches shutdown()."""
+    with _lock:
+        dumper = _dumper
+    if dumper is not None:
+        try:
+            dumper._registry.dump(dumper._dir, dumper._rank)
+        except OSError:
+            pass
 
 
 def metrics_port() -> int | None:
@@ -407,4 +438,10 @@ __all__ = [
     "NATIVE_SHRINK_LATENCY",
     "NATIVE_PROCESS_SETS", "NATIVE_PSET_COLLECTIVES", "NATIVE_PSET_BYTES",
     "NATIVE_PSET_CACHE_HITS", "NATIVE_SHM_POISONS",
+    "NumericalHealthError",
+    "HEALTH_NAN", "HEALTH_INF", "HEALTH_SUBNORMAL", "HEALTH_GRAD_NORM",
+    "HEALTH_GRAD_ABSMAX", "HEALTH_EVENTS", "HEALTH_FATAL",
+    "HEALTH_FIRST_NAN", "HEALTH_COLLECTIVES",
+    "AUDIT_SENT", "AUDIT_CHECKS", "AUDIT_MISMATCHES",
+    "AUDIT_LAST_BAD_RANK", "BUILD_INFO",
 ]

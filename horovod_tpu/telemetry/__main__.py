@@ -17,7 +17,12 @@ Subcommands:
   attribution table (per rank x phase: fraction of step critical path).
   ``-o merged.json`` additionally writes a clock-aligned merged Chrome
   trace; ``--json`` emits the attribution + counted event series as JSON
-  (what ``bench.py --trace`` and CI gate on).
+  (what ``bench.py --trace``, ``bench.py --health`` and CI gate on).
+* ``health <metrics-dir>`` — cross-rank numerical-health report over the
+  per-rank metric dumps: first-NaN per rank (collective name + round),
+  NaN/audit-mismatch totals, and the checksum audit's named suspect
+  rank(s).  ``--json`` emits the machine-readable document; pass
+  ``--trace-dir`` to also fold each rank's last flight-recorder phase in.
 
 Pure Python over JSON/binary files: runs anywhere, no native ``.so``,
 no JAX.
@@ -57,11 +62,24 @@ def main(argv: list[str] | None = None) -> int:
     ap_tr.add_argument("--json", action="store_true",
                        help="emit attribution + counted series as JSON")
 
+    ap_he = sub.add_parser(
+        "health", help="cross-rank numerical-health report over per-rank "
+                       "metric dumps (first NaN, norm spikes, SDC audit "
+                       "verdicts)")
+    ap_he.add_argument("metrics_dir")
+    ap_he.add_argument("--json", action="store_true",
+                       help="emit the machine-readable health document")
+    ap_he.add_argument("--trace-dir", default=None,
+                       help="also report each rank's last flight-recorder "
+                            "phase from its black box")
+
     args = ap.parse_args(argv)
     from horovod_tpu.telemetry import merge
 
     if args.cmd == "trace":
         return _trace_cmd(args)
+    if args.cmd == "health":
+        return _health_cmd(args)
 
     if args.cmd == "summarize":
         try:
@@ -115,6 +133,33 @@ def _trace_cmd(args) -> int:
               f"{len(merged['collectives'])} correlated collective(s)")
         print(ftrace.attribution_table(merged))
     return 0
+
+
+def _health_cmd(args) -> int:
+    import json as _json
+
+    from horovod_tpu.telemetry import health as fhealth
+
+    try:
+        doc = fhealth.health_summary(args.metrics_dir)
+    except FileNotFoundError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if args.trace_dir:
+        from horovod_tpu.runtime.fault import last_trace_phase
+
+        doc["last_phase_by_rank"] = {
+            rk: last_trace_phase(args.trace_dir, rk) or "n/a"
+            for rk in sorted(doc["ranks"])}
+    if args.json:
+        print(_json.dumps(doc, indent=1))
+    else:
+        print(fhealth.report(doc))
+        if args.trace_dir and doc.get("last_phase_by_rank"):
+            for rk, ph in sorted(doc["last_phase_by_rank"].items()):
+                print(f"  rank {rk} last recorded phase: {ph}")
+    # exit non-zero when the audit NAMED a suspect: scriptable triage
+    return 0 if not doc["suspect_ranks"] else 3
 
 
 def _merged_prometheus(metrics_dir: str) -> str:

@@ -1,0 +1,255 @@
+"""Numerical-health telemetry: metric names, the anomaly exception, the
+native-stats -> registry mirror, and the post-mortem/CLI report helpers.
+
+The detection machinery lives in the native engine (``csrc/health.{h,cc}``):
+the accumulate kernels and pack paths fold NaN/Inf/subnormal counts, absmax
+and L2-norm-squared in-band, and an opt-in sampled audit
+(``HOROVOD_TPU_AUDIT_SAMPLE=N``) checksums every Nth allreduce output and
+compares digests across ranks on the coordinator — naming the minority
+rank(s) on a mismatch with zero extra round trips.  This module is the
+Python face of that subsystem:
+
+* :class:`NumericalHealthError` — raised by the native engine binding when
+  ``HOROVOD_TPU_HEALTH_FATAL=1`` and an anomaly latched (first NaN, norm
+  spike, or an audit verdict naming this rank).  It composes with
+  ``hvd.elastic.run``: the corrupting rank raises and exits, the elastic
+  world shrinks around it, survivors keep training on healthy hosts.
+* the ``hvd_nan_total`` / ``hvd_grad_norm`` / ``hvd_audit_*`` metric
+  catalog, mirrored into the registry (and therefore /metrics and the
+  per-rank dumps) by the native engine's export-time collector with
+  ``set``/``name`` labels.
+* :func:`mirror_health` — the collector body (kept here so the scripted-
+  engine tests can drive it without a native engine).
+* :func:`health_summary` / :func:`report` — the ``python -m
+  horovod_tpu.telemetry health`` CLI over per-rank metric dumps.
+"""
+
+from __future__ import annotations
+
+# -- metric catalog (set/name-labeled where noted) --------------------------
+HEALTH_NAN = "hvd_nan_total"                  # counter {set, tensor}
+HEALTH_INF = "hvd_inf_total"                  # counter {set, tensor}
+HEALTH_SUBNORMAL = "hvd_subnormal_total"      # counter {set, tensor}
+HEALTH_GRAD_NORM = "hvd_grad_norm"            # gauge   {set, tensor}
+HEALTH_GRAD_ABSMAX = "hvd_grad_absmax"        # gauge   {set, tensor}
+HEALTH_EVENTS = "hvd_health_events_total"     # counter {kind}
+HEALTH_FATAL = "hvd_health_fatal"             # gauge: fatal latched
+HEALTH_FIRST_NAN = "hvd_health_first_nan_round"  # gauge {set, tensor}
+HEALTH_COLLECTIVES = "hvd_health_collectives_total"  # counter
+AUDIT_SENT = "hvd_audit_digests_total"        # counter
+AUDIT_CHECKS = "hvd_audit_checks_total"       # counter (coordinator)
+AUDIT_MISMATCHES = "hvd_audit_mismatches_total"  # counter (coordinator)
+AUDIT_LAST_BAD_RANK = "hvd_audit_last_bad_rank"  # gauge (-1 = none)
+BUILD_INFO = "hvd_build_info"                 # gauge 1 {version, wire, ...}
+
+HEALTH_METRICS = (
+    HEALTH_NAN, HEALTH_INF, HEALTH_SUBNORMAL, HEALTH_GRAD_NORM,
+    HEALTH_GRAD_ABSMAX, HEALTH_EVENTS, HEALTH_FATAL, HEALTH_FIRST_NAN,
+    HEALTH_COLLECTIVES, AUDIT_SENT, AUDIT_CHECKS, AUDIT_MISMATCHES,
+    AUDIT_LAST_BAD_RANK, BUILD_INFO,
+)
+
+
+class NumericalHealthError(RuntimeError):
+    """A numerical-health anomaly latched in fatal mode
+    (``HOROVOD_TPU_HEALTH_FATAL=1``): first NaN in a gradient, an L2-norm
+    spike past the EWMA threshold, or a cross-rank checksum audit that
+    named THIS rank as the diverging minority (silent data corruption).
+
+    Not retryable on the raising rank — the process should exit (or be
+    drained) so an elastic world can shrink the suspect host away; the
+    surviving ranks' collectives fail retryably (``WorldShrunkError``) and
+    resume in the re-formed world."""
+
+
+def mirror_health(reg, stats: dict, describe: dict, seen: dict) -> None:
+    """Fold one native health snapshot into the registry.
+
+    ``stats`` is the numeric summary (``NativeEngine.health_stats()``),
+    ``describe`` the JSON document (``health_describe()``), and ``seen``
+    the collector's persistent delta state — the same last-seen-counter
+    scheme every other native mirror uses, so a re-initialized engine
+    (whose PROCESS-wide health counters survive) never double-counts."""
+    totals = seen.setdefault("totals", {
+        "health_collectives": 0, "audits_sent": 0, "audit_checks": 0,
+        "audit_mismatches": 0})
+    for key, metric in (("health_collectives", HEALTH_COLLECTIVES),
+                        ("audits_sent", AUDIT_SENT),
+                        ("audit_checks", AUDIT_CHECKS),
+                        ("audit_mismatches", AUDIT_MISMATCHES)):
+        delta = stats[key] - totals.get(key, 0)
+        if delta > 0:
+            reg.counter(metric).inc(delta)
+            totals[key] = stats[key]
+    reg.gauge(HEALTH_FATAL).set(stats["health_fatal_latched"])
+    reg.gauge(AUDIT_LAST_BAD_RANK).set(stats["audit_last_bad_rank"])
+    # per-(set, name) gradient rows: counters by delta, gauges latest
+    per_name = seen.setdefault("names", {})
+    for row in describe.get("names", []):
+        # the tensor name travels as the `tensor` label (`name` would
+        # collide with the registry API's metric-name parameter)
+        labels = {"set": str(row["set"]), "tensor": row["name"]}
+        key = (labels["set"], labels["tensor"])
+        last = per_name.setdefault(key, {"nan": 0, "inf": 0,
+                                         "subnormal": 0})
+        for field, metric in (("nan", HEALTH_NAN), ("inf", HEALTH_INF),
+                              ("subnormal", HEALTH_SUBNORMAL)):
+            delta = row[field] - last[field]
+            if delta > 0:
+                reg.counter(metric, **labels).inc(delta)
+                last[field] = row[field]
+        reg.gauge(HEALTH_GRAD_NORM, **labels).set(row["norm"])
+        reg.gauge(HEALTH_GRAD_ABSMAX, **labels).set(row["absmax"])
+        if row.get("first_nan_round", -1) >= 0:
+            reg.gauge(HEALTH_FIRST_NAN, **labels).set(
+                row["first_nan_round"])
+    # per-kind event counters from the bounded log, deduped by identity
+    # (the log is a 64-deep FIFO, so extremely old entries can age out
+    # between collections; hvd_health_events_total is the ONLY event
+    # series — one anomaly, one sample, under its real kind)
+    replayed = seen.setdefault("events", set())
+    current = set()
+    for ev in describe.get("events", []):
+        key = (ev["kind"], ev["set"], ev["round"], ev["rank"], ev["name"])
+        current.add(key)
+        if key in replayed:
+            continue
+        replayed.add(key)
+        reg.counter(HEALTH_EVENTS, kind=ev["kind"]).inc()
+    # bound the dedup set: identities that aged out of the native log's
+    # 64-deep FIFO can never reappear, so only the current window needs
+    # remembering (otherwise a long-running job leaks one tuple per
+    # anomaly forever)
+    if len(replayed) > 512:
+        seen["events"] = current
+
+
+# ---------------------------------------------------------------------------
+# post-mortem + CLI report over per-rank metric dumps
+# ---------------------------------------------------------------------------
+
+def health_from_dump(dump: dict) -> dict | None:
+    """Extract the health picture from one rank's metrics dump: first-NaN
+    (name, round), audit verdict, event counts.  None when the dump holds
+    no health series (job predates health, or metrics were off)."""
+    out = {"first_nan": None, "bad_rank": None, "events": {},
+           "nan_total": 0.0, "mismatches": 0.0}
+    saw = False
+    for m in dump.get("metrics", []):
+        name = m.get("name")
+        if name == HEALTH_FIRST_NAN:
+            saw = True
+            labels = m.get("labels", {})
+            cand = (labels.get("tensor", "?"), int(m.get("value", -1)))
+            if out["first_nan"] is None or cand[1] < out["first_nan"][1]:
+                out["first_nan"] = cand
+        elif name == AUDIT_LAST_BAD_RANK:
+            saw = True
+            v = int(m.get("value", -1))
+            out["bad_rank"] = v if v >= 0 else None
+        elif name == HEALTH_EVENTS:
+            saw = True
+            kind = m.get("labels", {}).get("kind", "any")
+            out["events"][kind] = out["events"].get(kind, 0) + m["value"]
+        elif name == HEALTH_NAN:
+            saw = True
+            out["nan_total"] += m.get("value", 0)
+        elif name == AUDIT_MISMATCHES:
+            saw = True
+            out["mismatches"] += m.get("value", 0)
+    return out if saw else None
+
+
+def post_mortem_summary(metrics_dir: str | None, rank: int) -> str | None:
+    """One-phrase health verdict for hvdrun's per-rank post-mortem line:
+    the ISSUE's "rank 2: first NaN at collective grad/..., round 1841"
+    shape.  None when the job left no health telemetry."""
+    if not metrics_dir:
+        return None
+    import json
+    import os
+
+    path = os.path.join(metrics_dir, f"metrics.rank{rank}.json")
+    try:
+        with open(path) as f:
+            dump = json.load(f)
+    except (OSError, ValueError):
+        return None
+    h = health_from_dump(dump)
+    if h is None:
+        return None
+    parts = []
+    if h["first_nan"] is not None:
+        nm, rnd = h["first_nan"]
+        parts.append(f"first NaN at collective '{nm}', round {rnd}")
+    if h["mismatches"]:
+        bad = h["bad_rank"]
+        parts.append("SDC audit mismatch"
+                     + (f" (rank {bad} named)" if bad is not None else ""))
+    if not parts and h["events"]:
+        kinds = ",".join(sorted(k for k in h["events"] if k != "any"))
+        parts.append(f"anomalies: {kinds or 'recorded'}")
+    return "; ".join(parts) if parts else "clean"
+
+
+def health_summary(metrics_dir: str) -> dict:
+    """Machine-readable cross-rank health report over a dump directory
+    (the ``python -m horovod_tpu.telemetry health --json`` payload)."""
+    from horovod_tpu.telemetry.merge import load_metric_dumps
+
+    ranks = {}
+    for dump in load_metric_dumps(metrics_dir):
+        h = health_from_dump(dump)
+        if h is None:
+            continue
+        ranks[int(dump.get("rank", -1))] = {
+            "first_nan": (None if h["first_nan"] is None else
+                          {"name": h["first_nan"][0],
+                           "round": h["first_nan"][1]}),
+            "nan_total": h["nan_total"],
+            "audit_mismatches": h["mismatches"],
+            "bad_rank": h["bad_rank"],
+            "events": h["events"],
+        }
+    suspects = sorted({r["bad_rank"] for r in ranks.values()
+                       if r["bad_rank"] is not None})
+    nan_ranks = sorted(rk for rk, r in ranks.items()
+                       if r["first_nan"] is not None or r["nan_total"])
+    return {"ranks": ranks, "suspect_ranks": suspects,
+            "nan_ranks": nan_ranks,
+            "healthy": not suspects and not nan_ranks}
+
+
+def report(doc: dict) -> str:
+    """Human-readable rendering of a :func:`health_summary` document (one
+    snapshot: callers compute the doc once so the printed report and any
+    exit-code decision can never disagree)."""
+    if not doc["ranks"]:
+        return ("no health telemetry found — run with HOROVOD_TPU_METRICS"
+                "=1 (or hvdrun --metrics-dir) and HOROVOD_TPU_HEALTH on")
+    lines = ["numerical health report:"]
+    for rk in sorted(doc["ranks"]):
+        r = doc["ranks"][rk]
+        bits = []
+        if r["first_nan"]:
+            bits.append(f"first NaN at '{r['first_nan']['name']}' "
+                        f"round {r['first_nan']['round']}")
+        if r["nan_total"]:
+            bits.append(f"nan_total={r['nan_total']:g}")
+        if r["audit_mismatches"]:
+            bits.append(f"audit_mismatches={r['audit_mismatches']:g}")
+        if r["bad_rank"] is not None:
+            bits.append(f"named_bad_rank={r['bad_rank']}")
+        lines.append(f"  rank {rk}: " + ("; ".join(bits) or "clean"))
+    if doc["suspect_ranks"]:
+        lines.append(f"SUSPECT rank(s): "
+                     f"{', '.join(map(str, doc['suspect_ranks']))} — "
+                     "cross-rank checksum audit named them as diverging "
+                     "minorities (see docs/troubleshooting.md)")
+    elif doc["nan_ranks"]:
+        lines.append("NaNs observed (no SDC verdict) — likely a training "
+                     "dynamics problem, not a bad host; see "
+                     "docs/troubleshooting.md")
+    else:
+        lines.append("all ranks clean")
+    return "\n".join(lines)

@@ -195,6 +195,10 @@ class MetricsRegistry:
         self._lock = threading.Lock()
         self._metrics: dict[tuple, object] = {}
         self._collectors: list = []  # callables run before every export
+        # serializes dump(): the periodic dumper thread and an on-demand
+        # flush (the fatal-health raise path) share one pid-derived tmp
+        # name, and concurrent writers could publish a torn document
+        self._dump_lock = threading.Lock()
 
     # -- metric accessors (get-or-create) ----------------------------------
     def _get(self, cls, name: str, labels: dict[str, str], **kw):
@@ -309,6 +313,10 @@ class MetricsRegistry:
         os.makedirs(directory, exist_ok=True)
         path = os.path.join(directory, f"metrics.rank{rank}.json")
         tmp = f"{path}.{os.getpid()}.tmp"
+        with self._dump_lock:
+            return self._dump_locked(path, tmp, rank)
+
+    def _dump_locked(self, path: str, tmp: str, rank: int) -> str:
         try:
             with open(tmp, "w") as f:
                 f.write(self.to_json(rank=rank))

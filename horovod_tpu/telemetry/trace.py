@@ -44,7 +44,7 @@ PHASES = {
     0: "enqueue", 1: "negotiate", 2: "pack", 3: "wire-send",
     4: "wire-recv", 5: "accumulate", 6: "unpack", 7: "complete",
     8: "abort", 9: "world-change", 10: "signal", 11: "init",
-    12: "clock-probe",
+    12: "clock-probe", 13: "health",
 }
 PHASE_IDS = {v: k for k, v in PHASES.items()}
 

@@ -687,6 +687,25 @@ def scenario_ring_equiv():
     ]
     for h in handles:
         chunks.append(np.ascontiguousarray(hvd.synchronize(h)))
+    # 16-bit scatter-gather bait (group-phase satellite): the two big
+    # entries are 262208 bytes each — 64-byte multiples at 64-byte-aligned
+    # offsets, so HOROVOD_TPU_SG_THRESHOLD_BYTES <= 262208 wires them in
+    # place — while the odd tails push the fused total OFF the 8-element
+    # grid (per-rank chunk bases land mid-group), exactly the case the
+    # fp16 kernels' group-phase offset exists for.  bf16 always runs;
+    # fp16 joins on the same flag as its unfused rows.
+    sg16 = [(ml_dtypes.bfloat16, "rb16")]
+    if os.environ.get("HVD_TEST_RING_FP16") == "1":
+        sg16.append((np.float16, "rh16"))
+    for dt, tag in sg16:
+        handles = [
+            hvd.allreduce_async(
+                (rng.standard_normal(sz) * (r + i + 1)).astype(dt),
+                average=False, name=f"{tag}{i}")
+            for i, sz in enumerate((131104, 131104, 4099, 1001))
+        ]
+        for h in handles:
+            chunks.append(np.ascontiguousarray(hvd.synchronize(h)))
     # pairwise alltoall through the (maybe) segment-windowed exchange:
     # disjoint-offset byte movement only, so windowed vs monolithic (and
     # any stripe count) must be bitwise identical
@@ -1418,6 +1437,207 @@ def scenario_pset_elastic():
         pass  # a straggler change at the barrier is not what's under test
     hvd.shutdown()
     print(f"rank {launch_rank}: pset elastic OK", flush=True)
+
+
+def _health_stats():
+    from horovod_tpu.runtime import state as _state
+
+    return _state.engine().health_stats()
+
+
+def scenario_health_battery():
+    """In-band health stats over a steady named-gradient stream: the
+    accumulate observers count collectives, the pack-path per-entry
+    observers build the per-(set, name) gradient table (norms, absmax,
+    zero NaN on clean data), and — with HOROVOD_TPU_AUDIT_SAMPLE set by
+    the test — every rank queues digests while the coordinator's checks
+    all agree.  Per-process-set rows too: a sub-set's tensors land under
+    its own set id."""
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    ps = hvd.add_process_set([0, 1]) if n >= 2 else None
+    steps = int(os.environ.get("HVD_TEST_STEPS", "8"))
+    for step in range(steps):
+        hs = [hvd.allreduce_async(
+                  np.full(512, float(r + i + 1), np.float32),
+                  average=False, name=f"grad/w{i}")
+              for i in range(4)]
+        for h in hs:
+            hvd.synchronize(h)
+        if ps is not None and ps.included():
+            hvd.allreduce(np.full(64, float(ps.rank() + 1), np.float32),
+                          average=False, name="sub/g0", process_set=ps)
+    # flush: one more global round so every pending digest rides a frame
+    hvd.allreduce(np.ones(8, np.float32), average=False, name="flush")
+    import time
+
+    time.sleep(0.3)
+    d = _health_stats()
+    if os.environ.get("HOROVOD_TPU_HEALTH") == "0":
+        # kill switch: every observer is a dead branch — no folds, no
+        # per-name rows, no digests (results identical by construction,
+        # asserted bitwise by test_native_engine's health on/off pair)
+        assert d["health_enabled"] == 0, d
+        assert d["health_collectives"] == 0, d
+        assert d["health_names"] == 0, d
+        assert d["audits_sent"] == 0, d
+        print(f"rank {r}: health battery OK (disabled) collectives=0 "
+              f"audits=0", flush=True)
+        hvd.shutdown()
+        return
+    assert d["health_enabled"] == 1, d
+    assert d["nan_total"] == 0 and d["inf_total"] == 0, d
+    assert d["health_collectives"] >= steps, d
+    from horovod_tpu.runtime import state as _state
+
+    desc = _state.engine().health_describe()
+    # the frontend prefixes tensor names with the op (and sets with
+    # "ps<id>."), so the table keys are the wire names
+    names = {(row["set"], row["name"]): row for row in desc["names"]}
+    for i in range(4):
+        row = names.get((0, f"allreduce.grad/w{i}"))
+        assert row is not None, sorted(names)
+        assert row["count"] >= steps and row["norm"] > 0, row
+        assert row["nan"] == 0 and row["first_nan_round"] == -1, row
+    if ps is not None and ps.included():
+        row = names.get((ps.process_set_id,
+                         f"ps{ps.process_set_id}.allreduce.sub/g0"))
+        assert row is not None, sorted(names)
+        assert row["count"] >= steps - 1, row
+    if int(os.environ.get("HOROVOD_TPU_AUDIT_SAMPLE", "0")) > 0:
+        assert d["audits_sent"] >= steps, d
+        assert d["audit_mismatches"] == 0, d
+        if r == 0:
+            assert d["audit_checks"] >= steps - 1, d
+    else:
+        assert d["audits_sent"] == 0 and d["audit_checks"] == 0, d
+    print(f"rank {r}: health battery OK collectives="
+          f"{d['health_collectives']} audits={d['audits_sent']}",
+          flush=True)
+    hvd.shutdown()
+
+
+def scenario_health_flip():
+    """The SDC acceptance row: the test arms
+    ``flip:rank=V:phase=accumulate:hit=K`` with audit sampling on.  One
+    single-tensor allreduce per step means one collective per round, so
+    the flip deterministically corrupts the victim's LOCAL output of
+    round K (the accumulate hook counts once per allreduce) — and the
+    coordinator must attribute EXACTLY (victim, round K) by checksum
+    majority, a counted verdict with no timing in it."""
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    victim = int(os.environ.get("HVD_TEST_VICTIM", "2"))
+    hit = int(os.environ.get("HVD_TEST_FLIP_HIT", "5"))
+    steps = int(os.environ.get("HVD_TEST_STEPS", "12"))
+    assert steps > hit + 2
+    for step in range(steps):
+        out = hvd.allreduce(np.full(4096, float(r + 1), np.float32),
+                            average=False, name="grad/flip")
+        # every rank's output is the clean sum EXCEPT the victim's copy
+        # of the flipped round (its local corruption must not propagate)
+        if r != victim or step + 1 != hit:
+            assert np.allclose(out, n * (n + 1) / 2), (r, step, out[:4])
+    # two flush rounds: round K's digests ride later frames; by the time
+    # these complete, every comparison through round `steps` has resolved
+    for i in range(2):
+        hvd.allreduce(np.ones(8, np.float32), average=False,
+                      name=f"flush{i}")
+    d = _health_stats()
+    if r == 0:
+        assert d["audit_mismatches"] == 1, d
+        assert d["audit_last_bad_round"] == hit, d
+        # a 2-rank world has no majority (1v1 ties break by digest), so
+        # exact attribution needs n > 2 — detection is exact regardless
+        if n > 2:
+            assert d["audit_last_bad_rank"] == victim, d
+        print(f"rank 0: HEALTH_ATTR bad_rank={d['audit_last_bad_rank']} "
+              f"bad_round={d['audit_last_bad_round']} "
+              f"mismatches={d['audit_mismatches']}", flush=True)
+    # the broadcast verdict reached the victim too (non-fatal: recorded)
+    if r == victim and n > 2:
+        assert d["audit_last_bad_rank"] == victim, d
+    hvd.shutdown()
+    print(f"rank {r}: health flip OK", flush=True)
+
+
+def scenario_health_flip_unsampled():
+    """Sampling negative control: the flip lands on a round the audit
+    does NOT sample (hit % AUDIT_SAMPLE != 0), so no digest covers it and
+    no mismatch is recorded — the contrast the sample-rate bisect guide
+    keys on."""
+    hvd.init()
+    r = hvd.rank()
+    steps = int(os.environ.get("HVD_TEST_STEPS", "12"))
+    for step in range(steps):
+        hvd.allreduce(np.full(4096, float(r + 1), np.float32),
+                      average=False, name="grad/flip")
+    for i in range(2):
+        hvd.allreduce(np.ones(8, np.float32), average=False,
+                      name=f"flush{i}")
+    d = _health_stats()
+    if r == 0:
+        assert d["audit_checks"] > 0, d
+        print(f"rank 0: HEALTH_MISS mismatches={d['audit_mismatches']}",
+              flush=True)
+        assert d["audit_mismatches"] == 0, d
+    hvd.shutdown()
+    print(f"rank {r}: health flip unsampled OK", flush=True)
+
+
+def scenario_health_fatal_victim():
+    """Fatal mode composition: same deterministic flip, but with
+    HOROVOD_TPU_HEALTH_FATAL=1 the broadcast verdict latches on the
+    victim, whose next synchronize raises NumericalHealthError -> exit 9
+    (the marker the test and the elastic-shrink recipe key on)."""
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    victim = int(os.environ.get("HVD_TEST_VICTIM", "2"))
+    try:
+        for step in range(200):
+            hvd.allreduce(np.full(4096, float(r + 1), np.float32),
+                          average=False, name="grad/flip")
+    except hvd.NumericalHealthError as e:
+        assert r == victim, (r, str(e))
+        assert "silent data corruption" in str(e), str(e)
+        print(f"rank {r}: HEALTH_FATAL: {e}", flush=True)
+        sys.exit(9)
+    except RuntimeError as e:
+        # survivors: the victim's death aborts the (non-elastic) job
+        print(f"rank {r}: FAULT: {e}", flush=True)
+        sys.exit(7)
+    print(f"rank {r}: health fatal ran dry with no verdict", flush=True)
+
+
+def scenario_health_nan_fatal():
+    """First-NaN fatal policy: one rank feeds a poisoned gradient.  The
+    feeder's pack-path observer sees the input NaN (first-NaN event at
+    the exact round) and fatal mode raises NumericalHealthError on its
+    next synchronize -> exit 9.  Ranks that accumulate the poisoned
+    chunk raise too; a rank that only receives the reduced NaN in the
+    allgather phase instead fails on the feeder's death (exit 7) — the
+    test keys on the feeder's counted exit."""
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    bad_step = int(os.environ.get("HVD_TEST_NAN_STEP", "4"))
+    try:
+        for step in range(200):
+            x = np.full(1024, 1.0, np.float32)
+            if step == bad_step and r == n - 1:
+                x[13] = np.nan
+            hvd.allreduce(x, average=False, name="grad/w0")
+        print(f"rank {r}: nan fatal ran dry", flush=True)
+    except hvd.NumericalHealthError as e:
+        assert "nan" in str(e).lower(), str(e)
+        print(f"rank {r}: HEALTH_FATAL: {e}", flush=True)
+        d = _health_stats()
+        assert d["nan_total"] >= 1, d
+        if r == n - 1:  # the feeder's first-NaN round is exact
+            assert d["first_nan_round"] == bad_step + 1, d
+        sys.exit(9)
+    except RuntimeError as e:
+        print(f"rank {r}: FAULT: {e}", flush=True)
+        sys.exit(7)
 
 
 def scenario_fault_sigterm_stuck():

@@ -361,6 +361,62 @@ def test_wire_abi_v8_untouched():
     assert "version 8" in out.stdout, out.stdout
 
 
+def test_health_flip_attribution_artifact():
+    """BENCH_r14's counted SDC rows: the injected
+    ``flip:rank=V:phase=accumulate:hit=5`` must be detected (exactly one
+    audit mismatch) and attributed to exactly (victim, round 5) — a
+    checksum-majority verdict over deterministic rounds, with no timing
+    anywhere.  The sample-window series is a pure function of
+    (round, N): a flip at round 6 is caught by N in {1, 2} and missed by
+    N=4."""
+    r14 = _baseline("BENCH_r14.json")
+    for np_key, np_ in (("np2", 2), ("np4", 4)):
+        p = r14.get(np_key)
+        assert p, r14
+        assert p["detected"] is True, (np_key, p)
+        assert p["audit_mismatches"] == 1, (np_key, p)
+        assert p["bad_round"] == p["flip_hit"] == 5, (np_key, p)
+        assert p["attributed_exact"] is True, (np_key, p)
+        # every rank queued a digest for every round (sample 1)
+        assert len(p["audits_sent_per_rank"]) == np_, (np_key, p)
+        assert min(p["audits_sent_per_rank"]) >= p["steps"], (np_key, p)
+    # np4 has a 3v1 majority: the named rank is EXACTLY the victim
+    assert r14["np4"]["bad_rank"] == r14["np4"]["victim"] == 2, r14["np4"]
+    win = r14["sample_window"]
+    for key, row in win.items():
+        assert row["detected"] == row["expected_detected"], (key, row)
+    assert win["sample1"]["bad_round"] == 6, win
+    assert win["sample4"]["bad_round"] == -1, win
+
+
+def test_health_ctrl_bytes_audit_off_exact():
+    """Default mode (audit off) must move ZERO extra control-plane
+    bytes: BENCH_r14's negotiation workload with health on vs
+    HOROVOD_TPU_HEALTH=0 — the counted ctrl bytes/round ratio is exactly
+    1.0000 (audit-off frames serialize byte-for-byte plain wire v8;
+    tools/check_wire_abi.py asserts the trailing audit fields exist only
+    behind the set tag)."""
+    r14 = _baseline("BENCH_r14.json")
+    ovh = r14["health_overhead"]
+    on = ovh["health_on"]["ctrl_bytes_per_round_worker"]
+    off = ovh["health_off"]["ctrl_bytes_per_round_worker"]
+    assert on and off, ovh
+    assert ovh["ctrl_on_vs_off"] == 1.0, ovh
+    assert on == off, ovh
+
+
+def test_health_stats_overhead_gate():
+    """In-band health stats <= 1% end to end, measured where the clock is
+    deterministic: every byte rides a 200 Mbps-paced TCP link, so pacing
+    (not this 2-core box's scheduling noise) sets the step time, and the
+    extra streaming read passes must disappear into it."""
+    r14 = _baseline("BENCH_r14.json")
+    ovh = r14["health_overhead"]
+    ratio = ovh.get("paced_wall_on_vs_off")
+    assert ratio is not None, ovh
+    assert ratio <= 1.01, ovh
+
+
 def test_ring_counted_series_gate():
     """Fresh segmented ring at the BENCH_r08 workload (-np 2, shm,
     256 KB segments) vs the artifact: segments/ring and KB/ring are

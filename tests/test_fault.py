@@ -600,17 +600,22 @@ def test_hvdrun_rejects_malformed_inject_spec():
 def test_inject_spec_grammar():
     specs = fault_mod.parse_inject_spec(
         "kill:rank=2:cycle=5;hang:rank=1:phase=ring;delay:link=0-1:ms=500;"
-        "slow:rank=1:phase=pack:ms=30")
-    assert [s.kind for s in specs] == ["kill", "hang", "delay", "slow"]
+        "slow:rank=1:phase=pack:ms=30;"
+        "flip:rank=2:phase=accumulate:hit=5:bit=7")
+    assert [s.kind for s in specs] == ["kill", "hang", "delay", "slow",
+                                      "flip"]
     assert specs[0].rank == 2 and specs[0].hit == 5
     assert specs[0].phase == "negotiation"  # default
     assert specs[1].phase == "ring"
     assert specs[2].link == (0, 1) and specs[2].ms == 500
     assert specs[3].rank == 1 and specs[3].phase == "pack"
     assert specs[3].ms == 30
+    assert specs[4].phase == "accumulate" and specs[4].bit == 7
+    assert specs[4].rank == 2 and specs[4].hit == 5
     for bad in ("explode:rank=1", "kill:cycle=5", "kill:rank=1:phase=nope",
                 "delay:link=0:ms=5", "delay:link=0-1", "kill:rank",
-                "slow:rank=1:phase=pack", "slow:phase=pack:ms=5"):
+                "slow:rank=1:phase=pack", "slow:phase=pack:ms=5",
+                "flip:phase=accumulate"):
         with pytest.raises(ValueError):
             fault_mod.parse_inject_spec(bad)
 

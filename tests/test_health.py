@@ -1,0 +1,235 @@
+"""Numerical-health + silent-data-corruption subsystem tests: in-band
+stats, the sampled cross-rank checksum audit with deterministic SDC
+attribution, the fatal-mode NumericalHealthError policy, and the health
+CLI — all counted assertions (rounds and ranks, never timings)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from conftest import native_so_status
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "native_worker.py")
+
+_SO_SKIP = native_so_status()
+pytestmark = pytest.mark.skipif(_SO_SKIP is not None,
+                                reason=_SO_SKIP or "native .so ready")
+
+
+def _run(scenario: str, np_: int, timeout: float = 120.0, env=None):
+    full_env = dict(os.environ)
+    full_env.update(env or {})
+    return subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.run", "-np", str(np_),
+         sys.executable, WORKER, scenario],
+        cwd=REPO, env=full_env, capture_output=True, text=True,
+        timeout=timeout,
+    )
+
+
+def test_health_stats_battery_with_audit():
+    """Clean traffic: per-(set, name) gradient rows populate (norms > 0,
+    zero NaN), the accumulate observers count collectives, audit digests
+    flow and every coordinator comparison agrees — including a process
+    set's tensors under their own set id."""
+    res = _run("health_battery", 2, env={"HOROVOD_TPU_AUDIT_SAMPLE": "1"})
+    assert res.returncode == 0, res.stderr + res.stdout
+    for r in range(2):
+        assert f"rank {r}: health battery OK" in res.stdout
+
+
+def test_health_disabled_kill_switch():
+    """HOROVOD_TPU_HEALTH=0: every observer is a dead branch — zero
+    collectives folded, zero per-name rows, zero digests (and the audit
+    defaults off, so the wire is plain v8 bytes)."""
+    res = _run("health_battery", 2, env={"HOROVOD_TPU_HEALTH": "0"})
+    assert res.returncode == 0, res.stderr + res.stdout
+    for r in range(2):
+        assert f"rank {r}: health battery OK (disabled)" in res.stdout
+
+
+def test_flip_attribution_np4_exact():
+    """ACCEPTANCE chaos row: ``flip:rank=2:phase=accumulate`` at np4 is
+    detected within the sample window and attributed to EXACTLY rank 2 at
+    EXACTLY the armed round — a counted verdict (checksum majority 3v1),
+    not a timing one.  The victim's corrupted copy must NOT propagate:
+    every other rank's outputs stay the clean sums."""
+    res = _run("health_flip", 4, timeout=180, env={
+        "HOROVOD_TPU_AUDIT_SAMPLE": "1",
+        "HOROVOD_TPU_FAULT_INJECT":
+            "flip:rank=2:phase=accumulate:hit=5:bit=777",
+        "HVD_TEST_VICTIM": "2",
+        "HVD_TEST_FLIP_HIT": "5",
+    })
+    assert res.returncode == 0, res.stderr + res.stdout
+    assert ("rank 0: HEALTH_ATTR bad_rank=2 bad_round=5 mismatches=1"
+            in res.stdout), res.stdout
+    assert "FLIPPED output bit" in res.stderr, res.stderr[-2000:]
+    assert "silent data corruption — rank 2" in res.stderr, \
+        res.stderr[-2000:]
+    for r in range(4):
+        assert f"rank {r}: health flip OK" in res.stdout
+
+
+def test_flip_sampled_window():
+    """Sampling semantics: with AUDIT_SAMPLE=3 only rounds 3, 6, 9...
+    are checksummed, so a flip at round 5 goes undetected while one at
+    round 6 is caught — the sample-rate bisect the troubleshooting guide
+    documents."""
+    base = {"HOROVOD_TPU_AUDIT_SAMPLE": "3", "HVD_TEST_VICTIM": "1",
+            "HVD_TEST_STEPS": "12"}
+    caught = _run("health_flip", 2, timeout=180, env=dict(
+        base, HVD_TEST_FLIP_HIT="6",
+        HOROVOD_TPU_FAULT_INJECT="flip:rank=1:phase=accumulate:hit=6"))
+    # np2 has no majority: attribution is ambiguous there, but DETECTION
+    # (mismatch counted) is still exact — assert the mismatch only
+    assert caught.returncode != 0 or "mismatches=1" in caught.stdout \
+        or "audit mismatch" in caught.stderr, \
+        caught.stdout + caught.stderr[-1000:]
+    missed = _run("health_flip_unsampled", 2, timeout=180, env=dict(
+        base, HVD_TEST_FLIP_HIT="5",
+        HOROVOD_TPU_FAULT_INJECT="flip:rank=1:phase=accumulate:hit=5"))
+    assert missed.returncode == 0, missed.stderr + missed.stdout
+    assert "HEALTH_MISS mismatches=0" in missed.stdout, missed.stdout
+
+
+def test_sdc_victim_fatal_exit():
+    """Fatal mode: the broadcast verdict latches on the named rank, whose
+    next synchronize raises NumericalHealthError (exit 9) — the hook an
+    elastic supervisor uses to shrink a corrupting host away."""
+    res = _run("health_fatal_victim", 4, timeout=180, env={
+        "HOROVOD_TPU_AUDIT_SAMPLE": "1",
+        "HOROVOD_TPU_HEALTH_FATAL": "1",
+        "HOROVOD_TPU_FAULT_INJECT":
+            "flip:rank=2:phase=accumulate:hit=4",
+        "HVD_TEST_VICTIM": "2",
+        "HOROVOD_TPU_PEER_TIMEOUT_S": "8",
+        "HOROVOD_TPU_DATA_TIMEOUT_S": "4",
+    })
+    assert res.returncode != 0, res.stdout
+    assert "rank 2: HEALTH_FATAL:" in res.stdout, res.stdout
+    assert "silent data corruption" in res.stdout, res.stdout
+
+
+def test_first_nan_fatal_and_post_mortem(tmp_path):
+    """First-NaN policy end to end: the poisoned rank raises
+    NumericalHealthError at the exact round, and hvdrun's post-mortem
+    health column prints the ISSUE's "first NaN at collective ...,
+    round N" shape read from the metrics dumps."""
+    mdir = tmp_path / "metrics"
+    env = dict(os.environ)
+    env.update({
+        "HOROVOD_TPU_PEER_TIMEOUT_S": "8",
+        "HOROVOD_TPU_DATA_TIMEOUT_S": "4",
+        "HOROVOD_TPU_METRICS_INTERVAL": "5",
+    })
+    res = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.run", "-np", "2",
+         "--health-fatal", "--metrics-dir", str(mdir),
+         sys.executable, WORKER, "health_nan_fatal"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=180)
+    assert res.returncode != 0, res.stdout
+    assert "rank 1: HEALTH_FATAL:" in res.stdout, res.stdout
+    assert "first NaN" in res.stdout, res.stdout
+    # post-mortem health column (the flush-on-fatal dump feeds it)
+    assert "health=first NaN at collective 'allreduce.grad/w0', round 5" \
+        in res.stderr, res.stderr[-3000:]
+
+
+def test_health_cli_report_and_json(tmp_path):
+    """``python -m horovod_tpu.telemetry health`` over crafted per-rank
+    dumps: names the suspect rank (exit 3), reports first-NaN rows, and
+    --json emits the machine-readable document."""
+    from horovod_tpu.telemetry import health as H
+
+    def dump(rank, metrics):
+        doc = {"schema": "horovod_tpu.telemetry/1", "rank": rank,
+               "metrics": metrics}
+        (tmp_path / f"metrics.rank{rank}.json").write_text(
+            json.dumps(doc))
+
+    dump(0, [{"name": H.AUDIT_MISMATCHES, "type": "counter", "labels": {},
+              "value": 1},
+             {"name": H.AUDIT_LAST_BAD_RANK, "type": "gauge",
+              "labels": {}, "value": 2}])
+    dump(1, [{"name": H.HEALTH_NAN, "type": "counter",
+              "labels": {"set": "0", "tensor": "grad/w0"}, "value": 3},
+             {"name": H.HEALTH_FIRST_NAN, "type": "gauge",
+              "labels": {"set": "0", "tensor": "grad/w0"}, "value": 1841}])
+    dump(2, [{"name": H.AUDIT_LAST_BAD_RANK, "type": "gauge",
+              "labels": {}, "value": -1}])
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    res = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.telemetry", "health",
+         str(tmp_path)],
+        env=env, capture_output=True, text=True, timeout=60)
+    assert res.returncode == 3, res.stdout + res.stderr  # suspect named
+    assert "SUSPECT rank(s): 2" in res.stdout, res.stdout
+    assert "first NaN at 'grad/w0' round 1841" in res.stdout, res.stdout
+    res = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.telemetry", "health",
+         str(tmp_path), "--json"],
+        env=env, capture_output=True, text=True, timeout=60)
+    doc = json.loads(res.stdout)
+    assert doc["suspect_ranks"] == [2], doc
+    assert doc["ranks"]["1"]["first_nan"]["round"] == 1841 \
+        or doc["ranks"][1]["first_nan"]["round"] == 1841
+
+
+def test_health_stats_api_shape():
+    """The health C API is PROCESS-wide (valid without an engine, like
+    the fault counters): 16 well-formed values and a parseable describe
+    document."""
+    import ctypes
+
+    from horovod_tpu.runtime.native import lib_path
+
+    lib = ctypes.CDLL(lib_path())
+    lib.hvd_health_stats.argtypes = [ctypes.POINTER(ctypes.c_int64)]
+    lib.hvd_health_stats.restype = None
+    vals = (ctypes.c_int64 * 16)()
+    lib.hvd_health_stats(vals)
+    assert int(vals[0]) in (0, 1)       # enabled flag
+    assert int(vals[10]) == -1          # no audit verdict yet
+    assert int(vals[15]) == -1          # no NaN yet
+    lib.hvd_health_describe.restype = ctypes.c_void_p
+    lib.hvd_free_cstr.argtypes = [ctypes.c_void_p]
+    p = lib.hvd_health_describe()
+    try:
+        doc = json.loads(ctypes.cast(p, ctypes.c_char_p).value.decode())
+    finally:
+        lib.hvd_free_cstr(p)
+    assert doc["names"] == [] and doc["events"] == [], doc
+    assert lib.hvd_health_fatal() == 0
+
+
+@pytest.mark.slow  # elastic 4-proc chaos run
+def test_sdc_fatal_composes_with_elastic_shrink():
+    """Fatal mode + elastic membership: the corrupting rank raises
+    NumericalHealthError and exits; with elastic on, the survivors'
+    in-flight collectives fail RETRYABLY at the next negotiation
+    boundary instead of the job aborting — a loop following the
+    documented catch-WorldShrunkError recipe (elastic_loop) would keep
+    training at the shrunk size.  This scenario's plain loop exits on
+    the retryable error, so the counted signal here is the victim's
+    NumericalHealthError exit."""
+    res = _run("health_fatal_victim", 4, timeout=240, env={
+        "HOROVOD_TPU_AUDIT_SAMPLE": "1",
+        "HOROVOD_TPU_HEALTH_FATAL": "1",
+        "HOROVOD_TPU_ELASTIC": "1",
+        "HOROVOD_TPU_MIN_NP": "1",
+        "HOROVOD_TPU_FAULT_INJECT":
+            "flip:rank=2:phase=accumulate:hit=4",
+        "HVD_TEST_VICTIM": "2",
+        "HOROVOD_TPU_PEER_TIMEOUT_S": "8",
+        "HOROVOD_TPU_DATA_TIMEOUT_S": "4",
+    })
+    # the victim raised; survivors either finished the loop (retryable
+    # world-change errors are not raised by this scenario's plain loop)
+    # or failed retryably — the counted signal is the victim's exit
+    assert "rank 2: HEALTH_FATAL:" in res.stdout, res.stdout

@@ -627,6 +627,25 @@ def test_ring_segmented_bitwise_hierarchical_paced(tmp_path):
     _assert_blobs_equal(blobs, "mono", 4)
 
 
+def test_ring_equiv_bitwise_health_on_off(tmp_path):
+    """Numerical-health observers are READ-ONLY: the full ring-equivalence
+    battery — every dtype including the fp16 masked/SIMD path, fused
+    groups, scatter-gather bait — must produce BITWISE identical dumps
+    with in-band stats + audit sampling armed vs everything off.  Run
+    over TCP so the fp16 rows join (see the worker docstring)."""
+    blobs = _ring_equiv_blobs(
+        tmp_path, "ring_equiv", 2,
+        {"HOROVOD_TPU_SHM": "0", "HVD_TEST_RING_FP16": "1",
+         "HOROVOD_TPU_HEALTH": "1", "HOROVOD_TPU_AUDIT_SAMPLE": "2"},
+        [("health_on", "65536", "1")])
+    blobs.update(_ring_equiv_blobs(
+        tmp_path, "ring_equiv", 2,
+        {"HOROVOD_TPU_SHM": "0", "HVD_TEST_RING_FP16": "1",
+         "HOROVOD_TPU_HEALTH": "0"},
+        [("health_off", "65536", "1")]))
+    _assert_blobs_equal(blobs, "health_off", 2)
+
+
 def test_autotune_ring_segment_opt_in(tmp_path):
     """HOROVOD_TPU_AUTOTUNE_RING_SEGMENT=1 adds the segment size to the
     search ({64..1024} KB, CSV column included); values stay inside the

@@ -999,6 +999,161 @@ def test_pset_metric_labels_across_elastic_shrink(clean_telemetry):
 
 
 # ---------------------------------------------------------------------------
+# numerical-health metric mirror (collector-mirror pattern, no native .so)
+# ---------------------------------------------------------------------------
+
+def _health_stats_doc(**over):
+    d = {"health_enabled": 1, "health_fatal_mode": 0, "audit_sample": 0,
+         "nan_total": 0, "inf_total": 0, "subnormal_total": 0,
+         "health_collectives": 0, "audits_sent": 0, "audit_checks": 0,
+         "audit_mismatches": 0, "audit_last_bad_rank": -1,
+         "audit_last_bad_round": -1, "health_events": 0,
+         "health_fatal_latched": 0, "health_names": 0,
+         "first_nan_round": -1}
+    d.update(over)
+    return d
+
+
+def _name_row(set_, name, **over):
+    row = {"set": set_, "name": name, "count": 1, "elems": 10, "nan": 0,
+           "inf": 0, "subnormal": 0, "absmax": 1.0, "norm": 2.0,
+           "ewma": 2.0, "last_round": 1, "first_nan_round": -1,
+           "spikes": 0}
+    row.update(over)
+    return row
+
+
+def test_health_mirror_counters_and_labels(clean_telemetry):
+    """mirror_health folds native health snapshots into set/tensor-labeled
+    series: counters move by delta (re-collections never double-count),
+    gauges track the latest observation, first-NaN rounds become a
+    per-tensor gauge, and event kinds land as labeled counters."""
+    from horovod_tpu.telemetry import health as H
+
+    T.set_metrics_enabled(True)
+    reg = T.registry()
+    seen = {}
+    H.mirror_health(
+        reg,
+        _health_stats_doc(health_collectives=4, audits_sent=4,
+                          audit_checks=3, nan_total=2),
+        {"names": [_name_row(0, "grad/w0", nan=2, first_nan_round=7,
+                             norm=3.5),
+                   _name_row(1, "ps1.sub", norm=1.25)],
+         "events": [{"kind": "nan", "set": 0, "round": 7, "rank": -1,
+                     "name": "grad/w0", "value": 2}]},
+        seen)
+    assert reg.counter(H.HEALTH_NAN, set="0", tensor="grad/w0").value == 2
+    assert reg.gauge(H.HEALTH_GRAD_NORM, set="0",
+                     tensor="grad/w0").value == 3.5
+    assert reg.gauge(H.HEALTH_GRAD_NORM, set="1",
+                     tensor="ps1.sub").value == 1.25
+    assert reg.gauge(H.HEALTH_FIRST_NAN, set="0",
+                     tensor="grad/w0").value == 7
+    assert reg.counter(H.HEALTH_EVENTS, kind="nan").value == 1
+    assert reg.counter(H.HEALTH_COLLECTIVES).value == 4
+    # second collection with unchanged counters: no double counting, but
+    # gauges keep tracking the latest norm
+    H.mirror_health(
+        reg,
+        _health_stats_doc(health_collectives=4, audits_sent=4,
+                          audit_checks=3, nan_total=2),
+        {"names": [_name_row(0, "grad/w0", nan=2, first_nan_round=7,
+                             norm=9.0)],
+         "events": [{"kind": "nan", "set": 0, "round": 7, "rank": -1,
+                     "name": "grad/w0", "value": 2}]},
+        seen)
+    assert reg.counter(H.HEALTH_NAN, set="0", tensor="grad/w0").value == 2
+    assert reg.counter(H.HEALTH_EVENTS, kind="nan").value == 1
+    assert reg.gauge(H.HEALTH_GRAD_NORM, set="0",
+                     tensor="grad/w0").value == 9.0
+
+
+def test_health_labels_across_elastic_shrink(clean_telemetry):
+    """Satellite: health series across an elastic shrink mirror the PR 9
+    pset pattern — an evicted set's per-tensor rows FREEZE (no phantom
+    deltas), surviving sets keep counting under their renumbered world,
+    and the audit attribution gauge follows the latest verdict."""
+    from horovod_tpu.telemetry import health as H
+
+    T.set_metrics_enabled(True)
+    reg = T.registry()
+    seen = {}
+    # epoch 0: sets 1 and 2 both produce gradient rows
+    H.mirror_health(
+        reg, _health_stats_doc(health_collectives=10),
+        {"names": [_name_row(1, "ps1.g", nan=1, count=5),
+                   _name_row(2, "ps2.g", count=3)],
+         "events": []}, seen)
+    assert reg.counter(H.HEALTH_NAN, set="1", tensor="ps1.g").value == 1
+    # shrink: set 2's members died — its row is GONE from the describe
+    # doc; set 1 survives (renumbered) and keeps observing
+    H.mirror_health(
+        reg,
+        _health_stats_doc(health_collectives=16, audit_mismatches=1,
+                          audit_last_bad_rank=2, audit_last_bad_round=9),
+        {"names": [_name_row(1, "ps1.g", nan=3, count=9)],
+         "events": [{"kind": "audit-mismatch", "set": 0, "round": 9,
+                     "rank": 2, "name": "", "value": 0}]}, seen)
+    assert reg.counter(H.HEALTH_NAN, set="1", tensor="ps1.g").value == 3
+    assert reg.counter(H.AUDIT_MISMATCHES).value == 1
+    assert reg.gauge(H.AUDIT_LAST_BAD_RANK).value == 2
+    assert reg.counter(H.HEALTH_EVENTS, kind="audit-mismatch").value == 1
+    # the evicted set's series froze at its last value — and a further
+    # quiet collection adds no phantom deltas to anything
+    snap1 = {(m["name"], tuple(sorted(m["labels"].items()))): m["value"]
+             for m in reg.snapshot() if m["type"] == "counter"}
+    H.mirror_health(
+        reg, _health_stats_doc(health_collectives=16, audit_mismatches=1,
+                               audit_last_bad_rank=2),
+        {"names": [_name_row(1, "ps1.g", nan=3, count=9)],
+         "events": []}, seen)
+    snap2 = {(m["name"], tuple(sorted(m["labels"].items()))): m["value"]
+             for m in reg.snapshot() if m["type"] == "counter"}
+    assert snap1 == snap2
+
+
+def test_build_info_gauge_from_scripted_engine(clean_telemetry):
+    """Satellite: registering the native diagnostics collector publishes a
+    constant-1 hvd_build_info gauge labeled with the package version and
+    the configured knobs — the mixed-version-fleet tripwire."""
+    from horovod_tpu.runtime.native import NativeEngine
+    from horovod_tpu.telemetry import health as H
+
+    import horovod_tpu
+
+    T.set_metrics_enabled(True)
+
+    class Scripted(NativeEngine):
+        def __init__(self):
+            self._topology = None
+
+        def diagnostics(self):
+            return _fake_native_diag(psets=[], epoch=0, size=2)
+
+        def world_stats(self):
+            return {"world_epoch": 0, "world_size": 2, "world_rank": 0,
+                    "world_changes": 0, "rank_joins": 0,
+                    "shrink_latency_ns": 0, "elastic": 0}
+
+        def _fault_stats(self):
+            return {"heartbeat_age_s": 0.0, "peer_timeout_s": 60.0,
+                    "peer_timeouts": 0, "aborts": 0, "abort_latency_ns": 0,
+                    "heartbeats_tx": 0, "heartbeats_rx": 0}
+
+    Scripted()._register_diagnostics_collector()
+    rows = [m for m in T.registry().snapshot()
+            if m["name"] == H.BUILD_INFO]
+    assert len(rows) == 1, rows
+    labels = rows[0]["labels"]
+    assert labels["version"] == horovod_tpu.__version__, labels
+    assert rows[0]["value"] == 1
+    for key in ("wire_version", "pipeline_depth", "ring_segment_bytes",
+                "wire_stripes", "sg_threshold_bytes"):
+        assert key in labels, labels
+
+
+# ---------------------------------------------------------------------------
 # launcher flag threading
 # ---------------------------------------------------------------------------
 

@@ -63,6 +63,31 @@ def _parse_set_tagged(text: str) -> tuple[str, ...]:
     return tuple(out)
 
 
+def _parse_vector_member_frames(text: str, elem: str,
+                                member: str) -> tuple[str, ...]:
+    """Frame structs carrying a ``std::vector<elem> member`` field, in
+    declaration order — the health-audit trailing extension's carriers."""
+    out = []
+    for m in re.finditer(r"struct\s+(\w+)\s*\{(.*?)\n\};", text, re.S):
+        if re.search(r"std::vector<" + elem + r">\s+" + member + r"\b",
+                     m.group(2)):
+            out.append(m.group(1))
+    return tuple(out)
+
+
+def _trailing_after_set_tag(text: str, struct: str, member: str) -> bool:
+    """True when ``member`` is declared AFTER ``process_set`` in the
+    struct body — the serialization contract that keeps the trailing
+    audit/verdict blocks parseable (set tag first, block second)."""
+    m = re.search(r"struct\s+" + struct + r"\s*\{(.*?)\n\};", text, re.S)
+    if not m:
+        return False
+    body = m.group(1)
+    set_at = body.find("process_set")
+    mem_at = body.find(member)
+    return 0 <= set_at < mem_at
+
+
 def check(wire_h: str, common_h: str) -> list[str]:
     """All drift problems between the C++ headers' text and the Python
     mirrors; empty list = in sync."""
@@ -110,6 +135,35 @@ def check(wire_h: str, common_h: str) -> list[str]:
         problems.append(
             f"set-tagged frames: wire.h has {tagged_frames}, wire_abi.py "
             f"SET_TAGGED_FRAMES has {want_tagged}")
+
+    # health-audit trailing extension (PR 10): audit digests ride exactly
+    # the worker->coordinator frames the mirror lists, verdicts exactly
+    # the response-side ones, and both are declared AFTER the set tag so
+    # they serialize as trailing blocks — present ONLY on sampled frames
+    # (empty blocks emit zero bytes; the ctrl-bytes gate pins audit-off
+    # jobs at plain-v8 bytes)
+    audits = _parse_vector_member_frames(wire_h, "AuditRecord", "audits")
+    if audits != tuple(wire_abi.AUDIT_TAGGED_FRAMES):
+        problems.append(
+            f"audit-tagged frames: wire.h has {audits}, wire_abi.py "
+            f"AUDIT_TAGGED_FRAMES has {tuple(wire_abi.AUDIT_TAGGED_FRAMES)}")
+    verdicts = _parse_vector_member_frames(wire_h, "HealthVerdict",
+                                           "verdicts")
+    if verdicts != tuple(wire_abi.VERDICT_TAGGED_FRAMES):
+        problems.append(
+            f"verdict-tagged frames: wire.h has {verdicts}, wire_abi.py "
+            f"VERDICT_TAGGED_FRAMES has "
+            f"{tuple(wire_abi.VERDICT_TAGGED_FRAMES)}")
+    for struct in audits:
+        if not _trailing_after_set_tag(wire_h, struct, "audits"):
+            problems.append(
+                f"{struct}: `audits` must be declared after `process_set` "
+                "(trailing-block serialization order)")
+    for struct in verdicts:
+        if not _trailing_after_set_tag(wire_h, struct, "verdicts"):
+            problems.append(
+                f"{struct}: `verdicts` must be declared after "
+                "`process_set` (trailing-block serialization order)")
 
     ops = _parse_enum(common_h, "OpType")
     if ops != wire_abi.OP_TYPES:
