@@ -72,6 +72,12 @@ enum class OpType : int32_t {
   // id and broadcasts it in response-stream order, so all ranks register
   // sets at the same stream position (mesh builds synchronize on that)
   kProcessSet = 6,
+  // reduce-scatter (wire v9): phase 1 of the ring allreduce, stopped —
+  // each member keeps its own 64-byte-aligned stripe of the summed
+  // tensor instead of paying phase 2's re-replication (the ZeRO/FSDP
+  // primitive; upstream Horovod grew the same fourth entry point right
+  // after 0.15.2)
+  kReducescatter = 7,
 };
 
 struct Status {

@@ -116,8 +116,47 @@ const char* OpName(OpType op) {
     case OpType::kAllgather: return "ALLGATHER";
     case OpType::kBroadcast: return "BROADCAST";
     case OpType::kAlltoall: return "ALLTOALL";
+    case OpType::kReducescatter: return "REDUCESCATTER";
     default: return "ERROR";
   }
+}
+
+// Reduce-scatter stripe partition (wire v9) — ALSO the ring allreduce's
+// chunk partition, which is what makes hvd.reducescatter bitwise-equal to
+// "the member's own stripe of a full allreduce" by construction: the
+// reduce-scatter IS the allreduce's phase 1, stopped, over the same
+// chunks.  Stripe c of `total_bytes` over m members starts at
+// c * floor(total/m/64)*64; the uneven tail goes to the LAST member.
+// The 64-byte alignment cuts between whole elements for every dtype and
+// keeps the grouping-sensitive fp16 accumulate kernels' 8-lane grid
+// anchored identically for any (segment size, SG split).
+int64_t StripeLoBytes(int64_t total_bytes, int m, int c) {
+  if (m <= 0) return 0;
+  if (c >= m) return total_bytes;
+  if (c <= 0) return 0;
+  int64_t base = total_bytes / m / kReducescatterAlignBytes *
+                 kReducescatterAlignBytes;
+  return static_cast<int64_t>(c) * base;
+}
+
+// Grouped-allgather name unpacking: "__gag:<n>:<k>:<base>" -> (n, k,
+// base).  Returns false for ordinary names.
+bool ParseGagName(const std::string& nm, int* n, int* k, std::string* base) {
+  constexpr size_t plen = sizeof(kGroupedAllgatherPrefix) - 1;
+  if (nm.compare(0, plen, kGroupedAllgatherPrefix) != 0) return false;
+  size_t c1 = nm.find(':', plen);
+  if (c1 == std::string::npos) return false;
+  size_t c2 = nm.find(':', c1 + 1);
+  if (c2 == std::string::npos) return false;
+  *n = atoi(nm.substr(plen, c1 - plen).c_str());
+  *k = atoi(nm.substr(c1 + 1, c2 - c1 - 1).c_str());
+  *base = nm.substr(c2 + 1);
+  return *n > 0 && *k >= 0 && *k < *n;
+}
+
+bool IsGagName(const std::string& nm) {
+  return nm.compare(0, sizeof(kGroupedAllgatherPrefix) - 1,
+                    kGroupedAllgatherPrefix) == 0;
 }
 
 std::string DimsStr(const std::vector<int64_t>& dims) {
@@ -658,6 +697,14 @@ struct NegState {
   std::map<std::string, Negotiation> message_table;  // ordered: stable fuse
   std::deque<std::string> ready;        // fully-subscribed names, FIFO
   std::deque<Response> error_ready;     // validation failures to broadcast
+  // grouped allgather (wire v9): fully-subscribed "__gag:" names parked
+  // until every member of their group is ready (base -> index -> name);
+  // the group then fuses into one response
+  std::map<std::string, std::map<int, std::string>> gag_wait;
+  // groups with a validation-failed member (base -> members still owed
+  // an error): siblings drain as clean errors instead of parking forever
+  // — the no-hang contract every other cross-rank mismatch already keeps
+  std::map<std::string, int> gag_poisoned;
   ResponseCache cache;                  // this set's replicated slot table
   // this rank's claims sent (slot per name) awaiting cached execution
   std::unordered_map<std::string, int> bits_inflight;
@@ -691,6 +738,8 @@ struct NegState {
     message_table.clear();
     ready.clear();
     error_ready.clear();
+    gag_wait.clear();
+    gag_poisoned.clear();
     cache_claims.clear();
     cached_ready.clear();
     pending_invalid.clear();
@@ -765,6 +814,10 @@ struct ProcessSet {
   std::atomic<int64_t> collectives{0};
   std::atomic<int64_t> payload_bytes{0};
   std::atomic<int64_t> wire_ns{0};
+  // per-op breakdown (indexed by OpType; wire v9 telemetry: /metrics
+  // separates reducescatter vs allreduce traffic per set)
+  std::atomic<int64_t> op_collectives[8] = {};
+  std::atomic<int64_t> op_payload[8] = {};
 };
 
 class Engine {
@@ -800,6 +853,11 @@ class Engine {
   // Per-set stats rows {id, size, my set rank, collectives, payload bytes,
   // wire ns, cache hits, cache misses}; returns rows written (set 0 first).
   int ProcessSetStats(int64_t* out, int max_sets) const;
+  // Per-(set, op) rows of 4 int64s {set id, op code, collectives, payload
+  // bytes}; only ops with traffic emit a row; set 0 first.  Returns rows
+  // written.  This is what lets /metrics label hvd_pset_collectives-family
+  // counters with op= (reducescatter vs allreduce traffic separable).
+  int PsetOpStats(int64_t* out, int max_rows) const;
   int PollHandle(int handle);  // 0 pending, 1 ok, -1 error
   int WaitHandle(int handle, double timeout_s);
   HandleState* GetDone(int handle);  // valid until ReleaseHandle
@@ -1202,8 +1260,19 @@ class Engine {
   void ExecuteAllreduce(const Response& resp,
                         std::vector<TensorEntry>& entries);
   void ExecuteAllgather(const Response& resp, TensorEntry& entry);
+  // Fused allgather group (wire v9, "__gag:" names): ONE ring over the
+  // concatenated per-member blocks, then per-entry unpack.
+  void ExecuteGroupedAllgather(const Response& resp,
+                               std::vector<TensorEntry>& entries);
   void ExecuteBroadcast(const Response& resp, TensorEntry& entry);
   void ExecuteAlltoall(const Response& resp, TensorEntry& entry);
+  // Reduce-scatter (wire v9): phase 1 of the ring, stopped — the entry's
+  // handle completes with this member's own stripe.  `hier` is the
+  // algorithm captured IN STREAM ORDER by the caller (like
+  // WorkItem::hierarchical): every rank must pick the same path for the
+  // same collective even while a retune is in flight.
+  void ExecuteReducescatter(const Response& resp, TensorEntry& entry,
+                            bool hier);
   // Flat allreduce ring visits ranks in the topology descriptor's
   // host-contiguous order (ring_order_), not raw rank order: an n-rank
   // ring then crosses hosts exactly h times.  Allgather/alltoall keep
@@ -1211,12 +1280,38 @@ class Engine {
   Status RingAllreduce(const WireRegions& wr, int64_t nelems, DType dtype) {
     return RingAllreduceGroup(wr, nelems, dtype, C().ring_order);
   }
+  // Reduce-scatter rides the same loops with scatter_only=true, over the
+  // members in SET-RANK order (not the host-contiguous ring order):
+  // stripe ownership is rank-indexed, exactly like allgather's concat
+  // layout — the same precedent, and the same extra host crossings on
+  // topologies where the two orders differ.
+  Status RingReduceScatter(const WireRegions& wr, int64_t nelems,
+                           DType dtype) {
+    return RingAllreduceGroup(wr, nelems, dtype, C().members,
+                              /*scatter_only=*/true);
+  }
   Status RingAllreduceGroup(const WireRegions& wr, int64_t nelems,
-                            DType dtype, const std::vector<int>& members);
+                            DType dtype, const std::vector<int>& members,
+                            bool scatter_only = false);
   Status RingAllreduceGroupSegmented(const WireRegions& wr, int64_t nelems,
                                      DType dtype,
                                      const std::vector<int>& members,
-                                     int64_t seg_bytes);
+                                     int64_t seg_bytes,
+                                     bool scatter_only = false);
+  // Two-level reduce-scatter: intra-host ring allreduce, cross-host
+  // reduce-scatter over the local roots on the per-host stripe unions
+  // ((h-1)/h of the tensor on the slow links — HALF of hierarchical
+  // allreduce's cross-host bytes), then the root hands each local member
+  // its stripe.  Falls back to the flat set-order ring when members are
+  // not host-contiguous in set-rank order.
+  Status HierarchicalReducescatter(const WireRegions& wr, int64_t nelems,
+                                   DType dtype);
+  // Monolithic phase-1 ring over caller-supplied chunk byte bounds
+  // (size members+1, ascending): position p ends owning bounds chunk p.
+  Status RingReduceScatterBounds(char* buf,
+                                 const std::vector<int64_t>& bounds_b,
+                                 DType dtype,
+                                 const std::vector<int>& members);
   void ApplyRingSegment(int64_t bytes);
   Status HierarchicalAllreduce(const WireRegions& wr, int64_t nelems,
                                DType dtype);
@@ -1535,6 +1630,9 @@ class Engine {
   // global-set execution counters (set executors keep their own)
   std::atomic<int64_t> set0_collectives_{0};
   std::atomic<int64_t> set0_payload_bytes_{0};
+  // per-op breakdown for the global set (indexed by OpType)
+  std::atomic<int64_t> set0_op_collectives_[8] = {};
+  std::atomic<int64_t> set0_op_payload_[8] = {};
   // counters readable from the diagnostics thread
   std::atomic<int64_t> cache_hits_{0};
   std::atomic<int64_t> cache_misses_{0};
@@ -3153,9 +3251,14 @@ void Engine::ExecuteSet(ProcessSet& ps, const Response& resp,
   }
   if (entries.empty()) return;
   ps.collectives.fetch_add(1, std::memory_order_relaxed);
-  for (const TensorEntry& e : entries)
+  ps.op_collectives[static_cast<int>(resp.op) & 7].fetch_add(
+      1, std::memory_order_relaxed);
+  for (const TensorEntry& e : entries) {
     ps.payload_bytes.fetch_add(static_cast<int64_t>(e.nbytes),
                                std::memory_order_relaxed);
+    ps.op_payload[static_cast<int>(resp.op) & 7].fetch_add(
+        static_cast<int64_t>(e.nbytes), std::memory_order_relaxed);
+  }
   int64_t t0 = NowNs();
   for (const std::string& name : resp.names)
     timeline_.Start(name, OpName(resp.op));
@@ -3164,13 +3267,23 @@ void Engine::ExecuteSet(ProcessSet& ps, const Response& resp,
       ExecuteAllreduce(resp, entries);
       break;
     case OpType::kAllgather:
-      ExecuteAllgather(resp, entries[0]);
+      // keyed on the RESPONSE: a fused group stays on the grouped path
+      // even when a world change dropped some of this rank's entries
+      // (the grouped path then fails them cleanly instead of running a
+      // mismatched single-tensor ring against peers' fused one)
+      if (resp.names.size() > 1)
+        ExecuteGroupedAllgather(resp, entries);
+      else
+        ExecuteAllgather(resp, entries[0]);
       break;
     case OpType::kBroadcast:
       ExecuteBroadcast(resp, entries[0]);
       break;
     case OpType::kAlltoall:
       ExecuteAlltoall(resp, entries[0]);
+      break;
+    case OpType::kReducescatter:
+      ExecuteReducescatter(resp, entries[0], ps.comm.hierarchical);
       break;
     default:
       break;
@@ -3290,6 +3403,27 @@ int Engine::ProcessSetStats(int64_t* out, int max_sets) const {
         ps->neg.hits.load(std::memory_order_relaxed),
         ps->neg.misses.load(std::memory_order_relaxed));
   }
+  return n;
+}
+
+int Engine::PsetOpStats(int64_t* out, int max_rows) const {
+  int n = 0;
+  auto put_ops = [&](int64_t id, const std::atomic<int64_t>* coll,
+                     const std::atomic<int64_t>* bytes) {
+    for (int op = 0; op < 8; op++) {
+      int64_t c = coll[op].load(std::memory_order_relaxed);
+      if (c == 0 || n >= max_rows) continue;
+      int64_t* p = out + 4 * n++;
+      p[0] = id;
+      p[1] = op;
+      p[2] = c;
+      p[3] = bytes[op].load(std::memory_order_relaxed);
+    }
+  };
+  put_ops(0, set0_op_collectives_, set0_op_payload_);
+  std::lock_guard<std::mutex> lk(psets_mu_);
+  for (const auto& [id, ps] : psets_)
+    put_ops(id, ps->op_collectives, ps->op_payload);
   return n;
 }
 
@@ -3807,7 +3941,11 @@ void Engine::AdoptTuned(int64_t fusion, int64_t cycle_us, int64_t hier,
 void Engine::SplitRequests(NegState& ns, std::vector<Request>& reqs,
                            RequestList* full, std::vector<int>* claims) {
   for (Request& r : reqs) {
-    if (ns.cache.enabled() && r.op != OpType::kProcessSet) {
+    // grouped-allgather members always take the full path: the fused
+    // response's name-major first_dims cannot round-trip through per-name
+    // cache entries, and the group must re-fuse as one response each time
+    if (ns.cache.enabled() && r.op != OpType::kProcessSet &&
+        !IsGagName(r.name)) {
       int s = ns.cache.Lookup(r);
       if (s >= 0) {
         cache_hits_.fetch_add(1, std::memory_order_relaxed);
@@ -3856,9 +3994,11 @@ void Engine::ApplyCacheMutations(
       continue;
     }
     if (r.op != OpType::kAllreduce && r.op != OpType::kAllgather &&
-        r.op != OpType::kBroadcast && r.op != OpType::kAlltoall)
+        r.op != OpType::kBroadcast && r.op != OpType::kAlltoall &&
+        r.op != OpType::kReducescatter)
       continue;
     for (const std::string& nm : r.names) {
+      if (IsGagName(nm)) continue;  // never cached (see SplitRequests)
       auto it = snap.find(nm);
       bool local = it != snap.end();
       // a rank with no live tensor-table entry (caller released early)
@@ -4622,7 +4762,9 @@ void Engine::HandleArrivedRequests(NegState& ns, const RequestList& list,
                    q.root_rank != first.root_rank) {
           err = "broadcast root mismatch: " + std::to_string(first.root_rank) +
                 " vs " + std::to_string(q.root_rank);
-        } else if (q.op == OpType::kAllreduce && q.dims != first.dims) {
+        } else if ((q.op == OpType::kAllreduce ||
+                    q.op == OpType::kReducescatter) &&
+                   q.dims != first.dims) {
           err = "shape mismatch: rank " + std::to_string(first.rank) + " has " +
                 DimsStr(first.dims) + ", rank " + std::to_string(q.rank) +
                 " has " + DimsStr(q.dims);
@@ -4653,6 +4795,40 @@ void Engine::HandleArrivedRequests(NegState& ns, const RequestList& list,
         resp.names = {first.name};
         resp.error_message = "op '" + first.name + "': " + err;
         ns.error_ready.push_back(std::move(resp));
+        // a failed grouped-allgather member poisons its WHOLE group:
+        // siblings (parked or still arriving) drain as clean errors
+        // instead of waiting forever on a fuse that can never happen
+        int gn = 0, gk = 0;
+        std::string gbase;
+        if (first.op == OpType::kAllgather &&
+            ParseGagName(first.name, &gn, &gk, &gbase)) {
+          auto pit = ns.gag_poisoned.find(gbase);
+          if (pit != ns.gag_poisoned.end()) {
+            // a LATER member of an already-poisoned group failing its
+            // own validation resolves one owed sibling error —
+            // overwriting the count would poison the base name's next
+            // (retried) group
+            if (--pit->second <= 0) ns.gag_poisoned.erase(pit);
+          } else {
+            int remaining = gn - 1;
+            auto w = ns.gag_wait.find(gbase);
+            if (w != ns.gag_wait.end()) {
+              for (auto& [k2, nm2] : w->second) {
+                Response e2;
+                e2.op = OpType::kError;
+                e2.names = {nm2};
+                e2.error_message =
+                    "grouped allgather sibling '" + first.name +
+                    "' failed: " + err;
+                ns.error_ready.push_back(std::move(e2));
+                ns.message_table.erase(nm2);
+                remaining--;
+              }
+              ns.gag_wait.erase(w);
+            }
+            if (remaining > 0) ns.gag_poisoned[gbase] = remaining;
+          }
+        }
         ns.message_table.erase(r.name);
       } else {
         ns.ready.push_back(r.name);
@@ -4672,6 +4848,53 @@ void Engine::FuseReady(NegState& ns, ResponseList* out) {
     auto it = ns.message_table.find(name);
     if (it == ns.message_table.end()) continue;
     const Request& first = it->second.received.front();
+    // grouped allgather (wire v9): "__gag:<n>:<k>:<base>" names park in
+    // gag_wait until all n group members are fully subscribed, then fuse
+    // into ONE response (names in index order, first_dims flattened
+    // name-major) — one negotiated round, one ring for the whole group
+    {
+      int gn = 0, gk = 0;
+      std::string gbase;
+      if (first.op == OpType::kAllgather &&
+          ParseGagName(name, &gn, &gk, &gbase)) {
+        auto poisoned = ns.gag_poisoned.find(gbase);
+        if (poisoned != ns.gag_poisoned.end()) {
+          // a sibling failed validation: this member errors cleanly too
+          Response e2;
+          e2.op = OpType::kError;
+          e2.names = {name};
+          e2.error_message = "grouped allgather '" + gbase +
+                             "': a sibling op failed cross-rank "
+                             "validation — the group cannot fuse";
+          out->responses.push_back(std::move(e2));
+          ns.message_table.erase(name);
+          if (--poisoned->second <= 0) ns.gag_poisoned.erase(poisoned);
+          continue;
+        }
+        auto& wait = ns.gag_wait[gbase];
+        wait[gk] = name;  // message_table entry stays until the group fuses
+        if (static_cast<int>(wait.size()) < gn) continue;
+        Response gresp;
+        gresp.op = OpType::kAllgather;
+        std::vector<int64_t> fd;
+        fd.reserve(static_cast<size_t>(gn) * ns.expected());
+        for (auto& [k2, nm2] : wait) {  // std::map: index order
+          auto git = ns.message_table.find(nm2);
+          if (git == ns.message_table.end()) continue;  // defensive
+          gresp.names.push_back(nm2);
+          std::vector<int64_t> f(ns.expected(), 0);
+          for (const Request& q : git->second.received)
+            f[ns.IndexOf(q.rank)] = q.dims.empty() ? 1 : q.dims[0];
+          fd.insert(fd.end(), f.begin(), f.end());
+        }
+        for (const std::string& nm2 : gresp.names)
+          ns.message_table.erase(nm2);
+        ns.gag_wait.erase(gbase);
+        gresp.first_dims = std::move(fd);
+        out->responses.push_back(std::move(gresp));
+        continue;
+      }
+    }
     Response resp;
     resp.op = first.op;
     resp.names = {name};
@@ -4681,6 +4904,20 @@ void Engine::FuseReady(NegState& ns, ResponseList* out) {
       std::vector<int64_t> fd(ns.expected(), 0);
       for (const Request& q : it->second.received)
         fd[ns.IndexOf(q.rank)] = q.dims.empty() ? 1 : q.dims[0];
+      resp.first_dims = std::move(fd);
+    }
+    if (first.op == OpType::kReducescatter) {
+      // per-member stripe ELEMENT counts in set-rank order — the
+      // displacements of the 64-byte-aligned partition ("like
+      // allgather's" first_dims, wire v9)
+      int64_t esz = static_cast<int64_t>(DTypeSize(first.dtype));
+      int64_t total_b = NumElems(first.dims) * esz;
+      int mcount = ns.expected();
+      std::vector<int64_t> fd(static_cast<size_t>(mcount), 0);
+      for (int i = 0; i < mcount; i++)
+        fd[static_cast<size_t>(i)] =
+            (StripeLoBytes(total_b, mcount, i + 1) -
+             StripeLoBytes(total_b, mcount, i)) / esz;
       resp.first_dims = std::move(fd);
     }
     if (first.op == OpType::kProcessSet) {
@@ -5057,6 +5294,8 @@ void Engine::Dispatch(const Response& resp) {
   }
   if (resp.op != OpType::kError) {
     set0_collectives_.fetch_add(1, std::memory_order_relaxed);
+    set0_op_collectives_[static_cast<int>(resp.op) & 7].fetch_add(
+        1, std::memory_order_relaxed);
     // flight recorder: the negotiated round's identity is this stream
     // position — every rank dispatches the same responses in the same
     // order, so (set 0, epoch, round) correlates across ranks for free
@@ -5134,6 +5373,8 @@ void Engine::PipelineDispatch(const Response& resp) {
     cycle_bytes_ += static_cast<int64_t>(e.nbytes);
     set0_payload_bytes_.fetch_add(static_cast<int64_t>(e.nbytes),
                                   std::memory_order_relaxed);
+    set0_op_payload_[static_cast<int>(resp.op) & 7].fetch_add(
+        static_cast<int64_t>(e.nbytes), std::memory_order_relaxed);
   }
   // captured HERE, in response-stream order, not read by the executor at
   // run time: knob adoption happens at the same stream position on every
@@ -5538,9 +5779,12 @@ void Engine::RunWire(WorkItem& item) {
     }
     case OpType::kAllgather:
       timeline_.PipelineStart(-1, "WIRE");
-      ExecuteAllgather(resp, item.entries[0]);
+      if (resp.names.size() > 1)
+        ExecuteGroupedAllgather(resp, item.entries);
+      else
+        ExecuteAllgather(resp, item.entries[0]);
       timeline_.PipelineEnd(-1);
-      timeline_.End(item.entries[0].req.name);
+      for (auto& e : item.entries) timeline_.End(e.req.name);
       break;
     case OpType::kBroadcast:
       timeline_.PipelineStart(-1, "WIRE");
@@ -5551,6 +5795,12 @@ void Engine::RunWire(WorkItem& item) {
     case OpType::kAlltoall:
       timeline_.PipelineStart(-1, "WIRE");
       ExecuteAlltoall(resp, item.entries[0]);
+      timeline_.PipelineEnd(-1);
+      timeline_.End(item.entries[0].req.name);
+      break;
+    case OpType::kReducescatter:
+      timeline_.PipelineStart(-1, "WIRE");
+      ExecuteReducescatter(resp, item.entries[0], item.hierarchical);
       timeline_.PipelineEnd(-1);
       timeline_.End(item.entries[0].req.name);
       break;
@@ -5604,6 +5854,8 @@ void Engine::Execute(const Response& resp) {
     cycle_bytes_ += static_cast<int64_t>(e.nbytes);
     set0_payload_bytes_.fetch_add(static_cast<int64_t>(e.nbytes),
                                   std::memory_order_relaxed);
+    set0_op_payload_[static_cast<int>(resp.op) & 7].fetch_add(
+        static_cast<int64_t>(e.nbytes), std::memory_order_relaxed);
   }
   // inline data plane: this thread owns the links; apply the current cap
   SetLinksActiveStripes(wire_stripes_active_.load(std::memory_order_relaxed));
@@ -5614,13 +5866,27 @@ void Engine::Execute(const Response& resp) {
       ExecuteAllreduce(resp, entries);
       break;
     case OpType::kAllgather:
-      ExecuteAllgather(resp, entries[0]);
+      // keyed on the RESPONSE: a fused group stays on the grouped path
+      // even when a world change dropped some of this rank's entries
+      // (the grouped path then fails them cleanly instead of running a
+      // mismatched single-tensor ring against peers' fused one)
+      if (resp.names.size() > 1)
+        ExecuteGroupedAllgather(resp, entries);
+      else
+        ExecuteAllgather(resp, entries[0]);
       break;
     case OpType::kBroadcast:
       ExecuteBroadcast(resp, entries[0]);
       break;
     case OpType::kAlltoall:
       ExecuteAlltoall(resp, entries[0]);
+      break;
+    case OpType::kReducescatter:
+      // inline path: the bg thread IS the stream, so the live flag is
+      // the stream-ordered capture
+      ExecuteReducescatter(resp, entries[0],
+                           C().set_id == 0 ? hierarchical_allreduce_.load()
+                                           : C().hierarchical);
       break;
     default:
       break;
@@ -6232,7 +6498,8 @@ Status Engine::PeerSendRecvReduce(int r_send, const void* send_buf,
 
 Status Engine::RingAllreduceGroup(const WireRegions& wr, int64_t nelems,
                                   DType dtype,
-                                  const std::vector<int>& members) {
+                                  const std::vector<int>& members,
+                                  bool scatter_only) {
   int m = static_cast<int>(members.size());
   if (m <= 1 || nelems <= 0) return Status::OK();
   // chaos hook: "kill:rank=R:phase=ring" fires here — the survivors'
@@ -6245,12 +6512,15 @@ Status Engine::RingAllreduceGroup(const WireRegions& wr, int64_t nelems,
   // concurrent retune-to-0 race
   if (seg <= 0 && !wr.single() && !wr.parts.empty()) seg = 256 << 10;
   if (seg > 0)
-    return RingAllreduceGroupSegmented(wr, nelems, dtype, members, seg);
+    return RingAllreduceGroupSegmented(wr, nelems, dtype, members, seg,
+                                       scatter_only);
   // HOROVOD_TPU_RING_SEGMENT_BYTES=0: the historical monolithic ring —
   // one whole-chunk duplex exchange per step, barriering on each
   // (bisection knob, and the reference the segmented loop must match
   // bitwise).  Wall/idle time still feeds the ring counters so
-  // hvd_ring_wire_idle_fraction compares the two modes.
+  // hvd_ring_wire_idle_fraction compares the two modes.  Chunk schedule
+  // matches SegGeom: stripe-aligned chunks, shifted so position c owns
+  // chunk c after phase 1 (what lets reduce-scatter stop there).
   char* buf = wr.base();
   ring_runs_mono_.fetch_add(1, std::memory_order_relaxed);
   int me = static_cast<int>(
@@ -6259,14 +6529,17 @@ Status Engine::RingAllreduceGroup(const WireRegions& wr, int64_t nelems,
   size_t esize = DTypeSize(dtype);
   int right = members[(me + 1) % m];
   int left = members[(me + m - 1) % m];
-  auto chunk_lo = [&](int c) { return nelems * c / m; };
+  auto chunk_lo = [&](int c) {
+    return StripeLoBytes(nelems * static_cast<int64_t>(esize), m, c) /
+           static_cast<int64_t>(esize);
+  };
 
   int64_t idle = 0, t0 = NowNs();
   C().ring_idle_sink = &idle;
   Status result;
   for (int step = 0; step < m - 1 && result.ok(); step++) {
-    int send_c = (me - step + 2 * m) % m;
-    int recv_c = (me - step - 1 + 2 * m) % m;
+    int send_c = (me - step - 1 + 2 * m) % m;
+    int recv_c = (me - step - 2 + 2 * m) % m;
     int64_t s_lo = chunk_lo(send_c), s_hi = chunk_lo(send_c + 1);
     int64_t r_lo = chunk_lo(recv_c), r_hi = chunk_lo(recv_c + 1);
     TraceEmit(TracePhase::kWireSend, (s_hi - s_lo) * esize, right, 0, step);
@@ -6278,9 +6551,9 @@ Status Engine::RingAllreduceGroup(const WireRegions& wr, int64_t nelems,
     if (!st.ok())
       result = Status::Error("ring allreduce failed: " + st.message);
   }
-  for (int step = 0; step < m - 1 && result.ok(); step++) {
-    int send_c = (me + 1 - step + 2 * m) % m;
-    int recv_c = (me - step + 2 * m) % m;
+  for (int step = 0; step < m - 1 && result.ok() && !scatter_only; step++) {
+    int send_c = (me - step + 2 * m) % m;
+    int recv_c = (me - step - 1 + 2 * m) % m;
     int64_t s_lo = chunk_lo(send_c), s_hi = chunk_lo(send_c + 1);
     int64_t r_lo = chunk_lo(recv_c), r_hi = chunk_lo(recv_c + 1);
     TraceEmit(TracePhase::kWireSend, (s_hi - s_lo) * esize, right, 0,
@@ -6300,24 +6573,32 @@ Status Engine::RingAllreduceGroup(const WireRegions& wr, int64_t nelems,
 }
 
 namespace {
-// Work-unit geometry for the segmented ring.  chunk c = elements
-// [nelems*c/m, nelems*(c+1)/m); chunks differ by at most one element, so
-// segmentation is derived per chunk.  Global step t runs 0..2m-3: t <
-// m-1 is the reduce-scatter phase, the rest the allgather phase.  The
-// chunk SENT at step t is exactly the chunk RECEIVED at step t-1 (both
-// phases), so "send unit (t,s) is eligible once recv unit (t-1,s)
-// landed" needs no chunk translation: a segment index means the same
-// byte range on both sides of the dependency.
+// Work-unit geometry for the segmented ring.  chunk c = the 64-byte-
+// aligned reduce-scatter stripe c (StripeLoBytes; uneven tail to the last
+// chunk), so ring position c OWNS chunk c when phase 1 ends and
+// hvd.reducescatter is literally this loop stopped at step m-2 — the
+// chunk schedule is shifted one position against the classic formulation
+// (send (me - t - 1) instead of (me - t)) to land ownership there, which
+// relabels WHO starts each chunk's accumulate chain but keeps both
+// phases' streaming structure and byte counts identical.  Global step t
+// runs 0..2m-3: t < m-1 is the reduce-scatter phase, the rest the
+// allgather phase.  The chunk SENT at step t is exactly the chunk
+// RECEIVED at step t-1 (both phases), so "send unit (t,s) is eligible
+// once recv unit (t-1,s) landed" needs no chunk translation: a segment
+// index means the same byte range on both sides of the dependency.
 struct SegGeom {
   int64_t nelems;
   int m;
   int me;
   int64_t seg_elems;
-  int64_t chunk_lo(int c) const { return nelems * c / m; }
+  int64_t esize;
+  int64_t chunk_lo(int c) const {
+    return StripeLoBytes(nelems * esize, m, c) / esize;
+  }
   // One expression covers both phases: reduce-scatter step t sends
-  // (me - t), and allgather step k sends (me + 1 - k) = (me - t + m)
+  // (me - t - 1), and allgather step k sends (me - k) = (me - t + m - 1)
   // for t = k + m - 1 — congruent mod m.
-  int send_chunk(int t) const { return ((me - t) % m + 2 * m) % m; }
+  int send_chunk(int t) const { return ((me - t - 1) % m + 2 * m) % m; }
   int recv_chunk(int t) const { return send_chunk(t + 1); }
   int64_t segs(int c) const {
     int64_t len = chunk_lo(c + 1) - chunk_lo(c);
@@ -6365,7 +6646,8 @@ struct SegGeom {
 Status Engine::RingAllreduceGroupSegmented(const WireRegions& wr,
                                            int64_t nelems, DType dtype,
                                            const std::vector<int>& members,
-                                           int64_t seg_bytes) {
+                                           int64_t seg_bytes,
+                                           bool scatter_only) {
   int m = static_cast<int>(members.size());
   int me = static_cast<int>(
       std::find(members.begin(), members.end(), rank_) - members.begin());
@@ -6376,8 +6658,11 @@ Status Engine::RingAllreduceGroupSegmented(const WireRegions& wr,
   FaultInjector::Get().OnLink(right);
   if (left != right) FaultInjector::Get().OnLink(left);
   SegGeom g{nelems, m, me,
-            std::max<int64_t>(1, seg_bytes / static_cast<int64_t>(esize))};
-  const int last_step = 2 * m - 3;
+            std::max<int64_t>(1, seg_bytes / static_cast<int64_t>(esize)),
+            static_cast<int64_t>(esize)};
+  // reduce-scatter (wire v9) is this exact loop stopped at the end of
+  // phase 1: position p then owns fully-reduced chunk p — its stripe
+  const int last_step = scatter_only ? m - 2 : 2 * m - 3;
 
   Comm& c = C();
   ShmRing* tx = right < static_cast<int>(c.shm_tx->size())
@@ -6404,8 +6689,9 @@ Status Engine::RingAllreduceGroupSegmented(const WireRegions& wr,
 
   // reduce-scatter receives stage one segment before its single
   // accumulate (bounded scratch; segment boundaries are element-aligned
-  // so no cross-pop element carry is ever needed)
-  int64_t max_chunk = (nelems + m - 1) / m;
+  // so no cross-pop element carry is ever needed).  The LAST chunk is the
+  // largest under the aligned partition (it absorbs the tail).
+  int64_t max_chunk = nelems - g.chunk_lo(m - 1);
   size_t seg_cap = static_cast<size_t>(
                        std::min<int64_t>(g.seg_elems, max_chunk)) * esize;
   if (scratch_vec.size() < seg_cap) scratch_vec.resize(seg_cap);
@@ -7089,6 +7375,259 @@ void Engine::ExecuteAllgather(const Response& resp, TensorEntry& entry) {
   MarkDone(entry.handle, Status::OK(), std::move(out_dims), std::move(out));
 }
 
+// Fused allgather group (wire v9): the response carries names in group
+// order and first_dims flattened name-major (names.size() x members).
+// Member i's wire block is the concat of its contribution to EVERY tensor
+// in group order, so the whole group costs ONE variable-block ring
+// (m-1 steps) instead of names.size() separate negotiated rounds — the
+// "rematerialize all sharded params at once" primitive.  dtypes may
+// differ per entry (blocks are bytes; nothing accumulates).
+void Engine::ExecuteGroupedAllgather(const Response& resp,
+                                     std::vector<TensorEntry>& entries) {
+  Comm& c = C();
+  int m = c.size;
+  size_t n = entries.size();
+  auto fail_all = [&](const Status& st) {
+    for (auto& e : entries) MarkDone(e.handle, st, {}, {});
+    DataPlaneFail(st);
+  };
+  if (n != resp.names.size() ||
+      resp.first_dims.size() != n * static_cast<size_t>(m)) {
+    // entries short of names = some were dropped locally (e.g. failed by
+    // a world change): fail what's left cleanly — peers running the full
+    // fused ring hit their data timeout, the same contract every other
+    // local failure keeps
+    fail_all(Status::Error(
+        "grouped allgather group incomplete on this rank (" +
+        std::to_string(n) + " of " + std::to_string(resp.names.size()) +
+        " tensors live, " + std::to_string(resp.first_dims.size()) +
+        " first_dims for " + std::to_string(m) + " members)"));
+    return;
+  }
+  // resp.names order is group order; entries were pulled in names order
+  std::vector<int64_t> rowb(n);  // bytes per first-dim row, per entry
+  for (size_t i = 0; i < n; i++) {
+    int64_t stride = 1;
+    for (size_t d = 1; d < entries[i].req.dims.size(); d++)
+      stride *= entries[i].req.dims[d];
+    rowb[i] = stride * static_cast<int64_t>(DTypeSize(entries[i].req.dtype));
+  }
+  auto fd = [&](size_t i, int r) {
+    return resp.first_dims[i * static_cast<size_t>(m) +
+                           static_cast<size_t>(r)];
+  };
+  // hierarchical allgather configured (multi-host): keep the fused
+  // NEGOTIATED round but execute per entry through the two-level path —
+  // the flat fused ring would pay cross-host bytes on nearly every hop,
+  // silently downgrading the algorithm fusion exists to amortize
+  bool hier_ag = c.set_id == 0 ? hierarchical_allgather_
+                               : c.hierarchical_allgather;
+  if (hier_ag) {
+    for (size_t i = 0; i < n; i++) {
+      Response one;
+      one.op = OpType::kAllgather;
+      one.names = {resp.names[i]};
+      one.first_dims.assign(
+          resp.first_dims.begin() + static_cast<int64_t>(i) * m,
+          resp.first_dims.begin() + static_cast<int64_t>(i + 1) * m);
+      ExecuteAllgather(one, entries[i]);
+    }
+    return;
+  }
+  // member block layout: blk[r] = block start, inner[i][r] = entry i's
+  // offset within member r's block
+  std::vector<int64_t> blk(m + 1, 0);
+  std::vector<std::vector<int64_t>> inner(
+      n, std::vector<int64_t>(static_cast<size_t>(m), 0));
+  for (int r = 0; r < m; r++) {
+    int64_t off = 0;
+    for (size_t i = 0; i < n; i++) {
+      inner[i][static_cast<size_t>(r)] = off;
+      off += fd(i, r) * rowb[i];
+    }
+    blk[r + 1] = blk[r] + off;
+  }
+  std::vector<char> concat = PoolGet(static_cast<size_t>(blk[m]));
+  char* p = concat.data() + blk[c.rank];
+  for (auto& e : entries) {
+    std::memcpy(p, e.payload(), e.nbytes);
+    p += e.nbytes;
+  }
+  std::vector<size_t> mbytes(static_cast<size_t>(m));
+  for (int r = 0; r < m; r++)
+    mbytes[static_cast<size_t>(r)] = static_cast<size_t>(blk[r + 1] - blk[r]);
+  Status st =
+      ElasticizeWire(RingAllgatherGroup(c.members, mbytes, concat.data()));
+  if (!st.ok()) {
+    fail_all(st);
+    return;
+  }
+  // unpack: per entry, concat the member pieces in set-rank order
+  for (size_t i = 0; i < n; i++) {
+    int64_t rows = 0;
+    for (int r = 0; r < m; r++) rows += fd(i, r);
+    std::vector<char> out = PoolGet(static_cast<size_t>(rows * rowb[i]));
+    int64_t off = 0;
+    for (int r = 0; r < m; r++) {
+      int64_t nb = fd(i, r) * rowb[i];
+      std::memcpy(out.data() + off,
+                  concat.data() + blk[r] + inner[i][static_cast<size_t>(r)],
+                  static_cast<size_t>(nb));
+      off += nb;
+    }
+    std::vector<int64_t> out_dims = entries[i].req.dims;
+    if (out_dims.empty()) out_dims = {1};
+    out_dims[0] = rows;
+    PoolPut(std::move(entries[i].data));
+    MarkDone(entries[i].handle, Status::OK(), std::move(out_dims),
+             std::move(out));
+  }
+  PoolPut(std::move(concat));
+}
+
+// Reduce-scatter (wire v9): run the ring's phase 1 and STOP — this member
+// keeps stripe `me` (StripeLoBytes partition) of the summed tensor, at
+// (m-1)/m of the tensor on the wire instead of allreduce's 2(m-1)/m.
+// The output is bitwise the corresponding stripe of a full allreduce by
+// construction (same loop, same chunks, stopped earlier).  No cross-rank
+// checksum audit: outputs legitimately differ per member, so a digest
+// comparison would fabricate SDC verdicts.
+void Engine::ExecuteReducescatter(const Response& resp, TensorEntry& entry,
+                                  bool hier) {
+  (void)resp;
+  Comm& c = C();
+  DType dtype = entry.req.dtype;
+  size_t esize = DTypeSize(dtype);
+  int64_t nelems = NumElems(entry.req.dims);
+  // in-band input-gradient stats, like allreduce's observers
+  if (HealthEnabled())
+    HealthObserveEntry(t_trace_ctx.set, entry.req.name, t_trace_ctx.round,
+                       entry.payload(), nelems, dtype);
+  WireRegions wr;
+  wr.Add(entry.payload(), static_cast<int64_t>(entry.nbytes));
+  if (HealthEnabled()) HealthItemBegin();
+  Status st = ElasticizeWire(hier
+                                 ? HierarchicalReducescatter(wr, nelems, dtype)
+                                 : RingReduceScatter(wr, nelems, dtype));
+  // post-wire bracket: the accumulate-phase injector hook and the in-band
+  // health fold run exactly as for allreduce (read-only observers)
+  FaultInjector::Get().OnPhase(FaultPhase::kAccumulate);
+  if (HealthEnabled())
+    HealthItemEnd(t_trace_ctx.set, t_trace_ctx.round, entry.req.name);
+  if (!st.ok()) {
+    MarkDone(entry.handle, st, {}, {});
+    DataPlaneFail(st);
+    return;
+  }
+  int64_t total_b = nelems * static_cast<int64_t>(esize);
+  int64_t lo_b = StripeLoBytes(total_b, c.size, c.rank);
+  int64_t hi_b = StripeLoBytes(total_b, c.size, c.rank + 1);
+  std::vector<char> out = PoolGet(static_cast<size_t>(hi_b - lo_b));
+  if (hi_b > lo_b)
+    std::memcpy(out.data(), entry.payload() + lo_b,
+                static_cast<size_t>(hi_b - lo_b));
+  PoolPut(std::move(entry.data));
+  // the stripe is FLAT (1-D): stripes cut at 64-byte boundaries, not row
+  // boundaries, and the ZeRO convention shards flat parameter buffers —
+  // grouped_allgather of the flat stripes rebuilds the flat tensor
+  std::vector<int64_t> out_dims{(hi_b - lo_b) /
+                                static_cast<int64_t>(esize)};
+  MarkDone(entry.handle, Status::OK(), std::move(out_dims), std::move(out));
+}
+
+Status Engine::RingReduceScatterBounds(char* buf,
+                                       const std::vector<int64_t>& bounds_b,
+                                       DType dtype,
+                                       const std::vector<int>& members) {
+  int m = static_cast<int>(members.size());
+  if (m <= 1) return Status::OK();
+  int me = static_cast<int>(
+      std::find(members.begin(), members.end(), rank_) - members.begin());
+  if (me == m) return Status::Error("rank not in reduce-scatter group");
+  size_t esize = DTypeSize(dtype);
+  int right = members[(me + 1) % m];
+  int left = members[(me + m - 1) % m];
+  for (int step = 0; step < m - 1; step++) {
+    int send_c = (me - step - 1 + 2 * m) % m;
+    int recv_c = (me - step - 2 + 2 * m) % m;
+    int64_t s_lo = bounds_b[send_c], s_hi = bounds_b[send_c + 1];
+    int64_t r_lo = bounds_b[recv_c], r_hi = bounds_b[recv_c + 1];
+    Status st = PeerSendRecvReduce(
+        right, buf + s_lo, static_cast<size_t>(s_hi - s_lo), left,
+        buf + r_lo, (r_hi - r_lo) / static_cast<int64_t>(esize), dtype);
+    if (!st.ok())
+      return Status::Error("reduce-scatter failed: " + st.message);
+  }
+  return Status::OK();
+}
+
+Status Engine::HierarchicalReducescatter(const WireRegions& wr,
+                                         int64_t nelems, DType dtype) {
+  Comm& c = C();
+  size_t esize = DTypeSize(dtype);
+  int64_t total_b = nelems * static_cast<int64_t>(esize);
+  // per-host stripe unions are contiguous byte ranges ONLY when members,
+  // walked in host-group order, occupy ascending set positions; fall back
+  // to the flat set-order ring otherwise
+  bool contiguous = true;
+  {
+    int expect = 0;
+    for (const auto& g : c.host_groups) {
+      for (int r : g)
+        if (c.IndexOf(r) != expect++) {
+          contiguous = false;
+          break;
+        }
+      if (!contiguous) break;
+    }
+  }
+  if (!contiguous || !wr.single())
+    return RingAllreduceGroup(wr, nelems, dtype, c.members,
+                              /*scatter_only=*/true);
+  char* buf = wr.base();
+  // stage 1: intra-host ring allreduce of the full tensor (fast links)
+  Status st = RingAllreduceGroup(wr, nelems, dtype, c.local_group);
+  if (!st.ok()) return st;
+  int root = c.local_group.front();
+  // stage 2: local roots reduce-scatter the per-host stripe unions across
+  // hosts — (h-1)/h of the tensor on the slow links, half of what
+  // hierarchical allreduce's cross ring + broadcast would move
+  if (rank_ == root && c.cross_group.size() > 1) {
+    std::vector<int64_t> bounds;
+    bounds.reserve(c.host_groups.size() + 1);
+    int pos = 0;
+    for (const auto& g : c.host_groups) {
+      bounds.push_back(StripeLoBytes(total_b, c.size, pos));
+      pos += static_cast<int>(g.size());
+    }
+    bounds.push_back(total_b);
+    st = RingReduceScatterBounds(buf, bounds, dtype, c.cross_group);
+    if (!st.ok()) return st;
+  }
+  // stage 3: the root hands each local member its own stripe (one-way
+  // transfers; the tree-broadcast precedent for deadlock freedom)
+  if (rank_ == root) {
+    for (int r : c.local_group) {
+      if (r == rank_) continue;
+      int p = c.IndexOf(r);
+      int64_t lo = StripeLoBytes(total_b, c.size, p);
+      int64_t hi = StripeLoBytes(total_b, c.size, p + 1);
+      if (hi <= lo) continue;
+      st = PeerSendAll(r, buf + lo, static_cast<size_t>(hi - lo));
+      if (!st.ok()) return st;
+    }
+  } else {
+    int p = c.IndexOf(rank_);
+    int64_t lo = StripeLoBytes(total_b, c.size, p);
+    int64_t hi = StripeLoBytes(total_b, c.size, p + 1);
+    if (hi > lo) {
+      st = PeerRecvAll(root, buf + lo, static_cast<size_t>(hi - lo));
+      if (!st.ok()) return st;
+    }
+  }
+  return Status::OK();
+}
+
 // Binomial-tree broadcast over an arbitrary rank subgroup, rooted at
 // global rank `root` (must be a member): parent = clear the lowest set bit
 // of the root-relative member index; children = set each bit below the
@@ -7470,6 +8009,16 @@ int hvd_add_process_set(const int64_t* ranks, int n) {
 int hvd_process_set_stats(int64_t* out, int max_sets) {
   if (!g_engine) return 0;
   return g_engine->ProcessSetStats(out, max_sets);
+}
+
+// Per-(set, op) traffic rows of 4 int64s {set id, op code, collectives,
+// payload bytes}; only ops with traffic emit rows, global set first.
+// Returns rows written (0 when the engine is down).  Feeds the op=
+// labels on the hvd_pset_collectives/payload metric families so
+// reducescatter vs allreduce traffic is separable in /metrics.
+int hvd_pset_op_stats(int64_t* out, int max_rows) {
+  if (!g_engine) return 0;
+  return g_engine->PsetOpStats(out, max_rows);
 }
 
 int hvd_poll(int handle) { return g_engine ? g_engine->PollHandle(handle) : -2; }

@@ -23,10 +23,36 @@ namespace hvdtpu {
 
 // Bump kWireVersion on ANY layout change (header, field order, new frame).
 constexpr uint32_t kWireMagic = 0x48564457u;  // "HVDW" little-endian
-constexpr uint16_t kWireVersion = 8;          // v8: process sets (set-tagged
-                                              // request/response/cache
-                                              // frames; kProcessSet op;
-                                              // set registry in the table)
+constexpr uint16_t kWireVersion = 9;          // v9: sharded-training ops
+                                              // (kReducescatter requests +
+                                              // stripe-count responses;
+                                              // grouped-allgather fusion
+                                              // via the name prefix below).
+                                              // Frame layouts are UNCHANGED
+                                              // from v8 — v8-shaped jobs
+                                              // serialize the same byte
+                                              // counts (only the header's
+                                              // version field moved), which
+                                              // is what keeps the ctrl-bytes
+                                              // CI gate pinned at 1.0000.
+
+// Reduce-scatter stripe alignment (wire-visible: the coordinator's
+// first_dims stripe counts and every member's local partition must agree
+// byte-for-byte).  Stripe c of an n-byte tensor over m members starts at
+// c * floor(n / m / 64) * 64; the uneven tail goes to the LAST member.
+// 64 is load-bearing twice over: boundaries cut between whole elements
+// for every dtype, and the grouping-sensitive fp16 accumulate kernels'
+// 8-lane grid stays anchored exactly where the allreduce ring anchors it
+// (bitwise identity of a stripe vs the allreduce's own bytes).
+constexpr int64_t kReducescatterAlignBytes = 64;
+
+// Grouped-allgather fusion marker (wire v9): requests whose name starts
+// with this prefix ("__gag:<n>:<k>:<base>") negotiate as one fused
+// allgather response once all n members of the group are ready — one
+// negotiated round and ONE ring over the concatenated member blocks.
+// The prefix rides the wire inside ordinary request names, so the Python
+// mirror (wire_abi.GROUPED_ALLGATHER_PREFIX) must track it exactly.
+constexpr char kGroupedAllgatherPrefix[] = "__gag:";
 
 enum class FrameType : uint16_t {
   kInvalid = 0,
@@ -101,7 +127,11 @@ struct Response {
   std::vector<std::string> names;         // >1 => fused execution
   std::string error_message;              // op == kError
   int32_t root_rank = -1;                 // broadcast
-  // allgather/alltoall: first-dim contribution of every rank, in rank order
+  // allgather/alltoall: first-dim contribution of every member, set-rank
+  // order.  reducescatter (wire v9): per-member stripe ELEMENT counts —
+  // the displacements of the 64-byte-aligned partition, same shape.
+  // grouped allgather (wire v9): names.size() x members entries, flattened
+  // name-major ([name0 member0..memberM-1, name1 ...]).
   std::vector<int64_t> first_dims;
 };
 

@@ -200,6 +200,46 @@ def alltoall(tensor, name: str | None = None,
         process_set=sid)
 
 
+def reducescatter(tensor, average: bool = False, name: str | None = None,
+                  process_set=None) -> np.ndarray:
+    """Sum across the communicator; each member keeps its own stripe.
+
+    Phase 1 of the ring allreduce, stopped — (m-1)/m of the tensor on the
+    wire instead of allreduce's 2(m-1)/m, and the ZeRO/FSDP primitive: a
+    sharded optimizer reduces gradients with this, updates only its own
+    stripe of the state, and rematerializes parameters on demand with
+    :func:`grouped_allgather`.
+
+    The result is the member's FLAT (1-D) stripe: stripes cut at 64-byte
+    boundaries in set-rank order with the uneven tail on the last member
+    (the ZeRO convention of sharding flat buffers; stripe boundaries do
+    not respect row boundaries).  ``average`` divides the stripe by the
+    communicator size, matching ``ops.reducescatter``'s default of False.
+    """
+    sid, nprocs = _pset(process_set)
+    res = _state.engine().reducescatter(
+        _as_numpy(tensor), _pset_name("reducescatter", name, sid),
+        process_set=sid)
+    if average:
+        res = res / nprocs
+    return res
+
+
+def grouped_allgather(tensors, name: str | None = None,
+                      process_set=None) -> list:
+    """Allgather a LIST of tensors as one fused negotiated round.
+
+    All members submit the same group size; each tensor concatenates its
+    members' contributions along dim 0 in set-rank order (first dims may
+    differ, like :func:`allgather`).  The whole group rides ONE ring over
+    concatenated member blocks — the rematerialize-sharded-params
+    primitive pairing :func:`reducescatter`."""
+    sid, _ = _pset(process_set)
+    return _state.engine().grouped_allgather(
+        [_as_numpy(t) for t in tensors],
+        _pset_name("gallgather", name, sid), process_set=sid)
+
+
 def barrier() -> None:
     _state.engine().barrier()
 
@@ -240,6 +280,36 @@ def broadcast_async(tensor, root_rank: int, name: str | None = None,
     )
 
 
+def alltoall_async(tensor, name: str | None = None,
+                   process_set=None) -> int:
+    sid, _ = _pset(process_set)
+    return _state.engine().alltoall_async(
+        _as_numpy(tensor), _pset_name("alltoall", name, sid),
+        process_set=sid)
+
+
+def reducescatter_async(tensor, average: bool = False,
+                        name: str | None = None, process_set=None) -> int:
+    sid, nprocs = _pset(process_set)
+    engine = _state.engine()
+    handle = engine.reducescatter_async(
+        _as_numpy(tensor), _pset_name("reducescatter", name, sid),
+        process_set=sid)
+    if average:
+        # same engine-tracked divisor contract as allreduce_async
+        engine.average_handles[handle] = nprocs
+    return handle
+
+
+def grouped_allgather_async(tensors, name: str | None = None,
+                            process_set=None) -> list:
+    """One handle per tensor; synchronize each (any order)."""
+    sid, _ = _pset(process_set)
+    return _state.engine().grouped_allgather_async(
+        [_as_numpy(t) for t in tensors],
+        _pset_name("gallgather", name, sid), process_set=sid)
+
+
 def poll(handle: int) -> bool:
     """True when the async op is complete and `synchronize` will not block
     (reference `/root/reference/horovod/torch/mpi_ops.py:395-409`)."""
@@ -274,7 +344,9 @@ __all__ = [
     "ProcessSet", "add_process_set", "global_process_set",
     "process_set_stats",
     "allreduce", "allgather", "broadcast", "alltoall", "barrier",
+    "reducescatter", "grouped_allgather",
     "allreduce_async", "allgather_async", "broadcast_async",
+    "alltoall_async", "reducescatter_async", "grouped_allgather_async",
     "poll", "synchronize",
     "Compression", "Sum", "Average",
     "__version__",

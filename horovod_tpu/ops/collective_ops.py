@@ -49,8 +49,14 @@ def _ledger(op: str, tensors) -> None:
     _telemetry.record_compiled_collective(op, nbytes=nbytes)
 
 
-def axis_size(axis_name: str) -> int:
-    return lax.axis_size(axis_name)
+def axis_size(axis_name: str):
+    """Size of the named mesh axis.  ``lax.axis_size`` only exists on
+    newer jax; 0.4.x spells it as a psum of ones (constant-folded by
+    XLA), so every average/divisor path routes through this helper."""
+    try:
+        return lax.axis_size(axis_name)
+    except AttributeError:
+        return lax.psum(jnp.ones((), jnp.int32), axis_name)
 
 
 def vma_checking_active(axis_name: str) -> bool:
@@ -86,7 +92,7 @@ def allreduce(tensor, axis_name: str, average: bool = True, op: str = "sum"):
     if op == "sum":
         out = lax.psum(tensor, axis_name)
         if average:
-            out = out / lax.axis_size(axis_name)
+            out = out / axis_size(axis_name)
         return out
     if average:
         raise ValueError("average=True only valid with op='sum'")
@@ -165,7 +171,7 @@ def grouped_allreduce(tensors, axis_name: str, average: bool = True,
                 _telemetry.record_fusion_bucket(used, bucket_bytes)
             out = lax.psum(tuple(bucket), axis_name)
             if average:
-                n = lax.axis_size(axis_name)
+                n = axis_size(axis_name)
                 out = tuple(t / n for t in out)
             reduced.extend(out)
             bucket, used = [], 0
@@ -210,7 +216,7 @@ def reducescatter(tensor, axis_name: str, average: bool = False, scatter_axis: i
     """
     out = lax.psum_scatter(tensor, axis_name, scatter_dimension=scatter_axis, tiled=True)
     if average:
-        out = out / lax.axis_size(axis_name)
+        out = out / axis_size(axis_name)
     return out
 
 
@@ -229,7 +235,7 @@ def quantized_allreduce(tensor, axis_name: str, average: bool = True):
     total = lax.psum(q, axis_name)
     out = total.astype(dtype) * scale
     if average:
-        out = out / lax.axis_size(axis_name)
+        out = out / axis_size(axis_name)
     return out
 
 
@@ -248,7 +254,7 @@ def ppermute(tensor, axis_name: str, perm):
 
 def ring_shift(tensor, axis_name: str, shift: int = 1):
     """Shift values around the ring by ``shift`` positions (ICI-neighbor DMA)."""
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return lax.ppermute(tensor, axis_name, perm=perm)
 

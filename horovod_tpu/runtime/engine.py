@@ -127,6 +127,16 @@ class Engine:
         return self.synchronize(
             self.alltoall_async(array, name, process_set=process_set))
 
+    def reducescatter(self, array: np.ndarray, name: str,
+                      process_set: int = 0) -> np.ndarray:
+        return self.synchronize(
+            self.reducescatter_async(array, name, process_set=process_set))
+
+    def grouped_allgather(self, arrays, name: str,
+                          process_set: int = 0) -> list:
+        return [self.synchronize(h) for h in self.grouped_allgather_async(
+            arrays, name, process_set=process_set)]
+
     # -- async API (must be implemented) -----------------------------------
     # `out` (allreduce/broadcast): caller-owned result buffer of the
     # input's shape/dtype — written by the engine, enabling in-place ops
@@ -145,6 +155,17 @@ class Engine:
         raise NotImplementedError
 
     def alltoall_async(self, array, name, process_set: int = 0) -> int:
+        raise NotImplementedError
+
+    # `reducescatter` (wire v9): sum across the communicator, each member
+    # keeps its own FLAT 64-byte-aligned stripe (1-D result; uneven tail
+    # to the last member).  `grouped_allgather` rematerializes a list of
+    # sharded tensors in one fused negotiated round (one handle each).
+    def reducescatter_async(self, array, name, process_set: int = 0) -> int:
+        raise NotImplementedError
+
+    def grouped_allgather_async(self, arrays, name,
+                                process_set: int = 0) -> list:
         raise NotImplementedError
 
     # -- process sets ------------------------------------------------------
@@ -235,6 +256,17 @@ class SingleProcessEngine(Engine):
     def alltoall_async(self, array, name, process_set: int = 0) -> int:
         self._check_pset(process_set)
         return self._complete(np.array(array, copy=True))
+
+    def reducescatter_async(self, array, name, process_set: int = 0) -> int:
+        # size-1 stripe = the whole tensor; the contract is a FLAT (1-D)
+        # stripe at every world size, np1 included
+        self._check_pset(process_set)
+        return self._complete(np.array(array, copy=True).reshape(-1))
+
+    def grouped_allgather_async(self, arrays, name,
+                                process_set: int = 0) -> list:
+        self._check_pset(process_set)
+        return [self._complete(np.array(a, copy=True)) for a in arrays]
 
 
 def create_engine(topology, comm_ranks=None) -> Engine:

@@ -34,6 +34,16 @@ _DTYPES: dict[str, int] = {
 }
 
 _OP_ALLREDUCE, _OP_ALLGATHER, _OP_BROADCAST, _OP_ALLTOALL = 0, 1, 2, 3
+_OP_REDUCESCATTER = 7  # wire v9 (4-6 are response-only/registration codes)
+
+# wire v9 grouped-allgather name marker (mirrors csrc/wire.h
+# kGroupedAllgatherPrefix; checked by tools/check_wire_abi.py): requests
+# named "__gag:<n>:<k>:<base>" negotiate as ONE fused allgather round
+_GAG_PREFIX = "__gag:"
+
+# OpType -> label for the per-op metric families (csrc/common.h order)
+_OP_NAMES = ("allreduce", "allgather", "broadcast", "alltoall", "error",
+             "shutdown", "process_set", "reducescatter")
 
 _build_lock = threading.Lock()
 _lib = None
@@ -251,6 +261,13 @@ def _bind(lib):
         lib.hvd_process_set_stats.restype = ctypes.c_int
     except AttributeError:
         pass
+    try:
+        # per-(set, op) traffic rows (wire v9); same prebuilt-.so caveat
+        lib.hvd_pset_op_stats.argtypes = [
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int]
+        lib.hvd_pset_op_stats.restype = ctypes.c_int
+    except AttributeError:
+        pass
     return lib
 
 
@@ -450,6 +467,29 @@ class NativeEngine(Engine):
             {k: int(vals[8 * i + j]) for j, k in enumerate(keys)}
             for i in range(max(n, 0))
         ]
+
+    _MAX_PSET_OP_ROWS = 256
+
+    def pset_op_stats(self) -> list[dict]:
+        """Per-(set, op) traffic rows (wire v9): set id, op name,
+        collectives run, payload bytes — what separates reducescatter vs
+        allreduce traffic per communicator in /metrics.  Empty when the
+        loaded .so predates the op breakdown."""
+        fn = getattr(self._lib, "hvd_pset_op_stats", None)
+        if fn is None:
+            return []
+        vals = (ctypes.c_int64 * (4 * self._MAX_PSET_OP_ROWS))()
+        n = fn(vals, self._MAX_PSET_OP_ROWS)
+        rows = []
+        for i in range(max(n, 0)):
+            op = int(vals[4 * i + 1])
+            rows.append({
+                "set": int(vals[4 * i]),
+                "op": _OP_NAMES[op] if 0 <= op < len(_OP_NAMES) else str(op),
+                "collectives": int(vals[4 * i + 2]),
+                "payload_bytes": int(vals[4 * i + 3]),
+            })
+        return rows
 
     # -- numerical health + SDC audit ---------------------------------------
     _HEALTH_KEYS = (
@@ -713,6 +753,11 @@ class NativeEngine(Engine):
         stripe_seen = [0] * 8
         # per-process-set counters: one labelled series per set id
         pset_seen: dict = {}
+        # per-(set, op) counters (wire v9): op=-labelled series on their
+        # OWN families (hvd_pset_op_*) so reducescatter vs allreduce
+        # traffic is separable per communicator without double-counting
+        # the per-set totals
+        pset_op_seen: dict = {}
         shm_poison_seen = [0]
         cumulative = (
             ("stall_events", telemetry.NATIVE_STALL_EVENTS),
@@ -847,6 +892,24 @@ class NativeEngine(Engine):
                         if delta > 0:
                             reg.counter(metric, set=sid).inc(delta)
                             seen[key] = row[key]
+                try:
+                    op_rows = self.pset_op_stats()
+                except AttributeError:  # scripted engines carry no _lib
+                    op_rows = []
+                for row in op_rows:
+                    key = (str(row["set"]), str(row["op"]))
+                    seen = pset_op_seen.setdefault(
+                        key, {"collectives": 0, "payload_bytes": 0})
+                    for k, metric in (
+                            ("collectives",
+                             telemetry.NATIVE_PSET_OP_COLLECTIVES),
+                            ("payload_bytes",
+                             telemetry.NATIVE_PSET_OP_BYTES)):
+                        delta = row[k] - seen[k]
+                        if delta > 0:
+                            reg.counter(metric, set=key[0],
+                                        op=key[1]).inc(delta)
+                            seen[k] = row[k]
                 delta = d.get("shm_poisons", 0) - shm_poison_seen[0]
                 if delta > 0:
                     reg.counter(telemetry.NATIVE_SHM_POISONS).inc(delta)
@@ -1001,6 +1064,32 @@ class NativeEngine(Engine):
         return self._enqueue(_OP_ALLTOALL, array, name,
                              process_set=process_set)
 
+    def reducescatter_async(self, array, name, process_set: int = 0) -> int:
+        """Sum across the communicator; each member keeps its own FLAT
+        64-byte-aligned stripe (uneven tail to the last member) — phase 1
+        of the ring allreduce at half its wire bytes.  The result is 1-D:
+        stripes cut at byte boundaries, not row boundaries, matching the
+        ZeRO convention of sharding flat parameter/gradient buffers."""
+        return self._enqueue(_OP_REDUCESCATTER, array, name,
+                             process_set=process_set)
+
+    def grouped_allgather_async(self, arrays, name,
+                                process_set: int = 0) -> list[int]:
+        """Allgather a LIST of tensors as one fused negotiated round and
+        ONE ring over the concatenated member blocks (wire v9 "__gag:"
+        fusion) — the rematerialize-all-sharded-params primitive.  Every
+        member must pass the same group size; first dims may differ per
+        member like plain allgather.  Returns one handle per tensor."""
+        arrays = list(arrays)
+        n = len(arrays)
+        if n == 0:
+            return []
+        return [
+            self._enqueue(_OP_ALLGATHER, a, f"{_GAG_PREFIX}{n}:{k}:{name}",
+                          process_set=process_set)
+            for k, a in enumerate(arrays)
+        ]
+
     # -- completion --------------------------------------------------------
     def poll(self, handle: int) -> bool:
         rc = self._lib.hvd_poll(handle)
@@ -1074,6 +1163,7 @@ class NativeEngine(Engine):
     def alltoall(self, array, name, process_set=0):
         return self.synchronize(
             self.alltoall_async(array, name, process_set=process_set))
+
 
     def shutdown(self) -> None:
         collector = getattr(self, "_diagnostics_collector", None)

@@ -13,9 +13,39 @@ from __future__ import annotations
 
 # csrc/wire.h — frame header
 WIRE_MAGIC = 0x48564457  # "HVDW" little-endian
-WIRE_VERSION = 8         # v8: process sets (set-tagged request/response/
-                         # cache frames; kProcessSet op; set registry in
-                         # the bootstrap/world-change table)
+WIRE_VERSION = 9         # v9: sharded-training ops — kReducescatter
+                         # requests (responses carry per-member stripe
+                         # element counts on first_dims) and grouped-
+                         # allgather fusion via the "__gag:" name prefix.
+                         # Frame layouts are unchanged from v8: v8-shaped
+                         # jobs serialize the same byte counts (only the
+                         # header's version value moved), which keeps the
+                         # steady-state ctrl-bytes CI gate at 1.0000.
+
+# csrc/wire.h — reduce-scatter stripe alignment (wire v9): stripe c of an
+# n-byte tensor over m members starts at c * floor(n/m/64)*64 bytes, with
+# the uneven tail on the LAST member.  Wire-visible: the coordinator's
+# first_dims stripe counts and every member's local partition must agree.
+REDUCESCATTER_ALIGN_BYTES = 64
+
+def reducescatter_stripe_bounds(total_bytes: int, members: int) -> list:
+    """Byte boundaries of the wire-v9 reduce-scatter partition: members+1
+    ascending offsets with 64-byte-aligned interior cuts and the uneven
+    tail on the LAST member — the pure-Python mirror of the engine's
+    StripeLoBytes (tools/check_wire_abi.py pins the alignment constant;
+    the native battery pins the bytes)."""
+    if members <= 0:
+        return [0, total_bytes]
+    base = (total_bytes // members // REDUCESCATTER_ALIGN_BYTES
+            * REDUCESCATTER_ALIGN_BYTES)
+    return [c * base for c in range(members)] + [total_bytes]
+
+
+# csrc/wire.h — grouped-allgather fusion marker (wire v9): request names
+# "__gag:<n>:<k>:<base>" negotiate as ONE fused allgather response once
+# all n group members are ready.  Rides the wire inside ordinary request
+# names; tools/check_wire_abi.py asserts the two sides match.
+GROUPED_ALLGATHER_PREFIX = "__gag:"
 
 # csrc/wire.h — FrameType
 FRAME_INVALID = 0
@@ -116,7 +146,8 @@ OP_BROADCAST = 2
 OP_ALLTOALL = 3
 OP_ERROR = 4
 OP_SHUTDOWN = 5
-OP_PROCESS_SET = 6  # wire v8: collective process-set registration
+OP_PROCESS_SET = 6     # wire v8: collective process-set registration
+OP_REDUCESCATTER = 7   # wire v9: ring phase 1, stopped — stripe per member
 
 OP_TYPES = {
     "kAllreduce": OP_ALLREDUCE,
@@ -126,6 +157,7 @@ OP_TYPES = {
     "kError": OP_ERROR,
     "kShutdown": OP_SHUTDOWN,
     "kProcessSet": OP_PROCESS_SET,
+    "kReducescatter": OP_REDUCESCATTER,
 }
 
 # csrc/common.h — DType codes (also mirrored by runtime/native.py _DTYPES,

@@ -133,6 +133,10 @@ NATIVE_PROCESS_SETS = "hvd_process_sets"
 NATIVE_PSET_COLLECTIVES = "hvd_pset_collectives_total"
 NATIVE_PSET_BYTES = "hvd_pset_payload_bytes_total"
 NATIVE_PSET_CACHE_HITS = "hvd_pset_cache_hits_total"
+# per-(set, op) breakdown (wire v9) — separate families from the per-set
+# totals above so `sum by (set)` never double-counts
+NATIVE_PSET_OP_COLLECTIVES = "hvd_pset_op_collectives_total"
+NATIVE_PSET_OP_BYTES = "hvd_pset_op_payload_bytes_total"
 # shm poison word (wire v8 satellite): data-plane waits that unwedged
 # instantly on a peer's world change instead of riding out the timeout
 NATIVE_SHM_POISONS = "hvd_shm_poisons_total"
@@ -437,7 +441,8 @@ __all__ = [
     "NATIVE_WORLD_SIZE", "NATIVE_WORLD_CHANGES", "NATIVE_RANK_JOINS",
     "NATIVE_SHRINK_LATENCY",
     "NATIVE_PROCESS_SETS", "NATIVE_PSET_COLLECTIVES", "NATIVE_PSET_BYTES",
-    "NATIVE_PSET_CACHE_HITS", "NATIVE_SHM_POISONS",
+    "NATIVE_PSET_CACHE_HITS", "NATIVE_PSET_OP_COLLECTIVES",
+    "NATIVE_PSET_OP_BYTES", "NATIVE_SHM_POISONS",
     "NumericalHealthError",
     "HEALTH_NAN", "HEALTH_INF", "HEALTH_SUBNORMAL", "HEALTH_GRAD_NORM",
     "HEALTH_GRAD_ABSMAX", "HEALTH_EVENTS", "HEALTH_FATAL",

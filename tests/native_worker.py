@@ -99,6 +99,31 @@ def scenario_errors():
     except RuntimeError as e:
         assert "root mismatch" in str(e), str(e)
 
+    # reducescatter cross-rank shape mismatch (wire v9): the allreduce
+    # validation rule, so the same clean error — never a hang
+    try:
+        hvd.reducescatter(np.zeros((r + 1,), np.float32), name="bad_rs")
+        raise SystemExit(f"rank {r}: expected rs mismatch error")
+    except RuntimeError as e:
+        assert "shape mismatch" in str(e), str(e)
+
+    # grouped allgather with one INVALID member (dims beyond the first
+    # differ): the failing member errors AND poisons its siblings — every
+    # handle in the group completes with a clean error instead of parking
+    # forever on a fuse that can never happen
+    hs = hvd.grouped_allgather_async(
+        [np.zeros((2, r + 1), np.float32), np.zeros(3, np.float32)],
+        name="bad_gag")
+    failures = 0
+    for h in hs:
+        try:
+            hvd.synchronize(h)
+        except RuntimeError as e:
+            assert ("shape mismatch" in str(e)
+                    or "grouped allgather" in str(e)), str(e)
+            failures += 1
+    assert failures == len(hs), (r, failures)
+
     # engine still healthy after errors
     out = hvd.allreduce(np.ones(2, np.float32), average=False, name="after")
     assert np.allclose(out, n), out
@@ -1654,6 +1679,262 @@ def scenario_fault_sigterm_stuck():
     _signal.signal(_signal.SIGTERM, _signal.SIG_IGN)
     print(f"rank {r}: ignoring SIGTERM", flush=True)
     time.sleep(120)  # must be SIGKILLed by the launcher's grace escalation
+
+
+def _my_stripe(summed, comm_rank, comm_size):
+    """This member's stripe of a summed tensor under the wire-v9
+    partition (the eager reducescatter output contract)."""
+    from horovod_tpu.runtime.wire_abi import reducescatter_stripe_bounds
+
+    flat = np.ascontiguousarray(summed).reshape(-1)
+    b = reducescatter_stripe_bounds(flat.nbytes, comm_size)
+    es = flat.itemsize
+    return flat[b[comm_rank] // es:b[comm_rank + 1] // es]
+
+
+def scenario_rs_equiv():
+    """Reduce-scatter ring-equiv battery (wire v9): for every (dtype,
+    size) point the reducescatter output must be BITWISE the member's own
+    stripe of a full allreduce of the same inputs — asserted in-worker —
+    and the stripes are dumped to HVD_TEST_OUT_DIR so the test can assert
+    bitwise identity ACROSS transports/segment sizes/stripes/SG settings
+    (byte movement may change, arithmetic never).
+
+    fp16 joins on HVD_TEST_RING_FP16=1 with the same monolithic-shm
+    caveat as scenario_ring_equiv (the segmented loop removes the
+    per-pop grouping nondeterminism; the battery pins the segmented and
+    TCP legs).  Average rows ride along: average=True must be exactly
+    stripe/size.  The grouped allgather closes the loop: rematerializing
+    the stripes must rebuild the full allreduce result bitwise."""
+    import ml_dtypes
+
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    out_dir = os.environ["HVD_TEST_OUT_DIR"]
+    rng = np.random.default_rng(11)  # same stream on every rank
+    dtypes = [np.float32, ml_dtypes.bfloat16, np.float64, np.int32]
+    if os.environ.get("HVD_TEST_RING_FP16") == "1":
+        dtypes.append(np.float16)
+    sizes = (1, 7, 1001, 32768, 65537, 131072 + 5)
+    chunks = []
+    for dtype in dtypes:
+        for sz in sizes:
+            base = rng.standard_normal(sz) * 3
+            arr = (base * (r + 1)).astype(dtype)
+            tag = f"{np.dtype(dtype).name}.{sz}"
+            rs = hvd.reducescatter(arr, name=f"rs.{tag}")
+            ar = hvd.allreduce(arr, average=False, name=f"rsar.{tag}")
+            stripe = _my_stripe(ar, r, n)
+            assert rs.dtype == np.dtype(dtype) and rs.ndim == 1, (r, tag)
+            assert rs.tobytes() == stripe.tobytes(), (r, tag)
+            chunks.append(np.ascontiguousarray(rs))
+    # average row (floats only: ints promote on divide by design)
+    arr = (rng.standard_normal(4099) * (r + 1)).astype(np.float32)
+    rs_avg = hvd.reducescatter(arr, average=True, name="rs.avg")
+    ar = hvd.allreduce(arr, average=False, name="rsar.avg")
+    assert rs_avg.tobytes() == (_my_stripe(ar, r, n) / n).tobytes(), r
+    chunks.append(np.ascontiguousarray(rs_avg))
+    # async burst: several reducescatters in flight at once
+    hs = [hvd.reducescatter_async(
+        (rng.standard_normal(sz) * (r + i + 1)).astype(np.float32),
+        name=f"rsb{i}") for i, sz in enumerate((8195, 1001, 65537))]
+    for h in hs:
+        chunks.append(np.ascontiguousarray(hvd.synchronize(h)))
+    # grouped allgather rematerializes the stripes into the full summed
+    # tensors, bitwise (one fused negotiated round for the whole group)
+    xs = [(rng.standard_normal(sz) * (r + 1)).astype(np.float32)
+          for sz in (4099, 257, 65537)]
+    stripes = [hvd.reducescatter(x, name=f"rt{i}")
+               for i, x in enumerate(xs)]
+    fulls = hvd.grouped_allgather(stripes, name="rt")
+    for i, x in enumerate(xs):
+        ar = hvd.allreduce(x, average=False, name=f"rtar{i}")
+        assert fulls[i].tobytes() == np.ascontiguousarray(
+            ar).reshape(-1).tobytes(), (r, i)
+        chunks.append(np.ascontiguousarray(fulls[i]))
+    expect = os.environ.get("HVD_TEST_EXPECT_SEGMENTED")
+    if expect is not None:
+        d = _diag()
+        if expect == "1":
+            assert d["ring_collectives_segmented"] > 0, d
+            assert d["ring_collectives_monolithic"] == 0, d
+        else:
+            assert d["ring_collectives_segmented"] == 0, d
+            assert d["ring_collectives_monolithic"] > 0, d
+    # per-op counters observed the new op
+    from horovod_tpu.runtime import state as _st
+
+    ops_seen = {row["op"]: row for row in _st.engine().pset_op_stats()
+                if row["set"] == 0}
+    assert ops_seen.get("reducescatter", {}).get("collectives", 0) > 0, \
+        ops_seen
+    assert ops_seen.get("allgather", {}).get("collectives", 0) > 0, ops_seen
+    blob = b"".join(c.tobytes() for c in chunks)
+    with open(os.path.join(out_dir, f"rs_equiv_r{r}.bin"), "wb") as f:
+        f.write(blob)
+    hvd.shutdown()
+    print(f"rank {r}: rs equiv OK ({len(blob)} bytes)", flush=True)
+
+
+def scenario_rs_equiv_paced_flat():
+    """scenario_rs_equiv on a simulated every-rank-its-own-host topology
+    with paced cross-host links and the FLAT ring forced — every
+    reduce-scatter byte rides paced TCP."""
+    r = int(os.environ["HOROVOD_TPU_RANK"])
+    os.environ["HOROVOD_TPU_HOST_HASH"] = f"simhost{r}"
+    os.environ["HOROVOD_TPU_HIERARCHICAL_ALLREDUCE"] = "0"
+    scenario_rs_equiv()
+
+
+def scenario_rs_hier():
+    """Hierarchical reduce-scatter (simulated 2-rank hosts): integer-
+    valued inputs make every summation order exact, so the two-level
+    path (local allreduce -> cross-host stripe-union reduce-scatter ->
+    intra-host scatter) must still equal the stripe of the hierarchical
+    allreduce bit for bit."""
+    r = int(os.environ["HOROVOD_TPU_RANK"])
+    os.environ["HOROVOD_TPU_HOST_HASH"] = f"simhost{r // 2}"
+    os.environ["HOROVOD_TPU_HIERARCHICAL_ALLREDUCE"] = "1"
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    rng = np.random.default_rng(13)
+    for sz in (7, 1001, 65537):
+        arr = rng.integers(-8, 8, sz).astype(np.float32) * (r + 1)
+        rs = hvd.reducescatter(arr, name=f"hrs{sz}")
+        ar = hvd.allreduce(arr, average=False, name=f"hrsar{sz}")
+        assert rs.tobytes() == _my_stripe(ar, r, n).tobytes(), (r, sz)
+    hvd.shutdown()
+    print(f"rank {r}: rs hier OK", flush=True)
+
+
+def scenario_rs_pset_dump():
+    """Sub-world reducescatter bitwise checker (pset_dump pattern): run a
+    deterministic reducescatter + grouped-allgather battery over ONE
+    communicator and dump the stripes by COMMUNICATOR rank.  With
+    HVD_TEST_PSET_MEMBERS the battery rides that process set inside a
+    bigger world (non-members flood a complement set concurrently);
+    without it, the global set of a standalone world at the subset's
+    size.  The dumps must match byte for byte."""
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    out_dir = os.environ["HVD_TEST_OUT_DIR"]
+    members_env = os.environ.get("HVD_TEST_PSET_MEMBERS", "")
+    if members_env:
+        members = [int(x) for x in members_env.split(",")]
+        others = [x for x in range(n) if x not in members]
+        ps = hvd.add_process_set(members)
+        psn = hvd.add_process_set(others) if others else None
+        comm_rank, comm_size = ps.rank(), ps.size()
+        kw = {"process_set": ps}
+    else:
+        comm_rank, comm_size = r, n
+        kw = {}
+    if members_env and comm_rank < 0:
+        for i in range(30):
+            hvd.allreduce(np.full(4096, float(r), np.float32),
+                          average=False, name=f"rnoise{i}",
+                          process_set=psn)
+        hvd.allreduce(np.ones(2, np.float32), average=False, name="rsfin")
+        hvd.shutdown()
+        print(f"rank {r}: rs pset bystander OK", flush=True)
+        return
+    rng = np.random.default_rng(17)
+    chunks = []
+    for i, sz in enumerate((7, 1001, 32768, 65537)):
+        arr = (rng.standard_normal(sz) * (comm_rank + 1)).astype(np.float32)
+        rs = hvd.reducescatter(arr, name=f"prs{i}", **kw)
+        ar = hvd.allreduce(arr, average=False, name=f"prsar{i}", **kw)
+        assert rs.tobytes() == _my_stripe(
+            ar, comm_rank, comm_size).tobytes(), (r, i)
+        chunks.append(np.ascontiguousarray(rs))
+    stripes = [chunks[1], chunks[3]]
+    fulls = hvd.grouped_allgather(stripes, name="prg", **kw)
+    chunks.extend(np.ascontiguousarray(f) for f in fulls)
+    blob = b"".join(c.tobytes() for c in chunks)
+    with open(os.path.join(out_dir, f"rs_pset_r{comm_rank}.bin"),
+              "wb") as f:
+        f.write(blob)
+    if members_env:
+        hvd.allreduce(np.ones(2, np.float32), average=False, name="rsfin")
+    hvd.shutdown()
+    print(f"rank {r}: rs pset OK commrank={comm_rank} "
+          f"({len(blob)} bytes)", flush=True)
+
+
+def scenario_rs_elastic_loop():
+    """Elastic chaos workload over REDUCESCATTER (wire v9 satellite): a
+    steady reducescatter-of-ones stream under HOROVOD_TPU_ELASTIC=1 with
+    an injected mid-ring kill.  Survivors must see the retryable
+    WorldShrunkError, wait out world_changed(), and resume — where the
+    stripe-of-summed-ones result IS the live world size, so correctness
+    self-asserts in the shrunk world.  Prints the same RETRYABLE /
+    WORLD_CHANGED markers the chaos tests parse."""
+    import time as _time
+
+    hvd.init()
+    launch_rank = int(os.environ.get("HOROVOD_TPU_RANK", "0"))
+    elems = int(os.environ.get("HVD_TEST_ELEMS", "4096"))
+    steps_after = int(os.environ.get("HVD_TEST_STEPS_AFTER", "8"))
+    want_changes = int(os.environ.get("HVD_TEST_CHANGES", "1"))
+    data = np.ones(elems, np.float32)
+    from horovod_tpu.runtime import state as _st
+
+    changes_seen = 0
+    post_steps = 0
+    done = 0.0
+    ws = hvd.size()
+    for step in range(100000):
+        size_before = hvd.size()
+        hs = [hvd.reducescatter_async(data, name=f"ers{i}")
+              for i in range(2)]
+        try:
+            outs = [hvd.synchronize(h) for h in hs]
+            stop = hvd.broadcast(np.array([done], np.float32),
+                                 root_rank=0, name="ers_stop")
+        except hvd.WorldShrunkError as e:
+            print(f"rank {launch_rank}: RETRYABLE: {e}", flush=True)
+            for h in hs:
+                try:
+                    hvd.synchronize(h)
+                except (RuntimeError, ValueError):
+                    pass
+            deadline = _time.monotonic() + 60
+            while not hvd.world_changed():
+                if _time.monotonic() > deadline:
+                    raise SystemExit(
+                        f"rank {launch_rank}: world never re-formed")
+                _time.sleep(0.02)
+            continue
+        except RuntimeError as e:
+            if "shut down" in str(e):
+                break
+            raise
+        if stop[0] > 0:
+            ws = hvd.size()
+            break
+        changed = hvd.world_changed()
+        ws = hvd.size()
+        for out in outs:
+            # every element of my stripe is the sum of ones = world size
+            if out.size:
+                assert out[0] in (float(size_before), float(ws)), (
+                    launch_rank, out[0], size_before, ws)
+        d = _st.engine().world_stats()
+        if changed or d["world_changes"] > changes_seen:
+            changes_seen = d["world_changes"]
+            print(f"rank {launch_rank}: WORLD_CHANGED size={ws} "
+                  f"changes={d['world_changes']}", flush=True)
+            post_steps = 0
+        if changes_seen >= want_changes:
+            post_steps += 1
+            if hvd.rank() == 0 and post_steps >= steps_after:
+                done = 1.0
+    else:
+        print(f"rank {launch_rank}: rs elastic loop ran dry", flush=True)
+        sys.exit(5)
+    hvd.shutdown()
+    print(f"rank {launch_rank}: rs elastic loop OK world={ws} "
+          f"changes={changes_seen}", flush=True)
 
 
 if __name__ == "__main__":

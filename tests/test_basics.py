@@ -116,6 +116,40 @@ def test_alltoall_single(hvd_single):
     np.testing.assert_allclose(hvd.alltoall(x), x)
 
 
+def test_alltoall_async_single(hvd_single):
+    """API-symmetry satellite: alltoall gets the _async twin the other
+    collectives always had; handle poll/synchronize round-trips."""
+    x = np.arange(6, dtype=np.float32)
+    h = hvd.alltoall_async(x)
+    assert isinstance(h, int)
+    hvd.poll(h)  # probe must not consume the handle
+    np.testing.assert_allclose(hvd.synchronize(h), x)
+
+
+def test_reducescatter_single(hvd_single):
+    """np1 parity: the stripe is the whole tensor, FLAT (the 1-D stripe
+    contract holds at every world size)."""
+    x = np.arange(12, dtype=np.float32).reshape(3, 4)
+    out = hvd.reducescatter(x)
+    assert out.shape == (12,)
+    np.testing.assert_allclose(out, x.reshape(-1))
+    np.testing.assert_allclose(hvd.reducescatter(x, average=True),
+                               x.reshape(-1))
+    h = hvd.reducescatter_async(x, average=True)
+    np.testing.assert_allclose(hvd.synchronize(h), x.reshape(-1))
+
+
+def test_grouped_allgather_single(hvd_single):
+    xs = [np.ones((2, 3), np.float32), np.arange(4, dtype=np.float64)]
+    outs = hvd.grouped_allgather(xs)
+    assert len(outs) == 2
+    np.testing.assert_allclose(outs[0], xs[0])
+    np.testing.assert_allclose(outs[1], xs[1])
+    handles = hvd.grouped_allgather_async(xs)
+    for h, x in zip(handles, xs):
+        np.testing.assert_allclose(hvd.synchronize(h), x)
+
+
 def test_barrier(hvd_single):
     hvd.barrier()  # must not deadlock single-process
 

@@ -350,15 +350,15 @@ def test_trace_overhead_gate():
     assert abs(on / off - 1.0) <= 0.01, ovh
 
 
-def test_wire_abi_v8_untouched():
-    """The flight recorder must not have moved the wire: correlation is
-    wire-free by design, so tools/check_wire_abi.py still reports a clean
-    v8 sync (a version bump or frame-layout drift fails here)."""
+def test_wire_abi_version_in_sync():
+    """tools/check_wire_abi.py reports a clean sync at the CURRENT wire
+    version (v9: sharded-training ops) — a version bump without its
+    Python mirror, or frame-layout drift, fails here."""
     out = subprocess.run(
         [sys.executable, os.path.join(REPO, "tools", "check_wire_abi.py")],
         cwd=REPO, capture_output=True, text=True, timeout=120)
     assert out.returncode == 0, out.stdout + out.stderr
-    assert "version 8" in out.stdout, out.stdout
+    assert "version 9" in out.stdout, out.stdout
 
 
 def test_health_flip_attribution_artifact():
@@ -442,3 +442,59 @@ def test_ring_counted_series_gate():
             old, new, [s + direction for s in series_base],
             max_regression_pct=1.0)
         assert code == 0, (direction, rows)
+
+
+def test_sharded_counted_bytes_series_gate():
+    """Fresh sharded-vs-replicated counted series at the BENCH_r15
+    workload shape vs the artifact: per-member ring-payload KB per step
+    is an exact function of (payload, world size, op) — the replicated
+    step moves 2(m-1)/m of the tensor per member, the sharded
+    (reducescatter) step (m-1)/m, so the ratio is 0.5 by construction
+    and gates at <= 0.55.  The gate run skips the artifact's pacing
+    (counted series are pacing-independent) and uses a short loop;
+    per-step KB must match the artifact within 1% both directions."""
+    old = _baseline("BENCH_r15.json")
+    art = old.get("np4")
+    assert art, old
+    mb = int(old.get("config", {}).get("mb", 16))
+    steps = 3
+    fresh = {}
+    for mode in ("replicated", "sharded"):
+        fresh[mode] = _bench_worker_json(
+            4,
+            ["--sharded-worker", "--sharded-steps", str(steps),
+             "--sharded-mb", str(mb)],
+            {"HVD_SHARDED_MODE": mode, "HVD_SHARDED_REMAT": "0",
+             "HOROVOD_TPU_CYCLE_TIME": "1"},
+            timeout=300)
+        assert fresh[mode].get("mode") == mode, fresh[mode]
+        # fresh per-step KB within 1% of the artifact's, both directions,
+        # member by member (the series is step-count independent)
+        for got, want in zip(fresh[mode]["ring_kb_per_step_per_member"],
+                             art[mode]["ring_kb_per_step_per_member"]):
+            assert abs(got - want) <= 0.01 * want, (mode, got, want)
+    rep_kb = sum(fresh["replicated"]["ring_kb_per_step_per_member"])
+    sh_kb = sum(fresh["sharded"]["ring_kb_per_step_per_member"])
+    assert sh_kb <= 0.55 * rep_kb, (sh_kb, rep_kb)
+    # optimizer-state memory: the sharded state is ~1/N of the replicated
+    rep_opt = max(fresh["replicated"]["opt_state_bytes_per_member"])
+    sh_opt = max(fresh["sharded"]["opt_state_bytes_per_member"])
+    assert sh_opt <= rep_opt / 4 * 1.02, (sh_opt, rep_opt)
+
+
+def test_sharded_artifact_acceptance_shape():
+    """The BENCH_r15 acceptance shape on the checked-in artifact: the
+    counted sharded/replicated bytes ratio <= 0.55 at np4 on paced
+    links, per-member optimizer-state bytes ~1/N, the remat-every-step
+    transparency point near 1.0 (rematerializing everything each step
+    pays the allgather back), and wall_s recorded (not gated)."""
+    r15 = _baseline("BENCH_r15.json")
+    p = r15.get("np4")
+    assert p, r15
+    assert p["sharded_vs_replicated_bytes_ratio"] <= 0.55, p
+    assert abs(p["opt_state_ratio"] - 0.25) <= 0.01, p
+    rep_kb = sum(p["replicated"]["ring_kb_per_step_per_member"])
+    remat_kb = sum(p["sharded_remat1"]["ring_kb_per_step_per_member"])
+    assert 0.9 * rep_kb <= remat_kb <= 1.1 * rep_kb, (remat_kb, rep_kb)
+    for mode in ("replicated", "sharded", "sharded_remat1"):
+        assert p[mode].get("wall_s") is not None, mode

@@ -288,6 +288,24 @@ def test_elastic_shrink_at_negotiation():
     _assert_shrank(res, dead_rank=1, np_=3, final_size=2)
 
 
+def test_elastic_shrink_mid_reducescatter():
+    """Wire v9 chaos row: kill inside the reduce-scatter ring.  The
+    cancelled reducescatter must fail RETRYABLE (WorldShrunkError),
+    survivors wait out the world change and resume the stream in the
+    shrunk world, where the stripe-of-summed-ones self-check holds."""
+    res = _run_elastic("rs_elastic_loop", 3, "kill:rank=1:phase=ring:hit=8",
+                       extra_env={"HVD_TEST_ELEMS": "200000"},
+                       hvdrun_args=("--min-np", "1"))
+    assert res.returncode == 0, res.stdout + res.stderr
+    for r in (0, 2):
+        assert f"rank {r}: rs elastic loop OK" in res.stdout, (
+            r, res.stdout + res.stderr)
+    assert "RETRYABLE:" in res.stdout, res.stdout
+    assert "WORLD_CHANGED size=2" in res.stdout, res.stdout
+    assert "rs elastic loop ran dry" not in res.stdout
+    assert "aborting job" not in res.stdout, res.stdout
+
+
 def test_elastic_shrink_mid_ring_shm():
     """Kill inside the segmented ring over the shm data plane: survivors
     are parked on rings the dead peer will never service; the world-change

@@ -239,3 +239,87 @@ def test_spark_run_end_to_end_against_pinned_double(monkeypatch):
     # the frontend bracketed the job in a job group and cancelled it
     kinds = [k for (k, *rest) in sc.job_groups]
     assert kinds == ["set", "cancel"]
+
+# ---------------------------------------------------------------------------
+# eager <-> compiled reducescatter parity (wire v9 satellite)
+# ---------------------------------------------------------------------------
+
+def _summed_stripes(summed: "np.ndarray", members: int):
+    """The eager contract's stripes of a summed flat tensor: 64-byte-
+    aligned cuts in rank order, uneven tail on the last member."""
+    import numpy as np
+
+    from horovod_tpu.runtime.wire_abi import reducescatter_stripe_bounds
+
+    flat = np.ascontiguousarray(summed).reshape(-1)
+    b = reducescatter_stripe_bounds(flat.nbytes, members)
+    es = flat.itemsize
+    return [flat[b[i] // es:b[i + 1] // es] for i in range(members)]
+
+
+def test_reducescatter_contract_compiled_matches_eager_stripes(mesh8):
+    """Eager ``hvd.reducescatter`` and compiled ``ops.reducescatter``
+    (psum_scatter) implement the same contract: rank j keeps the j-th
+    rank-ordered shard of the elementwise sum.  On a stripe-aligned,
+    evenly divisible tensor the eager 64-byte flat stripes coincide with
+    psum_scatter's even dim-0 split — assert the compiled output against
+    the EAGER stripe formula, for average=False and True."""
+    import functools
+
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    import horovod_tpu.ops as ops
+
+    n = 8
+    elems = 1024  # fp32: 4096 bytes -> 512 B/stripe, 64-byte aligned
+    rng = np.random.default_rng(3)
+    per_rank = rng.standard_normal((n, elems)).astype(np.float32)
+    summed = per_rank.sum(axis=0, dtype=np.float32)
+
+    for average in (False, True):
+        f = functools.partial(
+            shard_map, mesh=mesh8, in_specs=P("hvd", None),
+            out_specs=P("hvd"))(
+            lambda x: ops.reducescatter(x[0], "hvd", average=average))
+        out = np.asarray(f(jnp.asarray(per_rank))).reshape(n, -1)
+        stripes = _summed_stripes(summed, n)
+        for j in range(n):
+            expect = stripes[j] / n if average else stripes[j]
+            np.testing.assert_allclose(out[j], expect, rtol=2e-5,
+                                       atol=2e-5)
+
+
+def test_reducescatter_contract_uneven_last_stripe():
+    """The eager stripe formula's uneven-tail contract: interior cuts are
+    64-byte aligned, coverage is exact and ordered, and every member but
+    the last gets the same stripe size — the LAST member absorbs the
+    remainder (psum_scatter cannot express this; the eager op exists
+    precisely to shard non-divisible flat buffers)."""
+    from horovod_tpu.runtime.wire_abi import (REDUCESCATTER_ALIGN_BYTES,
+                                              reducescatter_stripe_bounds)
+
+    for total, m in ((4099 * 4, 4), (7 * 8, 3), (65537 * 2, 8), (64, 4)):
+        b = reducescatter_stripe_bounds(total, m)
+        assert len(b) == m + 1 and b[0] == 0 and b[-1] == total
+        assert all(x <= y for x, y in zip(b, b[1:]))
+        for cut in b[1:-1]:
+            assert cut % REDUCESCATTER_ALIGN_BYTES == 0
+        sizes = [y - x for x, y in zip(b, b[1:])]
+        assert len(set(sizes[:-1])) <= 1  # equal interior stripes
+        assert sizes[-1] >= sizes[0]      # tail on the LAST member
+
+
+def test_reducescatter_contract_eager_np1_flat(hvd_single):
+    """np1 eager parity row: the stripe of a 1-member world is the whole
+    tensor, FLAT — the m=1 degenerate case of the same formula."""
+    import numpy as np
+
+    import horovod_tpu as hvd
+
+    x = np.arange(24, dtype=np.float32).reshape(4, 6)
+    out = hvd.reducescatter(x)
+    assert out.shape == (24,)
+    np.testing.assert_array_equal(out, _summed_stripes(x, 1)[0])

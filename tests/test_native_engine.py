@@ -1076,3 +1076,111 @@ def test_shm_data_plane_active_and_optional():
     assert "shm data plane" not in res_off.stderr
     for r in range(2):
         assert f"rank {r}: collectives OK" in res_off.stdout
+
+
+# ---------------------------------------------------------------------------
+# reduce-scatter + grouped allgather (wire v9)
+# ---------------------------------------------------------------------------
+
+def _rs_equiv_blobs(tmp_path, scenario, np_, extra_env, configs):
+    """Run the reduce-scatter equivalence battery once per (label,
+    segment-bytes, expect-segmented) config; returns label -> per-rank
+    stripe blobs.  The worker additionally asserts IN-PROCESS that every
+    stripe is bitwise the member's slice of a full allreduce — these
+    cross-config comparisons then pin that byte-movement knobs (segment
+    size, stripes, SG) never touch the arithmetic."""
+    blobs = {}
+    for label, seg, expect in configs:
+        out = tmp_path / label
+        out.mkdir()
+        env = dict(extra_env)
+        env.update({
+            "HOROVOD_TPU_RING_SEGMENT_BYTES": seg,
+            "HVD_TEST_OUT_DIR": str(out),
+            "HVD_TEST_EXPECT_SEGMENTED": expect,
+            "HOROVOD_TPU_CYCLE_TIME": "100",
+            "HOROVOD_TPU_BURST_WINDOW_US": "50000",
+        })
+        res = _run(scenario, np_, timeout=240, env=env)
+        assert res.returncode == 0, res.stderr + res.stdout
+        for r in range(np_):
+            assert f"rank {r}: rs equiv OK" in res.stdout
+        blobs[label] = _read_rank_files(str(out), "rs_equiv", np_)
+    return blobs
+
+
+def test_reducescatter_bitwise_shm_segment_sweep(tmp_path):
+    """Reduce-scatter over the shm data plane at segment 0 (monolithic
+    phase-1 ring), 64 KB, and 1 GB: the stripes must be bitwise identical
+    across all three AND bitwise equal to the member's own slice of a
+    full allreduce (asserted in-worker at every point)."""
+    blobs = _rs_equiv_blobs(
+        tmp_path, "rs_equiv", 2, {},
+        [("mono", "0", "0"), ("seg64k", "65536", "1"),
+         ("huge", str(1 << 30), "1")])
+    _assert_blobs_equal(blobs, "mono", 2)
+
+
+def test_reducescatter_bitwise_tcp_fp16(tmp_path):
+    """Same identity over plain TCP with fp16 included (the grouping-
+    sensitive kernels: stripe-aligned chunks keep the 8-lane grid
+    anchored identically for reduce-scatter and allreduce)."""
+    blobs = _rs_equiv_blobs(
+        tmp_path, "rs_equiv", 2,
+        {"HOROVOD_TPU_SHM": "0", "HVD_TEST_RING_FP16": "1"},
+        [("mono", "0", "0"), ("seg64k", "65536", "1")])
+    _assert_blobs_equal(blobs, "mono", 2)
+
+
+@pytest.mark.slow  # paced 2-proc runs x2 configs
+def test_reducescatter_bitwise_paced_striped(tmp_path):
+    """Every reduce-scatter byte over paced cross-host TCP (one simulated
+    host per rank, flat ring), striped 1 vs 4: pacing and striping are
+    byte-movement knobs and must leave the stripes bitwise unchanged."""
+    base = {"HOROVOD_TPU_CROSS_HOST_PACE_MBPS": "200"}
+    blobs = _rs_equiv_blobs(
+        tmp_path, "rs_equiv_paced_flat", 2,
+        dict(base, HOROVOD_TPU_WIRE_STRIPES="1"),
+        [("k1", "65536", "1")])
+    blobs.update(_rs_equiv_blobs(
+        tmp_path, "rs_equiv_paced_flat", 2,
+        dict(base, HOROVOD_TPU_WIRE_STRIPES="4"),
+        [("k4", "65536", "1")]))
+    _assert_blobs_equal(blobs, "k1", 2)
+
+
+def test_reducescatter_hierarchical(tmp_path):
+    """The two-level reduce-scatter path (local allreduce, cross-host
+    stripe-union reduce-scatter, intra-host scatter) on simulated 2-rank
+    hosts: integer-valued inputs make the comparison against the
+    hierarchical allreduce's stripe exact."""
+    res = _run("rs_hier", 4, timeout=240)
+    assert res.returncode == 0, res.stderr + res.stdout
+    for r in range(4):
+        assert f"rank {r}: rs hier OK" in res.stdout
+
+
+def test_reducescatter_pset_bitwise_vs_standalone(tmp_path):
+    """Sub-world reduce-scatter must compute bitwise what that subset
+    computes as a standalone world (stripes AND grouped-allgather
+    rematerializations), while non-members flood a complement set."""
+    sub = tmp_path / "sub"
+    sub.mkdir()
+    res = _run("rs_pset_dump", 4, timeout=240, env={
+        "HVD_TEST_PSET_MEMBERS": "1,3", "HVD_TEST_OUT_DIR": str(sub),
+        "HOROVOD_TPU_CYCLE_TIME": "100",
+        "HOROVOD_TPU_BURST_WINDOW_US": "50000"})
+    assert res.returncode == 0, res.stderr + res.stdout
+    alone = tmp_path / "alone"
+    alone.mkdir()
+    res = _run("rs_pset_dump", 2, timeout=240, env={
+        "HVD_TEST_OUT_DIR": str(alone),
+        "HOROVOD_TPU_CYCLE_TIME": "100",
+        "HOROVOD_TPU_BURST_WINDOW_US": "50000"})
+    assert res.returncode == 0, res.stderr + res.stdout
+    for cr in range(2):
+        sub_b = (sub / f"rs_pset_r{cr}.bin").read_bytes()
+        alone_b = (alone / f"rs_pset_r{cr}.bin").read_bytes()
+        assert sub_b == alone_b, (
+            f"comm rank {cr}: sub-world reduce-scatter differs from the "
+            "standalone world")

@@ -1217,3 +1217,82 @@ def test_run_py_threads_telemetry_env(tmp_path):
     assert f"MP={base_port + 1}" in res.stdout  # rank 0 -> base + 1
     assert mdir.is_dir()  # launcher pre-creates the dump directories
     assert tdir.is_dir()
+
+
+def test_pset_op_labels_across_elastic_shrink(clean_telemetry):
+    """Wire v9 satellite: the hvd_pset_op_collectives/payload families carry
+    op=-labelled series (reducescatter vs allreduce traffic separable per
+    communicator), mirrored with the same delta discipline as the per-set
+    rows — across an elastic shrink an evicted set's op rows FREEZE while
+    survivors keep counting.  Collector-mirror level, scripted engine."""
+    from horovod_tpu.runtime.native import NativeEngine
+
+    T.set_metrics_enabled(True)
+    state = {}
+
+    class Scripted(NativeEngine):
+        def __init__(self):  # no native init — scripted diagnostics
+            self._topology = None
+
+        def diagnostics(self):
+            return _fake_native_diag(psets=state["psets"],
+                                     epoch=state["epoch"],
+                                     size=state["size"])
+
+        def world_stats(self):
+            return {"world_epoch": state["epoch"],
+                    "world_size": state["size"], "world_rank": 0,
+                    "world_changes": 0, "rank_joins": 0,
+                    "shrink_latency_ns": 0, "elastic": 1}
+
+        def _fault_stats(self):
+            return {"heartbeat_age_s": 0.0, "peer_timeout_s": 60.0,
+                    "peer_timeouts": 0, "aborts": 0, "abort_latency_ns": 0,
+                    "heartbeats_tx": 0, "heartbeats_rx": 0}
+
+        def pset_op_stats(self):
+            return state["op_rows"]
+
+    def pset(sid, size, rank, coll, nbytes):
+        return {"id": sid, "size": size, "rank": rank, "collectives": coll,
+                "payload_bytes": nbytes, "wire_ns": 0, "cache_hits": 0,
+                "cache_misses": 0}
+
+    def oprow(sid, op, coll, nbytes):
+        return {"set": sid, "op": op, "collectives": coll,
+                "payload_bytes": nbytes}
+
+    eng = Scripted()
+    state.update(epoch=0, size=4, psets=[pset(0, 4, 0, 10, 1000)],
+                 op_rows=[oprow(0, "allreduce", 6, 600),
+                          oprow(0, "reducescatter", 4, 400),
+                          oprow(1, "reducescatter", 3, 300)])
+    eng._register_diagnostics_collector()
+    reg = T.registry()
+    reg.snapshot()  # collect #1
+    assert reg.counter(T.NATIVE_PSET_OP_COLLECTIVES, set="0",
+                       op="allreduce").value == 6
+    assert reg.counter(T.NATIVE_PSET_OP_COLLECTIVES, set="0",
+                       op="reducescatter").value == 4
+    assert reg.counter(T.NATIVE_PSET_OP_BYTES, set="1",
+                       op="reducescatter").value == 300
+
+    # elastic shrink: set 1's members died — its op rows VANISH (frozen
+    # series); the global set keeps counting both ops
+    state.update(epoch=1, size=3, psets=[pset(0, 3, 0, 15, 1500)],
+                 op_rows=[oprow(0, "allreduce", 8, 800),
+                          oprow(0, "reducescatter", 7, 700)])
+    reg.snapshot()  # collect #2
+    assert reg.counter(T.NATIVE_PSET_OP_COLLECTIVES, set="0",
+                       op="allreduce").value == 8
+    assert reg.counter(T.NATIVE_PSET_OP_COLLECTIVES, set="0",
+                       op="reducescatter").value == 7
+    # evicted set's op series: same value, no phantom deltas
+    assert reg.counter(T.NATIVE_PSET_OP_COLLECTIVES, set="1",
+                       op="reducescatter").value == 3
+    reg.snapshot()
+    assert reg.counter(T.NATIVE_PSET_OP_COLLECTIVES, set="1",
+                       op="reducescatter").value == 3
+    # the aggregate per-set family kept its single label set: no
+    # double-counted {set,op} series on it
+    assert reg.counter(T.NATIVE_PSET_COLLECTIVES, set="0").value == 15

@@ -34,7 +34,7 @@ def test_checker_detects_version_drift():
     """The guard must actually bite: a simulated version bump in wire.h
     without a Python update is reported."""
     wire_h, common_h = _headers()
-    tampered = wire_h.replace("kWireVersion = 8", "kWireVersion = 9")
+    tampered = wire_h.replace("kWireVersion = 9", "kWireVersion = 10")
     assert tampered != wire_h, "kWireVersion moved; update this test"
     problems = check_wire_abi.check(tampered, common_h)
     assert any("kWireVersion" in p for p in problems), problems
@@ -98,20 +98,51 @@ def test_v7_world_frames_present():
 
 
 def test_v8_process_set_collateral_present():
-    """The process-set subsystem's wire v8 collateral: the version is 8 on
-    both sides, the kProcessSet op exists at its pinned id, and the four
-    negotiation-side frames carry the trailing set tag in both mirrors."""
+    """The process-set subsystem's wire v8 collateral: the kProcessSet op
+    exists at its pinned id and the four negotiation-side frames carry the
+    trailing set tag in both mirrors."""
     from horovod_tpu.runtime import wire_abi
 
-    assert wire_abi.WIRE_VERSION == 8
     assert wire_abi.OP_TYPES["kProcessSet"] == wire_abi.OP_PROCESS_SET == 6
     assert wire_abi.GLOBAL_PROCESS_SET == 0
     assert wire_abi.SET_TAGGED_FRAMES == (
         "RequestList", "ResponseList", "CacheBitsFrame", "CachedExecFrame")
     wire_h, common_h = _headers()
-    assert "kWireVersion = 8" in wire_h
     assert "kProcessSet = 6" in common_h
     assert wire_h.count("int32_t process_set = 0;") == 4
+
+
+def test_v9_sharded_training_collateral_present():
+    """The sharded-training wire v9 collateral: the version is 9 on both
+    sides, the kReducescatter op exists at its pinned id, and the stripe
+    alignment + grouped-allgather prefix constants match their mirrors."""
+    from horovod_tpu.runtime import native, wire_abi
+
+    assert wire_abi.WIRE_VERSION == 9
+    assert wire_abi.OP_TYPES["kReducescatter"] == \
+        wire_abi.OP_REDUCESCATTER == 7
+    assert wire_abi.REDUCESCATTER_ALIGN_BYTES == 64
+    assert wire_abi.GROUPED_ALLGATHER_PREFIX == "__gag:"
+    assert native._GAG_PREFIX == wire_abi.GROUPED_ALLGATHER_PREFIX
+    assert native._OP_REDUCESCATTER == wire_abi.OP_REDUCESCATTER
+    wire_h, common_h = _headers()
+    assert "kWireVersion = 9" in wire_h
+    assert "kReducescatter = 7" in common_h
+    assert check_wire_abi._parse_constant(
+        wire_h, "kReducescatterAlignBytes") == 64
+    assert check_wire_abi._parse_string_constant(
+        wire_h, "kGroupedAllgatherPrefix") == "__gag:"
+
+
+def test_checker_detects_gag_prefix_drift():
+    """The grouped-allgather prefix changing in wire.h without the Python
+    mirror (the v9 drift-guard extension) is reported."""
+    wire_h, common_h = _headers()
+    tampered = wire_h.replace('kGroupedAllgatherPrefix[] = "__gag:"',
+                              'kGroupedAllgatherPrefix[] = "__grp:"')
+    assert tampered != wire_h, "kGroupedAllgatherPrefix moved; update this"
+    problems = check_wire_abi.check(tampered, common_h)
+    assert any("kGroupedAllgatherPrefix" in p for p in problems), problems
 
 
 def test_checker_detects_set_tag_drift():
@@ -128,7 +159,7 @@ def test_checker_detects_set_tag_drift():
 
 
 def test_version_mismatch_message_names_both_versions():
-    """A stale-version frame hitting a v8 engine must produce the
+    """A stale-version frame hitting a v9 engine must produce the
     descriptive both-versions error — the operator-facing contract for a
     mixed .so deployment — via the native parse probe.  Skips (not fails)
     when the .so predates the probe."""
@@ -151,7 +182,7 @@ def test_version_mismatch_message_names_both_versions():
     lib.hvd_free_cstr.argtypes = [ctypes.c_void_p]
     lib.hvd_wire_version.restype = ctypes.c_int
 
-    assert lib.hvd_wire_version() == wire_abi.WIRE_VERSION == 8
+    assert lib.hvd_wire_version() == wire_abi.WIRE_VERSION == 9
 
     def parse_error(buf: bytes) -> str | None:
         p = lib.hvd_frame_parse_error(buf, len(buf))
@@ -162,19 +193,19 @@ def test_version_mismatch_message_names_both_versions():
         finally:
             lib.hvd_free_cstr(p)
 
-    # v7 <-> v8 (the previous release still running somewhere): the
-    # process-set version bump must surface as the descriptive
+    # v8 <-> v9 (the previous release still running somewhere): the
+    # sharded-training version bump must surface as the descriptive
     # both-versions message, exactly like every previous bump
+    stale = wire_abi.frame_header(version=8) + b"\x00" * 16
+    msg = parse_error(stale)
+    assert msg is not None
+    assert "v8" in msg and "v9" in msg and "libhvdtpu.so" in msg, msg
+
+    # an even older v7 header: same contract, both versions named
     stale = wire_abi.frame_header(version=7) + b"\x00" * 16
     msg = parse_error(stale)
     assert msg is not None
-    assert "v7" in msg and "v8" in msg and "libhvdtpu.so" in msg, msg
-
-    # an even older v6 header: same contract, both versions named
-    stale = wire_abi.frame_header(version=6) + b"\x00" * 16
-    msg = parse_error(stale)
-    assert msg is not None
-    assert "v6" in msg and "v8" in msg and "libhvdtpu.so" in msg, msg
+    assert "v7" in msg and "v9" in msg and "libhvdtpu.so" in msg, msg
 
     # current-version garbage is a parse error, not a version error
     import struct

@@ -41,6 +41,14 @@ def _parse_constant(text: str, name: str) -> int | None:
     return int(m.group(1), 0) if m else None
 
 
+def _parse_string_constant(text: str, name: str) -> str | None:
+    """``constexpr char kName[] = "...";`` -> the literal (wire-visible
+    name markers like the grouped-allgather prefix)."""
+    m = re.search(r"constexpr\s+char\s+" + name + r"\[\]\s*=\s*\"([^\"]*)\"",
+                  text)
+    return m.group(1) if m else None
+
+
 def _parse_tuned_fields(text: str, struct_name: str) -> tuple[str, ...]:
     """``int64_t tuned_*`` members of a struct, in declaration (and
     therefore serialization) order — the autotuner-sync knobs both
@@ -165,6 +173,27 @@ def check(wire_h: str, common_h: str) -> list[str]:
                 f"{struct}: `verdicts` must be declared after "
                 "`process_set` (trailing-block serialization order)")
 
+    # sharded-training wire fields (v9): the stripe alignment and the
+    # grouped-allgather name prefix are wire-visible (the coordinator's
+    # stripe counts / fused-group detection depend on them byte-for-byte),
+    # so the Python mirrors must track them exactly
+    align = _parse_constant(wire_h, "kReducescatterAlignBytes")
+    if align != wire_abi.REDUCESCATTER_ALIGN_BYTES:
+        problems.append(
+            f"kReducescatterAlignBytes: wire.h has {align}, wire_abi.py "
+            f"has {wire_abi.REDUCESCATTER_ALIGN_BYTES}")
+    gag = _parse_string_constant(wire_h, "kGroupedAllgatherPrefix")
+    if gag != wire_abi.GROUPED_ALLGATHER_PREFIX:
+        problems.append(
+            f"kGroupedAllgatherPrefix: wire.h has {gag!r}, wire_abi.py "
+            f"GROUPED_ALLGATHER_PREFIX has "
+            f"{wire_abi.GROUPED_ALLGATHER_PREFIX!r}")
+    if native._GAG_PREFIX != wire_abi.GROUPED_ALLGATHER_PREFIX:
+        problems.append(
+            f"native.py _GAG_PREFIX {native._GAG_PREFIX!r} != wire_abi "
+            f"GROUPED_ALLGATHER_PREFIX "
+            f"{wire_abi.GROUPED_ALLGATHER_PREFIX!r}")
+
     ops = _parse_enum(common_h, "OpType")
     if ops != wire_abi.OP_TYPES:
         problems.append(
@@ -190,10 +219,12 @@ def check(wire_h: str, common_h: str) -> list[str]:
             f"native.py _DTYPES {native._DTYPES} != wire_abi.DTYPES "
             f"{wire_abi.DTYPES}")
     if (native._OP_ALLREDUCE, native._OP_ALLGATHER, native._OP_BROADCAST,
-            native._OP_ALLTOALL) != (wire_abi.OP_ALLREDUCE,
-                                     wire_abi.OP_ALLGATHER,
-                                     wire_abi.OP_BROADCAST,
-                                     wire_abi.OP_ALLTOALL):
+            native._OP_ALLTOALL,
+            native._OP_REDUCESCATTER) != (wire_abi.OP_ALLREDUCE,
+                                          wire_abi.OP_ALLGATHER,
+                                          wire_abi.OP_BROADCAST,
+                                          wire_abi.OP_ALLTOALL,
+                                          wire_abi.OP_REDUCESCATTER):
         problems.append("native.py _OP_* constants drifted from wire_abi")
     return problems
 
