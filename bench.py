@@ -1934,15 +1934,19 @@ def _run_elastic_point(n, inject, elems, peer_timeout, restart=False):
     import re
     lats = [float(m) for m in
             re.findall(r"SHRINK_LATENCY_S=([0-9.]+)", proc.stdout)]
-    changes = joins = 0
+    changes = joins = coord = failovers = 0
     final = None
     for m in re.finditer(
-            r"WORLD_CHANGED size=(\d+) changes=(\d+) joins=(\d+)",
+            r"WORLD_CHANGED size=(\d+) changes=(\d+) joins=(\d+)"
+            r"(?: coord=(\d+) failovers=(\d+))?",
             proc.stdout):
         if int(m.group(2)) >= changes:
             changes = int(m.group(2))
             final = int(m.group(1))
         joins = max(joins, int(m.group(3)))
+        if m.group(4) is not None:
+            coord = max(coord, int(m.group(4)))
+            failovers = max(failovers, int(m.group(5)))
     return {
         "inject": inject,
         "exit_code": proc.returncode,
@@ -1950,6 +1954,8 @@ def _run_elastic_point(n, inject, elems, peer_timeout, restart=False):
         "world_changes": changes,
         "rank_joins": joins,
         "final_size": final,
+        "coordinator": coord,
+        "failovers": failovers,
         "shrink_latency_max_s": round(max(lats), 3) if lats else None,
         "shrink_latency_min_s": round(min(lats), 3) if lats else None,
     }
@@ -2004,6 +2010,54 @@ def bench_elastic(args):
                if p.get("shrink_latency_max_s") is not None]
         if lat:
             point["shrink_latency_worst_s"] = max(lat)
+        results[f"np{n}"] = point
+    return results
+
+
+def bench_failover(args):
+    """Coordinator fail-over bench (BENCH_r16, wire v10): SIGKILL rank 0
+    at each injection point at -np 3 and 4, plus one
+    failover-then-rejoin-the-dead-slot round trip.
+
+    The COUNTED series are pure functions of the injection and gate CI
+    (tests/test_bench_gate.py): exit 0 per point, failovers == 1, the
+    elected coordinator == launch slot 1, final world size exact per
+    injection point, and joins == 1 on the rejoin row (the relaunched
+    slot 0 re-enters through the successor's re-bound rendezvous port).
+    The detect -> first-shrunk-world-cycle latency is RECORDED, not gated
+    — same shared-2-core-host caveat as BENCH_r11, and the kill points
+    ride the same socket-reset cascade (the successor's registration
+    window closes as soon as every survivor registers)."""
+    peer_timeout = args.elastic_peer_timeout
+    results = {"config": {
+        "peer_timeout_s": peer_timeout,
+        "data_timeout_s": 3.0,
+        "min_np": 1,
+        "nproc": os.cpu_count(),
+        "note": "rank 0 is the victim at every point; the lowest "
+                "surviving rank self-elects, re-binds the rendezvous "
+                "port, and drives a normal shrink round that renumbers "
+                "it to rank 0 — latency is the survivors' own "
+                "measurement (first retryable failure -> first completed "
+                "collective under the successor), recorded not gated",
+    }}
+    for n in (3, 4):
+        if n > args.elastic_max_np:
+            continue
+        point = {}
+        for label, inject, elems in (
+                ("kill_negotiation", "kill:rank=0:cycle=10", 4096),
+                ("kill_ring", "kill:rank=0:phase=ring:hit=5", 200000),
+        ):
+            point[label] = _run_elastic_point(n, inject, elems,
+                                              peer_timeout)
+        point["kill_ring_rejoin"] = _run_elastic_point(
+            n, "kill:rank=0:phase=ring:hit=5", 100000, peer_timeout,
+            restart=True)
+        lat = [p["shrink_latency_max_s"] for p in point.values()
+               if p.get("shrink_latency_max_s") is not None]
+        if lat:
+            point["failover_latency_worst_s"] = max(lat)
         results[f"np{n}"] = point
     return results
 
@@ -3570,6 +3624,10 @@ def build_parser() -> argparse.ArgumentParser:
                          "writes BENCH_r11.json")
     ap.add_argument("--elastic-peer-timeout", type=float, default=5.0)
     ap.add_argument("--elastic-max-np", type=int, default=4)
+    ap.add_argument("--failover", action="store_true",
+                    help="run ONLY the coordinator fail-over chaos bench "
+                         "(wire v10: SIGKILL rank 0, successor election, "
+                         "dead-slot rejoin); writes BENCH_r16.json")
     ap.add_argument("--process-sets", action="store_true",
                     help="run ONLY the process-set concurrency bench "
                          "(two disjoint sets concurrent vs the same work "
@@ -3815,6 +3873,24 @@ def main() -> None:
                         "world_changes"),
                 }
         print(json.dumps({"elastic": compact, "full": "BENCH_r11.json"}))
+        return
+    if args.failover:
+        # coordinator fail-over only: chaos launches — a few minutes,
+        # own artifact
+        out = bench_failover(args)
+        with open(os.path.join(REPO, "BENCH_r16.json"), "w") as f:
+            json.dump(out, f, indent=1)
+        compact = {}
+        for k, v in out.items():
+            if k.startswith("np"):
+                compact[k] = {
+                    "worst_failover_s": v.get("failover_latency_worst_s"),
+                    "coordinator": v.get("kill_ring", {}).get(
+                        "coordinator"),
+                    "rejoin_joins": v.get("kill_ring_rejoin", {}).get(
+                        "rank_joins"),
+                }
+        print(json.dumps({"failover": compact, "full": "BENCH_r16.json"}))
         return
     if args.fault:
         # fault-domain only: chaos launches + one negotiation run — a few

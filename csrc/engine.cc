@@ -1001,6 +1001,13 @@ class Engine {
     out[3] = elastic_ ? 1 : 0;
   }
 
+  // The acting coordinator's LAUNCH slot (0 until a fail-over elects a
+  // successor) — readable from any thread for the hvd_coordinator_rank
+  // gauge and hvd.coordinator_rank().
+  int CoordinatorSlot() const {
+    return coord_slot_pub_.load(std::memory_order_relaxed);
+  }
+
  private:
   void BackgroundLoop();
   void WaitForWork(std::chrono::microseconds max_wait);
@@ -1121,10 +1128,44 @@ class Engine {
   // — caller abandons the tick), abort classically otherwise (returns 1).
   int OnWorkerDeath(int dead_rank, const std::string& why);
   // Coordinator: run the propose/ack/commit protocol and rebuild.  `dead`
-  // holds already-closed old ranks; join admits the pending joiner.
-  // Returns true when the change had to abort instead.
+  // holds already-closed old ranks; join admits every queued joiner in
+  // ONE round (wire v10 satellite).  `self_old` is the proposer's own
+  // OLD rank — 0 in steady state, the successor's pre-election rank when
+  // a coordinator fail-over drives the round (the proposer always ends up
+  // the lowest survivor, hence new rank 0).  Returns true when the change
+  // had to abort instead.
   bool CoordinateWorldChange(std::vector<int> dead, const std::string& why,
-                             bool join);
+                             bool join, int self_old = 0);
+  // -- coordinator fail-over (wire v10) -----------------------------------
+  // Worker: rank 0 is gone (socket loss or heartbeat expiry — the same
+  // signals that abort a non-elastic job).  In an elastic world the
+  // survivors elect the lowest surviving rank instead of dying: this rank
+  // fails its in-flight cycle retryable, then either registers with a
+  // lower-ranked candidate (dialing its data listener from the last
+  // shipped bootstrap table) and adopts the successor's shrink round, or
+  // — when no lower candidate answers — becomes the successor itself.
+  // Returns true when the job must stop (abort ran), false when the
+  // fail-over succeeded and the engine continues in the shrunk world.
+  bool OnCoordinatorLoss(const std::string& why);
+  // The elected successor's half: collect kCoordElect registrations from
+  // the other survivors on the data listener, inherit the membership-owner
+  // duties (re-bind the rendezvous/join listener on the job's original
+  // port), and drive a normal kWorldChange shrink round that renumbers
+  // this rank to 0.  True = had to abort.
+  bool FailoverBecomeCoordinator(const std::string& why, int64_t t0_ns);
+  // How long the successor waits for survivor registrations (and a
+  // survivor waits for each candidate's proposal): must cover the skew
+  // between detection times — a survivor parked in a data transfer only
+  // notices the death after its data-plane bound expires.
+  double FailoverWindowSeconds() const;
+  // -- dead-link-vs-dead-rank arbitration (wire v10) ----------------------
+  // Record the accused peer behind a data-plane failure (wire threads) so
+  // the bg thread can ask the coordinator to probe it; returns st.
+  Status NoteWireFail(int peer, Status st);
+  bool ProbeAccusedDead(int a);  // shared arbitration evidence gathering
+  // Worker bg thread: send one kArbitrate request per accused peer.
+  void MaybeSendArbitration();
+  int CoordinatorSelfArbitrate();  // 0 none, 1 aborted, 2 world changed
   // Worker: apply a received world-change proposal (ack, await commit,
   // rebuild); loops internally when superseded.  true = aborted (stop).
   bool HandleWorldChange(WorldChangeFrame wc);
@@ -1137,8 +1178,9 @@ class Engine {
   enum class WcWait { kCommitted, kSuperseded, kAborted, kLost, kTimeout };
   WcWait AwaitWorldCommit(WorldChangeFrame* wc, double bound_s,
                           AbortFrame* abort_out);
-  // Shared tail: counters, epoch bump, fresh heartbeat clock.
-  void FinishWorldChange(bool join, int64_t t0_ns);
+  // Shared tail: counters, epoch bump, fresh heartbeat clock.  `njoins`
+  // is how many joiner slots this change admitted (0 for a shrink).
+  void FinishWorldChange(int njoins, int64_t t0_ns);
   // Rank 0: admit one pending joiner from the rendezvous listener.
   // 0 = none, 1 = aborted, 2 = world changed.
   int MaybeAcceptJoin();
@@ -1395,14 +1437,36 @@ class Engine {
   bool hier_default_ = false;            // table-derived default (pm_ init)
   Listener rendezvous_;                  // rank 0, elastic: joiners dial it
   bool rendezvous_open_ = false;
+  int rendezvous_port_ = 0;              // the job's advertised rendezvous
+                                         // port — a fail-over successor
+                                         // re-binds it so relaunched
+                                         // joiners still find the job
   uint64_t world_proposal_ = 0;          // coordinator: last proposal sent
-  struct PendingJoin {                   // rank 0: one joiner at a time
-    Socket sock;
-    std::string host, hash;
+  struct PendingJoin {                   // rank 0: queued joiners, admitted
+    Socket sock;                         // together in ONE world change
+    std::string host, hash;              // (wire v10 satellite)
     int port = 0;
     bool live = false;
   };
-  PendingJoin join_;
+  std::vector<PendingJoin> joins_;
+  int64_t join_settle_deadline_ns_ = 0;  // bg thread only
+  // -- coordinator fail-over (wire v10) -----------------------------------
+  // birth_slot_: this process's LAUNCH slot (HOROVOD_TPU_RANK) — stable
+  // across renumbering, so operators can name the acting coordinator in
+  // launch terms.  coord_slot_ is the acting coordinator's birth slot:
+  // rank-0-decided, table-shipped (every member and joiner learns it),
+  // published for the hvd_coordinator_rank gauge.
+  int birth_slot_ = 0;
+  int coord_slot_ = 0;
+  std::atomic<int> coord_slot_pub_{0};
+  int failover_depth_ = 0;               // bg thread: cascading-election cap
+  // -- arbitration (wire v10) ---------------------------------------------
+  // accused peer behind the latest data-plane failure (wire threads set,
+  // bg thread ships one kArbitrate request per accusation); a link-only
+  // verdict for that peer makes ElasticizeWire stop tagging retryable.
+  std::atomic<int> arb_accused_{-1};
+  int arb_sent_for_ = -1;                // bg thread only
+  std::atomic<int> arb_link_only_{-1};
   // published world info for cross-thread readers (Python diagnostics):
   // the bg thread renumbers rank_/size_ mid-run, so readers on other
   // threads use these mirrors (and hb arrays are allocated once at
@@ -1678,6 +1742,15 @@ Comm& Engine::C() { return t_comm != nullptr ? *t_comm : world_comm_; }
 Status Engine::Init(const std::string& host, int port, int rank, int size) {
   rank_ = rank;
   size_ = size;
+  // fail-over collateral: the job's rendezvous port (a successor re-binds
+  // it when it inherits the membership-owner duties) and this process's
+  // launch slot (stable across elastic renumbering — what the
+  // hvd_coordinator_rank gauge names).  A joiner's env rank describes the
+  // dead slot it refills, which is exactly the identity operators want.
+  rendezvous_port_ = port;
+  birth_slot_ = static_cast<int>(EnvInt64("HOROVOD_TPU_RANK", rank));
+  coord_slot_ = rank == 0 ? birth_slot_ : 0;
+  coord_slot_pub_.store(coord_slot_, std::memory_order_relaxed);
   // flight recorder first: bootstrap itself should be on the record (a
   // rank SIGKILLed mid-rendezvous leaves a black box too).  File-backed
   // when HOROVOD_TPU_TRACE_DIR is set; HOROVOD_TPU_TRACE=0 disables.
@@ -2000,7 +2073,7 @@ std::string Engine::BuildTable(
         << " " << stripes_local_ << " " << nics_ << " "
         << stripe_quantum_ << " " << sg_threshold_ << " "
         << tune_stripes_on_ << " " << (elastic_ ? 1 : 0) << " " << min_np_
-        << " " << hosts.size() << " ";
+        << " " << coord_slot_ << " " << hosts.size() << " ";
   for (size_t i = 0; i < hosts.size(); i++)
     table << hosts[i] << " " << ports[i] << " " << hashes[i] << " ";
   // process-set registry (wire v8): membership changes renumber every set
@@ -2031,11 +2104,11 @@ Status Engine::ParseTable(const std::string& table,
   int64_t table_depth = 2, table_seg = 256 << 10;
   int64_t t_sc = 1, t_sl = 1, t_nics = 1, t_quant = 64 << 10,
           t_sg = 4 << 20;
-  int t_elastic = 0, t_min_np = 1;
+  int t_elastic = 0, t_min_np = 1, t_coord_slot = 0;
   int64_t count = 0;
   is >> *shm_token >> shm_on_ >> cache_capacity_ >> table_depth
      >> table_seg >> t_sc >> t_sl >> t_nics >> t_quant >> t_sg
-     >> tune_stripes_on_ >> t_elastic >> t_min_np >> count;
+     >> tune_stripes_on_ >> t_elastic >> t_min_np >> t_coord_slot >> count;
   if (!is || count < 1 || count > (1 << 20))
     return Status::Error("malformed bootstrap table");
   ApplyPipelineDepth(table_depth);
@@ -2047,6 +2120,10 @@ Status Engine::ParseTable(const std::string& table,
   sg_threshold_ = t_sg < 0 ? 0 : t_sg;
   elastic_ = t_elastic != 0;
   min_np_ = t_min_np < 1 ? 1 : t_min_np;
+  // the acting coordinator's launch slot: every member (and every joiner)
+  // learns it from whichever table admitted it to the current world
+  coord_slot_ = t_coord_slot < 0 ? 0 : t_coord_slot;
+  coord_slot_pub_.store(coord_slot_, std::memory_order_relaxed);
   hosts->assign(static_cast<size_t>(count), "");
   ports->assign(static_cast<size_t>(count), 0);
   hashes->assign(static_cast<size_t>(count), "");
@@ -2326,9 +2403,10 @@ Status Engine::JoinBootstrap(const std::string& host, int port,
         "elastic join: rendezvous with the coordinator failed (is the job "
         "running with HOROVOD_TPU_ELASTIC=1?): " + s.message);
   const char* adv = getenv("HOROVOD_TPU_DATA_ADDR");
+  std::string my_addr = adv ? adv : coord_.LocalAddr();
   std::ostringstream hello;
-  hello << "JOIN " << (adv ? adv : coord_.LocalAddr()) << " "
-        << data_listener_.port() << " " << my_hash;
+  hello << "JOIN " << my_addr << " " << data_listener_.port() << " "
+        << my_hash;
   s = coord_.SendFrame(hello.str());
   if (!s.ok()) return s;
   // the world-change frame that admits us doubles as our bootstrap table
@@ -2360,13 +2438,6 @@ Status Engine::JoinBootstrap(const std::string& host, int port,
     have = true;
   }
   for (;;) {
-    // my slot is the (single) joiner entry
-    int new_rank = -1;
-    for (size_t i = 0; i < wc.old_ranks.size(); i++)
-      if (wc.old_ranks[i] < 0) new_rank = static_cast<int>(i);
-    if (new_rank < 0)
-      return Status::Error(
-          "elastic join: admitting world-change frame has no joiner slot");
     std::vector<std::string> nh, nhash;
     std::vector<int> np;
     std::string token;
@@ -2374,6 +2445,29 @@ Status Engine::JoinBootstrap(const std::string& host, int port,
     if (!s.ok()) return s;
     if (nh.size() != wc.old_ranks.size())
       return Status::Error("elastic join: table/membership size mismatch");
+    // my slot among the joiner entries: one round may admit SEVERAL
+    // queued joiners (wire v10 multi-joiner admission), so match by the
+    // advertised (host, data-listener port) identity this worker sent in
+    // its rendezvous hello; a lone joiner slot is unambiguous either way
+    int new_rank = -1, joiner_slots = 0, lone = -1;
+    for (size_t i = 0; i < wc.old_ranks.size(); i++) {
+      if (wc.old_ranks[i] >= 0) continue;
+      joiner_slots++;
+      lone = static_cast<int>(i);
+      if (np[i] == data_listener_.port() && nh[i] == my_addr) {
+        new_rank = static_cast<int>(i);  // exact identity always wins
+        break;
+      }
+    }
+    // the table ships each joiner's hello host VERBATIM, so the exact
+    // identity above is authoritative; a lone joiner slot stays
+    // unambiguous even if this worker's self-addressing disagrees
+    if (new_rank < 0 && joiner_slots == 1) new_rank = lone;
+    if (new_rank < 0)
+      return Status::Error(
+          "elastic join: admitting world-change frame has no joiner slot "
+          "matching this worker (" + my_addr + ":" +
+          std::to_string(data_listener_.port()) + ")");
     rank_ = new_rank;
     size_ = static_cast<int>(wc.old_ranks.size());
     hosts_ = std::move(nh);
@@ -2419,15 +2513,130 @@ Status Engine::ElasticizeWire(Status st) {
   }
   if (st.message.compare(0, strlen(kWorldChangeTag), kWorldChangeTag) == 0)
     return st;
-  // streak guard: repeated wire failures with no world change applied in
-  // between mean nobody is dying — a retryable tag would livelock the
-  // caller's wait-for-world_changed() loop, so let the raw error through
-  if (elastic_wire_fails_.fetch_add(1, std::memory_order_relaxed) >= 3)
+  // dead-link-vs-dead-rank ARBITRATION (wire v10): instead of the local
+  // streak guard guessing, the accused peer behind this failure is probed
+  // by the coordinator in one round trip (MaybeSendArbitration ships the
+  // request; the verdict lands on a later tick).  A link-only verdict
+  // means the peer is control-plane-live — no shrink is coming, so the
+  // raw error surfaces as fatal immediately instead of luring the caller
+  // into a retry livelock.
+  int accused = arb_accused_.load(std::memory_order_relaxed);
+  if (accused >= 0 &&
+      arb_link_only_.load(std::memory_order_relaxed) == accused)
+    return Status::Error(
+        st.message + " — coordinator arbitration: rank " +
+        std::to_string(accused) +
+        " is control-plane-live, so this is a wire-only failure "
+        "(dead link, not a dead rank) and no world change is coming");
+  // rank 0's own accusations are arbitrated by CoordinatorSelfArbitrate
+  // on the bg thread (which owns the worker control sockets and so can
+  // run the same active probe the remote path uses — recency alone races
+  // a freshly-dead peer whose ring transfer failed milliseconds before
+  // the control plane noticed); the verdict surfaces here on the retry.
+  // streak backstop: repeated wire failures with neither a world change
+  // nor an arbitration verdict in between — let the raw error through
+  // rather than retry forever (e.g. the coordinator itself unreachable)
+  if (elastic_wire_fails_.fetch_add(1, std::memory_order_relaxed) >= 6)
     return st;
   return Status::Error(
       std::string(kWorldChangeTag) + " " + st.message +
       " — if the peer is dead the world will shrink; retry after "
       "hvd.world_changed()");
+}
+
+Status Engine::NoteWireFail(int peer, Status st) {
+  // record the accused behind a data-plane failure (wire threads call
+  // this; the bg thread ships one kArbitrate probe per accusation).
+  // Aborted/poisoned cancellations are not accusations — their cause is
+  // already known — so callers wrap only genuine peer-transfer failures.
+  if (!st.ok() && peer >= 0)
+    arb_accused_.store(peer, std::memory_order_relaxed);
+  return st;
+}
+
+void Engine::MaybeSendArbitration() {
+  if (rank_ == 0 || !elastic_) return;
+  int accused = arb_accused_.load(std::memory_order_relaxed);
+  if (accused < 0 || accused == arb_sent_for_) return;
+  ArbitrateFrame af;
+  af.rank = rank_;
+  af.accused = accused;
+  af.verdict = kArbitrateRequest;
+  // best effort: a send failure here means the coordinator itself is in
+  // trouble — the heartbeat/loss machinery owns that path
+  if (SendCtrl(coord_, Serialize(af)).ok()) {
+    arb_sent_for_ = accused;
+    Faults().arb_requests.fetch_add(1, std::memory_order_relaxed);
+    hb_last_tx_ns_ = NowNs();
+  }
+}
+
+bool Engine::ProbeAccusedDead(int a) {
+  // the arbitration evidence, shared by the remote kArbitrate handler
+  // and the coordinator's self-arbitration: liveness records first, then
+  // an active probe on the accused's control socket.  One buffered write
+  // is NOT proof of life — a freshly-SIGKILLed peer's kernel accepts the
+  // first write and only answers with an RST — so the probe is
+  // write / settle / write: the second write fails on a reset socket,
+  // and a false link-only verdict would turn a survivable death into a
+  // fatal error on the accusing rank.
+  bool dead = !workers_[a].valid() ||
+              worker_live_[a].load(std::memory_order_relaxed) == 0;
+  if (!dead && peer_timeout_s_ > 0) {
+    double age =
+        (NowNs() - hb_seen_[a].load(std::memory_order_relaxed)) / 1e9;
+    dead = age > peer_timeout_s_;
+  }
+  if (!dead) {
+    HeartbeatFrame hb;
+    hb.rank = 0;
+    if (!SendCtrl(workers_[a], Serialize(hb)).ok()) {
+      dead = true;
+    } else {
+      // give a just-dead peer's RST time to land (readable on a live
+      // link just means queued worker frames — harmless), then demand a
+      // second successful write.  The settle window scales with the
+      // data-plane timeout so a congested cross-host RST still makes it
+      // back — a false link-only verdict fatally kills the accuser, so
+      // erring slow here is the cheap side.
+      int settle_ms = static_cast<int>(
+          std::max(50.0, std::min(500.0, DuplexTimeoutSeconds() * 100)));
+      (void)workers_[a].Readable(settle_ms);
+      if (!SendCtrl(workers_[a], Serialize(hb)).ok())
+        dead = true;
+      else
+        Faults().heartbeats_tx.fetch_add(2, std::memory_order_relaxed);
+    }
+  }
+  return dead;
+}
+
+int Engine::CoordinatorSelfArbitrate() {
+  // rank 0 arbitrates its own accusations with the SAME evidence a
+  // worker-reported accusation gets (ProbeAccusedDead).  Runs on the bg
+  // thread (which owns workers_).  A dead accused drives the normal
+  // shrink instead of a fatal verdict; a provably-live one earns the
+  // link-only verdict ElasticizeWire surfaces on the next retry.
+  if (!elastic_ || rank_ != 0) return 0;
+  int a = arb_accused_.load(std::memory_order_relaxed);
+  if (a < 0 || a == arb_sent_for_) return 0;
+  arb_sent_for_ = a;
+  if (a < 1 || a >= size_) return 0;
+  Faults().arb_requests.fetch_add(1, std::memory_order_relaxed);
+  if (ProbeAccusedDead(a)) {
+    Faults().arb_dead_verdicts.fetch_add(1, std::memory_order_relaxed);
+    worker_live_[a].store(0, std::memory_order_relaxed);
+    workers_[a].Close();
+    return OnWorkerDeath(
+               a, "rank " + std::to_string(a) +
+                  " found dead by arbitration (accused by the "
+                  "coordinator after a data-plane failure)") == 1
+               ? 1
+               : 2;
+  }
+  Faults().arb_link_verdicts.fetch_add(1, std::memory_order_relaxed);
+  arb_link_only_.store(a, std::memory_order_relaxed);
+  return 0;
 }
 
 void Engine::BeginWorldChange(const Status& cause) {
@@ -2493,25 +2702,36 @@ int Engine::OnWorkerDeath(int dead_rank, const std::string& why) {
 }
 
 bool Engine::CoordinateWorldChange(std::vector<int> dead,
-                                   const std::string& why, bool join) {
+                                   const std::string& why, bool join,
+                                   int self_old) {
   int64_t t0 = NowNs();
   timeline_.FaultMark(join ? "WORLD_JOIN" : "WORLD_SHRINK");
   if (!dead.empty()) timeline_.FaultMark("PEER_DEAD");
   LogWarn(std::string("elastic world change (") +
           (join ? "join" : "shrink") + "): " + why);
   BeginWorldChange(MakeWorldChangeStatus(why));
-  bool joiner = join && join_.live;
+  // multi-joiner admission (wire v10 satellite): every queued joiner whose
+  // socket is still live rides this ONE round — an N-rank relaunch pays
+  // one shrink-free grow instead of N serialized world changes (counted
+  // fresh each propose round; a joiner dying mid-round demotes the change)
+  int live_joins = 0;
   std::vector<int> survivors;
   int new_size = 0;
   WorldChangeFrame wc;
   std::string token;
   for (;;) {  // propose rounds: every death detected mid-round restarts it
-    survivors.assign(1, 0);
+    // the proposer survives by construction: rank 0 in steady state, the
+    // elected successor (its own OLD rank, the lowest surviving) during a
+    // coordinator fail-over — either way it sorts first, hence new rank 0
+    survivors.assign(1, self_old);
     for (int i = 1; i < size_; i++)
-      if (workers_[i].valid() &&
+      if (i != self_old && workers_[i].valid() &&
           std::find(dead.begin(), dead.end(), i) == dead.end())
         survivors.push_back(i);
-    new_size = static_cast<int>(survivors.size()) + (joiner ? 1 : 0);
+    live_joins = 0;
+    if (join)
+      for (auto& j : joins_) live_joins += j.live ? 1 : 0;
+    new_size = static_cast<int>(survivors.size()) + live_joins;
     if (new_size < min_np_) {
       AbortJob(Status::Error(
                    why + " — world would shrink to " +
@@ -2525,8 +2745,8 @@ bool Engine::CoordinateWorldChange(std::vector<int> dead,
     wc = WorldChangeFrame{};
     wc.epoch = ++world_proposal_;
     // the live joiner state, not the join argument: a joiner whose socket
-    // breaks mid-round demotes the change to a plain shrink
-    wc.kind = joiner ? 1 : 0;
+    // breaks mid-round demotes (or shrinks) the change
+    wc.kind = live_joins > 0 ? 1 : 0;
     wc.message = why;
     for (int d : dead) wc.dead_ranks.push_back(d);
     for (int r : survivors) {
@@ -2535,10 +2755,11 @@ bool Engine::CoordinateWorldChange(std::vector<int> dead,
       nhash.push_back(hashes_[r]);
       wc.old_ranks.push_back(r);
     }
-    if (joiner) {
-      nh.push_back(join_.host);
-      np.push_back(join_.port);
-      nhash.push_back(join_.hash);
+    for (auto& j : joins_) {
+      if (!j.live) continue;
+      nh.push_back(j.host);
+      np.push_back(j.port);
+      nhash.push_back(j.hash);
       wc.old_ranks.push_back(-1);
     }
     token = NewShmToken();
@@ -2563,7 +2784,7 @@ bool Engine::CoordinateWorldChange(std::vector<int> dead,
     std::string frame = Serialize(wc);
     bool redo = false;
     for (int r : survivors) {
-      if (r == 0) continue;
+      if (r == self_old) continue;
       if (!SendCtrl(workers_[r], frame).ok()) {
         worker_live_[r].store(0, std::memory_order_relaxed);
         workers_[r].Close();
@@ -2571,10 +2792,11 @@ bool Engine::CoordinateWorldChange(std::vector<int> dead,
         redo = true;
       }
     }
-    if (joiner && !join_.sock.SendFrame(frame).ok()) {
-      join_.live = false;
-      joiner = false;
-      redo = true;
+    for (auto& j : joins_) {
+      if (j.live && !j.sock.SendFrame(frame).ok()) {
+        j.live = false;
+        redo = true;
+      }
     }
     if (redo) continue;
     // collect one ack per member; a socket that breaks (or a member that
@@ -2586,13 +2808,15 @@ bool Engine::CoordinateWorldChange(std::vector<int> dead,
     // wedged round to minutes.
     std::set<int> pending;
     for (int r : survivors)
-      if (r != 0) pending.insert(r);
-    bool jpending = joiner;
+      if (r != self_old) pending.insert(r);
+    std::set<size_t> jpending;
+    for (size_t j = 0; j < joins_.size(); j++)
+      if (joins_[j].live) jpending.insert(j);
     double ack_bound = DuplexTimeoutSeconds() + 10;
     if (ack_bound < 30) ack_bound = 30;
     auto deadline = std::chrono::steady_clock::now() +
                     std::chrono::duration<double>(ack_bound);
-    while ((!pending.empty() || jpending) && !redo) {
+    while ((!pending.empty() || !jpending.empty()) && !redo) {
       if (std::chrono::steady_clock::now() > deadline) break;
       bool moved = false;
       for (auto it = pending.begin(); it != pending.end() && !redo;) {
@@ -2624,22 +2848,31 @@ bool Engine::CoordinateWorldChange(std::vector<int> dead,
         }
         it = acked ? pending.erase(it) : ++it;
       }
-      if (jpending && !redo && join_.sock.Readable(0)) {
+      for (auto it = jpending.begin(); it != jpending.end() && !redo;) {
+        PendingJoin& j = joins_[*it];
+        if (!j.sock.Readable(0)) {
+          ++it;
+          continue;
+        }
         std::string fr;
-        if (!join_.sock.RecvFrame(&fr).ok()) {
-          join_.live = false;
-          joiner = false;
+        if (!j.sock.RecvFrame(&fr).ok()) {
+          j.live = false;
+          it = jpending.erase(it);
           redo = true;
-        } else if (FrameTypeOf(fr) == FrameType::kWorldAck) {
-          WorldAckFrame af;
-          if (Parse(fr, &af).ok() && af.epoch == wc.epoch) jpending = false;
+          break;
         }
         moved = true;
+        bool acked = false;
+        if (FrameTypeOf(fr) == FrameType::kWorldAck) {
+          WorldAckFrame af;
+          if (Parse(fr, &af).ok() && af.epoch == wc.epoch) acked = true;
+        }
+        it = acked ? jpending.erase(it) : ++it;
       }
       if (!moved && !redo)
         std::this_thread::sleep_for(std::chrono::milliseconds(2));
     }
-    if (!redo && (!pending.empty() || jpending)) {
+    if (!redo && (!pending.empty() || !jpending.empty())) {
       for (int r : pending) {
         LogWarn("elastic: rank " + std::to_string(r) +
                 " never acked the world change — presumed dead");
@@ -2647,10 +2880,7 @@ bool Engine::CoordinateWorldChange(std::vector<int> dead,
         workers_[r].Close();
         dead.push_back(r);
       }
-      if (jpending) {
-        join_.live = false;
-        joiner = false;
-      }
+      for (size_t j : jpending) joins_[j].live = false;
       redo = true;
     }
     if (redo) continue;
@@ -2659,7 +2889,7 @@ bool Engine::CoordinateWorldChange(std::vector<int> dead,
     cf.epoch = wc.epoch;
     std::string cframe = Serialize(cf);
     for (int r : survivors) {
-      if (r == 0) continue;
+      if (r == self_old) continue;
       if (!SendCtrl(workers_[r], cframe).ok()) {
         // a death THIS late cannot be re-proposed (already-committed
         // members are rebuilding the mesh and no longer read control
@@ -2669,34 +2899,43 @@ bool Engine::CoordinateWorldChange(std::vector<int> dead,
         workers_[r].Close();
       }
     }
-    if (joiner) (void)join_.sock.SendFrame(cframe);
+    for (auto& j : joins_)
+      if (j.live) (void)j.sock.SendFrame(cframe);
     break;
   }
-  // apply the membership locally (rank 0 keeps rank 0 by construction:
-  // coordinator death always aborts, so the coordinator always survives)
+  // apply the membership locally.  The proposer is always the lowest
+  // surviving old rank (rank 0 in steady state; the elected successor
+  // during a fail-over), so it takes new rank 0 by construction.
   std::vector<Socket> nworkers(static_cast<size_t>(new_size));
   std::vector<std::string> nh, nhash;
   std::vector<int> np;
   for (size_t i = 0; i < survivors.size(); i++) {
     int r = survivors[i];
-    if (r != 0) nworkers[i] = std::move(workers_[r]);
+    if (r != self_old) nworkers[i] = std::move(workers_[r]);
     nh.push_back(hosts_[r]);
     np.push_back(ports_[r]);
     nhash.push_back(hashes_[r]);
   }
-  if (joiner) {
-    nworkers[static_cast<size_t>(new_size) - 1] = std::move(join_.sock);
-    nh.push_back(join_.host);
-    np.push_back(join_.port);
-    nhash.push_back(join_.hash);
+  int admitted_joins = 0;
+  {
+    size_t slot = survivors.size();
+    for (auto& j : joins_) {
+      if (!j.live) continue;
+      nworkers[slot++] = std::move(j.sock);
+      nh.push_back(j.host);
+      np.push_back(j.port);
+      nhash.push_back(j.hash);
+      admitted_joins++;
+    }
   }
-  join_.live = false;
+  joins_.clear();
   workers_ = std::move(nworkers);
   hosts_ = std::move(nh);
   ports_ = std::move(np);
   hashes_ = std::move(nhash);
   shm_token_ = token;
   size_ = new_size;
+  rank_ = 0;  // the proposer is the lowest survivor — new rank 0
   {
     std::lock_guard<std::mutex> lk(mu_);
     aborted_ = false;
@@ -2709,7 +2948,7 @@ bool Engine::CoordinateWorldChange(std::vector<int> dead,
              -1);
     return true;
   }
-  FinishWorldChange(joiner, t0);
+  FinishWorldChange(admitted_joins, t0);
   return false;
 }
 
@@ -2737,10 +2976,12 @@ bool Engine::HandleWorldChange(WorldChangeFrame wc) {
     WorldAckFrame ack;
     ack.rank = new_rank;
     ack.epoch = wc.epoch;
+    // coordinator loss mid-change is a fail-over trigger like any other
+    // (the "SIGKILL rank 0 mid-world-change" chaos row): the survivors'
+    // membership view is still the OLD world (adoption happens only at
+    // commit), so the election runs in a rank space everyone shares
     if (!SendCtrl(coord_, Serialize(ack)).ok())
-      return AbortJob(Status::Error("lost coordinator (rank 0) during the "
-                                    "world change — aborting"),
-                      0);
+      return OnCoordinatorLoss("connection lost during the world change");
     // must exceed the coordinator's ack bound (it may be waiting out a
     // wedged member before committing or re-proposing)
     double bound = DuplexTimeoutSeconds() + 30;
@@ -2749,15 +2990,11 @@ bool Engine::HandleWorldChange(WorldChangeFrame wc) {
     WcWait w = AwaitWorldCommit(&wc, bound, &af);
     if (w == WcWait::kSuperseded) continue;  // re-apply the newer proposal
     if (w == WcWait::kTimeout)
-      return AbortJob(
-          Status::Error("no world-commit from the coordinator within " +
-                        std::to_string(static_cast<int>(bound)) +
-                        "s — presumed dead; aborting"),
-          0);
+      return OnCoordinatorLoss(
+          "no world-commit within " +
+          std::to_string(static_cast<int>(bound)) + "s");
     if (w == WcWait::kLost)
-      return AbortJob(Status::Error("lost coordinator (rank 0) during "
-                                    "the world change — aborting"),
-                      0);
+      return OnCoordinatorLoss("connection lost during the world change");
     if (w == WcWait::kAborted)
       return AbortJob(Status::Error(af.message), af.dead_rank);
     rank_ = new_rank;
@@ -2778,13 +3015,19 @@ bool Engine::HandleWorldChange(WorldChangeFrame wc) {
   if (!s.ok())
     return AbortJob(
         Status::Error("elastic world rebuild failed: " + s.message), -1);
-  FinishWorldChange(wc.kind == 1, t0);
+  {
+    // joins applied this change = joiner slots in the adopted membership
+    int njoins = 0;
+    for (int64_t r : wc.old_ranks) njoins += r < 0 ? 1 : 0;
+    FinishWorldChange(wc.kind == 1 ? njoins : 0, t0);
+  }
   return false;
 }
 
-void Engine::FinishWorldChange(bool join, int64_t t0_ns) {
+void Engine::FinishWorldChange(int njoins, int64_t t0_ns) {
   Faults().world_changes.fetch_add(1, std::memory_order_relaxed);
-  if (join) Faults().rank_joins.fetch_add(1, std::memory_order_relaxed);
+  if (njoins > 0)
+    Faults().rank_joins.fetch_add(njoins, std::memory_order_relaxed);
   Faults().shrink_latency_ns.fetch_add(NowNs() - t0_ns,
                                        std::memory_order_relaxed);
   world_epoch_.fetch_add(1, std::memory_order_relaxed);
@@ -2797,6 +3040,12 @@ void Engine::FinishWorldChange(bool join, int64_t t0_ns) {
   TraceAutoDump(TracePhase::kWorldChange,
                 world_epoch_.load(std::memory_order_relaxed));
   elastic_wire_fails_.store(0, std::memory_order_relaxed);
+  // arbitration state names OLD-world ranks: a change resolves (or
+  // obsoletes) every outstanding accusation and verdict
+  arb_accused_.store(-1, std::memory_order_relaxed);
+  arb_link_only_.store(-1, std::memory_order_relaxed);
+  arb_sent_for_ = -1;
+  failover_depth_ = 0;  // a committed world has a live coordinator again
   {
     // a shutdown announced DURING the change was discarded with the rest
     // of the old-world control traffic: re-announce it in the new world
@@ -2811,51 +3060,357 @@ void Engine::FinishWorldChange(bool join, int64_t t0_ns) {
 
 int Engine::MaybeAcceptJoin() {
   if (!elastic_ || rank_ != 0 || !rendezvous_open_) return 0;
-  Socket sock;
-  if (!rendezvous_.Accept(&sock, 0.0).ok()) return 0;  // poll-only
-  // a real joiner's hello is in flight before this tick polls the accept;
-  // the short bound keeps a hello-less connection (port scanner, LB
-  // health probe) from parking the negotiation thread
-  if (!sock.Readable(100)) {
-    LogWarn("elastic: rendezvous connection sent no hello — dropped");
+  // drain EVERY queued joiner before proposing (wire v10 satellite): an
+  // N-rank relaunch whose workers dialed the rendezvous port together is
+  // admitted in ONE world-change round instead of N serialized
+  // shrink/grow cycles — the accept loop polls until the backlog is dry.
+  // Per-tick time budget: a real joiner's hello costs microseconds, only
+  // STALLERS (port scanner, LB probe) eat the 100ms/2s bounds below, and
+  // a burst of them must not park the negotiation thread past the
+  // heartbeat cadence (workers would presume the coordinator dead and
+  // elect a successor out from under it).  The unread backlog stays in
+  // the kernel queue and the settle window still folds joiners drained
+  // on a LATER tick into the same world-change round.
+  int64_t drain_deadline_ns = NowNs() + static_cast<int64_t>(2.0e9);
+  for (;;) {
+    if (NowNs() > drain_deadline_ns) {
+      LogWarn("elastic: rendezvous drain budget spent this tick — "
+              "remaining backlog deferred to the next tick");
+      break;
+    }
+    Socket sock;
+    if (!rendezvous_.Accept(&sock, 0.0).ok()) break;  // poll-only
+    // a real joiner's hello is in flight before this tick polls the
+    // accept; the short bound keeps a hello-less connection (port
+    // scanner, LB health probe) from parking the negotiation thread.
+    // Both per-connection bounds shrink toward the remaining tick budget
+    // so the TOTAL stall stays ~the budget even when the last accepted
+    // connection is itself a staller.
+    int64_t left_ms = (drain_deadline_ns - NowNs()) / 1000000;
+    if (!sock.Readable(static_cast<int>(
+            std::max<int64_t>(10, std::min<int64_t>(100, left_ms))))) {
+      LogWarn("elastic: rendezvous connection sent no hello — dropped");
+      continue;
+    }
+    // Readable proves only the FIRST byte: bound the whole frame read
+    // too, or a partial-frame staller wedges the negotiation thread (and
+    // with it heartbeats — one stray TCP connection must never kill the
+    // job)
+    left_ms = (drain_deadline_ns - NowNs()) / 1000000;
+    sock.SetRecvTimeout(
+        std::max(0.1, std::min(2.0, static_cast<double>(left_ms) / 1e3)));
+    std::string hello;
+    Status hs = sock.RecvFrame(&hello);
+    sock.SetRecvTimeout(0);  // the socket lives on as the joiner's link
+    if (!hs.ok()) {
+      LogWarn("elastic: rendezvous hello never completed — dropped");
+      continue;
+    }
+    std::istringstream is(hello);
+    std::string tag, h, hash;
+    int p = 0;
+    is >> tag >> h >> p >> hash;
+    if (tag != "JOIN" || h.empty() || p <= 0) {
+      LogWarn("elastic: unrecognized rendezvous hello '" + hello +
+              "' — dropped");
+      continue;
+    }
+    if (size_ + static_cast<int>(joins_.size()) + 1 > hb_cap_) {
+      LogWarn("elastic: join rejected — world at liveness capacity");
+      continue;
+    }
+    PendingJoin j;
+    j.sock = std::move(sock);
+    j.host = h;
+    j.port = p;
+    j.hash = hash.empty() ? h : hash;
+    j.live = true;
+    joins_.push_back(std::move(j));
+  }
+  if (joins_.empty()) {
+    join_settle_deadline_ns_ = 0;
     return 0;
   }
-  // Readable proves only the FIRST byte: bound the whole frame read too,
-  // or a partial-frame staller wedges the negotiation thread (and with
-  // it heartbeats — one stray TCP connection must never kill the job)
-  sock.SetRecvTimeout(2.0);
-  std::string hello;
-  Status hs = sock.RecvFrame(&hello);
-  sock.SetRecvTimeout(0);  // the socket lives on as the joiner's link
-  if (!hs.ok()) {
-    LogWarn("elastic: rendezvous hello never completed — dropped");
-    return 0;
+  // settle window from the FIRST queued joiner: co-relaunched workers
+  // whose bootstraps skewed under load (hvdrun respawns the slots
+  // together, but process startup races) still ride ONE world-change
+  // round instead of N serialized grows.  Non-blocking — negotiation
+  // ticks keep running and later arrivals join the queue meanwhile.
+  int64_t now = NowNs();
+  if (join_settle_deadline_ns_ == 0) {
+    double settle = 0.5;
+    if (const char* s = getenv("HOROVOD_TPU_JOIN_SETTLE_S"))
+      settle = atof(s);
+    join_settle_deadline_ns_ = now + static_cast<int64_t>(settle * 1e9);
   }
-  std::istringstream is(hello);
-  std::string tag, h, hash;
-  int p = 0;
-  is >> tag >> h >> p >> hash;
-  if (tag != "JOIN" || h.empty() || p <= 0) {
-    LogWarn("elastic: unrecognized rendezvous hello '" + hello +
-            "' — dropped");
-    return 0;
-  }
-  if (size_ + 1 > hb_cap_) {
-    LogWarn("elastic: join rejected — world at liveness capacity");
-    return 0;
-  }
-  join_.sock = std::move(sock);
-  join_.host = h;
-  join_.port = p;
-  join_.hash = hash.empty() ? h : hash;
-  join_.live = true;
+  if (now < join_settle_deadline_ns_) return 0;
+  join_settle_deadline_ns_ = 0;
+  std::string who;
+  for (auto& j : joins_)
+    who += (who.empty() ? "" : ", ") + j.host + ":" +
+           std::to_string(j.port);
   return CoordinateWorldChange({},
-                               "rank join: relaunched worker at " + h + ":" +
-                                   std::to_string(p) +
+                               "rank join: " +
+                                   std::to_string(joins_.size()) +
+                                   " relaunched worker(s) at " + who +
                                    " re-entering the world",
                                /*join=*/true)
              ? 1
              : 2;
+}
+
+// ---------------------------------------------------------------------------
+// coordinator fail-over (wire v10): election, successor take-over
+// ---------------------------------------------------------------------------
+
+double Engine::FailoverWindowSeconds() const {
+  // must cover the detection-time skew between survivors: a rank whose bg
+  // thread is parked in a data transfer only notices the coordinator died
+  // when its data-plane bound expires, and heartbeat-based detection lags
+  // up to the peer timeout.  Generous is fine — the successor leaves the
+  // window early once every expected survivor has registered.
+  double w = peer_timeout_s_ > 0 ? peer_timeout_s_ : 10.0;
+  double d = DuplexTimeoutSeconds();
+  if (d > w) w = d;
+  if (w < 5.0) w = 5.0;
+  return w + 5.0;
+}
+
+bool Engine::OnCoordinatorLoss(const std::string& why) {
+  std::string cause = "coordinator (rank 0) " + why;
+  // the classic contract survives verbatim outside elastic mode: the
+  // coordinator's death is a job-ending abort naming rank 0
+  if (!elastic_ || ShutdownInFlight() || size_ < 2)
+    return AbortJob(Status::Error(cause + " — presumed dead; aborting"), 0);
+  if (size_ - 1 < min_np_)
+    return AbortJob(
+        Status::Error(cause + " — world would shrink to " +
+                      std::to_string(size_ - 1) + " < HOROVOD_TPU_MIN_NP=" +
+                      std::to_string(min_np_) + "; aborting job"),
+        0);
+  // cascading elections (the successor ALSO dies before committing) are
+  // survivable, but bound the recursion so a pathological flap cannot
+  // spin forever
+  if (++failover_depth_ > 3)
+    return AbortJob(Status::Error(cause + " — and " +
+                                  std::to_string(failover_depth_ - 1) +
+                                  " successor election(s) also failed; "
+                                  "aborting"),
+                    0);
+  int64_t t0 = NowNs();
+  timeline_.FaultMark("COORD_LOST");
+  LogWarn(cause + " — elastic fail-over: electing a successor");
+  // fail the in-flight cycle retryable and tear the old data plane down,
+  // exactly as a received world-change proposal would: the successor's
+  // shrink round is a NORMAL kWorldChange, this rank just doesn't know
+  // who drives it yet.  The dead coordinator's control socket goes too.
+  BeginWorldChange(MakeWorldChangeStatus(cause));
+  coord_.Close();
+  // the negotiation-epoch REPLAY contract: every response the dead
+  // coordinator acked ran on every rank in broadcast order (or dies with
+  // the cycle and retries), and a partially-broadcast frame may have
+  // reached SOME ranks — which is exactly why BeginWorldChange re-keyed
+  // every response-cache replica cold and failed in-flight handles with
+  // the retryable WorldShrunkError.  Nothing acked can double-execute
+  // (the new epoch renegotiates from empty replicas) and nothing pending
+  // is lost (cancelled handles retry through hvd.elastic.run; the local
+  // submit queue re-enters negotiation in the new world).
+  //
+  // deterministic succession: the lowest surviving rank self-elects.
+  // Candidates are probed in ascending order by dialing the data-listener
+  // address the last shipped bootstrap table recorded; a dead candidate's
+  // listener refuses instantly, and when every lower rank is unreachable
+  // this rank IS the lowest survivor.
+  uint64_t epoch =
+      static_cast<uint64_t>(world_epoch_.load(std::memory_order_relaxed));
+  for (int c = 1; c < rank_; c++) {
+    // 2 s covers a listener mid-accept-burst; a DEAD candidate's port
+    // refuses instantly and just pays the retry backoff until the bound
+    Socket sock;
+    if (!Socket::Connect(hosts_[c], ports_[c], &sock, 2.0).ok()) {
+      LogWarn("fail-over: candidate rank " + std::to_string(c) +
+              " unreachable — presumed dead too");
+      continue;
+    }
+    CoordElectFrame ef;
+    ef.rank = rank_;
+    ef.epoch = epoch;
+    if (!sock.SendFrame(Serialize(ef)).ok()) continue;
+    LogWarn("fail-over: registered with candidate rank " +
+            std::to_string(c) + " — awaiting its shrink round");
+    coord_ = std::move(sock);
+    // the successor collects registrations for up to the fail-over
+    // window before proposing, then runs the normal ack/commit round
+    double bound = FailoverWindowSeconds() + DuplexTimeoutSeconds() + 30;
+    auto deadline = std::chrono::steady_clock::now() +
+                    std::chrono::duration<double>(bound);
+    bool next_candidate = false;
+    while (!next_candidate) {
+      if (std::chrono::steady_clock::now() > deadline) {
+        LogWarn("fail-over: candidate rank " + std::to_string(c) +
+                " never proposed within " +
+                std::to_string(static_cast<int>(bound)) +
+                "s — trying the next candidate");
+        next_candidate = true;
+        break;
+      }
+      if (!coord_.Readable(100)) continue;
+      std::string fr;
+      if (!RecvCtrl(coord_, &fr).ok()) {
+        LogWarn("fail-over: candidate rank " + std::to_string(c) +
+                " dropped the election connection");
+        next_candidate = true;
+        break;
+      }
+      NoteSeen(0);  // the candidate is the coordinator-to-be
+      FrameType ft = FrameTypeOf(fr);
+      if (ft == FrameType::kHeartbeat) {
+        Faults().heartbeats_rx.fetch_add(1, std::memory_order_relaxed);
+        continue;
+      }
+      if (ft == FrameType::kAbort) {
+        AbortFrame af;
+        (void)Parse(fr, &af);
+        return AbortJob(Status::Error(af.message.empty()
+                                          ? "job aborted during the "
+                                            "coordinator fail-over"
+                                          : af.message),
+                        af.dead_rank);
+      }
+      if (ft == FrameType::kWorldChange) {
+        WorldChangeFrame wcf;
+        Status ps = Parse(fr, &wcf);
+        if (!ps.ok()) return AbortJob(ps, -1);
+        // the successor's proposal: adopt it through the one shared
+        // world-change path (ack + commit ride the new coord_ socket)
+        return HandleWorldChange(std::move(wcf));
+      }
+      // anything else is a stray — ignore
+    }
+    coord_.Close();
+  }
+  // no lower candidate answered: this rank is the lowest survivor
+  return FailoverBecomeCoordinator(cause, t0);
+}
+
+bool Engine::FailoverBecomeCoordinator(const std::string& why,
+                                       int64_t t0_ns) {
+  LogWarn("fail-over: this rank (old rank " + std::to_string(rank_) +
+          ") is the lowest survivor — taking over as coordinator");
+  timeline_.FaultMark("COORD_ELECT");
+  // collect kCoordElect registrations from the other survivors on the
+  // data listener.  The window closes early once every old rank has
+  // answered; ranks still silent at the deadline are presumed dead and
+  // ride the shrink's dead list.
+  std::map<int, Socket> regs;
+  uint64_t epoch =
+      static_cast<uint64_t>(world_epoch_.load(std::memory_order_relaxed));
+  // only ranks ABOVE this one can register (the election already proved
+  // every lower candidate dead, and the <= rank_ guard below rejects
+  // them anyway) — counting them would hold the window open its full
+  // length whenever a higher-numbered rank co-died with the coordinator
+  int expected = size_ - rank_ - 1;
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::duration<double>(FailoverWindowSeconds());
+  while (static_cast<int>(regs.size()) < expected &&
+         std::chrono::steady_clock::now() < deadline) {
+    Socket sock;
+    if (!data_listener_.Accept(&sock, 0.2).ok()) continue;
+    sock.SetRecvTimeout(2.0);
+    std::string fr;
+    Status rs = sock.RecvFrame(&fr);
+    sock.SetRecvTimeout(0);
+    if (!rs.ok()) continue;
+    CoordElectFrame ef;
+    if (FrameTypeOf(fr) != FrameType::kCoordElect ||
+        !Parse(fr, &ef).ok()) {
+      LogWarn("fail-over: non-election connection during the "
+              "registration window — dropped");
+      continue;
+    }
+    if (ef.epoch != epoch) {
+      // a partially-committed world change straddled the death: the
+      // sender lives in a different rank space — its election must fail
+      // (it will abort on its proposal bound) rather than corrupt ours
+      LogWarn("fail-over: rank " + std::to_string(ef.rank) +
+              " registered from world epoch " + std::to_string(ef.epoch) +
+              " != " + std::to_string(epoch) + " — rejected");
+      continue;
+    }
+    if (ef.rank <= rank_ || ef.rank >= size_) {
+      LogWarn("fail-over: implausible election registration from rank " +
+              std::to_string(ef.rank) + " — dropped");
+      continue;
+    }
+    LogWarn("fail-over: rank " + std::to_string(ef.rank) + " registered");
+    regs[ef.rank] = std::move(sock);
+  }
+  // inherit the coordinator's control star: registered survivors keep
+  // their old-rank slots until the shrink renumbers them
+  std::vector<int> dead{0};
+  workers_.clear();
+  workers_.resize(static_cast<size_t>(size_));
+  for (int i = 1; i < size_; i++) {
+    if (i == rank_) continue;
+    auto it = regs.find(i);
+    if (it == regs.end()) {
+      LogWarn("fail-over: rank " + std::to_string(i) +
+              " never registered — presumed dead with the coordinator");
+      dead.push_back(i);
+      worker_live_[i].store(0, std::memory_order_relaxed);
+      continue;
+    }
+    workers_[i] = std::move(it->second);
+    worker_live_[i].store(1, std::memory_order_relaxed);
+    hb_seen_[i].store(NowNs(), std::memory_order_relaxed);
+  }
+  // inherit the membership-owner duties: the rendezvous/join listener
+  // moves with the coordinator role.  The job's advertised port is free
+  // on this host exactly when the old coordinator lived elsewhere or
+  // died; if the bind still fails, keep running on an ephemeral port —
+  // the world survives, only relaunched joiners can't find it.
+  rendezvous_.Close();
+  rendezvous_open_ = false;
+  if (rank_ < static_cast<int>(hosts_.size()) && !hosts_.empty() &&
+      hosts_[static_cast<size_t>(rank_)] != hosts_[0]) {
+    // launchers pin HOROVOD_TPU_RENDEZVOUS to the ORIGINAL coordinator
+    // host at spawn, so relaunched joiners dial an address nothing
+    // listens on once the role moved across hosts — the world itself
+    // survives either way
+    LogWarn("fail-over: the coordinator role moved from host " +
+            hosts_[0] + " to " + hosts_[static_cast<size_t>(rank_)] +
+            " — relaunched joiners dialing the launch-time rendezvous "
+            "address will not find this job (same-host fail-over, or a "
+            "fresh launch, restores join)");
+  }
+  Status ls = rendezvous_.Listen("", rendezvous_port_);
+  if (!ls.ok()) {
+    LogWarn("fail-over: could not re-bind the rendezvous port " +
+            std::to_string(rendezvous_port_) + " (" + ls.message +
+            ") — joiners will not find this job until the next launch");
+    ls = rendezvous_.Listen("", 0);
+  }
+  rendezvous_open_ = ls.ok();
+  joins_.clear();
+  // proposals must supersede anything the dead coordinator had in flight
+  uint64_t wp = static_cast<uint64_t>(
+      world_epoch_.load(std::memory_order_relaxed));
+  if (world_proposal_ < wp) world_proposal_ = wp;
+  // the successor now owns the coordinator identity the table ships
+  coord_slot_ = birth_slot_;
+  coord_slot_pub_.store(coord_slot_, std::memory_order_relaxed);
+  int self_old = rank_;
+  bool aborted = CoordinateWorldChange(std::move(dead), why,
+                                       /*join=*/false, self_old);
+  if (!aborted) {
+    Faults().coord_failovers.fetch_add(1, std::memory_order_relaxed);
+    Faults().failover_latency_ns.fetch_add(NowNs() - t0_ns,
+                                           std::memory_order_relaxed);
+    LOG_RANK(Warning, rank_)
+        << "fail-over complete: launch slot " << birth_slot_
+        << " is now the coordinator (rank 0 of " << size_ << ")";
+  }
+  return aborted;
 }
 
 // ---------------------------------------------------------------------------
@@ -4247,8 +4802,7 @@ void Engine::WorkerTick(RequestList& local, bool* stop) {
       if (AuditSampleN() > 0) cb.audits = HealthTakeAudits(sid, rank_);
       Status s = SendCtrl(coord_, Serialize(cb));
       if (!s.ok()) {
-        *stop = AbortJob(
-            Status::Error("lost coordinator (rank 0): " + s.message), 0);
+        *stop = OnCoordinatorLoss("connection lost (" + s.message + ")");
         return;
       }
       hb_last_tx_ns_ = NowNs();
@@ -4257,8 +4811,7 @@ void Engine::WorkerTick(RequestList& local, bool* stop) {
       if (AuditSampleN() > 0) full.audits = HealthTakeAudits(sid, rank_);
       Status s = SendCtrl(coord_, Serialize(full));
       if (!s.ok()) {
-        *stop = AbortJob(
-            Status::Error("lost coordinator (rank 0): " + s.message), 0);
+        *stop = OnCoordinatorLoss("connection lost (" + s.message + ")");
         return;
       }
       hb_last_tx_ns_ = NowNs();
@@ -4272,8 +4825,7 @@ void Engine::WorkerTick(RequestList& local, bool* stop) {
     std::string frame;
     Status s = RecvCtrl(coord_, &frame);
     if (!s.ok()) {
-      *stop = AbortJob(
-          Status::Error("lost coordinator (rank 0): " + s.message), 0);
+      *stop = OnCoordinatorLoss("connection lost (" + s.message + ")");
       return;
     }
     NoteSeen(0);  // any coordinator frame is a liveness proof
@@ -4306,6 +4858,20 @@ void Engine::WorkerTick(RequestList& local, bool* stop) {
     }
     if (ft == FrameType::kWorldCommit || ft == FrameType::kWorldAck) {
       continue;  // stale stragglers from a completed membership round
+    }
+    if (ft == FrameType::kArbitrate) {
+      // dead-link-vs-dead-rank verdict (wire v10): the coordinator probed
+      // the peer this rank accused and found it control-plane-live — the
+      // failure was wire-only, so ElasticizeWire stops tagging retryable
+      ArbitrateFrame af;
+      if (Parse(frame, &af).ok() && af.verdict == kArbitrateLinkOnly) {
+        arb_link_only_.store(af.accused, std::memory_order_relaxed);
+        Faults().arb_link_verdicts.fetch_add(1, std::memory_order_relaxed);
+        LogWarn("arbitration verdict: rank " + std::to_string(af.accused) +
+                " is control-plane-live — the data-plane failure is a "
+                "dead LINK, not a dead rank (no shrink coming)");
+      }
+      continue;
     }
     if (ft == FrameType::kCachedExec) {
       CachedExecFrame ce;
@@ -4389,6 +4955,14 @@ void Engine::WorkerTick(RequestList& local, bool* stop) {
 }
 
 bool Engine::CoordinatorTick(RequestList& local) {
+  // own data-plane accusations first: a dead accused shrinks the world
+  // (this tick's state died with it — abandon the tick, keep the loop),
+  // a live one stores the link-only verdict and the tick proceeds
+  {
+    int sa = CoordinatorSelfArbitrate();
+    if (sa == 1) return true;   // aborted: stop the loop
+    if (sa == 2) return false;  // shrunk: abandon this tick
+  }
   ResponseList out;  // the GLOBAL set's response list (tuned knobs +
                      // shutdown ride it, exactly as before)
   // per-set response lists for this tick's non-global traffic; created
@@ -4508,6 +5082,50 @@ bool Engine::CoordinatorTick(RequestList& local) {
               RegisterClaim(*ns, cb.rank, static_cast<int>(b * 8) + k,
                             cb.epoch, op);
         }
+      } else if (ft == FrameType::kArbitrate) {
+        // dead-link-vs-dead-rank arbitration request (wire v10): probe
+        // the accused in ONE round trip.  A dead accused runs the normal
+        // death path — the resulting world change IS the reporter's
+        // answer; a control-plane-live accused earns the reporter a
+        // link-only verdict so its retry loop stops waiting for a shrink
+        // that will never come.
+        ArbitrateFrame af;
+        if (!Parse(frame, &af).ok() ||
+            af.verdict != kArbitrateRequest) continue;
+        int a = af.accused;
+        if (a == 0) {
+          // the accused is the coordinator itself, which is self-evidently
+          // control-plane-live (this request just arrived): the reporter's
+          // failed transfer to rank 0 was wire-only
+          ArbitrateFrame verdict;
+          verdict.rank = 0;
+          verdict.accused = 0;
+          verdict.verdict = kArbitrateLinkOnly;
+          (void)SendCtrl(workers_[i], Serialize(verdict));
+          hb_last_tx_ns_ = NowNs();
+          continue;
+        }
+        if (a < 1 || a >= size_ || a == i) {
+          LogWarn("arbitration request accusing implausible rank " +
+                  std::to_string(a) + " — ignored");
+          continue;
+        }
+        if (ProbeAccusedDead(a)) {
+          Faults().arb_dead_verdicts.fetch_add(1, std::memory_order_relaxed);
+          worker_live_[a].store(0, std::memory_order_relaxed);
+          workers_[a].Close();
+          int r = OnWorkerDeath(
+              a, "rank " + std::to_string(a) + " found dead by " +
+                 "arbitration (accused by rank " + std::to_string(i) +
+                 " after a data-plane failure)");
+          return r == 1;  // shrunk (or aborted): this tick's state is gone
+        }
+        ArbitrateFrame verdict;
+        verdict.rank = 0;
+        verdict.accused = a;
+        verdict.verdict = kArbitrateLinkOnly;
+        (void)SendCtrl(workers_[i], Serialize(verdict));
+        hb_last_tx_ns_ = NowNs();
       } else {
         RequestList probe;
         Status ps = Parse(frame, &probe);
@@ -5190,27 +5808,23 @@ bool Engine::WorkerFaultTick(bool shutdown_in_flight) {
     double age = (now - hb_seen_[0].load(std::memory_order_relaxed)) / 1e9;
     if (age > peer_timeout_s_) {
       Faults().peer_timeouts.fetch_add(1, std::memory_order_relaxed);
-      return AbortJob(
-          Status::Error(
-              "coordinator (rank 0) sent no control frames for " +
-              std::to_string(static_cast<int>(age)) +
-              "s (HOROVOD_TPU_PEER_TIMEOUT_S=" +
-              std::to_string(static_cast<int>(peer_timeout_s_)) +
-              ") — presumed dead; aborting"),
-          0);
+      return OnCoordinatorLoss(
+          "sent no control frames for " +
+          std::to_string(static_cast<int>(age)) +
+          "s (HOROVOD_TPU_PEER_TIMEOUT_S=" +
+          std::to_string(static_cast<int>(peer_timeout_s_)) + ")");
     }
   }
   if (hb_interval_s_ > 0 && (now - hb_last_tx_ns_) / 1e9 > hb_interval_s_) {
     HeartbeatFrame f;
     f.rank = rank_;
     if (!SendCtrl(coord_, Serialize(f)).ok())
-      return AbortJob(
-          Status::Error("lost coordinator (rank 0) on heartbeat — "
-                        "presumed dead; aborting"),
-          0);
+      return OnCoordinatorLoss("unreachable on heartbeat");
     Faults().heartbeats_tx.fetch_add(1, std::memory_order_relaxed);
     hb_last_tx_ns_ = now;
   }
+  // dead-link-vs-dead-rank arbitration: ship one request per accusation
+  MaybeSendArbitration();
   return false;
 }
 
@@ -6188,8 +6802,9 @@ Status Engine::PeerSendAll(int r, const void* data, size_t n) {
     } else {
       int kk = link.SendSome(p, n);
       if (kk < 0)
-        return Status::Error("send to rank " + std::to_string(r) +
-                             " failed");
+        return NoteWireFail(r, Status::Error("send to rank " +
+                                             std::to_string(r) +
+                                             " failed"));
       k = static_cast<size_t>(kk);
     }
     if (k > 0) {
@@ -6206,9 +6821,9 @@ Status Engine::PeerSendAll(int r, const void* data, size_t n) {
     else
       SendBlockedWait(bo, link, n, /*fast_rx=*/false);
     if (Stalled(last_prog, Timeouts().oneway))
-      return PeerDeadStatus("peer send",
-                            "rank " + std::to_string(r),
-                            Timeouts().oneway);
+      return NoteWireFail(r, PeerDeadStatus("peer send",
+                                            "rank " + std::to_string(r),
+                                            Timeouts().oneway));
   }
   return Status::OK();
 }
@@ -6230,8 +6845,9 @@ Status Engine::PeerRecvAll(int r, void* data, size_t n) {
     } else {
       int kk = link.RecvSome(p, n);
       if (kk < 0)
-        return Status::Error("recv from rank " + std::to_string(r) +
-                             " failed or closed");
+        return NoteWireFail(r, Status::Error("recv from rank " +
+                                             std::to_string(r) +
+                                             " failed or closed"));
       k = static_cast<size_t>(kk);
     }
     if (k > 0) {
@@ -6257,9 +6873,9 @@ Status Engine::PeerRecvAll(int r, void* data, size_t n) {
       bo.Wait();
     }
     if (Stalled(last_prog, Timeouts().oneway))
-      return PeerDeadStatus("peer recv",
-                            "rank " + std::to_string(r),
-                            Timeouts().oneway);
+      return NoteWireFail(r, PeerDeadStatus("peer recv",
+                                            "rank " + std::to_string(r),
+                                            Timeouts().oneway));
   }
   return Status::OK();
 }
@@ -6304,8 +6920,10 @@ Status Engine::PeerSendRecv(int r_send, const void* send_buf, size_t send_n,
         int k = stx_link.SendSome(sp, sleft);
         if (k < 0) {
           flush_idle();
-          return Status::Error("send to rank " +
-                               std::to_string(r_send) + " failed");
+          return NoteWireFail(r_send,
+                              Status::Error("send to rank " +
+                                            std::to_string(r_send) +
+                                            " failed"));
         }
         sp += k;
         sleft -= static_cast<size_t>(k);
@@ -6322,9 +6940,10 @@ Status Engine::PeerSendRecv(int r_send, const void* send_buf, size_t send_n,
         int k = srx_link.RecvSome(rp, rleft);
         if (k < 0) {
           flush_idle();
-          return Status::Error("recv from rank " +
-                               std::to_string(r_recv) +
-                               " failed or closed");
+          return NoteWireFail(r_recv,
+                              Status::Error("recv from rank " +
+                                            std::to_string(r_recv) +
+                                            " failed or closed"));
         }
         rp += k;
         rleft -= static_cast<size_t>(k);
@@ -6375,11 +6994,17 @@ Status Engine::PeerSendRecv(int r_send, const void* send_buf, size_t send_n,
     }
     if (Stalled(last_prog, Timeouts().duplex)) {
       flush_idle();
-      return PeerDeadStatus("peer exchange",
-                            "rank " + std::to_string(r_send) +
-                                " (send) / rank " + std::to_string(r_recv) +
-                                " (recv)",
-                            Timeouts().duplex);
+      // a stall names no single culprit when the two sides differ: the
+      // accused must be KNOWN (not guessed) or a link-only verdict on
+      // the wrong peer turns the coming shrink into a fatal error —
+      // ambiguous stalls leave the verdict to the heartbeat machinery
+      return NoteWireFail(
+          r_send == r_recv ? r_recv : -1,
+          PeerDeadStatus("peer exchange",
+                         "rank " + std::to_string(r_send) +
+                             " (send) / rank " + std::to_string(r_recv) +
+                             " (recv)",
+                         Timeouts().duplex));
     }
   }
   return Status::OK();
@@ -6443,8 +7068,10 @@ Status Engine::PeerSendRecvReduce(int r_send, const void* send_buf,
         int k = stx_link.SendSome(sp, sleft);
         if (k < 0) {
           flush_idle();
-          return Status::Error("send to rank " +
-                               std::to_string(r_send) + " failed");
+          return NoteWireFail(r_send,
+                              Status::Error("send to rank " +
+                                            std::to_string(r_send) +
+                                            " failed"));
         }
         sp += k;
         sleft -= static_cast<size_t>(k);
@@ -6486,11 +7113,15 @@ Status Engine::PeerSendRecvReduce(int r_send, const void* send_buf,
       bo.Wait();
     if (Stalled(last_prog, Timeouts().duplex)) {
       flush_idle();
-      return PeerDeadStatus("reduce exchange",
-                            "rank " + std::to_string(r_send) +
-                                " (send) / rank " + std::to_string(r_recv) +
-                                " (recv)",
-                            Timeouts().duplex);
+      // ambiguous two-peer stall: accuse only a known culprit (see
+      // PeerSendRecv)
+      return NoteWireFail(
+          r_send == r_recv ? r_recv : -1,
+          PeerDeadStatus("reduce exchange",
+                         "rank " + std::to_string(r_send) +
+                             " (send) / rank " + std::to_string(r_recv) +
+                             " (recv)",
+                         Timeouts().duplex));
     }
   }
   return Status::OK();
@@ -6770,8 +7401,9 @@ Status Engine::RingAllreduceGroupSegmented(const WireRegions& wr,
               kk = cnt > 0 ? txs->SendvSome(iov, cnt) : 0;
             }
             if (kk < 0) {
-              err = Status::Error("segmented ring send to rank " +
-                                  std::to_string(right) + " failed");
+              err = NoteWireFail(
+                  right, Status::Error("segmented ring send to rank " +
+                                       std::to_string(right) + " failed"));
               break;
             }
             k = static_cast<size_t>(kk);
@@ -6851,9 +7483,10 @@ Status Engine::RingAllreduceGroupSegmented(const WireRegions& wr,
           } else {
             int kk = rxs->RecvSome(dst, want);
             if (kk < 0) {
-              err = Status::Error("segmented ring recv from rank " +
-                                  std::to_string(left) +
-                                  " failed or closed");
+              err = NoteWireFail(
+                  left, Status::Error("segmented ring recv from rank " +
+                                      std::to_string(left) +
+                                      " failed or closed"));
               break;
             }
             k = static_cast<size_t>(kk);
@@ -6874,9 +7507,10 @@ Status Engine::RingAllreduceGroupSegmented(const WireRegions& wr,
                                 iov, 16);
             int kk = cnt > 0 ? rxs->RecvvSome(iov, cnt) : 0;
             if (kk < 0) {
-              err = Status::Error("segmented ring recv from rank " +
-                                  std::to_string(left) +
-                                  " failed or closed");
+              err = NoteWireFail(
+                  left, Status::Error("segmented ring recv from rank " +
+                                      std::to_string(left) +
+                                      " failed or closed"));
               break;
             }
             k = static_cast<size_t>(kk);
@@ -6957,11 +7591,15 @@ Status Engine::RingAllreduceGroupSegmented(const WireRegions& wr,
       bo.Wait();
     }
     if (Stalled(last_prog, Timeouts().duplex)) {
-      err = PeerDeadStatus("segmented ring",
-                           "rank " + std::to_string(right) +
-                               " (send) / rank " + std::to_string(left) +
-                               " (recv)",
-                           Timeouts().duplex);
+      // ambiguous two-peer stall: accuse only a known culprit (see
+      // PeerSendRecv)
+      err = NoteWireFail(
+          left == right ? left : -1,
+          PeerDeadStatus("segmented ring",
+                               "rank " + std::to_string(right) +
+                                   " (send) / rank " + std::to_string(left) +
+                                   " (recv)",
+                               Timeouts().duplex));
       break;
     }
   }
@@ -7132,8 +7770,10 @@ Status Engine::RingAllgatherGroupSegmented(
           } else {
             int kk = txs->SendSome(concat + lo_b, send_avail);
             if (kk < 0) {
-              err = Status::Error("segmented allgather send to rank " +
-                                  std::to_string(right) + " failed");
+              err = NoteWireFail(
+                  right,
+                  Status::Error("segmented allgather send to rank " +
+                                std::to_string(right) + " failed"));
               break;
             }
             k = static_cast<size_t>(kk);
@@ -7184,9 +7824,10 @@ Status Engine::RingAllgatherGroupSegmented(
         } else {
           int kk = rxs->RecvSome(dst, want);
           if (kk < 0) {
-            err = Status::Error("segmented allgather recv from rank " +
-                                std::to_string(left) +
-                                " failed or closed");
+            err = NoteWireFail(
+                left, Status::Error("segmented allgather recv from rank " +
+                                    std::to_string(left) +
+                                    " failed or closed"));
             break;
           }
           k = static_cast<size_t>(kk);
@@ -7239,11 +7880,15 @@ Status Engine::RingAllgatherGroupSegmented(
       bo.Wait();
     }
     if (Stalled(last_prog, Timeouts().duplex)) {
-      err = PeerDeadStatus("segmented allgather",
-                           "rank " + std::to_string(right) +
-                               " (send) / rank " + std::to_string(left) +
-                               " (recv)",
-                           Timeouts().duplex);
+      // ambiguous two-peer stall: accuse only a known culprit (see
+      // PeerSendRecv)
+      err = NoteWireFail(
+          left == right ? left : -1,
+          PeerDeadStatus("segmented allgather",
+                         "rank " + std::to_string(right) +
+                             " (send) / rank " + std::to_string(left) +
+                             " (recv)",
+                         Timeouts().duplex));
       break;
     }
   }
@@ -8298,6 +8943,24 @@ void hvd_world_stats(int64_t* out) {
   out[7] = 0;
 }
 
+// Coordinator fail-over statistics (wire v10), in order: {the acting
+// coordinator's LAUNCH slot (-1 when the engine is down; 0 until a
+// fail-over elects a successor), completed fail-overs, cumulative
+// detect -> new-world-live fail-over latency ns, arbitration requests
+// sent, link-only verdicts received, dead verdicts the coordinator
+// resolved by shrinking, reserved, reserved}.  The counters are
+// process-wide (fault.h), like the abort counters.
+void hvd_coord_stats(int64_t* out) {
+  out[0] = g_engine ? g_engine->CoordinatorSlot() : -1;
+  out[1] = Faults().coord_failovers.load(std::memory_order_relaxed);
+  out[2] = Faults().failover_latency_ns.load(std::memory_order_relaxed);
+  out[3] = Faults().arb_requests.load(std::memory_order_relaxed);
+  out[4] = Faults().arb_link_verdicts.load(std::memory_order_relaxed);
+  out[5] = Faults().arb_dead_verdicts.load(std::memory_order_relaxed);
+  out[6] = 0;
+  out[7] = 0;
+}
+
 // The control-plane wire version this .so speaks (kWireVersion mirror for
 // Python-side diagnostics and the ABI drift guard).
 int hvd_wire_version() { return static_cast<int>(kWireVersion); }
@@ -8354,6 +9017,16 @@ const char* hvd_frame_parse_error(const void* buf, int64_t len) {
     }
     case FrameType::kWorldCommit: {
       WorldCommitFrame f;
+      st = Parse(s, &f);
+      break;
+    }
+    case FrameType::kCoordElect: {
+      CoordElectFrame f;
+      st = Parse(s, &f);
+      break;
+    }
+    case FrameType::kArbitrate: {
+      ArbitrateFrame f;
       st = Parse(s, &f);
       break;
     }

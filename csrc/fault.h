@@ -128,6 +128,17 @@ struct FaultCounters {
   // that unwedged instantly instead of riding out the data timeout)
   std::atomic<int64_t> shm_poisons_written{0};
   std::atomic<int64_t> shm_poisons_seen{0};
+  // coordinator fail-over (wire v10): completed successor take-overs and
+  // the cumulative detect -> new-world-live latency of those changes
+  // (counted ONLY on the successor — one event per fail-over job-wide)
+  std::atomic<int64_t> coord_failovers{0};
+  std::atomic<int64_t> failover_latency_ns{0};
+  // dead-link-vs-dead-rank arbitration (wire v10): requests this rank
+  // sent, link-only verdicts received (failure was wire-only; no shrink
+  // coming), and dead verdicts the coordinator resolved by shrinking
+  std::atomic<int64_t> arb_requests{0};
+  std::atomic<int64_t> arb_link_verdicts{0};
+  std::atomic<int64_t> arb_dead_verdicts{0};
 };
 
 FaultCounters& Faults();

@@ -226,7 +226,7 @@ FrameType FrameTypeOf(const std::string& buf) {
     return FrameType::kInvalid;
   }
   if (type < static_cast<uint16_t>(FrameType::kRequestList) ||
-      type > static_cast<uint16_t>(FrameType::kWorldCommit))
+      type > static_cast<uint16_t>(FrameType::kArbitrate))
     return FrameType::kInvalid;
   return static_cast<FrameType>(type);
 }
@@ -507,6 +507,44 @@ Status Parse(const std::string& buf, WorldCommitFrame* out) {
   if (!hs.ok()) return hs;
   out->epoch = rd.U64();
   if (rd.fail) return Status::Error("truncated world-commit frame");
+  return Status::OK();
+}
+
+std::string Serialize(const CoordElectFrame& f) {
+  std::string s;
+  PutHeader(&s, FrameType::kCoordElect);
+  PutI32(&s, f.rank);
+  PutU64(&s, f.epoch);
+  return s;
+}
+
+Status Parse(const std::string& buf, CoordElectFrame* out) {
+  Reader rd{buf};
+  Status hs = ReadHeader(&rd, FrameType::kCoordElect);
+  if (!hs.ok()) return hs;
+  out->rank = rd.I32();
+  out->epoch = rd.U64();
+  if (rd.fail) return Status::Error("truncated coord-elect frame");
+  return Status::OK();
+}
+
+std::string Serialize(const ArbitrateFrame& f) {
+  std::string s;
+  PutHeader(&s, FrameType::kArbitrate);
+  PutI32(&s, f.rank);
+  PutI32(&s, f.accused);
+  PutI32(&s, f.verdict);
+  return s;
+}
+
+Status Parse(const std::string& buf, ArbitrateFrame* out) {
+  Reader rd{buf};
+  Status hs = ReadHeader(&rd, FrameType::kArbitrate);
+  if (!hs.ok()) return hs;
+  out->rank = rd.I32();
+  out->accused = rd.I32();
+  out->verdict = rd.I32();
+  if (rd.fail) return Status::Error("truncated arbitrate frame");
   return Status::OK();
 }
 

@@ -23,13 +23,15 @@ namespace hvdtpu {
 
 // Bump kWireVersion on ANY layout change (header, field order, new frame).
 constexpr uint32_t kWireMagic = 0x48564457u;  // "HVDW" little-endian
-constexpr uint16_t kWireVersion = 9;          // v9: sharded-training ops
-                                              // (kReducescatter requests +
-                                              // stripe-count responses;
-                                              // grouped-allgather fusion
-                                              // via the name prefix below).
-                                              // Frame layouts are UNCHANGED
-                                              // from v8 — v8-shaped jobs
+constexpr uint16_t kWireVersion = 10;         // v10: coordinator fail-over
+                                              // (kCoordElect successor
+                                              // registration + kArbitrate
+                                              // dead-link-vs-dead-rank
+                                              // probes; the bootstrap table
+                                              // gains the coordinator-slot
+                                              // field).  Pre-existing frame
+                                              // layouts are UNCHANGED from
+                                              // v9 — v9-shaped jobs
                                               // serialize the same byte
                                               // counts (only the header's
                                               // version field moved), which
@@ -65,7 +67,22 @@ enum class FrameType : uint16_t {
   kWorldChange = 7,   // coordinator -> members: new-membership proposal
   kWorldAck = 8,      // member -> coordinator: proposal applied locally
   kWorldCommit = 9,   // coordinator -> members: rebuild the data plane now
+  kCoordElect = 10,   // survivor -> successor: coordinator fail-over
+                      // registration (wire v10)
+  kArbitrate = 11,    // both ways: dead-link-vs-dead-rank arbitration
+                      // (wire v10; request up, verdict down)
 };
+
+// Arbitration verdict codes (ArbitrateFrame.verdict, wire v10).  A worker
+// whose data-plane transfer failed without a world change behind it asks
+// the coordinator to probe the accused peer in one round trip instead of
+// the local streak guard guessing: a dead peer triggers the normal shrink
+// (no reply needed — the world change IS the answer); a control-plane-live
+// peer comes back as kArbitrateLinkOnly, telling the reporter its failure
+// is wire-only and no shrink is coming (surface the raw error as fatal).
+constexpr int32_t kArbitrateRequest = 0;   // worker -> coordinator
+constexpr int32_t kArbitrateLinkOnly = 1;  // coordinator -> reporter
+constexpr int32_t kArbitrateDead = 2;      // reserved (shrink answers it)
 
 // Numerical-health audit record (wire v8 trailing extension): one rank's
 // 64-bit checksum of a sampled allreduce's output, keyed by the
@@ -239,6 +256,29 @@ struct WorldCommitFrame {
   uint64_t epoch = 0;
 };
 
+// Survivor -> successor (wire v10): coordinator fail-over registration.
+// Sent over a fresh connection to the candidate's DATA listener after the
+// sender detected rank 0 dead; `rank` is the sender's OLD (current-world)
+// rank and `epoch` its applied world epoch — the successor drops
+// registrations from a different epoch (a partially-committed world change
+// straddling the death would put the two sides in different rank spaces).
+struct CoordElectFrame {
+  int32_t rank = 0;
+  uint64_t epoch = 0;
+};
+
+// Dead-link-vs-dead-rank arbitration (wire v10), one struct both ways:
+// verdict == kArbitrateRequest is a worker's "probe `accused` for me";
+// kArbitrateLinkOnly is the coordinator's "the accused is control-plane
+// live — your failure is wire-only, no shrink is coming".  A dead accused
+// never generates a reply: the coordinator runs the normal death path and
+// the resulting world change answers the reporter.
+struct ArbitrateFrame {
+  int32_t rank = 0;     // reporter's rank (request) / 0 (verdict)
+  int32_t accused = -1; // the peer whose transfer failed
+  int32_t verdict = kArbitrateRequest;
+};
+
 // Frame dispatch: the type a buffer claims to carry (kInvalid when the
 // buffer is too short or the magic/version doesn't match).
 FrameType FrameTypeOf(const std::string& buf);
@@ -253,6 +293,8 @@ std::string Serialize(const AbortFrame& f);
 std::string Serialize(const WorldChangeFrame& f);
 std::string Serialize(const WorldAckFrame& f);
 std::string Serialize(const WorldCommitFrame& f);
+std::string Serialize(const CoordElectFrame& f);
+std::string Serialize(const ArbitrateFrame& f);
 Status Parse(const std::string& buf, RequestList* out);
 Status Parse(const std::string& buf, ResponseList* out);
 Status Parse(const std::string& buf, CacheBitsFrame* out);
@@ -262,5 +304,7 @@ Status Parse(const std::string& buf, AbortFrame* out);
 Status Parse(const std::string& buf, WorldChangeFrame* out);
 Status Parse(const std::string& buf, WorldAckFrame* out);
 Status Parse(const std::string& buf, WorldCommitFrame* out);
+Status Parse(const std::string& buf, CoordElectFrame* out);
+Status Parse(const std::string& buf, ArbitrateFrame* out);
 
 }  // namespace hvdtpu

@@ -39,6 +39,7 @@ from horovod_tpu.runtime.state import (
     mpi_threads_supported,
     world_changed,
     world_epoch,
+    coordinator_rank,
     ProcessSet,
     add_process_set,
     global_process_set,
@@ -339,7 +340,7 @@ __all__ = [
     "init", "shutdown", "is_initialized",
     "rank", "size", "local_rank", "local_size", "cross_rank", "cross_size",
     "mpi_threads_supported",
-    "world_changed", "world_epoch", "WorldShrunkError",
+    "world_changed", "world_epoch", "coordinator_rank", "WorldShrunkError",
     "NumericalHealthError", "elastic",
     "ProcessSet", "add_process_set", "global_process_set",
     "process_set_stats",

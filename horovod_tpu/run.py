@@ -45,15 +45,27 @@ def _elastic_supervise(procs, args, first_rank, local_n, spawn,
     """Elastic supervision: a dead worker no longer ends the job — the
     engine shrinks the world around it (and, with ``--restart N`` budget
     left, the dead slot is relaunched as a JOINER that re-enters at a
-    negotiation boundary).  Only the coordinator's exit decides the job's
-    outcome: rank 0 exits 0 when training finished, non-zero when the
-    world could not survive (below --min-np, coordinator fault, ...)."""
+    negotiation boundary).
+
+    Since wire v10 the coordinator slot is no longer special-cased as
+    non-expendable: when rank 0 dies ABNORMALLY with other workers still
+    live, the survivors elect a successor in-engine (lowest surviving
+    rank, which re-binds the rendezvous port), so the launcher treats the
+    death like any other — survivors continue, and the dead slot is
+    relaunched as a joiner under the same --restart budget.  Rank 0's
+    CLEAN exit still ends the job (the coordinated shutdown reached every
+    rank by construction); with no survivors left, the job's outcome is
+    "did anyone finish cleanly"."""
     restarts_left = max(args.restart or 0, 0)
     max_np = args.max_np if args.max_np is not None else args.num_proc
     has_rank0 = first_rank == 0
     final_rc: dict[int, int] = {}
     live = set(range(local_n))
     job_rc = None
+    # once slot 0 dies and a successor takes over, the slot sheds its
+    # job-deciding status: a relaunched slot-0 JOINER is an ordinary
+    # worker, and its clean exit must not end the job under the others
+    slot0_deposed = False
     try:
         while live:
             for i in sorted(live):
@@ -63,11 +75,30 @@ def _elastic_supervise(procs, args, first_rank, local_n, spawn,
                 live.discard(i)
                 grank = first_rank + i
                 final_rc[i] = rc
-                if has_rank0 and i == 0:
-                    # the coordinator's exit — clean or not — IS the job's
-                    # outcome; stragglers (e.g. a wedged rank the world
+                if (has_rank0 and i == 0 and not slot0_deposed
+                        and (rc == 0
+                             or (not live
+                                 and local_n >= args.num_proc))):
+                    # the coordinator slot's CLEAN exit is the job
+                    # finishing (so is its death with nobody left to
+                    # elect — "nobody" judged only when this launcher
+                    # covers the WHOLE world; on a multi-host job remote
+                    # survivors may be electing a successor right now);
+                    # stragglers (e.g. a wedged rank the world
                     # shrank away from) get the settle window then the
                     # TERM/KILL escalation below
+                    if rc != 0 and any(
+                            v == 0 for s, v in final_rc.items() if s != 0):
+                        # rank 0 died dirty as the LAST process, but other
+                        # ranks already finished cleanly — the coordinated
+                        # shutdown completed job-wide, so the outcome is
+                        # "did anyone finish cleanly" (resolved below)
+                        print(f"[horovod_tpu.run] rank 0 (coordinator) "
+                              f"{_fault.describe_exit(rc)} after other "
+                              "ranks finished cleanly; job completed",
+                              file=sys.stderr)
+                        live.clear()
+                        break
                     print(f"[horovod_tpu.run] rank 0 (coordinator) "
                           f"{_fault.describe_exit(rc)}; job over",
                           file=sys.stderr)
@@ -76,7 +107,13 @@ def _elastic_supervise(procs, args, first_rank, local_n, spawn,
                     break
                 if rc == 0:
                     continue
-                print(f"[horovod_tpu.run] rank {grank} "
+                if has_rank0 and i == 0 and not slot0_deposed:
+                    slot0_deposed = True
+                    who = ("rank 0 (coordinator slot — survivors elect "
+                           "a successor)")
+                else:
+                    who = f"rank {grank}"
+                print(f"[horovod_tpu.run] {who} "
                       f"{_fault.describe_exit(rc)}; elastic mode — "
                       "survivors continue", file=sys.stderr)
                 if restarts_left > 0 and len(live) + 1 <= max_np:
@@ -96,10 +133,10 @@ def _elastic_supervise(procs, args, first_rank, local_n, spawn,
             time.sleep(0.05)
         kill_all()
     if job_rc is None:
-        if has_rank0:
-            # worker deaths were survived BY DESIGN: the coordinator's
-            # clean exit is the job finishing
-            job_rc = _exit_code(final_rc.get(0, 1))
+        if has_rank0 and final_rc.get(0) == 0:
+            # worker deaths were survived BY DESIGN: the coordinator
+            # slot's clean exit is the job finishing
+            job_rc = 0
         elif any(rc == 0 for rc in final_rc.values()):
             # non-coordinator host: rank 0 (on another host) owns the
             # job's outcome, and a local death the world shrank away
@@ -245,8 +282,10 @@ def main(argv=None) -> int:
                          "JOINERS (HOROVOD_TPU_JOIN=1) — the world shrinks "
                          "around the death, then grows back when the "
                          "relaunched worker re-enters at a negotiation "
-                         "boundary. Rank 0 (the coordinator) is never "
-                         "relaunched: its death still ends the job")
+                         "boundary. The coordinator slot is covered too: "
+                         "survivors elect a successor (which re-binds the "
+                         "rendezvous port) and the dead slot 0 rejoins "
+                         "like any other rank")
     ap.add_argument("--health-sample", type=int, default=None, metavar="N",
                     help="cross-rank silent-data-corruption audit: checksum "
                          "every Nth allreduce output and compare digests "

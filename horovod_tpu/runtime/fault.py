@@ -243,6 +243,26 @@ def heartbeat_age_from_metrics(metrics_dir: str | None,
     return None
 
 
+def coordinator_from_metrics(metrics_dir: str | None,
+                             rank: int) -> int | None:
+    """Last exported ``hvd_coordinator_rank`` for a rank, or None.
+
+    The gauge carries the acting coordinator's LAUNCH slot per world
+    epoch (0 until a fail-over, the successor's slot after one), so the
+    post-mortem can say WHO was coordinating when the job ended without
+    log archaeology."""
+    dump = _last_metrics(metrics_dir, rank)
+    if not dump:
+        return None
+    for m in dump.get("metrics", []):
+        if m.get("name") == "hvd_coordinator_rank":
+            try:
+                return int(m.get("value"))
+            except (TypeError, ValueError):
+                return None
+    return None
+
+
 _SPAN_RE = re.compile(r'"name"\s*:\s*"([^"]+)"\s*,\s*"ph"\s*:\s*"[BX]"')
 
 
@@ -309,9 +329,11 @@ def post_mortem_line(rank: int, returncode: int | None,
     span = last_timeline_span(timeline_path, rank)
     phase = last_trace_phase(trace_dir, rank)
     health = post_mortem_summary(metrics_dir, rank)
+    coord = coordinator_from_metrics(metrics_dir, rank)
     return (f"rank {rank}: {describe_exit(returncode)}, "
             f"heartbeat_age={age if age is not None else 'n/a'}"
             f"{'s' if age is not None else ''}, "
+            f"coordinator={coord if coord is not None else 'n/a'}, "
             f"last_span={span or 'n/a'}, "
             f"last_phase={phase or 'n/a'}, "
             f"health={health or 'n/a'}")

@@ -363,19 +363,49 @@ class NativeEngine(Engine):
         Engine-down/predates-elastic: epoch 0, size/rank from nothing."""
         fn = getattr(self._lib, "hvd_world_stats", None)
         if fn is None:
-            return {"world_epoch": 0, "world_size": self._topology.size,
-                    "world_rank": self._topology.rank, "world_changes": 0,
-                    "rank_joins": 0, "shrink_latency_ns": 0, "elastic": 0}
+            d = {"world_epoch": 0, "world_size": self._topology.size,
+                 "world_rank": self._topology.rank, "world_changes": 0,
+                 "rank_joins": 0, "shrink_latency_ns": 0, "elastic": 0}
+        else:
+            vals = (ctypes.c_int64 * 8)()
+            fn(vals)
+            d = {
+                "world_epoch": max(int(vals[0]), 0),
+                "world_size": int(vals[1]),
+                "world_rank": int(vals[2]),
+                "world_changes": max(int(vals[3]), 0),
+                "rank_joins": max(int(vals[4]), 0),
+                "shrink_latency_ns": max(int(vals[5]), 0),
+                "elastic": max(int(vals[6]), 0),
+            }
+        d.update(self.coord_stats())
+        return d
+
+    def coord_stats(self) -> dict:
+        """Coordinator fail-over statistics (wire v10).
+        ``coordinator_rank`` is the acting coordinator's LAUNCH slot — 0
+        for the life of a healthy job, the successor's launch slot after a
+        fail-over (in the live world the coordinator is always rank 0; the
+        launch slot is the identity an operator can grep logs for).  The
+        counters are process-wide, like the fault counters.  Zeros when
+        the loaded .so predates fail-over."""
+        fn = getattr(self._lib, "hvd_coord_stats", None)
+        if fn is None:
+            return {"coordinator_rank": 0, "coord_failovers": 0,
+                    "failover_latency_ns": 0, "arb_requests": 0,
+                    "arb_link_verdicts": 0, "arb_dead_verdicts": 0}
         vals = (ctypes.c_int64 * 8)()
         fn(vals)
         return {
-            "world_epoch": max(int(vals[0]), 0),
-            "world_size": int(vals[1]),
-            "world_rank": int(vals[2]),
-            "world_changes": max(int(vals[3]), 0),
-            "rank_joins": max(int(vals[4]), 0),
-            "shrink_latency_ns": max(int(vals[5]), 0),
-            "elastic": max(int(vals[6]), 0),
+            # raw: -1 is the engine-down sentinel, so a post-teardown
+            # collection can tell "no engine" from "launch slot 0" —
+            # state.coordinator_rank() clamps for the public surface
+            "coordinator_rank": int(vals[0]),
+            "coord_failovers": max(int(vals[1]), 0),
+            "failover_latency_ns": max(int(vals[2]), 0),
+            "arb_requests": max(int(vals[3]), 0),
+            "arb_link_verdicts": max(int(vals[4]), 0),
+            "arb_dead_verdicts": max(int(vals[5]), 0),
         }
 
     def topology_describe(self) -> dict | None:
@@ -748,7 +778,9 @@ class NativeEngine(Engine):
                      "ring_segments": 0, "ring_bytes": 0,
                      "peer_timeouts": 0, "aborts": 0, "heartbeats_tx": 0,
                      "heartbeats_rx": 0, "sg_bytes_skipped": 0,
-                     "pack_bytes": 0, "world_changes": 0, "rank_joins": 0}
+                     "pack_bytes": 0, "world_changes": 0, "rank_joins": 0,
+                     "coord_failovers": 0, "arb_requests": 0,
+                     "arb_link_verdicts": 0, "arb_dead_verdicts": 0}
         # per-stripe tx bytes: one labelled counter per stripe index
         stripe_seen = [0] * 8
         # per-process-set counters: one labelled series per set id
@@ -775,6 +807,10 @@ class NativeEngine(Engine):
             ("heartbeats_rx", telemetry.NATIVE_HEARTBEATS_RX),
             ("world_changes", telemetry.NATIVE_WORLD_CHANGES),
             ("rank_joins", telemetry.NATIVE_RANK_JOINS),
+            ("coord_failovers", telemetry.NATIVE_COORD_FAILOVERS),
+            ("arb_requests", telemetry.NATIVE_ARB_REQUESTS),
+            ("arb_link_verdicts", telemetry.NATIVE_ARB_LINK_VERDICTS),
+            ("arb_dead_verdicts", telemetry.NATIVE_ARB_DEAD_VERDICTS),
         )
         # the FAULT counters are process-wide by design (fault.h: they
         # survive engine re-init like the registry does) — seed their
@@ -785,7 +821,8 @@ class NativeEngine(Engine):
         for k in ("peer_timeouts", "aborts", "heartbeats_tx",
                   "heartbeats_rx"):
             last_seen[k] = fault_now[k]
-        for k in ("world_changes", "rank_joins"):
+        for k in ("world_changes", "rank_joins", "coord_failovers",
+                  "arb_requests", "arb_link_verdicts", "arb_dead_verdicts"):
             last_seen[k] = world_now[k]
         # abort latency: each collection observes the window's mean
         # detect->handles-failed latency (cumulative ns / cumulative count
@@ -794,6 +831,9 @@ class NativeEngine(Engine):
         # shrink latency: same windowed-mean scheme over world changes
         shrink_seen = [world_now["shrink_latency_ns"],
                        world_now["world_changes"]]
+        # fail-over latency: windowed mean over completed fail-overs
+        failover_seen = [world_now["failover_latency_ns"],
+                         world_now["coord_failovers"]]
         # per-stage cumulative (ns, item count) at last collection: each
         # collection observes the mean per-item stage latency of the
         # window into the stage histogram
@@ -861,6 +901,12 @@ class NativeEngine(Engine):
                     d["heartbeat_age_s"])
             if d["world_size"] > 0:  # -1 = engine down: keep the last size
                 reg.gauge(telemetry.NATIVE_WORLD_SIZE).set(d["world_size"])
+            # the acting coordinator's launch slot (0 until a fail-over);
+            # -1 = engine down: keep the last real value so the
+            # post-mortem's coordinator= column survives teardown
+            if d["coordinator_rank"] >= 0:
+                reg.gauge(telemetry.NATIVE_COORD_RANK).set(
+                    d["coordinator_rank"])
             with mirror_lock:
                 for key, metric in cumulative:
                     delta = d[key] - last_seen[key]
@@ -937,6 +983,14 @@ class NativeEngine(Engine):
                         dns / dn / 1e9)
                     shrink_seen[0] = d["shrink_latency_ns"]
                     shrink_seen[1] = d["world_changes"]
+                dns = d["failover_latency_ns"] - failover_seen[0]
+                dn = d["coord_failovers"] - failover_seen[1]
+                if dn > 0 and dns >= 0:
+                    reg.histogram(
+                        telemetry.NATIVE_COORD_FAILOVER_LATENCY).observe(
+                            dns / dn / 1e9)
+                    failover_seen[0] = d["failover_latency_ns"]
+                    failover_seen[1] = d["coord_failovers"]
                 if "health_collectives" in d:
                     desc = None
                     try:

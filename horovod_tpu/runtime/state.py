@@ -213,6 +213,24 @@ def world_epoch() -> int:
     return int(eng.world_stats()["world_epoch"])
 
 
+def coordinator_rank() -> int:
+    """The acting coordinator's LAUNCH slot (wire v10).
+
+    0 for the life of a healthy job.  After a coordinator fail-over the
+    elected successor renumbers itself to rank 0 in the live world, so
+    ``rank()`` can't tell you WHO coordinates — this can: it reports the
+    launch slot (``HOROVOD_TPU_RANK`` at spawn) of the process currently
+    wearing the coordinator hat, the identity an operator greps logs and
+    post-mortems for.  Engines without fail-over support report 0."""
+    _topology()  # raises NotInitializedError when appropriate
+    eng = _state.engine
+    if eng is None or not hasattr(eng, "coord_stats"):
+        return 0
+    # -1 is the engine-down sentinel the metrics mirror consumes; the
+    # public surface reports the launch-slot contract (0 = original)
+    return max(int(eng.coord_stats()["coordinator_rank"]), 0)
+
+
 def world_changed() -> bool:
     """True when the world membership changed since the last call (or
     since init) — and, when it did, refreshes ``rank()``/``size()`` and
@@ -372,10 +390,17 @@ class _Elastic:
             @functools.wraps(fn)
             def wrapper(*args, **kwargs):
                 restarts = 0
-                if sync is not None:
-                    sync()
+                need_sync = sync is not None
                 while True:
                     try:
+                        # sync() runs INSIDE the retry arm: a membership
+                        # change can land while the sync collective itself
+                        # is on the wire (a joiner arriving mid-step does
+                        # exactly this), and that cancellation must retry
+                        # like any other
+                        if need_sync:
+                            sync()
+                            need_sync = False
                         return fn(*args, **kwargs)
                     except WorldShrunkError:
                         if (max_restarts is not None
@@ -387,8 +412,7 @@ class _Elastic:
                             if time.monotonic() > deadline:
                                 raise
                             time.sleep(0.02)
-                        if sync is not None:
-                            sync()
+                        need_sync = sync is not None
 
             return wrapper
 

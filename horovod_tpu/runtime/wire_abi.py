@@ -13,11 +13,11 @@ from __future__ import annotations
 
 # csrc/wire.h — frame header
 WIRE_MAGIC = 0x48564457  # "HVDW" little-endian
-WIRE_VERSION = 9         # v9: sharded-training ops — kReducescatter
-                         # requests (responses carry per-member stripe
-                         # element counts on first_dims) and grouped-
-                         # allgather fusion via the "__gag:" name prefix.
-                         # Frame layouts are unchanged from v8: v8-shaped
+WIRE_VERSION = 10        # v10: coordinator fail-over — kCoordElect
+                         # successor registration, kArbitrate dead-link-
+                         # vs-dead-rank probes, and the coordinator-slot
+                         # field in the bootstrap table.  Pre-existing
+                         # frame layouts are unchanged from v9: v9-shaped
                          # jobs serialize the same byte counts (only the
                          # header's version value moved), which keeps the
                          # steady-state ctrl-bytes CI gate at 1.0000.
@@ -58,6 +58,8 @@ FRAME_ABORT = 6
 FRAME_WORLD_CHANGE = 7
 FRAME_WORLD_ACK = 8
 FRAME_WORLD_COMMIT = 9
+FRAME_COORD_ELECT = 10   # wire v10: survivor -> successor registration
+FRAME_ARBITRATE = 11     # wire v10: dead-link-vs-dead-rank probe/verdict
 
 FRAME_TYPES = {
     "kInvalid": FRAME_INVALID,
@@ -70,11 +72,25 @@ FRAME_TYPES = {
     "kWorldChange": FRAME_WORLD_CHANGE,
     "kWorldAck": FRAME_WORLD_ACK,
     "kWorldCommit": FRAME_WORLD_COMMIT,
+    "kCoordElect": FRAME_COORD_ELECT,
+    "kArbitrate": FRAME_ARBITRATE,
 }
 
 # csrc/wire.h — WorldChangeFrame.kind (elastic membership, wire v7)
 WORLD_CHANGE_SHRINK = 0
 WORLD_CHANGE_JOIN = 1
+
+# csrc/wire.h — ArbitrateFrame.verdict (wire v10).  A worker's data-plane
+# failure with no world change behind it becomes a kArbitrateRequest to
+# the coordinator, which probes the accused peer in one round trip: a
+# control-plane-live accused earns the reporter kArbitrateLinkOnly (the
+# failure was wire-only; the raw error surfaces fatal), while a dead
+# accused triggers the normal elastic shrink — the world change itself is
+# the answer (kArbitrateDead is reserved; it never rides the wire).
+# tools/check_wire_abi.py pins all three against wire.h.
+ARBITRATE_REQUEST = 0
+ARBITRATE_LINK_ONLY = 1
+ARBITRATE_DEAD = 2
 
 # csrc/wire.h — set-tagged frames (wire v8): every struct listed here
 # carries a TRAILING `int32_t process_set` field, serialized only when the

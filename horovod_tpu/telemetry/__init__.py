@@ -125,6 +125,18 @@ NATIVE_WORLD_CHANGES = "hvd_world_changes_total"
 NATIVE_RANK_JOINS = "hvd_rank_joins_total"
 NATIVE_SHRINK_LATENCY = "hvd_shrink_latency_seconds"
 
+# coordinator fail-over (wire v10): the acting coordinator's LAUNCH slot
+# (0 until a fail-over elects a successor), completed successor
+# take-overs, the detect -> new-world-live fail-over latency histogram,
+# and the dead-link-vs-dead-rank arbitration counters (requests sent,
+# link-only verdicts received, dead verdicts resolved by shrinking)
+NATIVE_COORD_RANK = "hvd_coordinator_rank"
+NATIVE_COORD_FAILOVERS = "hvd_coord_failovers_total"
+NATIVE_COORD_FAILOVER_LATENCY = "hvd_coord_failover_latency_seconds"
+NATIVE_ARB_REQUESTS = "hvd_arbitration_requests_total"
+NATIVE_ARB_LINK_VERDICTS = "hvd_arbitration_link_verdicts_total"
+NATIVE_ARB_DEAD_VERDICTS = "hvd_arbitration_dead_verdicts_total"
+
 # process sets (wire v8): registered-set count, plus per-set counters
 # labeled with set="<id>" (the global set is set 0) — collectives run,
 # payload bytes moved, and this rank's steady-state cache lookups, so two
@@ -440,6 +452,9 @@ __all__ = [
     "NATIVE_ABORT_LATENCY", "NATIVE_HEARTBEATS_TX", "NATIVE_HEARTBEATS_RX",
     "NATIVE_WORLD_SIZE", "NATIVE_WORLD_CHANGES", "NATIVE_RANK_JOINS",
     "NATIVE_SHRINK_LATENCY",
+    "NATIVE_COORD_RANK", "NATIVE_COORD_FAILOVERS",
+    "NATIVE_COORD_FAILOVER_LATENCY", "NATIVE_ARB_REQUESTS",
+    "NATIVE_ARB_LINK_VERDICTS", "NATIVE_ARB_DEAD_VERDICTS",
     "NATIVE_PROCESS_SETS", "NATIVE_PSET_COLLECTIVES", "NATIVE_PSET_BYTES",
     "NATIVE_PSET_CACHE_HITS", "NATIVE_PSET_OP_COLLECTIVES",
     "NATIVE_PSET_OP_BYTES", "NATIVE_SHM_POISONS",

@@ -911,6 +911,54 @@ def scenario_stripe_chaos():
     print(f"rank {r}: stripe chaos ran dry with no fault", flush=True)
 
 
+def scenario_arb_stripe_elastic():
+    """Dead-LINK-vs-dead-rank arbitration (wire v10): the stripe-chaos
+    workload under HOROVOD_TPU_ELASTIC=1.  One TCP stripe dies while both
+    endpoints stay control-plane-live, so no shrink is ever coming — the
+    old streak guard would burn retries guessing, and a naive retry loop
+    would park 60 s waiting for world_changed().  With arbitration the
+    coordinator attests the accused is alive in one round trip and the
+    retried collective fails FATALLY with the arbitration verdict in the
+    message; the worker prints ARBITRATED and exits 7."""
+    import threading
+    import time
+
+    from horovod_tpu.runtime import state as _state
+
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    if r == 1:
+        def killer():
+            time.sleep(float(os.environ.get("HVD_TEST_KILL_AFTER_S", "0.3")))
+            eng = _state.engine()
+            eng._lib.hvd_debug_kill_stripe(0, 1)  # stripe 1 of the 0-link
+            print("rank 1: stripe 1 of link to rank 0 killed", flush=True)
+
+        threading.Thread(target=killer, daemon=True).start()
+    data = np.full(1 << 20, float(r), np.float32)
+    deadline = time.monotonic() + 60
+    for step in range(5000):
+        if time.monotonic() > deadline:
+            break
+        try:
+            hvd.allreduce(data, average=False, name="asc")
+        except hvd.WorldShrunkError:
+            # retryable: wait briefly for a world change that (for a
+            # wire-only failure) must never arrive — arbitration should
+            # convert the NEXT failure to fatal long before this expires
+            wait = time.monotonic() + 15
+            while not hvd.world_changed() and time.monotonic() < wait:
+                time.sleep(0.02)
+            continue
+        except RuntimeError as e:
+            marker = ("ARBITRATED" if "arbitration" in str(e)
+                      else "FAULT")
+            print(f"rank {r}: {marker}: {e}", flush=True)
+            sys.exit(7)
+    print(f"rank {r}: arb stripe chaos ran dry with no verdict",
+          flush=True)
+
+
 def scenario_fault_idle():
     """Chaos-test workload with an IDLE victim: rank 0 submits steadily
     while the last rank naps between ops — detection must ride the
@@ -938,11 +986,12 @@ def scenario_elastic_loop():
     and the loop resumes in the re-formed world — where the sum-of-ones
     result IS the live world size, so correctness self-asserts.
 
-    Engine rank 0 (stable across changes — coordinator death aborts)
-    decides termination once it has observed HVD_TEST_CHANGES world
-    changes (or reached HVD_TEST_EXPECT_FINAL_SIZE — staggered deaths
-    may fold into fewer changes) and HVD_TEST_STEPS_AFTER further clean
-    steps; everyone else
+    Engine rank 0 (whoever currently wears it: the coordinator role moves
+    to the elected successor — renumbered to rank 0 — when rank 0 dies in
+    an elastic world, wire v10) decides termination once it has observed
+    HVD_TEST_CHANGES world changes (or reached HVD_TEST_EXPECT_FINAL_SIZE
+    — staggered deaths may fold into fewer changes) and
+    HVD_TEST_STEPS_AFTER further clean steps; everyone else
     (joiners included) leaves when the coordinated shutdown fails their
     next collective.  Prints per-event markers the chaos tests parse:
     RETRYABLE / WORLD_CHANGED size=N / SHRINK_LATENCY_S=x."""
@@ -1009,7 +1058,9 @@ def scenario_elastic_loop():
         if changed or d["world_changes"] > changes_seen:
             changes_seen = d["world_changes"]
             print(f"rank {launch_rank}: WORLD_CHANGED size={ws} "
-                  f"changes={d['world_changes']} joins={d['rank_joins']}",
+                  f"changes={d['world_changes']} joins={d['rank_joins']} "
+                  f"coord={d.get('coordinator_rank', 0)} "
+                  f"failovers={d.get('coord_failovers', 0)}",
                   flush=True)
             if t_err is not None:
                 print(f"rank {launch_rank}: SHRINK_LATENCY_S="

@@ -164,6 +164,41 @@ def test_elastic_artifact_shows_survival():
     assert points >= 10, f"only {points} elastic points in BENCH_r11"
 
 
+def test_failover_artifact_counted_series():
+    """BENCH_r16's counted series (wire v10): every coordinator-kill
+    point must show the fail-over actually WORKING — job exit 0, exactly
+    one fail-over, launch slot 1 elected coordinator, the final world
+    size exact per injection point, and the dead slot 0 rejoining through
+    the successor's re-bound rendezvous port on the rejoin rows.  The
+    detect -> first-shrunk-cycle wall is RECORDED (present), not gated —
+    the usual shared-2-core-host caveat."""
+    r16 = _baseline("BENCH_r16.json")
+    points = 0
+    for np_key, np_ in (("np3", 3), ("np4", 4)):
+        p = r16.get(np_key)
+        if not p:
+            continue
+        for label, row in p.items():
+            if not isinstance(row, dict) or "exit_code" not in row:
+                continue
+            points += 1
+            assert row["exit_code"] == 0, (np_key, label, row)
+            assert row["failovers"] == 1, (np_key, label, row)
+            assert row["coordinator"] == 1, (np_key, label, row)
+            if label == "kill_ring_rejoin":
+                # failover shrink + the dead slot's rejoin, one each
+                assert row["world_changes"] == 2, (np_key, label, row)
+                assert row["rank_joins"] == 1, (np_key, label, row)
+                assert row["final_size"] == np_, (np_key, label, row)
+            else:
+                assert row["world_changes"] == 1, (np_key, label, row)
+                assert row["rank_joins"] == 0, (np_key, label, row)
+                assert row["final_size"] == np_ - 1, (np_key, label, row)
+            # recorded, not gated
+            assert row["shrink_latency_max_s"] is not None, (np_key, label)
+    assert points >= 6, f"only {points} fail-over points in BENCH_r16"
+
+
 def test_wire_counted_series_gate():
     """Fresh striped + scatter-gather fused steps at the BENCH_r10
     workload shape (-np 2, 4 stripes, 64 KB quantum, SG on) vs the
@@ -358,7 +393,7 @@ def test_wire_abi_version_in_sync():
         [sys.executable, os.path.join(REPO, "tools", "check_wire_abi.py")],
         cwd=REPO, capture_output=True, text=True, timeout=120)
     assert out.returncode == 0, out.stdout + out.stderr
-    assert "version 9" in out.stdout, out.stdout
+    assert "version 10" in out.stdout, out.stdout
 
 
 def test_health_flip_attribution_artifact():

@@ -463,17 +463,161 @@ def test_elastic_death_during_shrink():
             res.stdout + res.stderr)
 
 
-def test_elastic_coordinator_death_still_aborts():
-    """Elastic mode does NOT make rank 0 expendable: the coordinator owns
-    membership, so its death is still a job-ending abort with workers
-    naming rank 0."""
-    res = _run_elastic("elastic_loop", 3, "kill:rank=0:phase=ring:hit=8",
-                       extra_env={"HVD_TEST_ELEMS": "200000"},
+# ---------------------------------------------------------------------------
+# coordinator fail-over (wire v10): rank 0's death is a survivable world
+# change — the lowest surviving rank self-elects, re-binds the control
+# plane, and drives a normal shrink round that renumbers it to rank 0
+# ---------------------------------------------------------------------------
+
+def _assert_failed_over(res, np_, final_size, coord=1):
+    """The fail-over acceptance shape: the job did NOT exit on rank 0's
+    death — survivors reported the retryable error, the successor (launch
+    slot `coord`) took over, the world re-formed at final_size, further
+    collectives completed there, and hvdrun exited 0."""
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert res.elapsed < EXIT_WALL_S + 30, f"took {res.elapsed:.0f}s"
+    for r in range(1, np_):
+        assert f"rank {r}: elastic loop OK" in res.stdout, (
+            r, res.stdout + res.stderr)
+    assert f"WORLD_CHANGED size={final_size}" in res.stdout, res.stdout
+    assert f"coord={coord}" in res.stdout, res.stdout
+    assert "failovers=1" in res.stdout, res.stdout
+    assert "survivors elect a successor" in res.stderr, res.stderr
+    assert "elastic loop ran dry" not in res.stdout
+    assert "aborting job" not in res.stdout, res.stdout
+
+
+def test_failover_coordinator_death_at_negotiation():
+    """SIGKILL rank 0 at a negotiation tick: workers detect the socket
+    reset, rank 1 self-elects (lowest survivor), ranks renumber, and the
+    np3 job finishes at size 2 with launch slot 1 coordinating."""
+    res = _run_elastic("elastic_loop", 3, "kill:rank=0:cycle=15",
+                       extra_env={"HVD_TEST_EXPECT_FINAL_SIZE": "2"},
                        hvdrun_args=("--min-np", "1"))
-    assert res.returncode != 0, res.stdout + res.stderr
-    assert res.elapsed < EXIT_WALL_S + 30
-    assert "rank 0" in res.stdout + res.stderr
-    assert "elastic loop OK" not in res.stdout, res.stdout
+    _assert_failed_over(res, np_=3, final_size=2)
+
+
+def test_failover_coordinator_death_mid_ring_np4():
+    """The acceptance row: an np4 elastic job survives SIGKILL of rank 0
+    mid-ring — rank 1 elected, world shrinks to 3, the training loop
+    resumes via the existing retry path with no user-script change."""
+    res = _run_elastic("elastic_loop", 4, "kill:rank=0:phase=ring:hit=8",
+                       extra_env={"HVD_TEST_ELEMS": "100000",
+                                  "HVD_TEST_EXPECT_FINAL_SIZE": "3"},
+                       hvdrun_args=("--min-np", "1"))
+    _assert_failed_over(res, np_=4, final_size=3)
+    lats = _shrink_latencies(res.stdout)
+    assert lats, res.stdout  # recorded, not gated (shared 2-core host)
+
+
+def test_failover_after_shrink_mid_world_change_window():
+    """Rank 1 dies mid-ring (normal shrink), then rank 0 dies around the
+    world-change window — the fail-over must compose with renumbering:
+    whoever is the lowest survivor IN THE CURRENT EPOCH self-elects, so
+    the np3 job ends as a 1-rank world that still completes cleanly."""
+    res = _run_elastic(
+        "elastic_loop", 3,
+        "kill:rank=1:phase=ring:hit=6;kill:rank=0:cycle=40",
+        extra_env={"HVD_TEST_ELEMS": "100000",
+                   "HVD_TEST_CHANGES": "2",
+                   "HVD_TEST_EXPECT_FINAL_SIZE": "1"},
+        hvdrun_args=("--min-np", "1"))
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert res.elapsed < EXIT_WALL_S + 30, f"took {res.elapsed:.0f}s"
+    assert "rank 2: elastic loop OK world=1" in res.stdout, res.stdout
+    assert "failovers=1" in res.stdout, res.stdout
+    assert "aborting job" not in res.stdout, res.stdout
+
+
+def test_failover_coordinator_slot_rejoins():
+    """hvdrun satellite: after the successor takes over (re-binding the
+    job's rendezvous port), the dead slot 0 is relaunched as a JOINER like
+    any other rank — the world grows back to 3 under coordinator slot 1,
+    and slot 0's clean exit no longer decides the job."""
+    res = _run_elastic("elastic_loop", 3, "kill:rank=0:phase=ring:hit=8",
+                       extra_env={"HVD_TEST_ELEMS": "100000",
+                                  "HVD_TEST_CHANGES": "2",
+                                  "HVD_TEST_EXPECT_FINAL_SIZE": "3"},
+                       hvdrun_args=("--min-np", "1", "--restart", "1"),
+                       timeout=EXIT_WALL_S + 120)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "relaunching rank 0 as a joiner" in res.stderr, res.stderr
+    assert "size=3 changes=2 joins=1 coord=1" in res.stdout, res.stdout
+    assert res.stdout.count("elastic loop OK") == 3, res.stdout
+
+
+def test_failover_world_bitwise_vs_fresh(tmp_path):
+    """A fail-over-shrunk world must compute EXACTLY what a fresh world
+    of that shape computes: np4 loses rank 0 mid-ring, the survivors
+    (launch 1,2,3 -> new ranks 0,1,2 under the elected coordinator) run
+    the PR 7 dump battery, and a fresh np3 job carrying the survivors'
+    values must match byte for byte."""
+    elastic_dir = tmp_path / "elastic"
+    fresh_dir = tmp_path / "fresh"
+    elastic_dir.mkdir()
+    fresh_dir.mkdir()
+    res = _run_elastic(
+        "elastic_dump", 4, "kill:rank=0:phase=ring:hit=6",
+        extra_env={"HVD_TEST_OUT_DIR": str(elastic_dir),
+                   "HVD_TEST_ELASTIC_KILL": "1",
+                   "HVD_TEST_EXPECT_SIZE": "3",
+                   "HVD_TEST_VALUES": "9,1,2,3"},  # 9 = the coordinator
+        hvdrun_args=("--min-np", "1"))
+    assert res.returncode == 0, res.stdout + res.stderr
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.update({"HVD_TEST_OUT_DIR": str(fresh_dir),
+                "HVD_TEST_EXPECT_SIZE": "3",
+                "HVD_TEST_VALUES": "1,2,3"})
+    fresh = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.run", "-np", "3",
+         sys.executable, WORKER, "elastic_dump"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=180)
+    assert fresh.returncode == 0, fresh.stdout + fresh.stderr
+    for r in range(3):
+        shrunk = (elastic_dir / f"elastic_dump_r{r}.bin").read_bytes()
+        scratch = (fresh_dir / f"elastic_dump_r{r}.bin").read_bytes()
+        assert shrunk, r
+        assert shrunk == scratch, (
+            f"new rank {r}: fail-over-world results differ from a fresh "
+            f"np3 run")
+
+
+def test_multi_joiner_single_round():
+    """Multi-joiner admission (wire v10 satellite): two ranks die, both
+    relaunched slots dial the rendezvous port together, and the
+    coordinator admits BOTH in one world-change round — joins=2 with the
+    grow folded into a single change (changes == shrinks + 1)."""
+    res = _run_elastic(
+        "elastic_loop", 4,
+        "kill:rank=2:phase=ring:hit=6;kill:rank=3:phase=ring:hit=6",
+        extra_env={"HVD_TEST_ELEMS": "100000",
+                   "HVD_TEST_CHANGES": "2",
+                   "HVD_TEST_EXPECT_FINAL_SIZE": "4"},
+        hvdrun_args=("--min-np", "1", "--restart", "2"),
+        timeout=EXIT_WALL_S + 120)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "joins=2" in res.stdout, res.stdout
+    assert res.stdout.count("elastic loop OK") == 4, res.stdout
+    # both joiners admitted by ONE round: the engine logs the combined
+    # admission (the serialized-alternative would say "1 relaunched")
+    assert "2 relaunched worker(s)" in res.stdout + res.stderr, (
+        res.stdout + res.stderr)
+
+
+def test_arbitration_dead_link_goes_fatal():
+    """Dead-link-vs-dead-rank arbitration (wire v10): one TCP stripe dies
+    while both endpoints stay alive.  No shrink can ever resolve it, and
+    instead of the old guess-by-streak the coordinator attests the
+    accused is control-plane-live — the retried collective fails FATALLY
+    with the arbitration verdict in the message, well inside the wall."""
+    res = _run_elastic("arb_stripe_elastic", 2, "",
+                       extra_env={"HOROVOD_TPU_SHM": "0",
+                                  "HOROVOD_TPU_WIRE_STRIPES": "4"},
+                       hvdrun_args=("--min-np", "1"))
+    assert res.elapsed < EXIT_WALL_S + 30, f"took {res.elapsed:.0f}s"
+    assert "stripe 1 of link to rank 0 killed" in res.stdout, res.stdout
+    assert "ARBITRATED:" in res.stdout, res.stdout + res.stderr
+    assert "control-plane-live" in res.stdout, res.stdout
 
 
 def test_elastic_below_min_np_aborts():
@@ -646,9 +790,14 @@ def test_post_mortem_line_formats(tmp_path):
     md = tmp_path / "m"
     md.mkdir()
     (md / "metrics.rank1.json").write_text(
-        '{"metrics": [{"name": "hvd_heartbeat_age_s", "value": 4.2}]}')
+        '{"metrics": [{"name": "hvd_heartbeat_age_s", "value": 4.2},'
+        ' {"name": "hvd_coordinator_rank", "value": 1}]}')
     line = fault_mod.post_mortem_line(1, -9, metrics_dir=str(md))
     assert "killed by SIGKILL" in line and "heartbeat_age=4.2" in line
+    # wire v10: the post-mortem names the acting coordinator's launch
+    # slot per the rank's last exported epoch ('n/a' without metrics)
+    assert "coordinator=1" in line, line
+    assert "coordinator=n/a" in fault_mod.post_mortem_line(0, 1)
     # truncated timeline (a killed rank leaves unterminated JSON)
     tl = tmp_path / "tl.json"
     tl.write_text('[\n{"name":"thread_name","ph":"M","pid":0,"tid":0,'

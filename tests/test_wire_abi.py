@@ -34,7 +34,7 @@ def test_checker_detects_version_drift():
     """The guard must actually bite: a simulated version bump in wire.h
     without a Python update is reported."""
     wire_h, common_h = _headers()
-    tampered = wire_h.replace("kWireVersion = 9", "kWireVersion = 10")
+    tampered = wire_h.replace("kWireVersion = 10", "kWireVersion = 11")
     assert tampered != wire_h, "kWireVersion moved; update this test"
     problems = check_wire_abi.check(tampered, common_h)
     assert any("kWireVersion" in p for p in problems), problems
@@ -56,9 +56,9 @@ def test_checker_detects_new_tuned_knob():
 
 def test_checker_detects_new_frame_type():
     wire_h, common_h = _headers()
-    tampered = wire_h.replace("kWorldCommit = 9,",
-                              "kWorldCommit = 9,\n  kNewFrame = 10,")
-    assert tampered != wire_h, "kWorldCommit moved; update this test"
+    tampered = wire_h.replace("kArbitrate = 11,",
+                              "kArbitrate = 11,\n  kNewFrame = 12,")
+    assert tampered != wire_h, "kArbitrate moved; update this test"
     problems = check_wire_abi.check(tampered, common_h)
     assert any("FrameType" in p for p in problems), problems
 
@@ -113,12 +113,11 @@ def test_v8_process_set_collateral_present():
 
 
 def test_v9_sharded_training_collateral_present():
-    """The sharded-training wire v9 collateral: the version is 9 on both
-    sides, the kReducescatter op exists at its pinned id, and the stripe
-    alignment + grouped-allgather prefix constants match their mirrors."""
+    """The sharded-training wire v9 collateral: the kReducescatter op
+    exists at its pinned id, and the stripe alignment + grouped-allgather
+    prefix constants match their mirrors."""
     from horovod_tpu.runtime import native, wire_abi
 
-    assert wire_abi.WIRE_VERSION == 9
     assert wire_abi.OP_TYPES["kReducescatter"] == \
         wire_abi.OP_REDUCESCATTER == 7
     assert wire_abi.REDUCESCATTER_ALIGN_BYTES == 64
@@ -126,12 +125,45 @@ def test_v9_sharded_training_collateral_present():
     assert native._GAG_PREFIX == wire_abi.GROUPED_ALLGATHER_PREFIX
     assert native._OP_REDUCESCATTER == wire_abi.OP_REDUCESCATTER
     wire_h, common_h = _headers()
-    assert "kWireVersion = 9" in wire_h
     assert "kReducescatter = 7" in common_h
     assert check_wire_abi._parse_constant(
         wire_h, "kReducescatterAlignBytes") == 64
     assert check_wire_abi._parse_string_constant(
         wire_h, "kGroupedAllgatherPrefix") == "__gag:"
+
+
+def test_v10_failover_collateral_present():
+    """The coordinator fail-over wire v10 collateral: the version is 10
+    on both sides, the election/arbitration frame types exist at their
+    pinned ids, and the arbitration verdict codes match their mirrors."""
+    from horovod_tpu.runtime import wire_abi
+
+    assert wire_abi.WIRE_VERSION == 10
+    assert wire_abi.FRAME_TYPES["kCoordElect"] == \
+        wire_abi.FRAME_COORD_ELECT == 10
+    assert wire_abi.FRAME_TYPES["kArbitrate"] == \
+        wire_abi.FRAME_ARBITRATE == 11
+    assert (wire_abi.ARBITRATE_REQUEST, wire_abi.ARBITRATE_LINK_ONLY,
+            wire_abi.ARBITRATE_DEAD) == (0, 1, 2)
+    wire_h, _ = _headers()
+    assert "kWireVersion = 10" in wire_h
+    for needle in ("kCoordElect = 10", "kArbitrate = 11",
+                   "kArbitrateRequest = 0", "kArbitrateLinkOnly = 1",
+                   "kArbitrateDead = 2"):
+        assert needle in wire_h, needle
+
+
+def test_checker_detects_arbitration_verdict_drift():
+    """A renumbered arbitration verdict constant in wire.h without the
+    Python mirror (the v10 drift-guard extension) is reported — the
+    verdict code flips the dead-link/dead-rank meaning on the wire
+    without changing any frame id, so it needs its own pin."""
+    wire_h, common_h = _headers()
+    tampered = wire_h.replace("kArbitrateLinkOnly = 1",
+                              "kArbitrateLinkOnly = 7")
+    assert tampered != wire_h, "kArbitrateLinkOnly moved; update this test"
+    problems = check_wire_abi.check(tampered, common_h)
+    assert any("kArbitrateLinkOnly" in p for p in problems), problems
 
 
 def test_checker_detects_gag_prefix_drift():
@@ -182,7 +214,7 @@ def test_version_mismatch_message_names_both_versions():
     lib.hvd_free_cstr.argtypes = [ctypes.c_void_p]
     lib.hvd_wire_version.restype = ctypes.c_int
 
-    assert lib.hvd_wire_version() == wire_abi.WIRE_VERSION == 9
+    assert lib.hvd_wire_version() == wire_abi.WIRE_VERSION == 10
 
     def parse_error(buf: bytes) -> str | None:
         p = lib.hvd_frame_parse_error(buf, len(buf))
@@ -193,19 +225,19 @@ def test_version_mismatch_message_names_both_versions():
         finally:
             lib.hvd_free_cstr(p)
 
-    # v8 <-> v9 (the previous release still running somewhere): the
-    # sharded-training version bump must surface as the descriptive
+    # v9 <-> v10 (the previous release still running somewhere): the
+    # fail-over version bump must surface as the descriptive
     # both-versions message, exactly like every previous bump
-    stale = wire_abi.frame_header(version=8) + b"\x00" * 16
+    stale = wire_abi.frame_header(version=9) + b"\x00" * 16
     msg = parse_error(stale)
     assert msg is not None
-    assert "v8" in msg and "v9" in msg and "libhvdtpu.so" in msg, msg
+    assert "v9" in msg and "v10" in msg and "libhvdtpu.so" in msg, msg
 
     # an even older v7 header: same contract, both versions named
     stale = wire_abi.frame_header(version=7) + b"\x00" * 16
     msg = parse_error(stale)
     assert msg is not None
-    assert "v7" in msg and "v9" in msg and "libhvdtpu.so" in msg, msg
+    assert "v7" in msg and "v10" in msg and "libhvdtpu.so" in msg, msg
 
     # current-version garbage is a parse error, not a version error
     import struct

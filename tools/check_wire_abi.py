@@ -194,6 +194,21 @@ def check(wire_h: str, common_h: str) -> list[str]:
             f"GROUPED_ALLGATHER_PREFIX "
             f"{wire_abi.GROUPED_ALLGATHER_PREFIX!r}")
 
+    # coordinator fail-over wire fields (v10): the election/arbitration
+    # frame ids are pinned by the FRAME_TYPES comparison above; the
+    # arbitration VERDICT codes are plain constexpr ints (they ride inside
+    # ArbitrateFrame.verdict), so they get their own constant pins — a
+    # renumbered verdict would flip the dead-link/dead-rank meaning on the
+    # wire without changing any frame id
+    for cname, pyval in (("kArbitrateRequest", wire_abi.ARBITRATE_REQUEST),
+                         ("kArbitrateLinkOnly",
+                          wire_abi.ARBITRATE_LINK_ONLY),
+                         ("kArbitrateDead", wire_abi.ARBITRATE_DEAD)):
+        got = _parse_constant(wire_h, cname)
+        if got != pyval:
+            problems.append(
+                f"{cname}: wire.h has {got}, wire_abi.py has {pyval}")
+
     ops = _parse_enum(common_h, "OpType")
     if ops != wire_abi.OP_TYPES:
         problems.append(
