@@ -2062,6 +2062,122 @@ def bench_failover(args):
     return results
 
 
+def _run_drain_point(n, drain_ranks, mode, elems, peer_timeout,
+                     hvdrun_args=()):
+    """One graceful-drain launch via hvdrun --min-np, driving
+    tests/native_worker.py's drain_loop.  Everything counted is a pure
+    function of the trigger: exit 0, drains applied, exact final size,
+    the drained rank's ON_DRAIN/DRAINED markers, and ZERO retryable
+    failures anywhere (the scenario runs under max_restarts=0, so one
+    WorldShrunkError crashes a worker and fails the point).  The
+    announce -> shrunk-world-live latency is the coordinator's own
+    hvd_drain_latency measurement (the DRAIN_LATENCY_S marker)."""
+    import re
+
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "HOROVOD_TPU_PEER_TIMEOUT_S": str(peer_timeout),
+        "HOROVOD_TPU_DATA_TIMEOUT_S": "3",
+        "HVD_TEST_ELEMS": str(elems),
+        "HVD_TEST_DRAIN_RANKS": ",".join(str(r) for r in drain_ranks),
+        "HVD_TEST_DRAIN_MODE": mode,
+        "HVD_TEST_EXPECT_FINAL_SIZE": str(n - len(drain_ranks)),
+    })
+    worker = os.path.join(REPO, "tests", "native_worker.py")
+    cmd = [sys.executable, "-m", "horovod_tpu.run", "-np", str(n),
+           "--grace-period", "1", "--min-np", "1", *hvdrun_args,
+           sys.executable, worker, "drain_loop"]
+    t0 = time.perf_counter()
+    proc = subprocess.run(cmd, env=env, cwd=REPO, capture_output=True,
+                          text=True, timeout=300)
+    wall = time.perf_counter() - t0
+    # drains is the coordinator's counter (counted once job-wide); the
+    # final size comes from the highest-changes WORLD_CHANGED marker
+    drains = 0
+    final = None
+    changes_best = -1
+    for m in re.finditer(
+            r"WORLD_CHANGED size=(\d+) changes=(\d+) drains=(\d+)",
+            proc.stdout):
+        drains = max(drains, int(m.group(3)))
+        if int(m.group(2)) >= changes_best:
+            changes_best = int(m.group(2))
+            final = int(m.group(1))
+    lats = [float(m) for m in
+            re.findall(r"DRAIN_LATENCY_S=([0-9.]+)", proc.stdout)]
+    out = proc.stdout + proc.stderr
+    return {
+        "mode": mode,
+        "drain_ranks": list(drain_ranks),
+        "exit_code": proc.returncode,
+        "wall_s": round(wall, 2),
+        "drains": drains,
+        "final_size": final,
+        "drained_clean": all(
+            f"rank {r}: DRAINED OK" in proc.stdout for r in drain_ranks),
+        "checkpointed": all(
+            f"rank {r}: ON_DRAIN checkpoint written" in proc.stdout
+            for r in drain_ranks),
+        "zero_retryable": ("RETRYABLE" not in proc.stdout
+                           and "WorldShrunkError" not in out),
+        "drain_latency_s": round(max(lats), 3) if lats else None,
+    }
+
+
+def bench_drain(args):
+    """Graceful-drain bench (BENCH_r17, wire v11): planned scale-in per
+    trigger at -np 3 and 4 — hvd.request_drain at a negotiation boundary,
+    mid-ring (the gentle change waits for the data plane to run dry),
+    SIGTERM-as-preemption through the --preempt-drain handler, and a
+    two-rank drain whose second eviction rides a world change already in
+    flight.
+
+    The COUNTED series gate CI (tests/test_bench_gate.py): exit 0 per
+    point, drains exact, final world size exact, the drained rank(s)
+    checkpointed + exited clean, and zero retryable failures observed by
+    ANY rank — the whole point of announcing the eviction instead of
+    letting detection find a corpse.  The announce -> shrunk-world-live
+    latency is counted from the coordinator's own hvd_drain_latency and
+    gated only STRUCTURALLY (present and under the drain deadline): its
+    magnitude carries the usual shared-2-core-host caveat."""
+    peer_timeout = args.elastic_peer_timeout
+    results = {"config": {
+        "peer_timeout_s": peer_timeout,
+        "data_timeout_s": 3.0,
+        "min_np": 1,
+        "drain_timeout_s": 30.0,
+        "nproc": os.cpu_count(),
+        "note": "a drain is ANNOUNCED: the drainee finishes its round, "
+                "checkpoints (on_drain), acks, and a gentle kind-2 world "
+                "change requeues un-negotiated work instead of failing "
+                "it — zero retryable failures anywhere is the counted "
+                "contract, vs the reactive path's one failed cycle plus "
+                "detection latency",
+    }}
+    for n in (3, 4):
+        if n > args.elastic_max_np:
+            continue
+        victim = n - 1
+        point = {}
+        point["drain_negotiation"] = _run_drain_point(
+            n, [victim], "api", 4096, peer_timeout)
+        point["drain_mid_ring"] = _run_drain_point(
+            n, [victim], "api", 200000, peer_timeout)
+        point["drain_sigterm"] = _run_drain_point(
+            n, [victim], "sigterm", 4096, peer_timeout,
+            hvdrun_args=("--preempt-drain",))
+        if n >= 3:
+            point["drain_two_ranks"] = _run_drain_point(
+                n, [n - 2, n - 1], "api", 4096, peer_timeout)
+        lat = [p["drain_latency_s"] for p in point.values()
+               if p.get("drain_latency_s") is not None]
+        if lat:
+            point["drain_latency_worst_s"] = max(lat)
+        results[f"np{n}"] = point
+    return results
+
+
 def trace_worker(args):
     """Subprocess under the launcher: a fixed fused-allreduce stream for
     the flight-recorder bench.  Batching is pinned by the parent (long
@@ -3628,6 +3744,12 @@ def build_parser() -> argparse.ArgumentParser:
                     help="run ONLY the coordinator fail-over chaos bench "
                          "(wire v10: SIGKILL rank 0, successor election, "
                          "dead-slot rejoin); writes BENCH_r16.json")
+    ap.add_argument("--drain", action="store_true",
+                    help="run ONLY the graceful-drain bench (wire v11: "
+                         "planned scale-in per trigger — request_drain, "
+                         "mid-ring, SIGTERM-as-preemption, two-rank — "
+                         "with the zero-retryable contract counted); "
+                         "writes BENCH_r17.json")
     ap.add_argument("--process-sets", action="store_true",
                     help="run ONLY the process-set concurrency bench "
                          "(two disjoint sets concurrent vs the same work "
@@ -3891,6 +4013,23 @@ def main() -> None:
                         "rank_joins"),
                 }
         print(json.dumps({"failover": compact, "full": "BENCH_r16.json"}))
+        return
+    if args.drain:
+        # graceful drain only: chaos launches — a few minutes, own
+        # artifact
+        out = bench_drain(args)
+        with open(os.path.join(REPO, "BENCH_r17.json"), "w") as f:
+            json.dump(out, f, indent=1)
+        compact = {}
+        for k, v in out.items():
+            if k.startswith("np"):
+                compact[k] = {
+                    "worst_drain_s": v.get("drain_latency_worst_s"),
+                    "zero_retryable": all(
+                        p.get("zero_retryable") for p in v.values()
+                        if isinstance(p, dict)),
+                }
+        print(json.dumps({"drain": compact, "full": "BENCH_r17.json"}))
         return
     if args.fault:
         # fault-domain only: chaos launches + one negotiation run — a few

@@ -38,6 +38,7 @@
 
 #include <fcntl.h>
 #include <poll.h>
+#include <sys/file.h>
 #include <sys/socket.h>
 #include <sys/time.h>
 #include <unistd.h>
@@ -1008,6 +1009,27 @@ class Engine {
     return coord_slot_pub_.load(std::memory_order_relaxed);
   }
 
+  // -- graceful drain, Python surface (wire v11) --------------------------
+  // Ask for a planned eviction: `target` is a CURRENT-world rank, -1 =
+  // this rank (the SIGTERM/spot-preemption path).  Any thread.
+  void RequestDrain(int target, const std::string& reason);
+  // The draining rank's Python side signals "checkpoint written": the bg
+  // thread sends the kDrain ack once the engine is quiesced.
+  void DrainAck() {
+    drain_ack_requested_.store(1, std::memory_order_relaxed);
+    Wake();
+  }
+  // 1 while a coordinator announce names THIS rank (Python polls it to
+  // run the on_drain hook), and 1 once the eviction committed and the
+  // engine stopped cleanly (Python then exits 0).
+  int DrainSelfAnnounced() const {
+    return drain_self_.load(std::memory_order_relaxed);
+  }
+  int Drained() const { return drained_.load(std::memory_order_relaxed); }
+  uint64_t CoordGeneration() const {
+    return coord_generation_.load(std::memory_order_relaxed);
+  }
+
  private:
   void BackgroundLoop();
   void WaitForWork(std::chrono::microseconds max_wait);
@@ -1122,8 +1144,13 @@ class Engine {
   // as fatal instead of luring callers into a retry livelock.
   Status ElasticizeWire(Status st);
   // Fail the in-flight cycle with `cause`, clear every piece of old-world
-  // negotiation/cache/claim state, and tear down the data plane.
-  void BeginWorldChange(const Status& cause);
+  // negotiation/cache/claim state, and tear down the data plane.  With
+  // `gentle` (a graceful drain, wire v11) the in-flight data plane is
+  // allowed to FINISH and un-negotiated work is REQUEUED into the new
+  // world instead of failed retryable — zero failed handles is the drain
+  // contract; a data plane that does not run dry inside the bound falls
+  // back to the abrasive path.
+  void BeginWorldChange(const Status& cause, bool gentle = false);
   // Coordinator: a worker died.  Shrink when elastic allows it (returns 0
   // — caller abandons the tick), abort classically otherwise (returns 1).
   int OnWorkerDeath(int dead_rank, const std::string& why);
@@ -1135,7 +1162,38 @@ class Engine {
   // the lowest survivor, hence new rank 0).  Returns true when the change
   // had to abort instead.
   bool CoordinateWorldChange(std::vector<int> dead, const std::string& why,
-                             bool join, int self_old = 0);
+                             bool join, int self_old = 0,
+                             bool drain = false);
+  // -- graceful drain (wire v11) ------------------------------------------
+  // Feed one eviction target into the coordinator-side queue (any
+  // thread; rank 0 consumes directly, workers forward via kDrain).
+  void NoteDrainRequest(int target, const std::string& reason);
+  // Worker bg thread: forward queued drain requests and send the
+  // quiesced-checkpoint ack once Python asked for it.
+  void MaybeSendDrain();
+  // Coordinator bg thread: announce pending drains, collect acks, and
+  // drive the gentle shrink.  0 = nothing, 1 = aborted, 2 = world changed
+  // (abandon the tick).
+  int CoordinatorDrainTick();
+  // Bounded gentle quiesce used by the drain world change: true when the
+  // pipeline / set executors ran dry inside `bound_s`.
+  bool DrainPipelineBounded(double bound_s);
+  bool QuiesceSetsGentle(double bound_s);
+  bool PipelineIdle();
+  // -- election fencing (wire v11) ----------------------------------------
+  // The job's shared bootstrap record ("<generation> <host> <port>") under
+  // HOROVOD_TPU_BOOTSTRAP_DIR: the acting coordinator persists its
+  // election generation + live rendezvous address there, so relaunched
+  // joiners dial the SUCCESSOR and a wedged-past-the-window survivor that
+  // recovers sees a newer generation and exits instead of electing a
+  // splinter world.  All no-ops when the dir is unset.
+  bool ReadBootstrapRecord(uint64_t* gen, std::string* host,
+                           int* port) const;
+  // flock'd compare-and-swap: true when `gen` is strictly newer than the
+  // record (the claim is written under the lock); false = another
+  // successor already claimed this or a newer generation.
+  bool ClaimGeneration(uint64_t gen);
+  void PublishBootstrapRecord();
   // -- coordinator fail-over (wire v10) -----------------------------------
   // Worker: rank 0 is gone (socket loss or heartbeat expiry — the same
   // signals that abort a non-elastic job).  In an elastic world the
@@ -1467,6 +1525,46 @@ class Engine {
   std::atomic<int> arb_accused_{-1};
   int arb_sent_for_ = -1;                // bg thread only
   std::atomic<int> arb_link_only_{-1};
+  // -- graceful drain (wire v11) ------------------------------------------
+  // Coordinator side: requested-but-unannounced targets (fed from worker
+  // kDrain requests, the rendezvous DRAIN hello, and rank 0's own
+  // RequestDrain — the last arrives from the Python thread, hence the
+  // mutex), then the announced set awaiting quiesced-checkpoint acks.
+  // A deadline expiry evicts anyway (degrading to the ordinary retryable
+  // shrink rather than letting an unresponsive drainee stall eviction).
+  std::mutex drain_mu_;
+  std::vector<int> drain_requests_;      // guarded by drain_mu_
+  std::string drain_reason_;             // guarded by drain_mu_
+  bool drain_want_self_ = false;         // guarded by drain_mu_ (worker:
+                                         // self-eviction survives world
+                                         // changes until it lands)
+  std::set<int> draining_;               // bg thread: announced, unacked
+  std::set<int> drain_acked_;            // bg thread
+  int64_t drain_deadline_ns_ = 0;        // bg thread
+  int64_t drain_t0_ns_ = 0;              // bg thread: announce stamp
+  // Worker side: the announce latch Python polls (run on_drain, ack),
+  // the ack request from the Python thread, and the committed-eviction
+  // latch the Python side exits 0 on.
+  std::atomic<int> drain_self_{0};
+  std::atomic<int> drain_ack_requested_{0};
+  bool drain_req_sent_ = false;          // bg thread, reset per world
+  bool drain_ack_sent_ = false;          // bg thread, reset per world
+  std::atomic<int> drained_{0};
+  // -- election fencing (wire v11) ----------------------------------------
+  // Monotonic election generation: 0 at launch, +1 per successful
+  // fail-over, table-shipped so every member and joiner tracks the
+  // acting coordinator's value; persisted in the bootstrap record.
+  std::atomic<uint64_t> coord_generation_{0};
+  // The last APPLIED world change's old_ranks map (new rank i <- prior
+  // rank), kept so a fail-over successor can adopt a registration from
+  // the immediately-prior epoch by translating its rank (the two-phase
+  // table handoff for survivors stranded mid-world-change).
+  std::vector<int64_t> last_wc_old_ranks_;
+  // the table-shipped "epoch this world will have" (wire v11): joiners
+  // adopt it so their later fail-over registrations carry the same epoch
+  // as every survivor (PR 14 left joiners at epoch 0 — a post-join
+  // fail-over rejected their registrations as mid-epoch strays)
+  int64_t table_epoch_next_ = 0;
   // published world info for cross-thread readers (Python diagnostics):
   // the bg thread renumbers rank_/size_ mid-run, so readers on other
   // threads use these mirrors (and hb arrays are allocated once at
@@ -1914,6 +2012,11 @@ Status Engine::Init(const std::string& host, int port, int rank, int size) {
         // non-elastic jobs never admit joiners: release the port
         rendezvous_.Close();
         rendezvous_open_ = false;
+      } else {
+        // bootstrap record (wire v11): generation 0 + the live
+        // rendezvous address, so launchers can re-point relaunched
+        // joiners at whoever coordinates and fence stale electors
+        PublishBootstrapRecord();
       }
     } else {
       s = Socket::Connect(host, port, &coord_, start_timeout_s_);
@@ -2073,7 +2176,10 @@ std::string Engine::BuildTable(
         << " " << stripes_local_ << " " << nics_ << " "
         << stripe_quantum_ << " " << sg_threshold_ << " "
         << tune_stripes_on_ << " " << (elastic_ ? 1 : 0) << " " << min_np_
-        << " " << coord_slot_ << " " << hosts.size() << " ";
+        << " " << coord_slot_ << " "
+        << coord_generation_.load(std::memory_order_relaxed) << " "
+        << (world_epoch_.load(std::memory_order_relaxed) + 1) << " "
+        << hosts.size() << " ";
   for (size_t i = 0; i < hosts.size(); i++)
     table << hosts[i] << " " << ports[i] << " " << hashes[i] << " ";
   // process-set registry (wire v8): membership changes renumber every set
@@ -2105,10 +2211,13 @@ Status Engine::ParseTable(const std::string& table,
   int64_t t_sc = 1, t_sl = 1, t_nics = 1, t_quant = 64 << 10,
           t_sg = 4 << 20;
   int t_elastic = 0, t_min_np = 1, t_coord_slot = 0;
+  uint64_t t_generation = 0;
+  int64_t t_epoch_next = 0;
   int64_t count = 0;
   is >> *shm_token >> shm_on_ >> cache_capacity_ >> table_depth
      >> table_seg >> t_sc >> t_sl >> t_nics >> t_quant >> t_sg
-     >> tune_stripes_on_ >> t_elastic >> t_min_np >> t_coord_slot >> count;
+     >> tune_stripes_on_ >> t_elastic >> t_min_np >> t_coord_slot
+     >> t_generation >> t_epoch_next >> count;
   if (!is || count < 1 || count > (1 << 20))
     return Status::Error("malformed bootstrap table");
   ApplyPipelineDepth(table_depth);
@@ -2124,6 +2233,13 @@ Status Engine::ParseTable(const std::string& table,
   // learns it from whichever table admitted it to the current world
   coord_slot_ = t_coord_slot < 0 ? 0 : t_coord_slot;
   coord_slot_pub_.store(coord_slot_, std::memory_order_relaxed);
+  // election generation (wire v11): table-shipped so every member tracks
+  // the acting coordinator's value — the generation fence compares a
+  // recovered survivor's view against the persisted bootstrap record
+  coord_generation_.store(t_generation, std::memory_order_relaxed);
+  // "the epoch this world will have": survivors derive it by their own
+  // +1 at commit; JOINERS adopt it outright (see JoinBootstrap)
+  table_epoch_next_ = t_epoch_next < 0 ? 0 : t_epoch_next;
   hosts->assign(static_cast<size_t>(count), "");
   ports->assign(static_cast<size_t>(count), 0);
   hashes->assign(static_cast<size_t>(count), "");
@@ -2388,8 +2504,9 @@ Engine::WcWait Engine::AwaitWorldCommit(WorldChangeFrame* wc, double bound_s,
     }
     if (ft == FrameType::kWorldCommit) {
       WorldCommitFrame cf;
-      if (Parse(fr, &cf).ok() && cf.epoch == wc->epoch)
+      if (Parse(fr, &cf).ok() && cf.epoch == wc->epoch) {
         return WcWait::kCommitted;
+      }
       // commits for an older epoch are stale — ignored
     }
   }
@@ -2493,6 +2610,23 @@ Status Engine::JoinBootstrap(const std::string& host, int port,
     if (w == WcWait::kAborted)
       return Status::Error("elastic join: job aborted — " + af.message);
     break;  // committed
+  }
+  // epoch alignment (wire v11): adopt the admitted world's epoch so a
+  // later fail-over registration from this rank carries the same epoch
+  // every survivor carries (PR 14 left joiners at epoch 0, so a
+  // post-join coordinator death rejected their registrations as
+  // mid-epoch strays and presumed the joiner dead).  The chaos hook
+  // recreates the one-behind stranded state the successor's prior-epoch
+  // adoption path must then rescue.
+  {
+    int64_t adopted = table_epoch_next_;
+    if (EnvFlag("HOROVOD_TPU_TEST_JOINER_STALE_EPOCH") && adopted > 0) {
+      adopted -= 1;
+      LogWarn("test hook: joiner keeps the one-behind world epoch " +
+              std::to_string(adopted));
+    }
+    world_epoch_.store(adopted, std::memory_order_relaxed);
+    last_wc_old_ranks_ = wc.old_ranks;
   }
   LOG_RANK(Warning, rank_) << "elastic join: entering a running world as "
                            << "rank " << rank_ << " of " << size_;
@@ -2639,10 +2773,82 @@ int Engine::CoordinatorSelfArbitrate() {
   return 0;
 }
 
-void Engine::BeginWorldChange(const Status& cause) {
+bool Engine::DrainPipelineBounded(double bound_s) {
+  if (!pipelined_) return true;
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::duration<double>(bound_s);
+  for (;;) {
+    DrainCompletions();
+    PipelineStallCheck();
+    std::unique_lock<std::mutex> lk(pipe_mu_);
+    if (dp_queue_.empty() && !dp_busy_flag_ && dp_done_.empty()) return true;
+    if (std::chrono::steady_clock::now() > deadline) return false;
+    pipe_cv_.wait_for(lk, std::chrono::milliseconds(5));
+  }
+}
+
+bool Engine::QuiesceSetsGentle(double bound_s) {
+  // unlike QuiesceSets this does NOT clear queued work: the transport is
+  // healthy (the drain was announced, nothing died), so the executors
+  // finish their queues and the collectives complete normally
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::duration<double>(bound_s);
+  for (auto& [id, ps] : psets_) {
+    std::unique_lock<std::mutex> lk(ps->mu);
+    if (!ps->cv.wait_until(lk, deadline,
+                           [&] { return ps->work.empty() && !ps->busy; }))
+      return false;
+  }
+  return true;
+}
+
+bool Engine::PipelineIdle() {
+  if (!pipelined_) return true;
+  std::lock_guard<std::mutex> lk(pipe_mu_);
+  return dp_queue_.empty() && !dp_busy_flag_ && dp_done_.empty();
+}
+
+void Engine::BeginWorldChange(const Status& cause, bool gentle) {
   // audit verdicts name ranks by OLD-world numbers and rounds restart
   // with the membership: drop anything still waiting for a frame
   pending_verdicts_.clear();
+  if (gentle) {
+    // graceful drain (wire v11): the change was ANNOUNCED, the drained
+    // rank quiesced before acking, and every peer is alive — so nothing
+    // on the wire needs cancelling.  Let in-flight work FINISH over the
+    // healthy transport, then REQUEUE un-negotiated work so it re-enters
+    // negotiation in the new world: zero failed handles, which is the
+    // drain contract the chaos rows assert per rank.  Bounded: a data
+    // plane that does not run dry inside the bound means a real fault
+    // landed mid-drain — fall through to the abrasive path below.
+    double bound = DuplexTimeoutSeconds() + 5.0;
+    if (DrainPipelineBounded(bound) && QuiesceSetsGentle(bound)) {
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        // only entries whose request already LEFT the submit queue are
+        // re-pushed (a request still queued will be drained normally in
+        // the new world; pushing it again would double-submit)
+        std::set<std::string> queued;
+        for (const Request& q : queue_) queued.insert(q.name);
+        std::vector<std::string> names;
+        for (auto& [name, e] : tensor_table_)
+          if (!queued.count(name)) names.push_back(name);
+        std::sort(names.begin(), names.end());
+        for (auto& nm : names) queue_.push_back(tensor_table_[nm].req);
+      }
+      // old-world negotiation / claim / cache state dies with the
+      // membership exactly as in the abrasive path; the requeued
+      // requests re-negotiate from the empty replicas
+      neg0_.Reset(cache_capacity_);
+      for (auto& [id, ps] : psets_) ps->neg.Reset(cache_capacity_);
+      cache_entries_.store(0, std::memory_order_relaxed);
+      pending_set_conns_.clear();
+      return;
+    }
+    LogWarn("graceful drain: the data plane did not run dry inside " +
+            std::to_string(static_cast<int>(bound)) +
+            "s — falling back to the ordinary (retryable) world change");
+  }
   SetAborting(true);  // parked transfers (ours + the executors') cancel
   // half-close every old-world link (fd-safe vs a mid-transfer executor):
   // local blocked TCP waits fail on the next syscall, and the RSTs
@@ -2703,13 +2909,14 @@ int Engine::OnWorkerDeath(int dead_rank, const std::string& why) {
 
 bool Engine::CoordinateWorldChange(std::vector<int> dead,
                                    const std::string& why, bool join,
-                                   int self_old) {
+                                   int self_old, bool drain) {
   int64_t t0 = NowNs();
-  timeline_.FaultMark(join ? "WORLD_JOIN" : "WORLD_SHRINK");
-  if (!dead.empty()) timeline_.FaultMark("PEER_DEAD");
+  timeline_.FaultMark(drain ? "WORLD_DRAIN"
+                            : join ? "WORLD_JOIN" : "WORLD_SHRINK");
+  if (!dead.empty() && !drain) timeline_.FaultMark("PEER_DEAD");
   LogWarn(std::string("elastic world change (") +
-          (join ? "join" : "shrink") + "): " + why);
-  BeginWorldChange(MakeWorldChangeStatus(why));
+          (drain ? "drain" : join ? "join" : "shrink") + "): " + why);
+  BeginWorldChange(MakeWorldChangeStatus(why), drain);
   // multi-joiner admission (wire v10 satellite): every queued joiner whose
   // socket is still live rides this ONE round — an N-rank relaunch pays
   // one shrink-free grow instead of N serialized world changes (counted
@@ -2745,8 +2952,9 @@ bool Engine::CoordinateWorldChange(std::vector<int> dead,
     wc = WorldChangeFrame{};
     wc.epoch = ++world_proposal_;
     // the live joiner state, not the join argument: a joiner whose socket
-    // breaks mid-round demotes (or shrinks) the change
-    wc.kind = live_joins > 0 ? 1 : 0;
+    // breaks mid-round demotes (or shrinks) the change.  A drain round is
+    // kind kWorldChangeDrain so every member takes the GENTLE path.
+    wc.kind = drain ? kWorldChangeDrain : (live_joins > 0 ? 1 : 0);
     wc.message = why;
     for (int d : dead) wc.dead_ranks.push_back(d);
     for (int r : survivors) {
@@ -2783,6 +2991,14 @@ bool Engine::CoordinateWorldChange(std::vector<int> dead,
     wc.table = BuildTable(nh, np, nhash, token, tsets);
     std::string frame = Serialize(wc);
     bool redo = false;
+    // drained ranks are ALIVE: they get the proposal too (self absent
+    // from old_ranks + kind drain = their clean-exit signal), but no ack
+    // is awaited — the new world does not include them and their engine
+    // quiesced before acking the announce
+    if (drain)
+      for (int d : dead)
+        if (d != self_old && d >= 1 && d < size_ && workers_[d].valid())
+          (void)SendCtrl(workers_[d], frame);
     for (int r : survivors) {
       if (r == self_old) continue;
       if (!SendCtrl(workers_[r], frame).ok()) {
@@ -2942,6 +3158,9 @@ bool Engine::CoordinateWorldChange(std::vector<int> dead,
     abort_status_ = Status::OK();
   }
   SetAborting(false);
+  // the two-phase-handoff translation map: a fail-over successor adopts
+  // prior-epoch registrations through the LAST applied change's old_ranks
+  last_wc_old_ranks_ = wc.old_ranks;
   Status s = BuildWorld();
   if (!s.ok()) {
     AbortJob(Status::Error("elastic world rebuild failed: " + s.message),
@@ -2955,16 +3174,30 @@ bool Engine::CoordinateWorldChange(std::vector<int> dead,
 bool Engine::HandleWorldChange(WorldChangeFrame wc) {
   int64_t t0 = NowNs();
   LogWarn("elastic world change from coordinator: " + wc.message);
-  BeginWorldChange(MakeWorldChangeStatus(wc.message));
+  BeginWorldChange(MakeWorldChangeStatus(wc.message),
+                   /*gentle=*/wc.kind == kWorldChangeDrain);
   for (;;) {
     int new_rank = -1;
     for (size_t i = 0; i < wc.old_ranks.size(); i++)
       if (wc.old_ranks[i] == rank_) new_rank = static_cast<int>(i);
-    if (new_rank < 0)
+    if (new_rank < 0) {
+      if (wc.kind == kWorldChangeDrain) {
+        // planned eviction landing on the drained rank: the drain is
+        // COMPLETE — this engine quiesced before acking the announce, so
+        // there is nothing to fail; stop cleanly and let the Python side
+        // exit 0 with its checkpoint written
+        drained_.store(1, std::memory_order_relaxed);
+        timeline_.FaultMark("DRAINED");
+        LOG_RANK(Warning, rank_)
+            << "drain complete: this rank left the world cleanly";
+        FailAll(Status::Shutdown());
+        return true;
+      }
       return AbortJob(
           Status::Error("world change evicted this rank (old rank " +
                         std::to_string(rank_) + ") — aborting"),
           -1);
+    }
     std::vector<std::string> nh, nhash;
     std::vector<int> np;
     std::string token;
@@ -3011,6 +3244,7 @@ bool Engine::HandleWorldChange(WorldChangeFrame wc) {
     abort_status_ = Status::OK();
   }
   SetAborting(false);
+  last_wc_old_ranks_ = wc.old_ranks;
   Status s = BuildWorld();
   if (!s.ok())
     return AbortJob(
@@ -3046,6 +3280,24 @@ void Engine::FinishWorldChange(int njoins, int64_t t0_ns) {
   arb_link_only_.store(-1, std::memory_order_relaxed);
   arb_sent_for_ = -1;
   failover_depth_ = 0;  // a committed world has a live coordinator again
+  // drain state names OLD-world ranks too: an interleaved change voids
+  // any in-flight announce AND any queued-but-unannounced requests (a
+  // stale target number would drain whoever now wears it); a surviving
+  // SELF-request (drain_want_self_) re-forwards in the new world with
+  // its new rank — the preemption notice did not expire because
+  // somebody else died first
+  draining_.clear();
+  drain_acked_.clear();
+  {
+    std::lock_guard<std::mutex> lk(drain_mu_);
+    drain_requests_.clear();
+  }
+  drain_self_.store(0, std::memory_order_relaxed);
+  drain_ack_requested_.store(0, std::memory_order_relaxed);
+  drain_req_sent_ = false;
+  drain_ack_sent_ = false;
+  // a fail-over successor bumped the generation before this change;
+  // every other member adopted it from the shipped table (ParseTable)
   {
     // a shutdown announced DURING the change was discarded with the rest
     // of the old-world control traffic: re-announce it in the new world
@@ -3110,6 +3362,34 @@ int Engine::MaybeAcceptJoin() {
     std::string tag, h, hash;
     int p = 0;
     is >> tag >> h >> p >> hash;
+    if (tag == "DRAIN") {
+      // control-client hello (wire v11): `hvdrun --drain RANK` dials the
+      // rendezvous listener and asks for a planned eviction; the reply
+      // confirms the request was QUEUED (the announce/ack/shrink runs at
+      // the next tick boundaries).  The connection is control-only and
+      // dropped after the reply.
+      int target = h.empty() ? -1 : atoi(h.c_str());
+      std::string err;
+      if (h.empty() || (target == 0 && h != "0")) {
+        err = "malformed drain hello '" + hello + "'";
+      } else if (target == 0) {
+        err = "rank 0 (the coordinator) cannot be drained";
+      } else if (target < 0 || target >= size_ ||
+                 !workers_[target].valid()) {
+        err = "rank " + h + " is not a live member of this world (size " +
+              std::to_string(size_) + ")";
+      }
+      if (err.empty()) {
+        NoteDrainRequest(target, "hvdrun --drain rank " + h);
+        (void)sock.SendFrame("DRAIN-OK " + h);
+        LogWarn("elastic: drain of rank " + h +
+                " requested via the rendezvous listener");
+      } else {
+        (void)sock.SendFrame("DRAIN-ERR " + err);
+        LogWarn("elastic: drain hello rejected — " + err);
+      }
+      continue;
+    }
     if (tag != "JOIN" || h.empty() || p <= 0) {
       LogWarn("elastic: unrecognized rendezvous hello '" + hello +
               "' — dropped");
@@ -3160,20 +3440,335 @@ int Engine::MaybeAcceptJoin() {
 }
 
 // ---------------------------------------------------------------------------
+// graceful drain (wire v11): announced scale-in — request, announce,
+// checkpoint-ack, gentle shrink
+// ---------------------------------------------------------------------------
+
+void Engine::NoteDrainRequest(int target, const std::string& reason) {
+  std::lock_guard<std::mutex> lk(drain_mu_);
+  drain_requests_.push_back(target);
+  if (!reason.empty()) drain_reason_ = reason;
+}
+
+void Engine::RequestDrain(int target, const std::string& reason) {
+  int self = world_rank_pub_.load(std::memory_order_relaxed);
+  if (target < 0) target = self;
+  {
+    std::lock_guard<std::mutex> lk(drain_mu_);
+    drain_requests_.push_back(target);
+    if (!reason.empty()) drain_reason_ = reason;
+    // a SELF-eviction request survives interleaved world changes: the
+    // bg thread re-forwards it each epoch until the drain lands (the
+    // preemption notice does not expire because somebody else died)
+    if (target == self && self != 0) drain_want_self_ = true;
+  }
+  Wake();
+}
+
+void Engine::MaybeSendDrain() {
+  if (rank_ == 0 || !elastic_) return;
+  // forward locally-requested evictions to the coordinator, once per
+  // world (FinishWorldChange re-arms so a surviving self-request is
+  // re-announced in the new world)
+  if (!drain_req_sent_) {
+    DrainFrame df;
+    {
+      std::lock_guard<std::mutex> lk(drain_mu_);
+      for (int t : drain_requests_) df.ranks.push_back(t);
+      if (drain_want_self_) df.ranks.push_back(rank_);
+      df.reason = drain_reason_;
+    }
+    if (!df.ranks.empty()) {
+      std::sort(df.ranks.begin(), df.ranks.end());
+      df.ranks.erase(std::unique(df.ranks.begin(), df.ranks.end()),
+                     df.ranks.end());
+      df.rank = rank_;
+      df.phase = kDrainRequest;
+      df.epoch =
+          static_cast<uint64_t>(world_epoch_.load(std::memory_order_relaxed));
+      // clear the queue only once the forward actually left: a
+      // transient send failure (coordinator mid-fail-over — exactly
+      // when preemption notices cluster) must not drop the request
+      if (SendCtrl(coord_, Serialize(df)).ok()) {
+        drain_req_sent_ = true;
+        hb_last_tx_ns_ = NowNs();
+        std::lock_guard<std::mutex> lk(drain_mu_);
+        drain_requests_.clear();
+      }
+    }
+  }
+  // the quiesced-checkpoint ack: the announce named this rank, Python
+  // ran the on_drain hook and asked for the ack, and the engine has no
+  // work left anywhere (submit queue, tensor table, pipeline, set
+  // executors) — the coordinator can now evict with nothing in flight
+  if (drain_self_.load(std::memory_order_relaxed) &&
+      drain_ack_requested_.load(std::memory_order_relaxed) &&
+      !drain_ack_sent_) {
+    bool quiet;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      quiet = tensor_table_.empty() && queue_.empty();
+    }
+    if (quiet && PipelineIdle()) {
+      for (auto& [id, ps] : psets_) {
+        std::lock_guard<std::mutex> lk(ps->mu);
+        if (!ps->work.empty() || ps->busy) {
+          quiet = false;
+          break;
+        }
+      }
+    } else {
+      quiet = false;
+    }
+    if (quiet) {
+      DrainFrame df;
+      df.rank = rank_;
+      df.phase = kDrainAck;
+      df.epoch =
+          static_cast<uint64_t>(world_epoch_.load(std::memory_order_relaxed));
+      if (SendCtrl(coord_, Serialize(df)).ok()) {
+        drain_ack_sent_ = true;
+        hb_last_tx_ns_ = NowNs();
+        LOG_RANK(Warning, rank_)
+            << "drain: checkpoint ack sent — awaiting the eviction";
+      }
+    }
+  }
+}
+
+int Engine::CoordinatorDrainTick() {
+  if (!elastic_) {
+    std::lock_guard<std::mutex> lk(drain_mu_);
+    if (!drain_requests_.empty()) {
+      LogWarn("drain requested but the job is not elastic "
+              "(HOROVOD_TPU_ELASTIC / --min-np) — request ignored");
+      drain_requests_.clear();
+    }
+    return 0;
+  }
+  int64_t now = NowNs();
+  if (draining_.empty()) {
+    std::vector<int> reqs;
+    std::string reason;
+    {
+      std::lock_guard<std::mutex> lk(drain_mu_);
+      reqs.swap(drain_requests_);
+      reason = drain_reason_.empty() ? "planned drain" : drain_reason_;
+    }
+    if (reqs.empty()) return 0;
+    std::set<int> targets;
+    for (int t : reqs) {
+      if (t == 0) {
+        LogWarn("drain of the coordinator (rank 0) is not supported — "
+                "request ignored (its DEATH is survivable: the fail-over "
+                "election covers coordinator loss)");
+        continue;
+      }
+      if (t < 1 || t >= size_ || !workers_[t].valid()) {
+        LogWarn("drain request for rank " + std::to_string(t) +
+                ": no such live rank — ignored");
+        continue;
+      }
+      targets.insert(t);
+    }
+    if (targets.empty()) return 0;
+    std::string who;
+    for (int t : targets)
+      who += (who.empty() ? "" : ", ") + std::to_string(t);
+    if (size_ - static_cast<int>(targets.size()) < min_np_) {
+      AbortJob(Status::Error(
+                   "planned drain of rank(s) " + who +
+                   " would shrink the world to " +
+                   std::to_string(size_ - static_cast<int>(targets.size())) +
+                   " < HOROVOD_TPU_MIN_NP=" + std::to_string(min_np_) +
+                   "; aborting job"),
+               -1);
+      return 1;
+    }
+    DrainFrame df;
+    df.rank = 0;
+    df.phase = kDrainAnnounce;
+    df.epoch =
+        static_cast<uint64_t>(world_epoch_.load(std::memory_order_relaxed));
+    for (int t : targets) df.ranks.push_back(t);
+    df.reason = reason;
+    std::string frame = Serialize(df);
+    for (int i = 1; i < size_; i++) {
+      if (!workers_[i].valid()) continue;
+      (void)SendCtrl(workers_[i], frame);
+    }
+    hb_last_tx_ns_ = now;
+    draining_ = std::move(targets);
+    drain_acked_.clear();
+    drain_t0_ns_ = now;
+    {
+      std::lock_guard<std::mutex> lk(drain_mu_);
+      drain_reason_ = reason;
+    }
+    drain_deadline_ns_ =
+        now + static_cast<int64_t>(DrainTimeoutSeconds() * 1e9);
+    timeline_.FaultMark("DRAIN_ANNOUNCE");
+    LogWarn("drain announced for rank(s) " + who + " (" + reason +
+            ") — draining ranks finish the round, checkpoint, and ack");
+    return 0;
+  }
+  // announce in flight: evict once every drainee acked (or died — the
+  // normal death path already handles the corpse) or the deadline passed
+  bool complete = true;
+  for (int t : draining_)
+    if (!drain_acked_.count(t) && workers_[t].valid()) complete = false;
+  if (!complete && now < drain_deadline_ns_) return 0;
+  if (!complete)
+    LogWarn("drain: not every draining rank acked within "
+            "HOROVOD_TPU_DRAIN_TIMEOUT_S — evicting anyway (survivors "
+            "may see one retryable round)");
+  std::vector<int> dead(draining_.begin(), draining_.end());
+  std::string who;
+  for (int t : dead) who += (who.empty() ? "" : ", ") + std::to_string(t);
+  std::string reason;
+  {
+    std::lock_guard<std::mutex> lk(drain_mu_);
+    reason = drain_reason_;
+    drain_reason_.clear();
+  }
+  draining_.clear();
+  drain_acked_.clear();
+  int64_t t0 = drain_t0_ns_;
+  bool aborted = CoordinateWorldChange(
+      std::move(dead),
+      "planned drain: rank(s) " + who + " leaving the world (" + reason +
+          ")",
+      /*join=*/false, /*self_old=*/0, /*drain=*/complete);
+  if (!aborted) {
+    Faults().drains.fetch_add(1, std::memory_order_relaxed);
+    Faults().drain_latency_ns.fetch_add(NowNs() - t0,
+                                        std::memory_order_relaxed);
+  }
+  return aborted ? 1 : 2;
+}
+
+// ---------------------------------------------------------------------------
 // coordinator fail-over (wire v10): election, successor take-over
+// (wire v11: generation + reachability fencing, progress-extended window)
 // ---------------------------------------------------------------------------
 
 double Engine::FailoverWindowSeconds() const {
+  // explicit override first (operators tuning tight fail-over SLAs; the
+  // chaos suite pins it so the wedged-survivor rows run in seconds)
+  if (const char* e = getenv("HOROVOD_TPU_FAILOVER_WINDOW_S"))
+    if (e[0]) {
+      double v = atof(e);
+      if (v > 0) return v;
+    }
   // must cover the detection-time skew between survivors: a rank whose bg
   // thread is parked in a data transfer only notices the coordinator died
   // when its data-plane bound expires, and heartbeat-based detection lags
   // up to the peer timeout.  Generous is fine — the successor leaves the
-  // window early once every expected survivor has registered.
+  // window early once every expected survivor has registered, and a
+  // survivor observed mid-registration EXTENDS it (the window measures
+  // silence, not wall time).
   double w = peer_timeout_s_ > 0 ? peer_timeout_s_ : 10.0;
   double d = DuplexTimeoutSeconds();
   if (d > w) w = d;
   if (w < 5.0) w = 5.0;
   return w + 5.0;
+}
+
+// ---------------------------------------------------------------------------
+// bootstrap record (wire v11): "<generation> <host> <port>" under
+// HOROVOD_TPU_BOOTSTRAP_DIR/coordinator.  The acting coordinator persists
+// its election generation and LIVE rendezvous address there: relaunched
+// joiners dial the successor after a cross-host fail-over, and a
+// wedged-past-the-window survivor that recovers sees a newer generation
+// and exits instead of electing a splinter world.  Everything degrades to
+// a no-op when the dir is unset (the reachability probe still stands).
+// ---------------------------------------------------------------------------
+
+namespace {
+std::string BootstrapRecordPath() {
+  const char* d = getenv("HOROVOD_TPU_BOOTSTRAP_DIR");
+  if (!d || !d[0]) return std::string();
+  return std::string(d) + "/coordinator";
+}
+}  // namespace
+
+bool Engine::ReadBootstrapRecord(uint64_t* gen, std::string* host,
+                                 int* port) const {
+  std::string path = BootstrapRecordPath();
+  if (path.empty()) return false;
+  int fd = open(path.c_str(), O_RDONLY);
+  if (fd < 0) return false;
+  flock(fd, LOCK_SH);
+  char buf[512] = {0};
+  ssize_t n = read(fd, buf, sizeof(buf) - 1);
+  flock(fd, LOCK_UN);
+  close(fd);
+  if (n <= 0) return false;
+  std::istringstream is(std::string(buf, static_cast<size_t>(n)));
+  uint64_t g = 0;
+  std::string h;
+  int p = 0;
+  if (!(is >> g)) return false;
+  is >> h >> p;
+  *gen = g;
+  if (host) *host = h;
+  if (port) *port = p;
+  return true;
+}
+
+bool Engine::ClaimGeneration(uint64_t gen) {
+  // flock'd compare-and-swap: at most ONE successor can claim each
+  // generation, so two simultaneous elections (a recovered wedged
+  // survivor racing the real successor) cannot both form worlds wherever
+  // the record is shared.  An absent/unwritable record never blocks
+  // recovery — the fence is advisory hardening on top of the
+  // reachability probe, not a required service.
+  std::string path = BootstrapRecordPath();
+  if (path.empty()) return true;
+  int fd = open(path.c_str(), O_RDWR | O_CREAT, 0644);
+  if (fd < 0) return true;
+  flock(fd, LOCK_EX);
+  char buf[512] = {0};
+  ssize_t n = read(fd, buf, sizeof(buf) - 1);
+  uint64_t cur = 0;
+  if (n > 0) cur = strtoull(buf, nullptr, 10);
+  bool won = gen > cur;
+  if (won) {
+    std::string host =
+        rank_ < static_cast<int>(hosts_.size()) && !hosts_.empty()
+            ? hosts_[static_cast<size_t>(rank_)]
+            : "127.0.0.1";
+    std::string rec = std::to_string(gen) + " " + host + " " +
+                      std::to_string(rendezvous_port_) + "\n";
+    if (ftruncate(fd, 0) == 0 && lseek(fd, 0, SEEK_SET) == 0)
+      (void)!write(fd, rec.data(), rec.size());
+  }
+  flock(fd, LOCK_UN);
+  close(fd);
+  return won;
+}
+
+void Engine::PublishBootstrapRecord() {
+  // (re)write the record with the LIVE rendezvous address — called by
+  // rank 0 at bootstrap (generation 0) and by a fail-over successor
+  // after it re-bound the rendezvous listener (the bind may have landed
+  // on an ephemeral port when the advertised one was taken)
+  std::string path = BootstrapRecordPath();
+  if (path.empty() || !rendezvous_open_) return;
+  int fd = open(path.c_str(), O_RDWR | O_CREAT, 0644);
+  if (fd < 0) return;
+  flock(fd, LOCK_EX);
+  std::string host =
+      rank_ < static_cast<int>(hosts_.size()) && !hosts_.empty()
+          ? hosts_[static_cast<size_t>(rank_)]
+          : "127.0.0.1";
+  std::string rec =
+      std::to_string(coord_generation_.load(std::memory_order_relaxed)) +
+      " " + host + " " + std::to_string(rendezvous_.port()) + "\n";
+  if (ftruncate(fd, 0) == 0 && lseek(fd, 0, SEEK_SET) == 0)
+    (void)!write(fd, rec.data(), rec.size());
+  flock(fd, LOCK_UN);
+  close(fd);
 }
 
 bool Engine::OnCoordinatorLoss(const std::string& why) {
@@ -3188,6 +3783,27 @@ bool Engine::OnCoordinatorLoss(const std::string& why) {
                       std::to_string(size_ - 1) + " < HOROVOD_TPU_MIN_NP=" +
                       std::to_string(min_np_) + "; aborting job"),
         0);
+  // GENERATION FENCE (wire v11): a survivor wedged PAST the whole
+  // fail-over window recovers into a job that may have already elected a
+  // successor and moved on — its "dead coordinator" is just its stale
+  // view.  The acting coordinator persists its election generation in
+  // the bootstrap record; a NEWER generation there proves this rank was
+  // left behind, so it exits instead of forming a second (splinter)
+  // world from a stale membership table.
+  {
+    uint64_t g = 0;
+    uint64_t mine = coord_generation_.load(std::memory_order_relaxed);
+    if (ReadBootstrapRecord(&g, nullptr, nullptr) && g > mine)
+      return AbortJob(
+          Status::Error(
+              cause + " — but the job's bootstrap record is at election "
+              "generation " + std::to_string(g) + " while this rank is "
+              "at " + std::to_string(mine) +
+              ": a successor world already formed without this rank "
+              "(generation fence) — exiting instead of electing a "
+              "splinter world"),
+          0);
+  }
   // cascading elections (the successor ALSO dies before committing) are
   // survivable, but bound the recursion so a pathological flap cannot
   // spin forever
@@ -3235,6 +3851,13 @@ bool Engine::OnCoordinatorLoss(const std::string& why) {
     CoordElectFrame ef;
     ef.rank = rank_;
     ef.epoch = epoch;
+    ef.generation = coord_generation_.load(std::memory_order_relaxed);
+    // test hook: delay between the dial and the registration frame so
+    // the chaos suite can exercise the successor's progress-extended
+    // window (a dialed-but-slow registrant must not be presumed dead)
+    if (const char* dly = getenv("HOROVOD_TPU_TEST_ELECT_DIAL_DELAY_MS"))
+      if (dly[0] && atoi(dly) > 0)
+        std::this_thread::sleep_for(std::chrono::milliseconds(atoi(dly)));
     if (!sock.SendFrame(Serialize(ef)).ok()) continue;
     LogWarn("fail-over: registered with candidate rank " +
             std::to_string(c) + " — awaiting its shrink round");
@@ -3285,6 +3908,31 @@ bool Engine::OnCoordinatorLoss(const std::string& why) {
         // world-change path (ack + commit ride the new coord_ socket)
         return HandleWorldChange(std::move(wcf));
       }
+      if (ft == FrameType::kCoordElect) {
+        // two-phase handoff ADOPTION NOTICE (wire v11): the candidate
+        // recognized our registration as coming from the immediately-
+        // prior epoch (this rank straddled a partially-committed world
+        // change) and replays the committed change's effect — our
+        // CURRENT rank, epoch, and generation — so its upcoming shrink
+        // proposal resolves in one shared rank space instead of
+        // rejecting us as an epoch mismatch.
+        CoordElectFrame notice;
+        if (Parse(fr, &notice).ok() && notice.rank > 0 &&
+            notice.epoch == epoch + 1) {
+          LogWarn("fail-over: prior-epoch registration adopted by the "
+                  "successor — this rank is rank " +
+                  std::to_string(notice.rank) + " of the committed world "
+                  "(epoch " + std::to_string(notice.epoch) + ")");
+          rank_ = notice.rank;
+          epoch = notice.epoch;
+          world_epoch_.store(static_cast<int64_t>(notice.epoch),
+                             std::memory_order_relaxed);
+          world_rank_pub_.store(rank_, std::memory_order_relaxed);
+          coord_generation_.store(notice.generation,
+                                  std::memory_order_relaxed);
+        }
+        continue;
+      }
       // anything else is a stray — ignore
     }
     coord_.Close();
@@ -3298,10 +3946,30 @@ bool Engine::FailoverBecomeCoordinator(const std::string& why,
   LogWarn("fail-over: this rank (old rank " + std::to_string(rank_) +
           ") is the lowest survivor — taking over as coordinator");
   timeline_.FaultMark("COORD_ELECT");
+  uint64_t my_gen = coord_generation_.load(std::memory_order_relaxed);
+  // generation fence, re-checked at take-over time: the candidate loop
+  // above may have burned most of a window — a successor world can have
+  // formed (and persisted a newer generation) meanwhile
+  {
+    uint64_t g = 0;
+    if (ReadBootstrapRecord(&g, nullptr, nullptr) && g > my_gen)
+      return AbortJob(
+          Status::Error(
+              why + " — the job's bootstrap record moved to election "
+              "generation " + std::to_string(g) +
+              " during this rank's election (generation fence): a "
+              "successor world already formed without it — exiting "
+              "instead of electing a splinter world"),
+          0);
+  }
   // collect kCoordElect registrations from the other survivors on the
   // data listener.  The window closes early once every old rank has
   // answered; ranks still silent at the deadline are presumed dead and
-  // ride the shrink's dead list.
+  // ride the shrink's dead list.  OBSERVED PROGRESS EXTENDS the window
+  // (wire v11, the ROADMAP's carried hole): a survivor that has DIALED —
+  // its connection accepted below — is alive and mid-registration, so
+  // the fixed max(peer, duplex) bound must not presume it dead; a hard
+  // cap keeps a frame-less staller from holding the window open forever.
   std::map<int, Socket> regs;
   uint64_t epoch =
       static_cast<uint64_t>(world_epoch_.load(std::memory_order_relaxed));
@@ -3310,41 +3978,156 @@ bool Engine::FailoverBecomeCoordinator(const std::string& why,
   // them anyway) — counting them would hold the window open its full
   // length whenever a higher-numbered rank co-died with the coordinator
   int expected = size_ - rank_ - 1;
-  auto deadline = std::chrono::steady_clock::now() +
-                  std::chrono::duration<double>(FailoverWindowSeconds());
-  while (static_cast<int>(regs.size()) < expected &&
-         std::chrono::steady_clock::now() < deadline) {
+  double window = FailoverWindowSeconds();
+  auto now0 = std::chrono::steady_clock::now();
+  auto deadline = now0 + std::chrono::duration<double>(window);
+  auto hard_cap = now0 + std::chrono::duration<double>(3 * window + 15);
+  struct PendingReg {
     Socket sock;
-    if (!data_listener_.Accept(&sock, 0.2).ok()) continue;
-    sock.SetRecvTimeout(2.0);
-    std::string fr;
-    Status rs = sock.RecvFrame(&fr);
-    sock.SetRecvTimeout(0);
-    if (!rs.ok()) continue;
+    std::chrono::steady_clock::time_point by;  // per-connection bound
+  };
+  std::vector<PendingReg> pend;
+  // admit one completed registration frame; returns false when the
+  // connection was not a usable registration (dropped)
+  auto admit = [&](Socket sock, const std::string& fr) {
     CoordElectFrame ef;
-    if (FrameTypeOf(fr) != FrameType::kCoordElect ||
-        !Parse(fr, &ef).ok()) {
+    if (FrameTypeOf(fr) != FrameType::kCoordElect || !Parse(fr, &ef).ok()) {
       LogWarn("fail-over: non-election connection during the "
               "registration window — dropped");
-      continue;
+      return;
+    }
+    if (ef.generation < my_gen) {
+      // a wedged survivor from a PREVIOUS generation recovered into our
+      // election: it is stale by construction (its own generation fence
+      // will turn it away); registering it would seat a rank whose
+      // world view predates the last fail-over
+      LogWarn("fail-over: rank " + std::to_string(ef.rank) +
+              " registered from stale election generation " +
+              std::to_string(ef.generation) + " < " +
+              std::to_string(my_gen) + " — rejected (generation fence)");
+      return;
     }
     if (ef.epoch != epoch) {
-      // a partially-committed world change straddled the death: the
-      // sender lives in a different rank space — its election must fail
-      // (it will abort on its proposal bound) rather than corrupt ours
+      // two-phase table handoff (wire v11): a registration from the
+      // IMMEDIATELY-PRIOR epoch is a survivor stranded by a partially-
+      // committed world change (it acked the proposal; the commit died
+      // with the coordinator).  Replay the committed change for it —
+      // translate its prior rank through the last applied old_ranks map
+      // and answer with an adoption notice carrying its CURRENT rank —
+      // instead of rejecting it into a doomed election of its own.
+      if (ef.epoch + 1 == epoch && !last_wc_old_ranks_.empty()) {
+        int cur = -1;
+        for (size_t i = 0; i < last_wc_old_ranks_.size(); i++)
+          if (last_wc_old_ranks_[i] == ef.rank)
+            cur = static_cast<int>(i);
+        // a JOINER admitted by the last change registers by its CURRENT
+        // rank (it never had a prior one — its slot maps from -1): adopt
+        // it in place rather than translating
+        if (cur < 0 && ef.rank >= 0 &&
+            ef.rank < static_cast<int>(last_wc_old_ranks_.size()) &&
+            last_wc_old_ranks_[static_cast<size_t>(ef.rank)] == -1)
+          cur = ef.rank;
+        if (cur > rank_ && cur < size_ && !regs.count(cur)) {
+          CoordElectFrame notice;
+          notice.rank = cur;
+          notice.epoch = epoch;
+          notice.generation = my_gen;
+          if (sock.SendFrame(Serialize(notice)).ok()) {
+            LogWarn("fail-over: rank " + std::to_string(ef.rank) +
+                    " registered from the immediately-prior epoch " +
+                    std::to_string(ef.epoch) +
+                    " — adopted as current rank " + std::to_string(cur) +
+                    " (replaying the partially-committed world change)");
+            regs[cur] = std::move(sock);
+            return;
+          }
+        }
+      }
       LogWarn("fail-over: rank " + std::to_string(ef.rank) +
               " registered from world epoch " + std::to_string(ef.epoch) +
               " != " + std::to_string(epoch) + " — rejected");
-      continue;
+      return;
     }
     if (ef.rank <= rank_ || ef.rank >= size_) {
       LogWarn("fail-over: implausible election registration from rank " +
               std::to_string(ef.rank) + " — dropped");
-      continue;
+      return;
     }
     LogWarn("fail-over: rank " + std::to_string(ef.rank) + " registered");
     regs[ef.rank] = std::move(sock);
+  };
+  while (static_cast<int>(regs.size()) < expected) {
+    auto now = std::chrono::steady_clock::now();
+    if (now > hard_cap) break;
+    if (now > deadline && pend.empty()) break;
+    Socket sock;
+    if (data_listener_.Accept(&sock, 0.1).ok()) {
+      auto by = now + std::chrono::duration_cast<
+                          std::chrono::steady_clock::duration>(
+                          std::chrono::duration<double>(window));
+      if (deadline < by) deadline = by;  // a dial IS progress
+      PendingReg pr;
+      pr.sock = std::move(sock);
+      pr.by = by;
+      pend.push_back(std::move(pr));
+    }
+    for (auto it = pend.begin(); it != pend.end();) {
+      if (it->sock.Readable(0)) {
+        it->sock.SetRecvTimeout(2.0);
+        std::string fr;
+        Status rs = it->sock.RecvFrame(&fr);
+        it->sock.SetRecvTimeout(0);
+        Socket s2 = std::move(it->sock);
+        it = pend.erase(it);
+        if (rs.ok()) admit(std::move(s2), fr);
+        continue;
+      }
+      if (std::chrono::steady_clock::now() > it->by) {
+        LogWarn("fail-over: a dialed connection never completed its "
+                "election registration inside the window — dropped");
+        it = pend.erase(it);
+        continue;
+      }
+      ++it;
+    }
   }
+  // REACHABILITY FENCE (wire v11): an election forming a world SMALLER
+  // THAN HALF the old one is exactly the splinter shape a partitioned or
+  // wedged survivor produces.  Probe every higher-ranked old rank that
+  // failed to register: a data listener that still ANSWERS is a live
+  // rank this election cannot account for — refuse to take over.
+  {
+    int new_size = static_cast<int>(regs.size()) + 1;
+    if (2 * new_size < size_) {
+      for (int i = rank_ + 1; i < size_; i++) {
+        if (regs.count(i)) continue;
+        Socket probe;
+        if (Socket::Connect(hosts_[i], ports_[i], &probe, 1.5).ok())
+          return AbortJob(
+              Status::Error(
+                  why + " — election fence: rank " + std::to_string(i) +
+                  "'s data listener still answers but it never "
+                  "registered within the fail-over window; refusing to "
+                  "form a splinter world of " + std::to_string(new_size) +
+                  " < half of " + std::to_string(size_) +
+                  " (reachability fence)"),
+              0);
+      }
+    }
+  }
+  // claim the next election generation (flock'd CAS on the bootstrap
+  // record): losing means another successor formed a world concurrently
+  // — this rank is the splinter side and must exit, not take over
+  my_gen += 1;
+  if (!ClaimGeneration(my_gen))
+    return AbortJob(
+        Status::Error(
+            why + " — election generation " + std::to_string(my_gen) +
+            " was already claimed by another successor (generation "
+            "fence): a newer world formed without this rank — exiting "
+            "instead of electing a splinter world"),
+        0);
+  coord_generation_.store(my_gen, std::memory_order_relaxed);
   // inherit the coordinator's control star: registered survivors keep
   // their old-rank slots until the shrink renumbers them
   std::vector<int> dead{0};
@@ -3373,24 +4156,28 @@ bool Engine::FailoverBecomeCoordinator(const std::string& why,
   rendezvous_open_ = false;
   if (rank_ < static_cast<int>(hosts_.size()) && !hosts_.empty() &&
       hosts_[static_cast<size_t>(rank_)] != hosts_[0]) {
-    // launchers pin HOROVOD_TPU_RENDEZVOUS to the ORIGINAL coordinator
-    // host at spawn, so relaunched joiners dial an address nothing
-    // listens on once the role moved across hosts — the world itself
-    // survives either way
+    // the successor's live rendezvous address is persisted in the
+    // bootstrap record below, so launchers running with
+    // HOROVOD_TPU_BOOTSTRAP_DIR re-point relaunched joiners at it;
+    // launchers without the record still dial the launch-time host
     LogWarn("fail-over: the coordinator role moved from host " +
             hosts_[0] + " to " + hosts_[static_cast<size_t>(rank_)] +
-            " — relaunched joiners dialing the launch-time rendezvous "
-            "address will not find this job (same-host fail-over, or a "
-            "fresh launch, restores join)");
+            " — relaunched joiners follow the bootstrap record to the "
+            "successor (launchers without HOROVOD_TPU_BOOTSTRAP_DIR "
+            "keep dialing the launch-time rendezvous host)");
   }
   Status ls = rendezvous_.Listen("", rendezvous_port_);
   if (!ls.ok()) {
     LogWarn("fail-over: could not re-bind the rendezvous port " +
             std::to_string(rendezvous_port_) + " (" + ls.message +
-            ") — joiners will not find this job until the next launch");
+            ") — re-binding on an ephemeral port (joiners reach it "
+            "through the bootstrap record when the launcher ships one)");
     ls = rendezvous_.Listen("", 0);
   }
   rendezvous_open_ = ls.ok();
+  // persist {generation, live rendezvous address}: the joiner-redirect
+  // half of the record (the generation half was claimed above)
+  PublishBootstrapRecord();
   joins_.clear();
   // proposals must supersede anything the dead coordinator had in flight
   uint64_t wp = static_cast<uint64_t>(
@@ -4873,6 +5660,29 @@ void Engine::WorkerTick(RequestList& local, bool* stop) {
       }
       continue;
     }
+    if (ft == FrameType::kDrain) {
+      // graceful-drain announce (wire v11): when it names THIS rank,
+      // latch the flag the Python side polls — it finishes the current
+      // round, runs the on_drain checkpoint hook, and asks for the ack
+      // (MaybeSendDrain ships it once the engine is quiesced)
+      DrainFrame df;
+      if (Parse(frame, &df).ok() && df.phase == kDrainAnnounce) {
+        uint64_t ep = static_cast<uint64_t>(
+            world_epoch_.load(std::memory_order_relaxed));
+        bool self_named = false;
+        for (int64_t r : df.ranks) self_named |= static_cast<int>(r) == rank_;
+        if (df.epoch == ep && self_named &&
+            !drain_self_.load(std::memory_order_relaxed)) {
+          drain_self_.store(1, std::memory_order_relaxed);
+          timeline_.FaultMark("DRAIN_ANNOUNCE");
+          LOG_RANK(Warning, rank_)
+              << "drain announced for this rank (" << df.reason
+              << ") — finish the round, checkpoint, ack";
+          Wake();
+        }
+      }
+      continue;
+    }
     if (ft == FrameType::kCachedExec) {
       CachedExecFrame ce;
       s = Parse(frame, &ce);
@@ -5126,6 +5936,39 @@ bool Engine::CoordinatorTick(RequestList& local) {
         verdict.verdict = kArbitrateLinkOnly;
         (void)SendCtrl(workers_[i], Serialize(verdict));
         hb_last_tx_ns_ = NowNs();
+      } else if (ft == FrameType::kDrain) {
+        // graceful drain (wire v11): a worker forwarding its preemption
+        // notice / hvd.request_drain (request), or a draining rank
+        // reporting its checkpoint written + engine quiesced (ack)
+        DrainFrame df;
+        if (!Parse(frame, &df).ok()) continue;
+        if (df.phase == kDrainAck) {
+          if (draining_.count(i)) {
+            drain_acked_.insert(i);
+            LogWarn("drain: rank " + std::to_string(i) +
+                    " checkpointed and quiesced");
+          }
+        } else if (df.phase == kDrainRequest) {
+          // targets name CURRENT-world ranks: a request serialized in an
+          // older epoch would drain whoever now wears that number —
+          // reject it; the sender re-forwards with its new epoch (the
+          // self-request path re-arms per world change)
+          if (df.epoch != static_cast<uint64_t>(
+                              world_epoch_.load(std::memory_order_relaxed))) {
+            LogWarn("drain request from rank " + std::to_string(i) +
+                    " names epoch " + std::to_string(df.epoch) +
+                    " ranks in epoch " +
+                    std::to_string(
+                        world_epoch_.load(std::memory_order_relaxed)) +
+                    " — dropped (stale)");
+            continue;
+          }
+          std::string reason = df.reason;
+          std::lock_guard<std::mutex> lk(drain_mu_);
+          for (int64_t t : df.ranks)
+            drain_requests_.push_back(static_cast<int>(t));
+          if (!reason.empty()) drain_reason_ = reason;
+        }
       } else {
         RequestList probe;
         Status ps = Parse(frame, &probe);
@@ -5768,10 +6611,21 @@ int Engine::CoordinatorFaultTick(bool shutdown_in_flight) {
       }
     }
   }
+  // graceful drain (wire v11): announce pending evictions, collect the
+  // drainees' checkpoint acks, drive the gentle shrink.  Joins hold off
+  // while a drain is in flight — one membership change at a time.
+  {
+    int dr = CoordinatorDrainTick();
+    if (dr != 0) return dr;
+  }
   // pending joiners are admitted here — the next negotiation boundary
-  // after the relaunched worker dialed the rendezvous listener
-  int jr = MaybeAcceptJoin();
-  if (jr != 0) return jr;
+  // after the relaunched worker dialed the rendezvous listener.  Joins
+  // hold off while a drain announce is in flight (one membership change
+  // at a time); the backlog keeps queueing and rides the next boundary.
+  if (draining_.empty()) {
+    int jr = MaybeAcceptJoin();
+    if (jr != 0) return jr;
+  }
   // idle links get an explicit heartbeat so workers' coordinator-age and
   // this rank's worker-ages stay fresh without any steady-state traffic
   if (hb_interval_s_ > 0 && (now - hb_last_tx_ns_) / 1e9 > hb_interval_s_) {
@@ -5825,6 +6679,8 @@ bool Engine::WorkerFaultTick(bool shutdown_in_flight) {
   }
   // dead-link-vs-dead-rank arbitration: ship one request per accusation
   MaybeSendArbitration();
+  // graceful drain: forward queued eviction requests + the quiesced ack
+  MaybeSendDrain();
   return false;
 }
 
@@ -8961,6 +9817,40 @@ void hvd_coord_stats(int64_t* out) {
   out[7] = 0;
 }
 
+// Graceful drain (wire v11).  hvd_request_drain asks for a PLANNED
+// eviction of `rank` (-1 = the calling rank — the SIGTERM/spot-preemption
+// path); the engine forwards it to the coordinator, which announces,
+// waits for the drainee's checkpoint ack, and drives a gentle shrink.
+// hvd_drain_ack is the draining rank's "checkpoint written" signal.
+int hvd_request_drain(int rank) {
+  if (!g_engine) return -1;
+  g_engine->RequestDrain(rank, "hvd.request_drain");
+  return 0;
+}
+
+int hvd_drain_ack() {
+  if (!g_engine) return -1;
+  g_engine->DrainAck();
+  return 0;
+}
+
+// Drain + election-fencing statistics, in order: {drain announced for
+// THIS rank (Python runs the on_drain hook when it flips 1), eviction
+// committed (the drained rank exits 0 on it), completed drains,
+// cumulative announce -> shrunk-world-live latency ns, the acting
+// coordinator's election generation, reserved x3}.  The counters are
+// process-wide (fault.h); the flags read 0 with no engine.
+void hvd_drain_stats(int64_t* out) {
+  out[0] = g_engine ? g_engine->DrainSelfAnnounced() : 0;
+  out[1] = g_engine ? g_engine->Drained() : 0;
+  out[2] = Faults().drains.load(std::memory_order_relaxed);
+  out[3] = Faults().drain_latency_ns.load(std::memory_order_relaxed);
+  out[4] = g_engine ? static_cast<int64_t>(g_engine->CoordGeneration()) : 0;
+  out[5] = 0;
+  out[6] = 0;
+  out[7] = 0;
+}
+
 // The control-plane wire version this .so speaks (kWireVersion mirror for
 // Python-side diagnostics and the ABI drift guard).
 int hvd_wire_version() { return static_cast<int>(kWireVersion); }
@@ -9027,6 +9917,11 @@ const char* hvd_frame_parse_error(const void* buf, int64_t len) {
     }
     case FrameType::kArbitrate: {
       ArbitrateFrame f;
+      st = Parse(s, &f);
+      break;
+    }
+    case FrameType::kDrain: {
+      DrainFrame f;
       st = Parse(s, &f);
       break;
     }

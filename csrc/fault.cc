@@ -57,6 +57,14 @@ double OnewayTimeoutSeconds() {
   return t;
 }
 
+double DrainTimeoutSeconds() {
+  static double t = [] {
+    double v = EnvDouble("HOROVOD_TPU_DRAIN_TIMEOUT_S", 30);
+    return v < 1 ? 1.0 : v;
+  }();
+  return t;
+}
+
 bool ElasticEnabled() {
   static bool on = EnvFlag("HOROVOD_TPU_ELASTIC");
   return on;

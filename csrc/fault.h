@@ -78,6 +78,13 @@ double PeerTimeoutSeconds();
 double DuplexTimeoutSeconds();
 double OnewayTimeoutSeconds();
 
+// HOROVOD_TPU_DRAIN_TIMEOUT_S (wire v11): how long the coordinator waits
+// for a draining rank's quiesced-checkpoint ack before evicting it anyway
+// (default 30; floor 1).  Deadline expiry degrades the eviction to the
+// ordinary retryable world change instead of stalling scale-in behind an
+// unresponsive drainee.
+double DrainTimeoutSeconds();
+
 // HOROVOD_TPU_ELASTIC: opt-in elastic membership — a dead rank SHRINKS the
 // world at the next negotiation boundary instead of aborting the job (and
 // relaunched ranks may JOIN it back).  Abort stays the default.  Rank 0
@@ -139,6 +146,11 @@ struct FaultCounters {
   std::atomic<int64_t> arb_requests{0};
   std::atomic<int64_t> arb_link_verdicts{0};
   std::atomic<int64_t> arb_dead_verdicts{0};
+  // graceful drain (wire v11): completed drain world changes (counted on
+  // the coordinator — one event per drain round job-wide) and the
+  // cumulative announce -> shrunk-world-live latency of those rounds
+  std::atomic<int64_t> drains{0};
+  std::atomic<int64_t> drain_latency_ns{0};
 };
 
 FaultCounters& Faults();

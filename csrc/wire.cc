@@ -226,7 +226,7 @@ FrameType FrameTypeOf(const std::string& buf) {
     return FrameType::kInvalid;
   }
   if (type < static_cast<uint16_t>(FrameType::kRequestList) ||
-      type > static_cast<uint16_t>(FrameType::kArbitrate))
+      type > static_cast<uint16_t>(FrameType::kDrain))
     return FrameType::kInvalid;
   return static_cast<FrameType>(type);
 }
@@ -515,6 +515,7 @@ std::string Serialize(const CoordElectFrame& f) {
   PutHeader(&s, FrameType::kCoordElect);
   PutI32(&s, f.rank);
   PutU64(&s, f.epoch);
+  PutU64(&s, f.generation);
   return s;
 }
 
@@ -524,6 +525,7 @@ Status Parse(const std::string& buf, CoordElectFrame* out) {
   if (!hs.ok()) return hs;
   out->rank = rd.I32();
   out->epoch = rd.U64();
+  out->generation = rd.U64();
   if (rd.fail) return Status::Error("truncated coord-elect frame");
   return Status::OK();
 }
@@ -545,6 +547,30 @@ Status Parse(const std::string& buf, ArbitrateFrame* out) {
   out->accused = rd.I32();
   out->verdict = rd.I32();
   if (rd.fail) return Status::Error("truncated arbitrate frame");
+  return Status::OK();
+}
+
+std::string Serialize(const DrainFrame& f) {
+  std::string s;
+  PutHeader(&s, FrameType::kDrain);
+  PutI32(&s, f.rank);
+  PutI32(&s, f.phase);
+  PutU64(&s, f.epoch);
+  PutDims(&s, f.ranks);
+  PutStr(&s, f.reason);
+  return s;
+}
+
+Status Parse(const std::string& buf, DrainFrame* out) {
+  Reader rd{buf};
+  Status hs = ReadHeader(&rd, FrameType::kDrain);
+  if (!hs.ok()) return hs;
+  out->rank = rd.I32();
+  out->phase = rd.I32();
+  out->epoch = rd.U64();
+  out->ranks = rd.Dims(1 << 20);  // member-count bound, like world frames
+  out->reason = rd.Str();
+  if (rd.fail) return Status::Error("truncated drain frame");
   return Status::OK();
 }
 

@@ -23,15 +23,17 @@ namespace hvdtpu {
 
 // Bump kWireVersion on ANY layout change (header, field order, new frame).
 constexpr uint32_t kWireMagic = 0x48564457u;  // "HVDW" little-endian
-constexpr uint16_t kWireVersion = 10;         // v10: coordinator fail-over
-                                              // (kCoordElect successor
-                                              // registration + kArbitrate
-                                              // dead-link-vs-dead-rank
-                                              // probes; the bootstrap table
-                                              // gains the coordinator-slot
+constexpr uint16_t kWireVersion = 11;         // v11: graceful drain + fenced
+                                              // elections (kDrain planned-
+                                              // eviction frames; world-change
+                                              // kind 2 = drain; kCoordElect
+                                              // carries the election
+                                              // GENERATION; the bootstrap
+                                              // table gains the generation
                                               // field).  Pre-existing frame
-                                              // layouts are UNCHANGED from
-                                              // v9 — v9-shaped jobs
+                                              // layouts other than
+                                              // CoordElectFrame are UNCHANGED
+                                              // from v10 — v10-shaped jobs
                                               // serialize the same byte
                                               // counts (only the header's
                                               // version field moved), which
@@ -71,7 +73,29 @@ enum class FrameType : uint16_t {
                       // registration (wire v10)
   kArbitrate = 11,    // both ways: dead-link-vs-dead-rank arbitration
                       // (wire v10; request up, verdict down)
+  kDrain = 12,        // both ways: graceful-drain protocol (wire v11 —
+                      // request up, announce down, ack up)
 };
+
+// Drain phases (DrainFrame.phase, wire v11).  A drain REQUEST flows toward
+// the coordinator (a worker forwarding its own SIGTERM/spot-preemption
+// notice, or hvd.request_drain()); the coordinator broadcasts an ANNOUNCE
+// naming the draining ranks; each draining rank finishes its in-flight
+// work, runs the user checkpoint hook, and ACKs — after which the
+// coordinator drives a kWorldChange shrink of kind kWorldChangeDrain that
+// the members apply GENTLY (requeue instead of fail-retryable: zero failed
+// handles on survivors, a clean exit 0 on the drained rank).
+constexpr int32_t kDrainRequest = 0;   // toward the coordinator
+constexpr int32_t kDrainAnnounce = 1;  // coordinator -> workers
+constexpr int32_t kDrainAck = 2;       // draining rank -> coordinator
+
+// WorldChangeFrame.kind values (0/1 since wire v7; 2 since v11).  A drain
+// shrink is announced ahead of time, so members take the gentle path:
+// wait out the in-flight data plane, REQUEUE un-negotiated work instead of
+// failing it retryable, and treat eviction as a clean shutdown.
+constexpr int32_t kWorldChangeShrink = 0;
+constexpr int32_t kWorldChangeJoin = 1;
+constexpr int32_t kWorldChangeDrain = 2;
 
 // Arbitration verdict codes (ArbitrateFrame.verdict, wire v10).  A worker
 // whose data-plane transfer failed without a world change behind it asks
@@ -236,7 +260,7 @@ struct AbortFrame {
 //   bootstrap would have taught it.
 struct WorldChangeFrame {
   uint64_t epoch = 0;               // proposal id, monotonic per coordinator
-  int32_t kind = 0;                 // 0 = shrink, 1 = join
+  int32_t kind = 0;                 // kWorldChangeShrink / Join / Drain
   std::string message;              // cause, surfaced in retryable errors
   std::vector<int64_t> dead_ranks;  // old ranks presumed dead (may be empty)
   std::vector<int64_t> old_ranks;   // old rank per new rank; -1 = joiner
@@ -259,12 +283,20 @@ struct WorldCommitFrame {
 // Survivor -> successor (wire v10): coordinator fail-over registration.
 // Sent over a fresh connection to the candidate's DATA listener after the
 // sender detected rank 0 dead; `rank` is the sender's OLD (current-world)
-// rank and `epoch` its applied world epoch — the successor drops
-// registrations from a different epoch (a partially-committed world change
-// straddling the death would put the two sides in different rank spaces).
+// rank and `epoch` its applied world epoch.  A registration from the
+// IMMEDIATELY-PRIOR epoch (a partially-committed world change straddled
+// the death) is adopted by replaying the committed change: the successor
+// answers with this same frame as an ADOPTION NOTICE carrying the
+// sender's CURRENT rank and epoch, then the normal shrink proposal
+// resolves in one shared rank space (wire v11).  `generation` (v11) is
+// the monotonic election generation: the successor rejects stale-
+// generation registrations, and a registrant seeing a HIGHER generation
+// than its own knows a newer world already formed — it exits instead of
+// electing a splinter.
 struct CoordElectFrame {
   int32_t rank = 0;
   uint64_t epoch = 0;
+  uint64_t generation = 0;
 };
 
 // Dead-link-vs-dead-rank arbitration (wire v10), one struct both ways:
@@ -277,6 +309,20 @@ struct ArbitrateFrame {
   int32_t rank = 0;     // reporter's rank (request) / 0 (verdict)
   int32_t accused = -1; // the peer whose transfer failed
   int32_t verdict = kArbitrateRequest;
+};
+
+// Graceful-drain protocol (wire v11), one struct all three ways (see the
+// kDrain* phase constants above).  `ranks` names the draining members
+// (announce), the requested eviction target (request; usually the
+// sender's own rank — a SIGTERM'd worker forwarding its preemption
+// notice), or is empty (ack).  `epoch` is the announcer's world epoch so
+// a stale announce straddling a membership change is discarded.
+struct DrainFrame {
+  int32_t rank = 0;              // sender's rank
+  int32_t phase = kDrainRequest;
+  uint64_t epoch = 0;
+  std::vector<int64_t> ranks;
+  std::string reason;            // surfaced in logs and markers
 };
 
 // Frame dispatch: the type a buffer claims to carry (kInvalid when the
@@ -295,6 +341,7 @@ std::string Serialize(const WorldAckFrame& f);
 std::string Serialize(const WorldCommitFrame& f);
 std::string Serialize(const CoordElectFrame& f);
 std::string Serialize(const ArbitrateFrame& f);
+std::string Serialize(const DrainFrame& f);
 Status Parse(const std::string& buf, RequestList* out);
 Status Parse(const std::string& buf, ResponseList* out);
 Status Parse(const std::string& buf, CacheBitsFrame* out);
@@ -306,5 +353,6 @@ Status Parse(const std::string& buf, WorldAckFrame* out);
 Status Parse(const std::string& buf, WorldCommitFrame* out);
 Status Parse(const std::string& buf, CoordElectFrame* out);
 Status Parse(const std::string& buf, ArbitrateFrame* out);
+Status Parse(const std::string& buf, DrainFrame* out);
 
 }  // namespace hvdtpu

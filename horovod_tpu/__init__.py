@@ -40,6 +40,10 @@ from horovod_tpu.runtime.state import (
     world_changed,
     world_epoch,
     coordinator_rank,
+    request_drain,
+    drain_requested,
+    ack_drain,
+    drained,
     ProcessSet,
     add_process_set,
     global_process_set,
@@ -342,6 +346,7 @@ __all__ = [
     "mpi_threads_supported",
     "world_changed", "world_epoch", "coordinator_rank", "WorldShrunkError",
     "NumericalHealthError", "elastic",
+    "request_drain", "drain_requested", "ack_drain", "drained",
     "ProcessSet", "add_process_set", "global_process_set",
     "process_set_stats",
     "allreduce", "allgather", "broadcast", "alltoall", "barrier",

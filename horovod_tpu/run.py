@@ -165,6 +165,89 @@ def _elastic_supervise(procs, args, first_rank, local_n, spawn,
     return job_rc
 
 
+def _read_bootstrap_record(boot_dir):
+    """The engine-maintained bootstrap record: ``<generation> <host>
+    <port>`` — the acting coordinator's election generation and LIVE
+    rendezvous address.  None when absent/torn.  Read under a shared
+    flock: the engine rewrites it (ftruncate + write) under an
+    exclusive one, and a lock-free read racing that window would see an
+    empty file and silently lose the successor redirect."""
+    try:
+        import fcntl
+
+        with open(os.path.join(boot_dir, "coordinator")) as f:
+            fcntl.flock(f.fileno(), fcntl.LOCK_SH)
+            try:
+                parts = f.read().split()
+            finally:
+                fcntl.flock(f.fileno(), fcntl.LOCK_UN)
+        gen, host, port = int(parts[0]), parts[1], int(parts[2])
+        if host and port > 0:
+            return gen, host, port
+    except (OSError, ValueError, IndexError):
+        pass
+    return None
+
+
+def _drain_client(args) -> int:
+    """``hvdrun --drain RANK`` (no command): ask a RUNNING elastic job to
+    gracefully evict a rank.  Dials the job's rendezvous listener — the
+    live address from the bootstrap record when available (it follows the
+    coordinator through fail-overs), else HOROVOD_TPU_RENDEZVOUS /
+    --rendezvous-port — sends the DRAIN hello, and prints the
+    coordinator's reply.  Exit 0 = queued (announce/checkpoint/shrink run
+    at the job's next tick boundaries), non-zero = rejected/unreachable."""
+    import socket as pysock
+    import struct
+
+    host, port = None, None
+    boot = os.environ.get("HOROVOD_TPU_BOOTSTRAP_DIR")
+    if boot:
+        rec = _read_bootstrap_record(boot)
+        if rec:
+            _, host, port = rec
+    if host is None:
+        addr = os.environ.get("HOROVOD_TPU_RENDEZVOUS", "")
+        if ":" in addr:
+            h, _, p = addr.rpartition(":")
+            try:
+                host, port = h, int(p)
+            except ValueError:
+                pass
+    if host is None and args.rendezvous_port:
+        host, port = "127.0.0.1", args.rendezvous_port
+    if host is None:
+        print("[horovod_tpu.run] --drain needs the job's rendezvous "
+              "address: set HOROVOD_TPU_BOOTSTRAP_DIR (the launcher's), "
+              "HOROVOD_TPU_RENDEZVOUS, or --rendezvous-port",
+              file=sys.stderr)
+        return 2
+
+    def recvn(sock, n):
+        buf = b""
+        while len(buf) < n:
+            chunk = sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("connection closed mid-reply")
+            buf += chunk
+        return buf
+
+    payload = f"DRAIN {args.drain}".encode()
+    try:
+        with pysock.create_connection((host, port), timeout=15) as s:
+            s.settimeout(15)
+            s.sendall(struct.pack("<Q", len(payload)) + payload)
+            (n,) = struct.unpack("<Q", recvn(s, 8))
+            reply = recvn(s, n).decode(errors="replace")
+    except (OSError, ConnectionError, struct.error) as e:
+        print(f"[horovod_tpu.run] --drain: could not reach the job's "
+              f"rendezvous listener at {host}:{port}: {e}",
+              file=sys.stderr)
+        return 1
+    print(f"[horovod_tpu.run] {reply}", file=sys.stderr)
+    return 0 if reply.startswith("DRAIN-OK") else 1
+
+
 def _parse_hosts(spec: str) -> list[tuple[str, int]]:
     out = []
     for part in spec.split(","):
@@ -175,7 +258,9 @@ def _parse_hosts(spec: str) -> list[tuple[str, int]]:
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="horovod_tpu.run")
-    ap.add_argument("-np", "--num-proc", type=int, required=True)
+    # required for launches; control modes (--drain with no command) run
+    # without it — validated below once the mode is known
+    ap.add_argument("-np", "--num-proc", type=int, default=None)
     ap.add_argument("--hosts", default=None,
                     help='"host1:slots,host2:slots" for multi-host runs')
     ap.add_argument("--host-index", type=int, default=0,
@@ -277,6 +362,30 @@ def main(argv=None) -> int:
                          "launch's -np). Approximate on multi-host "
                          "launches: each launcher counts only its OWN "
                          "live workers against the ceiling")
+    ap.add_argument("--drain", type=int, default=None, metavar="RANK",
+                    help="control mode (no command): ask a RUNNING "
+                         "elastic job to gracefully evict RANK — the "
+                         "coordinator announces the drain, the rank "
+                         "finishes its round, runs its on_drain "
+                         "checkpoint hook, and a gentle world change "
+                         "evicts it with zero failed collectives on "
+                         "survivors and exit 0 on the drained rank. "
+                         "Dials the rendezvous address from the "
+                         "bootstrap record (HOROVOD_TPU_BOOTSTRAP_DIR), "
+                         "HOROVOD_TPU_RENDEZVOUS, or --rendezvous-port")
+    ap.add_argument("--preempt-drain", action="store_true",
+                    help="elastic mode: workers convert SIGTERM into a "
+                         "graceful drain request (sets "
+                         "HOROVOD_TPU_PREEMPT_DRAIN=1) — the "
+                         "spot/preemptible contract where eviction comes "
+                         "with advance notice; the rank checkpoints via "
+                         "its on_drain hook and exits 0 instead of dying")
+    ap.add_argument("--drain-timeout", type=float, default=None,
+                    metavar="S",
+                    help="how long the coordinator waits for a draining "
+                         "rank's checkpoint ack before evicting it "
+                         "anyway (sets HOROVOD_TPU_DRAIN_TIMEOUT_S; "
+                         "default 30)")
     ap.add_argument("--restart", type=int, default=0, metavar="N",
                     help="elastic mode: relaunch up to N dead workers as "
                          "JOINERS (HOROVOD_TPU_JOIN=1) — the world shrinks "
@@ -333,8 +442,20 @@ def main(argv=None) -> int:
     if args.trace_dir:
         os.makedirs(args.trace_dir, exist_ok=True)
 
+    if args.drain is not None and not args.command:
+        # control mode: talk to a RUNNING job instead of launching one
+        return _drain_client(args)
+    if args.drain is not None:
+        # a launch command AND --drain would silently launch-and-ignore;
+        # make the two modes explicit
+        ap.error("--drain is a control mode against a RUNNING job — "
+                 "omit the command (use hvd.request_drain() to drain "
+                 "from inside a training script)")
+
     if not args.command:
         ap.error("no command given")
+    if args.num_proc is None:
+        ap.error("the following arguments are required: -np/--num-proc")
     cmd = args.command
     if cmd[0] == "--":
         cmd = cmd[1:]
@@ -397,6 +518,18 @@ def main(argv=None) -> int:
     elastic = args.min_np is not None or _fault.elastic_enabled()
     min_np_val = args.min_np if args.min_np is not None else _fault.min_np()
 
+    # bootstrap record dir (wire v11): the acting coordinator persists its
+    # election generation + live rendezvous address here, so relaunched
+    # joiners dial the SUCCESSOR after a fail-over (not the launch-time
+    # host) and a wedged-then-recovered survivor is fenced out of forming
+    # a splinter world.  Per-job unless the operator shares one.
+    boot_dir_created = None
+    if elastic and not os.environ.get("HOROVOD_TPU_BOOTSTRAP_DIR"):
+        import tempfile
+
+        boot_dir_created = tempfile.mkdtemp(prefix="hvdboot-")
+        os.environ["HOROVOD_TPU_BOOTSTRAP_DIR"] = boot_dir_created
+
     def _spawn(local_rank: int, join: bool = False) -> subprocess.Popen:
         rank = first_rank + local_rank
         env = dict(os.environ)
@@ -450,11 +583,29 @@ def main(argv=None) -> int:
         if elastic:
             env["HOROVOD_TPU_ELASTIC"] = "1"
             env["HOROVOD_TPU_MIN_NP"] = str(max(min_np_val, 1))
+        if args.preempt_drain:
+            env["HOROVOD_TPU_PREEMPT_DRAIN"] = "1"
+        if args.drain_timeout is not None:
+            env["HOROVOD_TPU_DRAIN_TIMEOUT_S"] = str(args.drain_timeout)
         if join:
             # a relaunched worker re-enters the RUNNING world through the
             # coordinator's rendezvous listener; its env rank describes
             # the dead slot, the engine negotiates the real one
             env["HOROVOD_TPU_JOIN"] = "1"
+            # after a fail-over the coordinator role (and with it the
+            # rendezvous listener) may live on another host: re-point the
+            # joiner at the SUCCESSOR's live address from the bootstrap
+            # record instead of the launch-time host
+            boot = env.get("HOROVOD_TPU_BOOTSTRAP_DIR")
+            rec = _read_bootstrap_record(boot) if boot else None
+            if rec is not None:
+                live = f"{rec[1]}:{rec[2]}"
+                if live != env["HOROVOD_TPU_RENDEZVOUS"]:
+                    print(f"[horovod_tpu.run] joiner rank {rank} dials "
+                          f"the successor's rendezvous at {live} "
+                          f"(bootstrap record, generation {rec[0]})",
+                          file=sys.stderr)
+                env["HOROVOD_TPU_RENDEZVOUS"] = live
             # the chaos spec targeted the ORIGINAL incarnation: a joiner
             # that re-arms the same kill would just die again and burn
             # the restart budget on a loop
@@ -492,6 +643,11 @@ def main(argv=None) -> int:
     finally:
         if elastic and aggregator is not None:
             aggregator.stop()
+        if boot_dir_created:
+            import shutil
+
+            shutil.rmtree(boot_dir_created, ignore_errors=True)
+            os.environ.pop("HOROVOD_TPU_BOOTSTRAP_DIR", None)
 
     exit_code = 0
     failed = False

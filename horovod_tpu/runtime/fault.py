@@ -52,6 +52,10 @@ DATA_TIMEOUT_ENV = "HOROVOD_TPU_DATA_TIMEOUT_S"
 ELASTIC_ENV = "HOROVOD_TPU_ELASTIC"
 MIN_NP_ENV = "HOROVOD_TPU_MIN_NP"
 JOIN_ENV = "HOROVOD_TPU_JOIN"
+DRAIN_TIMEOUT_ENV = "HOROVOD_TPU_DRAIN_TIMEOUT_S"
+PREEMPT_DRAIN_ENV = "HOROVOD_TPU_PREEMPT_DRAIN"
+BOOTSTRAP_DIR_ENV = "HOROVOD_TPU_BOOTSTRAP_DIR"
+FAILOVER_WINDOW_ENV = "HOROVOD_TPU_FAILOVER_WINDOW_S"
 
 # Mirror of csrc/engine.cc kWorldChangeTag: the retryable-failure prefix
 # every handle cancelled by an elastic membership change carries.  native.py
@@ -110,6 +114,17 @@ def data_timeout_s() -> float:
         except ValueError:
             pass
     return peer_timeout_s()
+
+
+def drain_timeout_s(environ=os.environ) -> float:
+    """Mirror of csrc/fault.cc DrainTimeoutSeconds (default 30, floor 1):
+    how long the coordinator waits for a draining rank's checkpoint ack
+    before evicting it anyway."""
+    try:
+        v = float(environ.get(DRAIN_TIMEOUT_ENV, "") or 30)
+    except ValueError:
+        v = 30.0
+    return max(v, 1.0)
 
 
 def elastic_enabled(environ=os.environ) -> bool:

@@ -268,6 +268,15 @@ def _bind(lib):
         lib.hvd_pset_op_stats.restype = ctypes.c_int
     except AttributeError:
         pass
+    try:
+        # graceful drain + election fencing (wire v11); same caveat
+        lib.hvd_request_drain.argtypes = [ctypes.c_int]
+        lib.hvd_request_drain.restype = ctypes.c_int
+        lib.hvd_drain_ack.restype = ctypes.c_int
+        lib.hvd_drain_stats.argtypes = [ctypes.POINTER(ctypes.c_int64)]
+        lib.hvd_drain_stats.restype = None
+    except AttributeError:
+        pass
     return lib
 
 
@@ -348,6 +357,7 @@ class NativeEngine(Engine):
         d.update(self._fault_stats())
         d.update(self._wire_stats())
         d.update(self.world_stats())
+        d.update(self.drain_stats())
         d.update(self.trace_stats())
         d.update(self.health_stats())
         psets = self.process_set_stats()
@@ -407,6 +417,48 @@ class NativeEngine(Engine):
             "arb_link_verdicts": max(int(vals[4]), 0),
             "arb_dead_verdicts": max(int(vals[5]), 0),
         }
+
+    def drain_stats(self) -> dict:
+        """Graceful-drain + election-fencing statistics (wire v11).
+        ``drain_requested`` flips 1 when a coordinator announce names
+        THIS rank (the training loop runs its on_drain checkpoint hook
+        and calls :meth:`ack_drain`); ``drained`` flips 1 once the
+        eviction committed and the engine stopped cleanly (the rank then
+        exits 0).  ``coord_generation`` is the acting coordinator's
+        election generation (0 until a fail-over).  Zeros when the
+        loaded .so predates the drain protocol."""
+        fn = getattr(self._lib, "hvd_drain_stats", None)
+        if fn is None:
+            return {"drain_requested": 0, "drained": 0, "drains": 0,
+                    "drain_latency_ns": 0, "coord_generation": 0}
+        vals = (ctypes.c_int64 * 8)()
+        fn(vals)
+        return {
+            "drain_requested": max(int(vals[0]), 0),
+            "drained": max(int(vals[1]), 0),
+            "drains": max(int(vals[2]), 0),
+            "drain_latency_ns": max(int(vals[3]), 0),
+            "coord_generation": max(int(vals[4]), 0),
+        }
+
+    def request_drain(self, rank: int = -1) -> bool:
+        """Ask for a PLANNED eviction of ``rank`` (-1 = this rank).  The
+        coordinator announces it, waits for the drainee's checkpoint ack,
+        and drives a gentle shrink — zero failed handles on survivors.
+        False when the loaded .so predates the drain protocol."""
+        fn = getattr(self._lib, "hvd_request_drain", None)
+        if fn is None:
+            return False
+        return int(fn(int(rank))) == 0
+
+    def ack_drain(self) -> bool:
+        """The draining rank's "checkpoint written" signal: the engine
+        sends the drain ack once it is quiesced, after which the
+        coordinator evicts this rank cleanly."""
+        fn = getattr(self._lib, "hvd_drain_ack", None)
+        if fn is None:
+            return False
+        return int(fn()) == 0
 
     def topology_describe(self) -> dict | None:
         """The engine's topology descriptor (hosts x NICs x ranks): ring
@@ -780,7 +832,8 @@ class NativeEngine(Engine):
                      "heartbeats_rx": 0, "sg_bytes_skipped": 0,
                      "pack_bytes": 0, "world_changes": 0, "rank_joins": 0,
                      "coord_failovers": 0, "arb_requests": 0,
-                     "arb_link_verdicts": 0, "arb_dead_verdicts": 0}
+                     "arb_link_verdicts": 0, "arb_dead_verdicts": 0,
+                     "drains": 0}
         # per-stripe tx bytes: one labelled counter per stripe index
         stripe_seen = [0] * 8
         # per-process-set counters: one labelled series per set id
@@ -811,6 +864,7 @@ class NativeEngine(Engine):
             ("arb_requests", telemetry.NATIVE_ARB_REQUESTS),
             ("arb_link_verdicts", telemetry.NATIVE_ARB_LINK_VERDICTS),
             ("arb_dead_verdicts", telemetry.NATIVE_ARB_DEAD_VERDICTS),
+            ("drains", telemetry.NATIVE_DRAINS),
         )
         # the FAULT counters are process-wide by design (fault.h: they
         # survive engine re-init like the registry does) — seed their
@@ -821,9 +875,12 @@ class NativeEngine(Engine):
         for k in ("peer_timeouts", "aborts", "heartbeats_tx",
                   "heartbeats_rx"):
             last_seen[k] = fault_now[k]
+        # .get everywhere: SCRIPTED test engines override world_stats
+        # with a minimal dict (they predate the coord/arb keys), and a
+        # missing key must seed 0, not kill collector registration
         for k in ("world_changes", "rank_joins", "coord_failovers",
                   "arb_requests", "arb_link_verdicts", "arb_dead_verdicts"):
-            last_seen[k] = world_now[k]
+            last_seen[k] = world_now.get(k, 0)
         # abort latency: each collection observes the window's mean
         # detect->handles-failed latency (cumulative ns / cumulative count
         # deltas), same scheme as the pipeline stage histograms
@@ -832,8 +889,16 @@ class NativeEngine(Engine):
         shrink_seen = [world_now["shrink_latency_ns"],
                        world_now["world_changes"]]
         # fail-over latency: windowed mean over completed fail-overs
-        failover_seen = [world_now["failover_latency_ns"],
-                         world_now["coord_failovers"]]
+        failover_seen = [world_now.get("failover_latency_ns", 0),
+                         world_now.get("coord_failovers", 0)]
+        # graceful drain (wire v11): counter + windowed-mean latency,
+        # process-wide like the rest of the fault family
+        try:
+            drain_now = self.drain_stats()
+        except AttributeError:  # scripted test engines carry no _lib
+            drain_now = {"drains": 0, "drain_latency_ns": 0}
+        last_seen["drains"] = drain_now["drains"]
+        drain_seen = [drain_now["drain_latency_ns"], drain_now["drains"]]
         # per-stage cumulative (ns, item count) at last collection: each
         # collection observes the mean per-item stage latency of the
         # window into the stage histogram
@@ -904,15 +969,21 @@ class NativeEngine(Engine):
             # the acting coordinator's launch slot (0 until a fail-over);
             # -1 = engine down: keep the last real value so the
             # post-mortem's coordinator= column survives teardown
-            if d["coordinator_rank"] >= 0:
+            if d.get("coordinator_rank", -1) >= 0:
                 reg.gauge(telemetry.NATIVE_COORD_RANK).set(
                     d["coordinator_rank"])
+            # the acting coordinator's election generation (0 until a
+            # fail-over; monotonic across them — the splinter fence's
+            # observable)
+            reg.gauge(telemetry.NATIVE_COORD_GENERATION).set(
+                d.get("coord_generation", 0))
             with mirror_lock:
                 for key, metric in cumulative:
-                    delta = d[key] - last_seen[key]
+                    now_v = d.get(key, last_seen[key])
+                    delta = now_v - last_seen[key]
                     if delta > 0:
                         reg.counter(metric).inc(delta)
-                        last_seen[key] = d[key]
+                        last_seen[key] = now_v
                 for s, now_b in enumerate(d["wire_stripe_bytes"]):
                     delta = now_b - stripe_seen[s]
                     if delta > 0:
@@ -983,14 +1054,21 @@ class NativeEngine(Engine):
                         dns / dn / 1e9)
                     shrink_seen[0] = d["shrink_latency_ns"]
                     shrink_seen[1] = d["world_changes"]
-                dns = d["failover_latency_ns"] - failover_seen[0]
-                dn = d["coord_failovers"] - failover_seen[1]
+                dns = d.get("failover_latency_ns", 0) - failover_seen[0]
+                dn = d.get("coord_failovers", 0) - failover_seen[1]
                 if dn > 0 and dns >= 0:
                     reg.histogram(
                         telemetry.NATIVE_COORD_FAILOVER_LATENCY).observe(
                             dns / dn / 1e9)
                     failover_seen[0] = d["failover_latency_ns"]
                     failover_seen[1] = d["coord_failovers"]
+                dns = d.get("drain_latency_ns", 0) - drain_seen[0]
+                dn = d.get("drains", 0) - drain_seen[1]
+                if dn > 0 and dns >= 0:
+                    reg.histogram(telemetry.NATIVE_DRAIN_LATENCY).observe(
+                        dns / dn / 1e9)
+                    drain_seen[0] = d["drain_latency_ns"]
+                    drain_seen[1] = d["drains"]
                 if "health_collectives" in d:
                     desc = None
                     try:

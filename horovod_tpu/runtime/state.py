@@ -134,6 +134,37 @@ def init(comm=None) -> None:
         from horovod_tpu import telemetry
 
         telemetry.on_init(topology.rank)
+    # spot-preemption forwarding (wire v11, opt-in): SIGTERM becomes a
+    # graceful drain request instead of a death — the eviction notice
+    # most preemptible/spot fabrics deliver.  Installed only when asked
+    # (hvdrun --preempt-drain sets the env) and only on the main thread.
+    if (os.environ.get("HOROVOD_TPU_PREEMPT_DRAIN") == "1"
+            and topology.size > 1 and engine is not None
+            and hasattr(engine, "request_drain")):
+        import signal
+        import sys
+
+        def _preempt(signum, frame):
+            try:
+                w = engine.world_stats()
+                if int(w.get("world_rank", 1)) == 0:
+                    # the acting coordinator cannot drain itself — die
+                    # and let the fail-over election cover it
+                    signal.signal(signal.SIGTERM, signal.SIG_DFL)
+                    os.kill(os.getpid(), signal.SIGTERM)
+                    return
+            except Exception:
+                pass
+            print("[horovod_tpu] SIGTERM: forwarding as a graceful "
+                  "drain request for this rank", file=sys.stderr,
+                  flush=True)
+            engine.request_drain(-1)
+
+        try:
+            if topology.rank != 0:
+                signal.signal(signal.SIGTERM, _preempt)
+        except ValueError:
+            pass  # not the main thread: the handler cannot be installed
 
 
 def shutdown() -> None:
@@ -229,6 +260,72 @@ def coordinator_rank() -> int:
     # -1 is the engine-down sentinel the metrics mirror consumes; the
     # public surface reports the launch-slot contract (0 = original)
     return max(int(eng.coord_stats()["coordinator_rank"]), 0)
+
+
+def request_drain(rank: int | None = None) -> bool:
+    """Ask for a PLANNED eviction of ``rank`` (None = this rank) from an
+    elastic world — the graceful alternative to killing the process
+    (wire v11).
+
+    The coordinator announces the drain, the draining rank finishes its
+    current round, runs its ``on_drain`` checkpoint hook (see
+    :meth:`elastic.run`), acks, and a gentle world change evicts it with
+    ZERO failed handles on survivors and a clean exit 0 on the drained
+    rank.  Spot/preemption notices route here: ``hvdrun`` installs a
+    SIGTERM-to-drain handler with ``--preempt-drain``, and operators can
+    trigger it externally with ``hvdrun --drain RANK``.
+
+    Returns False when the engine predates the drain protocol or the
+    job is not elastic (a warning is printed either way)."""
+    _topology()
+    eng = _state.engine
+    if eng is None or not hasattr(eng, "request_drain"):
+        import sys
+
+        print("[horovod_tpu] request_drain ignored: engine has no drain "
+              "support", file=sys.stderr)
+        return False
+    if not int(eng.world_stats().get("elastic", 0)):
+        import sys
+
+        print("[horovod_tpu] request_drain ignored: the job is not "
+              "elastic (launch with --min-np)", file=sys.stderr)
+        return False
+    return eng.request_drain(-1 if rank is None else int(rank))
+
+
+def drain_requested() -> bool:
+    """True while the coordinator has announced a drain of THIS rank:
+    finish the step, write your checkpoint, call :func:`ack_drain`, and
+    exit 0 once :func:`drained` reports the eviction (the
+    ``hvd.elastic.run`` wrapper does all of this when given an
+    ``on_drain=`` hook)."""
+    _topology()
+    eng = _state.engine
+    if eng is None or not hasattr(eng, "drain_stats"):
+        return False
+    return bool(eng.drain_stats()["drain_requested"])
+
+
+def ack_drain() -> bool:
+    """Signal "checkpoint written" on a draining rank; the engine sends
+    the drain ack once quiesced and the coordinator then evicts this
+    rank cleanly."""
+    _topology()
+    eng = _state.engine
+    if eng is None or not hasattr(eng, "ack_drain"):
+        return False
+    return eng.ack_drain()
+
+
+def drained() -> bool:
+    """True once this rank's planned eviction committed and the engine
+    stopped cleanly — the drained rank should exit 0."""
+    _topology()
+    eng = _state.engine
+    if eng is None or not hasattr(eng, "drain_stats"):
+        return False
+    return bool(eng.drain_stats()["drained"])
 
 
 def world_changed() -> bool:
@@ -356,7 +453,7 @@ class _Elastic:
 
     @staticmethod
     def run(func=None, *, sync=None, timeout: float = 60.0,
-            max_restarts: int | None = None):
+            max_restarts: int | None = None, on_drain=None):
         """Decorator packaging the elastic recovery loop (the recipe
         docs/troubleshooting.md used to spell out by hand)::
 
@@ -364,7 +461,10 @@ class _Elastic:
                 global params
                 params = hvd.broadcast(params, 0, name="sync_state")
 
-            @hvd.elastic.run(sync=sync_state)
+            def checkpoint():                # planned-eviction hook
+                save(params, "/ckpt/latest")
+
+            @hvd.elastic.run(sync=sync_state, on_drain=checkpoint)
             def train_step(batch):
                 return hvd.allreduce(grads(batch), name="grads")
 
@@ -375,6 +475,15 @@ class _Elastic:
         cancelled it), the wrapper waits out :func:`world_changed` —
         which refreshes ``rank()``/``size()`` — re-runs ``sync()``, and
         retries ``func`` from the top.
+
+        GRACEFUL DRAIN (wire v11): when the coordinator announces a
+        planned eviction of this rank (``hvdrun --drain``, a forwarded
+        SIGTERM/spot-preemption notice, or :func:`request_drain`), the
+        wrapper finishes the in-flight step, runs ``on_drain()`` (write
+        your checkpoint there), acks, waits for the eviction to commit,
+        and exits the process CLEANLY via ``SystemExit(0)`` — survivors
+        never see a retryable failure.  Without ``on_drain`` the drain
+        still proceeds (no checkpoint is written).
 
         ``timeout`` bounds each wait for the new world (a wire error with
         no world change behind it re-raises as fatal — see the streak
@@ -387,12 +496,45 @@ class _Elastic:
 
             from horovod_tpu.runtime.fault import WorldShrunkError
 
+            def drain_exit():
+                # checkpoint, ack, await the eviction, leave cleanly.
+                # An on_drain failure propagates WITHOUT the ack: the
+                # coordinator's drain deadline evicts anyway (degraded
+                # to one retryable round on survivors) and this rank's
+                # non-zero exit reports the checkpoint failure.
+                if on_drain is not None:
+                    on_drain()
+                ack_drain()
+                deadline = time.monotonic() + timeout
+                while time.monotonic() < deadline:
+                    if drained():
+                        shutdown()
+                        raise SystemExit(0)
+                    if not drain_requested():
+                        # voided by an interleaved membership change; a
+                        # surviving self-request re-announces — resume
+                        # training meanwhile
+                        return
+                    time.sleep(0.02)
+                raise SystemExit(
+                    "drain: the eviction never committed within "
+                    f"{timeout:g}s")
+
             @functools.wraps(fn)
             def wrapper(*args, **kwargs):
                 restarts = 0
                 need_sync = sync is not None
                 while True:
                     try:
+                        # keep rank()/size() fresh across GENTLE
+                        # membership changes too: a graceful drain never
+                        # raises WorldShrunkError, so without this poll
+                        # survivors would keep sharding by the stale
+                        # pre-drain size (and resync after it)
+                        if world_changed():
+                            need_sync = sync is not None
+                        if drain_requested():
+                            drain_exit()
                         # sync() runs INSIDE the retry arm: a membership
                         # change can land while the sync collective itself
                         # is on the wire (a joiner arriving mid-step does

@@ -13,14 +13,18 @@ from __future__ import annotations
 
 # csrc/wire.h — frame header
 WIRE_MAGIC = 0x48564457  # "HVDW" little-endian
-WIRE_VERSION = 10        # v10: coordinator fail-over — kCoordElect
-                         # successor registration, kArbitrate dead-link-
-                         # vs-dead-rank probes, and the coordinator-slot
-                         # field in the bootstrap table.  Pre-existing
-                         # frame layouts are unchanged from v9: v9-shaped
-                         # jobs serialize the same byte counts (only the
-                         # header's version value moved), which keeps the
-                         # steady-state ctrl-bytes CI gate at 1.0000.
+WIRE_VERSION = 11        # v11: graceful drain + fenced elections —
+                         # kDrain planned-eviction frames (request /
+                         # announce / ack), world-change kind 2 = drain
+                         # (the gentle requeue-not-fail path), the
+                         # election GENERATION on kCoordElect, and the
+                         # generation field in the bootstrap table.
+                         # Pre-existing frame layouts other than
+                         # CoordElectFrame are unchanged from v10:
+                         # v10-shaped jobs serialize the same byte counts
+                         # (only the header's version value moved), which
+                         # keeps the steady-state ctrl-bytes CI gate at
+                         # 1.0000.
 
 # csrc/wire.h — reduce-scatter stripe alignment (wire v9): stripe c of an
 # n-byte tensor over m members starts at c * floor(n/m/64)*64 bytes, with
@@ -59,7 +63,10 @@ FRAME_WORLD_CHANGE = 7
 FRAME_WORLD_ACK = 8
 FRAME_WORLD_COMMIT = 9
 FRAME_COORD_ELECT = 10   # wire v10: survivor -> successor registration
+                         # (v11: + generation; doubles as the successor's
+                         # prior-epoch ADOPTION NOTICE)
 FRAME_ARBITRATE = 11     # wire v10: dead-link-vs-dead-rank probe/verdict
+FRAME_DRAIN = 12         # wire v11: graceful-drain request/announce/ack
 
 FRAME_TYPES = {
     "kInvalid": FRAME_INVALID,
@@ -74,11 +81,27 @@ FRAME_TYPES = {
     "kWorldCommit": FRAME_WORLD_COMMIT,
     "kCoordElect": FRAME_COORD_ELECT,
     "kArbitrate": FRAME_ARBITRATE,
+    "kDrain": FRAME_DRAIN,
 }
 
-# csrc/wire.h — WorldChangeFrame.kind (elastic membership, wire v7)
+# csrc/wire.h — WorldChangeFrame.kind (elastic membership, wire v7; kind 2
+# since v11: a DRAIN shrink was announced ahead of time, so members take
+# the gentle path — requeue un-negotiated work instead of failing it
+# retryable, and the evicted rank exits 0 instead of aborting).
+# tools/check_wire_abi.py pins all three against wire.h.
 WORLD_CHANGE_SHRINK = 0
 WORLD_CHANGE_JOIN = 1
+WORLD_CHANGE_DRAIN = 2
+
+# csrc/wire.h — DrainFrame.phase (wire v11).  A REQUEST flows toward the
+# coordinator (`hvdrun --drain RANK`, a SIGTERM/spot-preemption notice the
+# worker forwards, or hvd.request_drain()); the coordinator broadcasts an
+# ANNOUNCE naming the draining ranks; each drainee finishes its round,
+# runs the on_drain checkpoint hook, and ACKs once quiesced — then the
+# kind-2 world change evicts it with zero failed handles anywhere.
+DRAIN_REQUEST = 0
+DRAIN_ANNOUNCE = 1
+DRAIN_ACK = 2
 
 # csrc/wire.h — ArbitrateFrame.verdict (wire v10).  A worker's data-plane
 # failure with no world change behind it becomes a kArbitrateRequest to

@@ -137,6 +137,14 @@ NATIVE_ARB_REQUESTS = "hvd_arbitration_requests_total"
 NATIVE_ARB_LINK_VERDICTS = "hvd_arbitration_link_verdicts_total"
 NATIVE_ARB_DEAD_VERDICTS = "hvd_arbitration_dead_verdicts_total"
 
+# graceful drain + fenced elections (wire v11): completed announced
+# scale-ins, the announce -> shrunk-world-live latency histogram, and the
+# acting coordinator's monotonic election generation (0 until a
+# fail-over; the splinter fence's observable)
+NATIVE_DRAINS = "hvd_drains_total"
+NATIVE_DRAIN_LATENCY = "hvd_drain_latency_seconds"
+NATIVE_COORD_GENERATION = "hvd_coord_generation"
+
 # process sets (wire v8): registered-set count, plus per-set counters
 # labeled with set="<id>" (the global set is set 0) — collectives run,
 # payload bytes moved, and this rank's steady-state cache lookups, so two
@@ -455,6 +463,7 @@ __all__ = [
     "NATIVE_COORD_RANK", "NATIVE_COORD_FAILOVERS",
     "NATIVE_COORD_FAILOVER_LATENCY", "NATIVE_ARB_REQUESTS",
     "NATIVE_ARB_LINK_VERDICTS", "NATIVE_ARB_DEAD_VERDICTS",
+    "NATIVE_DRAINS", "NATIVE_DRAIN_LATENCY", "NATIVE_COORD_GENERATION",
     "NATIVE_PROCESS_SETS", "NATIVE_PSET_COLLECTIVES", "NATIVE_PSET_BYTES",
     "NATIVE_PSET_CACHE_HITS", "NATIVE_PSET_OP_COLLECTIVES",
     "NATIVE_PSET_OP_BYTES", "NATIVE_SHM_POISONS",

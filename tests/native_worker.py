@@ -1033,7 +1033,8 @@ def scenario_elastic_loop():
                     hvd.synchronize(h)
                 except (RuntimeError, ValueError):
                     pass
-            deadline = _time.monotonic() + 60
+            deadline = _time.monotonic() + float(
+                os.environ.get("HVD_TEST_WORLD_WAIT_S", "60"))
             while not hvd.world_changed():
                 if _time.monotonic() > deadline:
                     raise SystemExit(
@@ -1089,6 +1090,139 @@ def scenario_elastic_loop():
     hvd.shutdown()
     print(f"rank {launch_rank}: elastic loop OK world={ws} "
           f"changes={changes_seen}", flush=True)
+
+
+def scenario_drain_loop():
+    """Graceful-drain chaos workload (wire v11): a steady allreduce
+    stream under --min-np where one (or more) ranks are PLANNED out of
+    the world — by hvd.request_drain() (mode=api), by a SIGTERM the
+    preempt handler forwards (mode=sigterm), or by an external
+    `hvdrun --drain` client (mode=cli; the test fires it).
+
+    The drain contract this scenario proves per rank: the drained rank
+    runs its on_drain checkpoint hook, exits 0 via the hvd.elastic.run
+    wrapper, and NO rank ever sees a retryable failure — the step
+    function runs under max_restarts=0, so any WorldShrunkError crashes
+    the worker and fails the row.  Markers: ON_DRAIN / DRAINED OK /
+    WORLD_CHANGED size=N drains=D / drain loop OK."""
+    import signal
+    import time as _time
+
+    hvd.init()
+    launch_rank = int(os.environ.get("HOROVOD_TPU_RANK", "0"))
+    elems = int(os.environ.get("HVD_TEST_ELEMS", "4096"))
+    steps_after = int(os.environ.get("HVD_TEST_STEPS_AFTER", "8"))
+    expect_final = int(os.environ.get("HVD_TEST_EXPECT_FINAL_SIZE", "0"))
+    drain_ranks = [int(r) for r in
+                   os.environ.get("HVD_TEST_DRAIN_RANKS", "").split(",")
+                   if r]
+    drain_step = int(os.environ.get("HVD_TEST_DRAIN_STEP", "5"))
+    mode = os.environ.get("HVD_TEST_DRAIN_MODE", "api")
+    ckpt_dir = os.environ.get("HVD_TEST_CKPT_DIR", "")
+    from horovod_tpu.runtime import state as _st
+
+    data = np.ones(elems, np.float32)
+    shared = {"stop": 0.0, "step": 0}
+
+    def sync_state():
+        hvd.broadcast(np.zeros(1, np.float32), root_rank=0,
+                      name="dl_sync")
+
+    def on_drain():
+        if ckpt_dir:
+            path = os.path.join(ckpt_dir, f"ckpt_r{launch_rank}.txt")
+            with open(path, "w") as f:
+                f.write(f"step={shared['step']}\n")
+        print(f"rank {launch_rank}: ON_DRAIN checkpoint written "
+              f"step={shared['step']}", flush=True)
+
+    # max_restarts=0 is the zero-retryable assertion: a WorldShrunkError
+    # anywhere crashes this worker and fails the chaos row
+    @hvd.elastic.run(sync=sync_state, on_drain=on_drain, max_restarts=0)
+    def train_step():
+        hs = [hvd.allreduce_async(data, average=False, name=f"dl{i}")
+              for i in range(4)]
+        outs = [hvd.synchronize(h) for h in hs]
+        stop = hvd.broadcast(np.array([shared["stop"]], np.float32),
+                             root_rank=0, name="dl_stop")
+        return outs, stop
+
+    fired = False
+    settled_steps = 0
+    ws = hvd.size()
+    try:
+        for step in range(100000):
+            shared["step"] = step
+            size_before = hvd.size()
+            try:
+                outs, stop = train_step()
+            except RuntimeError as e:
+                if "shut down" in str(e):
+                    break  # coordinated clean shutdown reached this rank
+                raise
+            hvd.world_changed()
+            ws = hvd.size()
+            for out in outs:
+                # the sum of ones IS the world size; around the drain the
+                # result belongs to any world the step straddled — TWO
+                # drain rounds can land within one step (a requeued op
+                # completing at the intermediate size), so accept the
+                # whole [end, start] range, not just the endpoints
+                lo, hi = sorted((float(size_before), float(ws)))
+                assert lo <= out[0] <= hi, (
+                    launch_rank, out[0], size_before, ws)
+            if stop[0] > 0:
+                break
+            if step == 2 and hvd.rank() == 0:
+                print(f"rank {launch_rank}: STEPPING", flush=True)
+            if not fired and step >= drain_step:
+                fired = True
+                if mode == "api" and launch_rank in drain_ranks:
+                    print(f"rank {launch_rank}: REQUESTING_DRAIN",
+                          flush=True)
+                    hvd.request_drain()
+                elif mode == "sigterm" and launch_rank in drain_ranks:
+                    # the spot-preemption shape: the fabric SIGTERMs the
+                    # worker; the --preempt-drain handler forwards it as
+                    # a drain request instead of dying
+                    print(f"rank {launch_rank}: SELF_SIGTERM", flush=True)
+                    os.kill(os.getpid(), signal.SIGTERM)
+                # mode == "cli": the test drives `hvdrun --drain`
+            d = _st.engine().drain_stats()
+            settled = (ws == expect_final if expect_final else
+                       d["drains"] >= 1)
+            if drain_ranks and d["drains"] < 1:
+                settled = False
+            if settled:
+                settled_steps += 1
+            else:
+                settled_steps = 0
+            if hvd.rank() == 0 and settled_steps >= steps_after:
+                shared["stop"] = 1.0
+        else:
+            print(f"rank {launch_rank}: drain loop ran dry", flush=True)
+            sys.exit(5)
+    except SystemExit as e:
+        if e.code == 0:
+            # the wrapper drained this rank: checkpoint written, engine
+            # stopped cleanly, eviction committed — leave with exit 0
+            print(f"rank {launch_rank}: DRAINED OK", flush=True)
+        raise
+    d = _st.engine().world_stats()
+    dd = _st.engine().drain_stats()
+    print(f"rank {launch_rank}: WORLD_CHANGED size={ws} "
+          f"changes={d['world_changes']} drains={dd['drains']} "
+          f"gen={dd['coord_generation']}", flush=True)
+    if dd["drains"] > 0:
+        # announce -> shrunk-world-live, the coordinator's own measure;
+        # drain_latency_ns is CUMULATIVE across rounds, so report the
+        # per-round mean (a two-round drain must not read as one 2x span)
+        print(f"rank {launch_rank}: DRAIN_LATENCY_S="
+              f"{dd['drain_latency_ns'] / 1e9 / dd['drains']:.3f}",
+              flush=True)
+    hvd.shutdown()
+    print(f"rank {launch_rank}: drain loop OK world={ws} "
+          f"drains={dd['drains']}", flush=True)
 
 
 def scenario_elastic_dump():
@@ -1949,7 +2083,8 @@ def scenario_rs_elastic_loop():
                     hvd.synchronize(h)
                 except (RuntimeError, ValueError):
                     pass
-            deadline = _time.monotonic() + 60
+            deadline = _time.monotonic() + float(
+                os.environ.get("HVD_TEST_WORLD_WAIT_S", "60"))
             while not hvd.world_changed():
                 if _time.monotonic() > deadline:
                     raise SystemExit(

@@ -199,6 +199,42 @@ def test_failover_artifact_counted_series():
     assert points >= 6, f"only {points} fail-over points in BENCH_r16"
 
 
+def test_drain_artifact_counted_series():
+    """BENCH_r17's counted series (wire v11): every graceful-drain point
+    must show the announced scale-in actually WORKING — job exit 0, the
+    drain applied, the final world size exact, the drained rank(s)
+    checkpointed (on_drain ran) and exited CLEAN, and ZERO retryable
+    failures observed by any rank (the contract that separates a planned
+    drain from the reactive failed-cycle-plus-detection path).  The
+    announce -> shrunk-world-live latency is gated STRUCTURALLY: present
+    and under the 30 s drain deadline — a planned single round, not a
+    heartbeat window — while its magnitude carries the usual
+    shared-2-core-host caveat."""
+    r17 = _baseline("BENCH_r17.json")
+    points = 0
+    for np_key, np_ in (("np3", 3), ("np4", 4)):
+        p = r17.get(np_key)
+        if not p:
+            continue
+        for label, row in p.items():
+            if not isinstance(row, dict) or "exit_code" not in row:
+                continue
+            points += 1
+            assert row["exit_code"] == 0, (np_key, label, row)
+            assert row["zero_retryable"] is True, (np_key, label, row)
+            assert row["drained_clean"] is True, (np_key, label, row)
+            assert row["checkpointed"] is True, (np_key, label, row)
+            ndrained = len(row["drain_ranks"])
+            assert row["final_size"] == np_ - ndrained, (np_key, label,
+                                                         row)
+            # one announce may cover both ranks, or the second rides its
+            # own round — either is a planned, failure-free eviction
+            assert 1 <= row["drains"] <= ndrained, (np_key, label, row)
+            assert row["drain_latency_s"] is not None, (np_key, label)
+            assert row["drain_latency_s"] < 30.0, (np_key, label, row)
+    assert points >= 8, f"only {points} drain points in BENCH_r17"
+
+
 def test_wire_counted_series_gate():
     """Fresh striped + scatter-gather fused steps at the BENCH_r10
     workload shape (-np 2, 4 stripes, 64 KB quantum, SG on) vs the
@@ -387,13 +423,13 @@ def test_trace_overhead_gate():
 
 def test_wire_abi_version_in_sync():
     """tools/check_wire_abi.py reports a clean sync at the CURRENT wire
-    version (v9: sharded-training ops) — a version bump without its
-    Python mirror, or frame-layout drift, fails here."""
+    version (v11: graceful drain + fenced elections) — a version bump
+    without its Python mirror, or frame-layout drift, fails here."""
     out = subprocess.run(
         [sys.executable, os.path.join(REPO, "tools", "check_wire_abi.py")],
         cwd=REPO, capture_output=True, text=True, timeout=120)
     assert out.returncode == 0, out.stdout + out.stderr
-    assert "version 10" in out.stdout, out.stdout
+    assert "version 11" in out.stdout, out.stdout
 
 
 def test_health_flip_attribution_artifact():

@@ -34,7 +34,7 @@ def test_checker_detects_version_drift():
     """The guard must actually bite: a simulated version bump in wire.h
     without a Python update is reported."""
     wire_h, common_h = _headers()
-    tampered = wire_h.replace("kWireVersion = 10", "kWireVersion = 11")
+    tampered = wire_h.replace("kWireVersion = 11", "kWireVersion = 12")
     assert tampered != wire_h, "kWireVersion moved; update this test"
     problems = check_wire_abi.check(tampered, common_h)
     assert any("kWireVersion" in p for p in problems), problems
@@ -56,9 +56,9 @@ def test_checker_detects_new_tuned_knob():
 
 def test_checker_detects_new_frame_type():
     wire_h, common_h = _headers()
-    tampered = wire_h.replace("kArbitrate = 11,",
-                              "kArbitrate = 11,\n  kNewFrame = 12,")
-    assert tampered != wire_h, "kArbitrate moved; update this test"
+    tampered = wire_h.replace("kDrain = 12,",
+                              "kDrain = 12,\n  kNewFrame = 13,")
+    assert tampered != wire_h, "kDrain moved; update this test"
     problems = check_wire_abi.check(tampered, common_h)
     assert any("FrameType" in p for p in problems), problems
 
@@ -133,12 +133,11 @@ def test_v9_sharded_training_collateral_present():
 
 
 def test_v10_failover_collateral_present():
-    """The coordinator fail-over wire v10 collateral: the version is 10
-    on both sides, the election/arbitration frame types exist at their
-    pinned ids, and the arbitration verdict codes match their mirrors."""
+    """The coordinator fail-over wire v10 collateral: the
+    election/arbitration frame types exist at their pinned ids and the
+    arbitration verdict codes match their mirrors."""
     from horovod_tpu.runtime import wire_abi
 
-    assert wire_abi.WIRE_VERSION == 10
     assert wire_abi.FRAME_TYPES["kCoordElect"] == \
         wire_abi.FRAME_COORD_ELECT == 10
     assert wire_abi.FRAME_TYPES["kArbitrate"] == \
@@ -146,11 +145,56 @@ def test_v10_failover_collateral_present():
     assert (wire_abi.ARBITRATE_REQUEST, wire_abi.ARBITRATE_LINK_ONLY,
             wire_abi.ARBITRATE_DEAD) == (0, 1, 2)
     wire_h, _ = _headers()
-    assert "kWireVersion = 10" in wire_h
     for needle in ("kCoordElect = 10", "kArbitrate = 11",
                    "kArbitrateRequest = 0", "kArbitrateLinkOnly = 1",
                    "kArbitrateDead = 2"):
         assert needle in wire_h, needle
+
+
+def test_v11_drain_collateral_present():
+    """The graceful-drain + fenced-election wire v11 collateral: the
+    version is 11 on both sides, the kDrain frame type exists at its
+    pinned id, the drain phase codes and world-change kinds match their
+    mirrors, and CoordElectFrame carries the election generation."""
+    from horovod_tpu.runtime import wire_abi
+
+    assert wire_abi.WIRE_VERSION == 11
+    assert wire_abi.FRAME_TYPES["kDrain"] == wire_abi.FRAME_DRAIN == 12
+    assert (wire_abi.DRAIN_REQUEST, wire_abi.DRAIN_ANNOUNCE,
+            wire_abi.DRAIN_ACK) == (0, 1, 2)
+    assert (wire_abi.WORLD_CHANGE_SHRINK, wire_abi.WORLD_CHANGE_JOIN,
+            wire_abi.WORLD_CHANGE_DRAIN) == (0, 1, 2)
+    wire_h, _ = _headers()
+    assert "kWireVersion = 11" in wire_h
+    for needle in ("kDrain = 12", "kDrainRequest = 0",
+                   "kDrainAnnounce = 1", "kDrainAck = 2",
+                   "kWorldChangeShrink = 0", "kWorldChangeJoin = 1",
+                   "kWorldChangeDrain = 2"):
+        assert needle in wire_h, needle
+    m = __import__("re").search(r"struct\s+CoordElectFrame\s*\{(.*?)\n\};",
+                                wire_h, __import__("re").S)
+    assert m and "uint64_t generation" in m.group(1)
+
+
+def test_checker_detects_drain_phase_drift():
+    """A renumbered drain phase constant in wire.h without the Python
+    mirror is reported — the phase code flips request/announce/ack
+    semantics on the wire without changing any frame id."""
+    wire_h, common_h = _headers()
+    tampered = wire_h.replace("kDrainAnnounce = 1", "kDrainAnnounce = 7")
+    assert tampered != wire_h, "kDrainAnnounce moved; update this test"
+    problems = check_wire_abi.check(tampered, common_h)
+    assert any("kDrainAnnounce" in p for p in problems), problems
+
+
+def test_checker_detects_lost_generation_field():
+    """CoordElectFrame losing the v11 generation field (the election
+    fence's carrier) is reported."""
+    wire_h, common_h = _headers()
+    tampered = wire_h.replace("  uint64_t generation = 0;\n};", "};", 1)
+    assert tampered != wire_h, "CoordElectFrame moved; update this test"
+    problems = check_wire_abi.check(tampered, common_h)
+    assert any("generation" in p for p in problems), problems
 
 
 def test_checker_detects_arbitration_verdict_drift():
@@ -214,7 +258,7 @@ def test_version_mismatch_message_names_both_versions():
     lib.hvd_free_cstr.argtypes = [ctypes.c_void_p]
     lib.hvd_wire_version.restype = ctypes.c_int
 
-    assert lib.hvd_wire_version() == wire_abi.WIRE_VERSION == 10
+    assert lib.hvd_wire_version() == wire_abi.WIRE_VERSION == 11
 
     def parse_error(buf: bytes) -> str | None:
         p = lib.hvd_frame_parse_error(buf, len(buf))
@@ -225,19 +269,19 @@ def test_version_mismatch_message_names_both_versions():
         finally:
             lib.hvd_free_cstr(p)
 
-    # v9 <-> v10 (the previous release still running somewhere): the
-    # fail-over version bump must surface as the descriptive
+    # v10 <-> v11 (the previous release still running somewhere): the
+    # drain/fencing version bump must surface as the descriptive
     # both-versions message, exactly like every previous bump
-    stale = wire_abi.frame_header(version=9) + b"\x00" * 16
+    stale = wire_abi.frame_header(version=10) + b"\x00" * 16
     msg = parse_error(stale)
     assert msg is not None
-    assert "v9" in msg and "v10" in msg and "libhvdtpu.so" in msg, msg
+    assert "v10" in msg and "v11" in msg and "libhvdtpu.so" in msg, msg
 
     # an even older v7 header: same contract, both versions named
     stale = wire_abi.frame_header(version=7) + b"\x00" * 16
     msg = parse_error(stale)
     assert msg is not None
-    assert "v7" in msg and "v10" in msg and "libhvdtpu.so" in msg, msg
+    assert "v7" in msg and "v11" in msg and "libhvdtpu.so" in msg, msg
 
     # current-version garbage is a parse error, not a version error
     import struct

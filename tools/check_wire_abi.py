@@ -209,6 +209,35 @@ def check(wire_h: str, common_h: str) -> list[str]:
             problems.append(
                 f"{cname}: wire.h has {got}, wire_abi.py has {pyval}")
 
+    # graceful drain + fenced elections (v11): the drain phase codes and
+    # the world-change kinds are plain constexpr ints riding inside frame
+    # bodies (DrainFrame.phase / WorldChangeFrame.kind) — a renumbering
+    # would silently flip request/announce/ack or shrink/join/drain
+    # semantics on the wire without changing any frame id, so each value
+    # gets its own pin.  The kDrain frame id itself rides the FRAME_TYPES
+    # comparison above; the CoordElectFrame generation field is a layout
+    # change covered by the v11 version bump.
+    for cname, pyval in (("kDrainRequest", wire_abi.DRAIN_REQUEST),
+                         ("kDrainAnnounce", wire_abi.DRAIN_ANNOUNCE),
+                         ("kDrainAck", wire_abi.DRAIN_ACK),
+                         ("kWorldChangeShrink",
+                          wire_abi.WORLD_CHANGE_SHRINK),
+                         ("kWorldChangeJoin", wire_abi.WORLD_CHANGE_JOIN),
+                         ("kWorldChangeDrain",
+                          wire_abi.WORLD_CHANGE_DRAIN)):
+        got = _parse_constant(wire_h, cname)
+        if got != pyval:
+            problems.append(
+                f"{cname}: wire.h has {got}, wire_abi.py has {pyval}")
+    # the generation must ride BOTH election-frame fields the fences read:
+    # struct CoordElectFrame must declare it (the drift this guard bites
+    # on is someone reverting the field without downgrading the version)
+    m = re.search(r"struct\s+CoordElectFrame\s*\{(.*?)\n\};", wire_h, re.S)
+    if not m or "generation" not in m.group(1):
+        problems.append(
+            "CoordElectFrame: wire.h lost the v11 `generation` field the "
+            "election fences serialize")
+
     ops = _parse_enum(common_h, "OpType")
     if ops != wire_abi.OP_TYPES:
         problems.append(
