@@ -2178,6 +2178,168 @@ def bench_drain(args):
     return results
 
 
+def _run_sentinel_point(n, victim, phase, slow_ms, interval_s=0.5,
+                        windows=3, timeout=300):
+    """One sentinel policy-loop launch (BENCH_r18): inject a chronic
+    per-phase straggler that the JOB ignores, and count the launcher-side
+    observe→decide→act arc — conviction naming exactly (victim, phase)
+    within the hysteresis budget, graceful drain, joiner relaunch, and
+    the world restored to full size with zero retryable failures."""
+    import re as _re
+    import shutil
+    import tempfile
+
+    from horovod_tpu.utils import net as _net
+
+    td = tempfile.mkdtemp(prefix="hvdsent-")
+    trace_dir = os.path.join(td, "trace")
+    ledger_dir = os.path.join(td, "ledger")
+    mport = _net.free_port()
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "HOROVOD_TPU_FAULT_INJECT":
+            f"slow:rank={victim}:phase={phase}:ms={slow_ms}",
+        "HOROVOD_TPU_PEER_TIMEOUT_S": "30",
+        "HOROVOD_TPU_DATA_TIMEOUT_S": "30",
+        "HVD_TEST_ELEMS": "8192",
+        "HVD_TEST_EXPECT_FINAL_SIZE": str(n),
+    })
+    worker = os.path.join(REPO, "tests", "native_worker.py")
+    cmd = [sys.executable, "-m", "horovod_tpu.run", "-np", str(n),
+           "--grace-period", "1", "--min-np", "1",
+           "--metrics-port", str(mport), "--trace-dir", trace_dir,
+           "--sentinel", "--sentinel-act", "--spare-pool", "1",
+           "--sentinel-interval", str(interval_s),
+           "--sentinel-windows", str(windows),
+           "--sentinel-ledger", ledger_dir,
+           sys.executable, worker, "sentinel_loop"]
+    t0 = time.perf_counter()
+    proc = subprocess.run(cmd, env=env, cwd=REPO, capture_output=True,
+                          text=True, timeout=timeout)
+    wall = time.perf_counter() - t0
+
+    from horovod_tpu.telemetry.ledger import Ledger
+
+    recs = Ledger(ledger_dir).read(victim)
+    convs = [r for r in recs if r.get("kind") == "conviction"]
+    acts = [r for r in recs if r.get("kind") == "act"]
+    conviction = convs[0] if convs else {}
+    drains, joins, final, changes_best = 0, 0, None, -1
+    for m in _re.finditer(
+            r"WORLD_CHANGED size=(\d+) changes=(\d+) drains=(\d+) "
+            r"joins=(\d+)", proc.stdout):
+        drains = max(drains, int(m.group(3)))
+        joins = max(joins, int(m.group(4)))
+        if int(m.group(2)) >= changes_best:
+            changes_best = int(m.group(2))
+            final = int(m.group(1))
+    pre = [int(x) for x in _re.findall(
+        r"RETRYABLE_PRE_JOIN=(\d+)", proc.stdout)]
+    joined = [int(x) for x in _re.findall(
+        r"RETRYABLE_JOIN=(\d+)", proc.stdout)]
+    result = {
+        "victim": victim,
+        "phase": phase,
+        "slow_ms": slow_ms,
+        "exit_code": proc.returncode,
+        "wall_s": round(wall, 2),
+        "convicted": bool(convs),
+        "conviction_reason": conviction.get("reason"),
+        "conviction_rank": conviction.get("rank"),
+        "conviction_phase": conviction.get("phase"),
+        "windows_to_convict": conviction.get("windows"),
+        "hysteresis_windows": windows,
+        "drain_acted": any(a.get("action") == "drain" for a in acts),
+        "relaunched": any(a.get("action") == "relaunch" for a in acts),
+        "drained_clean": f"rank {victim}: DRAINED OK" in proc.stdout,
+        "checkpointed": (f"rank {victim}: ON_DRAIN checkpoint written"
+                         in proc.stdout),
+        "drains": drains,
+        "joins": joins,
+        "final_size": final,
+        # the drain's zero-failed-handles contract: no survivor saw a
+        # retryable cancel WITHOUT a join behind it (the join's own
+        # cancel is the normal re-admission path, counted separately)
+        "retryable_pre_join_max": max(pre) if pre else None,
+        "retryable_join_total": sum(joined),
+        "zero_retryable": bool(pre) and max(pre) == 0,
+        "ledger_records": len(recs),
+        "ledger_tail": recs[-4:],
+    }
+    shutil.rmtree(td, ignore_errors=True)
+    return result
+
+
+def bench_sentinel(args):
+    """Fleet-sentinel bench (BENCH_r18): the full observe→decide→act
+    policy loop against an injected chronic straggler, plus the
+    sentinel's observer-purity guard.
+
+    The COUNTED series gate CI (tests/test_bench_gate.py): the sentinel
+    convicts exactly the injected (rank, phase) within the hysteresis
+    budget, drains it gracefully (clean exit + checkpoint + zero
+    retryable failures anywhere), relaunches the slot from the spare
+    pool, and the world returns to full size — all recorded in the
+    per-rank conviction ledger.  The overhead half runs the pinned
+    negotiation workload with the sentinel on vs off: the sentinel only
+    scrapes HTTP endpoints and reads local files, so the counted
+    ctrl-bytes-per-round ratio is EXACTLY 1.0 by construction."""
+    import tempfile
+
+    from horovod_tpu.utils import net as _net
+
+    results = {"config": {
+        "interval_s": 0.5,
+        "hysteresis_windows": 3,
+        "fraction": 0.4,
+        "nproc": os.cpu_count(),
+        "note": "the job never reacts to the straggler itself — the "
+                "launcher-side sentinel must find it through /metrics + "
+                "the flight-recorder black boxes, convict it with "
+                "hysteresis, drain it over the control path, and "
+                "relaunch the slot healthy (the joiner env drops the "
+                "fault injection)",
+    }}
+    results["np4"] = {"policy_loop": _run_sentinel_point(
+        4, victim=2, phase="pack", slow_ms=args.sentinel_slow_ms)}
+
+    # observer-purity guard: counted ctrl bytes/round for the pinned
+    # negotiation workload, sentinel on vs off (both with the /metrics
+    # stack up, so the only delta IS the sentinel)
+    overhead = {}
+    for label, sentinel_on in (("sentinel_on", True),
+                               ("sentinel_off", False)):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["HOROVOD_TPU_CYCLE_TIME"] = "50"
+        env["HOROVOD_TPU_BURST_WINDOW_US"] = "20000"
+        env.pop("HOROVOD_TPU_CACHE_CAPACITY", None)
+        extra = ["--metrics-port", str(_net.free_port())]
+        if sentinel_on:
+            extra += ["--sentinel", "--sentinel-interval", "0.5",
+                      "--sentinel-ledger",
+                      tempfile.mkdtemp(prefix="hvdsentov-")]
+        cmd = [sys.executable, "-m", "horovod_tpu.run", "-np", "4",
+               *extra,
+               sys.executable, os.path.abspath(__file__),
+               "--negotiation-worker", "--neg-steps", "60",
+               "--neg-tensors", "32", "--neg-elems", "16"]
+        hb = _run_json_subprocess(cmd, env, timeout=600)
+        overhead[label] = {
+            "ctrl_bytes_per_round_worker":
+                hb.get("ctrl_bytes_per_round_worker"),
+            "rounds_per_sec": hb.get("rounds_per_sec"),
+        }
+    on = overhead.get("sentinel_on", {}).get("ctrl_bytes_per_round_worker")
+    off = overhead.get("sentinel_off", {}).get(
+        "ctrl_bytes_per_round_worker")
+    if on and off:
+        overhead["on_vs_off"] = round(on / off, 4)
+    results["sentinel_overhead"] = overhead
+    return results
+
+
 def trace_worker(args):
     """Subprocess under the launcher: a fixed fused-allreduce stream for
     the flight-recorder bench.  Batching is pinned by the parent (long
@@ -3750,6 +3912,17 @@ def build_parser() -> argparse.ArgumentParser:
                          "mid-ring, SIGTERM-as-preemption, two-rank — "
                          "with the zero-retryable contract counted); "
                          "writes BENCH_r17.json")
+    ap.add_argument("--sentinel", action="store_true",
+                    help="run ONLY the fleet-sentinel bench (observe→"
+                         "decide→act: an injected chronic straggler is "
+                         "convicted from /metrics + flight-recorder "
+                         "attribution, drained, and its slot relaunched "
+                         "from the spare pool; plus the sentinel-on vs "
+                         "off counted ctrl-bytes guard); writes "
+                         "BENCH_r18.json")
+    ap.add_argument("--sentinel-slow-ms", type=int, default=40,
+                    help="per-pack injected delay for the sentinel "
+                         "bench's chronic straggler")
     ap.add_argument("--process-sets", action="store_true",
                     help="run ONLY the process-set concurrency bench "
                          "(two disjoint sets concurrent vs the same work "
@@ -4013,6 +4186,25 @@ def main() -> None:
                         "rank_joins"),
                 }
         print(json.dumps({"failover": compact, "full": "BENCH_r16.json"}))
+        return
+    if args.sentinel:
+        # fleet sentinel only: one policy-loop chaos launch + the
+        # observer-purity guard — a few minutes, own artifact
+        out = bench_sentinel(args)
+        with open(os.path.join(REPO, "BENCH_r18.json"), "w") as f:
+            json.dump(out, f, indent=1)
+        pl = out.get("np4", {}).get("policy_loop", {})
+        compact = {
+            "convicted": pl.get("convicted"),
+            "rank_phase": f'{pl.get("conviction_rank")}:'
+                          f'{pl.get("conviction_phase")}',
+            "relaunched": pl.get("relaunched"),
+            "final_size": pl.get("final_size"),
+            "zero_retryable": pl.get("zero_retryable"),
+            "ctrl_on_vs_off": out.get("sentinel_overhead", {}).get(
+                "on_vs_off"),
+        }
+        print(json.dumps({"sentinel": compact, "full": "BENCH_r18.json"}))
         return
     if args.drain:
         # graceful drain only: chaos launches — a few minutes, own

@@ -44,6 +44,7 @@ from horovod_tpu.runtime.state import (
     drain_requested,
     ack_drain,
     drained,
+    straggler_attribution,
     ProcessSet,
     add_process_set,
     global_process_set,
@@ -347,6 +348,7 @@ __all__ = [
     "world_changed", "world_epoch", "coordinator_rank", "WorldShrunkError",
     "NumericalHealthError", "elastic",
     "request_drain", "drain_requested", "ack_drain", "drained",
+    "straggler_attribution",
     "ProcessSet", "add_process_set", "global_process_set",
     "process_set_stats",
     "allreduce", "allgather", "broadcast", "alltoall", "barrier",

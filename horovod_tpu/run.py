@@ -41,7 +41,8 @@ def _exit_code(rc: int) -> int:
 
 
 def _elastic_supervise(procs, args, first_rank, local_n, spawn,
-                       kill_all) -> int:
+                       kill_all, sentinel=None, pending_relaunch=None,
+                       spare_tokens=None, ledger_dir=None) -> int:
     """Elastic supervision: a dead worker no longer ends the job — the
     engine shrinks the world around it (and, with ``--restart N`` budget
     left, the dead slot is relaunched as a JOINER that re-enters at a
@@ -75,6 +76,34 @@ def _elastic_supervise(procs, args, first_rank, local_n, spawn,
                 live.discard(i)
                 grank = first_rank + i
                 final_rc[i] = rc
+                if rc == 0 and pending_relaunch and i in pending_relaunch:
+                    # the sentinel drained this slot (clean exit by the
+                    # drain contract); close the observe→decide→act arc
+                    # by respawning it as a joiner — from the spare pool
+                    # first, then the ordinary --restart budget
+                    pending_relaunch.discard(i)
+                    if has_rank0 and i == 0:
+                        slot0_deposed = True
+                    source = None
+                    if spare_tokens and spare_tokens[0] > 0:
+                        spare_tokens[0] -= 1
+                        source = f"spare pool ({spare_tokens[0]} left)"
+                    elif restarts_left > 0:
+                        restarts_left -= 1
+                        source = f"restart budget ({restarts_left} left)"
+                    if source is not None and len(live) + 1 <= max_np:
+                        print(f"[horovod_tpu.run] sentinel: relaunching "
+                              f"drained rank {grank} as a joiner "
+                              f"({source})", file=sys.stderr)
+                        procs[i] = spawn(i, join=True)
+                        live.add(i)
+                        if sentinel is not None:
+                            sentinel.mark_relaunched(grank)
+                    else:
+                        print(f"[horovod_tpu.run] sentinel: rank {grank} "
+                              "drained but no spare/restart capacity to "
+                              "relaunch it", file=sys.stderr)
+                    continue
                 if (has_rank0 and i == 0 and not slot0_deposed
                         and (rc == 0
                              or (not live
@@ -162,7 +191,23 @@ def _elastic_supervise(procs, args, first_rank, local_n, spawn,
                 trace_dir=args.trace_dir
                 or os.environ.get("HOROVOD_TPU_TRACE_DIR"))
             print(f"[horovod_tpu.run]   {line}", file=sys.stderr)
+            _print_ledger_tail(ledger_dir, first_rank + i)
     return job_rc
+
+
+def _print_ledger_tail(ledger_dir, rank: int) -> None:
+    """The rank's last conviction-ledger records under its post-mortem
+    line — the sentinel's verdict history is exactly the context a death
+    needs ('was this rank already convicted/draining?')."""
+    if not ledger_dir:
+        return
+    try:
+        from horovod_tpu.telemetry.ledger import tail_lines
+
+        for ln in tail_lines(ledger_dir, rank, n=3):
+            print(f"[horovod_tpu.run]     {ln}", file=sys.stderr)
+    except Exception:
+        pass  # the post-mortem itself must never crash the launcher
 
 
 def _read_bootstrap_record(boot_dir):
@@ -189,6 +234,37 @@ def _read_bootstrap_record(boot_dir):
     return None
 
 
+def _send_drain(host: str, port: int, rank: int,
+                timeout_s: float = 15.0) -> tuple[bool, str]:
+    """Send the ``DRAIN <rank>`` control frame to the job's rendezvous
+    listener and read the reply.  ``(True, reply)`` iff the coordinator
+    queued the drain (DRAIN-OK); used by both ``hvdrun --drain`` and the
+    sentinel's act path."""
+    import socket as pysock
+    import struct
+
+    def recvn(sock, n):
+        buf = b""
+        while len(buf) < n:
+            chunk = sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("connection closed mid-reply")
+            buf += chunk
+        return buf
+
+    payload = f"DRAIN {rank}".encode()
+    try:
+        with pysock.create_connection((host, port),
+                                      timeout=timeout_s) as s:
+            s.settimeout(timeout_s)
+            s.sendall(struct.pack("<Q", len(payload)) + payload)
+            (n,) = struct.unpack("<Q", recvn(s, 8))
+            reply = recvn(s, n).decode(errors="replace")
+    except (OSError, ConnectionError, struct.error) as e:
+        return False, f"unreachable at {host}:{port}: {e}"
+    return reply.startswith("DRAIN-OK"), reply
+
+
 def _drain_client(args) -> int:
     """``hvdrun --drain RANK`` (no command): ask a RUNNING elastic job to
     gracefully evict a rank.  Dials the job's rendezvous listener — the
@@ -197,9 +273,6 @@ def _drain_client(args) -> int:
     --rendezvous-port — sends the DRAIN hello, and prints the
     coordinator's reply.  Exit 0 = queued (announce/checkpoint/shrink run
     at the job's next tick boundaries), non-zero = rejected/unreachable."""
-    import socket as pysock
-    import struct
-
     host, port = None, None
     boot = os.environ.get("HOROVOD_TPU_BOOTSTRAP_DIR")
     if boot:
@@ -223,29 +296,13 @@ def _drain_client(args) -> int:
               file=sys.stderr)
         return 2
 
-    def recvn(sock, n):
-        buf = b""
-        while len(buf) < n:
-            chunk = sock.recv(n - len(buf))
-            if not chunk:
-                raise ConnectionError("connection closed mid-reply")
-            buf += chunk
-        return buf
-
-    payload = f"DRAIN {args.drain}".encode()
-    try:
-        with pysock.create_connection((host, port), timeout=15) as s:
-            s.settimeout(15)
-            s.sendall(struct.pack("<Q", len(payload)) + payload)
-            (n,) = struct.unpack("<Q", recvn(s, 8))
-            reply = recvn(s, n).decode(errors="replace")
-    except (OSError, ConnectionError, struct.error) as e:
+    ok, reply = _send_drain(host, port, args.drain)
+    if not ok and reply.startswith("unreachable"):
         print(f"[horovod_tpu.run] --drain: could not reach the job's "
-              f"rendezvous listener at {host}:{port}: {e}",
-              file=sys.stderr)
+              f"rendezvous listener: {reply}", file=sys.stderr)
         return 1
     print(f"[horovod_tpu.run] {reply}", file=sys.stderr)
-    return 0 if reply.startswith("DRAIN-OK") else 1
+    return 0 if ok else 1
 
 
 def _parse_hosts(spec: str) -> list[tuple[str, int]]:
@@ -420,6 +477,56 @@ def main(argv=None) -> int:
                     help="disable the in-band numerical-health stats "
                          "(sets HOROVOD_TPU_HEALTH=0); on by default at "
                          "<=1%% end-to-end overhead")
+    ap.add_argument("--sentinel", action="store_true",
+                    help="run the fleet sentinel next to the supervisor "
+                         "(requires --metrics-port): every "
+                         "--sentinel-interval it scrapes each rank's "
+                         "/metrics, computes windowed straggler "
+                         "attribution from the flight-recorder black "
+                         "boxes (--trace-dir), scores each rank's health "
+                         "with hysteresis, and appends convictions to "
+                         "the per-rank ledger; the scores/convictions "
+                         "are served on the aggregated /metrics page "
+                         "(watch with `python -m horovod_tpu.telemetry "
+                         "top PORT`). OBSERVE-ONLY unless --sentinel-act")
+    ap.add_argument("--sentinel-act", action="store_true",
+                    help="opt into the sentinel's ACT half (implies "
+                         "--sentinel; requires elastic mode --min-np): a "
+                         "convicted rank is gracefully drained over the "
+                         "--drain control path and its slot relaunched "
+                         "as a joiner from --spare-pool (falling back "
+                         "to the --restart budget); the ledger records "
+                         "the conviction → drain → relaunch arc")
+    ap.add_argument("--sentinel-interval", type=float, default=2.0,
+                    metavar="S", help="sentinel window period in seconds "
+                                      "(default 2)")
+    ap.add_argument("--sentinel-frac", type=float, default=None,
+                    metavar="X",
+                    help="chronic-straggler threshold: a rank charged "
+                         "more than this share of a window's critical "
+                         "path counts a strike (default 0.4)")
+    ap.add_argument("--sentinel-windows", type=int, default=None,
+                    metavar="K",
+                    help="consecutive over-threshold windows (same "
+                         "phase) before a chronic-straggler conviction "
+                         "(default 3)")
+    ap.add_argument("--sentinel-ledger", default=None, metavar="DIR",
+                    help="conviction-ledger directory (default: "
+                         "<--trace-dir>/ledger when tracing, else a "
+                         "temp dir); one append-only "
+                         "ledger.rank<r>.jsonl per rank, fsynced per "
+                         "record, surviving the job")
+    ap.add_argument("--spare-pool", type=int, default=0, metavar="N",
+                    help="launch-ready spare capacity for --sentinel-act: "
+                         "up to N convicted-and-drained slots are "
+                         "relaunched as joiners without consuming the "
+                         "--restart budget (default 0)")
+    ap.add_argument("--preempt-feed", default=None, metavar="PATH",
+                    help="watch PATH for pre-emption notices (one "
+                         "hostname per line; `rank:N` addresses one "
+                         "rank) and gracefully drain the named ranks "
+                         "before the platform kills them (implies "
+                         "--sentinel and acting)")
     ap.add_argument("--grace-period", type=float,
                     default=float(os.environ.get("HOROVOD_TPU_GRACE_S", 10)),
                     metavar="S",
@@ -459,6 +566,18 @@ def main(argv=None) -> int:
     cmd = args.command
     if cmd[0] == "--":
         cmd = cmd[1:]
+
+    sentinel_on = bool(args.sentinel or args.sentinel_act
+                       or args.preempt_feed)
+    sentinel_acting = bool(args.sentinel_act or args.preempt_feed)
+    if sentinel_on and args.metrics_port is None:
+        ap.error("--sentinel needs --metrics-port: the sentinel observes "
+                 "by scraping each rank's /metrics endpoint")
+    if (sentinel_acting and args.min_np is None
+            and not _fault.elastic_enabled()):
+        ap.error("--sentinel-act / --preempt-feed need elastic mode "
+                 "(--min-np): acting means draining a rank, which "
+                 "requires a job that can shrink")
 
     if args.hosts:
         hosts = _parse_hosts(args.hosts)
@@ -620,29 +739,107 @@ def main(argv=None) -> int:
         procs.append(_spawn(local_rank))
 
     # job-level /metrics aggregation: one scrape target at the base port,
-    # every sample re-labelled with its rank
+    # every sample re-labelled with its rank.  With --sentinel the page
+    # also carries the sentinel's hvd_sentinel_* families, and a
+    # ScrapeCache keeps serving last-known-good samples (marked stale)
+    # for a rank whose scrape times out
     aggregator = None
+    sentinel = None
+    pending_relaunch: set[int] = set()
+    spare_tokens = [max(args.spare_pool, 0)]
+    ledger_dir = args.sentinel_ledger
     if args.metrics_port is not None:
         from horovod_tpu.telemetry.httpd import (MetricsServer,
+                                                 ScrapeCache,
                                                  scrape_and_aggregate)
 
         ports = {first_rank + i: args.metrics_port + 1 + first_rank + i
                  for i in range(local_n)}
+        if sentinel_on:
+            from horovod_tpu.telemetry.sentinel import (DEFAULT_FRACTION,
+                                                        DEFAULT_WINDOWS,
+                                                        Sentinel)
+
+            if ledger_dir is None:
+                if args.trace_dir:
+                    ledger_dir = os.path.join(args.trace_dir, "ledger")
+                else:
+                    import tempfile
+
+                    ledger_dir = tempfile.mkdtemp(prefix="hvdledger-")
+            rank_hosts: dict[int, str] = {}
+            if args.hosts:
+                gr = 0
+                for host, slots in _parse_hosts(args.hosts):
+                    for _ in range(slots):
+                        if gr < args.num_proc:
+                            rank_hosts[gr] = host
+                        gr += 1
+
+            def _sentinel_act(rank, conviction):
+                # dial the LIVE coordinator — after a fail-over the
+                # rendezvous listener lives at the bootstrap record's
+                # address, not the launch-time one
+                host, p = rendezvous_host, port
+                boot = os.environ.get("HOROVOD_TPU_BOOTSTRAP_DIR")
+                rec = _read_bootstrap_record(boot) if boot else None
+                if rec is not None:
+                    _, host, p = rec
+                ok, reply = _send_drain(host, p, rank)
+                print(f"[horovod_tpu.run] sentinel: rank {rank} convicted "
+                      f"({conviction.get('reason')}) — drain: {reply}",
+                      file=sys.stderr)
+                if ok and 0 <= rank - first_rank < local_n:
+                    pending_relaunch.add(rank - first_rank)
+                return ok
+
+            sentinel = Sentinel(
+                ports, ledger_dir=ledger_dir,
+                trace_dir=args.trace_dir
+                or os.environ.get("HOROVOD_TPU_TRACE_DIR"),
+                interval_s=args.sentinel_interval,
+                fraction=(args.sentinel_frac
+                          if args.sentinel_frac is not None
+                          else DEFAULT_FRACTION),
+                windows=(args.sentinel_windows
+                         if args.sentinel_windows is not None
+                         else DEFAULT_WINDOWS),
+                act=_sentinel_act if sentinel_acting else None,
+                preempt_feed=args.preempt_feed,
+                rank_hosts=rank_hosts)
+            print(f"[horovod_tpu.run] sentinel: watching {local_n} "
+                  f"rank(s), ledger at {ledger_dir}"
+                  + (" (acting)" if sentinel_acting
+                     else " (observe-only)"), file=sys.stderr)
+            sentinel.start()
+
+        agg_cache = ScrapeCache()
+
+        def _agg_page():
+            page = scrape_and_aggregate(ports, cache=agg_cache)
+            if sentinel is not None:
+                page += sentinel.registry.to_prometheus()
+            return page
+
         try:
-            aggregator = MetricsServer(
-                args.metrics_port,
-                aggregate=lambda: scrape_and_aggregate(ports))
+            aggregator = MetricsServer(args.metrics_port,
+                                       aggregate=_agg_page)
         except OSError as e:
             print(f"[horovod_tpu.run] /metrics aggregator disabled: {e}",
                   file=sys.stderr)
 
     try:
         if elastic:
-            return _elastic_supervise(procs, args, first_rank, local_n,
-                                      _spawn, _kill_all)
+            return _elastic_supervise(
+                procs, args, first_rank, local_n, _spawn, _kill_all,
+                sentinel=sentinel, pending_relaunch=pending_relaunch,
+                spare_tokens=spare_tokens, ledger_dir=ledger_dir)
     finally:
-        if elastic and aggregator is not None:
-            aggregator.stop()
+        if elastic:
+            if sentinel is not None:
+                sentinel.stop()
+            if aggregator is not None:
+                aggregator.stop()
         if boot_dir_created:
             import shutil
 
@@ -685,6 +882,8 @@ def main(argv=None) -> int:
                 time.sleep(0.05)
     finally:
         _kill_all()
+        if sentinel is not None:
+            sentinel.stop()
         if aggregator is not None:
             aggregator.stop()
         if failed:
@@ -704,6 +903,7 @@ def main(argv=None) -> int:
                     trace_dir=args.trace_dir
                     or os.environ.get("HOROVOD_TPU_TRACE_DIR"))
                 print(f"[horovod_tpu.run]   {line}", file=sys.stderr)
+                _print_ledger_tail(ledger_dir, first_rank + i)
     return exit_code
 
 

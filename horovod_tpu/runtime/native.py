@@ -833,7 +833,8 @@ class NativeEngine(Engine):
                      "pack_bytes": 0, "world_changes": 0, "rank_joins": 0,
                      "coord_failovers": 0, "arb_requests": 0,
                      "arb_link_verdicts": 0, "arb_dead_verdicts": 0,
-                     "drains": 0}
+                     "drains": 0, "trace_events": 0,
+                     "trace_events_dropped": 0}
         # per-stripe tx bytes: one labelled counter per stripe index
         stripe_seen = [0] * 8
         # per-process-set counters: one labelled series per set id
@@ -865,6 +866,8 @@ class NativeEngine(Engine):
             ("arb_link_verdicts", telemetry.NATIVE_ARB_LINK_VERDICTS),
             ("arb_dead_verdicts", telemetry.NATIVE_ARB_DEAD_VERDICTS),
             ("drains", telemetry.NATIVE_DRAINS),
+            ("trace_events", telemetry.NATIVE_TRACE_EVENTS),
+            ("trace_events_dropped", telemetry.NATIVE_TRACE_DROPPED),
         )
         # the FAULT counters are process-wide by design (fault.h: they
         # survive engine re-init like the registry does) — seed their
@@ -899,6 +902,16 @@ class NativeEngine(Engine):
             drain_now = {"drains": 0, "drain_latency_ns": 0}
         last_seen["drains"] = drain_now["drains"]
         drain_seen = [drain_now["drain_latency_ns"], drain_now["drains"]]
+        # flight-recorder counters: a file-backed ring (black-box mode)
+        # carries its totals across engine re-inits in this process, so
+        # seed from current like the other process-wide families
+        try:
+            trace_now = self.trace_stats()
+        except AttributeError:  # scripted test engines carry no _lib
+            trace_now = {}
+        last_seen["trace_events"] = trace_now.get("trace_events", 0)
+        last_seen["trace_events_dropped"] = trace_now.get(
+            "trace_events_dropped", 0)
         # per-stage cumulative (ns, item count) at last collection: each
         # collection observes the mean per-item stage latency of the
         # window into the stage histogram

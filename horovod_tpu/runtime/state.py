@@ -318,6 +318,30 @@ def ack_drain() -> bool:
     return eng.ack_drain()
 
 
+def straggler_attribution() -> dict | None:
+    """Cross-rank straggler attribution from the flight-recorder black
+    boxes (``HOROVOD_TPU_TRACE_DIR``): ``{"rows": [{rank, phase,
+    fraction, excess_ns}, ...], "critical_path_ns": ...}`` — the same
+    document ``python -m horovod_tpu.telemetry trace --json`` and the
+    fleet sentinel score from.  Pure file reads (any rank, or no rank at
+    all, can call it); None when tracing is off or no readable black box
+    exists yet."""
+    import os as _os
+
+    trace_dir = _os.environ.get("HOROVOD_TPU_TRACE_DIR")
+    if not trace_dir:
+        return None
+    from horovod_tpu.telemetry import trace as _ftrace
+
+    try:
+        docs = _ftrace.load_dir(trace_dir)
+    except FileNotFoundError:
+        return None
+    if not docs:
+        return None
+    return _ftrace.attribution(_ftrace.merge(docs))
+
+
 def drained() -> bool:
     """True once this rank's planned eviction committed and the engine
     stopped cleanly — the drained rank should exit 0."""

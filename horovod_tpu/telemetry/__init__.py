@@ -145,6 +145,34 @@ NATIVE_DRAINS = "hvd_drains_total"
 NATIVE_DRAIN_LATENCY = "hvd_drain_latency_seconds"
 NATIVE_COORD_GENERATION = "hvd_coord_generation"
 
+# flight-recorder progress mirror: counted events written/dropped by the
+# per-rank black box — the per-rank progress signal the fleet sentinel
+# scores against (a rank whose event counter stops moving while peers'
+# advance is wedged, whatever its heartbeat says)
+NATIVE_TRACE_EVENTS = "hvd_trace_events_total"
+NATIVE_TRACE_DROPPED = "hvd_trace_events_dropped_total"
+
+# fleet sentinel (launcher-side observe→decide→act loop): rolling health
+# score and this window's worst straggler share per rank, convictions by
+# (rank, reason), policy acts by action, the scrape-loop window counter,
+# and an info-style gauge carrying each rank's last flight-recorder phase
+# so `telemetry top` renders phases from the aggregated page alone
+SENTINEL_SCORE = "hvd_sentinel_score"
+SENTINEL_STRAGGLER_EXCESS = "hvd_sentinel_straggler_fraction"
+SENTINEL_CONVICTIONS = "hvd_sentinel_convictions_total"
+SENTINEL_ACTS = "hvd_sentinel_acts_total"
+SENTINEL_WINDOWS = "hvd_sentinel_windows_total"
+SENTINEL_LAST_PHASE = "hvd_sentinel_last_phase"
+
+# hvdrun aggregator self-metrics: per-rank scrape liveness, the age of
+# the freshest page the aggregator holds for each rank, and whether the
+# served samples are a stale last-known-good snapshot (a rank whose
+# scrape times out keeps its series on the page, marked, instead of
+# vanishing mid-incident)
+HVDRUN_RANK_UP = "hvdrun_rank_up"
+HVDRUN_SCRAPE_AGE = "hvdrun_scrape_age_seconds"
+HVDRUN_SCRAPE_STALE = "hvdrun_scrape_stale"
+
 # process sets (wire v8): registered-set count, plus per-set counters
 # labeled with set="<id>" (the global set is set 0) — collectives run,
 # payload bytes moved, and this rank's steady-state cache lookups, so two
@@ -464,6 +492,10 @@ __all__ = [
     "NATIVE_COORD_FAILOVER_LATENCY", "NATIVE_ARB_REQUESTS",
     "NATIVE_ARB_LINK_VERDICTS", "NATIVE_ARB_DEAD_VERDICTS",
     "NATIVE_DRAINS", "NATIVE_DRAIN_LATENCY", "NATIVE_COORD_GENERATION",
+    "NATIVE_TRACE_EVENTS", "NATIVE_TRACE_DROPPED",
+    "SENTINEL_SCORE", "SENTINEL_STRAGGLER_EXCESS", "SENTINEL_CONVICTIONS",
+    "SENTINEL_ACTS", "SENTINEL_WINDOWS", "SENTINEL_LAST_PHASE",
+    "HVDRUN_RANK_UP", "HVDRUN_SCRAPE_AGE", "HVDRUN_SCRAPE_STALE",
     "NATIVE_PROCESS_SETS", "NATIVE_PSET_COLLECTIVES", "NATIVE_PSET_BYTES",
     "NATIVE_PSET_CACHE_HITS", "NATIVE_PSET_OP_COLLECTIVES",
     "NATIVE_PSET_OP_BYTES", "NATIVE_SHM_POISONS",

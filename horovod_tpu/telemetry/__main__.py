@@ -62,6 +62,19 @@ def main(argv: list[str] | None = None) -> int:
     ap_tr.add_argument("--json", action="store_true",
                        help="emit attribution + counted series as JSON")
 
+    ap_top = sub.add_parser(
+        "top", help="live fleet dashboard over hvdrun's aggregated "
+                    "/metrics page: per-rank sentinel score, last phase, "
+                    "heartbeat age, wire MB/s, refreshed in place")
+    ap_top.add_argument("target",
+                        help="aggregator port, host:port, or full URL "
+                             "(the hvdrun --metrics-port base port)")
+    ap_top.add_argument("--interval", type=float, default=2.0,
+                        help="refresh period in seconds (default 2)")
+    ap_top.add_argument("--once", action="store_true",
+                        help="print a single frame and exit (scripts, "
+                             "tests)")
+
     ap_he = sub.add_parser(
         "health", help="cross-rank numerical-health report over per-rank "
                        "metric dumps (first NaN, norm spikes, SDC audit "
@@ -80,6 +93,14 @@ def main(argv: list[str] | None = None) -> int:
         return _trace_cmd(args)
     if args.cmd == "health":
         return _health_cmd(args)
+    if args.cmd == "top":
+        from horovod_tpu.telemetry import top as ftop
+
+        try:
+            return ftop.run(args.target, interval_s=args.interval,
+                            once=args.once)
+        except KeyboardInterrupt:
+            return 0
 
     if args.cmd == "summarize":
         try:

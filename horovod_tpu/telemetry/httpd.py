@@ -123,13 +123,52 @@ def relabel(text: str, rank: int) -> str:
     return "\n".join(out)
 
 
+class ScrapeCache:
+    """Last-known-good relabelled page per rank, for the launcher
+    aggregator: a rank whose scrape times out mid-incident keeps its
+    series on the page (marked stale, with its age) instead of vanishing
+    — exactly when an operator is staring at the dashboard asking what
+    that rank was doing.  Thread-safe: the aggregator renders from HTTP
+    handler threads."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._pages: dict[int, tuple[str, float]] = {}
+
+    def store(self, rank: int, page: str) -> None:
+        with self.lock():
+            self._pages[rank] = (page, time.monotonic())
+
+    def get(self, rank: int) -> tuple[str, float] | None:
+        """(page, age_seconds) or None when the rank never answered."""
+        with self.lock():
+            entry = self._pages.get(rank)
+        if entry is None:
+            return None
+        return entry[0], max(time.monotonic() - entry[1], 0.0)
+
+    def drop(self, rank: int) -> None:
+        """Forget a permanently-evicted rank so its frozen series leave
+        the page once the launcher stops listing it."""
+        with self.lock():
+            self._pages.pop(rank, None)
+
+    def lock(self):
+        return self._lock
+
+
 def scrape_and_aggregate(ports_by_rank: dict[int, int],
-                         timeout_s: float = 2.0) -> str:
+                         timeout_s: float = 2.0,
+                         cache: ScrapeCache | None = None) -> str:
     """Fetch every rank's ``/metrics`` (concurrently — a straggler hunt
     usually starts exactly when some rank is sick, and serial timeouts
     would stack) and join them into one page with a ``rank`` label per
     sample.  Ranks that don't answer (dead, not up yet) are reported
-    through ``hvdrun_rank_up`` instead of failing the scrape."""
+    through ``hvdrun_rank_up`` instead of failing the scrape; with a
+    :class:`ScrapeCache` the last-known-good samples keep being served
+    for them, marked via ``hvdrun_scrape_stale{rank=}``, and every
+    served rank carries ``hvdrun_scrape_age_seconds{rank=}`` (0 for a
+    fresh page, the cache age for a stale one)."""
     from concurrent.futures import ThreadPoolExecutor
 
     def fetch(item):
@@ -144,8 +183,20 @@ def scrape_and_aggregate(ports_by_rank: dict[int, int],
     items = sorted(ports_by_rank.items())
     with ThreadPoolExecutor(max_workers=min(len(items), 16) or 1) as ex:
         fetched = list(ex.map(fetch, items))
-    pages = [page for _, page in fetched if page is not None]
     up = {rank: int(page is not None) for rank, page in fetched}
+    pages, ages, stales = [], {}, {}
+    for rank, page in fetched:
+        if page is not None:
+            if cache is not None:
+                cache.store(rank, page)
+            pages.append(page)
+            ages[rank], stales[rank] = 0.0, 0
+            continue
+        entry = cache.get(rank) if cache is not None else None
+        if entry is not None:
+            cached_page, age = entry
+            pages.append(cached_page)
+            ages[rank], stales[rank] = age, 1
     # family grouping: exposition format wants all samples of one metric
     # contiguous — re-group the concatenated pages by SAMPLE name.  A
     # histogram's samples (name_bucket/_sum/_count) must sit under the
@@ -170,6 +221,12 @@ def scrape_and_aggregate(ports_by_rank: dict[int, int],
     lines = ["# TYPE hvdrun_rank_up gauge"]
     lines += [f'hvdrun_rank_up{{rank="{r}"}} {v}'
               for r, v in sorted(up.items())]
+    lines.append("# TYPE hvdrun_scrape_age_seconds gauge")
+    lines += [f'hvdrun_scrape_age_seconds{{rank="{r}"}} {ages[r]:.3f}'
+              for r in sorted(ages)]
+    lines.append("# TYPE hvdrun_scrape_stale gauge")
+    lines += [f'hvdrun_scrape_stale{{rank="{r}"}} {stales[r]}'
+              for r in sorted(stales)]
     typed: set[str] = set()
     for name in sorted(families, key=lambda n: (base_family(n), n)):
         base = base_family(name)

@@ -1,0 +1,116 @@
+"""Per-rank conviction ledger: the sentinel's durable memory.
+
+One append-only JSONL file per rank (``ledger.rank<r>.jsonl``), each line
+one observation, conviction, or act record.  The format is deliberately
+the dumbest durable thing that works: a line is written with ``\\n`` and
+fsynced before ``append`` returns, so a launcher crash (or the operator's
+ctrl-C) never loses an already-recorded verdict, and any half-written
+tail line is skipped by the reader instead of poisoning the file.  The
+ledger outlives the job — post-mortems and the next incarnation of the
+sentinel read the same files.
+
+Record kinds (the ``kind`` field; everything else is evidence):
+
+* ``observe`` — one scoring window: health score, the window's straggler
+  attribution share, heartbeat age, scrape liveness.  Written only when
+  something is non-trivial (score below 100 or liveness changed) so a
+  healthy fleet's ledger stays near-empty.
+* ``conviction`` — the scorer crossed a hysteresis threshold: ``reason``
+  is ``chronic-straggler`` / ``sdc`` / ``flapping-link`` /
+  ``preempt-feed``, with the evidence that convicted (phase, fraction,
+  consecutive windows, audit verdict, ...).
+* ``act`` — the policy half did something: ``action`` is ``drain``
+  (control frame sent), ``relaunch`` (slot respawned as a joiner), or
+  ``drain-failed``; together with the conviction that triggered it the
+  three records ARE the observe→decide→act arc.
+* ``event`` — a fleet event the sentinel witnessed (world size change,
+  fail-over, drain counted by the engine) — context lines for the tail.
+
+Pure stdlib; readable by anything that can read JSON lines.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import time
+
+
+def _rank_of(path: str) -> int:
+    m = re.search(r"ledger\.rank(\d+)\.jsonl$", os.path.basename(path))
+    return int(m.group(1)) if m else -1
+
+
+class Ledger:
+    """Append-only JSONL writer/reader over a directory of per-rank files."""
+
+    def __init__(self, directory: str) -> None:
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+
+    def path(self, rank: int) -> str:
+        return os.path.join(self.directory, f"ledger.rank{rank}.jsonl")
+
+    def append(self, rank: int, record: dict) -> dict:
+        """Write one record (stamping ``t`` unix seconds when absent) and
+        fsync it — a conviction that was reported must survive the
+        launcher dying the next instant."""
+        rec = dict(record)
+        rec.setdefault("t", round(time.time(), 3))
+        line = json.dumps(rec, separators=(",", ":"), sort_keys=True)
+        with open(self.path(rank), "a") as f:
+            f.write(line + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        return rec
+
+    def read(self, rank: int) -> list[dict]:
+        """Every intact record for a rank, oldest first.  A torn tail
+        line (killed mid-append on a filesystem without atomic small
+        appends) is skipped, not raised."""
+        out: list[dict] = []
+        try:
+            with open(self.path(rank)) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        out.append(json.loads(line))
+                    except ValueError:
+                        continue
+        except OSError:
+            pass
+        return out
+
+    def tail(self, rank: int, n: int = 5) -> list[dict]:
+        return self.read(rank)[-max(n, 0):]
+
+    def ranks(self) -> list[int]:
+        """Ranks with a ledger file, sorted."""
+        return sorted(
+            r for r in (_rank_of(p) for p in glob.glob(
+                os.path.join(self.directory, "ledger.rank*.jsonl")))
+            if r >= 0)
+
+
+def tail_lines(directory: str, rank: int, n: int = 3) -> list[str]:
+    """The last ``n`` ledger records for a rank, formatted one-per-line
+    for hvdrun's post-mortem (empty when the rank has no ledger).  The
+    interesting fields go first so the line reads as a verdict even when
+    truncated by a narrow terminal."""
+    out = []
+    for rec in Ledger(directory).tail(rank, n):
+        kind = rec.get("kind", "?")
+        bits = [f"ledger[{kind}]"]
+        for key in ("reason", "action", "score", "phase", "fraction",
+                    "windows", "event", "detail"):
+            if key in rec:
+                bits.append(f"{key}={rec[key]}")
+        when = rec.get("t")
+        if when is not None:
+            bits.append(time.strftime("%H:%M:%S", time.localtime(when)))
+        out.append(" ".join(str(b) for b in bits))
+    return out

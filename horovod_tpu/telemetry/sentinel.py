@@ -1,0 +1,449 @@
+"""Fleet sentinel: the launcher-side observe→decide(→act) loop.
+
+Every sensor this framework grew — flight-recorder straggler attribution,
+the SDC audit's named suspects, arbitration verdicts, live per-rank
+/metrics — and every actuator (graceful drain, joiner admission) existed
+as disconnected parts; this module is the connective tissue.  ``hvdrun
+--sentinel`` runs one :class:`Sentinel` next to the supervision loop:
+
+* **observe** — each window it scrapes every rank's /metrics endpoint and
+  re-reads the flight-recorder black boxes, computing a *windowed*
+  straggler attribution (only collectives that finished since the last
+  window, via an end-timestamp watermark — so a rank that was slow an
+  hour ago but recovered stops accruing blame immediately).
+* **decide** — a rolling health score per rank with hysteresis::
+
+      score = 100 - min(100·f_w, 60) - 10·min(c, K) - 40·convicted
+
+  where ``f_w`` is the rank's worst per-phase share of this window's
+  critical path, ``c`` its consecutive windows over the ``frac``
+  threshold, and ``convicted`` a latch.  Convictions (the hysteresis
+  edges): *chronic-straggler* = top attribution share > ``frac`` for
+  ``windows`` consecutive windows; *sdc* = the checksum audit named the
+  rank (any ``hvd_audit_mismatches_total`` > 0 with a suspect);
+  *flapping-link* = a rank's ``hvd_arbitration_link_verdicts_total``
+  grew in ``flap`` distinct windows (its link keeps going suspect and
+  coming back — the classic bad-cable signature).  A rank scores 0
+  while its scrape endpoint is down.
+* **act** (opt-in) — a conviction triggers the launcher's ``act``
+  callback exactly once per incarnation: hvdrun drains the rank over the
+  existing control frame and relaunches the slot as a joiner; the ledger
+  records the full conviction → drain → relaunch arc.
+
+Everything the sentinel learns lands in three places: the per-rank
+conviction ledger (:mod:`horovod_tpu.telemetry.ledger`), the
+``hvd_sentinel_*`` metric families on the launcher's aggregated /metrics
+page, and — via that page — ``python -m horovod_tpu.telemetry top``.
+
+The sentinel is a pure observer on the data plane: it speaks HTTP to
+scrape endpoints and reads local files, so sentinel-on vs sentinel-off
+moves ZERO control- or data-plane bytes between ranks (BENCH_r18 gates
+the counted ratio at exactly 1.0).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import threading
+import time
+import urllib.request
+
+from horovod_tpu.telemetry import (
+    SENTINEL_ACTS,
+    SENTINEL_CONVICTIONS,
+    SENTINEL_LAST_PHASE,
+    SENTINEL_SCORE,
+    SENTINEL_STRAGGLER_EXCESS,
+    SENTINEL_WINDOWS,
+    MetricsRegistry,
+)
+from horovod_tpu.telemetry import trace as ftrace
+from horovod_tpu.telemetry.health import AUDIT_LAST_BAD_RANK, AUDIT_MISMATCHES
+from horovod_tpu.telemetry.ledger import Ledger
+
+# decision defaults: X (critical-path share), K (consecutive windows),
+# F (distinct windows with fresh link verdicts)
+DEFAULT_FRACTION = 0.4
+DEFAULT_WINDOWS = 3
+DEFAULT_FLAP = 3
+DEFAULT_INTERVAL_S = 2.0
+
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[A-Za-z_:][A-Za-z0-9_:]*)"
+    r"(?:\{(?P<labels>[^}]*)\})?\s+(?P<value>\S+)$")
+_LABEL_RE = re.compile(r'(\w+)="([^"]*)"')
+
+
+def parse_prom(text: str) -> dict[str, list[tuple[dict, float]]]:
+    """Prometheus text → ``{family: [(labels, value), ...]}``.  Comments
+    and malformed lines are skipped; histogram suffixes stay suffixed."""
+    out: dict[str, list[tuple[dict, float]]] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            continue
+        try:
+            value = float(m.group("value"))
+        except ValueError:
+            continue
+        labels = dict(_LABEL_RE.findall(m.group("labels") or ""))
+        out.setdefault(m.group("name"), []).append((labels, value))
+    return out
+
+
+def _first(doc: dict, name: str, default=None):
+    rows = doc.get(name)
+    return rows[0][1] if rows else default
+
+
+class HealthScorer:
+    """Rolling per-rank scores + hysteresis convictions (pure logic, no
+    I/O — unit-testable without a job)."""
+
+    def __init__(self, fraction: float = DEFAULT_FRACTION,
+                 windows: int = DEFAULT_WINDOWS,
+                 flap: int = DEFAULT_FLAP) -> None:
+        self.fraction = float(fraction)
+        self.windows = max(int(windows), 1)
+        self.flap = max(int(flap), 1)
+        self._consec: dict[int, int] = {}
+        self._consec_phase: dict[int, str] = {}
+        self._link_seen: dict[int, float] = {}
+        self._flap_windows: dict[int, int] = {}
+        self._convicted: dict[int, dict] = {}  # rank -> conviction record
+        self._sdc_seen = 0.0
+
+    def convicted(self, rank: int) -> dict | None:
+        return self._convicted.get(rank)
+
+    def clear(self, rank: int) -> None:
+        """Forget a rank's record — called when its slot relaunches (a
+        fresh incarnation starts innocent)."""
+        self._consec.pop(rank, None)
+        self._consec_phase.pop(rank, None)
+        self._flap_windows.pop(rank, None)
+        self._convicted.pop(rank, None)
+
+    def observe(self, window: dict) -> tuple[dict[int, float], list[dict]]:
+        """One window: ``{ranks, up, attribution, audit_mismatches,
+        audit_bad_rank, link_verdicts_by_rank, heartbeat_age_by_rank}``
+        → ``(score_by_rank, new_convictions)``."""
+        ranks = list(window.get("ranks", ()))
+        up = window.get("up", {})
+        att_rows = (window.get("attribution") or {}).get("rows") or []
+        convictions: list[dict] = []
+
+        # worst per-phase share of this window's critical path, per rank
+        worst: dict[int, tuple[float, str]] = {}
+        for row in att_rows:
+            rk, frac = int(row["rank"]), float(row["fraction"])
+            if frac > worst.get(rk, (0.0, ""))[0]:
+                worst[rk] = (frac, str(row["phase"]))
+
+        for rk in ranks:
+            frac, phase = worst.get(rk, (0.0, ""))
+            if frac > self.fraction:
+                same = self._consec_phase.get(rk) in ("", None, phase)
+                self._consec[rk] = (self._consec.get(rk, 0) + 1
+                                    if same else 1)
+                self._consec_phase[rk] = phase
+            else:
+                self._consec[rk] = 0
+                self._consec_phase.pop(rk, None)
+            if (self._consec.get(rk, 0) >= self.windows
+                    and rk not in self._convicted):
+                convictions.append({
+                    "kind": "conviction", "reason": "chronic-straggler",
+                    "rank": rk, "phase": self._consec_phase.get(rk, ""),
+                    "fraction": frac,
+                    "windows": self._consec[rk]})
+
+        # SDC: the audit's named suspect convicts immediately (no
+        # hysteresis — one verdict is already cross-rank corroborated)
+        mism = float(window.get("audit_mismatches") or 0)
+        bad = window.get("audit_bad_rank")
+        if mism > self._sdc_seen:
+            self._sdc_seen = mism
+            if (bad is not None and int(bad) >= 0
+                    and int(bad) not in self._convicted):
+                convictions.append({
+                    "kind": "conviction", "reason": "sdc",
+                    "rank": int(bad), "mismatches": mism})
+
+        # flapping link: fresh link-only arbitration verdicts on the same
+        # rank across `flap` distinct windows
+        for rk, now_v in (window.get("link_verdicts_by_rank") or {}).items():
+            rk = int(rk)
+            if now_v > self._link_seen.get(rk, 0.0):
+                self._link_seen[rk] = now_v
+                self._flap_windows[rk] = self._flap_windows.get(rk, 0) + 1
+                if (self._flap_windows[rk] >= self.flap
+                        and rk not in self._convicted):
+                    convictions.append({
+                        "kind": "conviction", "reason": "flapping-link",
+                        "rank": rk,
+                        "flap_windows": self._flap_windows[rk],
+                        "link_verdicts": now_v})
+
+        for c in convictions:
+            self._convicted[c["rank"]] = c
+
+        hb = window.get("heartbeat_age_by_rank") or {}
+        interval = float(window.get("interval_s") or DEFAULT_INTERVAL_S)
+        scores: dict[int, float] = {}
+        for rk in ranks:
+            if not up.get(rk, False):
+                scores[rk] = 0.0
+                continue
+            frac, _ = worst.get(rk, (0.0, ""))
+            s = 100.0
+            s -= min(100.0 * frac, 60.0)
+            s -= 10.0 * min(self._consec.get(rk, 0), self.windows)
+            if rk in self._convicted:
+                s -= 40.0
+            if hb.get(rk, 0.0) > 5.0 * interval:
+                s -= 20.0
+            scores[rk] = max(round(s, 1), 0.0)
+        return scores, convictions
+
+
+class Sentinel:
+    """The scrape loop: glue between scraping, scoring, the ledger, the
+    metric families, and the launcher's act callback."""
+
+    def __init__(self, ports_by_rank: dict[int, int], *, ledger_dir: str,
+                 trace_dir: str | None = None,
+                 interval_s: float = DEFAULT_INTERVAL_S,
+                 fraction: float = DEFAULT_FRACTION,
+                 windows: int = DEFAULT_WINDOWS, flap: int = DEFAULT_FLAP,
+                 registry: MetricsRegistry | None = None,
+                 act=None, preempt_feed: str | None = None,
+                 rank_hosts: dict[int, str] | None = None,
+                 scrape_timeout_s: float = 1.0) -> None:
+        self.ports = dict(ports_by_rank)
+        self.trace_dir = trace_dir
+        self.interval_s = max(float(interval_s), 0.1)
+        self.ledger = Ledger(ledger_dir)
+        self.scorer = HealthScorer(fraction, windows, flap)
+        self.registry = registry if registry is not None \
+            else MetricsRegistry()
+        self._act = act  # act(rank, conviction) -> bool, launcher-provided
+        self._acted: set[int] = set()
+        self._preempt_feed = preempt_feed
+        self._feed_seen: set[str] = set()
+        self._rank_hosts = dict(rank_hosts or {})
+        self._scrape_timeout = float(scrape_timeout_s)
+        self._watermark_ns = 0
+        self._last_phase: dict[int, str] = {}
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.windows_run = 0
+
+    # -- observe -----------------------------------------------------------
+    def _scrape(self) -> tuple[dict[int, dict], dict[int, bool]]:
+        from concurrent.futures import ThreadPoolExecutor
+
+        def fetch(item):
+            rank, port = item
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/metrics",
+                        timeout=self._scrape_timeout) as r:
+                    return rank, parse_prom(r.read().decode())
+            except Exception:
+                return rank, None
+        items = sorted(self.ports.items())
+        if not items:
+            return {}, {}
+        with ThreadPoolExecutor(max_workers=min(len(items), 16)) as ex:
+            fetched = list(ex.map(fetch, items))
+        docs = {rk: doc for rk, doc in fetched if doc is not None}
+        up = {rk: doc is not None for rk, doc in fetched}
+        return docs, up
+
+    def _windowed_attribution(self) -> dict | None:
+        """Attribution over ONLY the collectives that finished since the
+        last window (end-timestamp watermark) — the rolling view the
+        chronic-straggler hysteresis needs.  None when the recorder is
+        off or no rank has produced a readable black box yet."""
+        if not self.trace_dir:
+            return None
+        try:
+            docs = ftrace.load_dir(self.trace_dir)
+        except FileNotFoundError:
+            return None
+        if not docs:
+            return None
+        merged = ftrace.merge(docs)
+        fresh = {key: c for key, c in merged["collectives"].items()
+                 if c["end"] is not None and c["end"] > self._watermark_ns}
+        if fresh:
+            self._watermark_ns = max(c["end"] for c in fresh.values())
+        sub = {"collectives": fresh, "ranks": merged["ranks"],
+               "epoch_by_rank": merged["epoch_by_rank"]}
+        att = ftrace.attribution(sub)
+        att["last_phase_by_rank"] = {
+            d["rank"]: (ftrace.last_phase(d) or ("n/a", {}))[0]
+            for d in docs}
+        return att
+
+    # -- the window --------------------------------------------------------
+    def step(self) -> dict:
+        """One observe→decide(→act) window; returns the window summary
+        (what tests and ``--sentinel`` verbose logging consume)."""
+        docs, up = self._scrape()
+        att = self._windowed_attribution()
+        window = {
+            "ranks": sorted(self.ports),
+            "up": up,
+            "attribution": att,
+            "interval_s": self.interval_s,
+            "audit_mismatches": max(
+                [_first(d, AUDIT_MISMATCHES, 0.0) for d in docs.values()],
+                default=0.0),
+            "audit_bad_rank": max(
+                [_first(d, AUDIT_LAST_BAD_RANK, -1.0)
+                 for d in docs.values()], default=-1.0),
+            "link_verdicts_by_rank": {
+                rk: _first(d, "hvd_arbitration_link_verdicts_total", 0.0)
+                for rk, d in docs.items()},
+            "heartbeat_age_by_rank": {
+                rk: _first(d, "hvd_heartbeat_age_s", 0.0)
+                for rk, d in docs.items()},
+        }
+        scores, convictions = self.scorer.observe(window)
+        self.windows_run += 1
+        self.registry.counter(SENTINEL_WINDOWS).inc()
+
+        for rk, score in scores.items():
+            self.registry.gauge(SENTINEL_SCORE, rank=str(rk)).set(score)
+            frac = 0.0
+            for row in (att or {}).get("rows") or []:
+                if int(row["rank"]) == rk:
+                    frac = max(frac, float(row["fraction"]))
+            self.registry.gauge(
+                SENTINEL_STRAGGLER_EXCESS, rank=str(rk)).set(frac)
+            # observe records only when the window says something
+            if score < 100.0:
+                self.ledger.append(rk, {
+                    "kind": "observe", "score": score, "fraction": frac,
+                    "up": bool(up.get(rk, False)),
+                    "window": self.windows_run})
+        for rk, phase in ((att or {}).get("last_phase_by_rank")
+                          or {}).items():
+            prev = self._last_phase.get(rk)
+            if prev is not None and prev != phase:
+                self.registry.gauge(SENTINEL_LAST_PHASE, rank=str(rk),
+                                    phase=prev).set(0)
+            self._last_phase[rk] = phase
+            self.registry.gauge(SENTINEL_LAST_PHASE, rank=str(rk),
+                                phase=phase).set(1)
+
+        feed_convictions = self._check_preempt_feed()
+        all_new = convictions + feed_convictions
+        for conv in all_new:
+            rk = conv["rank"]
+            self.ledger.append(rk, conv)
+            self.registry.counter(SENTINEL_CONVICTIONS, rank=str(rk),
+                                  reason=conv["reason"]).inc()
+            self._maybe_act(rk, conv)
+        return {"scores": scores, "convictions": all_new,
+                "attribution": att, "up": up,
+                "window": self.windows_run}
+
+    def _check_preempt_feed(self) -> list[dict]:
+        """New lines in the preemption feed (one hostname per line;
+        ``rank:N`` addresses a single rank on single-host jobs where one
+        hostname covers the whole world) → preempt-feed convictions."""
+        path = self._preempt_feed
+        if not path or not os.path.exists(path):
+            return []
+        try:
+            with open(path) as f:
+                lines = [ln.strip() for ln in f if ln.strip()]
+        except OSError:
+            return []
+        out = []
+        for line in lines:
+            if line in self._feed_seen or line.startswith("#"):
+                continue
+            self._feed_seen.add(line)
+            if line.startswith("rank:"):
+                try:
+                    targets = [int(line.split(":", 1)[1])]
+                except ValueError:
+                    continue
+            else:
+                targets = [rk for rk in sorted(self.ports)
+                           if self._rank_hosts.get(
+                               rk, "127.0.0.1") == line]
+            for rk in targets:
+                if self.scorer.convicted(rk):
+                    continue
+                conv = {"kind": "conviction", "reason": "preempt-feed",
+                        "rank": rk, "detail": line}
+                self.scorer._convicted[rk] = conv
+                out.append(conv)
+        return out
+
+    # -- act ---------------------------------------------------------------
+    def _maybe_act(self, rank: int, conviction: dict) -> None:
+        if self._act is None or rank in self._acted:
+            return
+        self._acted.add(rank)
+        try:
+            ok = bool(self._act(rank, conviction))
+        except Exception as exc:  # the loop must survive a failed act
+            ok = False
+            self.ledger.append(rank, {
+                "kind": "act", "action": "drain-failed",
+                "detail": f"{type(exc).__name__}: {exc}"[:200]})
+        if ok:
+            self.record_act(rank, "drain",
+                            detail=f"reason={conviction['reason']}")
+        elif rank in self._acted:
+            self.registry.counter(SENTINEL_ACTS,
+                                  action="drain-failed").inc()
+
+    def record_act(self, rank: int, action: str, detail: str = "") -> None:
+        """Ledger + metrics entry for a policy action; the launcher calls
+        this for the relaunch half it performs itself."""
+        self.ledger.append(rank, {"kind": "act", "action": action,
+                                  "detail": detail})
+        self.registry.counter(SENTINEL_ACTS, action=action).inc()
+
+    def mark_relaunched(self, rank: int) -> None:
+        """A convicted slot came back as a joiner: record the act, clear
+        the conviction latch, and allow future convictions to act again
+        (the new incarnation starts innocent)."""
+        self.record_act(rank, "relaunch", detail="joiner respawned")
+        self.scorer.clear(rank)
+        self._acted.discard(rank)
+
+    def acted_on(self, rank: int) -> bool:
+        return rank in self._acted
+
+    # -- loop --------------------------------------------------------------
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._loop, name="hvdtpu-sentinel", daemon=True)
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.step()
+            except Exception:
+                pass  # an observer crash must never take the job down
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

@@ -1,0 +1,160 @@
+"""``python -m horovod_tpu.telemetry top`` — live fleet dashboard.
+
+One scrape target, one screen: the launcher's aggregated /metrics page
+(``hvdrun --metrics-port P`` serves it at P) already carries every
+rank-labelled sample plus the sentinel's score/conviction families, so
+the dashboard needs no job-side cooperation — point it at the port and
+it renders a per-rank table (health score, this window's straggler
+share, convictions, last flight-recorder phase, heartbeat age, wire
+MB/s, scrape freshness), refreshed in place.
+
+Wire MB/s is computed dashboard-side from successive scrapes of the
+``hvd_ring_bytes_total`` counter — a rate needs two samples, so the
+first frame shows ``-``.  Pure stdlib; works against any job, sentinel
+on or off (sentinel-only columns show ``-`` when the families are
+absent).
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+import urllib.request
+
+from horovod_tpu.telemetry import (
+    HVDRUN_RANK_UP,
+    HVDRUN_SCRAPE_AGE,
+    HVDRUN_SCRAPE_STALE,
+    NATIVE_HEARTBEAT_AGE,
+    NATIVE_RING_BYTES,
+    SENTINEL_CONVICTIONS,
+    SENTINEL_LAST_PHASE,
+    SENTINEL_SCORE,
+    SENTINEL_STRAGGLER_EXCESS,
+    SENTINEL_WINDOWS,
+)
+
+_CLEAR = "\x1b[H\x1b[2J"  # cursor home + clear screen
+
+
+def resolve_url(target: str) -> str:
+    """``8000`` → the local aggregator; ``host:port`` and full URLs pass
+    through (``/metrics`` appended when missing)."""
+    if target.isdigit():
+        target = f"127.0.0.1:{target}"
+    if "://" not in target:
+        target = f"http://{target}"
+    if not target.rstrip("/").endswith("/metrics"):
+        target = target.rstrip("/") + "/metrics"
+    return target
+
+
+def fetch(url: str, timeout_s: float = 2.0) -> dict:
+    from horovod_tpu.telemetry.sentinel import parse_prom
+
+    with urllib.request.urlopen(url, timeout=timeout_s) as r:
+        return parse_prom(r.read().decode())
+
+
+def _by_rank(doc: dict, name: str) -> dict[int, float]:
+    out: dict[int, float] = {}
+    for labels, value in doc.get(name, ()):
+        try:
+            out[int(labels.get("rank", ""))] = value
+        except ValueError:
+            continue
+    return out
+
+
+def rows(doc: dict, prev: dict | None = None,
+         dt_s: float | None = None) -> list[dict]:
+    """Per-rank dashboard rows from one parsed page (+ the previous page
+    for rate columns)."""
+    up = _by_rank(doc, HVDRUN_RANK_UP)
+    score = _by_rank(doc, SENTINEL_SCORE)
+    frac = _by_rank(doc, SENTINEL_STRAGGLER_EXCESS)
+    hb = _by_rank(doc, NATIVE_HEARTBEAT_AGE)
+    age = _by_rank(doc, HVDRUN_SCRAPE_AGE)
+    stale = _by_rank(doc, HVDRUN_SCRAPE_STALE)
+    wire = _by_rank(doc, NATIVE_RING_BYTES)
+    wire_prev = _by_rank(prev, NATIVE_RING_BYTES) if prev else {}
+    conv: dict[int, list[str]] = {}
+    for labels, value in doc.get(SENTINEL_CONVICTIONS, ()):
+        if value > 0 and labels.get("rank", "").isdigit():
+            conv.setdefault(int(labels["rank"]), []).append(
+                labels.get("reason", "?"))
+    phase: dict[int, str] = {}
+    for labels, value in doc.get(SENTINEL_LAST_PHASE, ()):
+        if value > 0 and labels.get("rank", "").isdigit():
+            phase[int(labels["rank"])] = labels.get("phase", "?")
+    ranks = sorted(set(up) | set(score) | set(hb) | set(wire))
+    out = []
+    for rk in ranks:
+        rate = None
+        if dt_s and rk in wire and rk in wire_prev and dt_s > 0:
+            rate = max(wire[rk] - wire_prev[rk], 0.0) / dt_s / (1 << 20)
+        out.append({
+            "rank": rk,
+            "up": bool(up.get(rk, 0)),
+            "score": score.get(rk),
+            "fraction": frac.get(rk),
+            "convictions": sorted(conv.get(rk, [])),
+            "phase": phase.get(rk),
+            "heartbeat_age_s": hb.get(rk),
+            "wire_mb_s": rate,
+            "scrape_age_s": age.get(rk),
+            "stale": bool(stale.get(rk, 0)),
+        })
+    return out
+
+
+def _fmt(v, spec="{:.1f}") -> str:
+    return "-" if v is None else spec.format(v)
+
+
+def render(doc: dict, prev: dict | None = None,
+           dt_s: float | None = None) -> str:
+    """One dashboard frame as text (what ``--once`` prints verbatim)."""
+    table = rows(doc, prev, dt_s)
+    windows = doc.get(SENTINEL_WINDOWS)
+    head = (f"fleet top — {len(table)} rank(s)"
+            + (f", sentinel window {windows[0][1]:.0f}" if windows else
+               ", sentinel off")
+            + "  " + time.strftime("%H:%M:%S"))
+    cols = ("rank", "up", "score", "frac", "phase", "hb-age",
+            "wire MB/s", "scrape-age", "convictions")
+    widths = (4, 2, 5, 5, 11, 6, 9, 10, 0)
+    lines = [head, "  ".join(c.ljust(w) for c, w in zip(cols, widths))]
+    for r in table:
+        conv = ",".join(r["convictions"]) or "-"
+        if r["stale"]:
+            conv = (conv + " STALE").strip("- ").strip() or "STALE"
+        cells = (
+            str(r["rank"]), "y" if r["up"] else "n",
+            _fmt(r["score"], "{:.0f}"), _fmt(r["fraction"], "{:.2f}"),
+            (r["phase"] or "-")[:11], _fmt(r["heartbeat_age_s"]),
+            _fmt(r["wire_mb_s"]), _fmt(r["scrape_age_s"]), conv)
+        lines.append("  ".join(c.ljust(w) for c, w in zip(cells, widths)))
+    return "\n".join(lines)
+
+
+def run(target: str, interval_s: float = 2.0, once: bool = False,
+        out=None) -> int:
+    out = out or sys.stdout
+    url = resolve_url(target)
+    prev, prev_t = None, None
+    while True:
+        try:
+            doc = fetch(url)
+        except OSError as exc:
+            print(f"error: cannot scrape {url}: {exc}", file=sys.stderr)
+            return 2
+        now = time.monotonic()
+        frame = render(doc, prev,
+                       now - prev_t if prev_t is not None else None)
+        if once:
+            print(frame, file=out)
+            return 0
+        print(_CLEAR + frame, file=out, flush=True)
+        prev, prev_t = doc, now
+        time.sleep(max(interval_s, 0.2))

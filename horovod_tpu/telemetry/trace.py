@@ -98,6 +98,20 @@ def read_trace(path: str) -> dict:
     with open(path, "rb") as f:
         blob = f.read()
     if len(blob) < _HEADER_BLOCK or blob[:8] != MAGIC:
+        # a live scraper (the fleet sentinel, `telemetry top`) can race
+        # worker startup: the recorder creates its file-backed ring
+        # before the header lands, so an empty file — or a MAGIC-prefixed
+        # partial header — means "no events yet", not corruption.  Only
+        # a file whose first bytes CONTRADICT the magic is not a dump.
+        if not blob or MAGIC.startswith(blob[:8]) or (
+                blob[:8] == MAGIC and len(blob) < _HEADER_BLOCK):
+            return {
+                "path": path, "version": 0, "rank": -1, "size": 0,
+                "pid": 0, "ring_events": 0, "dropped": 0,
+                "clock_offset_ns": 0, "auto_dumps": 0,
+                "start_mono_ns": 0, "start_unix_ns": 0,
+                "world_epoch": 0, "rings": [], "empty": True,
+            }
         raise ValueError(f"{path!r} is not a flight-recorder dump")
     (_, version, rank, size, pid, ring_events, nrings_max, nrings,
      dropped, clock_offset, auto_dumps, start_mono, start_unix,

@@ -1225,6 +1225,128 @@ def scenario_drain_loop():
           f"drains={dd['drains']}", flush=True)
 
 
+def scenario_sentinel_loop():
+    """Fleet-sentinel policy-loop workload (BENCH_r18): a steady
+    allreduce stream under --min-np where one rank is made chronically
+    slow by fault injection (slow:rank=R:phase=pack) and NOBODY in the
+    job reacts — the launcher-side sentinel must observe the straggler
+    through /metrics + the flight recorder, convict it, drain it over
+    the control path, and relaunch the slot as a joiner (whose env drops
+    the injection, so the fleet comes back healthy at full size).
+
+    The worker just steps and reports; the proof is in the markers: the
+    convicted rank prints DRAINED OK and exits 0, and rank 0 stops only
+    once the world is back at HVD_TEST_EXPECT_FINAL_SIZE with at least
+    one drain AND one join counted.
+
+    Retryable accounting: the DRAIN must be gentle (zero failed handles
+    on survivors — wire v11's contract), but a JOINER's re-admission
+    cancels in-flight collectives by design and is absorbed by the
+    elastic retry loop.  The scenario counts the two separately — the
+    wrapper runs max_restarts=0 so every WorldShrunkError surfaces
+    here, where it is tallied as PRE_JOIN (a drain that failed handles:
+    gated to zero) or JOIN (the expected re-admission cancel) before
+    being retried."""
+    import time as _time
+
+    hvd.init()
+    launch_rank = int(os.environ.get("HOROVOD_TPU_RANK", "0"))
+    elems = int(os.environ.get("HVD_TEST_ELEMS", "4096"))
+    steps_after = int(os.environ.get("HVD_TEST_STEPS_AFTER", "6"))
+    expect_final = int(os.environ.get("HVD_TEST_EXPECT_FINAL_SIZE", "0"))
+    from horovod_tpu.runtime import state as _st
+
+    data = np.ones(elems, np.float32)
+    shared = {"stop": 0.0, "step": 0}
+
+    def sync_state():
+        hvd.broadcast(np.zeros(1, np.float32), root_rank=0,
+                      name="sl_sync")
+
+    def on_drain():
+        print(f"rank {launch_rank}: ON_DRAIN checkpoint written "
+              f"step={shared['step']}", flush=True)
+
+    @hvd.elastic.run(sync=sync_state, on_drain=on_drain, max_restarts=0)
+    def train_step():
+        hs = [hvd.allreduce_async(data, average=False, name=f"sl{i}")
+              for i in range(4)]
+        outs = [hvd.synchronize(h) for h in hs]
+        stop = hvd.broadcast(np.array([shared["stop"]], np.float32),
+                             root_rank=0, name="sl_stop")
+        return outs, stop
+
+    settled_steps = 0
+    retry_pre_join = 0
+    retry_join = 0
+    ws = hvd.size()
+    try:
+        for step in range(100000):
+            shared["step"] = step
+            size_before = hvd.size()
+            try:
+                outs, stop = train_step()
+            except hvd.WorldShrunkError as e:
+                # tally, then retry like elastic.run would: a join-time
+                # cancel (the error names its world change) is the
+                # normal re-admission path; anything else around a
+                # graceful drain means failed handles (gated to zero)
+                if "rank join" in str(e):
+                    retry_join += 1
+                else:
+                    retry_pre_join += 1
+                deadline = _time.monotonic() + 30
+                while not hvd.world_changed():
+                    if _time.monotonic() > deadline:
+                        raise
+                    _time.sleep(0.02)
+                continue
+            except RuntimeError as e:
+                if "shut down" in str(e):
+                    break  # coordinated clean shutdown reached this rank
+                raise
+            hvd.world_changed()
+            ws = hvd.size()
+            for out in outs:
+                # sum-of-ones IS the world size; around the drain/rejoin
+                # a step can straddle two worlds — accept the range
+                lo, hi = sorted((float(size_before), float(ws)))
+                assert lo <= out[0] <= hi, (
+                    launch_rank, out[0], size_before, ws)
+            if stop[0] > 0:
+                break
+            if step == 2 and hvd.rank() == 0:
+                print(f"rank {launch_rank}: STEPPING", flush=True)
+            w = _st.engine().world_stats()
+            d = _st.engine().drain_stats()
+            settled = (d["drains"] >= 1 and w.get("rank_joins", 0) >= 1
+                       and (not expect_final or ws == expect_final))
+            settled_steps = settled_steps + 1 if settled else 0
+            if hvd.rank() == 0 and settled_steps >= steps_after:
+                shared["stop"] = 1.0
+        else:
+            print(f"rank {launch_rank}: sentinel loop ran dry", flush=True)
+            sys.exit(5)
+    except SystemExit as e:
+        if e.code == 0:
+            # the sentinel's drain landed: checkpoint hook ran, engine
+            # stopped cleanly — the launcher relaunches this slot
+            print(f"rank {launch_rank}: DRAINED OK", flush=True)
+        raise
+    w = _st.engine().world_stats()
+    dd = _st.engine().drain_stats()
+    print(f"rank {launch_rank}: WORLD_CHANGED size={ws} "
+          f"changes={w['world_changes']} drains={dd['drains']} "
+          f"joins={w.get('rank_joins', 0)} gen={dd['coord_generation']}",
+          flush=True)
+    print(f"rank {launch_rank}: RETRYABLE_PRE_JOIN={retry_pre_join} "
+          f"RETRYABLE_JOIN={retry_join}", flush=True)
+    hvd.shutdown()
+    print(f"rank {launch_rank}: sentinel loop OK world={ws} "
+          f"drains={dd['drains']} joins={w.get('rank_joins', 0)}",
+          flush=True)
+
+
 def scenario_elastic_dump():
     """Bitwise checker for the shrunk world: after the world reaches
     HVD_TEST_EXPECT_SIZE members, run a deterministic allreduce battery

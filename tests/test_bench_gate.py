@@ -569,3 +569,46 @@ def test_sharded_artifact_acceptance_shape():
     assert 0.9 * rep_kb <= remat_kb <= 1.1 * rep_kb, (remat_kb, rep_kb)
     for mode in ("replicated", "sharded", "sharded_remat1"):
         assert p[mode].get("wall_s") is not None, mode
+
+
+def test_sentinel_artifact_counted_series():
+    """BENCH_r18's counted policy-loop series: the launcher-side sentinel
+    convicted EXACTLY the injected (rank, phase) chronic straggler within
+    the hysteresis budget, drained it over the control path (clean exit,
+    checkpoint written, zero pre-join retryable failures on survivors —
+    the graceful drain's zero-failed-handles contract), relaunched the
+    slot from the spare pool, and the world returned to full size with
+    the whole arc in the conviction ledger."""
+    r18 = _baseline("BENCH_r18.json")
+    p = r18["np4"]["policy_loop"]
+    assert p["exit_code"] == 0, p
+    # decide: conviction names the injected fault exactly, with hysteresis
+    assert p["convicted"] is True, p
+    assert p["conviction_reason"] == "chronic-straggler", p
+    assert p["conviction_rank"] == p["victim"] == 2, p
+    assert p["conviction_phase"] == p["phase"] == "pack", p
+    assert p["windows_to_convict"] <= p["hysteresis_windows"], p
+    # act: drain + relaunch, recorded in the ledger AND observed live
+    assert p["drain_acted"] and p["relaunched"], p
+    assert p["drained_clean"] and p["checkpointed"], p
+    assert p["drains"] >= 1 and p["joins"] >= 1, p
+    assert p["final_size"] == 4, p
+    # no survivor saw a drain-caused retryable cancel (the join's own
+    # re-admission cancel is counted separately and allowed)
+    assert p["retryable_pre_join_max"] == 0, p
+    assert p["zero_retryable"] is True, p
+    assert p["ledger_records"] >= 3, p  # observe + conviction + acts
+
+
+def test_sentinel_observer_purity_gate():
+    """The sentinel only scrapes HTTP endpoints and reads local files, so
+    the counted ctrl-bytes-per-round series with the sentinel on vs off
+    must agree EXACTLY (ratio 1.0, not a band): any drift means the
+    observer touched the control plane."""
+    r18 = _baseline("BENCH_r18.json")
+    ovh = r18["sentinel_overhead"]
+    on = ovh["sentinel_on"]["ctrl_bytes_per_round_worker"]
+    off = ovh["sentinel_off"]["ctrl_bytes_per_round_worker"]
+    assert on and off, ovh
+    assert ovh["on_vs_off"] == 1.0, ovh
+    assert on == off, ovh

@@ -1,0 +1,54 @@
+"""Docs-vs-code drift gate for metric families (satellite of the fleet
+sentinel PR): tools/check_metrics_docs.py parses every family constant
+out of the telemetry catalog and requires a docs/observability.md
+mention.  Fast, pure-text, tier-1."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import check_metrics_docs  # noqa: E402
+
+
+def test_all_catalog_families_documented():
+    missing = check_metrics_docs.missing_from_docs()
+    assert missing == [], (
+        f"metric families missing from docs/observability.md: {missing} — "
+        "add a row to the metric catalog / sentinel / Prometheus section")
+    names = check_metrics_docs.catalog_names()
+    # sanity on the parser itself: the catalog is real and both the hvd_
+    # and hvdrun_ namespaces made it through
+    assert len(names) >= 60
+    assert "hvd_sentinel_score" in names
+    assert "hvdrun_scrape_age_seconds" in names
+
+
+def test_checker_catches_an_undocumented_family(tmp_path):
+    """The checker must actually fail on drift (a gate that can't fire
+    is decoration): a synthetic repo with one undocumented family."""
+    pkg = tmp_path / "horovod_tpu" / "telemetry"
+    pkg.mkdir(parents=True)
+    (pkg / "__init__.py").write_text(
+        'DOCUMENTED = "hvd_documented_total"\n'
+        'MISSED = "hvd_missed_total"\n'
+        '_FMT = "hvd_not_a_{}_family"  # no match: not a plain literal\n')
+    (pkg / "health.py").write_text('EXTRA = "hvdrun_extra_gauge"\n')
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "observability.md").write_text(
+        "| `hvd_documented_total` | counter |\n"
+        "| `hvdrun_extra_gauge` | gauge |\n")
+    assert check_metrics_docs.missing_from_docs(str(tmp_path)) == \
+        ["hvd_missed_total"]
+
+
+def test_cli_exit_status():
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools",
+                                      "check_metrics_docs.py")],
+        capture_output=True, text=True, timeout=60, cwd=REPO)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "metric families documented" in out.stdout

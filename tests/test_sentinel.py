@@ -1,0 +1,351 @@
+"""Fleet-sentinel unit tests: the conviction ledger's durability
+contract, the health scorer's hysteresis edges, the windowed-attribution
+watermark, the preempt feed, the act-once-per-incarnation latch, and the
+``telemetry top`` dashboard — all pure logic, no job and no native .so
+(the live observe→decide→act arc is bench.py --sentinel's job, gated on
+the BENCH_r18 artifact by tests/test_bench_gate.py)."""
+
+import io
+import json
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from horovod_tpu import telemetry as T  # noqa: E402
+from horovod_tpu.telemetry import top as ftop  # noqa: E402
+from horovod_tpu.telemetry.ledger import Ledger, tail_lines  # noqa: E402
+from horovod_tpu.telemetry.sentinel import (  # noqa: E402
+    HealthScorer,
+    Sentinel,
+    parse_prom,
+)
+
+from test_telemetry import _synthetic_trace_pair  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# parse_prom
+# ---------------------------------------------------------------------------
+
+def test_parse_prom_samples_labels_and_garbage():
+    doc = parse_prom("\n".join([
+        "# HELP hvd_x whatever",
+        "# TYPE hvd_x counter",
+        'hvd_x{rank="2",op="allreduce"} 7',
+        "hvd_plain 1.5",
+        "hvd_hist_bucket{le=\"0.1\"} 3",
+        "not a sample at all ! !",
+        "hvd_bad_value nan-ish-garbage x",
+        "",
+    ]))
+    assert doc["hvd_x"] == [({"rank": "2", "op": "allreduce"}, 7.0)]
+    assert doc["hvd_plain"] == [({}, 1.5)]
+    assert doc["hvd_hist_bucket"] == [({"le": "0.1"}, 3.0)]
+    assert "hvd_bad_value" not in doc
+
+
+# ---------------------------------------------------------------------------
+# conviction ledger
+# ---------------------------------------------------------------------------
+
+def test_ledger_append_read_tail_and_torn_line(tmp_path):
+    led = Ledger(str(tmp_path))
+    for i in range(4):
+        rec = led.append(2, {"kind": "observe", "score": 90 - i})
+        assert "t" in rec  # stamped
+    led.append(2, {"kind": "conviction", "reason": "chronic-straggler",
+                   "phase": "pack"})
+    # a torn tail line (killed mid-append) is skipped, not raised
+    with open(led.path(2), "a") as f:
+        f.write('{"kind": "conv')
+    recs = led.read(2)
+    assert len(recs) == 5
+    assert recs[-1]["reason"] == "chronic-straggler"
+    tail = led.tail(2, 2)
+    assert [r["kind"] for r in tail] == ["observe", "conviction"]
+    assert tail[0]["score"] == 87  # the LAST two records, oldest first
+    assert led.ranks() == [2]
+    assert led.read(7) == []  # no file: empty, not an error
+
+
+def test_ledger_tail_lines_reads_as_verdict(tmp_path):
+    led = Ledger(str(tmp_path))
+    led.append(1, {"kind": "conviction", "reason": "sdc"})
+    led.append(1, {"kind": "act", "action": "drain", "detail": "reason=sdc"})
+    lines = tail_lines(str(tmp_path), 1, 3)
+    assert len(lines) == 2
+    assert lines[0].startswith("ledger[conviction] reason=sdc")
+    assert lines[1].startswith("ledger[act] action=drain")
+    assert tail_lines(str(tmp_path), 9) == []
+
+
+# ---------------------------------------------------------------------------
+# health scorer: hysteresis edges
+# ---------------------------------------------------------------------------
+
+def _window(ranks=(0, 1), frac=None, up=None, **over):
+    rows = [{"rank": rk, "phase": ph, "ns": int(f * 1e9), "fraction": f}
+            for rk, (f, ph) in (frac or {}).items()]
+    w = {"ranks": list(ranks),
+         "up": {rk: True for rk in ranks} if up is None else up,
+         "attribution": {"rows": rows},
+         "interval_s": 1.0,
+         "audit_mismatches": 0.0, "audit_bad_rank": -1.0,
+         "link_verdicts_by_rank": {}, "heartbeat_age_by_rank": {}}
+    w.update(over)
+    return w
+
+
+def test_chronic_straggler_needs_k_consecutive_windows():
+    sc = HealthScorer(fraction=0.4, windows=3)
+    hot = _window(frac={1: (0.6, "pack")})
+    for i in range(2):
+        scores, convs = sc.observe(hot)
+        assert convs == [] and sc.convicted(1) is None, i
+    scores, convs = sc.observe(hot)  # third consecutive window convicts
+    assert [c["reason"] for c in convs] == ["chronic-straggler"]
+    assert convs[0]["rank"] == 1 and convs[0]["phase"] == "pack"
+    assert convs[0]["windows"] == 3
+    # latched: the fourth window re-convicts nobody, score carries the -40
+    scores, convs = sc.observe(hot)
+    assert convs == [] and sc.convicted(1)["reason"] == "chronic-straggler"
+    assert scores[1] < scores[0] and scores[1] <= 100 - 40
+    assert scores[0] == 100.0  # the innocent rank is untouched
+
+
+def test_chronic_straggler_blip_and_phase_switch_reset():
+    sc = HealthScorer(fraction=0.4, windows=3)
+    hot = _window(frac={0: (0.7, "pack")})
+    sc.observe(hot)
+    sc.observe(hot)
+    # one clean window resets the consecutive counter entirely
+    sc.observe(_window())
+    _, convs = sc.observe(hot)
+    assert convs == []
+    # ... and switching phase restarts the count at 1 (the hysteresis is
+    # per-(rank, phase): two different slow phases are two hypotheses)
+    sc2 = HealthScorer(fraction=0.4, windows=3)
+    sc2.observe(_window(frac={0: (0.7, "pack")}))
+    sc2.observe(_window(frac={0: (0.7, "pack")}))
+    sc2.observe(_window(frac={0: (0.7, "wire-send")}))
+    _, convs = sc2.observe(_window(frac={0: (0.7, "wire-send")}))
+    assert convs == []  # wire-send is only at 2 consecutive windows
+    _, convs = sc2.observe(_window(frac={0: (0.7, "wire-send")}))
+    assert [c["phase"] for c in convs] == ["wire-send"]
+
+
+def test_sdc_conviction_is_immediate_and_single():
+    sc = HealthScorer()
+    _, convs = sc.observe(_window(audit_mismatches=1.0, audit_bad_rank=1.0))
+    assert [(c["reason"], c["rank"]) for c in convs] == [("sdc", 1)]
+    # same cumulative counter value next window: no duplicate conviction
+    _, convs = sc.observe(_window(audit_mismatches=1.0, audit_bad_rank=1.0))
+    assert convs == []
+
+
+def test_flapping_link_needs_distinct_windows():
+    sc = HealthScorer(flap=3)
+    # verdicts growing in 3 DISTINCT windows convict; a flat counter
+    # between them does not advance the flap count
+    sc.observe(_window(link_verdicts_by_rank={1: 1.0}))
+    sc.observe(_window(link_verdicts_by_rank={1: 1.0}))  # flat: no flap
+    sc.observe(_window(link_verdicts_by_rank={1: 2.0}))
+    _, convs = sc.observe(_window(link_verdicts_by_rank={1: 3.0}))
+    assert [c["reason"] for c in convs] == ["flapping-link"]
+    assert convs[0]["rank"] == 1 and convs[0]["flap_windows"] == 3
+
+
+def test_score_formula_down_heartbeat_and_clear():
+    sc = HealthScorer(fraction=0.4, windows=3)
+    scores, _ = sc.observe(_window(up={0: False, 1: True}))
+    assert scores[0] == 0.0 and scores[1] == 100.0  # scrape down = 0
+    scores, _ = sc.observe(_window(heartbeat_age_by_rank={1: 9.0}))
+    assert scores[1] == 80.0  # age > 5x the 1 s interval: -20
+    hot = _window(frac={1: (0.5, "pack")})
+    for _ in range(3):
+        sc.observe(hot)
+    assert sc.convicted(1)
+    # relaunch: the new incarnation starts innocent and can convict again
+    sc.clear(1)
+    assert sc.convicted(1) is None
+    for _ in range(2):
+        _, convs = sc.observe(hot)
+        assert convs == []
+    _, convs = sc.observe(hot)
+    assert [c["reason"] for c in convs] == ["chronic-straggler"]
+
+
+# ---------------------------------------------------------------------------
+# windowed attribution: the watermark forgets a recovered straggler
+# ---------------------------------------------------------------------------
+
+def test_windowed_attribution_watermark(tmp_path):
+    _synthetic_trace_pair(tmp_path, slow_rank=1, slow_phase="pack")
+    s = Sentinel({}, ledger_dir=str(tmp_path / "ledger"),
+                 trace_dir=str(tmp_path))
+    att = s._windowed_attribution()
+    assert att and att["top"]["rank"] == 1 and att["top"]["phase"] == "pack"
+    assert att["last_phase_by_rank"][1]  # phases surfaced for the dashboard
+    # nothing new finished since: the same collectives stop accruing blame
+    att2 = s._windowed_attribution()
+    assert att2["rows"] == [] and att2["total_critical_ns"] == 0
+    # no recorder at all: None, not an exception
+    assert Sentinel({}, ledger_dir=str(tmp_path / "l2"),
+                    trace_dir=str(tmp_path / "nope"))._windowed_attribution() \
+        is None
+
+
+# ---------------------------------------------------------------------------
+# the act half: preempt feed, act-once latch, relaunch arc
+# ---------------------------------------------------------------------------
+
+def test_preempt_feed_convicts_and_acts_once(tmp_path):
+    feed = tmp_path / "feed"
+    feed.write_text("# maintenance window\nrank:1\n")
+    acted = []
+    s = Sentinel({}, ledger_dir=str(tmp_path / "ledger"),
+                 act=lambda rk, conv: acted.append((rk, conv["reason"]))
+                 or True,
+                 preempt_feed=str(feed))
+    out = s.step()
+    assert [(c["rank"], c["reason"]) for c in out["convictions"]] == \
+        [(1, "preempt-feed")]
+    assert acted == [(1, "preempt-feed")] and s.acted_on(1)
+    # the same feed line never re-convicts; the latch never re-acts
+    assert s.step()["convictions"] == []
+    assert acted == [(1, "preempt-feed")]
+    kinds = [r["kind"] for r in s.ledger.read(1)]
+    assert kinds == ["conviction", "act"]
+    acts = [r for r in s.ledger.read(1) if r["kind"] == "act"]
+    assert acts[0]["action"] == "drain" and "preempt-feed" in acts[0]["detail"]
+    # relaunch: ledger records the arc's close, latch + conviction clear
+    s.mark_relaunched(1)
+    assert not s.acted_on(1) and s.scorer.convicted(1) is None
+    assert s.ledger.read(1)[-1]["action"] == "relaunch"
+
+
+def test_preempt_feed_hostname_targets_and_comments(tmp_path):
+    feed = tmp_path / "feed"
+    feed.write_text("# not-a-host\nhostB\nhostZ\n")
+    s = Sentinel({0: 1, 1: 2, 2: 3}, ledger_dir=str(tmp_path / "ledger"),
+                 preempt_feed=str(feed),
+                 rank_hosts={0: "hostA", 1: "hostB", 2: "hostB"})
+    convs = s._check_preempt_feed()
+    # every rank on the doomed host, nobody else, unknown hosts ignored
+    assert [(c["rank"], c["reason"]) for c in convs] == \
+        [(1, "preempt-feed"), (2, "preempt-feed")]
+    assert s._check_preempt_feed() == []  # seen-set: read once
+
+
+def test_failed_act_lands_in_ledger_not_the_loop(tmp_path):
+    feed = tmp_path / "feed"
+    feed.write_text("rank:0\n")
+
+    def boom(rk, conv):
+        raise RuntimeError("coordinator unreachable")
+
+    s = Sentinel({}, ledger_dir=str(tmp_path / "ledger"), act=boom,
+                 preempt_feed=str(feed))
+    out = s.step()  # must not raise
+    assert [c["rank"] for c in out["convictions"]] == [0]
+    acts = [r for r in s.ledger.read(0) if r["kind"] == "act"]
+    assert acts[0]["action"] == "drain-failed"
+    assert "coordinator unreachable" in acts[0]["detail"]
+
+
+def test_step_publishes_sentinel_families(tmp_path):
+    feed = tmp_path / "feed"
+    feed.write_text("rank:0\n")
+    s = Sentinel({}, ledger_dir=str(tmp_path / "ledger"), act=None,
+                 preempt_feed=str(feed))
+    s.step()
+    page = s.registry.to_prometheus()
+    assert T.SENTINEL_WINDOWS + " 1" in page
+    assert (T.SENTINEL_CONVICTIONS +
+            '{rank="0",reason="preempt-feed"} 1') in page
+
+
+# ---------------------------------------------------------------------------
+# telemetry top
+# ---------------------------------------------------------------------------
+
+def _top_page(score2=30.0, stale2=1, ring2=(1 << 20)):
+    return "\n".join([
+        "# TYPE hvdrun_rank_up gauge",
+        'hvdrun_rank_up{rank="0"} 1',
+        'hvdrun_rank_up{rank="2"} 0',
+        'hvdrun_scrape_age_seconds{rank="0"} 0.000',
+        f'hvdrun_scrape_age_seconds{{rank="2"}} 3.500',
+        'hvdrun_scrape_stale{rank="0"} 0',
+        f'hvdrun_scrape_stale{{rank="2"}} {stale2}',
+        'hvd_sentinel_score{rank="0"} 100',
+        f'hvd_sentinel_score{{rank="2"}} {score2}',
+        'hvd_sentinel_straggler_fraction{rank="2"} 0.61',
+        'hvd_sentinel_convictions_total{rank="2",reason="chronic-straggler"} 1',
+        'hvd_sentinel_last_phase{rank="2",phase="pack"} 1',
+        'hvd_sentinel_windows_total 42',
+        'hvd_heartbeat_age_s{rank="0"} 0.2',
+        'hvd_ring_bytes_total{rank="0"} 0',
+        f'hvd_ring_bytes_total{{rank="2"}} {ring2}',
+    ]) + "\n"
+
+
+def test_top_rows_rates_and_stale():
+    prev = parse_prom(_top_page(ring2=0))
+    doc = parse_prom(_top_page(ring2=2 << 20))
+    table = {r["rank"]: r for r in ftop.rows(doc, prev, dt_s=2.0)}
+    assert table[0]["up"] and table[0]["score"] == 100
+    r2 = table[2]
+    assert not r2["up"] and r2["score"] == 30 and r2["stale"]
+    assert r2["convictions"] == ["chronic-straggler"]
+    assert r2["phase"] == "pack" and r2["scrape_age_s"] == 3.5
+    assert r2["wire_mb_s"] == pytest.approx(1.0)  # 2 MiB over 2 s
+    frame = ftop.render(doc, prev, 2.0)
+    assert "sentinel window 42" in frame
+    assert "STALE" in frame and "chronic-straggler" in frame
+
+
+def test_top_resolve_url_forms():
+    assert ftop.resolve_url("9090") == "http://127.0.0.1:9090/metrics"
+    assert ftop.resolve_url("host:1") == "http://host:1/metrics"
+    assert ftop.resolve_url("http://h:1/metrics") == "http://h:1/metrics"
+
+
+def test_top_once_against_live_server():
+    from horovod_tpu.telemetry.httpd import MetricsServer
+
+    srv = MetricsServer(0, aggregate=_top_page)
+    try:
+        out = io.StringIO()
+        rc = ftop.run(str(srv.port), once=True, out=out)
+        assert rc == 0
+        assert "fleet top — 2 rank(s)" in out.getvalue()
+    finally:
+        srv.stop()
+    # dead target: error exit, not a traceback
+    assert ftop.run("127.0.0.1:1", once=True, out=io.StringIO()) == 2
+
+
+def test_top_cli_dispatch():
+    import subprocess
+
+    srv_script = (
+        "from horovod_tpu.telemetry.httpd import MetricsServer\n"
+        "import subprocess, sys\n"
+        "srv = MetricsServer(0, aggregate=lambda: "
+        "'hvdrun_rank_up{rank=\"0\"} 1\\n')\n"
+        "out = subprocess.run([sys.executable, '-m', "
+        "'horovod_tpu.telemetry', 'top', str(srv.port), '--once'],"
+        " capture_output=True, text=True, timeout=60)\n"
+        "srv.stop()\n"
+        "print(out.stdout)\n"
+        "sys.exit(out.returncode)\n")
+    out = subprocess.run(
+        [sys.executable, "-c", srv_script],
+        capture_output=True, text=True, timeout=120,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"), cwd=REPO)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "fleet top" in out.stdout

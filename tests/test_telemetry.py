@@ -1296,3 +1296,169 @@ def test_pset_op_labels_across_elastic_shrink(clean_telemetry):
     # the aggregate per-set family kept its single label set: no
     # double-counted {set,op} series on it
     assert reg.counter(T.NATIVE_PSET_COLLECTIVES, set="0").value == 15
+
+
+# ---------------------------------------------------------------------------
+# sentinel satellites: live-scrape empty-file race, last-known-good
+# aggregation, and the collector under a concurrent world change
+# ---------------------------------------------------------------------------
+
+def test_trace_reader_tolerates_empty_and_partial_file(tmp_path):
+    """A live scraper (the fleet sentinel, `telemetry top`) can race
+    worker startup: the recorder creates its file before the header
+    lands.  Empty or partial-MAGIC files mean "no events yet", not
+    corruption — only contradicting bytes raise."""
+    empty = tmp_path / "trace.rank0.bin"
+    empty.write_bytes(b"")
+    doc = FT.read_trace(str(empty))
+    assert doc["empty"] is True and doc["rings"] == []
+    partial = tmp_path / "trace.rank1.bin"
+    partial.write_bytes(FT.MAGIC[:5])  # mid-write header prefix
+    assert FT.read_trace(str(partial))["empty"] is True
+    # load_dir folds them in (rank recovered from the filename) so one
+    # slow-to-start rank never breaks the whole directory scan
+    _write_trace(str(tmp_path / "trace.rank2.bin"), 2,
+                 [("bg", [_ev(10, "init", arg=2)])])
+    docs = FT.load_dir(str(tmp_path))
+    assert [d["rank"] for d in docs] == [0, 1, 2]
+    # ...and attribution over the merge still works (empty docs add no
+    # collectives)
+    att = FT.attribution(FT.merge(docs))
+    assert att["rows"] == []
+    with pytest.raises(ValueError):
+        FT.read_trace(__file__)  # contradicting magic is still an error
+
+
+def test_aggregator_serves_stale_cached_samples():
+    """Satellite: a rank whose scrape times out keeps its last-known-good
+    samples on the aggregated page — marked ``hvdrun_scrape_stale`` with
+    a growing ``hvdrun_scrape_age_seconds`` — instead of vanishing
+    exactly when an operator is staring at the dashboard."""
+    from horovod_tpu.telemetry import httpd
+    from horovod_tpu.telemetry.httpd import MetricsServer, ScrapeCache
+
+    reg = MetricsRegistry()
+    reg.counter("hvd_cachetest_total").inc(9)
+    srv = MetricsServer(0, registry=reg, rank=1)
+    cache = ScrapeCache()
+    try:
+        page = httpd.scrape_and_aggregate({1: srv.port}, timeout_s=2.0,
+                                          cache=cache)
+    finally:
+        srv.stop()
+    assert 'hvd_cachetest_total{rank="1"} 9' in page
+    assert 'hvdrun_scrape_stale{rank="1"} 0' in page
+    assert 'hvdrun_scrape_age_seconds{rank="1"} 0.000' in page
+
+    # the rank dies: its series survive from the cache, marked stale
+    time.sleep(0.05)
+    page = httpd.scrape_and_aggregate({1: srv.port}, timeout_s=0.5,
+                                      cache=cache)
+    assert 'hvdrun_rank_up{rank="1"} 0' in page
+    assert 'hvd_cachetest_total{rank="1"} 9' in page  # last-known-good
+    assert 'hvdrun_scrape_stale{rank="1"} 1' in page
+    age = [ln for ln in page.splitlines()
+           if ln.startswith("hvdrun_scrape_age_seconds")]
+    assert age and float(age[0].rsplit(" ", 1)[1]) >= 0.05
+
+    # a never-seen rank: up=0, no cached series, no age row
+    page = httpd.scrape_and_aggregate({7: 1}, timeout_s=0.2, cache=cache)
+    assert 'hvdrun_rank_up{rank="7"} 0' in page
+    assert 'hvdrun_scrape_age_seconds{rank="7"}' not in page
+
+    # eviction is permanent: drop() frees the frozen series
+    cache.drop(1)
+    assert cache.get(1) is None
+
+
+def test_collector_and_dump_across_concurrent_world_change(clean_telemetry,
+                                                           tmp_path):
+    """Satellite: the registry's export paths stay whole while the world
+    changes underneath them — a drain between (and DURING) scrapes must
+    not KeyError, drop half a family, or let an evicted rank's series
+    move again."""
+    from horovod_tpu.runtime.native import NativeEngine
+
+    T.set_metrics_enabled(True)
+    state = {}
+
+    class Scripted(NativeEngine):
+        def __init__(self):  # no native init — scripted diagnostics
+            self._topology = None
+
+        def diagnostics(self):
+            return _fake_native_diag(**state)
+
+        def world_stats(self):
+            return {"world_epoch": state["epoch"],
+                    "world_size": state["size"], "world_rank": 0,
+                    "world_changes": 0, "rank_joins": 0,
+                    "shrink_latency_ns": 0, "elastic": 1}
+
+        def _fault_stats(self):
+            return {"heartbeat_age_s": 0.0, "peer_timeout_s": 60.0,
+                    "peer_timeouts": 0, "aborts": 0, "abort_latency_ns": 0,
+                    "heartbeats_tx": 0, "heartbeats_rx": 0}
+
+    def pset(sid, size, rank, coll, nbytes):
+        return {"id": sid, "size": size, "rank": rank, "collectives": coll,
+                "payload_bytes": nbytes, "wire_ns": 0, "cache_hits": 0,
+                "cache_misses": 0}
+
+    eng = Scripted()
+    state.update(epoch=0, size=4, psets=[pset(0, 4, 0, 10, 1000),
+                                         pset(1, 2, 1, 5, 500)])
+    eng._register_diagnostics_collector()
+    reg = T.registry()
+
+    errors = []
+
+    def scrape_loop():
+        try:
+            for _ in range(40):
+                page = reg.to_prometheus()
+                # family integrity: every sample's family must carry its
+                # TYPE comment on the same page (no torn families)
+                typed = {ln.split()[2] for ln in page.splitlines()
+                         if ln.startswith("# TYPE ")}
+                for ln in page.splitlines():
+                    if ln.startswith("#") or not ln.strip():
+                        continue
+                    fam = ln.split("{", 1)[0].split(" ", 1)[0]
+                    base = fam
+                    for sfx in ("_bucket", "_sum", "_count"):
+                        if fam.endswith(sfx) and fam[:-len(sfx)] in typed:
+                            base = fam[:-len(sfx)]
+                    assert base in typed, ln
+                reg.dump(str(tmp_path), 0)
+        except Exception as exc:  # pragma: no cover - the assertion
+            errors.append(exc)
+
+    threads = [threading.Thread(target=scrape_loop) for _ in range(2)]
+    for t in threads:
+        t.start()
+    # the concurrent drain: flip the world several times mid-scrape
+    for flip in range(10):
+        if flip % 2:
+            state.update(epoch=flip, size=4,
+                         psets=[pset(0, 4, 0, 10 + flip, 1000),
+                                pset(1, 2, 1, 5 + flip, 500)])
+        else:
+            state.update(epoch=flip, size=3,
+                         psets=[pset(0, 3, 0, 10 + flip, 1000)])
+        time.sleep(0.005)
+    for t in threads:
+        t.join(timeout=30)
+    assert not errors, errors[0]
+
+    # settle on the drained world: the evicted set's series freeze
+    state.update(epoch=99, size=3, psets=[pset(0, 3, 0, 50, 5000)])
+    reg.snapshot()
+    frozen = reg.counter(T.NATIVE_PSET_COLLECTIVES, set="1").value
+    reg.snapshot()
+    assert reg.counter(T.NATIVE_PSET_COLLECTIVES, set="1").value == frozen
+    assert reg.gauge(T.NATIVE_WORLD_SIZE).value == 3
+    # and the dump file is intact JSON with the world gauge in it
+    with open(tmp_path / "metrics.rank0.json") as f:
+        doc = json.load(f)
+    assert any(m["name"] == T.NATIVE_WORLD_SIZE for m in doc["metrics"])

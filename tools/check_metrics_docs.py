@@ -1,0 +1,67 @@
+#!/usr/bin/env python3
+"""Fail when a metric family exists in code but not in the docs.
+
+The telemetry catalog (``horovod_tpu/telemetry/__init__.py`` plus the
+health module's audit families) is the single source of metric names;
+``docs/observability.md`` is where an operator looks one up.  The two
+drift in exactly one direction — a new family ships without a docs row —
+so this check parses every ``NAME = "hvd_..."`` constant out of the
+catalog modules and greps the doc for each.  Run directly (exit 1 on a
+miss, listing them) or via the tier-1 test that wraps it.
+
+Pure stdlib + regex over source text: no horovod_tpu import, so it runs
+anywhere (including interpreters that can't load the native engine).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# modules that define metric-name constants (the catalog)
+CATALOG_FILES = (
+    os.path.join("horovod_tpu", "telemetry", "__init__.py"),
+    os.path.join("horovod_tpu", "telemetry", "health.py"),
+)
+DOC_FILE = os.path.join("docs", "observability.md")
+
+# NAME = "hvd_..." / "hvdrun_..." module-level constants; anything else
+# (format strings, dict keys, docstring mentions) is not a family
+_CONST_RE = re.compile(
+    r'^[A-Z][A-Z0-9_]*\s*=\s*"((?:hvd|hvdrun)_[a-z0-9_]+)"', re.M)
+
+
+def catalog_names(repo: str = REPO) -> list[str]:
+    names: set[str] = set()
+    for rel in CATALOG_FILES:
+        with open(os.path.join(repo, rel)) as f:
+            names.update(_CONST_RE.findall(f.read()))
+    return sorted(names)
+
+
+def missing_from_docs(repo: str = REPO) -> list[str]:
+    with open(os.path.join(repo, DOC_FILE)) as f:
+        doc = f.read()
+    return [n for n in catalog_names(repo) if n not in doc]
+
+
+def main() -> int:
+    names = catalog_names()
+    missing = missing_from_docs()
+    if missing:
+        print(f"{len(missing)} metric famil"
+              f"{'y' if len(missing) == 1 else 'ies'} missing from "
+              f"{DOC_FILE}:")
+        for n in missing:
+            print(f"  {n}")
+        return 1
+    print(f"ok: all {len(names)} metric families documented in "
+          f"{DOC_FILE}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
