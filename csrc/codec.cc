@@ -1,0 +1,138 @@
+#include "codec.h"
+
+#include <cmath>
+#include <cstring>
+
+namespace hvdtpu {
+
+int64_t CodecEncodedBytes(int64_t codec, int64_t nelems) {
+  if (nelems <= 0) return 0;
+  switch (codec) {
+    case kCodecFp16:
+    case kCodecBf16:
+      return nelems * 2;
+    case kCodecInt8:
+      return nelems + 4;  // 4-byte fp32 scale header, then one byte/elem
+    default:
+      return nelems * 4;
+  }
+}
+
+namespace {
+
+// One fp16/bf16 element: encode v, store the wire halfword, return the
+// decoded wire value (what every receiver will reconstruct).
+template <uint16_t (*kEnc)(float), float (*kDec)(uint16_t)>
+int64_t Encode16(const float* src, int64_t n, char* enc, float* resid,
+                 float* self) {
+  uint16_t* out = reinterpret_cast<uint16_t*>(enc);
+  for (int64_t i = 0; i < n; i++) {
+    float v = resid ? src[i] + resid[i] : src[i];
+    uint16_t h = kEnc(v);
+    out[i] = h;
+    float dec = kDec(h);
+    if (resid) resid[i] = std::isfinite(v - dec) ? v - dec : 0.0f;
+    if (self) self[i] = dec;
+  }
+  return n * 2;
+}
+
+int64_t EncodeInt8(const float* src, int64_t n, char* enc, float* resid,
+                   float* self) {
+  // pass 1: finite absmax decides the symmetric per-segment scale
+  float amax = 0.0f;
+  for (int64_t i = 0; i < n; i++) {
+    float v = resid ? src[i] + resid[i] : src[i];
+    float a = std::fabs(v);
+    if (std::isfinite(a) && a > amax) amax = a;
+  }
+  float scale = (amax > 1e-12f ? amax : 1e-12f) / 127.0f;
+  std::memcpy(enc, &scale, 4);
+  int8_t* q = reinterpret_cast<int8_t*>(enc + 4);
+  for (int64_t i = 0; i < n; i++) {
+    float v = resid ? src[i] + resid[i] : src[i];
+    float r;
+    if (std::isnan(v)) {
+      r = 0.0f;  // contract: NaN -> 0 on the wire
+    } else {
+      // round-half-to-even (numpy's np.round), saturating: Inf -> +/-127
+      r = static_cast<float>(std::nearbyint(v / scale));
+      if (r > 127.0f) r = 127.0f;
+      if (r < -127.0f) r = -127.0f;
+    }
+    int8_t qi = static_cast<int8_t>(r);
+    q[i] = qi;
+    float dec = static_cast<float>(qi) * scale;
+    if (resid) resid[i] = std::isfinite(v) ? v - dec : 0.0f;
+    if (self) self[i] = dec;
+  }
+  return n + 4;
+}
+
+}  // namespace
+
+int64_t CodecEncode(int64_t codec, const float* src, int64_t n, char* enc,
+                    float* resid, float* self) {
+  if (n <= 0) return 0;
+  switch (codec) {
+    case kCodecFp16:
+      return Encode16<FloatToHalfRNE, HalfToFloat>(src, n, enc, resid, self);
+    case kCodecBf16:
+      return Encode16<FloatToBF16RNE, BF16ToFloat>(src, n, enc, resid, self);
+    case kCodecInt8:
+      return EncodeInt8(src, n, enc, resid, self);
+    default: {
+      std::memcpy(enc, src, static_cast<size_t>(n) * 4);
+      if (self) std::memcpy(self, src, static_cast<size_t>(n) * 4);
+      return n * 4;
+    }
+  }
+}
+
+void CodecDecode(int64_t codec, const char* enc, int64_t n, float* dst) {
+  if (n <= 0) return;
+  switch (codec) {
+    case kCodecFp16: {
+      const uint16_t* in = reinterpret_cast<const uint16_t*>(enc);
+      for (int64_t i = 0; i < n; i++) dst[i] = HalfToFloat(in[i]);
+      break;
+    }
+    case kCodecBf16: {
+      const uint16_t* in = reinterpret_cast<const uint16_t*>(enc);
+      for (int64_t i = 0; i < n; i++) dst[i] = BF16ToFloat(in[i]);
+      break;
+    }
+    case kCodecInt8: {
+      float scale;
+      std::memcpy(&scale, enc, 4);
+      const int8_t* q = reinterpret_cast<const int8_t*>(enc + 4);
+      for (int64_t i = 0; i < n; i++)
+        dst[i] = static_cast<float>(q[i]) * scale;
+      break;
+    }
+    default:
+      std::memcpy(dst, enc, static_cast<size_t>(n) * 4);
+      break;
+  }
+}
+
+int64_t CodecFromName(const char* name) {
+  if (name == nullptr) return kCodecNone;
+  std::string s(name);
+  if (s.empty() || s == "none" || s == "0") return kCodecNone;
+  if (s == "fp16" || s == "float16" || s == "1") return kCodecFp16;
+  if (s == "bf16" || s == "bfloat16" || s == "2") return kCodecBf16;
+  if (s == "int8" || s == "3") return kCodecInt8;
+  return -1;
+}
+
+const char* CodecName(int64_t codec) {
+  switch (codec) {
+    case kCodecFp16: return "fp16";
+    case kCodecBf16: return "bf16";
+    case kCodecInt8: return "int8";
+    default: return "none";
+  }
+}
+
+}  // namespace hvdtpu

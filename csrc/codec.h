@@ -1,0 +1,126 @@
+#pragma once
+
+// Wire payload codecs (wire v12): scalar fp16 / bf16 / scaled-int8
+// encode+decode for the segmented-ring data plane.  Everything here is
+// plain CPU code operating on fp32 spans — a codec transforms the BYTES a
+// segment puts on the wire, never the math the accumulate kernels run
+// (receive paths decode BEFORE accumulating, so health observers and the
+// SDC audit see ordinary fp32 values).
+//
+// The contracts below are wire-visible: every member of a ring must
+// encode and decode identically or the reassembled bytes are garbage.
+// tests/test_codec_native.py pins each against the Python mirrors in
+// horovod_tpu/compression.py.
+
+#include <cstddef>
+#include <cstdint>
+#include <cstring>
+
+#include "common.h"
+
+namespace hvdtpu {
+
+// Codec ids as the tuned_codec knob and the bootstrap table carry them.
+// Mirrored by runtime/wire_abi.py CODEC_* (tools/check_wire_abi.py pins).
+constexpr int64_t kCodecNone = 0;
+constexpr int64_t kCodecFp16 = 1;
+constexpr int64_t kCodecBf16 = 2;
+constexpr int64_t kCodecInt8 = 3;
+
+// Scalar reproduction of the F16C convert lane, bit-exact with
+// _mm256_cvtps_ph(_MM_FROUND_TO_NEAREST_INT): round-to-nearest-EVEN with
+// correct subnormal generation and hardware NaN quieting (top 10 payload
+// bits kept, quiet bit forced) — unlike common.h's FloatToHalf, which
+// rounds half-UP and collapses NaN payloads.  Shared between the engine's
+// phased fp16 accumulate (PR 10) and the fp16 wire codec: numpy's
+// float32->float16 cast follows the same IEEE rules, which is what makes
+// the codec bit-identical to the Python Compression.fp16 roundtrip.
+inline uint16_t FloatToHalfRNE(float x) {
+  uint32_t f;
+  std::memcpy(&f, &x, 4);
+  uint32_t sign = (f >> 16) & 0x8000u;
+  uint32_t em = f & 0x7fffffffu;
+  if (em >= 0x7f800000u) {  // inf / nan
+    if (em == 0x7f800000u) return static_cast<uint16_t>(sign | 0x7c00u);
+    return static_cast<uint16_t>(sign | 0x7c00u | 0x200u |
+                                 ((em >> 13) & 0x3ffu));
+  }
+  // >= 65520 rounds up past the largest finite fp16 (65504) to inf
+  if (em >= 0x477ff000u) return static_cast<uint16_t>(sign | 0x7c00u);
+  uint16_t h;
+  if (em >= 0x38800000u) {  // normal fp16 range
+    uint32_t v = em - 0x38000000u;  // rebias 127 -> 15
+    uint32_t r = v >> 13;
+    uint32_t rem = v & 0x1fffu;
+    r += (rem > 0x1000u) || (rem == 0x1000u && (r & 1u));
+    h = static_cast<uint16_t>(r);  // mantissa carry rolls into the exp
+  } else {  // subnormal fp16 (or zero)
+    uint32_t exp = em >> 23;
+    uint64_t mant = (em & 0x7fffffu) | (exp ? 0x800000u : 0u);
+    if (!exp) exp = 1;
+    int shift = 126 - static_cast<int>(exp);  // m16 = mant >> shift, RNE
+    if (shift > 63 || mant == 0) {
+      h = 0;
+    } else {
+      uint64_t r = mant >> shift;
+      uint64_t rem = mant & ((uint64_t{1} << shift) - 1);
+      uint64_t half = uint64_t{1} << (shift - 1);
+      r += (rem > half) || (rem == half && (r & 1u));
+      h = static_cast<uint16_t>(r);  // may carry into the smallest normal
+    }
+  }
+  return static_cast<uint16_t>(sign | h);
+}
+
+// Round-to-nearest-even fp32 -> bf16 with explicit NaN quieting.  The
+// carry-add trick in common.h's FloatToBF16 overflows low-payload NaNs
+// into Inf (0x7f800001 + 0x7fff carries past the exponent), so the codec
+// quiets NaNs BEFORE the rounding path — same top-7-payload-bits-kept +
+// quiet-bit-forced semantics as the fp16 lane above.
+inline uint16_t FloatToBF16RNE(float x) {
+  uint32_t f;
+  std::memcpy(&f, &x, 4);
+  if ((f & 0x7fffffffu) > 0x7f800000u)  // nan: keep payload, force quiet
+    return static_cast<uint16_t>((f >> 16) | 0x0040u);
+  uint32_t rounded = f + 0x7fffu + ((f >> 16) & 1u);
+  return static_cast<uint16_t>(rounded >> 16);
+}
+
+// Encoded wire size of an n-element fp32 span.  fp16/bf16 are flat 2
+// bytes/elem (exactly 0.5x); int8 prefixes each encoded segment with its
+// 4-byte fp32 scale (n+4 bytes, ~0.25x + the scale block).  Empty spans
+// encode to zero bytes under every codec — both ring directions must
+// agree a zero-length segment moves nothing.
+int64_t CodecEncodedBytes(int64_t codec, int64_t nelems);
+
+// Encode src[0..n) into enc (capacity >= CodecEncodedBytes); returns the
+// bytes written.  When `resid` is non-null the per-element error-feedback
+// residual is ADDED to src before encoding and then REWRITTEN with the new
+// quantization error (encoded-value semantics: resid' = v - decode(enc(v));
+// non-finite v leaves resid' = 0 — an unrepresentable value must not
+// poison the feedback loop).  When `self` is non-null the decoded wire
+// values are also stored there — the chunk owner's self-roundtrip, which
+// keeps every rank's final bytes identical to what forwarding peers
+// decode (the SDC audit depends on cross-rank bitwise identity).
+//
+// int8 contract (pinned by tests/test_codec_native.py and mirrored by
+// compression.py's Int8Compressor):
+//   scale = max(max |v| over FINITE v, 1e-12) / 127   (fp32 arithmetic)
+//   q     = clip(round-half-to-EVEN(v / scale), -127, 127)
+//   NaN -> 0, +/-Inf -> +/-127, all-zero input roundtrips to exact zeros.
+int64_t CodecEncode(int64_t codec, const float* src, int64_t n, char* enc,
+                    float* resid, float* self);
+
+// Decode n elements from enc into dst (dst may not alias enc).
+void CodecDecode(int64_t codec, const char* enc, int64_t n, float* dst);
+
+// Parse a codec name ("none" | "fp16" | "bf16" | "int8", or a bare id
+// digit) to its id; returns -1 on unrecognized input so callers can
+// reject bad HOROVOD_TPU_WIRE_CODEC values loudly instead of silently
+// running uncompressed.
+int64_t CodecFromName(const char* name);
+
+// The inverse, for diagnostics and log lines.
+const char* CodecName(int64_t codec);
+
+}  // namespace hvdtpu

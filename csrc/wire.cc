@@ -189,6 +189,30 @@ void PutSetTagAndVerdicts(std::string* s, int32_t set,
   }
 }
 
+// Wire v12 trailing chain: set tag, verdicts, then the tuned_codec knob.
+// A codec-silent frame (codec < 0, the default) writes EXACTLY the v11
+// bytes — the codec field only ever rides frames that carry a knob value,
+// and writing it forces the earlier optional blocks (set tag, verdict
+// count) out explicitly so the parser can position past them.
+void PutSetTagVerdictsCodec(std::string* s, int32_t set,
+                            const std::vector<HealthVerdict>& verdicts,
+                            int64_t codec) {
+  if (codec < 0) {
+    PutSetTagAndVerdicts(s, set, verdicts);
+    return;
+  }
+  PutI32(s, set);
+  PutU32(s, static_cast<uint32_t>(verdicts.size()));
+  for (const HealthVerdict& v : verdicts) {
+    PutI32(s, v.bad_rank);
+    PutU32(s, v.epoch);
+    PutU32(s, v.round);
+    PutU64(s, v.want);
+    PutU64(s, v.got);
+  }
+  PutI64(s, codec);
+}
+
 int32_t ReadSetTagAndVerdicts(Reader* rd,
                               std::vector<HealthVerdict>* verdicts) {
   verdicts->clear();
@@ -210,6 +234,16 @@ int32_t ReadSetTagAndVerdicts(Reader* rd,
     v.got = rd->U64();
     verdicts->push_back(v);
   }
+  return set;
+}
+
+int32_t ReadSetTagVerdictsCodec(Reader* rd,
+                                std::vector<HealthVerdict>* verdicts,
+                                int64_t* codec) {
+  *codec = -1;
+  int32_t set = ReadSetTagAndVerdicts(rd, verdicts);
+  if (rd->fail || rd->off >= rd->buf.size()) return set;
+  *codec = rd->I64();
   return set;
 }
 
@@ -293,7 +327,7 @@ std::string Serialize(const ResponseList& l) {
     for (const std::string& nm : r.names) PutStr(&s, nm);
     PutDims(&s, r.first_dims);
   }
-  PutSetTagAndVerdicts(&s, l.process_set, l.verdicts);
+  PutSetTagVerdictsCodec(&s, l.process_set, l.verdicts, l.tuned_codec);
   return s;
 }
 
@@ -326,7 +360,8 @@ Status Parse(const std::string& buf, ResponseList* out) {
     if (rd.fail) return Status::Error("truncated response list");
     out->responses.push_back(std::move(r));
   }
-  out->process_set = ReadSetTagAndVerdicts(&rd, &out->verdicts);
+  out->process_set =
+      ReadSetTagVerdictsCodec(&rd, &out->verdicts, &out->tuned_codec);
   if (rd.fail) return Status::Error("truncated response-list verdicts");
   return Status::OK();
 }
@@ -373,7 +408,7 @@ std::string Serialize(const CachedExecFrame& f) {
     PutI64(&s, static_cast<int64_t>(g.size()));
     for (uint32_t id : g) PutU32(&s, id);
   }
-  PutSetTagAndVerdicts(&s, f.process_set, f.verdicts);
+  PutSetTagVerdictsCodec(&s, f.process_set, f.verdicts, f.tuned_codec);
   return s;
 }
 
@@ -407,7 +442,8 @@ Status Parse(const std::string& buf, CachedExecFrame* out) {
     if (rd.fail) return Status::Error("truncated cached-exec frame");
     out->groups.push_back(std::move(g));
   }
-  out->process_set = ReadSetTagAndVerdicts(&rd, &out->verdicts);
+  out->process_set =
+      ReadSetTagVerdictsCodec(&rd, &out->verdicts, &out->tuned_codec);
   if (rd.fail) return Status::Error("truncated cached-exec verdicts");
   return Status::OK();
 }

@@ -23,20 +23,18 @@ namespace hvdtpu {
 
 // Bump kWireVersion on ANY layout change (header, field order, new frame).
 constexpr uint32_t kWireMagic = 0x48564457u;  // "HVDW" little-endian
-constexpr uint16_t kWireVersion = 11;         // v11: graceful drain + fenced
-                                              // elections (kDrain planned-
-                                              // eviction frames; world-change
-                                              // kind 2 = drain; kCoordElect
-                                              // carries the election
-                                              // GENERATION; the bootstrap
-                                              // table gains the generation
-                                              // field).  Pre-existing frame
-                                              // layouts other than
-                                              // CoordElectFrame are UNCHANGED
-                                              // from v10 — v10-shaped jobs
-                                              // serialize the same byte
-                                              // counts (only the header's
-                                              // version field moved), which
+constexpr uint16_t kWireVersion = 12;         // v12: negotiated wire codecs —
+                                              // ResponseList and CachedExec
+                                              // gain a TRAILING tuned_codec
+                                              // knob (after the verdicts
+                                              // block, serialized only when
+                                              // >= 0) and the bootstrap table
+                                              // gains the wire_codec +
+                                              // codec_ef fields.  Codec-off
+                                              // jobs (the default) serialize
+                                              // byte-for-byte v11-SHAPED
+                                              // frames (only the header's
+                                              // version value moved), which
                                               // is what keeps the ctrl-bytes
                                               // CI gate pinned at 1.0000.
 
@@ -192,6 +190,11 @@ struct ResponseList {
   // audit-mismatch attributions (trailing, after the set tag; omitted
   // when empty — mismatch-free and audit-off jobs stay plain v8)
   std::vector<HealthVerdict> verdicts;
+  // negotiated wire codec (wire v12; csrc/codec.h kCodec* ids), LAST in
+  // the trailing chain and serialized only when >= 0: writing it forces
+  // the set tag + verdict count out explicitly so the parser can reach
+  // it, while codec-silent frames stay byte-for-byte v11-shaped
+  int64_t tuned_codec = -1;
 };
 
 // Steady-state claim: "every cache slot whose bit is set holds an entry
@@ -225,6 +228,9 @@ struct CachedExecFrame {
   int32_t process_set = 0;  // set tag (trailing; omitted when 0)
   // audit-mismatch attributions (trailing; omitted when empty)
   std::vector<HealthVerdict> verdicts;
+  // negotiated wire codec (wire v12) — same trailing-chain rules as on
+  // ResponseList: last, and serialized only when >= 0
+  int64_t tuned_codec = -1;
 };
 
 // Idle-tick liveness probe (fault domain): any control frame refreshes the

@@ -138,6 +138,15 @@ def allreduce(tensor, average: bool = True, name: str | None = None,
     """
     sid, nprocs = _pset(process_set)
     arr = _as_numpy(tensor)
+    if (compression is not Compression.none and arr.dtype == np.float32
+            and getattr(_state.engine(), "wire_codec", lambda: 0)() > 0):
+        # The engine's native wire codec (wire v12) already quantizes
+        # every fp32 segment on the wire — with per-segment error
+        # feedback, which the Python-side cast has no way to provide.
+        # Routing the raw fp32 through avoids quantizing TWICE (once
+        # here, once per hop); the caller's `compression=` intent is
+        # served by the negotiated codec instead.
+        compression = Compression.none
     comp, ctx = compression.compress(arr)
     if compression is Compression.int8:
         # Per-rank int8 scales cannot be summed, so the eager path models

@@ -107,6 +107,16 @@ class Int8Compressor(Compressor):
     dominates.  Reduction happens on the dequantized values (compress is
     applied before, decompress after the collective), so this trades 4x wire
     bytes for one quantization error per hop.
+
+    Contract (pinned by tests/test_compression.py, bit-mirrored per
+    segment by the native wire codec in ``csrc/codec.cc``):
+
+    * ``scale = max(absmax over FINITE values, 1e-12) / 127`` — non-finite
+      entries never poison the scale, and an all-zero tensor takes the
+      1e-12 floor so it roundtrips to exact zeros;
+    * ``q = clip(round-half-to-EVEN(v / scale), -127, 127)`` (numpy's
+      ``round``, the native's ``nearbyint``);
+    * NaN quantizes to 0; ``+/-Inf`` saturates to ``+/-127``.
     """
 
     @staticmethod
@@ -114,8 +124,12 @@ class Int8Compressor(Compressor):
         xp = _xp(tensor)
         if not xp.issubdtype(tensor.dtype, xp.floating):
             return tensor, None
-        scale = xp.maximum(xp.max(xp.abs(tensor)), 1e-12) / 127.0
-        q = xp.clip(xp.round(tensor / scale), -127, 127).astype(xp.int8)
+        a = xp.abs(tensor)
+        amax = xp.max(xp.where(xp.isfinite(a), a, 0))
+        scale = xp.maximum(amax, tensor.dtype.type(1e-12)) / tensor.dtype.type(
+            127.0)
+        r = xp.round(tensor / scale)
+        q = xp.clip(xp.where(xp.isnan(r), 0, r), -127, 127).astype(xp.int8)
         return q, (tensor.dtype, scale)
 
     @staticmethod

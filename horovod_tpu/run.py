@@ -383,6 +383,17 @@ def main(argv=None) -> int:
                          "across them — K congestion windows drive a "
                          "congested or paced link instead of one; results "
                          "are bitwise identical for any K")
+    ap.add_argument("--wire-codec", default=None,
+                    choices=("none", "fp16", "bf16", "int8"),
+                    metavar="CODEC",
+                    help="wire payload codec (sets HOROVOD_TPU_WIRE_CODEC "
+                         "for every worker; default none). fp32 ring "
+                         "payloads are encoded per segment on the sender "
+                         "and decoded before accumulate: fp16/bf16 halve "
+                         "wire bytes, int8 quarters them behind a per-"
+                         "segment fp32 scale with error-feedback "
+                         "residuals (HOROVOD_TPU_WIRE_CODEC_EF=0 "
+                         "disables). See docs/compression.md")
     ap.add_argument("--sg-threshold", type=int, default=None,
                     metavar="BYTES",
                     help="scatter-gather threshold (sets "
@@ -686,6 +697,8 @@ def main(argv=None) -> int:
             env["HOROVOD_TPU_WIRE_STRIPES"] = str(args.wire_stripes)
         if args.sg_threshold is not None:
             env["HOROVOD_TPU_SG_THRESHOLD_BYTES"] = str(args.sg_threshold)
+        if args.wire_codec is not None:
+            env["HOROVOD_TPU_WIRE_CODEC"] = args.wire_codec
         if args.health_sample is not None:
             env["HOROVOD_TPU_AUDIT_SAMPLE"] = str(args.health_sample)
         if args.health_fatal:

@@ -277,6 +277,26 @@ def _bind(lib):
         lib.hvd_drain_stats.restype = None
     except AttributeError:
         pass
+    try:
+        # negotiated wire codecs + error feedback (wire v12); same caveat
+        lib.hvd_codec_stats.argtypes = [ctypes.POINTER(ctypes.c_int64)]
+        lib.hvd_codec_stats.restype = None
+        lib.hvd_codec_residual_norm.restype = ctypes.c_double
+        lib.hvd_debug_set_wire_codec.argtypes = [ctypes.c_int64]
+        lib.hvd_debug_set_wire_codec.restype = None
+        lib.hvd_codec_encoded_bytes.argtypes = [ctypes.c_int64,
+                                                ctypes.c_int64]
+        lib.hvd_codec_encoded_bytes.restype = ctypes.c_int64
+        lib.hvd_codec_encode.argtypes = [
+            ctypes.c_int64, ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_void_p,
+        ]
+        lib.hvd_codec_encode.restype = ctypes.c_int64
+        lib.hvd_codec_decode.argtypes = [ctypes.c_int64, ctypes.c_void_p,
+                                         ctypes.c_int64, ctypes.c_void_p]
+        lib.hvd_codec_decode.restype = None
+    except AttributeError:
+        pass
     return lib
 
 
@@ -354,6 +374,7 @@ class NativeEngine(Engine):
         d.update(self._cache_stats())
         d.update(self._pipeline_stats())
         d.update(self._ring_stats())
+        d.update(self.codec_stats())
         d.update(self._fault_stats())
         d.update(self._wire_stats())
         d.update(self.world_stats())
@@ -737,6 +758,54 @@ class NativeEngine(Engine):
             min(d["ring_wire_idle_ns"] / max(d["ring_wire_ns"], 1), 1.0), 4)
         return d
 
+    def codec_stats(self) -> dict:
+        """Wire-codec counters for THIS rank (wire v12).  ``wire_codec``
+        is the ACTIVE codec id (0 none, 1 fp16, 2 bf16, 3 int8) — the
+        negotiated value, which a live retune moves in lockstep on every
+        rank.  ``codec_raw_bytes`` / ``codec_wire_bytes`` are counted
+        (pure functions of workload + codec geometry): their difference
+        is the bytes the codec kept off the wire, and their ratio gates
+        the bench (fp16 exactly 0.5x, int8 <= 0.30x).  ``codec_residual_
+        norm`` is the l2 norm parked in error feedback — plateaus when EF
+        is healthy, grows without bound when the codec is too aggressive.
+        Zeros when the loaded .so predates wire v12."""
+        fn = getattr(self._lib, "hvd_codec_stats", None)
+        keys = ("wire_codec", "codec_error_feedback", "codec_raw_bytes",
+                "codec_wire_bytes", "codec_collectives",
+                "codec_residual_tensors", "_codec_reserved",
+                "codec_residual_resets")
+        if fn is None:
+            d = dict.fromkeys(keys, 0)
+        else:
+            vals = (ctypes.c_int64 * 8)()
+            fn(vals)
+            d = {k: max(int(v), 0) for k, v in zip(keys, vals)}
+        d.pop("_codec_reserved")
+        d["codec_bytes_saved"] = max(
+            d["codec_raw_bytes"] - d["codec_wire_bytes"], 0)
+        nfn = getattr(self._lib, "hvd_codec_residual_norm", None)
+        d["codec_residual_norm"] = float(nfn()) if nfn is not None else 0.0
+        return d
+
+    def wire_codec(self) -> int:
+        """The ACTIVE negotiated wire codec id (0 when off or the loaded
+        .so predates wire v12) — the eager ``compression=`` path consults
+        this to avoid quantizing twice."""
+        fn = getattr(self._lib, "hvd_codec_stats", None)
+        if fn is None:
+            return 0
+        vals = (ctypes.c_int64 * 8)()
+        fn(vals)
+        return max(int(vals[0]), 0)
+
+    def set_wire_codec(self, codec: int) -> None:
+        """Live retune (rank 0): apply ``codec`` locally and ship it to
+        every worker on the next coordinator frame via the tuned_codec
+        knob — stream-ordered, so no collective runs with mixed codecs."""
+        fn = getattr(self._lib, "hvd_debug_set_wire_codec", None)
+        if fn is not None:
+            fn(int(codec))
+
     def _pipeline_stats(self) -> dict:
         """Data-plane pipeline counters for THIS rank.  ``pipeline_overlap_
         fraction`` is the share of wire time during which the negotiation
@@ -834,7 +903,8 @@ class NativeEngine(Engine):
                      "coord_failovers": 0, "arb_requests": 0,
                      "arb_link_verdicts": 0, "arb_dead_verdicts": 0,
                      "drains": 0, "trace_events": 0,
-                     "trace_events_dropped": 0}
+                     "trace_events_dropped": 0, "codec_bytes_saved": 0,
+                     "codec_residual_resets": 0}
         # per-stripe tx bytes: one labelled counter per stripe index
         stripe_seen = [0] * 8
         # per-process-set counters: one labelled series per set id
@@ -868,6 +938,9 @@ class NativeEngine(Engine):
             ("drains", telemetry.NATIVE_DRAINS),
             ("trace_events", telemetry.NATIVE_TRACE_EVENTS),
             ("trace_events_dropped", telemetry.NATIVE_TRACE_DROPPED),
+            ("codec_bytes_saved", telemetry.NATIVE_CODEC_BYTES_SAVED),
+            ("codec_residual_resets",
+             telemetry.NATIVE_CODEC_RESIDUAL_RESETS),
         )
         # the FAULT counters are process-wide by design (fault.h: they
         # survive engine re-init like the registry does) — seed their
@@ -974,6 +1047,10 @@ class NativeEngine(Engine):
             reg.gauge(telemetry.NATIVE_WIRE_STRIPES).set(d["wire_stripes"])
             reg.gauge(telemetry.NATIVE_SG_THRESHOLD).set(
                 d["sg_threshold_bytes"])
+            reg.gauge(telemetry.NATIVE_WIRE_CODEC).set(
+                d.get("wire_codec", 0))
+            reg.gauge(telemetry.NATIVE_CODEC_RESIDUAL_NORM).set(
+                d.get("codec_residual_norm", 0.0))
             if d["heartbeat_age_s"] >= 0:  # -1 = engine down: keep the
                 reg.gauge(telemetry.NATIVE_HEARTBEAT_AGE).set(  # last real age
                     d["heartbeat_age_s"])

@@ -13,18 +13,24 @@ from __future__ import annotations
 
 # csrc/wire.h — frame header
 WIRE_MAGIC = 0x48564457  # "HVDW" little-endian
-WIRE_VERSION = 11        # v11: graceful drain + fenced elections —
+WIRE_VERSION = 12        # v12: negotiated wire codecs — a trailing
+                         # `tuned_codec` knob on ResponseList and
+                         # CachedExecFrame (written only when >= 0,
+                         # ALWAYS after the verdicts block) ships the
+                         # coordinator's per-response payload encoding
+                         # (fp16 / bf16 / scaled-int8 with error
+                         # feedback), plus the wire_codec + codec_ef
+                         # fields in the bootstrap table.  Codec-off jobs
+                         # serialize byte-for-byte v11-shaped frames
+                         # (only the header's version value moved), which
+                         # keeps the steady-state ctrl-bytes CI gate at
+                         # 1.0000.
+                         # v11: graceful drain + fenced elections —
                          # kDrain planned-eviction frames (request /
                          # announce / ack), world-change kind 2 = drain
                          # (the gentle requeue-not-fail path), the
                          # election GENERATION on kCoordElect, and the
                          # generation field in the bootstrap table.
-                         # Pre-existing frame layouts other than
-                         # CoordElectFrame are unchanged from v10:
-                         # v10-shaped jobs serialize the same byte counts
-                         # (only the header's version value moved), which
-                         # keeps the steady-state ctrl-bytes CI gate at
-                         # 1.0000.
 
 # csrc/wire.h — reduce-scatter stripe alignment (wire v9): stripe c of an
 # n-byte tensor over m members starts at c * floor(n/m/64)*64 bytes, with
@@ -176,7 +182,27 @@ TUNED_KNOBS = (
     "tuned_pipeline_depth",
     "tuned_segment_bytes",
     "tuned_wire_stripes",
+    # wire v12: trailing-chain member — declared AFTER the verdicts block
+    # in both carrying structs and serialized LAST, so codec-off jobs
+    # (tuned_codec < 0 everywhere) stay byte-identical to v11 frames
+    "tuned_codec",
 )
+
+# csrc/codec.h — wire payload codec ids (wire v12), as the tuned_codec
+# knob, the bootstrap table, and HOROVOD_TPU_WIRE_CODEC carry them.
+# Wire-visible: every member of a ring must encode and decode
+# identically.  tools/check_wire_abi.py pins these against codec.h.
+CODEC_NONE = 0
+CODEC_FP16 = 1
+CODEC_BF16 = 2
+CODEC_INT8 = 3
+
+CODEC_IDS = {
+    "kCodecNone": CODEC_NONE,
+    "kCodecFp16": CODEC_FP16,
+    "kCodecBf16": CODEC_BF16,
+    "kCodecInt8": CODEC_INT8,
+}
 
 # csrc/common.h — OpType (the request/response op codes on the wire)
 OP_ALLREDUCE = 0

@@ -145,6 +145,18 @@ NATIVE_DRAINS = "hvd_drains_total"
 NATIVE_DRAIN_LATENCY = "hvd_drain_latency_seconds"
 NATIVE_COORD_GENERATION = "hvd_coord_generation"
 
+# negotiated wire codecs + error feedback (wire v12): the ACTIVE codec id
+# (0 none, 1 fp16, 2 bf16, 3 int8 — negotiated, so every rank reports the
+# same value), counted bytes the codec kept off the wire (raw - encoded;
+# fp16 halves, int8 quarters + scale blocks), the l2 norm parked in
+# error-feedback residuals (plateaus when EF is healthy, grows without
+# bound when the codec is too aggressive for the data), and residual
+# epoch resets (one per world change — survivors restart feedback clean)
+NATIVE_WIRE_CODEC = "hvd_wire_codec"
+NATIVE_CODEC_BYTES_SAVED = "hvd_codec_bytes_saved_total"
+NATIVE_CODEC_RESIDUAL_NORM = "hvd_codec_residual_norm"
+NATIVE_CODEC_RESIDUAL_RESETS = "hvd_codec_residual_resets_total"
+
 # flight-recorder progress mirror: counted events written/dropped by the
 # per-rank black box — the per-rank progress signal the fleet sentinel
 # scores against (a rank whose event counter stops moving while peers'
@@ -492,6 +504,8 @@ __all__ = [
     "NATIVE_COORD_FAILOVER_LATENCY", "NATIVE_ARB_REQUESTS",
     "NATIVE_ARB_LINK_VERDICTS", "NATIVE_ARB_DEAD_VERDICTS",
     "NATIVE_DRAINS", "NATIVE_DRAIN_LATENCY", "NATIVE_COORD_GENERATION",
+    "NATIVE_WIRE_CODEC", "NATIVE_CODEC_BYTES_SAVED",
+    "NATIVE_CODEC_RESIDUAL_NORM", "NATIVE_CODEC_RESIDUAL_RESETS",
     "NATIVE_TRACE_EVENTS", "NATIVE_TRACE_DROPPED",
     "SENTINEL_SCORE", "SENTINEL_STRAGGLER_EXCESS", "SENTINEL_CONVICTIONS",
     "SENTINEL_ACTS", "SENTINEL_WINDOWS", "SENTINEL_LAST_PHASE",

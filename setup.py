@@ -25,10 +25,11 @@ from setuptools.command.build_py import build_py as _build_py
 HERE = os.path.abspath(os.path.dirname(__file__))
 CSRC = os.path.join(HERE, "csrc")
 SOURCES = ["socket.cc", "wire.cc", "cache.cc", "shm.cc", "timeline.cc",
-           "autotune.cc", "fault.cc", "trace.cc", "health.cc", "engine.cc"]
+           "autotune.cc", "fault.cc", "trace.cc", "health.cc", "codec.cc",
+           "engine.cc"]
 HEADERS = ["common.h", "socket.h", "wire.h", "cache.h", "shm.h",
            "timeline.h", "autotune.h", "fault.h", "trace.h", "health.h",
-           "logging.h", "topo.h"]
+           "logging.h", "topo.h", "codec.h"]
 
 
 def _compiler() -> str:

@@ -776,6 +776,19 @@ def scenario_ring_equiv():
             assert d["sg_bytes_skipped"] > 0, d
         else:
             assert d["sg_bytes_skipped"] == 0, d
+    if os.environ.get("HVD_TEST_DUMP_DIAG") == "1":
+        # wire-codec v12 codec-off contract: the test compares these
+        # across env spellings (unset vs =none) — same results, same
+        # control-plane traffic, zero codec activity
+        import json
+
+        d = _diag()
+        with open(os.path.join(out_dir, f"ring_equiv_diag_r{r}.json"),
+                  "w") as f:
+            json.dump({k: d.get(k, 0) for k in
+                       ("negotiation_bytes_tx", "negotiation_bytes_rx",
+                        "wire_codec", "codec_wire_bytes",
+                        "codec_collectives")}, f)
     blob = b"".join(c.tobytes() for c in chunks)
     with open(os.path.join(out_dir, f"ring_equiv_r{r}.bin"), "wb") as f:
         f.write(blob)
@@ -2243,6 +2256,182 @@ def scenario_rs_elastic_loop():
     hvd.shutdown()
     print(f"rank {launch_rank}: rs elastic loop OK world={ws} "
           f"changes={changes_seen}", flush=True)
+
+
+def scenario_codec_equiv():
+    """Wire-codec (v12) bitwise battery for the elementwise 16-bit codecs:
+    with HOROVOD_TPU_WIRE_CODEC=fp16 (or bf16) every fp32 ring payload is
+    encoded on the sender and decoded before accumulate, so the 2-rank
+    allreduce result is EXACTLY computable in numpy from the codec's
+    roundtrip rt(v) = v.astype(half).astype(fp32): rank c owns stripe c
+    after phase 1 (csrc/engine.cc SegGeom: ring position c owns chunk c),
+    so out[stripe c] = rt(x_c + rt(x_{1-c})) — the owner adopts its own
+    phase-2 encode, so every rank sees the identical decoded bytes.
+
+    Asserts bitwise equality against that expectation per stripe, plus
+    the diagnostics contract: wire_codec negotiated, every collective
+    counted, and raw bytes exactly 2x wire bytes for a 16-bit codec."""
+    import ml_dtypes
+
+    from horovod_tpu.runtime import wire_abi
+
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    assert n == 2, "codec equiv expectation is derived for np=2"
+    codec = os.environ["HOROVOD_TPU_WIRE_CODEC"]
+    half = {"fp16": np.float16, "bf16": ml_dtypes.bfloat16}[codec]
+
+    def rt(v):
+        return v.astype(half).astype(np.float32)
+
+    rng = np.random.default_rng(42)  # same stream on every rank
+    sizes = (1, 7, 1001, 65537, 131072 + 5)
+    for sz in sizes:
+        base = (rng.standard_normal(sz) * 3).astype(np.float32)
+        xs = [base * np.float32(k + 1) for k in range(n)]
+        out = hvd.allreduce(xs[r].copy(), average=False,
+                            name=f"ce.{codec}.{sz}")
+        bounds = wire_abi.reducescatter_stripe_bounds(sz * 4, n)
+        expect = np.empty(sz, np.float32)
+        for c in range(n):
+            lo, hi = bounds[c] // 4, bounds[c + 1] // 4
+            expect[lo:hi] = rt(xs[c][lo:hi] + rt(xs[1 - c][lo:hi]))
+        assert out.tobytes() == expect.tobytes(), (
+            r, codec, sz,
+            int(np.argmax(out != expect)),
+        )
+    d = _diag()
+    assert d["wire_codec"] == {"fp16": 1, "bf16": 2}[codec], d
+    assert d["codec_collectives"] >= len(sizes), d
+    assert d["codec_wire_bytes"] > 0, d
+    # 16-bit codec: every encoded segment is exactly half its fp32 bytes
+    assert d["codec_raw_bytes"] == 2 * d["codec_wire_bytes"], d
+    hvd.shutdown()
+    print(f"rank {r}: codec equiv OK codec={codec}", flush=True)
+
+
+def scenario_codec_train():
+    """End-to-end training fidelity row for int8 + error feedback.  Every
+    rank's gradient carries rank-antisymmetric noise ~1000x the true
+    gradient (it cancels exactly in the fp32 sum), so the int8 scale is
+    noise-dominated (~1000/127) and per-step quantization error swamps
+    the true signal.  Error feedback carries each step's quantization
+    residual into the next encode, so the bias averages out and w -> 1;
+    with residuals disabled (HOROVOD_TPU_WIRE_CODEC_EF=0) the walk never
+    settles.  The test launches this worker once per codec mode and
+    compares the FINAL_ERR markers across runs."""
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    d_elems = int(os.environ.get("HVD_TEST_ELEMS", "64"))
+    steps = int(os.environ.get("HVD_TEST_STEPS", "80"))
+    lr, noise = 0.3, 1000.0
+    sign = np.float32(1.0 if r % 2 == 0 else -1.0)
+    rng = np.random.default_rng(7)  # same stream on every rank
+    # the noise is FIXED across steps: per-step fresh noise would dither
+    # the quantizer into an unbiased estimator and plain int8 would
+    # converge too.  With a frozen pattern the int8 lattice is frozen,
+    # the ~1-magnitude true gradient deterministically rounds away
+    # (scale/2 ~ 6), and only residual accumulation can recover it.
+    u = (rng.uniform(0.5, 1.5, d_elems)
+         * rng.choice([-1.0, 1.0], d_elems)).astype(np.float32)
+    w = 0.0
+    for step in range(steps):
+        g = np.full(d_elems, np.float32(w - 1.0)) + sign * noise * u
+        gbar = hvd.allreduce(g, average=True, name="train_g")
+        w -= lr * float(np.mean(gbar))
+    expect_codec = os.environ.get("HVD_TEST_EXPECT_CODEC")
+    if expect_codec is not None:
+        d = _diag()
+        assert d["wire_codec"] == int(expect_codec), d
+        if d["wire_codec"] > 0:
+            assert d["codec_collectives"] >= steps, d
+            if d["codec_error_feedback"]:
+                assert d["codec_residual_tensors"] > 0, d
+            else:
+                assert d["codec_residual_tensors"] == 0, d
+    hvd.shutdown()
+    print(f"rank {r}: codec train FINAL_ERR={abs(w - 1.0):.6f}", flush=True)
+
+
+def scenario_codec_elastic():
+    """Chaos row: a rank dies mid-COMPRESSED-ring (int8 + error feedback)
+    and the elastic shrink must still succeed — survivors retry, the
+    re-formed world reduces correctly, and every survivor's residual
+    state was reset with the epoch (stale residuals from the old world
+    must not leak into the new one: the membership, stripe bounds, and
+    segment keys all changed under them).  int8 roundtrip of all-ones is
+    only ~1e-7 accurate (scale = 1/127 is inexact in fp32), so the
+    sum-of-ones self-assert is tolerant where elastic_loop's is exact."""
+    import time as _time
+
+    hvd.init()
+    launch_rank = int(os.environ.get("HOROVOD_TPU_RANK", "0"))
+    elems = int(os.environ.get("HVD_TEST_ELEMS", "4096"))
+    steps_after = int(os.environ.get("HVD_TEST_STEPS_AFTER", "8"))
+    data = np.ones(elems, np.float32)
+    from horovod_tpu.runtime import state as _st
+
+    changes_seen = 0
+    post_steps = 0
+    done = 0.0
+    ws = hvd.size()
+    for step in range(100000):
+        size_before = hvd.size()
+        hs = [hvd.allreduce_async(data, average=False, name=f"cel{i}")
+              for i in range(4)]
+        try:
+            outs = [hvd.synchronize(h) for h in hs]
+            stop = hvd.broadcast(np.array([done], np.float32),
+                                 root_rank=0, name="cel_stop")
+        except hvd.WorldShrunkError as e:
+            print(f"rank {launch_rank}: RETRYABLE: {e}", flush=True)
+            for h in hs:
+                try:
+                    hvd.synchronize(h)
+                except (RuntimeError, ValueError):
+                    pass
+            deadline = _time.monotonic() + 60.0
+            while not hvd.world_changed():
+                if _time.monotonic() > deadline:
+                    raise SystemExit(
+                        f"rank {launch_rank}: world never re-formed")
+                _time.sleep(0.02)
+            continue
+        except RuntimeError as e:
+            if "shut down" in str(e):
+                break
+            raise
+        if stop[0] > 0:
+            ws = hvd.size()
+            break
+        ws = hvd.size()
+        for out in outs:
+            # int8 wire: sum-of-ones lands within codec tolerance of the
+            # live (or just-changed) world size, never anywhere else
+            assert (abs(out[0] - size_before) < 0.01
+                    or abs(out[0] - ws) < 0.01), (
+                launch_rank, out[0], size_before, ws)
+        d = _st.engine().world_stats()
+        if hvd.world_changed() or d["world_changes"] > changes_seen:
+            changes_seen = d["world_changes"]
+            print(f"rank {launch_rank}: WORLD_CHANGED size={ws} "
+                  f"changes={d['world_changes']}", flush=True)
+            post_steps = 0
+        if changes_seen >= 1:
+            post_steps += 1
+            if hvd.rank() == 0 and post_steps >= steps_after:
+                done = 1.0  # broadcast on the NEXT step stops everyone
+    else:
+        print(f"rank {launch_rank}: codec elastic ran dry", flush=True)
+        sys.exit(5)
+    dg = _diag()
+    assert dg["wire_codec"] == 3, dg
+    # the epoch reset fired: residuals existed (EF on, named tensors),
+    # and BeginWorldChange cleared them at least once
+    assert dg["codec_residual_resets"] >= 1, dg
+    hvd.shutdown()
+    print(f"rank {launch_rank}: codec elastic OK world={ws} "
+          f"resets={dg['codec_residual_resets']}", flush=True)
 
 
 if __name__ == "__main__":

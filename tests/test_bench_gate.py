@@ -24,6 +24,13 @@ the checked-in artifact:
   size, stripe layout, SG threshold) — drift means the stripe
   round-robin or the scatter-gather split silently changed shape,
   gated at 1% both directions.
+
+* wire-codec ``payload_bytes_per_step`` / ``codec_raw_bytes_per_step``
+  / ``codec_wire_bytes_per_step`` (BENCH_r19): exact functions of
+  (payload, ring size, codec) — fp16/bf16 halve every segment exactly,
+  int8 is n+4 bytes per n-elem segment — gated at 1% both directions,
+  plus the artifact-shape asserts (fp16 ratio exactly 0.5, int8 <= 0.30,
+  raw == 2x wire for the 16-bit codecs).
 """
 
 import json
@@ -423,13 +430,13 @@ def test_trace_overhead_gate():
 
 def test_wire_abi_version_in_sync():
     """tools/check_wire_abi.py reports a clean sync at the CURRENT wire
-    version (v11: graceful drain + fenced elections) — a version bump
-    without its Python mirror, or frame-layout drift, fails here."""
+    version (v12: negotiated wire codec knob) — a version bump without
+    its Python mirror, or frame-layout drift, fails here."""
     out = subprocess.run(
         [sys.executable, os.path.join(REPO, "tools", "check_wire_abi.py")],
         cwd=REPO, capture_output=True, text=True, timeout=120)
     assert out.returncode == 0, out.stdout + out.stderr
-    assert "version 11" in out.stdout, out.stdout
+    assert "version 12" in out.stdout, out.stdout
 
 
 def test_health_flip_attribution_artifact():
@@ -598,6 +605,94 @@ def test_sentinel_artifact_counted_series():
     assert p["retryable_pre_join_max"] == 0, p
     assert p["zero_retryable"] is True, p
     assert p["ledger_records"] >= 3, p  # observe + conviction + acts
+
+
+def test_codec_counted_series_gate():
+    """Fresh compressed-ring steps at the BENCH_r19 workload shape
+    (-np 2, simulated cross-host links so every byte rides a counted TCP
+    stripe) vs the artifact: payload bytes/step, codec raw bytes/step,
+    and codec wire bytes/step are exact functions of (payload, ring
+    size, codec) — fp16 halves EVERY segment (2n bytes for n elems),
+    int8 writes n+4 (one fp32 scale block per segment) — so a drift
+    beyond 1% in EITHER direction means the encode geometry or the
+    segment routing silently changed shape, not noise.  The gate run
+    skips the artifact's pacing (pacing changes WHEN bytes move, never
+    how many) and uses a short loop (the series are per-step medians,
+    step-count independent past the warm step)."""
+    old = _baseline("BENCH_r19.json")
+    mb = int(old.get("config", {}).get("mb", 32))
+    fresh = {}
+    for codec in ("none", "fp16", "int8"):
+        fresh[codec] = _bench_worker_json(
+            2,
+            ["--compress-worker", "--compress-steps", "3",
+             "--compress-mb", str(mb)],
+            {"HOROVOD_TPU_PIPELINE_DEPTH": "1",
+             "HOROVOD_TPU_CYCLE_TIME": "20",
+             "HOROVOD_TPU_BURST_WINDOW_US": "20000",
+             "HOROVOD_TPU_SG_THRESHOLD_BYTES": "0",
+             "HOROVOD_TPU_WIRE_CODEC": codec,
+             "HVD_RING_SIMHOSTS": "1",
+             "HOROVOD_TPU_HIERARCHICAL_ALLREDUCE": "0"},
+            timeout=300)
+        assert fresh[codec].get("wire_codec") == \
+            {"none": 0, "fp16": 1, "int8": 3}[codec], fresh[codec]
+    new = {"np2": fresh}
+    series_base = ["np2.none.payload_bytes_per_step",
+                   "np2.fp16.payload_bytes_per_step",
+                   "np2.fp16.codec_raw_bytes_per_step",
+                   "np2.fp16.codec_wire_bytes_per_step",
+                   "np2.int8.payload_bytes_per_step",
+                   "np2.int8.codec_wire_bytes_per_step"]
+    for direction in (":lower", ":higher"):
+        rows, code = bench_compare.compare(
+            old, new, [s + direction for s in series_base],
+            max_regression_pct=1.0)
+        assert code == 0, (direction, rows)
+
+
+def test_codec_artifact_ratios():
+    """The acceptance shape, asserted on the checked-in BENCH_r19
+    artifact's counted INTEGER series: fp16/bf16 move exactly half the
+    uncompressed payload (every fp32 segment is 2n bytes on the wire —
+    0.5x to the byte, no scale overhead), int8 lands at <= 0.30x (0.25x
+    + one 4-byte scale block per segment), the raw-vs-wire codec
+    counters agree with the payload arithmetic (raw == 2x wire for the
+    16-bit codecs; raw == none's payload for every codec — the encoder
+    saw every byte the uncompressed run would have moved), and int8
+    with EF on reports a non-zero plateauing residual norm while the
+    exact codecs report 0.  Wall-clock speedups are recorded with the
+    cpu_saturated caveat and deliberately NOT gated."""
+    r19 = _baseline("BENCH_r19.json")
+    points = 0
+    for np_key in ("np2", "np4"):
+        p = r19.get(np_key)
+        if not p:
+            continue
+        points += 1
+        base = p["none"]["payload_bytes_per_step"]
+        assert base > 0 and p["none"]["codec_wire_bytes_per_step"] == 0, p
+        for codec in ("fp16", "bf16"):
+            row = p[codec]
+            # exactly half, on integer byte counts
+            assert row["payload_bytes_per_step"] * 2 == base, (codec, row)
+            assert row["codec_raw_bytes_per_step"] == base, (codec, row)
+            assert row["codec_raw_bytes_per_step"] == \
+                2 * row["codec_wire_bytes_per_step"], (codec, row)
+            assert row["codec_residual_norm"] == 0.0, (codec, row)
+            assert p[f"{codec}_payload_ratio"] == 0.5, p
+        i8 = p["int8"]
+        assert i8["payload_bytes_per_step"] <= 0.30 * base, i8
+        assert i8["codec_raw_bytes_per_step"] == base, i8
+        # wire = raw/4 + 4 bytes per segment: strictly above a pure 0.25x
+        assert 0.25 * base < i8["codec_wire_bytes_per_step"] \
+            <= 0.26 * base, i8
+        assert i8["codec_error_feedback"] == 1, i8
+        assert i8["codec_residual_norm"] > 0.0, i8
+        assert p["int8_payload_ratio"] <= 0.30, p
+        for codec in ("fp16", "bf16", "int8"):
+            assert p.get(f"speedup_{codec}_vs_none") is not None, p
+    assert points == 2, r19
 
 
 def test_sentinel_observer_purity_gate():

@@ -83,6 +83,41 @@ class TestEagerNumpy:
         _, out = _roundtrip(Compression.int8, x)
         np.testing.assert_array_equal(out, x)
 
+    def test_int8_zero_tensor_exact_scale_floor(self):
+        """All-zero contract (pinned; the native wire codec bit-mirrors
+        it): the scale takes the 1e-12 floor rather than dividing by
+        zero, every quantum is exactly 0, and decompress returns EXACT
+        zeros — bitwise, not just allclose."""
+        x = np.zeros(33, np.float32)
+        wire, ctx = Compression.int8.compress(x)
+        assert not np.any(np.asarray(wire))
+        assert np.float32(ctx[1]) == np.float32(1e-12) / np.float32(127.0)
+        out = Compression.int8.decompress(wire, ctx)
+        assert out.tobytes() == x.tobytes()
+
+    def test_int8_nonfinite_contract(self):
+        """Inf/NaN rows (pinned): non-finite values are EXCLUDED from the
+        absmax — one bad gradient element must not flatten the whole
+        tensor's precision — NaN quantizes to 0, +/-Inf saturates to
+        +/-127, and finite neighbors keep their finite-only scale."""
+        x = np.array([np.nan, np.inf, -np.inf, 2.0, -1.0, 0.5], np.float32)
+        with np.errstate(invalid="ignore"):
+            wire, ctx = Compression.int8.compress(x)
+        assert np.float32(ctx[1]) == np.float32(2.0) / np.float32(127.0)
+        assert list(np.asarray(wire)) == [0, 127, -127, 127, -64, 32]
+        out = Compression.int8.decompress(wire, ctx)
+        assert np.all(np.isfinite(out))
+        np.testing.assert_allclose(out[3:], x[3:], atol=float(ctx[1]) / 2)
+
+    def test_int8_round_half_to_even(self):
+        """The lattice uses numpy's round (half-to-EVEN), same as the
+        native codec's nearbyint — half-up would drift the parity test."""
+        scale = np.float32(127.0) / np.float32(127.0)  # absmax 127 -> scale 1
+        x = np.array([127.0, 0.5, 1.5, 2.5, -0.5, -1.5], np.float32)
+        wire, _ = Compression.int8.compress(x)
+        assert list(np.asarray(wire)) == [127, 0, 2, 2, 0, -2], (
+            list(np.asarray(wire)), scale)
+
     def test_fp64_restored(self):
         x = _payload(np.float64)
         for comp in (Compression.fp16, Compression.bf16, Compression.int8):

@@ -34,7 +34,7 @@ def test_checker_detects_version_drift():
     """The guard must actually bite: a simulated version bump in wire.h
     without a Python update is reported."""
     wire_h, common_h = _headers()
-    tampered = wire_h.replace("kWireVersion = 11", "kWireVersion = 12")
+    tampered = wire_h.replace("kWireVersion = 12", "kWireVersion = 13")
     assert tampered != wire_h, "kWireVersion moved; update this test"
     problems = check_wire_abi.check(tampered, common_h)
     assert any("kWireVersion" in p for p in problems), problems
@@ -79,7 +79,7 @@ def test_v6_tuned_wire_stripes_present():
     BOTH response-side frames and the Python mirror tracks the knob list."""
     from horovod_tpu.runtime import wire_abi
 
-    assert wire_abi.TUNED_KNOBS[-1] == "tuned_wire_stripes"
+    assert "tuned_wire_stripes" in wire_abi.TUNED_KNOBS
     wire_h, _ = _headers()
     assert wire_h.count("int64_t tuned_wire_stripes") == 2
 
@@ -153,19 +153,18 @@ def test_v10_failover_collateral_present():
 
 def test_v11_drain_collateral_present():
     """The graceful-drain + fenced-election wire v11 collateral: the
-    version is 11 on both sides, the kDrain frame type exists at its
-    pinned id, the drain phase codes and world-change kinds match their
-    mirrors, and CoordElectFrame carries the election generation."""
+    kDrain frame type exists at its pinned id, the drain phase codes and
+    world-change kinds match their mirrors, and CoordElectFrame carries
+    the election generation (the version pin itself moved to the v12
+    test)."""
     from horovod_tpu.runtime import wire_abi
 
-    assert wire_abi.WIRE_VERSION == 11
     assert wire_abi.FRAME_TYPES["kDrain"] == wire_abi.FRAME_DRAIN == 12
     assert (wire_abi.DRAIN_REQUEST, wire_abi.DRAIN_ANNOUNCE,
             wire_abi.DRAIN_ACK) == (0, 1, 2)
     assert (wire_abi.WORLD_CHANGE_SHRINK, wire_abi.WORLD_CHANGE_JOIN,
             wire_abi.WORLD_CHANGE_DRAIN) == (0, 1, 2)
     wire_h, _ = _headers()
-    assert "kWireVersion = 11" in wire_h
     for needle in ("kDrain = 12", "kDrainRequest = 0",
                    "kDrainAnnounce = 1", "kDrainAck = 2",
                    "kWorldChangeShrink = 0", "kWorldChangeJoin = 1",
@@ -258,7 +257,7 @@ def test_version_mismatch_message_names_both_versions():
     lib.hvd_free_cstr.argtypes = [ctypes.c_void_p]
     lib.hvd_wire_version.restype = ctypes.c_int
 
-    assert lib.hvd_wire_version() == wire_abi.WIRE_VERSION == 11
+    assert lib.hvd_wire_version() == wire_abi.WIRE_VERSION == 12
 
     def parse_error(buf: bytes) -> str | None:
         p = lib.hvd_frame_parse_error(buf, len(buf))
@@ -269,19 +268,19 @@ def test_version_mismatch_message_names_both_versions():
         finally:
             lib.hvd_free_cstr(p)
 
-    # v10 <-> v11 (the previous release still running somewhere): the
-    # drain/fencing version bump must surface as the descriptive
-    # both-versions message, exactly like every previous bump
-    stale = wire_abi.frame_header(version=10) + b"\x00" * 16
+    # v11 <-> v12 (the previous release still running somewhere): the
+    # codec version bump must surface as the descriptive both-versions
+    # message, exactly like every previous bump
+    stale = wire_abi.frame_header(version=11) + b"\x00" * 16
     msg = parse_error(stale)
     assert msg is not None
-    assert "v10" in msg and "v11" in msg and "libhvdtpu.so" in msg, msg
+    assert "v11" in msg and "v12" in msg and "libhvdtpu.so" in msg, msg
 
     # an even older v7 header: same contract, both versions named
     stale = wire_abi.frame_header(version=7) + b"\x00" * 16
     msg = parse_error(stale)
     assert msg is not None
-    assert "v7" in msg and "v11" in msg and "libhvdtpu.so" in msg, msg
+    assert "v7" in msg and "v12" in msg and "libhvdtpu.so" in msg, msg
 
     # current-version garbage is a parse error, not a version error
     import struct
@@ -294,3 +293,64 @@ def test_version_mismatch_message_names_both_versions():
     hb = wire_abi.frame_header(
         frame_type=wire_abi.FRAME_HEARTBEAT) + struct.pack("<i", 3)
     assert parse_error(hb) is None
+
+def _codec_header():
+    with open(os.path.join(REPO, "csrc", "codec.h")) as f:
+        return f.read()
+
+
+def test_v12_codec_collateral_present():
+    """The negotiated-codec wire v12 collateral: the version is 12 on both
+    sides, tuned_codec is the LAST knob in the mirror and rides BOTH
+    response-side frames after their verdicts block, and the codec ids
+    match csrc/codec.h."""
+    from horovod_tpu.runtime import wire_abi
+
+    assert wire_abi.WIRE_VERSION == 12
+    assert wire_abi.TUNED_KNOBS[-1] == "tuned_codec"
+    assert (wire_abi.CODEC_NONE, wire_abi.CODEC_FP16, wire_abi.CODEC_BF16,
+            wire_abi.CODEC_INT8) == (0, 1, 2, 3)
+    wire_h, common_h = _headers()
+    assert "kWireVersion = 12" in wire_h
+    assert wire_h.count("int64_t tuned_codec") == 2
+    codec_h = _codec_header()
+    for needle in ("kCodecNone = 0", "kCodecFp16 = 1", "kCodecBf16 = 2",
+                   "kCodecInt8 = 3"):
+        assert needle in codec_h, needle
+    assert check_wire_abi.check(wire_h, common_h, codec_h) == []
+
+
+def test_checker_detects_codec_id_drift():
+    """A renumbered codec id in codec.h without the Python mirror is
+    reported — half the ring would decode fp16 as bf16 with no
+    frame-layout change, so each value gets its own pin."""
+    wire_h, common_h = _headers()
+    codec_h = _codec_header()
+    tampered = codec_h.replace("kCodecBf16 = 2", "kCodecBf16 = 7")
+    assert tampered != codec_h, "kCodecBf16 moved; update this test"
+    problems = check_wire_abi.check(wire_h, common_h, tampered)
+    assert any("codec ids" in p for p in problems), problems
+
+
+def test_checker_detects_codec_knob_order_drift():
+    """tuned_codec declared BEFORE the verdicts block breaks the
+    trailing-chain serialization (codec-off frames stop being
+    byte-identical to v11) — the checker must bite on the reorder."""
+    wire_h, common_h = _headers()
+    codec_h = _codec_header()
+    # move the ResponseList tuned_codec declaration up next to the other
+    # knobs (before verdicts): delete the trailing one, re-insert early
+    import re
+
+    m = re.search(r"struct\s+ResponseList\s*\{(.*?)\n\};", wire_h, re.S)
+    body = m.group(1)
+    decl = next(ln for ln in body.splitlines()
+                if "int64_t tuned_codec" in ln)
+    reordered = body.replace("\n" + decl, "", 1).replace(
+        "int64_t tuned_fusion",
+        decl.strip() + "\n  int64_t tuned_fusion", 1)
+    tampered = wire_h.replace(body, reordered, 1)
+    assert tampered != wire_h, "ResponseList moved; update this test"
+    problems = check_wire_abi.check(tampered, common_h, codec_h)
+    assert any("tuned_codec" in p and "verdicts" in p
+               for p in problems), problems

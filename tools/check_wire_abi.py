@@ -96,9 +96,12 @@ def _trailing_after_set_tag(text: str, struct: str, member: str) -> bool:
     return 0 <= set_at < mem_at
 
 
-def check(wire_h: str, common_h: str) -> list[str]:
+def check(wire_h: str, common_h: str,
+          codec_h: str | None = None) -> list[str]:
     """All drift problems between the C++ headers' text and the Python
-    mirrors; empty list = in sync."""
+    mirrors; empty list = in sync.  ``codec_h`` (csrc/codec.h, wire v12)
+    is optional so pre-v12 callers and doctored-text drift tests keep
+    working; when given, the codec ids are pinned too."""
     from horovod_tpu.runtime import native, wire_abi
 
     problems: list[str] = []
@@ -238,6 +241,35 @@ def check(wire_h: str, common_h: str) -> list[str]:
             "CoordElectFrame: wire.h lost the v11 `generation` field the "
             "election fences serialize")
 
+    # negotiated wire codecs (v12): tuned_codec rides the TUNED_KNOBS
+    # comparison above (declaration order includes it LAST), but its
+    # trailing-chain position is a separate contract — it must be
+    # declared AFTER the verdicts block in both carriers, or codec-off
+    # frames stop being byte-identical to v11 and the parser misreads
+    # every sampled-audit frame
+    for struct in ("ResponseList", "CachedExecFrame"):
+        m = re.search(r"struct\s+" + struct + r"\s*\{(.*?)\n\};", wire_h,
+                      re.S)
+        body = m.group(1) if m else ""
+        v_at = body.find("verdicts")
+        c_at = body.find("tuned_codec")
+        if not (0 <= v_at < c_at):
+            problems.append(
+                f"{struct}: `tuned_codec` must be declared after "
+                "`verdicts` (trailing-chain serialization order)")
+    # the codec ids themselves ride the knob, the bootstrap table, and
+    # HOROVOD_TPU_WIRE_CODEC — a renumbering would make half the ring
+    # decode fp16 as bf16 without any frame-layout change, so each value
+    # gets its own pin against csrc/codec.h
+    if codec_h is not None:
+        codecs = {name: _parse_constant(codec_h, name)
+                  for name in wire_abi.CODEC_IDS}
+        got = {k: v for k, v in codecs.items() if v is not None}
+        if got != wire_abi.CODEC_IDS:
+            problems.append(
+                f"codec ids: codec.h has {got}, wire_abi.py CODEC_IDS "
+                f"has {wire_abi.CODEC_IDS}")
+
     ops = _parse_enum(common_h, "OpType")
     if ops != wire_abi.OP_TYPES:
         problems.append(
@@ -279,7 +311,12 @@ def main() -> int:
         wire_h = f.read()
     with open(os.path.join(csrc, "common.h")) as f:
         common_h = f.read()
-    problems = check(wire_h, common_h)
+    codec_path = os.path.join(csrc, "codec.h")
+    codec_h = None
+    if os.path.exists(codec_path):
+        with open(codec_path) as f:
+            codec_h = f.read()
+    problems = check(wire_h, common_h, codec_h)
     if problems:
         print("wire ABI drift between csrc headers and the Python mirror:")
         for p in problems:
