@@ -56,6 +56,7 @@
 #include "timeline.h"
 #include "topo.h"
 #include "trace.h"
+#include "uring.h"
 #include "wire.h"
 
 namespace hvdtpu {
@@ -827,6 +828,54 @@ class Engine {
   int Enqueue(OpType op, const std::string& name, DType dtype,
               const std::vector<int64_t>& dims, const void* data,
               int root_rank, void* user_out, int process_set = 0);
+  // Install the submit priority future Enqueues of `name` will carry
+  // (wire v13): clamped to [kPriorityMin, kPriorityMax]; 0 removes the
+  // entry so the name goes back to the priority-less (v12-identical)
+  // fast path.  Callable from any frontend thread.
+  void SetTensorPriority(const std::string& name, int32_t priority) {
+    if (priority < kPriorityMin) priority = kPriorityMin;
+    if (priority > kPriorityMax) priority = kPriorityMax;
+    std::lock_guard<std::mutex> plk(prio_mu_);
+    if (priority == 0)
+      prio_map_.erase(name);
+    else
+      prio_map_[name] = priority;
+  }
+
+  // TTFNT (time-to-first-needed-tensor): armed when a broadcast round is
+  // dispatched, with the round's highest LOCALLY-prioritized tensor as the
+  // needed one; NoteTensorDone stops the clock when it completes.  The
+  // windowed mean (hvd_ttfnt_seconds) is the wall-clock face of the
+  // priority schedule: consumer-order rounds hand the first-needed tensor
+  // back sooner even when the round's total time is unchanged.
+  void ArmTtfnt(const ResponseList& rl) {
+    std::lock_guard<std::mutex> plk(prio_mu_);
+    if (ttfnt_armed_ || prio_map_.empty()) return;
+    int32_t best = 0;
+    const std::string* best_name = nullptr;
+    for (const Response& r : rl.responses) {
+      if (r.op == OpType::kError || r.op == OpType::kProcessSet) continue;
+      for (const std::string& nm : r.names) {
+        auto pit = prio_map_.find(nm);
+        if (pit != prio_map_.end() &&
+            (best_name == nullptr || pit->second > best)) {
+          best = pit->second;
+          best_name = &nm;
+        }
+      }
+    }
+    if (!best_name) return;
+    ttfnt_armed_ = true;
+    ttfnt_name_ = *best_name;
+    ttfnt_t0_ = NowNs();
+  }
+  void NoteTensorDone(const std::string& name) {
+    std::lock_guard<std::mutex> plk(prio_mu_);
+    if (!ttfnt_armed_ || name != ttfnt_name_) return;
+    ttfnt_armed_ = false;
+    ttfnt_ns_.fetch_add(NowNs() - ttfnt_t0_, std::memory_order_relaxed);
+    ttfnt_rounds_.fetch_add(1, std::memory_order_relaxed);
+  }
   // Collective registration of a new process set: every WORLD rank calls
   // this with the same sorted member list; the returned handle completes
   // with the coordinator-assigned set id as a 4-byte result.
@@ -990,6 +1039,28 @@ class Engine {
       for (const auto& l : peers_) b += l.stripe_tx_bytes(s);
       out[8 + s] = b;
     }
+  }
+
+  // Priority-schedule + io_uring data-plane statistics (wire v13), in
+  // order: {wire syscalls, uring SQEs submitted, uring enters, io_uring
+  // active, io_uring supported, TTFNT ns total, TTFNT rounds, priority
+  // rounds, priority first-position hits, priority sched enabled}.  The
+  // syscall and position series are COUNTED — pure functions of workload +
+  // transport — which is what lets the bench gate "3x fewer syscalls" and
+  // "first-needed tensor scheduled first" at 1% on a noisy shared host.
+  void DataplaneStats(int64_t out[16]) const {
+    WireSyscallCounters& wc = WireCounters();
+    out[0] = wc.syscalls.load(std::memory_order_relaxed);
+    out[1] = wc.uring_sqes.load(std::memory_order_relaxed);
+    out[2] = wc.uring_enters.load(std::memory_order_relaxed);
+    out[3] = io_uring_on_.load(std::memory_order_relaxed) ? 1 : 0;
+    out[4] = UringWire::Supported() ? 1 : 0;
+    out[5] = ttfnt_ns_.load(std::memory_order_relaxed);
+    out[6] = ttfnt_rounds_.load(std::memory_order_relaxed);
+    out[7] = prio_rounds_.load(std::memory_order_relaxed);
+    out[8] = prio_first_hits_.load(std::memory_order_relaxed);
+    out[9] = prio_sched_on_.load(std::memory_order_relaxed) ? 1 : 0;
+    for (int i = 10; i < 16; i++) out[i] = 0;
   }
 
   // Topology descriptor as JSON (diagnostics/tests).
@@ -1742,6 +1813,27 @@ class Engine {
   std::atomic<int64_t> pack_bytes_total_{0};  // bytes memcpy'd into fusion
   std::atomic<int64_t> sg_bytes_total_{0};    // pack memcpys avoided
   std::atomic<int64_t> alltoall_windowed_{0};
+  // -- priority response scheduling + io_uring transport (wire v13) -------
+  // prio_map_: tensor name -> submit priority, written by frontend threads
+  // (SetTensorPriority) and read by Enqueue; guarded by prio_mu_.  The
+  // scheduling itself (prio_seen_ latch, FuseReady ordering) is
+  // negotiation-thread-only; counters are atomics for the diag thread.
+  mutable std::mutex prio_mu_;
+  std::unordered_map<std::string, int32_t> prio_map_;
+  bool prio_seen_ = false;  // a non-zero priority arrived (coordinator)
+  std::atomic<bool> prio_sched_on_{true};  // HOROVOD_TPU_PRIORITY_SCHED
+  std::atomic<int64_t> prio_rounds_{0};       // rounds scheduled by priority
+  std::atomic<int64_t> prio_first_hits_{0};   // …whose head was the max-prio
+  // time-to-first-needed-tensor: armed per broadcast round at dispatch,
+  // disarmed when the highest-priority tensor of that round completes
+  bool ttfnt_armed_ = false;        // bg thread only
+  std::string ttfnt_name_;
+  int64_t ttfnt_t0_ = 0;
+  std::atomic<int64_t> ttfnt_ns_{0};
+  std::atomic<int64_t> ttfnt_rounds_{0};
+  bool io_uring_requested_ = false;        // env ask (read at Init)
+  std::atomic<bool> io_uring_on_{false};   // granted by the kernel probe
+  bool io_uring_fallback_logged_ = false;
   // The world communicator: the Comm every thread uses unless a set
   // executor installed its own (monolithic-ring idle attribution rides
   // Comm::ring_idle_sink, per executing communicator).  Rebuilt by
@@ -2082,6 +2174,17 @@ Status Engine::Init(const std::string& host, int port, int rank, int size) {
   if (stripe_quantum_ > (8 << 20)) stripe_quantum_ = 8 << 20;
   sg_threshold_ = EnvInt64("HOROVOD_TPU_SG_THRESHOLD_BYTES", 4 << 20);
   if (sg_threshold_ < 0) sg_threshold_ = 0;
+  // io_uring wire transport (wire v13): a RANK-LOCAL choice, unlike every
+  // shipped knob above — the transport only changes this rank's syscall
+  // pattern, the bytes on the wire are identical, so a poll rank and a
+  // uring rank interoperate freely.  Requested via env, granted only if
+  // the kernel probe passes at mesh-build time.
+  io_uring_requested_ = EnvFlag("HOROVOD_TPU_IO_URING");
+  // priority response scheduling (wire v13): enabled by default but inert
+  // until some rank submits a non-zero priority (prio_seen_); =0 keeps
+  // the counters live but restores FIFO order — the bench's control arm
+  // and the bisect knob.
+  prio_sched_on_ = !EnvFlagIsZero("HOROVOD_TPU_PRIORITY_SCHED");
   // stripe autotuning changes how many sockets the mesh pre-opens, so
   // the opt-in flag is rank-0-decided and table-shipped like the stripe
   // counts themselves: a flag set on only one side would make connect
@@ -2568,6 +2671,27 @@ Status Engine::BuildWorld() {
         }
       LOG_RANK(Debug, rank_) << "cross-host pacing " << pace_mbps
                              << " MB/s on " << paced << " peer socket(s)";
+    }
+    // io_uring wire transport: flip every data-plane link after the mesh
+    // handshakes (which ran over plain sends) so the kernel probe runs
+    // once and the whole mesh shares one ring.  Unsupported kernels log
+    // ONE actionable line and keep poll — never an error: the transport
+    // is a syscall-pattern choice, not a wire-format one.
+    if (io_uring_requested_) {
+      bool granted = true;
+      for (int j = 0; j < size_; j++)
+        if (j != rank_ && peers_[j].valid()) granted &= peers_[j].EnableUring();
+      io_uring_on_ = granted && UringWire::Get().Active();
+      if (!io_uring_on_ && !io_uring_fallback_logged_) {
+        io_uring_fallback_logged_ = true;
+        LOG_RANK(Warning, rank_)
+            << "poll: io_uring unavailable (HOROVOD_TPU_IO_URING=1 but the "
+               "kernel probe failed — need io_uring_setup + "
+               "IORING_FEAT_EXT_ARG, Linux 5.11+); wire stays on poll";
+      } else if (io_uring_on_) {
+        LOG_RANK(Debug, rank_) << "wire transport: io_uring (batched "
+                                  "submit, one enter per park)";
+      }
     }
   }
   // hierarchical data plane: default on exactly when the topology is
@@ -4650,6 +4774,10 @@ Status Engine::BuildSetComm(ProcessSet& ps) {
     for (int g : ns.members)
       if (g != rank_ && hashes_[g] != hashes_[rank_])
         ps.links[g].SetPacing(pace_mbps * 1e6);
+  // set sub-meshes ride the same process-wide ring as the world mesh
+  if (io_uring_requested_ && io_uring_on_)
+    for (int g : ns.members)
+      if (g != rank_ && ps.links[g].valid()) ps.links[g].EnableUring();
   // same-host members get their own shm rings, namespaced per set so two
   // sets' rings (and the world's) never collide
   if (shm_on_) {
@@ -5148,6 +5276,14 @@ int Engine::Enqueue(OpType op, const std::string& name, DType dtype,
   e.req.root_rank = root_rank;
   e.req.dims = dims;
   e.req.set = process_set;
+  {
+    // priority (wire v13): names without an installed priority submit 0,
+    // which keeps the RequestList's trailing block absent and the frames
+    // byte-identical to v12
+    std::lock_guard<std::mutex> plk(prio_mu_);
+    auto pit = prio_map_.find(name);
+    if (pit != prio_map_.end()) e.req.priority = pit->second;
+  }
   e.data = std::move(staged);
   e.nbytes = nbytes;
   e.handle = handle;
@@ -5941,6 +6077,7 @@ void Engine::WorkerTick(RequestList& local, bool* stop) {
       auto snap = SnapshotReqs(*ns, rl);
       ProcessSet* ps = rl.process_set != 0 ? FindSet(rl.process_set)
                                            : nullptr;
+      ArmTtfnt(rl);
       for (const Response& r : rl.responses) {
         if (ps != nullptr)
           DispatchSet(*ps, r);
@@ -6380,6 +6517,7 @@ bool Engine::CoordinatorTick(RequestList& local) {
     Dispatch(resp);
   }
   auto snap = SnapshotReqs(neg0_, out);
+  ArmTtfnt(out);
   for (const Response& r : out.responses) Dispatch(r);
   ApplyCacheMutations(neg0_, out, snap);
   return shutdown;
@@ -6412,6 +6550,10 @@ void Engine::HandleArrivedRequests(NegState& ns, const RequestList& list,
       timeline_.NegotiateStart(r.name, OpName(r.op));
     }
     neg.ranks.insert(r.rank);
+    // a single non-zero priority anywhere flips the coordinator from
+    // arrival-order to priority-order scheduling for the rest of the job
+    // (priority-less jobs never pay the sort, and stay bitwise-FIFO)
+    if (r.priority != 0) prio_seen_ = true;
     neg.received.push_back(r);
     timeline_.NegotiateRankReady(r.name, r.rank);
     if (static_cast<int>(neg.ranks.size()) == ns.expected()) {
@@ -6509,11 +6651,56 @@ void Engine::FuseReady(NegState& ns, ResponseList* out) {
     out->responses.push_back(std::move(ns.error_ready.front()));
     ns.error_ready.pop_front();
   }
+  // Priority response scheduling (wire v13): once any rank has submitted a
+  // non-zero priority (prio_seen_, latched for the rest of the job), each
+  // round's ready queue is re-ordered by (max submitted priority desc,
+  // name asc) — consumer order — instead of arrival order.  The key
+  // depends only on the round's membership, never on which rank's request
+  // arrived first, so every coordinator incarnation schedules identically.
+  // The counters run whenever priorities are in play, sched on OR off, so
+  // the FIFO control arm (HOROVOD_TPU_PRIORITY_SCHED=0) produces the same
+  // counted response-order series the bench gate compares against.
+  auto prio_of = [&ns](const std::string& nm) {
+    int32_t p = kPriorityMin;
+    auto mit = ns.message_table.find(nm);
+    if (mit != ns.message_table.end())
+      for (const Request& q : mit->second.received)
+        if (q.priority > p) p = q.priority;
+    return p;
+  };
+  const bool prio_any = prio_seen_ && !ns.ready.empty();
+  int32_t round_max = kPriorityMin;
+  if (prio_any) {
+    std::vector<std::pair<int32_t, std::string>> keyed;
+    keyed.reserve(ns.ready.size());
+    for (std::string& nm : ns.ready) keyed.emplace_back(prio_of(nm),
+                                                        std::move(nm));
+    if (prio_sched_on_.load(std::memory_order_relaxed))
+      std::sort(keyed.begin(), keyed.end(),
+                [](const std::pair<int32_t, std::string>& a,
+                   const std::pair<int32_t, std::string>& b) {
+                  return a.first != b.first ? a.first > b.first
+                                            : a.second < b.second;
+                });
+    ns.ready.clear();
+    for (auto& kv : keyed) {
+      if (kv.first > round_max) round_max = kv.first;
+      ns.ready.push_back(std::move(kv.second));
+    }
+  }
+  bool head_set = false;
+  int32_t head_prio = kPriorityMin;
   while (!ns.ready.empty()) {
     std::string name = std::move(ns.ready.front());
     ns.ready.pop_front();
     auto it = ns.message_table.find(name);
     if (it == ns.message_table.end()) continue;
+    if (prio_any && !head_set) {
+      // the round's first schedulable tensor: the counted response-order
+      // series is "did the max-priority tensor land at position 0?"
+      head_set = true;
+      head_prio = prio_of(name);
+    }
     const Request& first = it->second.received.front();
     // grouped allgather (wire v9): "__gag:<n>:<k>:<base>" names park in
     // gag_wait until all n group members are fully subscribed, then fuse
@@ -6600,6 +6787,7 @@ void Engine::FuseReady(NegState& ns, ResponseList* out) {
     int64_t bytes =
         NumElems(first.dims) * static_cast<int64_t>(DTypeSize(first.dtype));
     DType dtype = first.dtype;
+    const int32_t resp_prio = prio_seen_ ? prio_of(name) : kPriorityMin;
     ns.message_table.erase(it);
     // fuse ready same-dtype allreduces up to the threshold, looking ahead
     // PAST non-matching entries (other ops, other dtypes, too-big) instead
@@ -6616,8 +6804,13 @@ void Engine::FuseReady(NegState& ns, ResponseList* out) {
           continue;
         }
         const Request& nr = nx->second.received.front();
-        if (nr.op != OpType::kAllreduce || nr.dtype != dtype) {
-          ++itr;  // skip, keep for a later response
+        if (nr.op != OpType::kAllreduce || nr.dtype != dtype ||
+            (prio_seen_ && prio_of(*itr) != resp_prio)) {
+          // skip, keep for a later response — including any tensor from a
+          // DIFFERENT priority class: fusing it here would re-delay the
+          // urgent tensor behind the bulk it was prioritized past (a
+          // priority-less job has every class 0, so nothing changes)
+          ++itr;
           continue;
         }
         int64_t nbytes =
@@ -6633,6 +6826,11 @@ void Engine::FuseReady(NegState& ns, ResponseList* out) {
       }
     }
     out->responses.push_back(std::move(resp));
+  }
+  if (prio_any && head_set) {
+    prio_rounds_.fetch_add(1, std::memory_order_relaxed);
+    if (head_prio >= round_max)
+      prio_first_hits_.fetch_add(1, std::memory_order_relaxed);
   }
 }
 
@@ -7219,6 +7417,7 @@ void Engine::DrainCompletions() {
 // pipelined completion paths share it so they can never drift.
 void Engine::FinishAllreduceEntry(TensorEntry& e, const Status& st,
                                   bool copy_out) {
+  if (st.ok()) NoteTensorDone(e.req.name);
   if (e.user_out) {
     if (copy_out && st.ok() && !e.inplace)
       std::memcpy(e.user_out, e.data.data(), e.nbytes);
@@ -7834,6 +8033,27 @@ bool Stalled(std::chrono::steady_clock::time_point last_progress,
              .count() > limit;
 }
 
+// poll(2) park with the data-plane syscall counter: every wire park and
+// transfer syscall lands in WireCounters() so hvd_wire_syscalls_total is
+// the full counted series the io_uring gate compares against.
+int WirePoll(struct pollfd* fds, int n, int timeout_ms) {
+  WireCounters().syscalls.fetch_add(1, std::memory_order_relaxed);
+  return ::poll(fds, n, timeout_ms);
+}
+
+// Park for the io_uring transport: the in-flight SQEs ARE the wait
+// condition, so one bounded io_uring_enter both submits anything prepped
+// and sleeps until the first CQE — the syscall that replaces the poll
+// park AND the transfer syscalls it guarded.  False when the ring has
+// nothing in flight (pacing gap or SQ-full fallthrough); the caller
+// falls back to a yield so it re-offers the transfer promptly.
+bool UringParkWait(int timeout_ms) {
+  UringWire& u = UringWire::Get();
+  if (!u.Active() || u.InflightTotal() == 0) return false;
+  u.Pump(true, timeout_ms);
+  return true;
+}
+
 // Deterministic wait for progress loops whose blocked direction is a TCP
 // send (ROADMAP "paced/TCP waits still poll"): a paced-out sender knows
 // the token-bucket refill time — sleep exactly that, freeing the core
@@ -7858,13 +8078,18 @@ void SendBlockedWait(Backoff& bo, Link& tx, size_t want, bool fast_rx) {
     std::this_thread::yield();
     return;
   }
+  if (tx.uring()) {
+    // uring mode: the blocked send is an in-flight SQE — park in the ring
+    if (!UringParkWait(fast_rx ? 1 : 50)) std::this_thread::yield();
+    return;
+  }
   // park on the stripe the next logical byte goes to — the only one whose
   // writability can unblock the in-order send cursor
   struct pollfd p;
   p.fd = tx.send_fd();
   p.events = POLLOUT;
   p.revents = 0;
-  ::poll(&p, 1, fast_rx ? 1 : 50);
+  WirePoll(&p, 1, fast_rx ? 1 : 50);
 }
 }  // namespace
 
@@ -7947,11 +8172,15 @@ Status Engine::PeerRecvAll(int r, void* data, size_t n) {
       // bounded so the abort latch and the no-progress clock are
       // re-checked promptly
       bo.idle++;
-      struct pollfd pf;
-      pf.fd = link.recv_fd();
-      pf.events = POLLIN;
-      pf.revents = 0;
-      ::poll(&pf, 1, 50);
+      if (link.uring()) {
+        if (!UringParkWait(50)) std::this_thread::yield();
+      } else {
+        struct pollfd pf;
+        pf.fd = link.recv_fd();
+        pf.events = POLLIN;
+        pf.revents = 0;
+        WirePoll(&pf, 1, 50);
+      }
     } else {
       bo.Wait();
     }
@@ -8055,10 +8284,14 @@ Status Engine::PeerSendRecv(int r_send, const void* send_buf, size_t send_n,
       // Socket::SendRecv had) so either direction's readiness wakes the
       // loop immediately; 50 ms bounds the abort/no-progress re-checks
       bo.idle++;
-      struct pollfd pf[2];
-      pf[0] = {stx_link.send_fd(), POLLOUT, 0};
-      pf[1] = {srx_link.recv_fd(), POLLIN, 0};
-      ::poll(pf, 2, 50);
+      if (stx_link.uring() || srx_link.uring()) {
+        if (!UringParkWait(50)) std::this_thread::yield();
+      } else {
+        struct pollfd pf[2];
+        pf[0] = {stx_link.send_fd(), POLLOUT, 0};
+        pf[1] = {srx_link.recv_fd(), POLLIN, 0};
+        WirePoll(pf, 2, 50);
+      }
     } else if (!tx && sleft > 0) {
       SendBlockedWait(bo, stx_link, sleft, /*fast_rx=*/rleft > 0);
     } else if (!rx && rleft > 0 && bo.idle >= 64) {
@@ -8067,11 +8300,16 @@ Status Engine::PeerSendRecv(int r_send, const void* send_buf, size_t send_n,
       // tx ring still needs push retries); 50 ms bounds the abort-latch
       // and no-progress re-check cadence
       bo.idle++;
-      struct pollfd pf;
-      pf.fd = srx_link.recv_fd();
-      pf.events = POLLIN;
-      pf.revents = 0;
-      ::poll(&pf, 1, (tx && sleft > 0) ? 1 : 50);
+      if (srx_link.uring()) {
+        if (!UringParkWait((tx && sleft > 0) ? 1 : 50))
+          std::this_thread::yield();
+      } else {
+        struct pollfd pf;
+        pf.fd = srx_link.recv_fd();
+        pf.events = POLLIN;
+        pf.revents = 0;
+        WirePoll(&pf, 1, (tx && sleft > 0) ? 1 : 50);
+      }
     } else {
       bo.Wait();
     }
@@ -8468,6 +8706,13 @@ Status Engine::RingAllreduceGroupSegmented(const WireRegions& wr,
   int st = 0;          // send step
   int64_t ssg = 0;     // send segment within st
   int64_t s_off = 0;   // bytes of the current send segment already pushed
+  // current send segment already encoded into staging: the encode must
+  // run exactly once per (step, segment) — error feedback folds the
+  // residual into the values, and an async transport (io_uring) may pin
+  // the staging buffer across zero-progress offers, so keying the encode
+  // on s_off == 0 alone would re-quantize (and mutate in-flight bytes)
+  // every time a send returns 0
+  bool enc_staged = false;
   int rt = 0;          // recv step
   int64_t rsg = 0;     // segments fully landed (and accumulated) in rt
   int64_t r_off = 0;   // bytes of the current recv segment already popped
@@ -8504,6 +8749,7 @@ Status Engine::RingAllreduceGroupSegmented(const WireRegions& wr,
         if (enc_b == 0) {
           // empty chunk (nelems < m): placeholder completes byte-free
           ssg++;
+          enc_staged = false;
           if (ssg >= nsegs) {
             st++;
             ssg = 0;
@@ -8517,19 +8763,23 @@ Status Engine::RingAllreduceGroupSegmented(const WireRegions& wr,
             // reduce phase: encode (value + residual); the residual slot
             // absorbs what this quantization dropped, to be re-added on
             // the NEXT step's encode of the same elements
-            if (s_off == 0)
+            if (s_off == 0 && !enc_staged) {
               CodecEncode(cdc, fbuf + e_lo, n_el, enc_send,
                           ef_resid ? ef_resid + e_lo : nullptr, nullptr);
+              enc_staged = true;
+            }
             src = enc_send;
           } else {
             char* eseg = enc_buf + enc_seg_lo(sc, ssg);
-            if (st == m - 1 && s_off == 0)
+            if (st == m - 1 && s_off == 0 && !enc_staged) {
               // allgather phase, owner step: quantize the reduced
               // segment ONCE into the mirror and adopt the decoded
               // values locally (`self`) — bitwise what peers will decode
               CodecEncode(cdc, fbuf + e_lo, n_el, eseg,
                           ef_resid ? ef_resid + e_lo : nullptr,
                           fbuf + e_lo);
+              enc_staged = true;
+            }
             src = eseg;  // st > m-1: forward the landed bytes verbatim
           }
           send_avail = static_cast<size_t>(enc_b - s_off);
@@ -8572,6 +8822,7 @@ Status Engine::RingAllreduceGroupSegmented(const WireRegions& wr,
               codec_raw += n_el * 4;
               ssg++;
               s_off = 0;
+              enc_staged = false;
               if (ssg >= nsegs) {
                 st++;
                 ssg = 0;
@@ -8840,11 +9091,16 @@ Status Engine::RingAllreduceGroupSegmented(const WireRegions& wr,
       // least that often, so a dead neighbor can never park this loop
       // past the peer timeout.
       bo.idle++;
-      struct pollfd p;
-      p.fd = rxs->recv_fd();
-      p.events = POLLIN;
-      p.revents = 0;
-      ::poll(&p, 1, (tx && send_avail > 0) ? 1 : 50);
+      if (rxs->uring()) {
+        if (!UringParkWait((tx && send_avail > 0) ? 1 : 50))
+          std::this_thread::yield();
+      } else {
+        struct pollfd p;
+        p.fd = rxs->recv_fd();
+        p.events = POLLIN;
+        p.revents = 0;
+        WirePoll(&p, 1, (tx && send_avail > 0) ? 1 : 50);
+      }
     } else {
       bo.Wait();
     }
@@ -9135,11 +9391,16 @@ Status Engine::RingAllgatherGroupSegmented(
       SendBlockedWait(bo, *txs, send_avail, /*fast_rx=*/rt <= last_step);
     else if (rxs && rt <= last_step && bo.idle >= 64) {
       bo.idle++;
-      struct pollfd p;
-      p.fd = rxs->recv_fd();
-      p.events = POLLIN;
-      p.revents = 0;
-      ::poll(&p, 1, (tx && send_avail > 0) ? 1 : 50);
+      if (rxs->uring()) {
+        if (!UringParkWait((tx && send_avail > 0) ? 1 : 50))
+          std::this_thread::yield();
+      } else {
+        struct pollfd p;
+        p.fd = rxs->recv_fd();
+        p.events = POLLIN;
+        p.revents = 0;
+        WirePoll(&p, 1, (tx && send_avail > 0) ? 1 : 50);
+      }
     } else {
       bo.Wait();
     }
@@ -10113,6 +10374,25 @@ void hvd_wire_stats(int64_t* out) {
   g_engine->WireStats(out);
 }
 
+// Priority-scheduled + io_uring data-plane statistics (wire v13); layout
+// documented at Engine::DataplaneStats.  All -1 when the engine is down.
+void hvd_dataplane_stats(int64_t* out) {
+  if (!g_engine) {
+    for (int i = 0; i < 16; i++) out[i] = -1;
+    return;
+  }
+  g_engine->DataplaneStats(out);
+}
+
+// Install the submit priority future ops named `name` will carry (wire
+// v13): larger runs earlier in a negotiated round; 0 (the default for
+// every name) restores arrival order AND the v12-identical frames.  Safe
+// to call any time from any thread; takes effect on the next enqueue.
+void hvd_set_tensor_priority(const char* name, int64_t priority) {
+  if (g_engine && name)
+    g_engine->SetTensorPriority(name, static_cast<int32_t>(priority));
+}
+
 // Topology descriptor (hosts x NICs x ranks) as a malloc'd JSON string
 // (free via hvd_free_cstr); NULL when the engine is down.  Surfaces the
 // ring order and per-link stripe counts the wire actually uses.
@@ -10314,6 +10594,12 @@ void hvd_drain_stats(int64_t* out) {
 // Python-side diagnostics and the ABI drift guard).
 int hvd_wire_version() { return static_cast<int>(kWireVersion); }
 
+// Kernel capability probe (engine or not): 1 when the io_uring wire
+// backend can run here — io_uring_setup succeeds and the kernel reports
+// IORING_FEAT_EXT_ARG (Linux 5.11+).  The test suite keys its
+// uring-vs-poll batteries on this so they skip, not fail, on old hosts.
+int hvd_io_uring_supported() { return UringWire::Supported() ? 1 : 0; }
+
 // Parse probe for tests/tools: returns NULL when `buf` parses as a control
 // frame, else a malloc'd error string (free via hvd_free_cstr).  This is
 // how the suite asserts the v4<->v5 version-mismatch path produces the
@@ -10394,6 +10680,31 @@ const char* hvd_frame_parse_error(const void* buf, int64_t len) {
     }
   }
   return st.ok() ? nullptr : strdup(st.message.c_str());
+}
+
+// Serialize probe for the wire v13 tests: a canonical two-request
+// allreduce RequestList with every request at `priority` (global set, no
+// audits).  Returns malloc'd frame bytes, *len set; free via
+// hvd_free_cstr.  This is how the suite asserts priority-silent frames
+// are byte-for-byte the v12 layout (and the priority block strictly
+// trailing) without standing up two engines.
+const char* hvd_debug_serialize_reqlist(int32_t priority, int64_t* len) {
+  RequestList rl;
+  for (int i = 0; i < 2; i++) {
+    Request r;
+    r.rank = i;
+    r.op = OpType::kAllreduce;
+    r.dtype = DType::kFloat32;
+    r.name = i == 0 ? "allreduce.g0" : "allreduce.g1";
+    r.dims = {4, 2};
+    r.priority = priority;
+    rl.requests.push_back(std::move(r));
+  }
+  std::string s = Serialize(rl);
+  char* out = static_cast<char*>(malloc(s.size()));
+  memcpy(out, s.data(), s.size());
+  if (len) *len = static_cast<int64_t>(s.size());
+  return out;
 }
 
 // -- flight recorder (trace.h) ----------------------------------------------

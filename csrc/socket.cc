@@ -1,5 +1,7 @@
 #include "socket.h"
 
+#include "uring.h"
+
 #include <arpa/inet.h>
 #include <errno.h>
 #include <fcntl.h>
@@ -163,6 +165,7 @@ Status Socket::RecvAll(void* data, size_t n) {
 
 int Socket::RawSendSome(const void* data, size_t n) {
   while (true) {
+    WireCounters().syscalls.fetch_add(1, std::memory_order_relaxed);
     ssize_t k = ::send(fd_, data, n, MSG_NOSIGNAL | MSG_DONTWAIT);
     if (k >= 0) return static_cast<int>(k);
     if (errno == EINTR) continue;
@@ -173,6 +176,7 @@ int Socket::RawSendSome(const void* data, size_t n) {
 
 int Socket::RawRecvSome(void* data, size_t n) {
   while (true) {
+    WireCounters().syscalls.fetch_add(1, std::memory_order_relaxed);
     ssize_t k = ::recv(fd_, data, n, MSG_DONTWAIT);
     if (k > 0) return static_cast<int>(k);
     if (k == 0) return -1;  // EOF mid-transfer is an error on the data plane
@@ -188,6 +192,7 @@ int Socket::RawSendvSome(const struct iovec* iov, int iovcnt) {
   msg.msg_iov = const_cast<struct iovec*>(iov);
   msg.msg_iovlen = static_cast<size_t>(iovcnt);
   while (true) {
+    WireCounters().syscalls.fetch_add(1, std::memory_order_relaxed);
     ssize_t k = ::sendmsg(fd_, &msg, MSG_NOSIGNAL | MSG_DONTWAIT);
     if (k >= 0) return static_cast<int>(k);
     if (errno == EINTR) continue;
@@ -202,6 +207,7 @@ int Socket::RawRecvvSome(const struct iovec* iov, int iovcnt) {
   msg.msg_iov = const_cast<struct iovec*>(iov);
   msg.msg_iovlen = static_cast<size_t>(iovcnt);
   while (true) {
+    WireCounters().syscalls.fetch_add(1, std::memory_order_relaxed);
     ssize_t k = ::recvmsg(fd_, &msg, MSG_DONTWAIT);
     if (k > 0) return static_cast<int>(k);
     if (k == 0) return -1;
@@ -320,7 +326,7 @@ Status Socket::Connect(const std::string& host, int port, Socket* out,
 Link::Link(Link&& o) noexcept
     : n_(o.n_), quantum_(o.quantum_), send_idx_(o.send_idx_),
       send_off_(o.send_off_), recv_idx_(o.recv_idx_), recv_off_(o.recv_off_),
-      pace_(o.pace_) {
+      pace_(o.pace_), uring_(o.uring_) {
   active_.store(o.active_.load(std::memory_order_relaxed),
                 std::memory_order_relaxed);
   for (int i = 0; i < kMaxStripes; i++) {
@@ -329,6 +335,7 @@ Link::Link(Link&& o) noexcept
                        std::memory_order_relaxed);
   }
   o.n_ = 0;
+  o.uring_ = false;
 }
 
 Link& Link::operator=(Link&& o) noexcept {
@@ -341,6 +348,7 @@ Link& Link::operator=(Link&& o) noexcept {
     recv_idx_ = o.recv_idx_;
     recv_off_ = o.recv_off_;
     pace_ = o.pace_;
+    uring_ = o.uring_;
     active_.store(o.active_.load(std::memory_order_relaxed),
                   std::memory_order_relaxed);
     for (int i = 0; i < kMaxStripes; i++) {
@@ -349,6 +357,7 @@ Link& Link::operator=(Link&& o) noexcept {
                          std::memory_order_relaxed);
     }
     o.n_ = 0;
+    o.uring_ = false;
   }
   return *this;
 }
@@ -381,6 +390,18 @@ int Link::ActiveK() const {
 }
 
 void Link::Close() {
+  if (uring_) {
+    // Order matters: shut the sockets down FIRST so any in-flight SQE
+    // completes promptly with an error, then drain/orphan those ops so no
+    // late CQE can touch this link (or a caller buffer) after teardown,
+    // and only then release the fds.
+    for (int i = 0; i < n_; i++) socks_[i].ShutdownBoth();
+    UringWire::Get().OrphanOwner(this);
+    uring_ = false;
+    inflight_send_ = inflight_recv_ = 0;
+    ahead_send_ = ahead_recv_ = 0;
+    uring_err_send_ = uring_err_recv_ = false;
+  }
   for (int i = 0; i < kMaxStripes; i++) socks_[i].Close();
   n_ = 0;
 }
@@ -413,6 +434,7 @@ void Link::AdvanceRecv(size_t k) {
 
 int Link::SendSome(const void* data, size_t n) {
   if (n_ == 0) return -1;
+  if (uring_) return UringSend(data, n);
   size_t quota = static_cast<size_t>(quantum_ - send_off_);
   size_t want = n < quota ? n : quota;
   size_t allow = pace_.Allowance(want);
@@ -427,6 +449,7 @@ int Link::SendSome(const void* data, size_t n) {
 
 int Link::RecvSome(void* data, size_t n) {
   if (n_ == 0) return -1;
+  if (uring_) return UringRecv(data, n);
   size_t quota = static_cast<size_t>(quantum_ - recv_off_);
   size_t want = n < quota ? n : quota;
   int k = socks_[recv_idx_].RawRecvSome(data, want);
@@ -455,6 +478,7 @@ int TrimIovecs(const struct iovec* iov, int iovcnt, size_t budget,
 
 int Link::SendvSome(const struct iovec* iov, int iovcnt) {
   if (n_ == 0) return -1;
+  if (uring_) return UringSendv(iov, iovcnt);
   size_t total = 0;
   for (int i = 0; i < iovcnt; i++) total += iov[i].iov_len;
   size_t quota = static_cast<size_t>(quantum_ - send_off_);
@@ -474,6 +498,7 @@ int Link::SendvSome(const struct iovec* iov, int iovcnt) {
 
 int Link::RecvvSome(const struct iovec* iov, int iovcnt) {
   if (n_ == 0) return -1;
+  if (uring_) return UringRecvv(iov, iovcnt);
   size_t quota = static_cast<size_t>(quantum_ - recv_off_);
   struct iovec trimmed[16];
   int cnt = TrimIovecs(iov, iovcnt, quota, trimmed);
@@ -481,6 +506,156 @@ int Link::RecvvSome(const struct iovec* iov, int iovcnt) {
   int k = socks_[recv_idx_].RawRecvvSome(trimmed, cnt);
   if (k > 0) AdvanceRecv(static_cast<size_t>(k));
   return k;
+}
+
+// ---------------------------------------------------------------------------
+// Link io_uring mode.  Same state machine as the poll path seen from the
+// caller — Some calls still return bytes-moved / 0-would-block / -1-error
+// and advance the same cursors — but the 0 now covers "SQE in flight": the
+// kernel runs the op while the caller loops, and the next call after the
+// CQE lands returns its byte count for the SAME stream position the caller
+// has been re-offering (that re-offer contract is what makes the buffer
+// pin safe).  Pacing is prepaid at prep and refunded for short sends, so
+// net tokens == bytes moved, exactly like consume-after-send.
+// ---------------------------------------------------------------------------
+
+namespace {
+void LinkUringComplete(void* owner, int stripe, int dir, int res) {
+  (void)stripe;
+  static_cast<Link*>(owner)->UringComplete(dir, res);
+}
+}  // namespace
+
+bool Link::EnableUring() {
+  if (uring_) return true;
+  if (!UringWire::Supported()) return false;
+  if (!UringWire::Get().Init(256, &LinkUringComplete)) return false;
+  uring_ = true;
+  return true;
+}
+
+void Link::UringComplete(int dir, int res) {
+  if (dir == 0) {
+    int64_t prepped = inflight_send_;
+    inflight_send_ = 0;
+    if (res > 0) {
+      if (res < prepped)
+        pace_.Refund(static_cast<size_t>(prepped - res));
+      ahead_send_ = res;
+    } else {
+      pace_.Refund(static_cast<size_t>(prepped));
+      if (res != 0 && res != -EAGAIN && res != -EINTR)
+        uring_err_send_ = true;  // sticky: next SendSome returns -1
+    }
+  } else {
+    inflight_recv_ = 0;
+    if (res > 0) {
+      ahead_recv_ = res;
+    } else if (res == 0) {
+      uring_err_recv_ = true;  // EOF mid-transfer, like RawRecvSome
+    } else if (res != -EAGAIN && res != -EINTR) {
+      uring_err_recv_ = true;
+    }
+  }
+}
+
+int Link::TakeAheadSend() {
+  int k = static_cast<int>(ahead_send_);
+  ahead_send_ = 0;
+  AdvanceSend(static_cast<size_t>(k));
+  return k;
+}
+
+int Link::TakeAheadRecv() {
+  int k = static_cast<int>(ahead_recv_);
+  ahead_recv_ = 0;
+  AdvanceRecv(static_cast<size_t>(k));
+  return k;
+}
+
+int Link::UringSend(const void* data, size_t n) {
+  if (ahead_send_ > 0) return TakeAheadSend();
+  if (uring_err_send_) return -1;
+  if (inflight_send_ > 0) {
+    UringWire::Get().Pump(false, 0);  // free CQ reap, no syscall
+    if (ahead_send_ > 0) return TakeAheadSend();
+    return uring_err_send_ ? -1 : 0;
+  }
+  size_t quota = static_cast<size_t>(quantum_ - send_off_);
+  size_t want = n < quota ? n : quota;
+  size_t allow = pace_.Allowance(want);
+  if (allow == 0) return 0;  // paced out == would-block
+  if (!UringWire::Get().PrepSend(this, send_idx_, socks_[send_idx_].fd(),
+                                 data, allow))
+    return 0;  // SQ full — the next Pump drains it
+  pace_.Consume(allow);
+  inflight_send_ = static_cast<int64_t>(allow);
+  return 0;
+}
+
+int Link::UringRecv(void* data, size_t n) {
+  if (ahead_recv_ > 0) return TakeAheadRecv();
+  if (uring_err_recv_) return -1;
+  if (inflight_recv_ > 0) {
+    UringWire::Get().Pump(false, 0);
+    if (ahead_recv_ > 0) return TakeAheadRecv();
+    return uring_err_recv_ ? -1 : 0;
+  }
+  size_t quota = static_cast<size_t>(quantum_ - recv_off_);
+  size_t want = n < quota ? n : quota;
+  if (!UringWire::Get().PrepRecv(this, recv_idx_, socks_[recv_idx_].fd(),
+                                 data, want))
+    return 0;
+  inflight_recv_ = static_cast<int64_t>(want);
+  return 0;
+}
+
+int Link::UringSendv(const struct iovec* iov, int iovcnt) {
+  if (ahead_send_ > 0) return TakeAheadSend();
+  if (uring_err_send_) return -1;
+  if (inflight_send_ > 0) {
+    UringWire::Get().Pump(false, 0);
+    if (ahead_send_ > 0) return TakeAheadSend();
+    return uring_err_send_ ? -1 : 0;
+  }
+  size_t total = 0;
+  for (int i = 0; i < iovcnt; i++) total += iov[i].iov_len;
+  size_t quota = static_cast<size_t>(quantum_ - send_off_);
+  size_t want = total < quota ? total : quota;
+  size_t allow = pace_.Allowance(want);
+  if (allow == 0) return 0;
+  struct iovec trimmed[16];
+  int cnt = TrimIovecs(iov, iovcnt, allow, trimmed);
+  if (cnt == 0) return 0;
+  size_t prepped = 0;
+  for (int i = 0; i < cnt; i++) prepped += trimmed[i].iov_len;
+  if (!UringWire::Get().PrepSendv(this, send_idx_, socks_[send_idx_].fd(),
+                                  trimmed, cnt))
+    return 0;
+  pace_.Consume(prepped);
+  inflight_send_ = static_cast<int64_t>(prepped);
+  return 0;
+}
+
+int Link::UringRecvv(const struct iovec* iov, int iovcnt) {
+  if (ahead_recv_ > 0) return TakeAheadRecv();
+  if (uring_err_recv_) return -1;
+  if (inflight_recv_ > 0) {
+    UringWire::Get().Pump(false, 0);
+    if (ahead_recv_ > 0) return TakeAheadRecv();
+    return uring_err_recv_ ? -1 : 0;
+  }
+  size_t quota = static_cast<size_t>(quantum_ - recv_off_);
+  struct iovec trimmed[16];
+  int cnt = TrimIovecs(iov, iovcnt, quota, trimmed);
+  if (cnt == 0) return 0;
+  size_t prepped = 0;
+  for (int i = 0; i < cnt; i++) prepped += trimmed[i].iov_len;
+  if (!UringWire::Get().PrepRecvv(this, recv_idx_, socks_[recv_idx_].fd(),
+                                  trimmed, cnt))
+    return 0;
+  inflight_recv_ = static_cast<int64_t>(prepped);
+  return 0;
 }
 
 Status Link::SendAll(const void* data, size_t n) {

@@ -46,6 +46,12 @@ struct PaceBucket {
   // may sleep exactly this long instead of guessing.
   double DelaySeconds(size_t want) const;
   void Consume(size_t sent) { tokens -= static_cast<double>(sent); }
+  // Give back tokens for bytes the kernel ultimately did not accept: the
+  // io_uring path must Consume at submit time (the pacing decision happens
+  // before the kernel runs the op), so a short send refunds the remainder
+  // when its CQE lands — net consumption equals bytes actually moved,
+  // identical to the poll path's consume-after-send.
+  void Refund(size_t unsent) { tokens += static_cast<double>(unsent); }
 };
 
 class Socket {
@@ -183,6 +189,25 @@ class Link {
   int recv_fd() const { return socks_[recv_idx_].fd(); }
   int fd() const { return recv_fd(); }
 
+  // io_uring transport mode (wire v13): the nonblocking transfer methods
+  // switch from one syscall per call to prep-SQE / reap-CQE against the
+  // process-wide UringWire, with the actual submit+park batched into one
+  // io_uring_enter by the progress loop's Pump.  Byte-stream semantics,
+  // cursor arithmetic, and pacing are IDENTICAL — only the syscall pattern
+  // changes — so reassembly stays bitwise for any K and either transport
+  // end of a connection interoperates with either on the peer.  Call
+  // before the first transfer; false (and poll mode kept) when the kernel
+  // lacks io_uring.
+  bool EnableUring();
+  bool uring() const { return uring_; }
+  // True while an SQE is in flight in either direction — what a progress
+  // loop should Pump for instead of poll()ing fds.
+  bool UringInflight() const {
+    return inflight_send_ > 0 || inflight_recv_ > 0;
+  }
+  // CQE router target (UringWire's completion handler calls this).
+  void UringComplete(int dir, int res);
+
   // Stripe index the next logical send byte goes to (timeline lanes).
   int send_stripe() const { return send_idx_; }
   // Cumulative payload bytes sent on stripe i (telemetry; readable from
@@ -195,6 +220,12 @@ class Link {
   int ActiveK() const;
   void AdvanceSend(size_t k);
   void AdvanceRecv(size_t k);
+  int UringSend(const void* data, size_t n);
+  int UringRecv(void* data, size_t n);
+  int UringSendv(const struct iovec* iov, int iovcnt);
+  int UringRecvv(const struct iovec* iov, int iovcnt);
+  int TakeAheadSend();
+  int TakeAheadRecv();
 
   Socket socks_[kMaxStripes];
   int n_ = 0;
@@ -206,6 +237,23 @@ class Link {
   int64_t recv_off_ = 0;
   PaceBucket pace_;
   std::atomic<int64_t> tx_bytes_[kMaxStripes] = {};
+
+  // io_uring mode state.  At most ONE SQE in flight per direction, always
+  // at the current cursor stripe: the op pins the caller's buffer at the
+  // current stream position, and the Some-call contract (callers re-offer
+  // the same position until progress) makes that pin safe.  `ahead_*` is a
+  // completed byte count not yet handed to the caller; errors latch sticky
+  // so the next call returns -1 and routes through the same
+  // NoteWireFail/arbitration path as a poll-mode failure.  Links are only
+  // moved during bootstrap, before uring mode can be enabled, so moves
+  // never relocate an owner pointer the kernel still holds.
+  bool uring_ = false;
+  int64_t inflight_send_ = 0;  // bytes prepped in the in-flight send SQE
+  int64_t inflight_recv_ = 0;
+  int64_t ahead_send_ = 0;  // completed, not yet returned to the caller
+  int64_t ahead_recv_ = 0;
+  bool uring_err_send_ = false;
+  bool uring_err_recv_ = false;
 };
 
 class Listener {

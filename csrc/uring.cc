@@ -1,0 +1,435 @@
+// io_uring wire backend — raw kernel ABI, no liburing.  See uring.h for
+// the design contract.  Everything kernel-facing lives under
+// HVDTPU_HAVE_IO_URING (set by the Makefile when <linux/io_uring.h> is
+// present); the stub build keeps every symbol so the .so links
+// identically and Supported() simply reports false.
+#include "uring.h"
+
+#include <errno.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+namespace hvdtpu {
+
+WireSyscallCounters& WireCounters() {
+  static WireSyscallCounters c;
+  return c;
+}
+
+UringWire& UringWire::Get() {
+  static UringWire w;
+  return w;
+}
+
+}  // namespace hvdtpu
+
+#ifdef HVDTPU_HAVE_IO_URING
+
+#include <linux/io_uring.h>
+#include <linux/time_types.h>
+#include <sys/mman.h>
+#include <sys/syscall.h>
+
+// glibc carries no wrappers for these; the numbers are ABI-stable across
+// every architecture that defines them (425/426 on the usual ones), and
+// <sys/syscall.h> provides them on any kernel new enough to matter.
+#ifndef __NR_io_uring_setup
+#define __NR_io_uring_setup 425
+#endif
+#ifndef __NR_io_uring_enter
+#define __NR_io_uring_enter 426
+#endif
+
+namespace hvdtpu {
+
+namespace {
+
+inline unsigned LoadAcq(const unsigned* p) {
+  return __atomic_load_n(p, __ATOMIC_ACQUIRE);
+}
+inline void StoreRel(unsigned* p, unsigned v) {
+  __atomic_store_n(p, v, __ATOMIC_RELEASE);
+}
+
+int SysSetup(unsigned entries, struct io_uring_params* p) {
+  return static_cast<int>(::syscall(__NR_io_uring_setup, entries, p));
+}
+
+long SysEnter(int fd, unsigned to_submit, unsigned min_complete,
+              unsigned flags, const void* arg, size_t argsz) {
+  return ::syscall(__NR_io_uring_enter, fd, to_submit, min_complete, flags,
+                   arg, argsz);
+}
+
+}  // namespace
+
+bool UringWire::Supported() {
+  // One-time kernel probe: a throwaway 4-entry ring tells us both that
+  // io_uring exists (5.1+, not seccomp-blocked) and which features it
+  // speaks.  EXT_ARG (5.11+) is non-negotiable — without timed waits a
+  // dead peer would park the wire thread indefinitely and the fault
+  // domain's stall detection would never get to run.
+  static int cached = -1;
+  if (cached < 0) {
+    struct io_uring_params p;
+    memset(&p, 0, sizeof(p));
+    int fd = SysSetup(4, &p);
+    if (fd < 0) {
+      cached = 0;
+    } else {
+      cached = (p.features & IORING_FEAT_EXT_ARG) ? 1 : 0;
+      ::close(fd);
+    }
+  }
+  return cached == 1;
+}
+
+bool UringWire::Init(unsigned entries, CompletionFn on_complete) {
+  if (ring_fd_ >= 0) {
+    on_complete_ = on_complete;
+    return true;
+  }
+  if (!Supported()) return false;
+  if (entries < 8) entries = 8;
+
+  struct io_uring_params p;
+  memset(&p, 0, sizeof(p));
+  int fd = SysSetup(entries, &p);
+  if (fd < 0) return false;
+
+  size_t sq_sz = p.sq_off.array + p.sq_entries * sizeof(unsigned);
+  size_t cq_sz = p.cq_off.cqes + p.cq_entries * sizeof(struct io_uring_cqe);
+  single_mmap_ = (p.features & IORING_FEAT_SINGLE_MMAP) != 0;
+  if (single_mmap_ && cq_sz > sq_sz) sq_sz = cq_sz;
+
+  void* sq = ::mmap(nullptr, sq_sz, PROT_READ | PROT_WRITE,
+                    MAP_SHARED | MAP_POPULATE, fd, IORING_OFF_SQ_RING);
+  if (sq == MAP_FAILED) {
+    ::close(fd);
+    return false;
+  }
+  void* cq = sq;
+  size_t cq_map_sz = 0;
+  if (!single_mmap_) {
+    cq_map_sz = cq_sz;
+    cq = ::mmap(nullptr, cq_sz, PROT_READ | PROT_WRITE,
+                MAP_SHARED | MAP_POPULATE, fd, IORING_OFF_CQ_RING);
+    if (cq == MAP_FAILED) {
+      ::munmap(sq, sq_sz);
+      ::close(fd);
+      return false;
+    }
+  }
+  size_t sqes_sz = p.sq_entries * sizeof(struct io_uring_sqe);
+  void* sqes = ::mmap(nullptr, sqes_sz, PROT_READ | PROT_WRITE,
+                      MAP_SHARED | MAP_POPULATE, fd, IORING_OFF_SQES);
+  if (sqes == MAP_FAILED) {
+    ::munmap(sq, sq_sz);
+    if (!single_mmap_) ::munmap(cq, cq_map_sz);
+    ::close(fd);
+    return false;
+  }
+
+  ring_fd_ = fd;
+  on_complete_ = on_complete;
+  sq_ring_ = sq;
+  sq_ring_sz_ = sq_sz;
+  cq_ring_ = cq;
+  cq_ring_sz_ = cq_map_sz;
+  sqes_ = sqes;
+  sqes_sz_ = sqes_sz;
+  sq_entries_ = p.sq_entries;
+  cq_entries_ = p.cq_entries;
+
+  char* sqb = static_cast<char*>(sq);
+  sq_head_ = reinterpret_cast<unsigned*>(sqb + p.sq_off.head);
+  sq_tail_ = reinterpret_cast<unsigned*>(sqb + p.sq_off.tail);
+  sq_mask_ = reinterpret_cast<unsigned*>(sqb + p.sq_off.ring_mask);
+  sq_array_ = reinterpret_cast<unsigned*>(sqb + p.sq_off.array);
+  char* cqb = static_cast<char*>(cq);
+  cq_head_ = reinterpret_cast<unsigned*>(cqb + p.cq_off.head);
+  cq_tail_ = reinterpret_cast<unsigned*>(cqb + p.cq_off.tail);
+  cq_mask_ = reinterpret_cast<unsigned*>(cqb + p.cq_off.ring_mask);
+  cqes_ = cqb + p.cq_off.cqes;
+
+  to_submit_ = 0;
+  live_slots_ = 0;
+  slots_ = new Slot[sq_entries_]();
+  return true;
+}
+
+void UringWire::Destroy() {
+  if (ring_fd_ < 0) return;
+  // Closing the ring fd cancels and waits out anything still in flight
+  // (the kernel won't release the ring while an op references caller
+  // memory), so this is safe even with live slots.
+  ::close(ring_fd_);
+  ring_fd_ = -1;
+  ::munmap(sq_ring_, sq_ring_sz_);
+  if (!single_mmap_ && cq_ring_) ::munmap(cq_ring_, cq_ring_sz_);
+  ::munmap(sqes_, sqes_sz_);
+  sq_ring_ = cq_ring_ = sqes_ = nullptr;
+  delete[] slots_;
+  slots_ = nullptr;
+  to_submit_ = 0;
+  live_slots_ = 0;
+}
+
+int UringWire::AllocSlot() {
+  for (unsigned i = 0; i < sq_entries_; ++i) {
+    if (!slots_[i].live) return static_cast<int>(i);
+  }
+  return -1;
+}
+
+void* UringWire::NextSqe(unsigned* out_idx) {
+  unsigned head = LoadAcq(sq_head_);
+  unsigned tail = *sq_tail_;
+  if (tail - head >= sq_entries_) return nullptr;  // SQ full
+  unsigned idx = tail & *sq_mask_;
+  *out_idx = idx;
+  struct io_uring_sqe* sqe =
+      reinterpret_cast<struct io_uring_sqe*>(static_cast<char*>(sqes_)) + idx;
+  memset(sqe, 0, sizeof(*sqe));
+  return sqe;
+}
+
+bool UringWire::PrepSend(void* owner, int stripe, int fd, const void* buf,
+                         size_t n) {
+  if (ring_fd_ < 0) return false;
+  int si = AllocSlot();
+  if (si < 0) return false;
+  unsigned qi = 0;
+  struct io_uring_sqe* sqe =
+      static_cast<struct io_uring_sqe*>(NextSqe(&qi));
+  if (!sqe) return false;
+  Slot& s = slots_[si];
+  s.owner = owner;
+  s.stripe = stripe;
+  s.dir = 0;
+  s.live = true;
+  ++live_slots_;
+  sqe->opcode = IORING_OP_SEND;
+  sqe->fd = fd;
+  sqe->addr = reinterpret_cast<uint64_t>(buf);
+  sqe->len = static_cast<uint32_t>(n);
+  sqe->msg_flags = MSG_NOSIGNAL;
+  sqe->user_data = static_cast<uint64_t>(si);
+  sq_array_[qi] = qi;
+  StoreRel(sq_tail_, *sq_tail_ + 1);
+  ++to_submit_;
+  return true;
+}
+
+bool UringWire::PrepRecv(void* owner, int stripe, int fd, void* buf,
+                         size_t n) {
+  if (ring_fd_ < 0) return false;
+  int si = AllocSlot();
+  if (si < 0) return false;
+  unsigned qi = 0;
+  struct io_uring_sqe* sqe =
+      static_cast<struct io_uring_sqe*>(NextSqe(&qi));
+  if (!sqe) return false;
+  Slot& s = slots_[si];
+  s.owner = owner;
+  s.stripe = stripe;
+  s.dir = 1;
+  s.live = true;
+  ++live_slots_;
+  sqe->opcode = IORING_OP_RECV;
+  sqe->fd = fd;
+  sqe->addr = reinterpret_cast<uint64_t>(buf);
+  sqe->len = static_cast<uint32_t>(n);
+  sqe->user_data = static_cast<uint64_t>(si);
+  sq_array_[qi] = qi;
+  StoreRel(sq_tail_, *sq_tail_ + 1);
+  ++to_submit_;
+  return true;
+}
+
+bool UringWire::PrepSendv(void* owner, int stripe, int fd,
+                          const struct iovec* iov, int cnt) {
+  if (ring_fd_ < 0 || cnt <= 0 || cnt > 16) return false;
+  int si = AllocSlot();
+  if (si < 0) return false;
+  unsigned qi = 0;
+  struct io_uring_sqe* sqe =
+      static_cast<struct io_uring_sqe*>(NextSqe(&qi));
+  if (!sqe) return false;
+  Slot& s = slots_[si];
+  s.owner = owner;
+  s.stripe = stripe;
+  s.dir = 0;
+  s.live = true;
+  ++live_slots_;
+  // The caller's iovec array is stack-transient; the kernel reads the
+  // msghdr (and through it the iovecs) asynchronously, so both must live
+  // in the slot until the CQE lands.
+  memcpy(s.iov, iov, sizeof(struct iovec) * cnt);
+  memset(&s.mh, 0, sizeof(s.mh));
+  s.mh.msg_iov = s.iov;
+  s.mh.msg_iovlen = static_cast<size_t>(cnt);
+  sqe->opcode = IORING_OP_SENDMSG;
+  sqe->fd = fd;
+  sqe->addr = reinterpret_cast<uint64_t>(&s.mh);
+  sqe->len = 1;
+  sqe->msg_flags = MSG_NOSIGNAL;
+  sqe->user_data = static_cast<uint64_t>(si);
+  sq_array_[qi] = qi;
+  StoreRel(sq_tail_, *sq_tail_ + 1);
+  ++to_submit_;
+  return true;
+}
+
+bool UringWire::PrepRecvv(void* owner, int stripe, int fd,
+                          const struct iovec* iov, int cnt) {
+  if (ring_fd_ < 0 || cnt <= 0 || cnt > 16) return false;
+  int si = AllocSlot();
+  if (si < 0) return false;
+  unsigned qi = 0;
+  struct io_uring_sqe* sqe =
+      static_cast<struct io_uring_sqe*>(NextSqe(&qi));
+  if (!sqe) return false;
+  Slot& s = slots_[si];
+  s.owner = owner;
+  s.stripe = stripe;
+  s.dir = 1;
+  s.live = true;
+  ++live_slots_;
+  memcpy(s.iov, iov, sizeof(struct iovec) * cnt);
+  memset(&s.mh, 0, sizeof(s.mh));
+  s.mh.msg_iov = s.iov;
+  s.mh.msg_iovlen = static_cast<size_t>(cnt);
+  sqe->opcode = IORING_OP_RECVMSG;
+  sqe->fd = fd;
+  sqe->addr = reinterpret_cast<uint64_t>(&s.mh);
+  sqe->len = 1;
+  sqe->user_data = static_cast<uint64_t>(si);
+  sq_array_[qi] = qi;
+  StoreRel(sq_tail_, *sq_tail_ + 1);
+  ++to_submit_;
+  return true;
+}
+
+int UringWire::Reap() {
+  int n = 0;
+  unsigned head = *cq_head_;
+  while (head != LoadAcq(cq_tail_)) {
+    const struct io_uring_cqe* cqe =
+        static_cast<const struct io_uring_cqe*>(cqes_) + (head & *cq_mask_);
+    unsigned si = static_cast<unsigned>(cqe->user_data);
+    int res = cqe->res;
+    ++head;
+    StoreRel(cq_head_, head);
+    if (si < sq_entries_ && slots_[si].live) {
+      Slot& s = slots_[si];
+      void* owner = s.owner;
+      int stripe = s.stripe;
+      int dir = s.dir;
+      s.live = false;
+      s.owner = nullptr;
+      --live_slots_;
+      if (owner && on_complete_) on_complete_(owner, stripe, dir, res);
+    }
+    ++n;
+  }
+  return n;
+}
+
+int UringWire::Pump(bool wait, int timeout_ms) {
+  if (ring_fd_ < 0) return 0;
+  int delivered = Reap();  // CQ reads are free — no syscall
+  bool need_wait = wait && delivered == 0 && live_slots_ > 0;
+  if (to_submit_ == 0 && !need_wait) return delivered;
+
+  unsigned flags = 0;
+  unsigned min_complete = 0;
+  struct io_uring_getevents_arg arg;
+  struct __kernel_timespec ts;
+  const void* argp = nullptr;
+  size_t argsz = 0;
+  if (need_wait) {
+    if (timeout_ms < 1) timeout_ms = 1;
+    ts.tv_sec = timeout_ms / 1000;
+    ts.tv_nsec = static_cast<long long>(timeout_ms % 1000) * 1000000;
+    memset(&arg, 0, sizeof(arg));
+    arg.ts = reinterpret_cast<uint64_t>(&ts);
+    flags = IORING_ENTER_GETEVENTS | IORING_ENTER_EXT_ARG;
+    min_complete = 1;
+    argp = &arg;
+    argsz = sizeof(arg);
+  }
+
+  WireCounters().syscalls.fetch_add(1, std::memory_order_relaxed);
+  WireCounters().uring_enters.fetch_add(1, std::memory_order_relaxed);
+  long r = SysEnter(ring_fd_, to_submit_, min_complete, flags, argp, argsz);
+  if (r >= 0) {
+    WireCounters().uring_sqes.fetch_add(r, std::memory_order_relaxed);
+    unsigned submitted = static_cast<unsigned>(r);
+    to_submit_ -= submitted < to_submit_ ? submitted : to_submit_;
+  }
+  // EINTR/ETIME/EAGAIN/EBUSY are all "nothing submitted or timed out" —
+  // the SQEs stay queued and the next Pump retries; anything harder will
+  // surface as an error CQE or a dead socket on the poll-side checks.
+  delivered += Reap();
+  return delivered;
+}
+
+void UringWire::OrphanOwner(void* owner) {
+  if (ring_fd_ < 0 || !owner) return;
+  int orphaned = 0;
+  for (unsigned i = 0; i < sq_entries_; ++i) {
+    if (slots_[i].live && slots_[i].owner == owner) {
+      slots_[i].owner = nullptr;  // CQE will be reaped and dropped
+      ++orphaned;
+    }
+  }
+  if (orphaned == 0) return;
+  // The owner shut its sockets down before calling us, so these ops
+  // complete with errors almost immediately; drain bounded (~1s).
+  for (int spin = 0; spin < 100; ++spin) {
+    bool any = false;
+    for (unsigned i = 0; i < sq_entries_; ++i) {
+      if (slots_[i].live && slots_[i].owner == nullptr) {
+        any = true;
+        break;
+      }
+    }
+    if (!any) return;
+    Pump(true, 10);
+  }
+  // Drain timed out (op pinned in the kernel despite the shutdown).
+  // Destroying the ring is the one remaining way to guarantee no
+  // completion ever writes into memory the caller is about to free.
+  Destroy();
+}
+
+}  // namespace hvdtpu
+
+#else  // !HVDTPU_HAVE_IO_URING — stub build, poll path only
+
+namespace hvdtpu {
+
+bool UringWire::Supported() { return false; }
+bool UringWire::Init(unsigned, CompletionFn) { return false; }
+void UringWire::Destroy() {}
+bool UringWire::PrepSend(void*, int, int, const void*, size_t) {
+  return false;
+}
+bool UringWire::PrepRecv(void*, int, int, void*, size_t) { return false; }
+bool UringWire::PrepSendv(void*, int, int, const struct iovec*, int) {
+  return false;
+}
+bool UringWire::PrepRecvv(void*, int, int, const struct iovec*, int) {
+  return false;
+}
+int UringWire::Pump(bool, int) { return 0; }
+void UringWire::OrphanOwner(void*) {}
+void* UringWire::NextSqe(unsigned*) { return nullptr; }
+int UringWire::AllocSlot() { return -1; }
+int UringWire::Reap() { return 0; }
+
+}  // namespace hvdtpu
+
+#endif  // HVDTPU_HAVE_IO_URING

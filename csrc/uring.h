@@ -1,0 +1,138 @@
+// Batched wire I/O over io_uring (wire data plane, PR 20).  The striped
+// poll transport costs one syscall per (stripe, direction) unit per
+// progress-loop iteration — poll(2) to park, sendmsg/recvmsg to move — and
+// on a K-striped paced ring that triple dominates the hot loop.  This
+// backend keeps the Link abstraction's byte-stream contract untouched
+// (reassembly is cursor-identical for any K, so results stay bitwise) and
+// only changes HOW bytes reach the kernel: each tick's stripe sends and
+// recvs become SQEs written into shared memory, ONE io_uring_enter both
+// submits the batch and parks for the first completion (EXT_ARG bounded
+// timeout, so the fault domain's re-check cadence survives), and
+// completions are reaped from the CQ ring for free.
+//
+// Implemented against the RAW kernel ABI (<linux/io_uring.h> + three
+// syscalls) — the build hosts carry no liburing, and the handful of mmap'd
+// ring operations needed here don't justify the dependency.  When the
+// header is absent at build time (HVDTPU_HAVE_IO_URING unset) or the
+// kernel rejects io_uring_setup / lacks IORING_FEAT_EXT_ARG at runtime,
+// Supported() is false and the engine stays on the portable poll path.
+//
+// Threading contract: single-threaded, like Socket and Link — whichever
+// thread runs the wire owns the ring.  One process-wide instance serves
+// every uring-enabled Link so a duplex K-striped exchange still costs one
+// enter per park, not one per link.
+#pragma once
+
+#include <sys/socket.h>
+#include <sys/uio.h>
+
+#include <atomic>
+#include <cstddef>
+#include <cstdint>
+
+namespace hvdtpu {
+
+// Process-wide data-plane syscall counters (socket.cc increments them on
+// every send/recv/poll syscall; uring.cc on every enter).  These are the
+// COUNTED series behind hvd_wire_syscalls_total / hvd_uring_sqe_total —
+// pure functions of workload + transport, gateable at 1% where wall-clock
+// on a shared 2-core host is not.
+struct WireSyscallCounters {
+  std::atomic<int64_t> syscalls{0};      // send/recv/sendmsg/recvmsg/poll
+  std::atomic<int64_t> uring_enters{0};  // io_uring_enter calls
+  std::atomic<int64_t> uring_sqes{0};    // SQEs submitted
+};
+WireSyscallCounters& WireCounters();
+
+class UringWire {
+ public:
+  // Completion router: `owner`/`stripe`/`dir` echo the Prep* call, `res`
+  // is the raw CQE result (bytes moved, 0 = recv EOF, negative errno).
+  // socket.cc installs a handler that forwards into Link bookkeeping.
+  using CompletionFn = void (*)(void* owner, int stripe, int dir, int res);
+
+  static UringWire& Get();
+
+  // Build-time header + runtime kernel probe, cached after the first call:
+  // io_uring_setup must succeed AND advertise IORING_FEAT_EXT_ARG (5.11+)
+  // — without timed waits a dead peer could park the wire thread past the
+  // fault domain's detection deadline, so older kernels stay on poll.
+  static bool Supported();
+
+  bool Init(unsigned entries, CompletionFn on_complete);
+  bool Active() const { return ring_fd_ >= 0; }
+  void Destroy();
+
+  // One in-flight op per (owner, stripe, dir) is the callers' invariant;
+  // each Prep writes one SQE (no syscall).  False when the SQ is full or
+  // no pending slot is free — callers treat it as would-block and let the
+  // next Pump drain the backlog.  The iovec forms copy the (<= 16 entry)
+  // array into slot-owned storage that outlives the kernel's use.
+  bool PrepSend(void* owner, int stripe, int fd, const void* buf, size_t n);
+  bool PrepRecv(void* owner, int stripe, int fd, void* buf, size_t n);
+  bool PrepSendv(void* owner, int stripe, int fd, const struct iovec* iov,
+                 int cnt);
+  bool PrepRecvv(void* owner, int stripe, int fd, const struct iovec* iov,
+                 int cnt);
+
+  // Submit everything prepped and reap completions; the single syscall of
+  // the steady state.  wait=false: reap-only is free (shared-memory CQ
+  // read) unless there are SQEs to submit.  wait=true: one enter submits
+  // AND parks for >= 1 CQE, bounded by timeout_ms.  Returns completions
+  // delivered to the handler.
+  int Pump(bool wait, int timeout_ms);
+
+  // Drop every pending op owned by `owner` (a Link being torn down): the
+  // owner's sockets are already shut down, so in-flight ops complete
+  // promptly with an error CQE; this drains them (bounded) and orphans
+  // whatever survives so late CQEs route nowhere.  If the drain times out
+  // the whole ring is destroyed — the kernel's ring teardown cancels and
+  // waits on in-flight ops, which is the only remaining way to guarantee
+  // no completion ever lands in freed caller memory.
+  void OrphanOwner(void* owner);
+
+  int InflightTotal() const { return live_slots_; }
+
+ private:
+  struct Slot {
+    void* owner = nullptr;
+    int stripe = 0;
+    int dir = 0;
+    bool live = false;
+    struct msghdr mh;
+    struct iovec iov[16];
+  };
+
+  void* NextSqe(unsigned* out_idx);
+  int AllocSlot();
+  int Reap();
+
+  int ring_fd_ = -1;
+  CompletionFn on_complete_ = nullptr;
+
+  // mmap'd rings (raw pointers into the shared SQ/CQ pages)
+  void* sq_ring_ = nullptr;
+  size_t sq_ring_sz_ = 0;
+  void* cq_ring_ = nullptr;
+  size_t cq_ring_sz_ = 0;
+  void* sqes_ = nullptr;
+  size_t sqes_sz_ = 0;
+  bool single_mmap_ = false;
+
+  unsigned sq_entries_ = 0;
+  unsigned cq_entries_ = 0;
+  unsigned* sq_head_ = nullptr;
+  unsigned* sq_tail_ = nullptr;
+  unsigned* sq_mask_ = nullptr;
+  unsigned* sq_array_ = nullptr;
+  unsigned* cq_head_ = nullptr;
+  unsigned* cq_tail_ = nullptr;
+  unsigned* cq_mask_ = nullptr;
+  void* cqes_ = nullptr;
+
+  unsigned to_submit_ = 0;  // SQEs prepped since the last enter
+  int live_slots_ = 0;
+  Slot* slots_ = nullptr;   // sq_entries_ of them
+};
+
+}  // namespace hvdtpu

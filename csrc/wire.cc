@@ -148,6 +148,38 @@ void PutSetTagAndAudits(std::string* s, int32_t set,
   }
 }
 
+// Wire v13 trailing chain on RequestList: set tag, audits, then the
+// per-request priority block.  A priority-silent frame (every request at
+// the default 0) writes EXACTLY the v12 bytes; when any priority is set,
+// the earlier optional blocks (set tag, audit count) are forced out
+// explicitly — the same rule PutSetTagVerdictsCodec uses for tuned_codec —
+// so the parser can position past them to the priorities.
+void PutSetTagAuditsPriorities(std::string* s, int32_t set,
+                               const std::vector<AuditRecord>& audits,
+                               const std::vector<Request>& requests) {
+  bool any = false;
+  for (const Request& r : requests) {
+    if (r.priority != 0) {
+      any = true;
+      break;
+    }
+  }
+  if (!any) {
+    PutSetTagAndAudits(s, set, audits);
+    return;
+  }
+  PutI32(s, set);
+  PutU32(s, static_cast<uint32_t>(audits.size()));
+  for (const AuditRecord& a : audits) {
+    PutI32(s, a.rank);
+    PutU32(s, a.epoch);
+    PutU32(s, a.round);
+    PutU64(s, a.sum);
+  }
+  PutU32(s, static_cast<uint32_t>(requests.size()));
+  for (const Request& r : requests) PutI32(s, r.priority);
+}
+
 int32_t ReadSetTagAndAudits(Reader* rd, std::vector<AuditRecord>* audits) {
   audits->clear();
   if (rd->fail || rd->off >= rd->buf.size()) return 0;
@@ -278,7 +310,7 @@ std::string Serialize(const RequestList& l) {
     PutStr(&s, r.name);
     PutDims(&s, r.dims);
   }
-  PutSetTagAndAudits(&s, l.process_set, l.audits);
+  PutSetTagAuditsPriorities(&s, l.process_set, l.audits, l.requests);
   return s;
 }
 
@@ -304,6 +336,18 @@ Status Parse(const std::string& buf, RequestList* out) {
   }
   out->process_set = ReadSetTagAndAudits(&rd, &out->audits);
   if (rd.fail) return Status::Error("truncated request-list audit block");
+  // trailing priority block (wire v13): present exactly when bytes remain
+  if (rd.off < rd.buf.size()) {
+    uint32_t np = rd.U32();
+    if (rd.fail || np != out->requests.size())
+      return Status::Error("request-list priority block count mismatch");
+    for (Request& r : out->requests) {
+      r.priority = rd.I32();
+      if (r.priority < kPriorityMin || r.priority > kPriorityMax)
+        return Status::Error("request priority out of range");
+    }
+    if (rd.fail) return Status::Error("truncated request-list priorities");
+  }
   for (Request& r : out->requests) r.set = out->process_set;
   return Status::OK();
 }

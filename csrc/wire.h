@@ -23,20 +23,42 @@ namespace hvdtpu {
 
 // Bump kWireVersion on ANY layout change (header, field order, new frame).
 constexpr uint32_t kWireMagic = 0x48564457u;  // "HVDW" little-endian
-constexpr uint16_t kWireVersion = 12;         // v12: negotiated wire codecs —
-                                              // ResponseList and CachedExec
-                                              // gain a TRAILING tuned_codec
-                                              // knob (after the verdicts
-                                              // block, serialized only when
-                                              // >= 0) and the bootstrap table
-                                              // gains the wire_codec +
-                                              // codec_ef fields.  Codec-off
+constexpr uint16_t kWireVersion = 13;         // v13: consumer-order priority
+                                              // scheduling — RequestList
+                                              // gains a TRAILING per-request
+                                              // priority block (after the
+                                              // audit block, serialized only
+                                              // when any request carries a
+                                              // non-zero priority), which the
+                                              // coordinator uses to order
+                                              // each round's responses by
+                                              // (priority desc, name) instead
+                                              // of arrival.  Priority-less
                                               // jobs (the default) serialize
-                                              // byte-for-byte v11-SHAPED
+                                              // byte-for-byte v12-SHAPED
                                               // frames (only the header's
                                               // version value moved), which
                                               // is what keeps the ctrl-bytes
                                               // CI gate pinned at 1.0000.
+                                              // v12: negotiated wire codecs —
+                                              // ResponseList and CachedExec
+                                              // gained a TRAILING tuned_codec
+                                              // knob (after the verdicts
+                                              // block, serialized only when
+                                              // >= 0) and the bootstrap table
+                                              // gained the wire_codec +
+                                              // codec_ef fields.
+
+// Scheduling priority bounds (wire v13).  A request's priority is a small
+// int: 0 is the default (arrival order preserved — all-zero request lists
+// serialize the v12-shaped frame with NO priority block), higher runs
+// earlier.  Frontends auto-derive from registration order (first-layer
+// params highest) under HOROVOD_TPU_PRIORITY=1; hvd.allreduce(priority=)
+// overrides.  The bounds are wire-visible: the parser rejects frames whose
+// priority block carries values outside them (a torn or hostile frame),
+// and the Python mirror pins both.
+constexpr int32_t kPriorityMin = 0;
+constexpr int32_t kPriorityMax = 1 << 20;
 
 // Reduce-scatter stripe alignment (wire-visible: the coordinator's
 // first_dims stripe counts and every member's local partition must agree
@@ -144,6 +166,11 @@ struct Request {
   // one frame holds one set's requests, so global-set-only frames stay
   // byte-for-byte what wire v7 produced).
   int32_t set = 0;
+  // Scheduling priority (wire v13): NOT serialized in the per-request
+  // body — the enclosing RequestList's TRAILING priority block carries
+  // one value per request, and only when any is non-zero, so
+  // priority-less jobs stay byte-for-byte v12-shaped.
+  int32_t priority = 0;
 };
 
 // Every negotiation-side frame below is SET-TAGGED (wire v8): a trailing
@@ -159,6 +186,13 @@ struct RequestList {
   // sampled health-audit digests (trailing, after the set tag; omitted
   // when empty — the empty case reproduces plain-v8 bytes exactly)
   std::vector<AuditRecord> audits;
+  // Scheduling priorities (wire v13): LAST in the trailing chain — one
+  // int32 per request, serialized only when any request carries a
+  // non-zero priority.  Writing the block forces the set tag and the
+  // audit count out explicitly (same force-out rule as tuned_codec), so
+  // the parser can position past them; all-zero jobs write nothing and
+  // stay byte-for-byte v12-shaped.  The values live in
+  // Request::priority; this comment anchors the serialization contract.
 };
 
 struct Response {

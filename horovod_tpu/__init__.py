@@ -19,6 +19,7 @@ Top-level API mirrors `horovod.torch`/`horovod.tensorflow` basics
 from __future__ import annotations
 
 import itertools
+import os
 
 import numpy as np
 
@@ -117,13 +118,63 @@ def _pset_name(prefix: str, name: str | None, sid: int) -> str:
     return base if sid == 0 else f"ps{sid}.{base}"
 
 
+def _apply_priority(engine, wire_name: str, priority) -> None:
+    """Register a tensor's scheduling priority with the engine (wire v13).
+
+    An explicit ``priority`` always wins.  Otherwise, under
+    ``HOROVOD_TPU_PRIORITY=1``, first-registration order auto-derives one
+    counting DOWN from ``PRIORITY_MAX``: gradients registered first (the
+    first layers, whose parameters the next forward pass consumes first)
+    schedule first.  The registry rides the ENGINE object — like
+    ``average_handles`` — so a ``shutdown()``/``init()`` cycle re-sends
+    every priority to the fresh engine instead of trusting a stale map.
+    """
+    setp = getattr(engine, "set_tensor_priority", None)
+    if setp is None:  # scripted test engines / pre-v13 .so
+        return
+    reg = getattr(engine, "_prio_registry", None)
+    if reg is None:
+        reg = engine._prio_registry = {}
+    if priority is not None:
+        p = int(priority)
+        if reg.get(wire_name) != p:
+            reg[wire_name] = p
+            setp(wire_name, p)
+        return
+    if os.environ.get("HOROVOD_TPU_PRIORITY") != "1":
+        return
+    if wire_name not in reg:
+        from horovod_tpu.runtime.wire_abi import PRIORITY_MAX, PRIORITY_MIN
+
+        p = max(PRIORITY_MAX - len(reg), PRIORITY_MIN + 1)
+        reg[wire_name] = p
+        setp(wire_name, p)
+
+
+def set_tensor_priority(name: str, priority: int, process_set=None) -> bool:
+    """Pin the negotiation priority of ``allreduce(name=...)``'s tensor.
+
+    Higher schedules earlier in each negotiated round (wire v13); 0
+    restores FIFO for that tensor.  Returns False when the loaded engine
+    predates priority scheduling.  Applies to future submissions of the
+    name — the per-round order is still decided by the coordinator over
+    the globally-ready set."""
+    sid, _ = _pset(process_set)
+    engine = _state.engine()
+    if getattr(engine, "set_tensor_priority", None) is None:
+        return False
+    _apply_priority(engine, _pset_name("allreduce", name, sid),
+                    int(priority))
+    return True
+
+
 # --------------------------------------------------------------------------
 # Synchronous eager collectives (numpy in, numpy out)
 # --------------------------------------------------------------------------
 
 def allreduce(tensor, average: bool = True, name: str | None = None,
               compression=Compression.none, out=None,
-              process_set=None) -> np.ndarray:
+              process_set=None, priority: int | None = None) -> np.ndarray:
     """Sum (or average) across all processes.
 
     ``out``: optional result buffer (input's shape/dtype, C-contiguous)
@@ -135,6 +186,12 @@ def allreduce(tensor, average: bool = True, name: str | None = None,
     ``process_set``: a :class:`ProcessSet` (or id) restricting the
     collective to that set's members, running concurrently with other
     sets' traffic; ``average`` divides by the SET size.
+
+    ``priority``: wire v13 scheduling hint — higher-priority tensors are
+    ordered first in each negotiated round (and never fused with a
+    different priority class), shrinking time-to-first-needed-tensor for
+    the layers the next forward pass consumes first.  Omit it and set
+    ``HOROVOD_TPU_PRIORITY=1`` to auto-derive from registration order.
     """
     sid, nprocs = _pset(process_set)
     arr = _as_numpy(tensor)
@@ -155,8 +212,10 @@ def allreduce(tensor, average: bool = True, name: str | None = None,
         # agreement round in the engine (not implemented).
         comp, ctx = compression.decompress(comp, ctx), None
     direct = out if compression is Compression.none else None
-    res = _state.engine().allreduce(comp, _pset_name("allreduce", name, sid),
-                                    out=direct, process_set=sid)
+    wname = _pset_name("allreduce", name, sid)
+    engine = _state.engine()
+    _apply_priority(engine, wname, priority)
+    res = engine.allreduce(comp, wname, out=direct, process_set=sid)
     res = compression.decompress(res, ctx)
     if average:
         if direct is not None:
@@ -264,12 +323,14 @@ def barrier() -> None:
 # --------------------------------------------------------------------------
 
 def allreduce_async(tensor, average: bool = True, name: str | None = None,
-                    out=None, process_set=None) -> int:
+                    out=None, process_set=None,
+                    priority: int | None = None) -> int:
     sid, nprocs = _pset(process_set)
     arr = _as_numpy(tensor)
     engine = _state.engine()
-    handle = engine.allreduce_async(arr, _pset_name("allreduce", name, sid),
-                                    out=out, process_set=sid)
+    wname = _pset_name("allreduce", name, sid)
+    _apply_priority(engine, wname, priority)
+    handle = engine.allreduce_async(arr, wname, out=out, process_set=sid)
     if average:
         # tracked on the engine (with the communicator size to divide by)
         # so handle-id reuse after shutdown()/init() can never inherit a
@@ -361,7 +422,7 @@ __all__ = [
     "ProcessSet", "add_process_set", "global_process_set",
     "process_set_stats",
     "allreduce", "allgather", "broadcast", "alltoall", "barrier",
-    "reducescatter", "grouped_allgather",
+    "reducescatter", "grouped_allgather", "set_tensor_priority",
     "allreduce_async", "allgather_async", "broadcast_async",
     "alltoall_async", "reducescatter_async", "grouped_allgather_async",
     "poll", "synchronize",

@@ -383,6 +383,17 @@ def main(argv=None) -> int:
                          "across them — K congestion windows drive a "
                          "congested or paced link instead of one; results "
                          "are bitwise identical for any K")
+    ap.add_argument("--io-uring", action="store_true",
+                    help="batch wire I/O through io_uring (sets "
+                         "HOROVOD_TPU_IO_URING=1 for every worker): each "
+                         "progress tick submits the whole stripe set in "
+                         "one io_uring_enter and parks on completions "
+                         "instead of poll+send/recv per stripe. Rank-"
+                         "local and transport-only — bytes on the wire "
+                         "are identical, so mixed io_uring/poll fleets "
+                         "interoperate; falls back to poll (with one "
+                         "warning) on kernels without io_uring "
+                         "(needs IORING_FEAT_EXT_ARG, Linux 5.11+)")
     ap.add_argument("--wire-codec", default=None,
                     choices=("none", "fp16", "bf16", "int8"),
                     metavar="CODEC",
@@ -699,6 +710,8 @@ def main(argv=None) -> int:
             env["HOROVOD_TPU_SG_THRESHOLD_BYTES"] = str(args.sg_threshold)
         if args.wire_codec is not None:
             env["HOROVOD_TPU_WIRE_CODEC"] = args.wire_codec
+        if args.io_uring:
+            env["HOROVOD_TPU_IO_URING"] = "1"
         if args.health_sample is not None:
             env["HOROVOD_TPU_AUDIT_SAMPLE"] = str(args.health_sample)
         if args.health_fatal:

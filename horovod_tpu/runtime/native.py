@@ -297,6 +297,15 @@ def _bind(lib):
         lib.hvd_codec_decode.restype = None
     except AttributeError:
         pass
+    try:
+        # priority scheduling + io_uring data plane (wire v13); same caveat
+        lib.hvd_set_tensor_priority.argtypes = [ctypes.c_char_p,
+                                                ctypes.c_int64]
+        lib.hvd_set_tensor_priority.restype = None
+        lib.hvd_dataplane_stats.argtypes = [ctypes.POINTER(ctypes.c_int64)]
+        lib.hvd_dataplane_stats.restype = None
+    except AttributeError:
+        pass
     return lib
 
 
@@ -377,6 +386,7 @@ class NativeEngine(Engine):
         d.update(self.codec_stats())
         d.update(self._fault_stats())
         d.update(self._wire_stats())
+        d.update(self.dataplane_stats())
         d.update(self.world_stats())
         d.update(self.drain_stats())
         d.update(self.trace_stats())
@@ -521,6 +531,38 @@ class NativeEngine(Engine):
         d["wire_stripes"] = max(d["wire_stripes"], 1)
         d["wire_stripe_bytes"] = [max(int(vals[8 + s]), 0) for s in range(8)]
         return d
+
+    def dataplane_stats(self) -> dict:
+        """Priority-schedule + io_uring counters (wire v13) for THIS rank.
+        ``wire_syscalls`` counts every data-plane send/recv/poll syscall
+        and ``uring_enters``/``uring_sqes`` the batched replacements — all
+        COUNTED series (pure functions of workload + transport), which is
+        what lets the bench gate "io_uring needs 3x fewer syscalls" where
+        wall-clock can't be trusted.  ``ttfnt_ns``/``ttfnt_rounds`` feed
+        the hvd_ttfnt_seconds windowed mean; ``priority_rounds`` /
+        ``priority_first_hits`` are the counted response-order series.
+        Zeros when the loaded .so predates wire v13."""
+        fn = getattr(self._lib, "hvd_dataplane_stats", None)
+        keys = ("wire_syscalls", "uring_sqes", "uring_enters",
+                "io_uring_active", "io_uring_supported", "ttfnt_ns",
+                "ttfnt_rounds", "priority_rounds", "priority_first_hits",
+                "priority_sched")
+        if fn is None:
+            return dict.fromkeys(keys, 0)
+        vals = (ctypes.c_int64 * 16)()
+        fn(vals)
+        return {k: max(int(v), 0) for k, v in zip(keys, vals)}
+
+    def set_tensor_priority(self, name: str, priority: int) -> bool:
+        """Install the scheduling priority future ops named ``name`` carry
+        (wire v13): larger runs earlier in a negotiated round; 0 (the
+        default) restores arrival order and the v12-identical frames.
+        False when the loaded .so predates priorities."""
+        fn = getattr(self._lib, "hvd_set_tensor_priority", None)
+        if fn is None:
+            return False
+        fn(name.encode(), int(priority))
+        return True
 
     # -- process sets (wire v8) --------------------------------------------
     _MAX_PSET_STATS = 64
@@ -885,7 +927,13 @@ class NativeEngine(Engine):
                   ring_segment_bytes=str(d0.get("ring_segment_bytes", 0)),
                   wire_stripes=str(d0.get("wire_stripes", 0)),
                   sg_threshold_bytes=str(
-                      d0.get("sg_threshold_bytes", 0))).set(1)
+                      d0.get("sg_threshold_bytes", 0)),
+                  # wire v13 transport/schedule knobs: a half-upgraded
+                  # fleet (some ranks on io_uring or priority scheduling,
+                  # some not) shows as >1 label set before any wire-version
+                  # handshake can trip
+                  io_uring=str(d0.get("io_uring_active", 0)),
+                  priority=str(d0.get("priority_sched", 0))).set(1)
         # serializes the read-then-inc: the dump thread and a direct
         # collector() call (shutdown, user snapshot) may race, and both
         # seeing the same stale value would double-count a stall
@@ -904,7 +952,19 @@ class NativeEngine(Engine):
                      "arb_link_verdicts": 0, "arb_dead_verdicts": 0,
                      "drains": 0, "trace_events": 0,
                      "trace_events_dropped": 0, "codec_bytes_saved": 0,
-                     "codec_residual_resets": 0}
+                     "codec_residual_resets": 0, "wire_syscalls": 0,
+                     "uring_sqes": 0, "uring_enters": 0,
+                     "priority_rounds": 0, "priority_first_hits": 0}
+        # the wire syscall counters (v13) are process-wide statics
+        # (socket.cc / uring.cc) like the fault family: a second engine
+        # init in this process seeds from the current totals so it does
+        # not re-mirror the first engine's syscall history
+        for k in ("wire_syscalls", "uring_sqes", "uring_enters"):
+            last_seen[k] = d0.get(k, 0)
+        # TTFNT (time-to-first-needed-tensor): each collection observes
+        # the window's mean (cumulative ns / cumulative round deltas),
+        # same scheme as the stage histograms; per-engine so seeds at 0
+        ttfnt_seen = [0, 0]
         # per-stripe tx bytes: one labelled counter per stripe index
         stripe_seen = [0] * 8
         # per-process-set counters: one labelled series per set id
@@ -941,6 +1001,11 @@ class NativeEngine(Engine):
             ("codec_bytes_saved", telemetry.NATIVE_CODEC_BYTES_SAVED),
             ("codec_residual_resets",
              telemetry.NATIVE_CODEC_RESIDUAL_RESETS),
+            ("wire_syscalls", telemetry.NATIVE_WIRE_SYSCALLS),
+            ("uring_sqes", telemetry.NATIVE_URING_SQES),
+            ("uring_enters", telemetry.NATIVE_URING_ENTERS),
+            ("priority_rounds", telemetry.NATIVE_PRIORITY_ROUNDS),
+            ("priority_first_hits", telemetry.NATIVE_PRIORITY_FIRST_HITS),
         )
         # the FAULT counters are process-wide by design (fault.h: they
         # survive engine re-init like the registry does) — seed their
@@ -1051,6 +1116,8 @@ class NativeEngine(Engine):
                 d.get("wire_codec", 0))
             reg.gauge(telemetry.NATIVE_CODEC_RESIDUAL_NORM).set(
                 d.get("codec_residual_norm", 0.0))
+            reg.gauge(telemetry.NATIVE_URING_ACTIVE).set(
+                max(d.get("io_uring_active", 0), 0))
             if d["heartbeat_age_s"] >= 0:  # -1 = engine down: keep the
                 reg.gauge(telemetry.NATIVE_HEARTBEAT_AGE).set(  # last real age
                     d["heartbeat_age_s"])
@@ -1159,6 +1226,13 @@ class NativeEngine(Engine):
                         dns / dn / 1e9)
                     drain_seen[0] = d["drain_latency_ns"]
                     drain_seen[1] = d["drains"]
+                dns = d.get("ttfnt_ns", 0) - ttfnt_seen[0]
+                dn = d.get("ttfnt_rounds", 0) - ttfnt_seen[1]
+                if dn > 0 and dns >= 0:
+                    reg.gauge(telemetry.NATIVE_TTFNT_SECONDS).set(
+                        dns / dn / 1e9)
+                    ttfnt_seen[0] = d.get("ttfnt_ns", 0)
+                    ttfnt_seen[1] = d.get("ttfnt_rounds", 0)
                 if "health_collectives" in d:
                     desc = None
                     try:

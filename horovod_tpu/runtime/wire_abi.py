@@ -13,7 +13,17 @@ from __future__ import annotations
 
 # csrc/wire.h — frame header
 WIRE_MAGIC = 0x48564457  # "HVDW" little-endian
-WIRE_VERSION = 12        # v12: negotiated wire codecs — a trailing
+WIRE_VERSION = 13        # v13: priority response scheduling — RequestList
+                         # gains a TRAILING per-request priority block
+                         # (one int32 per request, written only when any
+                         # request carries a non-zero priority, ALWAYS
+                         # after the set tag and audits blocks), and the
+                         # coordinator orders each negotiated round by
+                         # (max submitted priority desc, name asc) instead
+                         # of arrival order.  Priority-less jobs serialize
+                         # byte-for-byte v12-shaped frames (only the
+                         # header's version value moved).
+                         # v12: negotiated wire codecs — a trailing
                          # `tuned_codec` knob on ResponseList and
                          # CachedExecFrame (written only when >= 0,
                          # ALWAYS after the verdicts block) ships the
@@ -203,6 +213,24 @@ CODEC_IDS = {
     "kCodecBf16": CODEC_BF16,
     "kCodecInt8": CODEC_INT8,
 }
+
+# csrc/wire.h — request priority bounds (wire v13).  A request's priority
+# is a small int in [PRIORITY_MIN, PRIORITY_MAX]; larger schedules earlier
+# in a negotiated round, ties break by name ascending (deterministic).  0
+# (the default) keeps the trailing block absent and the frames
+# v12-identical.  Frontends auto-deriving priorities from registration
+# order count DOWN from PRIORITY_MAX so first-registered (first-needed
+# next step) parameters run first.  tools/check_wire_abi.py pins both
+# against wire.h.
+PRIORITY_MIN = 0
+PRIORITY_MAX = 1 << 20
+
+# csrc/wire.h — frames carrying the trailing per-request priority block
+# (wire v13): one int32 per request, written only when some request's
+# priority is non-zero, AFTER the set tag and audits blocks.
+PRIORITY_TAGGED_FRAMES = (
+    "RequestList",
+)
 
 # csrc/common.h — OpType (the request/response op codes on the wire)
 OP_ALLREDUCE = 0

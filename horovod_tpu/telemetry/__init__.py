@@ -157,6 +157,23 @@ NATIVE_CODEC_BYTES_SAVED = "hvd_codec_bytes_saved_total"
 NATIVE_CODEC_RESIDUAL_NORM = "hvd_codec_residual_norm"
 NATIVE_CODEC_RESIDUAL_RESETS = "hvd_codec_residual_resets_total"
 
+# priority-scheduled, low-syscall data plane (wire v13): counted wire
+# syscalls (send/recv/poll) vs the io_uring replacements (SQEs submitted,
+# enters made) — the ≥3x syscall drop is gated on these counted series;
+# the active gauge answers "is io_uring actually on?" per rank; TTFNT is
+# the windowed mean time from response dispatch to the round's
+# highest-priority tensor completing (the wall-clock face of consumer-
+# order scheduling); the priority round counters are the counted
+# response-order series (first_hits/rounds = share of rounds whose head
+# was the max-priority tensor)
+NATIVE_WIRE_SYSCALLS = "hvd_wire_syscalls_total"
+NATIVE_URING_SQES = "hvd_uring_sqe_total"
+NATIVE_URING_ENTERS = "hvd_uring_enter_total"
+NATIVE_URING_ACTIVE = "hvd_uring_active"
+NATIVE_TTFNT_SECONDS = "hvd_ttfnt_seconds"
+NATIVE_PRIORITY_ROUNDS = "hvd_priority_rounds_total"
+NATIVE_PRIORITY_FIRST_HITS = "hvd_priority_first_hits_total"
+
 # flight-recorder progress mirror: counted events written/dropped by the
 # per-rank black box — the per-rank progress signal the fleet sentinel
 # scores against (a rank whose event counter stops moving while peers'
@@ -506,6 +523,9 @@ __all__ = [
     "NATIVE_DRAINS", "NATIVE_DRAIN_LATENCY", "NATIVE_COORD_GENERATION",
     "NATIVE_WIRE_CODEC", "NATIVE_CODEC_BYTES_SAVED",
     "NATIVE_CODEC_RESIDUAL_NORM", "NATIVE_CODEC_RESIDUAL_RESETS",
+    "NATIVE_WIRE_SYSCALLS", "NATIVE_URING_SQES", "NATIVE_URING_ENTERS",
+    "NATIVE_URING_ACTIVE", "NATIVE_TTFNT_SECONDS",
+    "NATIVE_PRIORITY_ROUNDS", "NATIVE_PRIORITY_FIRST_HITS",
     "NATIVE_TRACE_EVENTS", "NATIVE_TRACE_DROPPED",
     "SENTINEL_SCORE", "SENTINEL_STRAGGLER_EXCESS", "SENTINEL_CONVICTIONS",
     "SENTINEL_ACTS", "SENTINEL_WINDOWS", "SENTINEL_LAST_PHASE",

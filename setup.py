@@ -26,10 +26,19 @@ HERE = os.path.abspath(os.path.dirname(__file__))
 CSRC = os.path.join(HERE, "csrc")
 SOURCES = ["socket.cc", "wire.cc", "cache.cc", "shm.cc", "timeline.cc",
            "autotune.cc", "fault.cc", "trace.cc", "health.cc", "codec.cc",
-           "engine.cc"]
+           "uring.cc", "engine.cc"]
 HEADERS = ["common.h", "socket.h", "wire.h", "cache.h", "shm.h",
            "timeline.h", "autotune.h", "fault.h", "trace.h", "health.h",
-           "logging.h", "topo.h", "codec.h"]
+           "logging.h", "topo.h", "codec.h", "uring.h"]
+
+
+def _io_uring_flags() -> list:
+    # Feature probe, same rule as csrc/Makefile: the raw-syscall io_uring
+    # backend needs only the kernel UAPI header (no liburing).  Without it
+    # uring.cc builds its stubs and the engine keeps the poll transport.
+    if os.path.exists("/usr/include/linux/io_uring.h"):
+        return ["-DHVDTPU_HAVE_IO_URING"]
+    return []
 
 
 def _compiler() -> str:
@@ -55,7 +64,7 @@ def _build_native(out_dir: str) -> str:
     ):
         return so
     cmd = [cxx, "-O2", "-g", "-std=c++17", "-fPIC", "-Wall", "-shared",
-           "-pthread", "-o", so, *srcs]
+           "-pthread", *_io_uring_flags(), "-o", so, *srcs]
     try:
         subprocess.run(cmd, check=True, capture_output=True, text=True)
     except subprocess.CalledProcessError as exc:

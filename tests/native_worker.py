@@ -776,6 +776,18 @@ def scenario_ring_equiv():
             assert d["sg_bytes_skipped"] > 0, d
         else:
             assert d["sg_bytes_skipped"] == 0, d
+    expect_uring = os.environ.get("HVD_TEST_EXPECT_URING")
+    if expect_uring is not None:
+        # the uring-vs-poll battery must not pass vacuously: with =1 the
+        # io_uring transport actually carried the wire (ring live, SQEs
+        # submitted); with =0 the poll leg ran with zero ring activity
+        d = _diag()
+        if expect_uring == "1":
+            assert d["io_uring_active"] == 1, d
+            assert d["uring_sqes"] > 0 and d["uring_enters"] > 0, d
+        else:
+            assert d["io_uring_active"] == 0, d
+            assert d["uring_sqes"] == 0, d
     if os.environ.get("HVD_TEST_DUMP_DIAG") == "1":
         # wire-codec v12 codec-off contract: the test compares these
         # across env spellings (unset vs =none) — same results, same
@@ -814,6 +826,67 @@ def scenario_ring_equiv_paced_flat():
     os.environ["HOROVOD_TPU_HOST_HASH"] = f"simhost{r}"
     os.environ["HOROVOD_TPU_HIERARCHICAL_ALLREDUCE"] = "0"
     scenario_ring_equiv()
+
+
+def scenario_priority():
+    """Priority-scheduling battery (wire v13) under inverted-arrival bait:
+    every step submits a fused batch in ASCENDING priority order — the
+    lowest-priority tensor arrives (and would FIFO-schedule) first — plus
+    the explicit set_tensor_priority spelling.  Per-rank results are
+    dumped like ring_equiv; the test runs this with
+    HOROVOD_TPU_PRIORITY_SCHED=1 vs =0 and asserts the dumps are BITWISE
+    identical — response ORDER may never change the arithmetic.  (Both
+    legs submit IDENTICAL priorities, so fusion classes — which key on
+    priority whenever any is non-zero, sched on or off — group the same
+    tensors and the comparison isolates pure ordering.)
+
+    With HVD_TEST_EXPECT_PRIORITY=1 (the sched-on leg) rank 0 asserts
+    every priority round scheduled a round-max-priority response first
+    (the counted first-hit series) and that the TTFNT meter armed.
+    Negotiation caching must be off (the test pins
+    HOROVOD_TPU_CACHE_CAPACITY=0) so every step renegotiates and the
+    coordinator keeps making ordering decisions."""
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    out_dir = os.environ["HVD_TEST_OUT_DIR"]
+    rng = np.random.default_rng(1234)  # same stream on every rank
+    chunks = []
+    for step in range(8):
+        handles = []
+        for i in range(6):
+            arr = (rng.standard_normal(4097 + 512 * i) * (r + 1 + i)
+                   ).astype(np.float32)
+            # ascending priority, descending need: g5 (submitted LAST)
+            # carries the round's max — FIFO would schedule g0 first
+            handles.append(hvd.allreduce_async(
+                arr, average=False, name=f"pr{step}.g{i}",
+                priority=(i + 1) * 10))
+        # a deliberate inter-submission gap on the highest-priority
+        # tensor's side: arrival order is settled before it lands
+        for h in handles:
+            chunks.append(np.ascontiguousarray(hvd.synchronize(h)))
+    # explicit API spelling: set once, applies to later submissions
+    assert hvd.set_tensor_priority("late", 999)
+    for step in range(2):
+        arr = (rng.standard_normal(2048) * (r + 1)).astype(np.float32)
+        chunks.append(np.ascontiguousarray(
+            hvd.allreduce(arr, average=False, name="late")))
+    d = _diag()
+    if os.environ.get("HVD_TEST_EXPECT_PRIORITY") == "1" and r == 0:
+        assert d["priority_rounds"] > 0, d
+        assert d["priority_first_hits"] == d["priority_rounds"], d
+        assert d["priority_sched"] == 1, d
+        assert d["ttfnt_rounds"] > 0 and d["ttfnt_ns"] > 0, d
+    if os.environ.get("HVD_TEST_EXPECT_PRIORITY") == "0" and r == 0:
+        # FIFO control arm: priorities flow (rounds counted) but the
+        # scheduler is off
+        assert d["priority_sched"] == 0, d
+        assert d["priority_rounds"] > 0, d
+    blob = b"".join(c.tobytes() for c in chunks)
+    with open(os.path.join(out_dir, f"priority_r{r}.bin"), "wb") as f:
+        f.write(blob)
+    hvd.shutdown()
+    print(f"rank {r}: priority OK ({len(blob)} bytes)", flush=True)
 
 
 def scenario_topo_describe():

@@ -103,6 +103,24 @@ def test_kill_mid_ring_tcp():
     _assert_died_well(res, dead_rank=1, np_=2)
 
 
+def test_kill_mid_ring_tcp_uring():
+    """Chaos row for the io_uring wire: rank 1 dies while its peers have
+    SQEs in flight on the batched ring.  The completion surfaces the error
+    (ECONNRESET/EPIPE in a CQE instead of a poll revent), NoteWireFail
+    latches it sticky, and the same arbitration path must name the dead
+    rank inside the bound — the syscall batching must not swallow or
+    defer the failure."""
+    from test_native_engine import _uring_supported
+
+    if not _uring_supported():
+        pytest.skip("kernel io_uring insufficient; poll chaos legs cover")
+    res = _run_chaos("fault_loop", 2, "kill:rank=1:phase=ring:hit=8",
+                     extra_env={"HVD_TEST_ELEMS": "2000000",
+                                "HOROVOD_TPU_SHM": "0",
+                                "HOROVOD_TPU_IO_URING": "1"})
+    _assert_died_well(res, dead_rank=1, np_=2)
+
+
 def test_kill_at_pack():
     res = _run_chaos("fault_loop", 2, "kill:rank=1:phase=pack:hit=6")
     _assert_died_well(res, dead_rank=1, np_=2)

@@ -769,6 +769,132 @@ def test_striped_sg_bitwise_hierarchical_paced(tmp_path):
     _assert_blobs_equal(blobs, "k1", 4)
 
 
+# ---------------------------------------------------------------------------
+# io_uring wire backend + priority scheduling (wire v13)
+# ---------------------------------------------------------------------------
+
+def _uring_supported() -> bool:
+    """True when the loaded .so reports the kernel can run the io_uring
+    wire (io_uring_setup + IORING_FEAT_EXT_ARG).  The uring batteries
+    SKIP on old kernels — the poll legs of the matrix cover them."""
+    import ctypes
+
+    if native_so_status() is not None:
+        return False
+    from horovod_tpu.runtime.native import lib_path
+
+    lib = ctypes.CDLL(lib_path())
+    if not hasattr(lib, "hvd_io_uring_supported"):
+        return False
+    return bool(lib.hvd_io_uring_supported())
+
+
+def _uring_cfg(cfg, on=True):
+    env = dict(cfg)
+    env["HOROVOD_TPU_IO_URING"] = "1" if on else "0"
+    env["HVD_TEST_EXPECT_URING"] = "1" if on else "0"
+    return env
+
+
+def test_uring_vs_poll_bitwise_tcp(tmp_path):
+    """The io_uring transport is invisible above the byte stream: the
+    poll single-stripe packed baseline must match uring at K ∈ {1,2,4}
+    stripes × scatter-gather on/off bitwise over plain TCP (fp16 rows
+    included), with the worker-side probes proving the ring actually
+    carried the wire (SQEs submitted) — and stayed silent on the poll
+    leg (the HOROVOD_TPU_IO_URING=0 forced-fallback contract)."""
+    if not _uring_supported():
+        pytest.skip("kernel lacks io_uring (IORING_FEAT_EXT_ARG)")
+    blobs = _wire_equiv_blobs(
+        tmp_path, "ring_equiv", 2,
+        {"HOROVOD_TPU_SHM": "0", "HVD_TEST_RING_FP16": "1"},
+        [("poll_k1", _uring_cfg(_stripe_cfg(1, sg=False), on=False)),
+         ("uring_k1", _uring_cfg(_stripe_cfg(1, sg=False))),
+         ("uring_k2_sg", _uring_cfg(_stripe_cfg(2, sg=True, traffic=True))),
+         ("uring_k4_sg", _uring_cfg(_stripe_cfg(4, sg=True,
+                                                traffic=True)))])
+    _assert_blobs_equal(blobs, "poll_k1", 2)
+
+
+@pytest.mark.slow
+def test_uring_vs_poll_bitwise_paced_codec(tmp_path):
+    """uring vs poll with the fp16 wire codec live on a paced flat-ring
+    topology (every byte rides paced cross-host TCP, encoded on the
+    sender): the transport must not disturb codec framing — both legs
+    run the SAME codec, so the lossy arithmetic is identical and the
+    comparison is exact."""
+    if not _uring_supported():
+        pytest.skip("kernel lacks io_uring (IORING_FEAT_EXT_ARG)")
+    blobs = _wire_equiv_blobs(
+        tmp_path, "ring_equiv_paced_flat", 2,
+        {"HOROVOD_TPU_CROSS_HOST_PACE_MBPS": "200",
+         "HOROVOD_TPU_WIRE_CODEC": "fp16"},
+        [("poll_k2", _uring_cfg(_stripe_cfg(2, sg=False), on=False)),
+         ("uring_k2", _uring_cfg(_stripe_cfg(2, sg=False))),
+         ("uring_k4_sg", _uring_cfg(_stripe_cfg(4, sg=True,
+                                                traffic=True)))])
+    _assert_blobs_equal(blobs, "poll_k2", 2)
+
+
+def _priority_blobs(tmp_path, configs, np_=2):
+    """Run the priority battery once per (label, env overlay); returns
+    label -> per-rank blobs.  Negotiation caching is pinned OFF so every
+    step renegotiates and the coordinator keeps making ordering
+    decisions; cycle batching is pinned like the ring battery so every
+    leg fuses identical groups."""
+    blobs = {}
+    for label, env_over in configs:
+        out = tmp_path / label
+        out.mkdir()
+        env = {
+            "HVD_TEST_OUT_DIR": str(out),
+            "HOROVOD_TPU_CACHE_CAPACITY": "0",
+            "HOROVOD_TPU_CYCLE_TIME": "100",
+            "HOROVOD_TPU_BURST_WINDOW_US": "50000",
+            "HOROVOD_TPU_SHM": "0",
+        }
+        env.update(env_over)
+        res = _run("priority", np_, timeout=240, env=env)
+        assert res.returncode == 0, res.stderr + res.stdout
+        for r in range(np_):
+            assert f"rank {r}: priority OK" in res.stdout
+        blobs[label] = _read_rank_files(str(out), "priority", np_)
+    return blobs
+
+
+def test_priority_vs_fifo_bitwise(tmp_path):
+    """Consumer-order scheduling may only change WHEN results arrive,
+    never what they are: the inverted-arrival battery under
+    HOROVOD_TPU_PRIORITY_SCHED=1 must match the FIFO control arm (=0 —
+    same priorities on the wire, same fusion classes, arrival order)
+    bitwise on every rank, with the sched-on leg asserting every round
+    scheduled a round-max-priority response first."""
+    blobs = _priority_blobs(tmp_path, [
+        ("fifo", {"HOROVOD_TPU_PRIORITY_SCHED": "0",
+                  "HVD_TEST_EXPECT_PRIORITY": "0"}),
+        ("sched", {"HOROVOD_TPU_PRIORITY_SCHED": "1",
+                   "HVD_TEST_EXPECT_PRIORITY": "1"}),
+    ])
+    _assert_blobs_equal(blobs, "fifo", 2)
+
+
+def test_priority_on_uring_wire_bitwise(tmp_path):
+    """Both tentpole halves composed: priority-ordered responses riding
+    the io_uring transport must match the poll spelling bitwise, with
+    the first-hit counters asserting the ordering engaged on both."""
+    if not _uring_supported():
+        pytest.skip("kernel lacks io_uring (IORING_FEAT_EXT_ARG)")
+    blobs = _priority_blobs(tmp_path, [
+        ("poll", {"HOROVOD_TPU_PRIORITY_SCHED": "1",
+                  "HVD_TEST_EXPECT_PRIORITY": "1",
+                  "HOROVOD_TPU_IO_URING": "0"}),
+        ("uring", {"HOROVOD_TPU_PRIORITY_SCHED": "1",
+                   "HVD_TEST_EXPECT_PRIORITY": "1",
+                   "HOROVOD_TPU_IO_URING": "1"}),
+    ])
+    _assert_blobs_equal(blobs, "poll", 2)
+
+
 def test_autotune_wire_stripes_opt_in(tmp_path):
     """HOROVOD_TPU_AUTOTUNE_WIRE_STRIPES=1 adds the active stripe count
     to the search ({1,2,4}, CSV column included) over plain TCP: the mesh

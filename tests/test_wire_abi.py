@@ -34,7 +34,7 @@ def test_checker_detects_version_drift():
     """The guard must actually bite: a simulated version bump in wire.h
     without a Python update is reported."""
     wire_h, common_h = _headers()
-    tampered = wire_h.replace("kWireVersion = 12", "kWireVersion = 13")
+    tampered = wire_h.replace("kWireVersion = 13", "kWireVersion = 14")
     assert tampered != wire_h, "kWireVersion moved; update this test"
     problems = check_wire_abi.check(tampered, common_h)
     assert any("kWireVersion" in p for p in problems), problems
@@ -257,7 +257,7 @@ def test_version_mismatch_message_names_both_versions():
     lib.hvd_free_cstr.argtypes = [ctypes.c_void_p]
     lib.hvd_wire_version.restype = ctypes.c_int
 
-    assert lib.hvd_wire_version() == wire_abi.WIRE_VERSION == 12
+    assert lib.hvd_wire_version() == wire_abi.WIRE_VERSION == 13
 
     def parse_error(buf: bytes) -> str | None:
         p = lib.hvd_frame_parse_error(buf, len(buf))
@@ -268,19 +268,25 @@ def test_version_mismatch_message_names_both_versions():
         finally:
             lib.hvd_free_cstr(p)
 
-    # v11 <-> v12 (the previous release still running somewhere): the
-    # codec version bump must surface as the descriptive both-versions
-    # message, exactly like every previous bump
+    # v12 <-> v13 (the previous release still running somewhere): the
+    # priority/io_uring version bump must surface as the descriptive
+    # both-versions message, exactly like every previous bump
+    stale = wire_abi.frame_header(version=12) + b"\x00" * 16
+    msg = parse_error(stale)
+    assert msg is not None
+    assert "v12" in msg and "v13" in msg and "libhvdtpu.so" in msg, msg
+
+    # two releases back (v11, pre-codec): same contract, both named
     stale = wire_abi.frame_header(version=11) + b"\x00" * 16
     msg = parse_error(stale)
     assert msg is not None
-    assert "v11" in msg and "v12" in msg and "libhvdtpu.so" in msg, msg
+    assert "v11" in msg and "v13" in msg and "libhvdtpu.so" in msg, msg
 
     # an even older v7 header: same contract, both versions named
     stale = wire_abi.frame_header(version=7) + b"\x00" * 16
     msg = parse_error(stale)
     assert msg is not None
-    assert "v7" in msg and "v12" in msg and "libhvdtpu.so" in msg, msg
+    assert "v7" in msg and "v13" in msg and "libhvdtpu.so" in msg, msg
 
     # current-version garbage is a parse error, not a version error
     import struct
@@ -300,18 +306,16 @@ def _codec_header():
 
 
 def test_v12_codec_collateral_present():
-    """The negotiated-codec wire v12 collateral: the version is 12 on both
-    sides, tuned_codec is the LAST knob in the mirror and rides BOTH
-    response-side frames after their verdicts block, and the codec ids
-    match csrc/codec.h."""
+    """The negotiated-codec wire v12 collateral: tuned_codec is the LAST
+    knob in the mirror and rides BOTH response-side frames after their
+    verdicts block, and the codec ids match csrc/codec.h (the version pin
+    itself moved to the v13 test)."""
     from horovod_tpu.runtime import wire_abi
 
-    assert wire_abi.WIRE_VERSION == 12
     assert wire_abi.TUNED_KNOBS[-1] == "tuned_codec"
     assert (wire_abi.CODEC_NONE, wire_abi.CODEC_FP16, wire_abi.CODEC_BF16,
             wire_abi.CODEC_INT8) == (0, 1, 2, 3)
     wire_h, common_h = _headers()
-    assert "kWireVersion = 12" in wire_h
     assert wire_h.count("int64_t tuned_codec") == 2
     codec_h = _codec_header()
     for needle in ("kCodecNone = 0", "kCodecFp16 = 1", "kCodecBf16 = 2",
@@ -330,6 +334,104 @@ def test_checker_detects_codec_id_drift():
     assert tampered != codec_h, "kCodecBf16 moved; update this test"
     problems = check_wire_abi.check(wire_h, common_h, tampered)
     assert any("codec ids" in p for p in problems), problems
+
+
+def test_v13_priority_collateral_present():
+    """The priority-scheduling wire v13 collateral: the version is 13 on
+    both sides, the priority bounds match their mirrors, Request carries
+    the per-request priority field, and the trailing priority block is
+    declared AFTER the audits block in every PRIORITY_TAGGED frame."""
+    from horovod_tpu.runtime import wire_abi
+
+    assert wire_abi.WIRE_VERSION == 13
+    assert wire_abi.PRIORITY_MIN == 0
+    assert wire_abi.PRIORITY_MAX == 1 << 20
+    assert wire_abi.PRIORITY_TAGGED_FRAMES == ("RequestList",)
+    wire_h, common_h = _headers()
+    assert "kWireVersion = 13" in wire_h
+    assert "int32_t priority = 0;" in wire_h
+    assert check_wire_abi.check(wire_h, common_h) == []
+
+
+def test_checker_detects_priority_bound_drift():
+    """A renumbered priority bound in wire.h without the Python mirror is
+    reported — the clamp range decides what frontends may encode, so a
+    silent change skews every auto-derived priority."""
+    wire_h, common_h = _headers()
+    tampered = wire_h.replace("kPriorityMax = 1 << 20",
+                              "kPriorityMax = 1 << 16")
+    assert tampered != wire_h, "kPriorityMax moved; update this test"
+    problems = check_wire_abi.check(tampered, common_h)
+    assert any("kPriorityMax" in p for p in problems), problems
+
+
+def test_checker_detects_lost_priority_field():
+    """Request losing its priority member (the v13 value carrier) is
+    reported."""
+    wire_h, common_h = _headers()
+    tampered = wire_h.replace("  int32_t priority = 0;", "", 1)
+    assert tampered != wire_h, "Request.priority moved; update this test"
+    problems = check_wire_abi.check(tampered, common_h)
+    assert any("priority" in p for p in problems), problems
+
+
+def test_priority_silent_frames_are_v12_identical():
+    """wire v13's priority-off contract, asserted on actual frame BYTES:
+    a RequestList whose every request sits at the default priority 0
+    serializes with NO trailing priority block — the exact v12 layout —
+    and a prioritized list appends the block strictly at the end (the
+    priority-0 frame is a byte prefix), so mixed v13 jobs where only some
+    tensors carry priorities still parse everywhere."""
+    import ctypes
+
+    import pytest
+
+    from conftest import native_so_status
+    from horovod_tpu.runtime import wire_abi
+
+    if native_so_status() is not None:
+        pytest.skip(native_so_status())
+    from horovod_tpu.runtime.native import lib_path
+
+    lib = ctypes.CDLL(lib_path())
+    if not hasattr(lib, "hvd_debug_serialize_reqlist"):
+        pytest.skip("loaded .so predates hvd_debug_serialize_reqlist")
+    lib.hvd_debug_serialize_reqlist.argtypes = [
+        ctypes.c_int32, ctypes.POINTER(ctypes.c_int64)]
+    lib.hvd_debug_serialize_reqlist.restype = ctypes.c_void_p
+    lib.hvd_free_cstr.argtypes = [ctypes.c_void_p]
+
+    def frame(priority: int) -> bytes:
+        n = ctypes.c_int64()
+        p = lib.hvd_debug_serialize_reqlist(priority, ctypes.byref(n))
+        try:
+            return ctypes.string_at(p, n.value)
+        finally:
+            lib.hvd_free_cstr(p)
+
+    silent, hot = frame(0), frame(7)
+    # the silent frame ends where the v12 body ends: no set tag (global
+    # set), no audit block, no priority block
+    assert silent.startswith(wire_abi.frame_header())
+    # trailing chain: set tag (4) + audit count (4) + request count (4)
+    # + 2 priorities (8) = 20 bytes appended, nothing else moved
+    assert hot.startswith(silent), "priority block is not strictly trailing"
+    assert len(hot) == len(silent) + 20, (len(silent), len(hot))
+    import struct
+
+    assert struct.unpack_from("<i", hot, len(silent))[0] == 0  # set tag
+    assert struct.unpack_from("<I", hot, len(silent) + 4)[0] == 0  # audits
+    assert struct.unpack_from("<I", hot, len(silent) + 8)[0] == 2  # count
+    assert struct.unpack_from("<ii", hot, len(silent) + 12) == (7, 7)
+    # both spellings parse clean on the current engine
+    lib.hvd_frame_parse_error.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+    lib.hvd_frame_parse_error.restype = ctypes.c_void_p
+    for f in (silent, hot):
+        err = lib.hvd_frame_parse_error(f, len(f))
+        if err:
+            msg = ctypes.cast(err, ctypes.c_char_p).value
+            lib.hvd_free_cstr(err)
+            raise AssertionError(msg)
 
 
 def test_checker_detects_codec_knob_order_drift():

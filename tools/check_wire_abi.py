@@ -36,9 +36,17 @@ def _parse_enum(text: str, enum_name: str) -> dict[str, int]:
 
 
 def _parse_constant(text: str, name: str) -> int | None:
+    # value forms: hex, decimal, or a single shift expression (`1 << 20`,
+    # the priority-bound idiom) — anything fancier should be spelled out
     m = re.search(r"constexpr\s+\w+(?:_t)?\s+" + name +
-                  r"\s*=\s*(0x[0-9a-fA-F]+|\d+)u?", text)
-    return int(m.group(1), 0) if m else None
+                  r"\s*=\s*(0x[0-9a-fA-F]+|\d+(?:\s*<<\s*\d+)?)u?", text)
+    if not m:
+        return None
+    value = m.group(1)
+    if "<<" in value:
+        base, shift = value.split("<<")
+        return int(base.strip(), 0) << int(shift.strip(), 0)
+    return int(value, 0)
 
 
 def _parse_string_constant(text: str, name: str) -> str | None:
@@ -269,6 +277,40 @@ def check(wire_h: str, common_h: str,
             problems.append(
                 f"codec ids: codec.h has {got}, wire_abi.py CODEC_IDS "
                 f"has {wire_abi.CODEC_IDS}")
+
+    # priority response scheduling (v13): the bounds are wire-visible (the
+    # parser rejects out-of-range priority blocks as torn frames, and both
+    # ends must agree on what "max" means for the auto-derivation count-
+    # down), so each gets its own pin
+    for cname, pyval in (("kPriorityMin", wire_abi.PRIORITY_MIN),
+                         ("kPriorityMax", wire_abi.PRIORITY_MAX)):
+        got = _parse_constant(wire_h, cname)
+        if got != pyval:
+            problems.append(
+                f"{cname}: wire.h has {got}, wire_abi.py has {pyval}")
+    # struct Request must declare the (non-serialized, frame-block-carried)
+    # priority field — losing it without downgrading the version is the
+    # drift this guard bites on, same shape as the v11 generation pin
+    m = re.search(r"struct\s+Request\s*\{(.*?)\n\};", wire_h, re.S)
+    if not m or not re.search(r"int32_t\s+priority\s*=", m.group(1)):
+        problems.append(
+            "Request: wire.h lost the v13 `priority` field the "
+            "RequestList trailing block serializes")
+    # the trailing priority block rides exactly the frames the mirror
+    # lists, anchored AFTER the audits block (trailing-chain order: set
+    # tag, audits, priorities) — the block is comment-anchored in the
+    # struct body since its values live in Request::priority
+    for struct in wire_abi.PRIORITY_TAGGED_FRAMES:
+        m = re.search(r"struct\s+" + struct + r"\s*\{(.*?)\n\};", wire_h,
+                      re.S)
+        body = m.group(1) if m else ""
+        a_at = body.find("audits")
+        p_at = body.find("priorit")
+        if not (0 <= a_at < p_at):
+            problems.append(
+                f"{struct}: the v13 trailing priority block must be "
+                "anchored after `audits` (trailing-chain serialization "
+                "order)")
 
     ops = _parse_enum(common_h, "OpType")
     if ops != wire_abi.OP_TYPES:
